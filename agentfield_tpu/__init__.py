@@ -1,0 +1,19 @@
+"""agentfield_tpu — a TPU-native agent orchestration framework.
+
+Capabilities mirror the reference AgentField platform ("Kubernetes for AI
+agents": control plane + polyglot agent nodes + async execution + workflow
+DAG + shared memory + DID/VC audit), with the external-LLM execution path
+replaced by an in-tree TPU serving backend (JAX/XLA/Pallas/pjit).
+
+Subpackages
+-----------
+- ``models``        functional JAX model implementations (Llama family)
+- ``ops``           Pallas TPU kernels (flash attention, paged attention)
+- ``parallel``      device meshes, GSPMD sharding rules, ring attention
+- ``serving``       paged KV cache + continuous-batching inference engine
+- ``training``      sharded train step (fine-tuning path)
+- ``control_plane`` the orchestration server (nodes, executions, memory, ...)
+- ``sdk``           the agent-developer SDK (Agent, @reasoner, ai(), call())
+"""
+
+__version__ = "0.1.0"

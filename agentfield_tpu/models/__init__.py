@@ -1,0 +1,10 @@
+from agentfield_tpu.models.configs import (  # noqa: F401
+    LlamaConfig,
+    PRESETS,
+    get_config,
+)
+from agentfield_tpu.models.llama import (  # noqa: F401
+    init_params,
+    forward,
+    make_contiguous_cache,
+)

@@ -1,0 +1,111 @@
+"""Model configuration presets for the Llama family.
+
+The reference delegates all model choice to external providers via litellm
+(reference: sdk/python/agentfield/agent_ai.py:342-343, model fallback chain at
+agent_ai.py:345-384); here models are in-tree, so configs are first-class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    # dtype name, resolved lazily so configs stay hashable / serializable
+    dtype: str = "bfloat16"
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (for memory planning)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = d * (self.q_dim + 2 * self.kv_dim) + self.q_dim * d + 3 * d * f + 2 * d
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + embed + d
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    # Tiny config for unit tests — MXU-aligned dims, trivially fast on CPU.
+    "llama-tiny": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        max_seq_len=256,
+        dtype="float32",
+    ),
+    # A mid-size config for single-chip smoke benches (~0.3B).
+    "llama-smoke": LlamaConfig(
+        vocab_size=32768,
+        hidden_size=1024,
+        intermediate_size=4096,
+        num_layers=8,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=64,
+        max_seq_len=4096,
+    ),
+    # Llama 3.2 1B (north-star config 1: greeting-agent smoke model).
+    "llama-3.2-1b": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        tie_embeddings=True,
+        max_seq_len=8192,
+    ),
+    # Llama 3 8B (primary north-star model).
+    "llama-3-8b": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=8192,
+    ),
+    # Llama 3 70B (TP=8 over ICI, north-star config 5).
+    "llama-3-70b": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=8192,
+    ),
+}
+
+
+def get_config(name: str) -> LlamaConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown model config {name!r}; known: {sorted(PRESETS)}") from None

@@ -1,0 +1,242 @@
+"""Functional JAX implementation of the Llama decoder family.
+
+TPU-first design:
+
+- Parameters are a plain pytree with all transformer layers **stacked** on a
+  leading axis; the forward pass is a single ``lax.scan`` over layers, so XLA
+  compiles one layer body regardless of depth (fast compiles, perfect for
+  pjit partitioning and pipeline stages later).
+- Every projection is stored ``[in, out]`` so ``x @ w`` lands on the MXU with
+  no transposes; softmax/norm accumulation is float32, weights bfloat16.
+- No data-dependent Python control flow — everything is jit/scan/pjit safe.
+
+This is the in-tree replacement for the reference's external-provider LLM
+path (reference: sdk/python/agentfield/agent_ai.py:95-447 delegates
+``Agent.ai()`` to litellm; here the model is local and TPU-resident).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from agentfield_tpu.models.configs import LlamaConfig
+
+Params = dict[str, Any]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from all-masked rows
+
+
+def resolve_dtype(name: str) -> jnp.dtype:
+    return jnp.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, dtype: str | None = None) -> Params:
+    """Random-normal init. Layers are stacked on axis 0 of every layer leaf."""
+    dt = resolve_dtype(dtype or cfg.dtype)
+    d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 10)
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    scale = 0.02
+    params: Params = {
+        "embed": norm(keys[0], (v, d), scale),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dt),
+            "mlp_norm": jnp.ones((L, d), dt),
+            "wq": norm(keys[1], (L, d, cfg.q_dim), scale),
+            "wk": norm(keys[2], (L, d, cfg.kv_dim), scale),
+            "wv": norm(keys[3], (L, d, cfg.kv_dim), scale),
+            "wo": norm(keys[4], (L, cfg.q_dim, d), scale),
+            "w_gate": norm(keys[5], (L, d, f), scale),
+            "w_up": norm(keys[6], (L, d, f), scale),
+            "w_down": norm(keys[7], (L, f, d), scale),
+        },
+        "final_norm": jnp.ones((d,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(keys[8], (d, v), scale)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks (shared with the paged serving engine)
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_sincos(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables for the given absolute positions. positions: [...]."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs split at head_dim/2 (HF 'rotate_half' convention, so HF
+    checkpoints load without permutation). x: [B, S, N, hd]; cos/sin: [B, S, hd/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    c, s = cos[..., None, :], sin[..., None, :]  # broadcast over heads
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)
+
+
+def attention_ref(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, T, Kh, hd]
+    v: jax.Array,  # [B, T, Kh, hd]
+    q_pos: jax.Array,  # [B, S] absolute positions of queries
+    k_pos: jax.Array,  # [B, T] absolute positions of keys
+    k_valid: jax.Array,  # [B, T] bool — is this key slot populated
+) -> jax.Array:
+    """Reference GQA attention with causal+validity masking, f32 softmax.
+
+    This is the XLA-fused fallback; the Pallas flash/paged kernels in
+    ``agentfield_tpu.ops`` are drop-in replacements on TPU.
+    """
+    B, S, H, hd = q.shape
+    Kh = k.shape[2]
+    rep = H // Kh
+    qg = q.reshape(B, S, Kh, rep, hd)
+    logits = jnp.einsum(
+        "bskrh,btkh->bkrst", qg, k, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    mask = (k_pos[:, None, :] <= q_pos[:, :, None]) & k_valid[:, None, :]  # [B,S,T]
+    logits = jnp.where(mask[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkrst,btkh->bskrh", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
+def qkv_proj(lp: Params, x_normed: jax.Array, cfg: LlamaConfig, cos, sin):
+    """Project + rope. Returns q [B,S,H,hd], k/v [B,S,Kh,hd]."""
+    B, S, _ = x_normed.shape
+    q = (x_normed @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = (x_normed @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = (x_normed @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def mlp_block(lp: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return ((gate * (h @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+
+
+def unembed(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (h @ w).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Full forward (no cache / contiguous cache)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def forward(params: Params, cfg: LlamaConfig, tokens: jax.Array, positions: jax.Array):
+    """Dense causal forward. tokens/positions: [B, S].
+
+    Returns (logits [B, S, V] float32, (k, v) each [L, B, S, Kh, hd]) — the
+    per-layer K/V are the scan outputs, free to collect, and are what a
+    serving prefill writes into the paged cache.
+    """
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = qkv_proj(lp, h, cfg, cos, sin)
+        attn = attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
+        x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
+        x = x + mlp_block(lp, x, cfg)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    return unembed(params, cfg, x), (ks, vs)
+
+
+def make_contiguous_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype: str | None = None):
+    dt = resolve_dtype(dtype or cfg.dtype)
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def forward_with_cache(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S]
+    cache: dict[str, jax.Array],
+    offset: jax.Array,  # scalar int32: write position (rows aligned; ragged
+    # batches are the paged engine's job, serving/engine.py)
+):
+    """Incremental forward over a contiguous KV cache (simple generation path,
+    used for correctness testing of the paged engine and by __graft_entry__).
+    """
+    B, S = tokens.shape
+    T = cache["k"].shape[2]
+    positions = offset + jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
+    k_valid = k_pos < (offset + S)
+
+    def body(x, xs):
+        lp, ck, cv = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = qkv_proj(lp, h, cfg, cos, sin)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, offset, 0, 0))
+        attn = attention_ref(q, ck, cv, positions, k_pos, k_valid)
+        x = x + (attn.reshape(B, S, -1) @ lp["wo"]).astype(x.dtype)
+        x = x + mlp_block(lp, x, cfg)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    return unembed(params, cfg, x), {"k": ks, "v": vs}
+
+
+def generate_greedy(params, cfg: LlamaConfig, prompt: jax.Array, num_steps: int, max_len: int):
+    """Greedy decode via the contiguous cache — a correctness oracle for the
+    continuous-batching engine, not the serving path."""
+    B, S = prompt.shape
+    if num_steps < 1:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+    # The final generated token is returned but never written to the cache,
+    # so only S + num_steps - 1 slots are needed.
+    if S + num_steps - 1 > max_len:
+        raise ValueError(
+            f"prompt ({S}) + num_steps ({num_steps}) - 1 exceeds max_len ({max_len}); "
+            "dynamic_update_slice would silently clamp the cache write"
+        )
+    cache = make_contiguous_cache(cfg, B, max_len)
+    logits, cache = forward_with_cache(params, cfg, prompt, cache, jnp.int32(0))
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    out = [tok]
+    for i in range(num_steps - 1):
+        logits, cache = forward_with_cache(params, cfg, tok[:, None], cache, jnp.int32(S + i))
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out.append(tok)
+    return jnp.stack(out, axis=1)

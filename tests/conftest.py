@@ -1,0 +1,21 @@
+"""Test environment: force CPU with 8 virtual devices so multi-chip sharding
+paths (tp/dp/sp meshes, collectives) are exercised hermetically, mirroring the
+reference's "N processes on localhost" integration strategy
+(reference: sdk/python/tests/integration/conftest.py:113-166)."""
+
+import os
+
+# Must run before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng_key():
+    import jax
+
+    return jax.random.PRNGKey(0)

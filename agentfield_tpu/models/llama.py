@@ -154,13 +154,22 @@ def unembed(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def forward(params: Params, cfg: LlamaConfig, tokens: jax.Array, positions: jax.Array):
+@functools.partial(jax.jit, static_argnames=("cfg", "collect_kv", "remat"))
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    collect_kv: bool = True,
+    remat: bool = False,
+):
     """Dense causal forward. tokens/positions: [B, S].
 
     Returns (logits [B, S, V] float32, (k, v) each [L, B, S, Kh, hd]) — the
     per-layer K/V are the scan outputs, free to collect, and are what a
-    serving prefill writes into the paged cache.
+    serving prefill writes into the paged cache. Training passes
+    ``collect_kv=False`` (don't materialize caches) and ``remat=True``
+    (rematerialize the layer body in backward, trading FLOPs for HBM).
     """
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
@@ -171,10 +180,12 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jax.Array, positions: jax.
         attn = attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
         x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
         x = x + mlp_block(lp, x, cfg)
-        return x, (k, v)
+        return x, ((k, v) if collect_kv else None)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-    return unembed(params, cfg, x), (ks, vs)
+    if remat:
+        body = jax.checkpoint(body)
+    x, kv = jax.lax.scan(body, x, params["layers"])
+    return unembed(params, cfg, x), kv
 
 
 def make_contiguous_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype: str | None = None):

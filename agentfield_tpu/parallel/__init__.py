@@ -1,0 +1,15 @@
+from agentfield_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    AXIS_STAGE,
+    auto_mesh_shape,
+    make_mesh,
+    use_mesh,
+)
+from agentfield_tpu.parallel.sharding import (  # noqa: F401
+    named_sharding,
+    param_pspecs,
+    shard_params,
+)

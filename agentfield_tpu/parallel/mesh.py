@@ -1,0 +1,71 @@
+"""Device meshes for the TPU build.
+
+The reference's only distribution axes are HTTP-coordinated agent processes
+and a Go worker pool (reference: internal/handlers/execute.go:1341-1386, SURVEY
+§2.4) — tensor math happened in external providers. Here the compute scales
+over a ``jax.sharding.Mesh``: XLA inserts ICI/DCN collectives from sharding
+annotations; the control plane never touches tensor traffic.
+
+Canonical axis names (used by every PartitionSpec in the repo):
+
+- ``data``    — batch/data parallelism (DP)
+- ``model``   — tensor parallelism over heads / ffn dims (TP, rides ICI)
+- ``seq``     — sequence/context parallelism (SP/CP, ring attention)
+- ``expert``  — expert parallelism for MoE layers (EP)
+- ``stage``   — pipeline stages across slices (PP, rides DCN)
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_STAGE = "stage"
+
+# Mesh axis order: slower-varying axes first so that `model` (the most
+# bandwidth-hungry axis) maps to physically adjacent devices on the ICI torus.
+CANONICAL_ORDER = (AXIS_STAGE, AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+
+
+def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a Mesh from an {axis: size} dict. Axes are laid out in
+    CANONICAL_ORDER; missing axes get size 1 (so PartitionSpecs referring to
+    any canonical axis always resolve)."""
+    if devices is None:
+        devices = jax.devices()
+    shape = dict(shape or {})
+    n = int(np.prod(list(shape.values()))) if shape else len(devices)
+    if not shape:
+        shape = {AXIS_DATA: n}
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
+    full = [(ax, shape.get(ax, 1)) for ax in CANONICAL_ORDER]
+    dims = [s for _, s in full]
+    names = [ax for ax, _ in full]
+    dev_array = np.asarray(devices[:n]).reshape(dims)
+    return Mesh(dev_array, axis_names=names)
+
+
+def use_mesh(mesh: Mesh):
+    """Context manager making `mesh` the ambient mesh (jax>=0.9 renamed
+    use_mesh → set_mesh; accept either)."""
+    setter = getattr(jax.sharding, "set_mesh", None) or jax.sharding.use_mesh
+    return setter(mesh)
+
+
+def auto_mesh_shape(n_devices: int, tp: int | None = None) -> dict[str, int]:
+    """Factor n_devices into {data, model}. If tp is not given, pick the
+    largest power-of-two TP degree ≤ 8 that divides n_devices — TP wants to
+    stay within one ICI domain; the rest goes to DP."""
+    if tp is None:
+        tp = 1
+        while tp < 8 and (n_devices % (tp * 2) == 0):
+            tp *= 2
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    return {AXIS_DATA: n_devices // tp, AXIS_MODEL: tp}

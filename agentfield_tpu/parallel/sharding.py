@@ -1,0 +1,69 @@
+"""GSPMD sharding rules for Llama parameter pytrees.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs: qkv and
+gate/up projections are column-parallel (output dim on ``model``), o_proj and
+down_proj are row-parallel (input dim on ``model``), embeddings shard the
+vocab. XLA inserts the psum/all-gathers over ICI — there is no hand-written
+collective in the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+
+def param_pspecs(cfg: LlamaConfig) -> dict[str, Any]:
+    """PartitionSpec pytree matching ``models.llama.init_params``.
+    Layer leaves have a leading stacked-layer axis (never sharded — it is
+    scanned over; pipeline parallelism splits it explicitly instead)."""
+    m = AXIS_MODEL
+    specs: dict[str, Any] = {
+        "embed": P(m, None),  # vocab-sharded; doubles as column-parallel tied lm_head
+        "layers": {
+            "attn_norm": P(None, None),
+            "mlp_norm": P(None, None),
+            "wq": P(None, None, m),
+            "wk": P(None, None, m),
+            "wv": P(None, None, m),
+            "wo": P(None, m, None),
+            "w_gate": P(None, None, m),
+            "w_up": P(None, None, m),
+            "w_down": P(None, m, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, m)
+    return specs
+
+
+def named_sharding(mesh: Mesh, spec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, cfg: LlamaConfig, mesh: Mesh) -> Any:
+    """Place an (unsharded) param pytree onto the mesh. One pytree-aware
+    device_put so XLA batches the host-to-device transfers."""
+    return jax.device_put(params, named_sharding(mesh, param_pspecs(cfg)))
+
+
+def check_divisibility(cfg: LlamaConfig, tp: int) -> None:
+    """TP degree must divide every model-sharded dimension."""
+    for name, dim in [
+        ("q_dim", cfg.q_dim),
+        ("kv_dim", cfg.kv_dim),
+        ("intermediate_size", cfg.intermediate_size),
+        ("vocab_size", cfg.vocab_size),
+    ]:
+        if dim % tp:
+            raise ValueError(f"tp={tp} does not divide {name}={dim} for this config")

@@ -1,0 +1,6 @@
+from agentfield_tpu.training.trainer import (  # noqa: F401
+    TrainState,
+    causal_lm_loss,
+    make_train_step,
+    init_train_state,
+)

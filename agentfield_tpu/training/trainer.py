@@ -1,0 +1,83 @@
+"""Sharded training step (fine-tuning path).
+
+The reference has no training at all (models live behind external APIs); this
+is a new first-class component per SURVEY §2.4. Design: pure-functional optax
+step under one ``jax.jit`` — params/opt-state carry NamedShardings (TP over
+``model``, batch over ``data``), so XLA emits the reduce-scatter/all-reduce
+pattern over ICI with no hand-written collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.models.llama import forward, init_params
+from agentfield_tpu.parallel.mesh import AXIS_DATA
+from agentfield_tpu.parallel.sharding import named_sharding, param_pspecs
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def causal_lm_loss(params, cfg: LlamaConfig, batch: dict[str, jax.Array]):
+    """Masked next-token cross-entropy. batch: tokens/positions/targets [B,S];
+    targets < 0 are ignored (padding)."""
+    logits, _ = forward(
+        params, cfg, batch["tokens"], batch["positions"], collect_kv=False, remat=True
+    )
+    targets = batch["targets"]
+    mask = (targets >= 0).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = -(ll * mask).sum() / denom
+    return loss, {"loss": loss, "tokens": mask.sum()}
+
+
+def init_train_state(
+    cfg: LlamaConfig,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    dtype: str | None = None,
+) -> TrainState:
+    """Initialize params directly sharded on the mesh (jit with out_shardings,
+    so a 70B init never materializes unsharded) and derive opt-state with
+    matching placement."""
+    if mesh is None:
+        params = init_params(cfg, key, dtype)
+    else:
+        shardings = named_sharding(mesh, param_pspecs(cfg))
+        params = jax.jit(
+            lambda k: init_params(cfg, k, dtype), out_shardings=shardings
+        )(key)
+    opt_state = optimizer.init(params)  # moments inherit param shardings
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, batch: dict[str, jax.Array]):
+        grad_fn = jax.value_and_grad(causal_lm_loss, has_aux=True)
+        (loss, metrics), grads = grad_fn(state.params, cfg, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return train_step
+
+
+def shard_batch(batch: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Array]:
+    """Place a host batch with the batch dim split over the ``data`` axis."""
+    sharding = jax.sharding.NamedSharding(mesh, P(AXIS_DATA, None))
+    return {k: jax.device_put(v, sharding) for k, v in batch.items()}

@@ -1,15 +1,29 @@
 """Test environment: force CPU with 8 virtual devices so multi-chip sharding
 paths (tp/dp/sp meshes, collectives) are exercised hermetically, mirroring the
 reference's "N processes on localhost" integration strategy
-(reference: sdk/python/tests/integration/conftest.py:113-166)."""
+(reference: sdk/python/tests/integration/conftest.py:113-166).
+
+Subtlety: this image's sitecustomize imports jax at *interpreter start* (the
+axon TPU tunnel), so jax's config has already latched JAX_PLATFORMS=axon from
+the environment and plain env assignment here is too late. jax.config.update
+still works because the *backend* only initializes on first use, which is
+after conftest import. XLA_FLAGS is read by the CPU client at backend-init
+time, so setting it here is still effective.
+
+Set AGENTFIELD_TPU_TEST_REAL=1 to run the suite against the real chip.
+"""
 
 import os
 
-# Must run before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("AGENTFIELD_TPU_TEST_REAL", "").lower() not in ("1", "true", "yes"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,30 @@
+"""Backend/environment compatibility helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(virtual_devices: int | None = None) -> None:
+    """Force the CPU backend even when the image preloads a TPU plugin.
+
+    This image's sitecustomize imports jax at *interpreter start* (the axon
+    TPU tunnel), so jax's config has already latched JAX_PLATFORMS from the
+    environment and plain env assignment is too late. jax.config.update still
+    works because *backends* initialize lazily, on first use — which is after
+    any caller of this helper. XLA_FLAGS is read by the CPU client at
+    backend-init time, so setting it here is also still effective.
+
+    Must be called before the first jax computation / jax.devices() call.
+    """
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

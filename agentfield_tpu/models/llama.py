@@ -154,8 +154,7 @@ def unembed(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "collect_kv", "remat"))
-def forward(
+def forward_impl(
     params: Params,
     cfg: LlamaConfig,
     tokens: jax.Array,
@@ -186,6 +185,9 @@ def forward(
         body = jax.checkpoint(body)
     x, kv = jax.lax.scan(body, x, params["layers"])
     return unembed(params, cfg, x), kv
+
+
+forward = jax.jit(forward_impl, static_argnames=("cfg", "collect_kv", "remat"))
 
 
 def make_contiguous_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype: str | None = None):

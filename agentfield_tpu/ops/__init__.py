@@ -1,0 +1,1 @@
+from agentfield_tpu.ops.paged_attention import paged_attention  # noqa: F401

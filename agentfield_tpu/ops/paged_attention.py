@@ -1,0 +1,58 @@
+"""Paged decode attention.
+
+``paged_attention`` computes single-token GQA attention where K/V live in a
+paged HBM pool indexed through per-sequence page tables (the kernel pattern
+from the ragged-paged-attention line of work — see PAPERS.md).
+
+Two implementations:
+
+- ``ref``   — gather pages with XLA (materializes [B, max_ctx] K/V in HBM,
+  correct everywhere incl. CPU tests; bandwidth-wasteful).
+- ``pallas`` — Pallas TPU kernel that streams pages HBM→VMEM per sequence
+  and never materializes the gathered context (added in ops/pallas; selected
+  automatically on TPU backends once registered).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def paged_attention_ref(
+    q: jax.Array,  # [B, H, hd]       — one query token per sequence
+    k_pages: jax.Array,  # [P, ps, Kh, hd]  — one layer's page pool
+    v_pages: jax.Array,  # [P, ps, Kh, hd]
+    page_tables: jax.Array,  # [B, maxp] int32 page ids (0 = garbage page)
+    seq_lens: jax.Array,  # [B] int32 — #valid tokens (incl. current) per sequence
+) -> jax.Array:
+    """Reference implementation via page gather. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    P, ps, Kh, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    T = maxp * ps
+
+    k = k_pages[page_tables].reshape(B, T, Kh, hd)
+    v = v_pages[page_tables].reshape(B, T, Kh, hd)
+
+    rep = H // Kh
+    qg = q.reshape(B, Kh, rep, hd)
+    logits = jnp.einsum("bkrh,btkh->bkrt", qg, k, preferred_element_type=jnp.float32)
+    logits = logits * (hd ** -0.5)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, T]
+    logits = jnp.where(valid[:, None, None, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkrt,btkh->bkrh", probs, v, preferred_element_type=jnp.float32)
+    return out.reshape(B, H, hd).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref"):
+    if impl == "ref":
+        return paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens)
+    if impl == "pallas":
+        from agentfield_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
+
+        return paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens)
+    raise ValueError(f"unknown paged_attention impl {impl!r}")

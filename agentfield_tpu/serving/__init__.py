@@ -1,0 +1,8 @@
+from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache  # noqa: F401
+from agentfield_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    InferenceEngine,
+    Request,
+    TokenEvent,
+)
+from agentfield_tpu.serving.sampler import SamplingParams  # noqa: F401

@@ -1,0 +1,389 @@
+"""Continuous-batching inference engine.
+
+This is the TPU-native heart of the framework: it replaces the reference's
+external-LLM hot path (``Agent.ai()`` → litellm → provider API,
+sdk/python/agentfield/agent_ai.py:95-447) with an in-tree engine, and its
+scheduling semantics mirror the reference's async execution queue
+(internal/handlers/execute.go:121-152,1302-1439): bounded admission with
+explicit backpressure, and N concurrent requests coalesced into shared decode
+steps (SURVEY §2.4 "serving engine" row; BASELINE.json configs[2]).
+
+Design:
+
+- **Decode** is one jitted step over a fixed ``max_batch`` of slots; inactive
+  slots write their K/V to the reserved garbage page so shapes stay static.
+- **Prefill** is one request at a time, padded to a static bucket length, KV
+  scattered directly into the paged pool.
+- **Host scheduler** (``step()``) admits pending requests when pages+slot are
+  free (prefill-prioritized), otherwise runs a decode step; tokens stream out
+  as ``TokenEvent``s — the transport layer (gRPC/SSE) subscribes to these the
+  way reference clients subscribe to execution events
+  (internal/handlers/execute.go:568).
+
+All device work is functional: page pools are donated through the jitted
+steps, so XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.models import llama
+from agentfield_tpu.ops.paged_attention import paged_attention
+from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache, build_page_table
+from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_batch: int = 32  # concurrent decode slots
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 32  # max context = max_pages_per_seq * page_size
+    max_pending: int = 1024  # admission queue bound (reference queue default:
+    # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
+    attn_impl: str = "ref"
+    dtype: str | None = None
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def prefill_bucket(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.max_context)
+
+
+@dataclasses.dataclass
+class Request:
+    id: str
+    prompt: list[int]
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    request_id: str
+    token: int
+    index: int  # 0-based index among generated tokens
+    finished: bool
+    finish_reason: str | None = None  # "stop" | "length"
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    pages: list[int]
+    length: int  # tokens whose K/V are (or will be) cached, incl. pending last token
+    generated: int
+    last_token: int
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
+    """Jitted decode step, cached per (model, engine) config so every engine
+    instance shares one compilation."""
+    ps = ecfg.page_size
+
+    def decode(params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps, top_ks, top_ps):
+        B = tokens.shape[0]
+        positions = seq_lens  # 0-based position of the incoming token
+        x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
+        cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta)
+        page_idx = jnp.take_along_axis(
+            page_tables, (seq_lens // ps)[:, None], axis=1
+        )[:, 0]  # [B] page holding this token (garbage page 0 when inactive)
+        slot_idx = seq_lens % ps
+
+        def body(x, xs):
+            lp, kp, vp = xs
+            h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
+            kp = kp.at[page_idx, slot_idx].set(k[:, 0])
+            vp = vp.at[page_idx, slot_idx].set(v[:, 0])
+            attn = paged_attention(
+                q[:, 0], kp, vp, page_tables, seq_lens + 1, impl=ecfg.attn_impl
+            )
+            x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
+            x = x + llama.mlp_block(lp, x, cfg)
+            return x, (kp, vp)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+        logits = llama.unembed(params, cfg, x)[:, 0]  # [B, V]
+        next_tokens = sample_tokens(logits, rng, temps, top_ks, top_ps)
+        # Advance lengths on-device (active slots have seq_len > 0) so the
+        # host never re-uploads control state during steady-state decode.
+        new_seq_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
+        return next_tokens, new_seq_lens, kp, vp
+
+    return jax.jit(decode, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
+    ps = ecfg.page_size
+
+    def prefill(params, k_pages, v_pages, tokens, length, page_table_row):
+        # tokens: [1, bucket]; positions past `length` are padding whose
+        # K/V are routed to the garbage page.
+        positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+        logits, (ks, vs) = llama.forward_impl(params, cfg, tokens, positions)
+        pos = positions[0]
+        in_range = pos < length
+        page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
+        slot_ids = pos % ps
+        k_pages = k_pages.at[:, page_ids, slot_ids].set(ks[:, 0])
+        v_pages = v_pages.at[:, page_ids, slot_ids].set(vs[:, 0])
+        last = logits[0, length - 1]
+        return last, k_pages, v_pages
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+class QueueFullError(Exception):
+    """Admission queue at capacity — surfaced as backpressure (the reference
+    returns HTTP 503 from the async gateway, execute.go:333-346)."""
+
+
+class RequestTooLongError(Exception):
+    pass
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        params: Any,
+        cfg: LlamaConfig,
+        ecfg: EngineConfig | None = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.ecfg = ecfg or EngineConfig()
+        if self.ecfg.max_pages_per_seq > self.ecfg.num_pages - 1:
+            raise ValueError(
+                f"max_pages_per_seq={self.ecfg.max_pages_per_seq} cannot exceed "
+                f"num_pages-1={self.ecfg.num_pages - 1} (page 0 is reserved); "
+                "an admitted request could otherwise never obtain its pages"
+            )
+        self.params = params
+        self.cache = PagedKVCache.create(
+            cfg, self.ecfg.num_pages, self.ecfg.page_size, self.ecfg.dtype
+        )
+        self.allocator = PageAllocator(self.ecfg.num_pages)
+        B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
+        self.page_tables = np.zeros((B, maxp), np.int32)
+        self.seq_lens = np.zeros((B,), np.int32)
+        self.last_tokens = np.zeros((B,), np.int32)
+        self.temps = np.zeros((B,), np.float32)
+        self.top_ks = np.zeros((B,), np.int32)
+        self.top_ps = np.ones((B,), np.float32)
+        self.slots: list[_Slot | None] = [None] * B
+        self.pending: collections.deque[Request] = collections.deque()
+        self._rng = jax.random.PRNGKey(seed)
+        self._decode_jit = _decode_fn(cfg, self.ecfg)
+        # Device-resident copies of the control arrays; refreshed from the
+        # numpy shadows only when admission/release dirties them.
+        self._dirty = True
+        self._dev: dict[str, jax.Array] = {}
+        # Counters (exported via the control plane's /metrics, mirroring the
+        # reference's gateway gauges, internal/services/execution_metrics.go:14-44)
+        self.stats = {
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "decode_steps": 0,
+            "requests_finished": 0,
+            "backpressure_total": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # host-side scheduling
+    # ------------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Enqueue a request. Raises QueueFullError at capacity and
+        RequestTooLongError if it can never fit the page budget."""
+        if not req.prompt:
+            raise ValueError(f"request {req.id}: prompt must be non-empty")
+        needed = self._pages_needed(req)
+        if needed > self.ecfg.max_pages_per_seq:
+            raise RequestTooLongError(
+                f"request {req.id}: {len(req.prompt)} prompt + "
+                f"{req.sampling.max_new_tokens} new tokens needs {needed} pages "
+                f"> max_pages_per_seq={self.ecfg.max_pages_per_seq}"
+            )
+        if len(self.pending) >= self.ecfg.max_pending:
+            self.stats["backpressure_total"] += 1
+            raise QueueFullError(f"pending queue at capacity {self.ecfg.max_pending}")
+        self.pending.append(req)
+
+    def _pages_needed(self, req: Request) -> int:
+        total = len(req.prompt) + req.sampling.max_new_tokens
+        return -(-total // self.ecfg.page_size)
+
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or self.num_active > 0
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _try_admit(self) -> list[TokenEvent]:
+        """Admit one pending request: allocate pages, prefill, sample first
+        token. Returns its first TokenEvent (possibly already finished)."""
+        if not self.pending:
+            return []
+        free_slot = next((i for i, s in enumerate(self.slots) if s is None), None)
+        if free_slot is None:
+            return []
+        req = self.pending[0]
+        pages = self.allocator.alloc(self._pages_needed(req))
+        if pages is None:
+            # Page-starved: stay pending; decode steps will free pages.
+            # (Not counted as backpressure — that counter mirrors per-request
+            # queue-full rejections, the reference's 503 analogue.)
+            return []
+        self.pending.popleft()
+
+        prompt = np.asarray(req.prompt, np.int32)
+        bucket = self.ecfg.prefill_bucket(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        row = build_page_table(pages, self.ecfg.max_pages_per_seq)
+
+        fn = _prefill_fn(self.cfg, self.ecfg, bucket)
+        last_logits, self.cache.k_pages, self.cache.v_pages = fn(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(padded),
+            jnp.int32(len(prompt)),
+            jnp.asarray(row),
+        )
+        s = req.sampling
+        tok = int(
+            sample_tokens(
+                last_logits[None],
+                self._next_rng(),
+                jnp.asarray([s.temperature], jnp.float32),
+                jnp.asarray([s.top_k], jnp.int32),
+                jnp.asarray([s.top_p], jnp.float32),
+            )[0]
+        )
+        self.stats["prefill_tokens"] += len(prompt)
+
+        slot = _Slot(req=req, pages=pages, length=len(prompt), generated=1, last_token=tok)
+        event = self._emit(free_slot, slot, tok)
+        if not event.finished:
+            self.slots[free_slot] = slot
+            self.page_tables[free_slot] = row
+            self.seq_lens[free_slot] = slot.length
+            self.last_tokens[free_slot] = tok
+            self.temps[free_slot] = s.temperature
+            self.top_ks[free_slot] = s.top_k
+            self.top_ps[free_slot] = s.top_p
+        self._dirty = True
+        return [event]
+
+    def _emit(self, slot_idx: int, slot: _Slot, tok: int) -> TokenEvent:
+        s = slot.req.sampling
+        reason = None
+        if tok in s.stop_token_ids:
+            reason = "stop"
+        elif slot.generated >= s.max_new_tokens:
+            reason = "length"
+        ev = TokenEvent(
+            request_id=slot.req.id,
+            token=tok,
+            index=slot.generated - 1,
+            finished=reason is not None,
+            finish_reason=reason,
+        )
+        if ev.finished:
+            self._release(slot_idx, slot)
+        return ev
+
+    def _release(self, slot_idx: int, slot: _Slot) -> None:
+        self.allocator.free(slot.pages)
+        self.stats["requests_finished"] += 1
+        if self.slots[slot_idx] is slot:
+            self.slots[slot_idx] = None
+        self.page_tables[slot_idx] = 0
+        self.seq_lens[slot_idx] = 0
+        self.temps[slot_idx] = 0.0
+        self.top_ks[slot_idx] = 0
+        self.top_ps[slot_idx] = 1.0
+        self._dirty = True
+
+    def step(self) -> list[TokenEvent]:
+        """One scheduler tick: admit (prefill) if possible, else decode."""
+        events = self._try_admit()
+        if events:
+            return events
+        if self.num_active == 0:
+            return []
+
+        if self._dirty:
+            self._dev = {
+                "tokens": jnp.asarray(self.last_tokens),
+                "seq_lens": jnp.asarray(self.seq_lens),
+                "page_tables": jnp.asarray(self.page_tables),
+                "temps": jnp.asarray(self.temps),
+                "top_ks": jnp.asarray(self.top_ks),
+                "top_ps": jnp.asarray(self.top_ps),
+            }
+            self._dirty = False
+        d = self._dev
+        next_tokens, new_seq_lens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            d["tokens"],
+            d["seq_lens"],
+            d["page_tables"],
+            self._next_rng(),
+            d["temps"],
+            d["top_ks"],
+            d["top_ps"],
+        )
+        d["tokens"], d["seq_lens"] = next_tokens, new_seq_lens
+        next_np = np.asarray(next_tokens)
+        self.stats["decode_steps"] += 1
+
+        out: list[TokenEvent] = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            slot.length += 1
+            slot.generated += 1
+            tok = int(next_np[i])
+            slot.last_token = tok
+            self.seq_lens[i] = slot.length
+            self.last_tokens[i] = tok
+            self.stats["decode_tokens"] += 1
+            out.append(self._emit(i, slot, tok))
+        return out
+
+    def run_to_completion(self, requests: list[Request]) -> dict[str, list[int]]:
+        """Convenience driver: submit everything, step until drained, return
+        generated token lists (streaming callers use step() directly)."""
+        for r in requests:
+            self.submit(r)
+        results: dict[str, list[int]] = {r.id: [] for r in requests}
+        while self.has_work():
+            for ev in self.step():
+                results[ev.request_id].append(ev.token)
+        return results

@@ -1,0 +1,93 @@
+"""Paged KV cache: device-resident page pool + host-side allocator.
+
+TPU-first replacement for the reference's approach to context (the reference
+merely *trims prompts* to fit an external provider's window —
+sdk/python/agentfield/agent_ai.py:262-325). Here long sessions keep their KV
+resident in HBM pages so agent→agent call chains never re-prefill
+(SURVEY §5 "long-context" row, §7 step 7).
+
+Layout: ``[num_layers, num_pages, page_size, num_kv_heads, head_dim]`` —
+layers stacked on axis 0 so the decode step scans over them; the trailing
+``num_kv_heads * head_dim`` is lane-aligned (multiple of 128) for all real
+configs. Page 0 is reserved as a garbage sink: inactive decode slots write
+there, which keeps the decode step shape-static with no host branching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.models.llama import resolve_dtype
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: jnp.ndarray  # [L, P, ps, Kh, hd]
+    v_pages: jnp.ndarray  # [L, P, ps, Kh, hd]
+    page_size: int
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @staticmethod
+    def create(
+        cfg: LlamaConfig, num_pages: int, page_size: int, dtype: str | None = None
+    ) -> "PagedKVCache":
+        dt = resolve_dtype(dtype or cfg.dtype)
+        shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, dt), v_pages=jnp.zeros(shape, dt), page_size=page_size
+        )
+
+    def hbm_bytes(self) -> int:
+        return 2 * self.k_pages.size * self.k_pages.dtype.itemsize
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the device page pool.
+
+    Page 0 is never handed out (garbage sink for inactive slots). This is the
+    TPU analogue of the reference's queue-capacity backpressure
+    (reference: internal/handlers/execute.go:333-346 returns HTTP 503 when the
+    job queue is full): when no pages are free, admission fails and the
+    caller surfaces backpressure.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
+        self.num_pages = num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Allocate n pages or None (all-or-nothing, so a half-admitted
+        request never strands pages)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == 0 or p >= self.num_pages:
+                raise ValueError(f"invalid page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+def build_page_table(pages: list[int], max_pages: int) -> np.ndarray:
+    """Fixed-width page-table row; unused entries point at garbage page 0."""
+    if len(pages) > max_pages:
+        raise ValueError(f"{len(pages)} pages exceed table width {max_pages}")
+    row = np.zeros((max_pages,), np.int32)
+    row[: len(pages)] = pages
+    return row

@@ -1,0 +1,70 @@
+"""Token sampling for the serving engine.
+
+Replaces the reference's provider-side sampling knobs (temperature etc. were
+passed through litellm — sdk/python/agentfield/agent_ai.py:329-343). Greedy
+and temperature sampling are vectorized over the decode batch so mixed
+per-request settings share one jitted step (no shape specialization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → disabled
+    top_p: float = 1.0  # 1 → disabled
+    max_new_tokens: int = 128
+    stop_token_ids: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    temperatures: jax.Array,  # [B] float32; <=0 → greedy for that row
+    top_ks: jax.Array,  # [B] int32; 0 → disabled  (applied as top-K_MAX prefilter)
+    top_ps: jax.Array,  # [B] float32; >=1 → disabled
+    k_max: int = 64,  # static prefilter width for top-k/top-p rows
+) -> jax.Array:
+    """Vectorized mixed-strategy sampling. Rows with temperature<=0 take the
+    argmax. Rows with plain temperature sampling (top_k=0, top_p>=1) sample the
+    FULL tempered vocab. Rows requesting top-k/top-p truncation sample inside a
+    static ``k_max``-wide candidate set (one lax.top_k scan, no vocab sort);
+    requested top_k values larger than k_max are clamped to k_max."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    rng_full, rng_trunc = jax.random.split(rng)
+
+    # Full-vocab tempered sampling (exact for untruncated rows).
+    full = jax.random.categorical(rng_full, logits / temps, axis=-1).astype(jnp.int32)
+
+    # Truncated path inside the k_max candidate set.
+    vals, idxs = jax.lax.top_k(logits, k_max)  # [B, k_max] descending
+    scaled = vals / temps
+    ranks = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_ks[:, None] > 0, jnp.minimum(top_ks[:, None], k_max), k_max)
+    k_mask = ranks < k_eff
+    # nucleus mask on the tempered distribution (keep first token always)
+    probs = jax.nn.softmax(jnp.where(k_mask, scaled, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p_mask = (cum - probs) < jnp.minimum(top_ps, 1.0)[:, None]
+    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+    choice = jax.random.categorical(rng_trunc, masked, axis=-1)
+    trunc = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    truncated_row = (top_ks > 0) | (top_ps < 1.0)
+    sampled = jnp.where(truncated_row, trunc, full)
+    return jnp.where(temperatures <= 0, greedy, sampled)

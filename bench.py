@@ -1,0 +1,104 @@
+"""Headline benchmark: continuous-batching decode throughput on one chip.
+
+Mirrors BASELINE.json's north star (Agent.ai() served in-tree instead of via
+litellm): N concurrent reasoner-style requests coalesced into shared decode
+steps. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N/3000, ...}
+vs_baseline is against the 3,000 tok/s/chip north-star target (BASELINE.md).
+
+Env knobs: AGENTFIELD_BENCH_CPU=1 (debug on CPU), AGENTFIELD_BENCH_MODEL,
+AGENTFIELD_BENCH_REQUESTS, AGENTFIELD_BENCH_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    if os.environ.get("AGENTFIELD_BENCH_CPU") == "1":
+        from agentfield_tpu._compat import force_cpu_backend
+
+        force_cpu_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    model = os.environ.get("AGENTFIELD_BENCH_MODEL", "llama-3.2-1b")
+    n_requests = int(os.environ.get("AGENTFIELD_BENCH_REQUESTS", "256"))
+    max_batch = int(os.environ.get("AGENTFIELD_BENCH_BATCH", "64"))
+    prompt_len, new_tokens = 128, 128
+
+    cfg = get_config(model)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        max_batch=max_batch,
+        page_size=32,
+        num_pages=max_batch * 8 * 2 + 1,
+        max_pages_per_seq=8,  # 256-token context budget per request
+        max_pending=max(n_requests, 1024),
+    )
+
+    def make_reqs(prefix: str, n: int):
+        key = jax.random.PRNGKey(1)
+        toks = jax.random.randint(key, (n, prompt_len), 0, cfg.vocab_size, jnp.int32)
+        return [
+            Request(
+                id=f"{prefix}{i}",
+                prompt=toks[i].tolist(),
+                sampling=SamplingParams(max_new_tokens=new_tokens),
+            )
+            for i in range(n)
+        ]
+
+    # Warmup: trigger prefill-bucket + decode compiles.
+    warm = InferenceEngine(params, cfg, ecfg)
+    for ev in warm.run_to_completion(make_reqs("w", 2)):
+        pass
+
+    # TTFT: idle engine, one request, time submit -> first token.
+    ttfts = []
+    for i in range(3):
+        e = InferenceEngine(params, cfg, ecfg)
+        [req] = make_reqs(f"t{i}", 1)
+        t0 = time.perf_counter()
+        e.submit(req)
+        while not e.step():
+            pass
+        ttfts.append((time.perf_counter() - t0) * 1e3)
+    ttft_ms = sorted(ttfts)[len(ttfts) // 2]
+
+    # Throughput: drain n_requests through max_batch decode slots.
+    engine = InferenceEngine(params, cfg, ecfg)
+    reqs = make_reqs("r", n_requests)
+    t0 = time.perf_counter()
+    results = engine.run_to_completion(reqs)
+    elapsed = time.perf_counter() - t0
+    total_tokens = sum(len(v) for v in results.values())
+    tok_s = total_tokens / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_throughput_{model}_continuous_batching_{n_requests}req",
+                "value": round(tok_s, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s / 3000.0, 3),
+                "ttft_ms_p50": round(ttft_ms, 1),
+                "total_tokens": total_tokens,
+                "elapsed_s": round(elapsed, 2),
+                "decode_steps": engine.stats["decode_steps"],
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

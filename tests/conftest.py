@@ -3,27 +3,16 @@ paths (tp/dp/sp meshes, collectives) are exercised hermetically, mirroring the
 reference's "N processes on localhost" integration strategy
 (reference: sdk/python/tests/integration/conftest.py:113-166).
 
-Subtlety: this image's sitecustomize imports jax at *interpreter start* (the
-axon TPU tunnel), so jax's config has already latched JAX_PLATFORMS=axon from
-the environment and plain env assignment here is too late. jax.config.update
-still works because the *backend* only initializes on first use, which is
-after conftest import. XLA_FLAGS is read by the CPU client at backend-init
-time, so setting it here is still effective.
-
 Set AGENTFIELD_TPU_TEST_REAL=1 to run the suite against the real chip.
+(See agentfield_tpu/_compat.py for why plain env assignment is too late here.)
 """
 
 import os
 
 if os.environ.get("AGENTFIELD_TPU_TEST_REAL", "").lower() not in ("1", "true", "yes"):
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from agentfield_tpu._compat import force_cpu_backend
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_backend(virtual_devices=8)
 
 import pytest  # noqa: E402
 

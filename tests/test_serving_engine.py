@@ -1,0 +1,121 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.llama import generate_greedy
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+from agentfield_tpu.serving.engine import QueueFullError, RequestTooLongError
+from agentfield_tpu.serving.kv_cache import PageAllocator
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompt(key, n):
+    return jax.random.randint(key, (n,), 0, CFG.vocab_size, jnp.int32).tolist()
+
+
+def _greedy_req(rid, prompt, max_new=8):
+    return Request(id=rid, prompt=prompt, sampling=SamplingParams(max_new_tokens=max_new))
+
+
+def test_engine_matches_contiguous_oracle(params):
+    """Continuous-batched greedy decode == the contiguous-cache oracle, for
+    concurrent requests with different prompt lengths."""
+    prompts = [_prompt(jax.random.PRNGKey(i), n) for i, n in enumerate([5, 9, 12])]
+    engine = InferenceEngine(params, CFG, ECFG)
+    results = engine.run_to_completion(
+        [_greedy_req(f"r{i}", p, max_new=6) for i, p in enumerate(prompts)]
+    )
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=6, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle, f"request r{i} diverged from oracle"
+
+
+def test_stop_token_finishes_early(params):
+    prompt = _prompt(jax.random.PRNGKey(0), 5)
+    oracle = generate_greedy(params, CFG, jnp.asarray([prompt], jnp.int32), 6, 64)[0].tolist()
+    stop = oracle[2]
+    engine = InferenceEngine(params, CFG, ECFG)
+    req = Request(
+        id="r", prompt=prompt, sampling=SamplingParams(max_new_tokens=6, stop_token_ids=(stop,))
+    )
+    results = engine.run_to_completion([req])
+    assert results["r"] == oracle[:3]
+    assert engine.allocator.free_pages == ECFG.num_pages - 1  # all pages returned
+
+
+def test_pages_released_after_completion(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    engine.run_to_completion(
+        [_greedy_req(f"r{i}", _prompt(jax.random.PRNGKey(i), 7), 4) for i in range(6)]
+    )
+    assert engine.allocator.free_pages == ECFG.num_pages - 1
+    assert engine.num_active == 0
+    assert engine.stats["requests_finished"] == 6
+
+
+def test_more_requests_than_slots(params):
+    """8 requests through 4 slots — continuous batching must drain them all."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    reqs = [_greedy_req(f"r{i}", _prompt(jax.random.PRNGKey(i), 4), 3) for i in range(8)]
+    results = engine.run_to_completion(reqs)
+    assert all(len(results[f"r{i}"]) == 3 for i in range(8))
+
+
+def test_too_long_request_rejected(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    with pytest.raises(RequestTooLongError):
+        engine.submit(_greedy_req("big", list(range(60)), max_new=10))
+
+
+def test_empty_prompt_rejected(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    with pytest.raises(ValueError, match="non-empty"):
+        engine.submit(_greedy_req("e", [], 2))
+
+
+def test_queue_backpressure(params):
+    ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8, max_pending=2)
+    engine = InferenceEngine(params, CFG, ecfg)
+    engine.submit(_greedy_req("a", [1, 2, 3], 2))
+    engine.submit(_greedy_req("b", [1, 2, 3], 2))
+    with pytest.raises(QueueFullError):
+        engine.submit(_greedy_req("c", [1, 2, 3], 2))
+    assert engine.stats["backpressure_total"] == 1
+
+
+def test_temperature_sampling_diverges_and_completes(params):
+    engine = InferenceEngine(params, CFG, ECFG, seed=7)
+    reqs = [
+        Request(
+            id=f"r{i}",
+            prompt=_prompt(jax.random.PRNGKey(0), 5),
+            sampling=SamplingParams(temperature=1.0, max_new_tokens=8),
+        )
+        for i in range(2)
+    ]
+    results = engine.run_to_completion(reqs)
+    assert all(len(v) == 8 for v in results.values())
+    assert all(0 <= t < CFG.vocab_size for v in results.values() for t in v)
+
+
+def test_allocator_invariants():
+    a = PageAllocator(8)
+    got = a.alloc(7)
+    assert got is not None and 0 not in got
+    assert a.alloc(1) is None
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got[:1])  # double free
+    with pytest.raises(ValueError):
+        a.free([0])  # reserved page

@@ -1,0 +1,10 @@
+"""Control plane: the orchestration layer of agentfield_tpu.
+
+Re-design of the reference's Go control plane (SURVEY §1-§3: node registry,
+execution gateway, presence/status/health, memory, webhooks, workflow DAG)
+with one structural change: LLM execution is in-tree — model nodes run the
+TPU serving engine (`agentfield_tpu.serving`) and register like agent nodes,
+so `Agent.ai()` is placed by the same scheduler that routes reasoner calls.
+"""
+
+from agentfield_tpu.control_plane.server import ControlPlane, create_app  # noqa: F401

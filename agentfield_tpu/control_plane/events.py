@@ -1,0 +1,69 @@
+"""In-process event buses.
+
+Same role as the reference's generic EventBus[T]
+(internal/events/event_bus.go:6-57): subscribe/publish fan-out with
+non-blocking drop on slow consumers, feeding SSE/WS streams and the sync
+gateway's wait-for-completion path. asyncio-native: each subscriber is a
+bounded asyncio.Queue; publish never awaits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from typing import Any, AsyncIterator
+
+
+class EventBus:
+    def __init__(self, maxsize: int = 256, history: int = 0):
+        self._subs: dict[str, set[asyncio.Queue]] = collections.defaultdict(set)
+        self._maxsize = maxsize
+        self._history: collections.deque | None = (
+            collections.deque(maxlen=history) if history else None
+        )
+        self.dropped = 0
+
+    def publish(self, topic: str, event: Any) -> None:
+        """Non-blocking publish; slow subscribers drop events (the reference
+        makes the same tradeoff — event_bus.go:42-55 drops on full channel)."""
+        if self._history is not None:
+            self._history.append((topic, event))
+        for q in list(self._subs.get(topic, ())) + list(self._subs.get("*", ())):
+            try:
+                q.put_nowait((topic, event))
+            except asyncio.QueueFull:
+                self.dropped += 1
+
+    def subscribe(self, topic: str = "*") -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=self._maxsize)
+        self._subs[topic].add(q)
+        return q
+
+    def unsubscribe(self, topic: str, q: asyncio.Queue) -> None:
+        self._subs.get(topic, set()).discard(q)
+
+    def history(self) -> list[tuple[str, Any]]:
+        return list(self._history or ())
+
+    async def stream(self, topic: str = "*") -> AsyncIterator[Any]:
+        q = self.subscribe(topic)
+        try:
+            while True:
+                _, ev = await q.get()
+                yield ev
+        finally:
+            self.unsubscribe(topic, q)
+
+    async def wait_for(self, topic: str, predicate, timeout: float | None = None) -> Any:
+        """Block until an event on `topic` satisfies `predicate` (the sync
+        gateway's completion-wait — reference: waitForExecutionCompletion,
+        execute.go:568)."""
+        q = self.subscribe(topic)
+        try:
+            async with asyncio.timeout(timeout):
+                while True:
+                    _, ev = await q.get()
+                    if predicate(ev):
+                        return ev
+        finally:
+            self.unsubscribe(topic, q)

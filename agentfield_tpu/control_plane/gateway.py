@@ -1,0 +1,326 @@
+"""Execution gateway: sync + async invocation of node components.
+
+Reimplements the semantics of the reference's execution controller
+(internal/handlers/execute.go): prepare → call agent → 200-direct or
+202-ack + status-callback completion; async path through a bounded worker
+pool with queue-full backpressure (execute.go:319-367,1302-1439). asyncio
+replaces the Go worker goroutines: completion handling is naturally
+serialized on the event loop (the reference dedicates a single completion
+goroutine for the same reason, execute.go:1404-1429).
+
+Agent wire contract (network boundary):
+    POST {base_url}/{reasoners|skills}/{component}  json={"input": ..., "execution_id": ...}
+    headers: X-Run-ID, X-Execution-ID, X-Parent-Execution-ID, X-Session-ID, X-Actor-ID
+    → 200 {"result": ...}      direct completion
+    → 202 {}                   agent later POSTs /api/v1/executions/{id}/status
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+import aiohttp
+
+from agentfield_tpu.control_plane.events import EventBus
+from agentfield_tpu.control_plane.metrics import Metrics
+from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.types import (
+    AgentNode,
+    Execution,
+    ExecutionStatus,
+    NodeStatus,
+    TargetType,
+    new_id,
+    now,
+)
+
+EXEC_TOPIC = "executions"
+
+CONTEXT_HEADERS = (
+    "X-Run-ID",
+    "X-Execution-ID",
+    "X-Parent-Execution-ID",
+    "X-Session-ID",
+    "X-Actor-ID",
+)
+
+
+class GatewayError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class ExecutionGateway:
+    def __init__(
+        self,
+        storage: SQLiteStorage,
+        bus: EventBus,
+        metrics: Metrics,
+        agent_timeout: float = 90.0,  # reference agent-call timeout (execute.go:187)
+        sync_wait_timeout: float = 600.0,
+        async_workers: int = 8,
+        queue_capacity: int = 1024,  # reference default (execute.go:1373)
+        webhook_notify=None,  # callable(execution) -> None
+    ):
+        self.storage = storage
+        self.bus = bus
+        self.metrics = metrics
+        self.agent_timeout = agent_timeout
+        self.sync_wait_timeout = sync_wait_timeout
+        self.queue_capacity = queue_capacity
+        self.async_workers = async_workers
+        self.webhook_notify = webhook_notify
+        self._queue: asyncio.Queue[Execution] = asyncio.Queue(maxsize=queue_capacity)
+        self._workers: list[asyncio.Task] = []
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.agent_timeout)
+        )
+        self._workers = [
+            asyncio.create_task(self._worker_loop(i)) for i in range(self.async_workers)
+        ]
+
+    async def stop(self) -> None:
+        for w in self._workers:
+            w.cancel()
+        await asyncio.gather(*self._workers, return_exceptions=True)
+        if self._session:
+            await self._session.close()
+
+    # ------------------------------------------------------------------
+
+    def _prepare(
+        self,
+        target: str,
+        payload: Any,
+        headers: dict[str, str],
+        webhook_url: str | None,
+        status: ExecutionStatus,
+    ) -> tuple[Execution, AgentNode]:
+        """Parse target, resolve node+component, persist the execution record
+        (reference: prepareExecution, execute.go:641)."""
+        if "." not in target:
+            raise GatewayError(400, f"target {target!r} must be '<node>.<component>'")
+        node_id, comp_name = target.split(".", 1)
+        node = self.storage.get_node(node_id)
+        if node is None:
+            raise GatewayError(404, f"unknown node {node_id!r}")
+        if node.status not in (NodeStatus.ACTIVE, NodeStatus.STARTING):
+            raise GatewayError(503, f"node {node_id!r} is {node.status.value}")
+        found = node.component(comp_name)
+        if found is None:
+            raise GatewayError(404, f"node {node_id!r} has no component {comp_name!r}")
+        _, ttype = found
+
+        # Normalize header casing (clients may send lowercase).
+        headers = {k.title(): v for k, v in headers.items()}
+        ex = Execution(
+            execution_id=headers.get("X-Execution-Id") or new_id("exec"),
+            target=target,
+            target_type=ttype,
+            status=status,
+            run_id=headers.get("X-Run-Id") or new_id("run"),
+            parent_execution_id=headers.get("X-Parent-Execution-Id"),
+            session_id=headers.get("X-Session-Id"),
+            actor_id=headers.get("X-Actor-Id"),
+            input=payload,
+            webhook_url=webhook_url,
+            started_at=now(),
+        )
+        try:
+            self.storage.create_execution(ex)
+        except Exception as e:
+            if "UNIQUE" in str(e) or "PRIMARY KEY" in str(e):
+                raise GatewayError(
+                    409, f"execution id {ex.execution_id!r} already exists"
+                ) from None
+            raise
+        self.metrics.inc("gateway_executions_total")
+        return ex, node
+
+    def _agent_url(self, node: AgentNode, ex: Execution) -> str:
+        comp = ex.target.split(".", 1)[1]
+        kind = {"reasoner": "reasoners", "skill": "skills", "generate": "generate"}[
+            ex.target_type.value
+        ]
+        return f"{node.base_url.rstrip('/')}/{kind}/{comp}"
+
+    async def _call_agent(self, node: AgentNode, ex: Execution) -> None:
+        """POST to the agent; 200 completes inline, 202 defers to the status
+        callback (reference: callAgent, execute.go:783-828)."""
+        assert self._session is not None
+        headers = {
+            "X-Run-ID": ex.run_id,
+            "X-Execution-ID": ex.execution_id,
+            "X-Session-ID": ex.session_id or "",
+            "X-Actor-ID": ex.actor_id or "",
+        }
+        if ex.parent_execution_id:
+            headers["X-Parent-Execution-ID"] = ex.parent_execution_id
+        t0 = time.perf_counter()
+        try:
+            async with self._session.post(
+                self._agent_url(node, ex),
+                json={"input": ex.input, "execution_id": ex.execution_id},
+                headers=headers,
+            ) as resp:
+                if resp.status == 200:
+                    body = await resp.json()
+                    await self.complete(ex.execution_id, result=body.get("result"))
+                elif resp.status == 202:
+                    pass  # agent will POST the status callback
+                else:
+                    text = (await resp.text())[:500]
+                    await self.complete(
+                        ex.execution_id,
+                        error=f"agent returned {resp.status}: {text}",
+                    )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            await self.complete(ex.execution_id, error=f"agent call failed: {e!r}")
+        finally:
+            self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------
+
+    async def execute_sync(
+        self,
+        target: str,
+        payload: Any,
+        headers: dict[str, str],
+        webhook_url: str | None = None,
+        timeout: float | None = None,
+    ) -> Execution:
+        """Sync path: call agent, then wait on the event bus until the
+        execution reaches a terminal state (execute.go:195-278)."""
+        ex, node = self._prepare(target, payload, headers, webhook_url, ExecutionStatus.RUNNING)
+        await self._call_agent(node, ex)
+        current = self.storage.get_execution(ex.execution_id)
+        if current is not None and current.status.terminal:
+            return current
+        try:
+            await self.bus.wait_for(
+                EXEC_TOPIC,
+                lambda ev: ev.get("execution_id") == ex.execution_id and ev.get("terminal"),
+                timeout=timeout or self.sync_wait_timeout,
+            )
+        except TimeoutError:
+            await self.complete(ex.execution_id, error="sync wait timeout", timeout=True)
+        return self.storage.get_execution(ex.execution_id)  # type: ignore[return-value]
+
+    async def execute_async(
+        self,
+        target: str,
+        payload: Any,
+        headers: dict[str, str],
+        webhook_url: str | None = None,
+    ) -> Execution:
+        """Async path: enqueue and 202 immediately; queue-full → 503
+        backpressure (execute.go:327-367)."""
+        ex, _node = self._prepare(target, payload, headers, webhook_url, ExecutionStatus.QUEUED)
+        try:
+            self._queue.put_nowait(ex)
+        except asyncio.QueueFull:
+            ex.status = ExecutionStatus.FAILED
+            ex.error = "async queue at capacity"
+            ex.finished_at = now()
+            self.storage.update_execution(ex)
+            self.metrics.inc("gateway_backpressure_total")
+            raise GatewayError(503, "async execution queue is full") from None
+        self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
+        return ex
+
+    async def _worker_loop(self, idx: int) -> None:
+        while True:
+            ex = await self._queue.get()
+            try:
+                self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
+                self.metrics.inc("worker_dispatch_total")
+                node_id = ex.target.split(".", 1)[0]
+                node = self.storage.get_node(node_id)
+                if node is None:
+                    await self.complete(ex.execution_id, error=f"node {node_id} vanished")
+                    continue
+                ex.status = ExecutionStatus.RUNNING
+                self.storage.update_execution(ex)
+                self._publish(ex)
+                await self._call_agent(node, ex)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # a worker must never die (cf. sweep loop)
+                self.metrics.inc("worker_errors_total")
+                try:
+                    await self.complete(ex.execution_id, error=f"internal dispatch error: {e!r}")
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
+
+    async def complete(
+        self,
+        execution_id: str,
+        result: Any = None,
+        error: str | None = None,
+        timeout: bool = False,
+    ) -> Execution | None:
+        """Terminal-state transition: persist once, publish once, fire webhook
+        (reference: completeExecution/failExecution, execute.go:831-919;
+        completions serialized — here by the event loop)."""
+        ex = self.storage.get_execution(execution_id)
+        if ex is None:
+            return None
+        if ex.status.terminal:
+            return ex  # idempotent: late callbacks don't double-complete
+        if timeout:
+            ex.status = ExecutionStatus.TIMEOUT
+            ex.error = error
+        elif error is not None:
+            ex.status = ExecutionStatus.FAILED
+            ex.error = error
+        else:
+            ex.status = ExecutionStatus.COMPLETED
+            ex.result = result
+        ex.finished_at = now()
+        self.storage.update_execution(ex)
+        self.metrics.inc(f"gateway_executions_{ex.status.value}_total")
+        if ex.started_at:
+            self.metrics.observe("execution_duration_seconds", ex.finished_at - ex.started_at)
+        self._publish(ex)
+        if ex.webhook_url and self.webhook_notify:
+            self.webhook_notify(ex)
+        return ex
+
+    async def handle_status_update(
+        self, execution_id: str, status: str, result: Any = None, error: str | None = None
+    ) -> Execution | None:
+        """Agent status callback (reference: handleStatusUpdate, execute.go:423)."""
+        if status == "completed":
+            return await self.complete(execution_id, result=result)
+        if status in ("failed", "error"):
+            return await self.complete(execution_id, error=error or "agent reported failure")
+        if status == "running":
+            ex = self.storage.get_execution(execution_id)
+            if ex is not None and not ex.status.terminal:
+                ex.status = ExecutionStatus.RUNNING
+                self.storage.update_execution(ex)
+                self._publish(ex)
+            return ex
+        raise GatewayError(400, f"unknown status {status!r}")
+
+    def _publish(self, ex: Execution) -> None:
+        self.bus.publish(
+            EXEC_TOPIC,
+            {
+                "execution_id": ex.execution_id,
+                "run_id": ex.run_id,
+                "target": ex.target,
+                "status": ex.status.value,
+                "terminal": ex.status.terminal,
+                "ts": now(),
+            },
+        )

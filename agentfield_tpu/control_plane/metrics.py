@@ -1,0 +1,69 @@
+"""Minimal Prometheus-style metrics registry.
+
+Covers the reference's gateway metric surface
+(internal/services/execution_metrics.go:14-44: queue depth, worker inflight,
+step duration histogram, backpressure counter) plus serving-engine gauges
+(tok/s, TTFT) — rendered in Prometheus text exposition format at /metrics
+(reference serves the same endpoint, server.go:607).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class Metrics:
+    def __init__(self, prefix: str = "agentfield"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = collections.defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._hist: dict[str, list[float]] = {}
+        self._hist_buckets: dict[str, tuple[float, ...]] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float, buckets: tuple[float, ...] = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)) -> None:
+        with self._lock:
+            if name not in self._hist:
+                self._hist[name] = [0.0] * (len(buckets) + 2)  # buckets + sum + count
+                self._hist_buckets[name] = buckets
+            h = self._hist[name]
+            for i, b in enumerate(self._hist_buckets[name]):
+                if value <= b:
+                    h[i] += 1
+            h[-2] += value
+            h[-1] += 1
+
+    def counter_value(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            for name, v in sorted(self._counters.items()):
+                out.append(f"# TYPE {self.prefix}_{name} counter")
+                out.append(f"{self.prefix}_{name} {v}")
+            for name, v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {self.prefix}_{name} gauge")
+                out.append(f"{self.prefix}_{name} {v}")
+            for name, h in sorted(self._hist.items()):
+                buckets = self._hist_buckets[name]
+                out.append(f"# TYPE {self.prefix}_{name} histogram")
+                cum = 0.0
+                for i, b in enumerate(buckets):
+                    cum = h[i]
+                    out.append(f'{self.prefix}_{name}_bucket{{le="{b}"}} {cum}')
+                out.append(f'{self.prefix}_{name}_bucket{{le="+Inf"}} {h[-1]}')
+                out.append(f"{self.prefix}_{name}_sum {h[-2]}")
+                out.append(f"{self.prefix}_{name}_count {h[-1]}")
+        return "\n".join(out) + "\n"

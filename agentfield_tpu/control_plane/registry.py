@@ -1,0 +1,191 @@
+"""Node registry, presence leasing, and status management.
+
+Condenses the reference's three services — node registration handlers
+(internal/handlers/nodes.go:363,646), StatusManager state machine
+(internal/services/status_manager.go:356,449) and PresenceManager lease
+tracking (internal/services/presence_manager.go:68,113) — into one
+asyncio-native component: heartbeats refresh a lease; a sweep loop marks
+expired nodes inactive and hard-evicts long-gone ones. Lease numbers follow
+the reference defaults (TTL 5m, sweep 30s, evict 30m — server.go:131-137).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from agentfield_tpu.control_plane.events import EventBus
+from agentfield_tpu.control_plane.metrics import Metrics
+from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.types import (
+    AgentNode,
+    ComponentMeta,
+    NodeStatus,
+    now,
+)
+
+NODE_TOPIC = "nodes"
+
+
+class RegistryError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class NodeRegistry:
+    def __init__(
+        self,
+        storage: SQLiteStorage,
+        bus: EventBus,
+        metrics: Metrics,
+        heartbeat_ttl: float = 300.0,
+        sweep_interval: float = 30.0,
+        evict_after: float = 1800.0,
+    ):
+        self.storage = storage
+        self.bus = bus
+        self.metrics = metrics
+        self.heartbeat_ttl = heartbeat_ttl
+        self.sweep_interval = sweep_interval
+        self.evict_after = evict_after
+        self._sweeper: asyncio.Task | None = None
+        # In-memory heartbeat cache: storage writes are throttled so a 2s
+        # heartbeat cadence doesn't hammer SQLite (the reference caches
+        # heartbeats in memory for the same reason, nodes.go:290).
+        self._last_persist: dict[str, float] = {}
+
+    async def start(self) -> None:
+        self._sweeper = asyncio.create_task(self._sweep_loop())
+
+    async def stop(self) -> None:
+        if self._sweeper:
+            self._sweeper.cancel()
+            await asyncio.gather(self._sweeper, return_exceptions=True)
+
+    # ------------------------------------------------------------------
+
+    def register(self, payload: dict[str, Any]) -> AgentNode:
+        """Idempotent registration: re-registering an existing node refreshes
+        its components and lease (the reference treats re-registration the
+        same way, nodes.go:363)."""
+        node_id = payload.get("node_id")
+        base_url = payload.get("base_url")
+        if not node_id or not isinstance(node_id, str):
+            raise RegistryError(400, "node_id is required")
+        if "." in node_id:
+            raise RegistryError(400, "node_id must not contain '.' (target separator)")
+        if not base_url or not isinstance(base_url, str) or not base_url.startswith("http"):
+            raise RegistryError(400, "base_url must be an http(s) URL")
+
+        def comps(kind: str) -> list[ComponentMeta]:
+            out = []
+            for c in payload.get(kind + "s", []):
+                if isinstance(c, str):
+                    c = {"id": c}
+                if not isinstance(c, dict) or not c.get("id"):
+                    raise RegistryError(400, f"each {kind} needs an 'id' (got {c!r})")
+                out.append(
+                    ComponentMeta(
+                        id=c["id"],
+                        node_id=node_id,
+                        kind=kind,
+                        description=c.get("description", ""),
+                        input_schema=c.get("input_schema", {}),
+                        output_schema=c.get("output_schema", {}),
+                    )
+                )
+            return out
+
+        node = AgentNode(
+            node_id=node_id,
+            base_url=base_url,
+            status=NodeStatus.ACTIVE,
+            kind=payload.get("kind", "agent"),
+            reasoners=comps("reasoner"),
+            skills=comps("skill"),
+            metadata=payload.get("metadata", {}),
+        )
+        self.storage.upsert_node(node)
+        self._last_persist[node_id] = now()
+        self.metrics.inc("nodes_registered_total")
+        self.bus.publish(NODE_TOPIC, {"type": "registered", "node_id": node_id, "ts": now()})
+        return node
+
+    def heartbeat(self, node_id: str, data: dict[str, Any] | None = None) -> AgentNode:
+        node = self.storage.get_node(node_id)
+        if node is None:
+            raise RegistryError(404, f"unknown node {node_id!r}; re-register")
+        node.last_heartbeat = now()
+        requested = (data or {}).get("status")
+        if requested is not None:
+            try:
+                new_status = NodeStatus(requested)
+            except ValueError:
+                raise RegistryError(
+                    400, f"invalid status {requested!r}; one of {[s.value for s in NodeStatus]}"
+                ) from None
+        else:
+            new_status = NodeStatus.ACTIVE
+        if NodeStatus.valid_transition(node.status, new_status):
+            if node.status != new_status:
+                self._publish_status(node.node_id, node.status, new_status)
+            node.status = new_status
+        # Throttled persistence: immediately on explicit status change, else at
+        # most every 10s — a 2s heartbeat cadence must not hammer SQLite. The
+        # lease check tolerates the staleness (TTL is 300s >> 10s).
+        if requested or now() - self._last_persist.get(node_id, 0) > 10.0:
+            self.storage.upsert_node(node)
+            self._last_persist[node_id] = now()
+        return node
+
+    def deregister(self, node_id: str) -> bool:
+        ok = self.storage.delete_node(node_id)
+        if ok:
+            self._last_persist.pop(node_id, None)
+            self.bus.publish(NODE_TOPIC, {"type": "deregistered", "node_id": node_id, "ts": now()})
+        return ok
+
+    def _publish_status(self, node_id: str, old: NodeStatus, new: NodeStatus) -> None:
+        self.bus.publish(
+            NODE_TOPIC,
+            {
+                "type": "status_changed",
+                "node_id": node_id,
+                "old": old.value,
+                "new": new.value,
+                "ts": now(),
+            },
+        )
+
+    # ------------------------------------------------------------------
+
+    def sweep_once(self, at: float | None = None) -> dict[str, int]:
+        """Expire leases: TTL → inactive; hard evict after `evict_after`
+        (reference: PresenceManager.checkExpirations, presence_manager.go:113)."""
+        t = at or now()
+        marked = evicted = 0
+        for node in self.storage.list_nodes():
+            age = t - node.last_heartbeat
+            if age > self.evict_after:
+                self.deregister(node.node_id)
+                evicted += 1
+            elif age > self.heartbeat_ttl and node.status == NodeStatus.ACTIVE:
+                self._publish_status(node.node_id, node.status, NodeStatus.INACTIVE)
+                node.status = NodeStatus.INACTIVE
+                self.storage.upsert_node(node)
+                marked += 1
+        self.metrics.set_gauge(
+            "nodes_active",
+            sum(1 for n in self.storage.list_nodes() if n.status == NodeStatus.ACTIVE),
+        )
+        return {"marked_inactive": marked, "evicted": evicted}
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sweep_interval)
+            try:
+                self.sweep_once()
+            except Exception:  # pragma: no cover - sweep must never die
+                self.metrics.inc("sweep_errors_total")

@@ -1,0 +1,455 @@
+"""Control-plane HTTP server (aiohttp).
+
+Route surface mirrors the reference's REST API (route table:
+internal/server/server.go:557-1049) — /api/v1 namespace, node lifecycle,
+sync/async execution, status callbacks, batch status, scoped memory, vector
+search, SSE event streams, Prometheus /metrics, /health.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from aiohttp import web
+
+from agentfield_tpu.control_plane.events import EventBus
+from agentfield_tpu.control_plane.gateway import EXEC_TOPIC, ExecutionGateway, GatewayError
+from agentfield_tpu.control_plane.metrics import Metrics
+from agentfield_tpu.control_plane.registry import NODE_TOPIC, NodeRegistry, RegistryError
+from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.types import ExecutionStatus, now
+from agentfield_tpu.control_plane.webhooks import WebhookDispatcher
+
+MEMORY_TOPIC = "memory"
+VALID_SCOPES = ("global", "session", "actor", "workflow")
+
+CP_KEY: web.AppKey["ControlPlane"] = web.AppKey("cp")
+
+
+class ControlPlane:
+    """Wires storage + bus + registry + gateway + webhook dispatcher
+    (the reference's AgentFieldServer plays this role, server.go:75-273)."""
+
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        agent_timeout: float = 90.0,
+        sync_wait_timeout: float = 600.0,
+        async_workers: int = 8,
+        queue_capacity: int = 1024,
+        heartbeat_ttl: float = 300.0,
+        sweep_interval: float = 30.0,
+        evict_after: float = 1800.0,
+        webhook_secret: str | None = None,
+        cleanup_interval: float = 60.0,
+        stale_after: float = 3600.0,  # reference cleanup defaults (config.go:48-55)
+        retention: float = 86400.0,
+    ):
+        self.storage = SQLiteStorage(db_path)
+        self.bus = EventBus()
+        self.metrics = Metrics()
+        self.webhooks = WebhookDispatcher(self.storage, self.metrics)
+        self.webhook_secret = webhook_secret
+        self.registry = NodeRegistry(
+            self.storage,
+            self.bus,
+            self.metrics,
+            heartbeat_ttl=heartbeat_ttl,
+            sweep_interval=sweep_interval,
+            evict_after=evict_after,
+        )
+        self.gateway = ExecutionGateway(
+            self.storage,
+            self.bus,
+            self.metrics,
+            agent_timeout=agent_timeout,
+            sync_wait_timeout=sync_wait_timeout,
+            async_workers=async_workers,
+            queue_capacity=queue_capacity,
+            webhook_notify=lambda ex: self.webhooks.notify(ex, self.webhook_secret),
+        )
+
+        self.cleanup_interval = cleanup_interval
+        self.stale_after = stale_after
+        self.retention = retention
+        self._cleanup_task: asyncio.Task | None = None
+        self._started = False
+
+    async def start(self) -> None:
+        if self._started:  # create_app's startup hook + manual start() are both fine
+            return
+        self._started = True
+        await self.gateway.start()
+        await self.registry.start()
+        await self.webhooks.start()
+        self._cleanup_task = asyncio.create_task(self._cleanup_loop())
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if self._cleanup_task:
+            self._cleanup_task.cancel()
+            await asyncio.gather(self._cleanup_task, return_exceptions=True)
+        await self.webhooks.stop()
+        await self.registry.stop()
+        await self.gateway.stop()
+        self.storage.close()
+
+    def cleanup_once(self) -> dict[str, int]:
+        """Stale marking + retention GC (reference: ExecutionCleanupService,
+        internal/handlers/execution_cleanup.go)."""
+        t = now()
+        stale = self.storage.mark_stale_executions(t - self.stale_after, t)
+        deleted = self.storage.delete_executions_before(t - self.retention)
+        if stale:
+            self.metrics.inc("executions_marked_stale_total", stale)
+        if deleted:
+            self.metrics.inc("executions_gc_total", deleted)
+        return {"stale": stale, "deleted": deleted}
+
+    async def _cleanup_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cleanup_interval)
+            try:
+                self.cleanup_once()
+            except Exception:
+                self.metrics.inc("cleanup_errors_total")
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def create_app(cp: ControlPlane) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app[CP_KEY] = cp
+
+    async def on_startup(_app):
+        await cp.start()
+
+    async def on_cleanup(_app):
+        await cp.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    routes = web.RouteTableDef()
+
+    # -- health / metrics ----------------------------------------------
+
+    @routes.get("/health")
+    async def health(_req):
+        return web.json_response({"status": "ok", "ts": now()})
+
+    @routes.get("/metrics")
+    async def metrics(_req):
+        return web.Response(text=cp.metrics.render(), content_type="text/plain")
+
+    # -- nodes ----------------------------------------------------------
+
+    @routes.post("/api/v1/nodes")
+    async def register_node(req: web.Request):
+        try:
+            node = cp.registry.register(await req.json())
+        except RegistryError as e:
+            return _json_error(e.status, e.message)
+        except (json.JSONDecodeError, TypeError):
+            return _json_error(400, "invalid JSON body")
+        return web.json_response({"node": node.to_dict()}, status=201)
+
+    @routes.get("/api/v1/nodes")
+    async def list_nodes(_req):
+        return web.json_response({"nodes": [n.to_dict() for n in cp.storage.list_nodes()]})
+
+    @routes.get("/api/v1/nodes/{node_id}")
+    async def get_node(req: web.Request):
+        node = cp.storage.get_node(req.match_info["node_id"])
+        if node is None:
+            return _json_error(404, "unknown node")
+        return web.json_response({"node": node.to_dict()})
+
+    @routes.post("/api/v1/nodes/{node_id}/heartbeat")
+    async def heartbeat(req: web.Request):
+        try:
+            body = await req.json() if req.can_read_body else {}
+        except json.JSONDecodeError:
+            body = {}
+        try:
+            node = cp.registry.heartbeat(req.match_info["node_id"], body)
+        except RegistryError as e:
+            return _json_error(e.status, e.message)
+        return web.json_response({"status": node.status.value, "ts": now()})
+
+    @routes.delete("/api/v1/nodes/{node_id}")
+    async def deregister(req: web.Request):
+        if not cp.registry.deregister(req.match_info["node_id"]):
+            return _json_error(404, "unknown node")
+        return web.json_response({"deleted": True})
+
+    # -- execution ------------------------------------------------------
+
+    def _headers(req: web.Request) -> dict[str, str]:
+        return {
+            k: v
+            for k, v in req.headers.items()
+            if k.lower().startswith("x-") and v
+        }
+
+    @routes.post("/api/v1/execute/{target}")
+    async def execute_sync(req: web.Request):
+        try:
+            body = await req.json() if req.can_read_body else {}
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        try:
+            ex = await cp.gateway.execute_sync(
+                req.match_info["target"],
+                body.get("input"),
+                _headers(req),
+                webhook_url=body.get("webhook_url"),
+                timeout=body.get("timeout"),
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        return web.json_response(ex.to_dict())
+
+    @routes.post("/api/v1/execute/async/{target}")
+    async def execute_async(req: web.Request):
+        try:
+            body = await req.json() if req.can_read_body else {}
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        try:
+            ex = await cp.gateway.execute_async(
+                req.match_info["target"],
+                body.get("input"),
+                _headers(req),
+                webhook_url=body.get("webhook_url"),
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        return web.json_response(
+            {"execution_id": ex.execution_id, "run_id": ex.run_id, "status": ex.status.value},
+            status=202,
+        )
+
+    @routes.get("/api/v1/executions/{execution_id}")
+    async def get_execution(req: web.Request):
+        ex = cp.storage.get_execution(req.match_info["execution_id"])
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        return web.json_response(ex.to_dict())
+
+    @routes.post("/api/v1/executions/{execution_id}/status")
+    async def status_callback(req: web.Request):
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        try:
+            ex = await cp.gateway.handle_status_update(
+                req.match_info["execution_id"],
+                body.get("status", ""),
+                result=body.get("result"),
+                error=body.get("error"),
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        return web.json_response({"status": ex.status.value})
+
+    @routes.post("/api/v1/executions/batch-status")
+    async def batch_status(req: web.Request):
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        ids = body.get("execution_ids", [])
+        if not isinstance(ids, list) or len(ids) > 1000:
+            return _json_error(400, "execution_ids must be a list of at most 1000 ids")
+        out = {}
+        for eid in ids:
+            ex = cp.storage.get_execution(eid)
+            if ex is not None:
+                out[eid] = {
+                    "status": ex.status.value,
+                    "result": ex.result if ex.status.terminal else None,
+                    "error": ex.error,
+                }
+        return web.json_response({"executions": out})
+
+    @routes.get("/api/v1/executions")
+    async def list_executions(req: web.Request):
+        q = req.query
+        try:
+            status = ExecutionStatus(q["status"]) if "status" in q else None
+            limit = int(q.get("limit", "100"))
+            offset = int(q.get("offset", "0"))
+        except ValueError as e:
+            return _json_error(400, f"invalid query parameter: {e}")
+        exs = cp.storage.list_executions(
+            run_id=q.get("run_id"), status=status, limit=limit, offset=offset
+        )
+        return web.json_response({"executions": [e.to_dict() for e in exs]})
+
+    # -- event streams (SSE) -------------------------------------------
+
+    async def _sse(req: web.Request, topic: str) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(req)
+        q = cp.bus.subscribe(topic)
+        try:
+            while True:
+                try:
+                    async with asyncio.timeout(15):
+                        _, ev = await q.get()
+                    await resp.write(f"data: {json.dumps(ev)}\n\n".encode())
+                except TimeoutError:
+                    await resp.write(b": keepalive\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            cp.bus.unsubscribe(topic, q)
+        return resp
+
+    @routes.get("/api/v1/events/executions")
+    async def exec_events(req: web.Request):
+        return await _sse(req, EXEC_TOPIC)
+
+    @routes.get("/api/v1/events/nodes")
+    async def node_events(req: web.Request):
+        return await _sse(req, NODE_TOPIC)
+
+    @routes.get("/api/v1/memory/events")
+    async def memory_events(req: web.Request):
+        return await _sse(req, MEMORY_TOPIC)
+
+    # -- memory (scoped KV + vectors) ----------------------------------
+
+    def _scope(req: web.Request) -> tuple[str, str]:
+        scope = req.query.get("scope", "global")
+        scope_id = req.query.get("scope_id", "")
+        if scope not in VALID_SCOPES:
+            raise GatewayError(400, f"scope must be one of {VALID_SCOPES}")
+        if scope != "global" and not scope_id:
+            raise GatewayError(400, f"scope {scope!r} requires scope_id")
+        return scope, scope_id
+
+    @routes.post("/api/v1/memory/{key}")
+    async def memory_set(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+            body = await req.json()
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        key = req.match_info["key"]
+        cp.storage.memory_set(scope, scope_id, key, body.get("value"))
+        cp.bus.publish(
+            MEMORY_TOPIC,
+            {"type": "set", "scope": scope, "scope_id": scope_id, "key": key, "ts": now()},
+        )
+        return web.json_response({"ok": True})
+
+    @routes.get("/api/v1/memory/{key}")
+    async def memory_get(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        value = cp.storage.memory_get(scope, scope_id, req.match_info["key"])
+        if value is None:
+            return _json_error(404, "key not found")
+        return web.json_response({"value": value})
+
+    @routes.delete("/api/v1/memory/{key}")
+    async def memory_delete(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        key = req.match_info["key"]
+        if not cp.storage.memory_delete(scope, scope_id, key):
+            return _json_error(404, "key not found")
+        cp.bus.publish(
+            MEMORY_TOPIC,
+            {"type": "delete", "scope": scope, "scope_id": scope_id, "key": key, "ts": now()},
+        )
+        return web.json_response({"ok": True})
+
+    @routes.get("/api/v1/memory")
+    async def memory_list(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        return web.json_response(
+            {"items": cp.storage.memory_list(scope, scope_id, req.query.get("prefix", ""))}
+        )
+
+    @routes.post("/api/v1/memory/vectors/set")
+    async def vector_set(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+            body = await req.json()
+            cp.storage.vector_set(
+                scope, scope_id, body["key"], body["embedding"], body.get("metadata")
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return _json_error(400, f"invalid vector payload: {e!r}")
+        return web.json_response({"ok": True})
+
+    @routes.post("/api/v1/memory/vectors/search")
+    async def vector_search(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+            body = await req.json()
+            results = cp.storage.vector_search(
+                scope,
+                scope_id,
+                body["embedding"],
+                top_k=int(body.get("top_k", 5)),
+                metric=body.get("metric", "cosine"),
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return _json_error(400, f"invalid search payload: {e!r}")
+        return web.json_response({"results": results})
+
+    @routes.post("/api/v1/memory/vectors/delete")
+    async def vector_delete(req: web.Request):
+        try:
+            scope, scope_id = _scope(req)
+            body = await req.json()
+            ok = cp.storage.vector_delete(scope, scope_id, body["key"])
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        except (json.JSONDecodeError, KeyError) as e:
+            return _json_error(400, f"invalid payload: {e!r}")
+        return web.json_response({"ok": ok})
+
+    app.add_routes(routes)
+    return app
+
+
+async def run_server(cp: ControlPlane, host: str = "127.0.0.1", port: int = 8800) -> web.AppRunner:
+    app = create_app(cp)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

@@ -1,0 +1,421 @@
+"""Storage provider: narrow interface + SQLite implementation.
+
+Plays the role of the reference's StorageProvider contract and LocalStorage
+(internal/storage/storage.go:30-178, local.go) with a deliberately narrower
+surface: documents are stored as JSON blobs keyed by their natural ids, with
+indexed columns only for the fields queries filter on. Vector similarity is a
+brute-force scan (as the reference's SQLite store is —
+vector_store_sqlite.go:79) with the distance math vectorized in numpy; the
+C++ scan kernel replaces it behind the same method.
+
+SQLite runs in WAL mode; the provider is synchronous and cheap (sub-ms ops),
+called directly from asyncio handlers — long scans can be pushed to a thread
+by callers.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Iterable
+
+import numpy as np
+
+from agentfield_tpu.control_plane.types import (
+    AgentNode,
+    Execution,
+    ExecutionStatus,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS agent_nodes (
+    node_id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    last_heartbeat REAL NOT NULL,
+    doc TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS executions (
+    execution_id TEXT PRIMARY KEY,
+    run_id TEXT NOT NULL,
+    parent_execution_id TEXT,
+    target TEXT NOT NULL,
+    status TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    finished_at REAL,
+    doc TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_exec_run ON executions(run_id);
+CREATE INDEX IF NOT EXISTS idx_exec_status ON executions(status);
+CREATE INDEX IF NOT EXISTS idx_exec_created ON executions(created_at);
+CREATE TABLE IF NOT EXISTS memory (
+    scope TEXT NOT NULL,
+    scope_id TEXT NOT NULL,
+    key TEXT NOT NULL,
+    value TEXT NOT NULL,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (scope, scope_id, key)
+);
+CREATE TABLE IF NOT EXISTS vectors (
+    scope TEXT NOT NULL,
+    scope_id TEXT NOT NULL,
+    key TEXT NOT NULL,
+    embedding BLOB NOT NULL,
+    dim INTEGER NOT NULL,
+    metadata TEXT NOT NULL,
+    PRIMARY KEY (scope, scope_id, key)
+);
+CREATE TABLE IF NOT EXISTS webhooks (
+    id TEXT PRIMARY KEY,
+    execution_id TEXT NOT NULL,
+    url TEXT NOT NULL,
+    secret TEXT,
+    status TEXT NOT NULL,
+    attempts INTEGER NOT NULL DEFAULT 0,
+    next_attempt_at REAL NOT NULL,
+    payload TEXT,
+    last_error TEXT,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_webhooks_due ON webhooks(status, next_attempt_at);
+CREATE TABLE IF NOT EXISTS locks (
+    name TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    expires_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS kv_config (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+"""
+
+
+class SQLiteStorage:
+    """StorageProvider over a single SQLite file (":memory:" for tests)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        with self._lock:
+            if path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- nodes ----------------------------------------------------------
+
+    def upsert_node(self, node: AgentNode) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO agent_nodes(node_id,status,last_heartbeat,doc) VALUES(?,?,?,?) "
+                "ON CONFLICT(node_id) DO UPDATE SET status=excluded.status, "
+                "last_heartbeat=excluded.last_heartbeat, doc=excluded.doc",
+                (node.node_id, node.status.value, node.last_heartbeat, json.dumps(node.to_dict())),
+            )
+            self._conn.commit()
+
+    def get_node(self, node_id: str) -> AgentNode | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT doc FROM agent_nodes WHERE node_id=?", (node_id,)
+            ).fetchone()
+        return AgentNode.from_dict(json.loads(row["doc"])) if row else None
+
+    def list_nodes(self) -> list[AgentNode]:
+        with self._lock:
+            rows = self._conn.execute("SELECT doc FROM agent_nodes ORDER BY node_id").fetchall()
+        return [AgentNode.from_dict(json.loads(r["doc"])) for r in rows]
+
+    def delete_node(self, node_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute("DELETE FROM agent_nodes WHERE node_id=?", (node_id,))
+            self._conn.commit()
+        return cur.rowcount > 0
+
+    # -- executions -----------------------------------------------------
+
+    def create_execution(self, ex: Execution) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO executions(execution_id,run_id,parent_execution_id,target,"
+                "status,created_at,finished_at,doc) VALUES(?,?,?,?,?,?,?,?)",
+                (
+                    ex.execution_id,
+                    ex.run_id,
+                    ex.parent_execution_id,
+                    ex.target,
+                    ex.status.value,
+                    ex.created_at,
+                    ex.finished_at,
+                    json.dumps(ex.to_dict()),
+                ),
+            )
+            self._conn.commit()
+
+    def update_execution(self, ex: Execution) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE executions SET status=?, finished_at=?, doc=? WHERE execution_id=?",
+                (ex.status.value, ex.finished_at, json.dumps(ex.to_dict()), ex.execution_id),
+            )
+            self._conn.commit()
+
+    def get_execution(self, execution_id: str) -> Execution | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT doc FROM executions WHERE execution_id=?", (execution_id,)
+            ).fetchone()
+        return Execution.from_dict(json.loads(row["doc"])) if row else None
+
+    def list_executions(
+        self,
+        run_id: str | None = None,
+        status: ExecutionStatus | None = None,
+        limit: int = 100,
+        offset: int = 0,
+    ) -> list[Execution]:
+        q = "SELECT doc FROM executions"
+        cond, args = [], []
+        if run_id is not None:
+            cond.append("run_id=?")
+            args.append(run_id)
+        if status is not None:
+            cond.append("status=?")
+            args.append(status.value)
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY created_at LIMIT ? OFFSET ?"
+        args += [limit, offset]
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
+
+    def mark_stale_executions(self, older_than: float, now: float) -> int:
+        """Fail non-terminal executions (RUNNING *and* QUEUED — the async queue
+        is in-memory, so rows orphaned by a restart are QUEUED forever
+        otherwise) created before `older_than` (reference: MarkStaleExecutions,
+        storage.go:66 + cleanup service)."""
+        n = 0
+        for status in (ExecutionStatus.RUNNING, ExecutionStatus.QUEUED):
+            for ex in self.list_executions(status=status, limit=10_000):
+                if ex.created_at < older_than:
+                    ex.status = ExecutionStatus.TIMEOUT
+                    ex.error = "marked stale by cleanup"
+                    ex.finished_at = now
+                    self.update_execution(ex)
+                    n += 1
+        return n
+
+    def delete_executions_before(self, cutoff: float) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM executions WHERE created_at < ? AND status IN (?,?,?)",
+                (
+                    cutoff,
+                    ExecutionStatus.COMPLETED.value,
+                    ExecutionStatus.FAILED.value,
+                    ExecutionStatus.TIMEOUT.value,
+                ),
+            )
+            self._conn.commit()
+        return cur.rowcount
+
+    # -- memory (scoped KV) --------------------------------------------
+
+    def memory_set(self, scope: str, scope_id: str, key: str, value: Any) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO memory(scope,scope_id,key,value,updated_at) VALUES(?,?,?,?,?) "
+                "ON CONFLICT(scope,scope_id,key) DO UPDATE SET value=excluded.value, "
+                "updated_at=excluded.updated_at",
+                (scope, scope_id, key, json.dumps(value), time.time()),
+            )
+            self._conn.commit()
+
+    def memory_get(self, scope: str, scope_id: str, key: str) -> Any | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM memory WHERE scope=? AND scope_id=? AND key=?",
+                (scope, scope_id, key),
+            ).fetchone()
+        return json.loads(row["value"]) if row else None
+
+    def memory_delete(self, scope: str, scope_id: str, key: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM memory WHERE scope=? AND scope_id=? AND key=?",
+                (scope, scope_id, key),
+            )
+            self._conn.commit()
+        return cur.rowcount > 0
+
+    def memory_list(self, scope: str, scope_id: str, prefix: str = "") -> dict[str, Any]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM memory WHERE scope=? AND scope_id=? AND key LIKE ? "
+                "ORDER BY key",
+                (scope, scope_id, prefix + "%"),
+            ).fetchall()
+        return {r["key"]: json.loads(r["value"]) for r in rows}
+
+    # -- vectors --------------------------------------------------------
+
+    def vector_set(
+        self, scope: str, scope_id: str, key: str, embedding: Iterable[float], metadata: dict | None = None
+    ) -> None:
+        vec = np.asarray(list(embedding), np.float32)
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO vectors(scope,scope_id,key,embedding,dim,metadata) VALUES(?,?,?,?,?,?) "
+                "ON CONFLICT(scope,scope_id,key) DO UPDATE SET embedding=excluded.embedding, "
+                "dim=excluded.dim, metadata=excluded.metadata",
+                (scope, scope_id, key, vec.tobytes(), vec.size, json.dumps(metadata or {})),
+            )
+            self._conn.commit()
+
+    def vector_delete(self, scope: str, scope_id: str, key: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM vectors WHERE scope=? AND scope_id=? AND key=?",
+                (scope, scope_id, key),
+            )
+            self._conn.commit()
+        return cur.rowcount > 0
+
+    def vector_search(
+        self,
+        scope: str,
+        scope_id: str,
+        query: Iterable[float],
+        top_k: int = 5,
+        metric: str = "cosine",
+    ) -> list[dict[str, Any]]:
+        """Brute-force similarity scan, vectorized over all rows at once."""
+        q = np.asarray(list(query), np.float32)
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, embedding, dim, metadata FROM vectors WHERE scope=? AND scope_id=?",
+                (scope, scope_id),
+            ).fetchall()
+        if not rows:
+            return []
+        keys, mats, metas = [], [], []
+        for r in rows:
+            if r["dim"] != q.size:
+                continue
+            keys.append(r["key"])
+            mats.append(np.frombuffer(r["embedding"], np.float32))
+            metas.append(json.loads(r["metadata"]))
+        if not keys:
+            return []
+        m = np.stack(mats)  # [N, d]
+        if metric == "cosine":
+            denom = np.linalg.norm(m, axis=1) * (np.linalg.norm(q) + 1e-12) + 1e-12
+            scores = (m @ q) / denom
+        elif metric == "dot":
+            scores = m @ q
+        elif metric == "l2":
+            scores = -np.linalg.norm(m - q, axis=1)
+        else:
+            raise ValueError(f"unknown metric {metric!r}")
+        order = np.argsort(-scores)[:top_k]
+        return [
+            {"key": keys[i], "score": float(scores[i]), "metadata": metas[i]} for i in order
+        ]
+
+    # -- webhooks -------------------------------------------------------
+
+    def webhook_create(self, rec: dict[str, Any]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO webhooks(id,execution_id,url,secret,status,attempts,"
+                "next_attempt_at,payload,created_at) VALUES(?,?,?,?,?,?,?,?,?)",
+                (
+                    rec["id"],
+                    rec["execution_id"],
+                    rec["url"],
+                    rec.get("secret"),
+                    rec.get("status", "pending"),
+                    rec.get("attempts", 0),
+                    rec.get("next_attempt_at", time.time()),
+                    json.dumps(rec.get("payload")),
+                    time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def webhook_due(self, now: float, limit: int = 64) -> list[dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM webhooks WHERE status='pending' AND next_attempt_at<=? "
+                "ORDER BY next_attempt_at LIMIT ?",
+                (now, limit),
+            ).fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["payload"] = json.loads(d["payload"]) if d["payload"] else None
+            out.append(d)
+        return out
+
+    def webhook_update(
+        self, wid: str, status: str, attempts: int, next_attempt_at: float, last_error: str | None
+    ) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE webhooks SET status=?, attempts=?, next_attempt_at=?, last_error=? "
+                "WHERE id=?",
+                (status, attempts, next_attempt_at, last_error, wid),
+            )
+            self._conn.commit()
+
+    # -- distributed locks ---------------------------------------------
+
+    def acquire_lock(self, name: str, owner: str, ttl: float) -> bool:
+        """DB-backed lock with TTL (reference: internal/storage/locks.go)."""
+        t = time.time()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT owner, expires_at FROM locks WHERE name=?", (name,)
+            ).fetchone()
+            if row and row["expires_at"] > t and row["owner"] != owner:
+                return False
+            self._conn.execute(
+                "INSERT INTO locks(name,owner,expires_at) VALUES(?,?,?) "
+                "ON CONFLICT(name) DO UPDATE SET owner=excluded.owner, "
+                "expires_at=excluded.expires_at",
+                (name, owner, t + ttl),
+            )
+            self._conn.commit()
+        return True
+
+    def release_lock(self, name: str, owner: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM locks WHERE name=? AND owner=?", (name, owner)
+            )
+            self._conn.commit()
+        return cur.rowcount > 0
+
+    # -- config ---------------------------------------------------------
+
+    def config_set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv_config(key,value) VALUES(?,?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, json.dumps(value)),
+            )
+            self._conn.commit()
+
+    def config_get(self, key: str) -> Any | None:
+        with self._lock:
+            row = self._conn.execute("SELECT value FROM kv_config WHERE key=?", (key,)).fetchone()
+        return json.loads(row["value"]) if row else None

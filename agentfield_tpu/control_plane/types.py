@@ -1,0 +1,157 @@
+"""Core control-plane record types.
+
+Mirrors the semantic content of the reference's pkg/types (AgentNode,
+Execution, status enums — reference: control-plane/pkg/types/types.go:158,
+status machine in pkg/types/status_test.go) without copying its structure:
+records here are plain dataclasses serialized to/from SQLite rows and JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+import uuid
+from typing import Any
+
+
+def now() -> float:
+    return time.time()
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:20]}"
+
+
+class NodeStatus(str, enum.Enum):
+    STARTING = "starting"
+    ACTIVE = "active"
+    INACTIVE = "inactive"
+    STOPPING = "stopping"
+
+    @staticmethod
+    def valid_transition(old: "NodeStatus", new: "NodeStatus") -> bool:
+        """Status state machine (reference: StatusManager.isValidTransition,
+        internal/services/status_manager.go:449). Self-transitions allowed."""
+        if old == new:
+            return True
+        allowed = {
+            NodeStatus.STARTING: {NodeStatus.ACTIVE, NodeStatus.INACTIVE, NodeStatus.STOPPING},
+            NodeStatus.ACTIVE: {NodeStatus.INACTIVE, NodeStatus.STOPPING},
+            NodeStatus.INACTIVE: {NodeStatus.ACTIVE, NodeStatus.STARTING, NodeStatus.STOPPING},
+            NodeStatus.STOPPING: {NodeStatus.INACTIVE, NodeStatus.STARTING},
+        }
+        return new in allowed[old]
+
+
+class ExecutionStatus(str, enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    TIMEOUT = "timeout"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (ExecutionStatus.COMPLETED, ExecutionStatus.FAILED, ExecutionStatus.TIMEOUT)
+
+
+class TargetType(str, enum.Enum):
+    REASONER = "reasoner"
+    SKILL = "skill"
+    GENERATE = "generate"  # model-node inference target (no reference analogue:
+    # this is the in-tree TPU serving path)
+
+
+@dataclasses.dataclass
+class ComponentMeta:
+    """A reasoner or skill exposed by a node."""
+
+    id: str
+    node_id: str
+    kind: str  # "reasoner" | "skill"
+    description: str = ""
+    input_schema: dict[str, Any] = dataclasses.field(default_factory=dict)
+    output_schema: dict[str, Any] = dataclasses.field(default_factory=dict)
+    did: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AgentNode:
+    node_id: str
+    base_url: str
+    status: NodeStatus = NodeStatus.STARTING
+    kind: str = "agent"  # "agent" | "model" (TPU serving node)
+    reasoners: list[ComponentMeta] = dataclasses.field(default_factory=list)
+    skills: list[ComponentMeta] = dataclasses.field(default_factory=list)
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+    did: str | None = None
+    registered_at: float = dataclasses.field(default_factory=now)
+    last_heartbeat: float = dataclasses.field(default_factory=now)
+
+    def component(self, name: str) -> tuple[ComponentMeta, TargetType] | None:
+        for r in self.reasoners:
+            if r.id == name:
+                return r, TargetType.REASONER
+        for s in self.skills:
+            if s.id == name:
+                return s, TargetType.SKILL
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["status"] = self.status.value
+        return d
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "AgentNode":
+        d = dict(d)
+        d["status"] = NodeStatus(d.get("status", "starting"))
+        d["reasoners"] = [ComponentMeta(**r) for r in d.get("reasoners", [])]
+        d["skills"] = [ComponentMeta(**s) for s in d.get("skills", [])]
+        return AgentNode(**d)
+
+
+@dataclasses.dataclass
+class Execution:
+    """One reasoner/skill/generate invocation. The flat parent/run linkage is
+    what the workflow DAG is rebuilt from (reference: workflow_dag.go:268
+    builds the DAG from executions' parent_execution_id)."""
+
+    execution_id: str
+    target: str  # "node_id.component"
+    target_type: TargetType
+    status: ExecutionStatus
+    run_id: str
+    parent_execution_id: str | None = None
+    session_id: str | None = None
+    actor_id: str | None = None
+    input: Any = None
+    result: Any = None
+    error: str | None = None
+    webhook_url: str | None = None
+    created_at: float = dataclasses.field(default_factory=now)
+    started_at: float | None = None
+    finished_at: float | None = None
+    notes: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["target_type"] = self.target_type.value
+        d["status"] = self.status.value
+        return d
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Execution":
+        d = dict(d)
+        d["target_type"] = TargetType(d["target_type"])
+        d["status"] = ExecutionStatus(d["status"])
+        return Execution(**d)
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(obj, separators=(",", ":"), default=str)

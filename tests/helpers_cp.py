@@ -1,0 +1,134 @@
+"""Test harness for the control plane: async test decorator, a fake agent
+node server, and a control-plane boot helper.
+
+Mirrors the reference's test strategy (SURVEY §4): handlers are driven over
+real HTTP against a fake agent (httptest-style), and the full server boots
+on a localhost ephemeral port for integration flows.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import socket
+
+import aiohttp
+from aiohttp import web
+
+from agentfield_tpu.control_plane.server import ControlPlane, create_app
+
+
+def async_test(fn):
+    """Run an async test function to completion on a fresh event loop."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+
+    return wrapper
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeAgent:
+    """A minimal agent node honoring the gateway wire contract.
+
+    Reasoner behaviors:
+      - echo      → 200 {"result": {"echo": input}}
+      - deferred  → 202 now, POST status callback "completed" after a tick
+      - boom      → 500
+      - slow      → sleeps `slow_s`, then 200
+      - silent202 → 202 and never calls back
+    """
+
+    def __init__(self, control_plane_url: str, slow_s: float = 1.0):
+        self.cp_url = control_plane_url
+        self.slow_s = slow_s
+        self.port = free_port()
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        self.calls: list[dict] = []
+        self.runner: web.AppRunner | None = None
+
+    def reasoner_specs(self):
+        return [{"id": r} for r in ("echo", "deferred", "boom", "slow", "silent202")]
+
+    async def _handle(self, req: web.Request):
+        rid = req.match_info["rid"]
+        body = await req.json()
+        self.calls.append({"rid": rid, "body": body, "headers": dict(req.headers)})
+        if rid == "echo":
+            return web.json_response({"result": {"echo": body.get("input")}})
+        if rid == "boom":
+            return web.Response(status=500, text="kaboom")
+        if rid == "slow":
+            await asyncio.sleep(self.slow_s)
+            return web.json_response({"result": "slow done"})
+        if rid == "deferred":
+            eid = body["execution_id"]
+
+            async def callback():
+                await asyncio.sleep(0.05)
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"{self.cp_url}/api/v1/executions/{eid}/status",
+                        json={"status": "completed", "result": {"deferred": True}},
+                    )
+
+            asyncio.create_task(callback())
+            return web.Response(status=202)
+        if rid == "silent202":
+            return web.Response(status=202)
+        return web.Response(status=404)
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_post("/reasoners/{rid}", self._handle)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
+        return self
+
+    async def stop(self):
+        if self.runner:
+            await self.runner.cleanup()
+
+
+class CPHarness:
+    """Boots a real control plane + fake agent, exposes an HTTP client."""
+
+    def __init__(self, **cp_kwargs):
+        self.cp = ControlPlane(**cp_kwargs)
+        self.port = free_port()
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        self.agent = FakeAgent(self.base_url)
+        self._runner: web.AppRunner | None = None
+        self.http: aiohttp.ClientSession | None = None
+
+    async def __aenter__(self):
+        self._runner = web.AppRunner(create_app(self.cp))
+        await self._runner.setup()
+        await web.TCPSite(self._runner, "127.0.0.1", self.port).start()
+        await self.agent.start()
+        self.http = aiohttp.ClientSession(base_url=self.base_url)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.http.close()
+        await self.agent.stop()
+        await self._runner.cleanup()
+
+    async def register_agent(self, node_id: str = "fake-agent"):
+        async with self.http.post(
+            "/api/v1/nodes",
+            json={
+                "node_id": node_id,
+                "base_url": self.agent.base_url,
+                "reasoners": self.agent.reasoner_specs(),
+            },
+        ) as r:
+            assert r.status == 201, await r.text()
+            return await r.json()

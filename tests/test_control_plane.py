@@ -1,0 +1,350 @@
+"""Control-plane integration tests over real HTTP (reference analogue:
+sdk/python/tests/integration/test_agentfield_end_to_end.py — real server,
+real agent process, real round-trips; here in one event loop)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from agentfield_tpu.control_plane.types import ExecutionStatus, NodeStatus
+from agentfield_tpu.control_plane.webhooks import SIGNATURE_HEADER, sign_payload
+from tests.helpers_cp import CPHarness, FakeAgent, async_test, free_port
+from aiohttp import web
+
+
+@async_test
+async def test_register_heartbeat_list():
+    async with CPHarness() as h:
+        body = await h.register_agent()
+        assert body["node"]["status"] == "active"
+        async with h.http.post("/api/v1/nodes/fake-agent/heartbeat") as r:
+            assert r.status == 200
+        async with h.http.get("/api/v1/nodes") as r:
+            nodes = (await r.json())["nodes"]
+            assert [n["node_id"] for n in nodes] == ["fake-agent"]
+        async with h.http.post("/api/v1/nodes/ghost/heartbeat") as r:
+            assert r.status == 404
+
+
+@async_test
+async def test_sync_execute_direct_200():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo", json={"input": {"msg": "hi"}}
+        ) as r:
+            assert r.status == 200
+            doc = await r.json()
+        assert doc["status"] == "completed"
+        assert doc["result"] == {"echo": {"msg": "hi"}}
+        # context headers were forwarded to the agent
+        call = h.agent.calls[0]
+        assert call["headers"]["X-Execution-ID"] == doc["execution_id"]
+        assert call["headers"]["X-Run-ID"] == doc["run_id"]
+
+
+@async_test
+async def test_sync_execute_202_callback():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/fake-agent.deferred", json={}) as r:
+            assert r.status == 200
+            doc = await r.json()
+        assert doc["status"] == "completed"
+        assert doc["result"] == {"deferred": True}
+
+
+@async_test
+async def test_async_execute_poll_and_batch():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/async/fake-agent.deferred", json={}) as r:
+            assert r.status == 202
+            eid = (await r.json())["execution_id"]
+        for _ in range(100):
+            async with h.http.get(f"/api/v1/executions/{eid}") as r:
+                doc = await r.json()
+            if doc["status"] == "completed":
+                break
+            await asyncio.sleep(0.02)
+        assert doc["status"] == "completed"
+        async with h.http.post(
+            "/api/v1/executions/batch-status", json={"execution_ids": [eid, "nope"]}
+        ) as r:
+            batch = (await r.json())["executions"]
+        assert batch[eid]["status"] == "completed"
+        assert "nope" not in batch
+
+
+@async_test
+async def test_error_paths():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/fake-agent.boom", json={}) as r:
+            doc = await r.json()
+        assert doc["status"] == "failed" and "500" in doc["error"]
+        async with h.http.post("/api/v1/execute/no-dot", json={}) as r:
+            assert r.status == 400
+        async with h.http.post("/api/v1/execute/ghost.echo", json={}) as r:
+            assert r.status == 404
+        async with h.http.post("/api/v1/execute/fake-agent.nope", json={}) as r:
+            assert r.status == 404
+
+
+@async_test
+async def test_agent_timeout_fails_execution():
+    async with CPHarness(agent_timeout=0.2) as h:
+        h.agent.slow_s = 5.0
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/fake-agent.slow", json={}) as r:
+            doc = await r.json()
+        assert doc["status"] == "failed"
+        assert "agent call failed" in doc["error"]
+
+
+@async_test
+async def test_async_backpressure_503():
+    async with CPHarness(async_workers=1, queue_capacity=1) as h:
+        h.agent.slow_s = 1.0
+        await h.register_agent()
+        codes = []
+        for _ in range(4):
+            async with h.http.post("/api/v1/execute/async/fake-agent.slow", json={}) as r:
+                codes.append(r.status)
+        assert 503 in codes, codes
+        async with h.http.get("/metrics") as r:
+            text = await r.text()
+        assert "agentfield_gateway_backpressure_total" in text
+
+
+@async_test
+async def test_sync_wait_timeout_marks_timeout():
+    async with CPHarness(sync_wait_timeout=0.3) as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/fake-agent.silent202", json={}) as r:
+            doc = await r.json()
+        assert doc["status"] == "timeout"
+
+
+@async_test
+async def test_memory_kv_and_scopes():
+    async with CPHarness() as h:
+        async with h.http.post("/api/v1/memory/greeting", json={"value": {"x": 1}}) as r:
+            assert r.status == 200
+        async with h.http.get("/api/v1/memory/greeting") as r:
+            assert (await r.json())["value"] == {"x": 1}
+        async with h.http.post(
+            "/api/v1/memory/k1?scope=session&scope_id=s1", json={"value": "a"}
+        ) as r:
+            assert r.status == 200
+        async with h.http.get("/api/v1/memory/k1") as r:
+            assert r.status == 404  # global scope does not see session scope
+        async with h.http.get("/api/v1/memory?scope=session&scope_id=s1") as r:
+            assert (await r.json())["items"] == {"k1": "a"}
+        async with h.http.post("/api/v1/memory/k?scope=session", json={"value": 1}) as r:
+            assert r.status == 400  # session scope requires scope_id
+        async with h.http.delete("/api/v1/memory/greeting") as r:
+            assert r.status == 200
+        async with h.http.get("/api/v1/memory/greeting") as r:
+            assert r.status == 404
+
+
+@async_test
+async def test_vector_memory_search():
+    async with CPHarness() as h:
+        vecs = {"a": [1.0, 0.0], "b": [0.9, 0.1], "c": [0.0, 1.0]}
+        for k, v in vecs.items():
+            async with h.http.post(
+                "/api/v1/memory/vectors/set",
+                json={"key": k, "embedding": v, "metadata": {"name": k}},
+            ) as r:
+                assert r.status == 200
+        async with h.http.post(
+            "/api/v1/memory/vectors/search", json={"embedding": [1.0, 0.0], "top_k": 2}
+        ) as r:
+            res = (await r.json())["results"]
+        assert [x["key"] for x in res] == ["a", "b"]
+        assert res[0]["metadata"] == {"name": "a"}
+
+
+@async_test
+async def test_webhook_delivery_with_hmac_and_retry():
+    received = []
+    attempts = {"n": 0}
+
+    async def receiver(req: web.Request):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            return web.Response(status=500)  # force one retry
+        received.append({"body": await req.read(), "sig": req.headers.get(SIGNATURE_HEADER)})
+        return web.Response(status=200)
+
+    port = free_port()
+    app = web.Application()
+    app.router.add_post("/hook", receiver)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+
+    try:
+        async with CPHarness(webhook_secret="s3cret") as h:
+            h.cp.webhooks.base_backoff = 0.05  # fast retry for the test
+            await h.register_agent()
+            async with h.http.post(
+                "/api/v1/execute/fake-agent.echo",
+                json={"input": 1, "webhook_url": f"http://127.0.0.1:{port}/hook"},
+            ) as r:
+                assert (await r.json())["status"] == "completed"
+            for _ in range(100):
+                if received:
+                    break
+                await asyncio.sleep(0.05)
+            assert received, "webhook never delivered"
+            body = received[0]["body"]
+            assert received[0]["sig"] == sign_payload("s3cret", body)
+            payload = json.loads(body)
+            assert payload["status"] == "completed"
+            assert attempts["n"] == 2  # one failure + one successful retry
+    finally:
+        await runner.cleanup()
+
+
+@async_test
+async def test_sse_execution_events():
+    async with CPHarness() as h:
+        await h.register_agent()
+
+        async def consume():
+            events = []
+            async with aiohttp.ClientSession(base_url=h.base_url) as s:
+                async with s.get("/api/v1/events/executions") as resp:
+                    async for line in resp.content:
+                        if line.startswith(b"data: "):
+                            events.append(json.loads(line[6:]))
+                            if events[-1].get("terminal"):
+                                return events
+            return events
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)  # let the subscriber attach
+        async with h.http.post("/api/v1/execute/fake-agent.echo", json={}) as r:
+            assert r.status == 200
+        events = await asyncio.wait_for(task, timeout=5)
+        assert any(e.get("terminal") and e["status"] == "completed" for e in events)
+
+
+@async_test
+async def test_lowercase_context_headers_and_duplicate_id():
+    async with CPHarness() as h:
+        await h.register_agent()
+        hdrs = {"x-run-id": "run_low", "x-execution-id": "exec_low", "x-session-id": "sess1"}
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo", json={}, headers=hdrs
+        ) as r:
+            doc = await r.json()
+        assert doc["run_id"] == "run_low"
+        assert doc["execution_id"] == "exec_low"
+        assert doc["session_id"] == "sess1"
+        # duplicate execution id → 409, not 500
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo", json={}, headers=hdrs
+        ) as r:
+            assert r.status == 409
+
+
+@async_test
+async def test_client_input_validation_400s():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post(
+            "/api/v1/nodes/fake-agent/heartbeat", json={"status": "bogus"}
+        ) as r:
+            assert r.status == 400
+        async with h.http.get("/api/v1/executions?status=bogus") as r:
+            assert r.status == 400
+        async with h.http.get("/api/v1/executions?limit=abc") as r:
+            assert r.status == 400
+        async with h.http.post(
+            "/api/v1/nodes",
+            json={"node_id": "x", "base_url": "http://y", "reasoners": [{"name": "no-id"}]},
+        ) as r:
+            assert r.status == 400
+
+
+@async_test
+async def test_restart_orphan_cleanup():
+    async with CPHarness(stale_after=0.0) as h:
+        await h.register_agent()
+        # orphaned QUEUED row (as if the process died with work in the queue)
+        from agentfield_tpu.control_plane.types import Execution, ExecutionStatus, TargetType
+
+        ex = Execution(
+            execution_id="exec_orphan",
+            target="fake-agent.echo",
+            target_type=TargetType.REASONER,
+            status=ExecutionStatus.QUEUED,
+            run_id="run_orphan",
+        )
+        h.cp.storage.create_execution(ex)
+        res = h.cp.cleanup_once()
+        assert res["stale"] >= 1
+        assert h.cp.storage.get_execution("exec_orphan").status == ExecutionStatus.TIMEOUT
+
+
+def test_node_status_transitions():
+    ok = NodeStatus.valid_transition
+    assert ok(NodeStatus.STARTING, NodeStatus.ACTIVE)
+    assert ok(NodeStatus.ACTIVE, NodeStatus.INACTIVE)
+    assert ok(NodeStatus.INACTIVE, NodeStatus.ACTIVE)
+    assert ok(NodeStatus.ACTIVE, NodeStatus.ACTIVE)
+    assert not ok(NodeStatus.ACTIVE, NodeStatus.STARTING)
+    assert not ok(NodeStatus.STOPPING, NodeStatus.ACTIVE)
+
+
+@async_test
+async def test_registry_sweep_marks_and_evicts():
+    async with CPHarness(heartbeat_ttl=10, evict_after=100) as h:
+        await h.register_agent("n1")
+        await h.register_agent("n2")
+        reg = h.cp.registry
+        st = h.cp.storage
+        n1 = st.get_node("n1")
+        n1.last_heartbeat -= 50  # past TTL
+        st.upsert_node(n1)
+        n2 = st.get_node("n2")
+        n2.last_heartbeat -= 500  # past hard evict
+        st.upsert_node(n2)
+        res = reg.sweep_once()
+        assert res == {"marked_inactive": 1, "evicted": 1}
+        assert st.get_node("n1").status == NodeStatus.INACTIVE
+        assert st.get_node("n2") is None
+        # inactive node rejects execution with 503
+        async with h.http.post("/api/v1/execute/n1.echo", json={}) as r:
+            assert r.status == 503
+
+
+def test_storage_locks_and_stale(tmp_path):
+    from agentfield_tpu.control_plane.storage import SQLiteStorage
+    from agentfield_tpu.control_plane.types import Execution, TargetType, new_id, now
+
+    st = SQLiteStorage(str(tmp_path / "cp.db"))
+    assert st.acquire_lock("l1", "me", ttl=100)
+    assert not st.acquire_lock("l1", "you", ttl=100)
+    assert st.acquire_lock("l1", "me", ttl=100)  # re-entrant for same owner
+    assert st.release_lock("l1", "me")
+    assert st.acquire_lock("l1", "you", ttl=100)
+
+    ex = Execution(
+        execution_id=new_id("exec"),
+        target="a.b",
+        target_type=TargetType.REASONER,
+        status=ExecutionStatus.RUNNING,
+        run_id=new_id("run"),
+    )
+    st.create_execution(ex)
+    n = st.mark_stale_executions(older_than=now() + 10, now=now())
+    assert n == 1
+    assert st.get_execution(ex.execution_id).status == ExecutionStatus.TIMEOUT
+    st.close()

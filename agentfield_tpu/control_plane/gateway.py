@@ -172,6 +172,8 @@ class ExecutionGateway:
             ) as resp:
                 if resp.status == 200:
                     body = await resp.json()
+                    if not isinstance(body, dict):
+                        raise ValueError(f"agent 200 body must be an object, got {type(body).__name__}")
                     await self.complete(ex.execution_id, result=body.get("result"))
                 elif resp.status == 202:
                     pass  # agent will POST the status callback
@@ -181,7 +183,11 @@ class ExecutionGateway:
                         ex.execution_id,
                         error=f"agent returned {resp.status}: {text}",
                     )
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # Any failure talking to / parsing from the agent must terminate the
+            # execution — an exception here would otherwise strand it RUNNING.
             await self.complete(ex.execution_id, error=f"agent call failed: {e!r}")
         finally:
             self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)
@@ -241,6 +247,12 @@ class ExecutionGateway:
             try:
                 self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
                 self.metrics.inc("worker_dispatch_total")
+                # Re-read: the row may have gone terminal while queued (client
+                # status callback, cleanup) — never resurrect it.
+                fresh = self.storage.get_execution(ex.execution_id)
+                if fresh is None or fresh.status.terminal:
+                    continue
+                ex = fresh
                 node_id = ex.target.split(".", 1)[0]
                 node = self.storage.get_node(node_id)
                 if node is None:

@@ -119,6 +119,7 @@ class NodeRegistry:
             raise RegistryError(404, f"unknown node {node_id!r}; re-register")
         node.last_heartbeat = now()
         requested = (data or {}).get("status")
+        old_status = node.status
         if requested is not None:
             try:
                 new_status = NodeStatus(requested)
@@ -132,10 +133,11 @@ class NodeRegistry:
             if node.status != new_status:
                 self._publish_status(node.node_id, node.status, new_status)
             node.status = new_status
-        # Throttled persistence: immediately on explicit status change, else at
-        # most every 10s — a 2s heartbeat cadence must not hammer SQLite. The
-        # lease check tolerates the staleness (TTL is 300s >> 10s).
-        if requested or now() - self._last_persist.get(node_id, 0) > 10.0:
+        # Throttled persistence: immediately on any actual status change (events
+        # and storage must not diverge), else at most every 10s — a 2s heartbeat
+        # cadence must not hammer SQLite. The lease check tolerates the
+        # staleness (TTL is 300s >> 10s).
+        if node.status != old_status or now() - self._last_persist.get(node_id, 0) > 10.0:
             self.storage.upsert_node(node)
             self._last_persist[node_id] = now()
         return node
@@ -165,8 +167,8 @@ class NodeRegistry:
         """Expire leases: TTL → inactive; hard evict after `evict_after`
         (reference: PresenceManager.checkExpirations, presence_manager.go:113)."""
         t = at or now()
-        marked = evicted = 0
-        for node in self.storage.list_nodes():
+        marked = evicted = active = 0
+        for node in self.storage.list_nodes():  # single pass; gauge derived inline
             age = t - node.last_heartbeat
             if age > self.evict_after:
                 self.deregister(node.node_id)
@@ -176,10 +178,9 @@ class NodeRegistry:
                 node.status = NodeStatus.INACTIVE
                 self.storage.upsert_node(node)
                 marked += 1
-        self.metrics.set_gauge(
-            "nodes_active",
-            sum(1 for n in self.storage.list_nodes() if n.status == NodeStatus.ACTIVE),
-        )
+            elif node.status == NodeStatus.ACTIVE:
+                active += 1
+        self.metrics.set_gauge("nodes_active", active)
         return {"marked_inactive": marked, "evicted": evicted}
 
     async def _sweep_loop(self) -> None:

@@ -98,29 +98,60 @@ class ControlPlane:
         await self.gateway.stop()
         self.storage.close()
 
-    def cleanup_once(self) -> dict[str, int]:
+    async def cleanup_once(self) -> dict[str, int]:
         """Stale marking + retention GC (reference: ExecutionCleanupService,
-        internal/handlers/execution_cleanup.go)."""
+        internal/handlers/execution_cleanup.go). Stale executions terminate
+        through gateway.complete so SSE subscribers and webhooks still see a
+        terminal event for orphaned work."""
         t = now()
-        stale = self.storage.mark_stale_executions(t - self.stale_after, t)
+        stale = 0
+        for status in (ExecutionStatus.RUNNING, ExecutionStatus.QUEUED):
+            for ex in self.storage.list_executions(status=status, limit=10_000):
+                if ex.created_at < t - self.stale_after:
+                    await self.gateway.complete(
+                        ex.execution_id, error="marked stale by cleanup", timeout=True
+                    )
+                    stale += 1
         deleted = self.storage.delete_executions_before(t - self.retention)
+        wh = self.storage.delete_webhooks_before(t - self.retention)
         if stale:
             self.metrics.inc("executions_marked_stale_total", stale)
         if deleted:
             self.metrics.inc("executions_gc_total", deleted)
-        return {"stale": stale, "deleted": deleted}
+        return {"stale": stale, "deleted": deleted, "webhooks_deleted": wh}
 
     async def _cleanup_loop(self) -> None:
         while True:
             await asyncio.sleep(self.cleanup_interval)
             try:
-                self.cleanup_once()
+                await self.cleanup_once()
             except Exception:
                 self.metrics.inc("cleanup_errors_total")
 
 
 def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+class _BadBody(Exception):
+    pass
+
+
+async def _json_dict(req: web.Request, allow_empty: bool = True) -> dict:
+    """Parse the request body as a JSON object; anything else is a 400."""
+    if not req.can_read_body:
+        if allow_empty:
+            return {}
+        raise _BadBody("JSON object body required")
+    try:
+        body = await req.json()
+    except json.JSONDecodeError:
+        raise _BadBody("invalid JSON body") from None
+    if body is None and allow_empty:
+        return {}
+    if not isinstance(body, dict):
+        raise _BadBody(f"JSON object body required, got {type(body).__name__}")
+    return body
 
 
 def create_app(cp: ControlPlane) -> web.Application:
@@ -153,11 +184,11 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/nodes")
     async def register_node(req: web.Request):
         try:
-            node = cp.registry.register(await req.json())
+            node = cp.registry.register(await _json_dict(req, allow_empty=False))
         except RegistryError as e:
             return _json_error(e.status, e.message)
-        except (json.JSONDecodeError, TypeError):
-            return _json_error(400, "invalid JSON body")
+        except (_BadBody, TypeError) as e:
+            return _json_error(400, str(e) or "invalid JSON body")
         return web.json_response({"node": node.to_dict()}, status=201)
 
     @routes.get("/api/v1/nodes")
@@ -174,11 +205,10 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/nodes/{node_id}/heartbeat")
     async def heartbeat(req: web.Request):
         try:
-            body = await req.json() if req.can_read_body else {}
-        except json.JSONDecodeError:
-            body = {}
-        try:
+            body = await _json_dict(req)
             node = cp.registry.heartbeat(req.match_info["node_id"], body)
+        except _BadBody as e:
+            return _json_error(400, str(e))
         except RegistryError as e:
             return _json_error(e.status, e.message)
         return web.json_response({"status": node.status.value, "ts": now()})
@@ -201,17 +231,23 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/execute/{target}")
     async def execute_sync(req: web.Request):
         try:
-            body = await req.json() if req.can_read_body else {}
-        except json.JSONDecodeError:
-            return _json_error(400, "invalid JSON body")
-        try:
+            body = await _json_dict(req)
+            timeout = body.get("timeout")
+            if timeout is not None and (
+                isinstance(timeout, bool)
+                or not isinstance(timeout, (int, float))
+                or timeout <= 0
+            ):
+                raise _BadBody("timeout must be a positive number")
             ex = await cp.gateway.execute_sync(
                 req.match_info["target"],
                 body.get("input"),
                 _headers(req),
                 webhook_url=body.get("webhook_url"),
-                timeout=body.get("timeout"),
+                timeout=timeout,
             )
+        except _BadBody as e:
+            return _json_error(400, str(e))
         except GatewayError as e:
             return _json_error(e.status, e.message)
         return web.json_response(ex.to_dict())
@@ -219,9 +255,9 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/execute/async/{target}")
     async def execute_async(req: web.Request):
         try:
-            body = await req.json() if req.can_read_body else {}
-        except json.JSONDecodeError:
-            return _json_error(400, "invalid JSON body")
+            body = await _json_dict(req)
+        except _BadBody as e:
+            return _json_error(400, str(e))
         try:
             ex = await cp.gateway.execute_async(
                 req.match_info["target"],
@@ -246,9 +282,9 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/executions/{execution_id}/status")
     async def status_callback(req: web.Request):
         try:
-            body = await req.json()
-        except json.JSONDecodeError:
-            return _json_error(400, "invalid JSON body")
+            body = await _json_dict(req, allow_empty=False)
+        except _BadBody as e:
+            return _json_error(400, str(e))
         try:
             ex = await cp.gateway.handle_status_update(
                 req.match_info["execution_id"],
@@ -265,9 +301,9 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.post("/api/v1/executions/batch-status")
     async def batch_status(req: web.Request):
         try:
-            body = await req.json()
-        except json.JSONDecodeError:
-            return _json_error(400, "invalid JSON body")
+            body = await _json_dict(req, allow_empty=False)
+        except _BadBody as e:
+            return _json_error(400, str(e))
         ids = body.get("execution_ids", [])
         if not isinstance(ids, list) or len(ids) > 1000:
             return _json_error(400, "execution_ids must be a list of at most 1000 ids")
@@ -287,8 +323,8 @@ def create_app(cp: ControlPlane) -> web.Application:
         q = req.query
         try:
             status = ExecutionStatus(q["status"]) if "status" in q else None
-            limit = int(q.get("limit", "100"))
-            offset = int(q.get("offset", "0"))
+            limit = min(max(int(q.get("limit", "100")), 1), 1000)
+            offset = max(int(q.get("offset", "0")), 0)
         except ValueError as e:
             return _json_error(400, f"invalid query parameter: {e}")
         exs = cp.storage.list_executions(
@@ -349,11 +385,11 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def memory_set(req: web.Request):
         try:
             scope, scope_id = _scope(req)
-            body = await req.json()
+            body = await _json_dict(req, allow_empty=False)
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        except json.JSONDecodeError:
-            return _json_error(400, "invalid JSON body")
+        except _BadBody as e:
+            return _json_error(400, str(e))
         key = req.match_info["key"]
         cp.storage.memory_set(scope, scope_id, key, body.get("value"))
         cp.bus.publish(
@@ -402,13 +438,13 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def vector_set(req: web.Request):
         try:
             scope, scope_id = _scope(req)
-            body = await req.json()
+            body = await _json_dict(req, allow_empty=False)
             cp.storage.vector_set(
                 scope, scope_id, body["key"], body["embedding"], body.get("metadata")
             )
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        except (_BadBody, KeyError, TypeError, ValueError) as e:
             return _json_error(400, f"invalid vector payload: {e!r}")
         return web.json_response({"ok": True})
 
@@ -416,7 +452,7 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def vector_search(req: web.Request):
         try:
             scope, scope_id = _scope(req)
-            body = await req.json()
+            body = await _json_dict(req, allow_empty=False)
             results = cp.storage.vector_search(
                 scope,
                 scope_id,
@@ -426,7 +462,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             )
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        except (_BadBody, KeyError, TypeError, ValueError) as e:
             return _json_error(400, f"invalid search payload: {e!r}")
         return web.json_response({"results": results})
 
@@ -434,11 +470,11 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def vector_delete(req: web.Request):
         try:
             scope, scope_id = _scope(req)
-            body = await req.json()
+            body = await _json_dict(req, allow_empty=False)
             ok = cp.storage.vector_delete(scope, scope_id, body["key"])
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        except (json.JSONDecodeError, KeyError) as e:
+        except (_BadBody, KeyError, TypeError) as e:
             return _json_error(400, f"invalid payload: {e!r}")
         return web.json_response({"ok": ok})
 

@@ -376,6 +376,16 @@ class SQLiteStorage:
             )
             self._conn.commit()
 
+    def delete_webhooks_before(self, cutoff: float) -> int:
+        """GC terminal webhook rows (delivered/failed) older than cutoff."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM webhooks WHERE created_at < ? AND status IN ('delivered','failed')",
+                (cutoff,),
+            )
+            self._conn.commit()
+        return cur.rowcount
+
     # -- distributed locks ---------------------------------------------
 
     def acquire_lock(self, name: str, owner: str, ttl: float) -> bool:

@@ -1,0 +1,3 @@
+from agentfield_tpu.sdk.agent import Agent, AgentRouter  # noqa: F401
+from agentfield_tpu.sdk.context import ExecutionContext  # noqa: F401
+from agentfield_tpu.sdk.client import ControlPlaneClient  # noqa: F401

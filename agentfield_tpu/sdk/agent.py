@@ -1,0 +1,376 @@
+"""The agent-developer SDK: ``Agent``, ``@reasoner``/``@skill``, ``call()``,
+``ai()``.
+
+Re-design of the reference's Agent core (sdk/python/agentfield/agent.py:305:
+a FastAPI subclass whose decorators synthesize pydantic input models, HTTP
+endpoints and tracked wrappers; serve() registers with the control plane and
+heartbeats). Differences, deliberate:
+
+- aiohttp instead of FastAPI (toolchain), same decorator ergonomics.
+- ``ai()`` routes to an in-tree TPU model node through the control plane
+  (reference delegates to litellm/external providers, agent_ai.py:342) —
+  no external LLM API in the loop.
+- The 202-ack + status-callback contract is identical in spirit to the
+  reference (agent.py:1182-1197: spawn task, ack, POST status later).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+from typing import Any, Callable
+
+import pydantic
+from aiohttp import web
+
+from agentfield_tpu.sdk.client import ControlPlaneClient, ControlPlaneError
+from agentfield_tpu.sdk.context import (
+    ExecutionContext,
+    current_context,
+    reset_context,
+    set_context,
+)
+
+DEFAULT_CONTROL_PLANE = os.environ.get("AGENTFIELD_URL", "http://127.0.0.1:8800")
+
+
+def _schema_from_signature(fn: Callable) -> tuple[type[pydantic.BaseModel], dict, list[str]]:
+    """Synthesize a pydantic input model from the function signature
+    (reference builds InputSchema the same way, agent.py:1150-1162).
+    Parameters named ctx/context receive the current ExecutionContext at
+    invocation instead of appearing in the schema."""
+    fields: dict[str, Any] = {}
+    ctx_params: list[str] = []
+    for name, p in inspect.signature(fn).parameters.items():
+        if name == "self":
+            continue
+        if name in ("ctx", "context"):
+            ctx_params.append(name)
+            continue
+        ann = p.annotation if p.annotation is not inspect.Parameter.empty else Any
+        default = p.default if p.default is not inspect.Parameter.empty else ...
+        fields[name] = (ann, default)
+    model = pydantic.create_model(f"{fn.__name__}_Input", **fields)
+    return model, model.model_json_schema(), ctx_params
+
+
+class ComponentDef:
+    def __init__(self, id: str, kind: str, fn: Callable, description: str):
+        self.id = id
+        self.kind = kind  # "reasoner" | "skill"
+        self.fn = fn
+        self.description = description
+        self.input_model, self.input_schema, self.ctx_params = _schema_from_signature(fn)
+
+    async def invoke(self, payload: Any, ctx: "ExecutionContext | None" = None) -> Any:
+        if isinstance(payload, dict):
+            kwargs = dict(self.input_model(**payload))
+        elif payload is None:
+            kwargs = dict(self.input_model())
+        else:
+            required = [
+                n for n, f in self.input_model.model_fields.items() if f.is_required()
+            ]
+            if len(required) != 1:
+                raise TypeError(
+                    f"{self.id} expects keyword arguments {list(self.input_model.model_fields)}"
+                )
+            kwargs = dict(self.input_model(**{required[0]: payload}))
+        for name in self.ctx_params:
+            kwargs[name] = ctx
+        result = self.fn(**kwargs)
+        if inspect.isawaitable(result):
+            result = await result
+        return result
+
+
+class AgentRouter:
+    """Composable component group attached via include_router (reference:
+    sdk/python/agentfield/router.py:13 + agent.py:2042 — prefixing semantics)."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix.strip("_")
+        self.components: list[ComponentDef] = []
+
+    def reasoner(self, id: str | None = None, description: str = ""):
+        return self._decorator("reasoner", id, description)
+
+    def skill(self, id: str | None = None, description: str = ""):
+        return self._decorator("skill", id, description)
+
+    def _decorator(self, kind: str, id: str | None, description: str):
+        def deco(fn):
+            cid = id or fn.__name__
+            if self.prefix:
+                cid = f"{self.prefix}_{cid}"
+            self.components.append(ComponentDef(cid, kind, fn, description or (fn.__doc__ or "")))
+            return fn
+
+        return deco
+
+
+class Agent:
+    def __init__(
+        self,
+        node_id: str,
+        control_plane: str = DEFAULT_CONTROL_PLANE,
+        host: str = "127.0.0.1",
+        port: int = 0,  # 0 → auto-assign (reference AGENTFIELD_AUTO_PORT)
+        kind: str = "agent",
+        heartbeat_interval: float = 2.0,  # reference enhanced-heartbeat cadence
+        metadata: dict | None = None,
+    ):
+        if "." in node_id:
+            raise ValueError("node_id must not contain '.'")
+        self.node_id = node_id
+        self.kind = kind
+        self.host = host
+        self.port = port
+        self.metadata = metadata or {}
+        self.heartbeat_interval = heartbeat_interval
+        self.client = ControlPlaneClient(control_plane)
+        self.components: dict[str, ComponentDef] = {}
+        self._runner: web.AppRunner | None = None
+        self._hb_task: asyncio.Task | None = None
+        self._pending: set[asyncio.Task] = set()
+
+    # -- decorators -----------------------------------------------------
+
+    def reasoner(self, id: str | None = None, description: str = ""):
+        return self._decorator("reasoner", id, description)
+
+    def skill(self, id: str | None = None, description: str = ""):
+        return self._decorator("skill", id, description)
+
+    def _decorator(self, kind: str, id: str | None, description: str):
+        def deco(fn):
+            comp = ComponentDef(id or fn.__name__, kind, fn, description or (fn.__doc__ or ""))
+            self._add_component(comp)
+            return fn
+
+        return deco
+
+    def _add_component(self, comp: ComponentDef) -> None:
+        if comp.id in self.components:
+            raise ValueError(f"duplicate component id {comp.id!r}")
+        self.components[comp.id] = comp
+
+    def include_router(self, router: AgentRouter) -> None:
+        for comp in router.components:
+            self._add_component(comp)
+
+    # -- HTTP surface ---------------------------------------------------
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+
+        async def handle(req: web.Request) -> web.Response:
+            comp = self.components.get(req.match_info["cid"])
+            kind = "reasoner" if req.path.startswith("/reasoners/") else "skill"
+            if comp is None or comp.kind != kind:
+                return web.json_response({"error": "unknown component"}, status=404)
+            try:
+                body = await req.json() if req.can_read_body else {}
+            except Exception:
+                return web.json_response({"error": "invalid JSON"}, status=400)
+            payload = body.get("input")
+            ctx = ExecutionContext.from_headers(req.headers)
+            if ctx is None:
+                # Direct invocation (no gateway execution id): run inline.
+                try:
+                    result = await self._run(comp, payload, ExecutionContext.new_root())
+                except pydantic.ValidationError as e:
+                    return web.json_response({"error": str(e)}, status=422)
+                except Exception as e:
+                    return web.json_response({"error": repr(e)}, status=500)
+                return web.json_response({"result": result})
+            # Gateway-tracked: ack 202, execute in background, call back
+            # (reference: agent.py:1182-1197 + _execute_async_with_callback).
+            task = asyncio.create_task(self._run_tracked(comp, payload, ctx))
+            self._pending.add(task)
+            task.add_done_callback(self._pending.discard)
+            return web.Response(status=202)
+
+        async def health(_req):
+            return web.json_response({"status": "ok", "node_id": self.node_id})
+
+        async def list_components(req: web.Request):
+            kind = "reasoner" if req.path == "/reasoners" else "skill"
+            return web.json_response(
+                {
+                    kind + "s": [
+                        {"id": c.id, "description": c.description, "input_schema": c.input_schema}
+                        for c in self.components.values()
+                        if c.kind == kind
+                    ]
+                }
+            )
+
+        app.router.add_post("/reasoners/{cid}", handle)
+        app.router.add_post("/skills/{cid}", handle)
+        app.router.add_get("/health", health)
+        app.router.add_get("/reasoners", list_components)
+        app.router.add_get("/skills", list_components)
+        return app
+
+    async def _run(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> Any:
+        token = set_context(ctx)
+        try:
+            return await comp.invoke(payload, ctx)
+        finally:
+            reset_context(token)
+
+    async def _run_tracked(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> None:
+        try:
+            result = await self._run(comp, payload, ctx)
+        except Exception as e:
+            await self._safe_status(ctx.execution_id, "failed", error=repr(e))
+        else:
+            await self._safe_status(ctx.execution_id, "completed", result=result)
+
+    async def _safe_status(self, execution_id: str, status: str, **kw) -> None:
+        try:
+            await self.client.post_status(execution_id, status, **kw)
+        except Exception:
+            pass  # control plane unreachable; execution will be marked stale
+
+    # -- outbound: call() and ai() -------------------------------------
+
+    def _outbound_ctx(self) -> ExecutionContext:
+        ctx = current_context()
+        return ctx.child() if ctx else ExecutionContext.new_root()
+
+    async def call(self, target: str, _payload: Any = None, **kwargs) -> Any:
+        """Cross-agent invocation through the gateway with DAG linkage
+        (reference: Agent.call, agent.py:2472)."""
+        payload = _payload if _payload is not None else (kwargs or None)
+        doc = await self.client.execute(target, payload, headers=self._outbound_ctx().to_headers())
+        if doc["status"] != "completed":
+            raise RuntimeError(f"call {target} {doc['status']}: {doc.get('error')}")
+        return doc["result"]
+
+    async def ai(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        model: str | None = None,
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: list[int] | None = None,
+        timeout: float = 600.0,
+    ) -> dict[str, Any]:
+        """LLM call served by an in-tree TPU model node (replaces the
+        reference's litellm path, agent_ai.py:95-447). Placement v0: first
+        active model node (or `model` node id); the placement scheduler
+        arrives with multi-node support."""
+        node_id = model
+        if node_id is None:
+            nodes = await self.client.list_nodes()
+            candidates = [
+                n["node_id"] for n in nodes if n.get("kind") == "model" and n["status"] == "active"
+            ]
+            if not candidates:
+                raise RuntimeError("no active model node registered")
+            node_id = candidates[0]
+        payload = {
+            "prompt": prompt,
+            "tokens": tokens,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "stop_token_ids": stop_token_ids or [],
+        }
+        doc = await self.client.execute(
+            f"{node_id}.generate",
+            payload,
+            headers=self._outbound_ctx().to_headers(),
+            timeout=timeout,
+        )
+        if doc["status"] != "completed":
+            raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
+        return doc["result"]
+
+    # -- memory façade --------------------------------------------------
+
+    @property
+    def memory(self) -> ControlPlaneClient:
+        """Scoped memory API (reference: Agent.memory, agent.py:750)."""
+        return self.client
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _node_spec(self) -> dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "base_url": f"http://{self.host}:{self.port}",
+            "kind": self.kind,
+            "metadata": self.metadata,
+            "reasoners": [
+                {"id": c.id, "description": c.description, "input_schema": c.input_schema}
+                for c in self.components.values()
+                if c.kind == "reasoner"
+            ],
+            "skills": [
+                {"id": c.id, "description": c.description, "input_schema": c.input_schema}
+                for c in self.components.values()
+                if c.kind == "skill"
+            ],
+        }
+
+    async def start(self) -> None:
+        """Start the HTTP server, register, begin heartbeating."""
+        self._runner = web.AppRunner(self._build_app())
+        await self._runner.setup()
+        # Bind port 0 directly and read back the kernel-assigned port — no
+        # probe-close-rebind TOCTOU race.
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        await self.client.register_node(self._node_spec())
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+            await asyncio.gather(self._hb_task, return_exceptions=True)
+        if self._pending:
+            await asyncio.gather(*self._pending, return_exceptions=True)
+        try:
+            await self.client.heartbeat(self.node_id, status="stopping")
+        except Exception:
+            pass
+        if self._runner:
+            await self._runner.cleanup()
+        await self.client.close()
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            try:
+                await self.client.heartbeat(self.node_id)
+            except ControlPlaneError as e:
+                if e.status == 404:  # control plane restarted: re-register
+                    try:
+                        await self.client.register_node(self._node_spec())
+                    except Exception:
+                        pass
+            except Exception:
+                pass  # transient; keep heartbeating (reference ConnectionManager)
+
+    def serve(self) -> None:
+        """Blocking entrypoint for standalone agent processes."""
+
+        async def main():
+            await self.start()
+            stop = asyncio.Event()
+            try:
+                await stop.wait()
+            finally:
+                await self.stop()
+
+        asyncio.run(main())

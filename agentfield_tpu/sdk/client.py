@@ -1,0 +1,228 @@
+"""Control-plane HTTP client.
+
+The SDK-side counterpart of the reference's AgentFieldClient
+(sdk/python/agentfield/client.py:68: register, execute sync/async, batch
+status, heartbeat, graceful shutdown) on aiohttp. Async-only — the SDK's
+public sync façade wraps it with asyncio.run where needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+from urllib.parse import quote, urlencode
+
+import aiohttp
+
+
+class ControlPlaneError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"[{status}] {message}")
+        self.status = status
+        self.message = message
+
+
+class ControlPlaneClient:
+    def __init__(self, base_url: str, timeout: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _s(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def _req(self, method: str, path: str, **kw) -> Any:
+        s = await self._s()
+        async with s.request(method, self.base_url + path, **kw) as resp:
+            if resp.status >= 400:
+                try:
+                    msg = (await resp.json()).get("error", "")
+                except Exception:
+                    msg = (await resp.text())[:300]
+                raise ControlPlaneError(resp.status, msg)
+            if resp.content_type == "application/json":
+                return await resp.json()
+            return await resp.text()
+
+    # -- nodes ----------------------------------------------------------
+
+    async def register_node(self, spec: dict[str, Any]) -> dict[str, Any]:
+        return await self._req("POST", "/api/v1/nodes", json=spec)
+
+    async def heartbeat(self, node_id: str, status: str | None = None) -> dict[str, Any]:
+        body = {"status": status} if status else {}
+        return await self._req("POST", f"/api/v1/nodes/{node_id}/heartbeat", json=body)
+
+    async def deregister_node(self, node_id: str) -> None:
+        await self._req("DELETE", f"/api/v1/nodes/{node_id}")
+
+    async def list_nodes(self) -> list[dict[str, Any]]:
+        return (await self._req("GET", "/api/v1/nodes"))["nodes"]
+
+    # -- execution ------------------------------------------------------
+
+    async def execute(
+        self,
+        target: str,
+        payload: Any = None,
+        headers: dict[str, str] | None = None,
+        timeout: float | None = None,
+        webhook_url: str | None = None,
+    ) -> dict[str, Any]:
+        body: dict[str, Any] = {"input": payload}
+        if timeout is not None:
+            body["timeout"] = timeout
+        if webhook_url:
+            body["webhook_url"] = webhook_url
+        return await self._req(
+            "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}
+        )
+
+    async def execute_async(
+        self,
+        target: str,
+        payload: Any = None,
+        headers: dict[str, str] | None = None,
+        webhook_url: str | None = None,
+    ) -> dict[str, Any]:
+        body: dict[str, Any] = {"input": payload}
+        if webhook_url:
+            body["webhook_url"] = webhook_url
+        return await self._req(
+            "POST", f"/api/v1/execute/async/{target}", json=body, headers=headers or {}
+        )
+
+    async def get_execution(self, execution_id: str) -> dict[str, Any]:
+        return await self._req("GET", f"/api/v1/executions/{execution_id}")
+
+    async def batch_status(self, execution_ids: list[str]) -> dict[str, Any]:
+        return (
+            await self._req(
+                "POST", "/api/v1/executions/batch-status", json={"execution_ids": execution_ids}
+            )
+        )["executions"]
+
+    async def post_status(
+        self, execution_id: str, status: str, result: Any = None, error: str | None = None
+    ) -> None:
+        """Agent-side completion callback, retried with backoff (the reference
+        retries 5x — agent.py:1493-1515)."""
+        last: Exception | None = None
+        for attempt in range(5):
+            try:
+                await self._req(
+                    "POST",
+                    f"/api/v1/executions/{execution_id}/status",
+                    json={"status": status, "result": result, "error": error},
+                )
+                return
+            except ControlPlaneError as e:
+                if e.status < 500:
+                    raise
+                last = e
+            except aiohttp.ClientError as e:
+                last = e
+            await asyncio.sleep(0.2 * (2**attempt))
+        raise last  # type: ignore[misc]
+
+    async def wait_for_execution(
+        self, execution_id: str, timeout: float = 600.0, poll_interval: float = 0.05
+    ) -> dict[str, Any]:
+        """Adaptive polling until terminal (the reference prefers an SSE event
+        stream with polling fallback — async_execution_manager.py:644; v0
+        polls with backoff, SSE client lands with streaming support)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        interval = poll_interval
+        while True:
+            doc = await self.get_execution(execution_id)
+            if doc["status"] in ("completed", "failed", "timeout"):
+                return doc
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"execution {execution_id} not terminal after {timeout}s")
+            await asyncio.sleep(interval)
+            interval = min(interval * 1.5, 1.0)
+
+    # -- memory ---------------------------------------------------------
+
+    def _scope_q(self, scope: str, scope_id: str | None, **extra: str) -> str:
+        params = {"scope": scope}
+        if scope_id:
+            params["scope_id"] = scope_id
+        params.update({k: v for k, v in extra.items() if v})
+        return "?" + urlencode(params)
+
+    @staticmethod
+    def _k(key: str) -> str:
+        return quote(key, safe="")
+
+    async def memory_set(
+        self, key: str, value: Any, scope: str = "global", scope_id: str | None = None
+    ) -> None:
+        await self._req(
+            "POST", f"/api/v1/memory/{self._k(key)}{self._scope_q(scope, scope_id)}", json={"value": value}
+        )
+
+    async def memory_get(
+        self, key: str, scope: str = "global", scope_id: str | None = None, default: Any = None
+    ) -> Any:
+        try:
+            return (await self._req("GET", f"/api/v1/memory/{self._k(key)}{self._scope_q(scope, scope_id)}"))[
+                "value"
+            ]
+        except ControlPlaneError as e:
+            if e.status == 404:
+                return default
+            raise
+
+    async def memory_delete(
+        self, key: str, scope: str = "global", scope_id: str | None = None
+    ) -> bool:
+        try:
+            await self._req("DELETE", f"/api/v1/memory/{self._k(key)}{self._scope_q(scope, scope_id)}")
+            return True
+        except ControlPlaneError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    async def memory_list(
+        self, scope: str = "global", scope_id: str | None = None, prefix: str = ""
+    ) -> dict[str, Any]:
+        q = self._scope_q(scope, scope_id, prefix=prefix)
+        return (await self._req("GET", f"/api/v1/memory{q}"))["items"]
+
+    async def vector_set(
+        self,
+        key: str,
+        embedding: list[float],
+        metadata: dict | None = None,
+        scope: str = "global",
+        scope_id: str | None = None,
+    ) -> None:
+        await self._req(
+            "POST",
+            f"/api/v1/memory/vectors/set{self._scope_q(scope, scope_id)}",
+            json={"key": key, "embedding": embedding, "metadata": metadata},
+        )
+
+    async def vector_search(
+        self,
+        embedding: list[float],
+        top_k: int = 5,
+        metric: str = "cosine",
+        scope: str = "global",
+        scope_id: str | None = None,
+    ) -> list[dict[str, Any]]:
+        return (
+            await self._req(
+                "POST",
+                f"/api/v1/memory/vectors/search{self._scope_q(scope, scope_id)}",
+                json={"embedding": embedding, "top_k": top_k, "metric": metric},
+            )
+        )["results"]

@@ -1,0 +1,206 @@
+"""Model node: the TPU serving engine exposed as a control-plane node.
+
+This is the piece that has no analogue in the reference — there, ``ai()``
+left the cluster via litellm (agent_ai.py:342). Here a model node registers
+like any agent node (kind="model") with a single ``generate`` reasoner, so
+placement, health, status, DAG tracking and webhooks all apply to LLM calls
+for free, and N concurrent ``ai()`` calls across the cluster coalesce into
+shared decode steps in one engine (SURVEY §2.4 serving row).
+
+The engine runs on a dedicated thread (JAX compute must not block the event
+loop); completions resolve asyncio futures on the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import jax
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    QueueFullError,
+    Request,
+    RequestTooLongError,
+)
+from agentfield_tpu.serving.sampler import SamplingParams
+from agentfield_tpu.sdk.agent import Agent
+
+
+class ByteTokenizer:
+    """Trivial byte-level tokenizer for demos/tests with random-weight models
+    (real checkpoints use the HF tokenizer adapter)."""
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return [b % self.vocab_size for b in text.encode("utf-8")]
+
+    def decode(self, tokens: list[int]) -> str:
+        return bytes(t % 256 for t in tokens).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer adapter (for real Llama checkpoints)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, tokens: list[int]) -> str:
+        return self._tok.decode(tokens)
+
+
+class ModelBackend:
+    def __init__(
+        self,
+        params: Any,
+        cfg: LlamaConfig,
+        ecfg: EngineConfig | None = None,
+        tokenizer=None,
+        seed: int = 0,
+        idle_sleep: float = 0.002,
+        model_name: str = "custom",
+    ):
+        self.cfg = cfg
+        self.model_name = model_name
+        self.engine = InferenceEngine(params, cfg, ecfg, seed=seed)
+        self.tokenizer = tokenizer
+        self.idle_sleep = idle_sleep
+        self._buffers: dict[str, list[int]] = {}
+        self._futures: dict[str, asyncio.Future] = {}
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._next = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._drive_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.cancel()
+
+    async def _drive_loop(self) -> None:
+        """Continuous-batching driver: engine.step() on a worker thread, token
+        events dispatched to waiting futures. A step failure must not kill the
+        loop silently — it would strand every in-flight future (cf. the
+        gateway worker-loop guard)."""
+        while True:
+            if not self.engine.has_work():
+                self._wake.clear()
+                try:
+                    async with asyncio.timeout(self.idle_sleep * 50):
+                        await self._wake.wait()
+                except TimeoutError:
+                    continue
+            try:
+                events = await asyncio.to_thread(self.engine.step)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # Fail everything in flight with the real error; the engine's
+                # state may be corrupt, so don't pretend those requests live.
+                for rid, fut in list(self._futures.items()):
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(f"engine step failed: {e!r}"))
+                    self._futures.pop(rid, None)
+                    self._buffers.pop(rid, None)
+                await asyncio.sleep(0.1)
+                continue
+            for ev in events:
+                buf = self._buffers.setdefault(ev.request_id, [])
+                buf.append(ev.token)
+                if ev.finished:
+                    fut = self._futures.pop(ev.request_id, None)
+                    tokens = self._buffers.pop(ev.request_id, [])
+                    if fut is not None and not fut.done():
+                        fut.set_result({"tokens": tokens, "finish_reason": ev.finish_reason})
+
+    async def generate(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: list[int] | None = None,
+    ) -> dict[str, Any]:
+        if tokens is None:
+            if prompt is None:
+                raise ValueError("one of 'prompt' or 'tokens' is required")
+            if self.tokenizer is None:
+                raise ValueError("no tokenizer loaded on this model node; pass 'tokens'")
+            tokens = self.tokenizer.encode(prompt)
+        self._next += 1
+        rid = f"gen_{self._next}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._futures[rid] = fut
+        try:
+            self.engine.submit(
+                Request(
+                    id=rid,
+                    prompt=list(tokens),
+                    sampling=SamplingParams(
+                        temperature=temperature,
+                        top_k=top_k,
+                        top_p=top_p,
+                        max_new_tokens=max_new_tokens,
+                        stop_token_ids=tuple(stop_token_ids or ()),
+                    ),
+                )
+            )
+        except (QueueFullError, RequestTooLongError):
+            self._futures.pop(rid, None)
+            raise
+        self._wake.set()
+        result = await fut
+        if self.tokenizer is not None:
+            result["text"] = self.tokenizer.decode(result["tokens"])
+        result["model"] = self.model_name
+        return result
+
+
+def build_model_node(
+    node_id: str = "model",
+    control_plane: str | None = None,
+    model: str = "llama-tiny",
+    params: Any = None,
+    ecfg: EngineConfig | None = None,
+    tokenizer=None,
+    seed: int = 0,
+) -> tuple[Agent, ModelBackend]:
+    """Construct (agent, backend): the agent exposes `generate` and handles
+    registration/heartbeats; the backend drives the engine. Caller sequence:
+    ``await backend.start(); await agent.start()``."""
+    cfg = get_config(model)
+    if params is None:
+        params = init_params(cfg, jax.random.PRNGKey(seed))
+    if tokenizer is None:
+        tokenizer = ByteTokenizer(cfg.vocab_size)
+    backend = ModelBackend(params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model)
+
+    kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
+    if control_plane:
+        kwargs["control_plane"] = control_plane
+    agent = Agent(node_id, **kwargs)
+    # The bound method's own signature drives schema synthesis — no
+    # hand-maintained forwarding wrapper to drift out of sync.
+    agent.reasoner(id="generate", description=f"TPU-served {model} generation")(
+        backend.generate
+    )
+    return agent, backend

@@ -1,0 +1,40 @@
+"""The greeting agent (north-star config 1): one reasoner backed by
+`Agent.ai()` served from the in-tree TPU model node.
+
+Usage: python examples/greeting_agent.py [control_plane_url]
+Then:  curl -X POST $CP/api/v1/execute/greeting-agent.say_hello \
+            -d '{"input": {"name": "world"}}'
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from agentfield_tpu.sdk import Agent
+
+
+def build(cp_url: str) -> Agent:
+    app = Agent("greeting-agent", cp_url)
+
+    @app.reasoner(description="Greet someone with a model-generated flourish")
+    async def say_hello(name: str, max_new_tokens: int = 12) -> dict:
+        out = await app.ai(prompt=f"Hello {name}!", max_new_tokens=max_new_tokens)
+        return {"greeting": f"Hello {name}!", "model_says": out.get("text"), "model": out["model"]}
+
+    return app
+
+
+async def main() -> None:
+    cp_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8800"
+    app = build(cp_url)
+    await app.start()
+    print(f"greeting-agent registered at :{app.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await app.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,23 @@
+"""Run a standalone control plane.
+
+Usage: python examples/run_control_plane.py [port] [db_path]
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from agentfield_tpu.control_plane.server import ControlPlane, run_server
+
+
+async def main() -> None:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8800
+    db = sys.argv[2] if len(sys.argv) > 2 else ":memory:"
+    await run_server(ControlPlane(db_path=db), port=port)
+    print(f"control plane listening on :{port} (db={db})", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

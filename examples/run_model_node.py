@@ -1,0 +1,38 @@
+"""Run a TPU model node serving `generate` to the cluster.
+
+Usage: python examples/run_model_node.py [control_plane_url] [model]
+Env:   AGENTFIELD_MODEL_CPU=1  — serve on the CPU backend (debug/demo)
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+if os.environ.get("AGENTFIELD_MODEL_CPU") == "1":
+    from agentfield_tpu._compat import force_cpu_backend
+
+    force_cpu_backend()
+
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import build_model_node
+
+
+async def main() -> None:
+    cp_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8800"
+    model = sys.argv[2] if len(sys.argv) > 2 else "llama-tiny"
+    ecfg = EngineConfig(max_batch=8, page_size=16, num_pages=256, max_pages_per_seq=16)
+    agent, backend = build_model_node("model", cp_url, model=model, ecfg=ecfg)
+    await backend.start()
+    await agent.start()
+    print(f"model node '{model}' registered at :{agent.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await agent.stop()
+        await backend.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -288,7 +288,7 @@ async def test_restart_orphan_cleanup():
             run_id="run_orphan",
         )
         h.cp.storage.create_execution(ex)
-        res = h.cp.cleanup_once()
+        res = await h.cp.cleanup_once()
         assert res["stale"] >= 1
         assert h.cp.storage.get_execution("exec_orphan").status == ExecutionStatus.TIMEOUT
 

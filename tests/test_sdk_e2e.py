@@ -1,0 +1,224 @@
+"""End-to-end SDK tests: real control plane + real SDK agents + a real model
+node (tiny Llama on CPU) in one event loop — the minimum end-to-end slice of
+SURVEY §7 step 4 (greeting-agent say_hello → control plane → model node →
+tokens back)."""
+
+import asyncio
+
+import pytest
+
+from agentfield_tpu.sdk import Agent, AgentRouter
+from agentfield_tpu.sdk.context import current_context
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import ByteTokenizer, build_model_node
+from tests.helpers_cp import CPHarness, async_test
+
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=128, max_pages_per_seq=16)
+
+
+@async_test
+async def test_reasoner_schema_and_direct_invoke():
+    async with CPHarness() as h:
+        app = Agent("greeter", h.base_url)
+
+        @app.reasoner()
+        def say_hello(name: str, excited: bool = False) -> str:
+            return f"Hello {name}{'!' if excited else '.'}"
+
+        await app.start()
+        try:
+            # schema synthesized from the signature
+            spec = app._node_spec()["reasoners"][0]
+            assert spec["id"] == "say_hello"
+            assert "name" in spec["input_schema"]["properties"]
+            # gateway round-trip with kwargs mapping
+            async with h.http.post(
+                "/api/v1/execute/greeter.say_hello",
+                json={"input": {"name": "Ada", "excited": True}},
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["result"] == "Hello Ada!"
+            # validation error → failed execution, not a hang
+            async with h.http.post(
+                "/api/v1/execute/greeter.say_hello", json={"input": {"wrong": 1}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "failed"
+        finally:
+            await app.stop()
+
+
+@async_test
+async def test_cross_agent_call_preserves_run_dag():
+    async with CPHarness() as h:
+        upstream = Agent("upstream", h.base_url)
+        downstream = Agent("downstream", h.base_url)
+        seen = {}
+
+        @downstream.reasoner()
+        def leaf(x: int) -> int:
+            seen["leaf_ctx"] = current_context()
+            return x * 2
+
+        @upstream.reasoner()
+        async def root(x: int) -> int:
+            seen["root_ctx"] = current_context()
+            return await upstream.call("downstream.leaf", x=x) + 1
+
+        await upstream.start()
+        await downstream.start()
+        try:
+            async with h.http.post(
+                "/api/v1/execute/upstream.root", json={"input": {"x": 5}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["result"] == 11
+            # same run, child linked to parent — the DAG edge
+            assert seen["leaf_ctx"].run_id == seen["root_ctx"].run_id == doc["run_id"]
+            assert seen["leaf_ctx"].parent_execution_id == seen["root_ctx"].execution_id
+            # both executions visible under the run
+            async with h.http.get(f"/api/v1/executions?run_id={doc['run_id']}") as r:
+                execs = (await r.json())["executions"]
+            assert len(execs) == 2
+        finally:
+            await upstream.stop()
+            await downstream.stop()
+
+
+@async_test
+async def test_agent_ai_through_model_node():
+    """north-star config 1: Agent.ai() → control plane → TPU model node."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("greeting-agent", h.base_url)
+
+        @app.reasoner()
+        async def say_hello(name: str) -> dict:
+            out = await app.ai(prompt=f"Hello {name}", max_new_tokens=6)
+            return {"reply_tokens": out["tokens"], "model": out["model"]}
+
+        await app.start()
+        try:
+            async with h.http.post(
+                "/api/v1/execute/greeting-agent.say_hello",
+                json={"input": {"name": "world"}},
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert len(doc["result"]["reply_tokens"]) == 6
+            assert doc["result"]["model"] == "llama-tiny"
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_concurrent_ai_calls_share_engine():
+    """north-star config 3 in miniature: N concurrent ai() calls coalesce
+    into shared decode steps on one engine."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        caller = Agent("caller", h.base_url)
+        await caller.start()
+        try:
+            outs = await asyncio.gather(
+                *(
+                    caller.ai(prompt=f"request number {i}", max_new_tokens=5)
+                    for i in range(8)
+                )
+            )
+            assert all(len(o["tokens"]) == 5 for o in outs)
+            stats = backend.engine.stats
+            # 8 requests × 5 tokens, but decode steps shared across slots:
+            # strictly fewer steps than tokens proves coalescing
+            assert stats["decode_tokens"] > stats["decode_steps"]
+        finally:
+            await caller.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_router_prefixing_and_skills():
+    async with CPHarness() as h:
+        app = Agent("routed", h.base_url)
+        router = AgentRouter(prefix="math")
+
+        @router.skill()
+        def add(a: int, b: int) -> int:
+            return a + b
+
+        app.include_router(router)
+        await app.start()
+        try:
+            async with h.http.post(
+                "/api/v1/execute/routed.math_add", json={"input": {"a": 2, "b": 3}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["result"] == 5
+            assert doc["target_type"] == "skill"
+        finally:
+            await app.stop()
+
+
+@async_test
+async def test_memory_facade():
+    async with CPHarness() as h:
+        app = Agent("memuser", h.base_url)
+        await app.start()
+        try:
+            await app.memory.memory_set("notes", {"v": 1}, scope="session", scope_id="s9")
+            got = await app.memory.memory_get("notes", scope="session", scope_id="s9")
+            assert got == {"v": 1}
+            assert await app.memory.memory_get("missing", default="dflt") == "dflt"
+            # URL-hostile keys survive the round-trip (percent-encoding)
+            weird = "user/prefs?x=1&y=#z"
+            await app.memory.memory_set(weird, "ok")
+            assert await app.memory.memory_get(weird) == "ok"
+            assert await app.memory.memory_delete(weird)
+        finally:
+            await app.stop()
+
+
+@async_test
+async def test_ctx_param_injection():
+    async with CPHarness() as h:
+        app = Agent("ctxuser", h.base_url)
+
+        @app.reasoner()
+        def who_am_i(ctx, tag: str) -> dict:
+            return {"tag": tag, "execution_id": ctx.execution_id, "run_id": ctx.run_id}
+
+        await app.start()
+        try:
+            async with h.http.post(
+                "/api/v1/execute/ctxuser.who_am_i", json={"input": {"tag": "t1"}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["result"]["execution_id"] == doc["execution_id"]
+            assert doc["result"]["run_id"] == doc["run_id"]
+            # ctx is not part of the public schema
+            spec = app._node_spec()["reasoners"][0]
+            assert "ctx" not in spec["input_schema"].get("properties", {})
+        finally:
+            await app.stop()
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(512)
+    ids = tok.encode("hello")
+    assert tok.decode(ids) == "hello"
+    assert all(0 <= t < 512 for t in ids)

@@ -197,22 +197,6 @@ class SQLiteStorage:
             rows = self._conn.execute(q, args).fetchall()
         return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
 
-    def mark_stale_executions(self, older_than: float, now: float) -> int:
-        """Fail non-terminal executions (RUNNING *and* QUEUED — the async queue
-        is in-memory, so rows orphaned by a restart are QUEUED forever
-        otherwise) created before `older_than` (reference: MarkStaleExecutions,
-        storage.go:66 + cleanup service)."""
-        n = 0
-        for status in (ExecutionStatus.RUNNING, ExecutionStatus.QUEUED):
-            for ex in self.list_executions(status=status, limit=10_000):
-                if ex.created_at < older_than:
-                    ex.status = ExecutionStatus.TIMEOUT
-                    ex.error = "marked stale by cleanup"
-                    ex.finished_at = now
-                    self.update_execution(ex)
-                    n += 1
-        return n
-
     def delete_executions_before(self, cutoff: float) -> int:
         with self._lock:
             cur = self._conn.execute(

@@ -161,6 +161,7 @@ def forward_impl(
     positions: jax.Array,
     collect_kv: bool = True,
     remat: bool = False,
+    attn_impl: str = "ref",
 ):
     """Dense causal forward. tokens/positions: [B, S].
 
@@ -173,10 +174,26 @@ def forward_impl(
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
 
+    def attend(q, k, v):
+        if attn_impl == "flash":
+            # Pallas flash kernel: causal-from-zero layout [B, H, S, hd].
+            # Valid whenever positions are per-row aranges (prefill), which is
+            # what the serving engine guarantees. Interpreted on CPU backends.
+            from agentfield_tpu.ops.pallas.flash_attention_kernel import flash_attention
+
+            return flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                causal=True,
+                interpret=jax.default_backend() == "cpu",
+            ).transpose(0, 2, 1, 3)
+        return attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
+
     def body(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = qkv_proj(lp, h, cfg, cos, sin)
-        attn = attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
+        attn = attend(q, k, v)
         x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
         x = x + mlp_block(lp, x, cfg)
         return x, ((k, v) if collect_kv else None)
@@ -187,7 +204,7 @@ def forward_impl(
     return unembed(params, cfg, x), kv
 
 
-forward = jax.jit(forward_impl, static_argnames=("cfg", "collect_kv", "remat"))
+forward = jax.jit(forward_impl, static_argnames=("cfg", "collect_kv", "remat", "attn_impl"))
 
 
 def make_contiguous_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype: str | None = None):

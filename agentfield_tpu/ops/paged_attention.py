@@ -54,5 +54,10 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref
     if impl == "pallas":
         from agentfield_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
 
-        return paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens)
+        # Mosaic kernels only compile for TPU; on CPU backends (tests, local
+        # demos) run the same kernel in the Pallas interpreter.
+        interpret = jax.default_backend() == "cpu"
+        return paged_attention_pallas(
+            q, k_pages, v_pages, page_tables, seq_lens, interpret=interpret
+        )
     raise ValueError(f"unknown paged_attention impl {impl!r}")

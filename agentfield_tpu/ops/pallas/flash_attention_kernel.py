@@ -1,0 +1,167 @@
+"""Pallas TPU flash attention (prefill path).
+
+Blockwise-softmax attention that never materializes the [S, T] score matrix:
+K/V stream HBM→VMEM through the grid's innermost dimension while running
+max/sum statistics rescale a VMEM accumulator (the standard online-softmax
+recurrence). Causal blocks above the diagonal are predicated off with
+``pl.when``. GQA is expressed in the BlockSpec index maps — query head h
+reads kv head ``h // (H // Kh)`` — so no KV repetition is materialized.
+
+Replaces ``models.llama.attention_ref`` inside jitted prefill on TPU; the
+einsum reference remains the CPU/test oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # [1, 1, bq, hd]
+    k_ref,  # [1, 1, bk, hd]
+    v_ref,  # [1, 1, bk, hd]
+    o_ref,  # [1, 1, bq, hd]
+    m_scr,  # [bq, 1] f32
+    l_scr,  # [bq, 1] f32
+    acc_scr,  # [bq, hd] f32
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [bq, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_scr[...]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale factor for old stats
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        # Fully-masked rows (can't happen in causal self-attention, but keep
+        # the division safe) fall back to 0 via the l floor.
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, H, S, hd]
+    k: jax.Array,  # [B, Kh, T, hd]
+    v: jax.Array,  # [B, Kh, T, hd]
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, H, S, hd]. S and T must be multiples of the block sizes
+    (the serving engine's prefill buckets guarantee this); callers with ragged
+    lengths pad and mask downstream."""
+    B, H, S, hd = q.shape
+    Kh, T = k.shape[1], k.shape[2]
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+
+    def pick_block(n: int, pref: int) -> int:
+        # Largest power-of-two tile ≤ pref that divides n — sequence lengths
+        # here are always multiples of 16 (engine prefill buckets), but may
+        # not be multiples of 128 when max_context caps a bucket (e.g. 192).
+        for b in (pref, 128, 64, 32, 16):
+            if b <= pref and n % b == 0:
+                return b
+        raise ValueError(f"sequence length {n} must be a multiple of 16")
+
+    block_q = pick_block(S, min(block_q, S))
+    block_k = pick_block(T, min(block_k, T))
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+    num_k_blocks = T // block_k
+
+    grid = (B, H, S // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, qi, ki: (b, h // rep, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, qi, ki: (b, h // rep, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * S * T * hd,
+            bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,
+            transcendentals=B * H * S * T,
+        ),
+        interpret=interpret,
+    )(q, k, v)

@@ -1,0 +1,145 @@
+"""Pallas TPU paged decode attention.
+
+One query token per sequence attends over K/V scattered across HBM pages.
+The page indirection lives in the BlockSpec index maps via scalar prefetch
+(``PrefetchScalarGridSpec``): the grid's innermost dimension walks each
+sequence's page list and the index map looks the physical page id up in the
+prefetched page table, so the pipeline DMAs exactly the pages each sequence
+owns — the gathered [B, max_ctx] K/V of the reference implementation
+(ops/paged_attention.py) is never materialized. Online-softmax statistics
+accumulate across pages in VMEM scratch (same recurrence as the flash
+kernel). This is the ragged-paged-attention kernel pattern (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(
+    page_tables_ref,  # [B, maxp] int32 (scalar prefetch)
+    seq_lens_ref,  # [B] int32 (scalar prefetch)
+    q_ref,  # [1, 1, rep, hd]
+    k_ref,  # [1, ps, 1, hd]  — the page picked by the index map
+    v_ref,  # [1, ps, 1, hd]
+    o_ref,  # [1, 1, rep, hd]
+    m_scr,  # [rep, 1] f32
+    l_scr,  # [rep, 1] f32
+    acc_scr,  # [rep, hd] f32
+    *,
+    sm_scale: float,
+    page_size: int,
+    num_page_steps: int,
+):
+    b = pl.program_id(0)
+    pi = pl.program_id(2)
+    seq_len = seq_lens_ref[b]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Pages wholly past the sequence end contribute nothing (their DMA may
+    # fetch the garbage page; the mask below would zero it anyway, but
+    # skipping saves the FLOPs).
+    @pl.when(pi * page_size < seq_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [rep, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rep, ps]
+        k_pos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < seq_len, s, _NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, :, 0, :].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(pi == num_page_steps - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def paged_attention_pallas(
+    q: jax.Array,  # [B, H, hd]
+    k_pages: jax.Array,  # [P, ps, Kh, hd]
+    v_pages: jax.Array,  # [P, ps, Kh, hd]
+    page_tables: jax.Array,  # [B, maxp] int32
+    seq_lens: jax.Array,  # [B] int32 (valid tokens incl. current)
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    B, H, hd = q.shape
+    P, ps, Kh, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    qg = q.reshape(B, Kh, rep, hd)
+    grid = (B, Kh, maxp)
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rep, hd), lambda b, kvh, pi, pt, sl: (b, kvh, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, hd),
+                lambda b, kvh, pi, pt, sl: (pt[b, pi], 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, hd),
+                lambda b, kvh, pi, pt, sl: (pt[b, pi], 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rep, hd), lambda b, kvh, pi, pt, sl: (b, kvh, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Kh, rep, hd), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * maxp * ps * hd,
+            bytes_accessed=2 * B * maxp * ps * hd * k_pages.dtype.itemsize,
+            transcendentals=B * H * maxp * ps,
+        ),
+        interpret=interpret,
+    )(page_tables, seq_lens, qg, k_pages, v_pages)
+    return out.reshape(B, H, hd)

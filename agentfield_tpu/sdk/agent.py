@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import json
 import os
 from typing import Any, Callable
 
@@ -174,6 +175,8 @@ class Agent:
                 body = await req.json() if req.can_read_body else {}
             except Exception:
                 return web.json_response({"error": "invalid JSON"}, status=400)
+            if not isinstance(body, dict):
+                return web.json_response({"error": "JSON object body required"}, status=400)
             payload = body.get("input")
             ctx = ExecutionContext.from_headers(req.headers)
             if ctx is None:
@@ -224,6 +227,8 @@ class Agent:
     async def _run_tracked(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> None:
         try:
             result = await self._run(comp, payload, ctx)
+            json.dumps(result)  # fail fast: an unserializable result must
+            # surface as a failed execution, not a stranded-until-stale one
         except Exception as e:
             await self._safe_status(ctx.execution_id, "failed", error=repr(e))
         else:

@@ -76,12 +76,15 @@ class ControlPlaneClient:
         webhook_url: str | None = None,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
+        kw: dict[str, Any] = {}
         if timeout is not None:
             body["timeout"] = timeout
+            # The session-wide total would otherwise abort long waits early.
+            kw["timeout"] = aiohttp.ClientTimeout(total=timeout + 30)
         if webhook_url:
             body["webhook_url"] = webhook_url
         return await self._req(
-            "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}
+            "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}, **kw
         )
 
     async def execute_async(

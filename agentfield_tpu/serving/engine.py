@@ -50,7 +50,8 @@ class EngineConfig:
     max_pages_per_seq: int = 32  # max context = max_pages_per_seq * page_size
     max_pending: int = 1024  # admission queue bound (reference queue default:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
-    attn_impl: str = "ref"
+    attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
+    prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas)
     dtype: str | None = None
 
     @property
@@ -137,7 +138,9 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
         # tokens: [1, bucket]; positions past `length` are padding whose
         # K/V are routed to the garbage page.
         positions = jnp.arange(bucket, dtype=jnp.int32)[None]
-        logits, (ks, vs) = llama.forward_impl(params, cfg, tokens, positions)
+        logits, (ks, vs) = llama.forward_impl(
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl
+        )
         pos = positions[0]
         in_range = pos < length
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)

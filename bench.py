@@ -33,6 +33,7 @@ def main() -> None:
     model = os.environ.get("AGENTFIELD_BENCH_MODEL", "llama-3.2-1b")
     n_requests = int(os.environ.get("AGENTFIELD_BENCH_REQUESTS", "256"))
     max_batch = int(os.environ.get("AGENTFIELD_BENCH_BATCH", "64"))
+    attn = os.environ.get("AGENTFIELD_BENCH_ATTN", "ref")  # "ref" | "pallas"
     prompt_len, new_tokens = 128, 128
 
     cfg = get_config(model)
@@ -43,6 +44,8 @@ def main() -> None:
         num_pages=max_batch * 8 * 2 + 1,
         max_pages_per_seq=8,  # 256-token context budget per request
         max_pending=max(n_requests, 1024),
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
     )
 
     def make_reqs(prefix: str, n: int):

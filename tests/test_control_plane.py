@@ -325,9 +325,8 @@ async def test_registry_sweep_marks_and_evicts():
             assert r.status == 503
 
 
-def test_storage_locks_and_stale(tmp_path):
+def test_storage_locks(tmp_path):
     from agentfield_tpu.control_plane.storage import SQLiteStorage
-    from agentfield_tpu.control_plane.types import Execution, TargetType, new_id, now
 
     st = SQLiteStorage(str(tmp_path / "cp.db"))
     assert st.acquire_lock("l1", "me", ttl=100)
@@ -335,16 +334,4 @@ def test_storage_locks_and_stale(tmp_path):
     assert st.acquire_lock("l1", "me", ttl=100)  # re-entrant for same owner
     assert st.release_lock("l1", "me")
     assert st.acquire_lock("l1", "you", ttl=100)
-
-    ex = Execution(
-        execution_id=new_id("exec"),
-        target="a.b",
-        target_type=TargetType.REASONER,
-        status=ExecutionStatus.RUNNING,
-        run_id=new_id("run"),
-    )
-    st.create_execution(ex)
-    n = st.mark_stale_executions(older_than=now() + 10, now=now())
-    assert n == 1
-    assert st.get_execution(ex.execution_id).status == ExecutionStatus.TIMEOUT
     st.close()

@@ -332,6 +332,83 @@ def create_app(cp: ControlPlane) -> web.Application:
         )
         return web.json_response({"executions": [e.to_dict() for e in exs]})
 
+    # -- workflow DAG / runs / notes -----------------------------------
+
+    @routes.get("/api/v1/workflows/{run_id}/dag")
+    async def workflow_dag(req: web.Request):
+        from agentfield_tpu.control_plane.dag import build_dag
+
+        light = req.query.get("lightweight", "") in ("1", "true")
+        dag = build_dag(cp.storage, req.match_info["run_id"], lightweight=light)
+        if not dag["nodes"]:
+            return _json_error(404, "unknown run")
+        return web.json_response(dag)
+
+    @routes.get("/api/v1/runs")
+    async def runs(req: web.Request):
+        from agentfield_tpu.control_plane.dag import run_summaries
+
+        try:
+            limit = min(max(int(req.query.get("limit", "50")), 1), 500)
+        except ValueError:
+            return _json_error(400, "invalid limit")
+        return web.json_response({"runs": run_summaries(cp.storage, limit=limit)})
+
+    @routes.post("/api/v1/executions/{execution_id}/notes")
+    async def add_note(req: web.Request):
+        """Execution notes (reference: app.note() → handlers/execution_notes.go)."""
+        try:
+            body = await _json_dict(req, allow_empty=False)
+        except _BadBody as e:
+            return _json_error(400, str(e))
+        ex = cp.storage.get_execution(req.match_info["execution_id"])
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        ex.notes.append({"note": body.get("note"), "ts": now(), "actor": body.get("actor")})
+        cp.storage.update_execution(ex)
+        return web.json_response({"ok": True, "notes": len(ex.notes)})
+
+    @routes.post("/api/v1/workflow/executions/events")
+    async def workflow_event(req: web.Request):
+        """Lifecycle-event ingestion for calls the gateway never saw (in-process
+        child calls — reference: WorkflowExecutionEventHandler,
+        internal/handlers/workflow_execution_events.go:35)."""
+        from agentfield_tpu.control_plane.types import Execution, TargetType
+
+        try:
+            body = await _json_dict(req, allow_empty=False)
+            event = body["event"]
+            eid = body["execution_id"]
+            run_id = body["run_id"]
+            ttype = TargetType(body.get("target_type", "reasoner"))
+        except _BadBody as e:
+            return _json_error(400, str(e))
+        except KeyError as e:
+            return _json_error(400, f"missing field {e}")
+        except ValueError as e:
+            return _json_error(400, str(e))
+        if event not in ("start", "complete", "error"):
+            return _json_error(400, f"unknown event {event!r}")
+        ex = cp.storage.get_execution(eid)
+        if ex is None:
+            ex = Execution(
+                execution_id=eid,
+                target=body.get("target", "unknown.unknown"),
+                target_type=ttype,
+                status=ExecutionStatus.RUNNING,
+                run_id=run_id,
+                parent_execution_id=body.get("parent_execution_id"),
+                session_id=body.get("session_id"),
+                actor_id=body.get("actor_id"),
+                input=body.get("input"),
+            )
+            cp.storage.create_execution(ex)
+        if event == "complete" and not ex.status.terminal:
+            await cp.gateway.complete(eid, result=body.get("result"))
+        elif event == "error" and not ex.status.terminal:
+            await cp.gateway.complete(eid, error=body.get("error") or "error event")
+        return web.json_response({"ok": True})
+
     # -- event streams (SSE) -------------------------------------------
 
     async def _sse(req: web.Request, topic: str) -> web.StreamResponse:

@@ -180,6 +180,7 @@ class SQLiteStorage:
         status: ExecutionStatus | None = None,
         limit: int = 100,
         offset: int = 0,
+        newest_first: bool = False,
     ) -> list[Execution]:
         q = "SELECT doc FROM executions"
         cond, args = [], []
@@ -191,7 +192,7 @@ class SQLiteStorage:
             args.append(status.value)
         if cond:
             q += " WHERE " + " AND ".join(cond)
-        q += " ORDER BY created_at LIMIT ? OFFSET ?"
+        q += f" ORDER BY created_at {'DESC' if newest_first else 'ASC'} LIMIT ? OFFSET ?"
         args += [limit, offset]
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()

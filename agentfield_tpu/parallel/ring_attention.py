@@ -1,0 +1,127 @@
+"""Ring attention: sequence/context parallelism over an ICI ring.
+
+The reference has no long-context story at all — it trims prompts to the
+provider window (sdk/python/agentfield/agent_ai.py:262-325, SURVEY §5
+long-context row). Here sequences shard over the mesh's ``seq`` axis: each
+device holds a [B, S/n, H, hd] slice of Q/K/V, computes blockwise attention
+against its resident K/V block, and rotates K/V around the ring with
+``ppermute`` while folding results into online-softmax statistics — peak
+memory O(S/n · S/n) per device, full-sequence attention without any device
+ever materializing the whole context.
+
+Causality uses the block structure: a Q block attends K blocks from earlier
+ring positions fully, its own block causally, later blocks not at all —
+whole-block skips drop the FLOPs entirely (lax.cond), while the ppermute
+still runs every step so the ring stays in lockstep.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentfield_tpu.parallel.mesh import AXIS_SEQ
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal):
+    """One Q-block × K-block partial attention. q: [B, Sq, H, hd];
+    k/v: [B, Sk, Kh, hd]; positions: [Sq]/[Sk] global. Returns
+    (scores_max [B,H,Sq,1], exp_sum [B,H,Sq,1], acc [B,Sq,H,hd])."""
+    B, Sq, H, hd = q.shape
+    Kh = k.shape[2]
+    rep = H // Kh
+    qg = q.reshape(B, Sq, Kh, rep, hd).astype(jnp.float32) * (hd**-0.5)
+    s = jnp.einsum("bskrh,btkh->bkrst", qg, k.astype(jnp.float32))  # [B,Kh,rep,Sq,Sk]
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,Kh,rep,Sq,1]
+    # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bkrst,btkh->bskrh", p, v.astype(jnp.float32))  # [B,Sq,Kh,rep,hd]
+    return m_safe, l, acc.reshape(B, Sq, H, hd)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Body run per-device under shard_map. All inputs are local shards
+    [B, S_local, H|Kh, hd]; the device's ring index orders causality."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, hd = q.shape
+    s_local = k.shape[1]
+
+    def step(i, carry):
+        m, l, acc, cur_k, cur_v = carry
+        # K/V currently resident arrived from ring position (my_idx - i).
+        src_idx = (my_idx - i) % n
+        q_pos = my_idx * Sq + jnp.arange(Sq, dtype=jnp.int32)
+        k_pos = src_idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+
+        def attend(args):
+            m, l, acc = args
+            bm, bl, bacc = _block_attend(q, cur_k, cur_v, q_pos, k_pos, causal)
+            bm = bm.reshape(B, -1, Sq, 1)  # [B, H, Sq, 1] (Kh*rep == H)
+            bl = bl.reshape(B, -1, Sq, 1)
+            # Online-softmax merge with the running statistics.
+            m_new = jnp.maximum(m, bm)
+            alpha_old = jnp.exp(m - m_new)
+            alpha_blk = jnp.exp(bm - m_new)
+            l_new = l * alpha_old + bl * alpha_blk
+            ao = alpha_old.transpose(0, 2, 1, 3)  # [B, Sq, H, 1]
+            ab = alpha_blk.transpose(0, 2, 1, 3)
+            return m_new, l_new, acc * ao + bacc * ab
+
+        if causal:
+            # Blocks wholly above the diagonal (src after me on the ring)
+            # contribute nothing: skip their FLOPs, not just mask them. The
+            # ppermute below stays unconditional — the ring must stay in
+            # lockstep.
+            m, l, acc = jax.lax.cond(src_idx <= my_idx, attend, lambda a: a, (m, l, acc))
+        else:
+            m, l, acc = attend((m, l, acc))
+        # Rotate K/V to the next device (direction: ring neighbor +1).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        nxt_k = jax.lax.ppermute(cur_k, axis_name, perm)
+        nxt_v = jax.lax.ppermute(cur_v, axis_name, perm)
+        return m, l, acc, nxt_k, nxt_v
+
+    # The stats depend on axis_index, so the initial carry must already be
+    # marked device-varying for shard_map's vma type system (jax >= 0.9).
+    m0 = jax.lax.pvary(jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32), axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((B, H, Sq, 1), jnp.float32), axis_name)
+    acc0 = jax.lax.pvary(jnp.zeros((B, Sq, H, hd), jnp.float32), axis_name)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    l = jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)  # [B, Sq, H, 1]
+    return (acc / l).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "causal", "axis_name"))
+def ring_attention(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, S, Kh, hd]
+    v: jax.Array,  # [B, S, Kh, hd]
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Full-sequence attention with S sharded over `axis_name`. S must divide
+    evenly by the axis size. Heads stay replicated across the seq axis (they
+    may simultaneously be sharded over `model` by the caller's outer pjit)."""
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(f"sequence {q.shape[1]} not divisible by {axis_name}={n}")
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -299,6 +299,17 @@ class Agent:
             raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
         return doc["result"]
 
+    async def note(self, note: Any, actor: str | None = None) -> None:
+        """Attach a note to the current execution (reference: Agent.note,
+        agent.py:2804 → execution notes API). No-op outside an execution."""
+        ctx = current_context()
+        if ctx is None:
+            return
+        try:
+            await self.client.add_note(ctx.execution_id, note, actor or self.node_id)
+        except Exception:
+            pass  # notes are advisory; never fail the reasoner over one
+
     # -- memory façade --------------------------------------------------
 
     @property

@@ -151,6 +151,25 @@ class ControlPlaneClient:
             await asyncio.sleep(interval)
             interval = min(interval * 1.5, 1.0)
 
+    # -- workflow / notes ----------------------------------------------
+
+    async def add_note(self, execution_id: str, note: Any, actor: str | None = None) -> None:
+        await self._req(
+            "POST",
+            f"/api/v1/executions/{execution_id}/notes",
+            json={"note": note, "actor": actor},
+        )
+
+    async def workflow_dag(self, run_id: str, lightweight: bool = False) -> dict[str, Any]:
+        q = "?lightweight=1" if lightweight else ""
+        return await self._req("GET", f"/api/v1/workflows/{run_id}/dag{q}")
+
+    async def run_summaries(self, limit: int = 50) -> list[dict[str, Any]]:
+        return (await self._req("GET", f"/api/v1/runs?limit={limit}"))["runs"]
+
+    async def post_workflow_event(self, event: dict[str, Any]) -> None:
+        await self._req("POST", "/api/v1/workflow/executions/events", json=event)
+
     # -- memory ---------------------------------------------------------
 
     def _scope_q(self, scope: str, scope_id: str | None, **extra: str) -> str:

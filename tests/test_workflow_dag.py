@@ -1,0 +1,105 @@
+"""Workflow DAG, run aggregation, notes, and lifecycle-event ingestion."""
+
+import pytest
+
+from agentfield_tpu.control_plane.dag import aggregate_status
+from agentfield_tpu.control_plane.types import ExecutionStatus as ES
+from agentfield_tpu.sdk import Agent
+from tests.helpers_cp import CPHarness, async_test
+
+
+def test_aggregate_precedence():
+    # failure > running > queued > completed (reference aggregator precedence)
+    assert aggregate_status([ES.COMPLETED, ES.FAILED, ES.RUNNING]) == "failed"
+    assert aggregate_status([ES.COMPLETED, ES.RUNNING]) == "running"
+    assert aggregate_status([ES.QUEUED, ES.COMPLETED]) == "queued"
+    assert aggregate_status([ES.COMPLETED, ES.COMPLETED]) == "completed"
+    assert aggregate_status([ES.TIMEOUT, ES.RUNNING]) == "timeout"
+    assert aggregate_status([]) == "empty"
+
+
+@async_test
+async def test_dag_from_nested_calls():
+    async with CPHarness() as h:
+        a = Agent("a", h.base_url)
+        b = Agent("b", h.base_url)
+
+        @b.reasoner()
+        async def leaf(x: int) -> int:
+            await b.note({"saw": x})
+            return x + 1
+
+        @a.reasoner()
+        async def root(x: int) -> int:
+            r1 = await a.call("b.leaf", x=x)
+            r2 = await a.call("b.leaf", x=r1)
+            return r2
+
+        await a.start()
+        await b.start()
+        try:
+            async with h.http.post("/api/v1/execute/a.root", json={"input": {"x": 1}}) as r:
+                doc = await r.json()
+            assert doc["result"] == 3
+            dag = await a.client.workflow_dag(doc["run_id"])
+            assert dag["overall_status"] == "completed"
+            assert len(dag["nodes"]) == 3
+            assert dag["roots"] == [doc["execution_id"]]
+            # both leaf executions hang off the root
+            kids = [e for e in dag["edges"] if e["from"] == doc["execution_id"]]
+            assert len(kids) == 2 and not any(e["dangling"] for e in dag["edges"])
+            # the note landed on a leaf node
+            leaf_nodes = [n for n in dag["nodes"] if n["target"] == "b.leaf"]
+            assert any(n["notes"] for n in leaf_nodes)
+            # lightweight omits payloads
+            light = await a.client.workflow_dag(doc["run_id"], lightweight=True)
+            assert "input" not in light["nodes"][0]
+            # run summaries include this run
+            runs = await a.client.run_summaries()
+            mine = [r for r in runs if r["run_id"] == doc["run_id"]]
+            assert mine and mine[0]["executions"] == 3
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@async_test
+async def test_workflow_event_ingestion():
+    """In-process child calls the gateway never saw still appear in the DAG."""
+    async with CPHarness() as h:
+        a = Agent("a", h.base_url)
+        await a.start()
+        try:
+            await a.client.post_workflow_event(
+                {
+                    "event": "start",
+                    "execution_id": "exec_inproc",
+                    "run_id": "run_w1",
+                    "target": "a.inner_fn",
+                    "parent_execution_id": None,
+                }
+            )
+            dag = await a.client.workflow_dag("run_w1")
+            assert dag["overall_status"] == "running"
+            await a.client.post_workflow_event(
+                {
+                    "event": "complete",
+                    "execution_id": "exec_inproc",
+                    "run_id": "run_w1",
+                    "result": {"ok": 1},
+                }
+            )
+            dag = await a.client.workflow_dag("run_w1")
+            assert dag["overall_status"] == "completed"
+            assert dag["nodes"][0]["result"] == {"ok": 1}
+        finally:
+            await a.stop()
+
+
+@async_test
+async def test_dag_unknown_run_404():
+    async with CPHarness() as h:
+        async with h.http.get("/api/v1/workflows/ghost/dag") as r:
+            assert r.status == 404
+        async with h.http.post("/api/v1/workflow/executions/events", json={"event": "bogus"}) as r:
+            assert r.status == 400

@@ -1,0 +1,404 @@
+"""`aftpu` — the unified CLI.
+
+Command surface mirrors the reference's `af` tool (internal/cli/root.go:32:
+server|init|install|run|dev|stop|logs|list|mcp|vc|version) re-shaped for the
+TPU build: `model` runs a TPU model node, `status` reads the cluster through
+the control-plane API. Process management keeps a pidfile registry under the
+data dir (reference: internal/infrastructure/process/manager.go).
+
+Run as ``python -m agentfield_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import agentfield_tpu
+from agentfield_tpu.config import Config, load_config
+
+PY = sys.executable
+
+
+def data_dir(cfg: Config) -> Path:
+    d = cfg.expanded_data_dir()
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "logs").mkdir(exist_ok=True)
+    return d
+
+
+def _registry_path(cfg: Config) -> Path:
+    return data_dir(cfg) / "processes.json"
+
+
+def _load_registry(cfg: Config) -> dict:
+    p = _registry_path(cfg)
+    return json.loads(p.read_text()) if p.exists() else {}
+
+
+def _save_registry(cfg: Config, reg: dict) -> None:
+    _registry_path(cfg).write_text(json.dumps(reg, indent=2))
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _spawn(cfg: Config, name: str, argv: list[str], env: dict | None = None) -> int:
+    log = data_dir(cfg) / "logs" / f"{name}.log"
+    reg = _load_registry(cfg)
+    if name in reg and _alive(reg[name]["pid"]):
+        print(f"{name} already running (pid {reg[name]['pid']})", file=sys.stderr)
+        return 1
+    with open(log, "ab") as lf:
+        proc = subprocess.Popen(
+            argv,
+            stdout=lf,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, **(env or {})},
+            start_new_session=True,
+        )
+    reg[name] = {"pid": proc.pid, "argv": argv, "started_at": time.time(), "log": str(log)}
+    _save_registry(cfg, reg)
+    print(f"started {name} (pid {proc.pid}, log {log})")
+    return 0
+
+
+# -- commands -----------------------------------------------------------
+
+
+def cmd_server(cfg: Config, args) -> int:
+    if args.detach:
+        argv = [PY, "-m", "agentfield_tpu.cli"]
+        if args.config:
+            argv += ["--config", args.config]
+        argv += ["server"]
+        if args.port is not None:
+            argv += ["--port", str(args.port)]
+        return _spawn(cfg, "control-plane", argv)
+    from agentfield_tpu.control_plane.server import ControlPlane, run_server
+
+    async def main():
+        db = os.path.expanduser(cfg.server.db_path)
+        Path(db).parent.mkdir(parents=True, exist_ok=True)
+        cp = ControlPlane(
+            db_path=db,
+            agent_timeout=cfg.execution.agent_timeout,
+            sync_wait_timeout=cfg.execution.sync_wait_timeout,
+            async_workers=cfg.execution.async_workers,
+            queue_capacity=cfg.execution.queue_capacity,
+            heartbeat_ttl=cfg.presence.heartbeat_ttl,
+            sweep_interval=cfg.presence.sweep_interval,
+            evict_after=cfg.presence.evict_after,
+            webhook_secret=cfg.server.webhook_secret,
+            cleanup_interval=cfg.execution.cleanup_interval,
+            stale_after=cfg.execution.stale_after,
+            retention=cfg.execution.retention,
+        )
+        await run_server(cp, host=cfg.server.host, port=args.port or cfg.server.port)
+        print(f"control plane on {cfg.server.host}:{args.port or cfg.server.port} (db={db})", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for s in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(s, stop.set)
+        await stop.wait()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_model(cfg: Config, args) -> int:
+    name = f"model-{args.name}" if args.name else "model"
+    if args.detach:
+        argv = [PY, "-m", "agentfield_tpu.cli"]
+        if args.config:
+            argv += ["--config", args.config]
+        argv += ["model", "--model", args.model or cfg.model_node.model]
+        if args.checkpoint:
+            argv += ["--checkpoint", args.checkpoint]
+        if args.name:
+            argv += ["--name", args.name]
+        if args.url:
+            argv += ["--url", args.url]
+        if args.cpu:
+            argv += ["--cpu"]
+        return _spawn(cfg, name, argv)
+    if args.cpu or os.environ.get("AGENTFIELD_MODEL_CPU") == "1":
+        from agentfield_tpu._compat import force_cpu_backend
+
+        force_cpu_backend()
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    mn = cfg.model_node
+
+    async def main():
+        ecfg = EngineConfig(
+            max_batch=mn.max_batch,
+            page_size=mn.page_size,
+            num_pages=mn.num_pages,
+            max_pages_per_seq=mn.max_pages_per_seq,
+            attn_impl=mn.attn_impl,
+            prefill_impl=mn.prefill_impl,
+        )
+        agent, backend = build_model_node(
+            args.name or "model",
+            args.url or f"http://{cfg.server.host}:{cfg.server.port}",
+            model=args.model or mn.model,
+            ecfg=ecfg,
+            checkpoint=args.checkpoint or mn.checkpoint,
+        )
+        await backend.start()
+        await agent.start()
+        print(f"model node '{agent.node_id}' ({args.model or mn.model}) on :{agent.port}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for s in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(s, stop.set)
+        try:
+            await stop.wait()
+        finally:
+            await agent.stop()
+            await backend.stop()
+
+    asyncio.run(main())
+    return 0
+
+
+AGENT_TEMPLATE = '''"""{name} — an agentfield_tpu agent."""
+
+from agentfield_tpu.sdk import Agent
+
+app = Agent("{name}")
+
+
+@app.reasoner(description="Example reasoner backed by the TPU model node")
+async def respond(prompt: str, max_new_tokens: int = 64) -> dict:
+    out = await app.ai(prompt=prompt, max_new_tokens=max_new_tokens)
+    return {{"text": out.get("text"), "model": out["model"]}}
+
+
+@app.skill(description="Example deterministic skill")
+def word_count(text: str) -> int:
+    return len(text.split())
+
+
+if __name__ == "__main__":
+    app.serve()
+'''
+
+
+def cmd_init(cfg: Config, args) -> int:
+    """Scaffold an agent project (reference: af init, internal/cli/init.go:202)."""
+    target = Path(args.name)
+    if target.exists():
+        print(f"{target} already exists", file=sys.stderr)
+        return 1
+    target.mkdir(parents=True)
+    (target / "main.py").write_text(AGENT_TEMPLATE.format(name=args.name))
+    (target / "agentfield.yaml").write_text(
+        f"name: {args.name}\nentry: main.py\ndescription: scaffolded by aftpu init\n"
+    )
+    print(f"created {target}/ (main.py, agentfield.yaml)")
+    return 0
+
+
+def cmd_run(cfg: Config, args) -> int:
+    entry = Path(args.path)
+    if entry.is_dir():
+        entry = entry / "main.py"
+    if not entry.exists():
+        print(f"no such agent entry {entry}", file=sys.stderr)
+        return 1
+    name = args.name or entry.resolve().parent.name
+    env = {"AGENTFIELD_URL": args.url} if args.url else {}
+    return _spawn(cfg, name, [PY, str(entry)], env=env)
+
+
+def cmd_dev(cfg: Config, args) -> int:
+    """Foreground run with restart-on-change (reference: af dev, commands/dev.go:37)."""
+    entry = Path(args.path)
+    if entry.is_dir():
+        entry = entry / "main.py"
+    watch_dir = entry.resolve().parent
+
+    def snapshot():
+        return {
+            p: p.stat().st_mtime for p in watch_dir.rglob("*.py") if p.is_file()
+        }
+
+    while True:
+        proc = subprocess.Popen([PY, str(entry)], env={**os.environ})
+        state = snapshot()
+        try:
+            while True:
+                time.sleep(1.0)
+                if proc.poll() is not None:
+                    print(f"agent exited ({proc.returncode}); waiting for changes...")
+                    while snapshot() == state:
+                        time.sleep(1.0)
+                    break
+                if snapshot() != state:
+                    print("change detected; restarting...")
+                    _terminate(proc)
+                    break
+        except KeyboardInterrupt:
+            _terminate(proc)
+            return 0
+
+
+def _terminate(proc: subprocess.Popen) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def cmd_stop(cfg: Config, args) -> int:
+    reg = _load_registry(cfg)
+    names = [args.name] if args.name else list(reg)
+    rc = 0
+    for name in names:
+        info = reg.get(name)
+        if not info:
+            print(f"unknown process {name!r}", file=sys.stderr)
+            rc = 1
+            continue
+        if _alive(info["pid"]):
+            os.kill(info["pid"], signal.SIGTERM)
+            print(f"stopped {name} (pid {info['pid']})")
+        else:
+            print(f"{name} was not running")
+        del reg[name]
+    _save_registry(cfg, reg)
+    return rc
+
+
+def cmd_list(cfg: Config, args) -> int:
+    reg = _load_registry(cfg)
+    if not reg:
+        print("no managed processes")
+        return 0
+    for name, info in sorted(reg.items()):
+        state = "running" if _alive(info["pid"]) else "dead"
+        print(f"{name:24s} pid={info['pid']:<8d} {state:8s} log={info['log']}")
+    return 0
+
+
+def cmd_logs(cfg: Config, args) -> int:
+    reg = _load_registry(cfg)
+    info = reg.get(args.name)
+    log = Path(info["log"]) if info else data_dir(cfg) / "logs" / f"{args.name}.log"
+    if not log.exists():
+        print(f"no log for {args.name!r}", file=sys.stderr)
+        return 1
+    text = log.read_text(errors="replace").splitlines()
+    for line in text[-args.tail :]:
+        print(line)
+    return 0
+
+
+def cmd_status(cfg: Config, args) -> int:
+    """Cluster status via the control-plane API."""
+    import urllib.request
+
+    url = args.url or f"http://{cfg.server.host}:{cfg.server.port}"
+    try:
+        with urllib.request.urlopen(f"{url}/api/v1/nodes", timeout=5) as r:
+            nodes = json.loads(r.read())["nodes"]
+        with urllib.request.urlopen(f"{url}/api/v1/runs?limit=5", timeout=5) as r:
+            runs = json.loads(r.read())["runs"]
+    except Exception as e:
+        print(f"control plane unreachable at {url}: {e}", file=sys.stderr)
+        return 1
+    print(f"control plane: {url}  nodes: {len(nodes)}")
+    for n in nodes:
+        comps = len(n.get("reasoners", [])) + len(n.get("skills", []))
+        print(f"  {n['node_id']:24s} {n['kind']:6s} {n['status']:9s} {comps} components")
+    if runs:
+        print("recent runs:")
+        for r_ in runs:
+            print(f"  {r_['run_id']:28s} {r_['overall_status']:10s} {r_['executions']} executions")
+    return 0
+
+
+def cmd_version(cfg: Config, args) -> int:
+    print(f"agentfield_tpu {agentfield_tpu.__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="aftpu", description=__doc__)
+    p.add_argument("--config", help="YAML config file")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("server", help="run the control plane")
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument("--detach", action="store_true")
+    s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("model", help="run a TPU model node")
+    s.add_argument("--model", help="model preset (see models/configs.py)")
+    s.add_argument("--checkpoint", help="HF checkpoint dir (safetensors)")
+    s.add_argument("--name", help="node id (default: model)")
+    s.add_argument("--url", help="control plane URL")
+    s.add_argument("--cpu", action="store_true", help="serve on the CPU backend (demo/debug)")
+    s.add_argument("--detach", action="store_true")
+    s.set_defaults(fn=cmd_model)
+
+    s = sub.add_parser("init", help="scaffold an agent project")
+    s.add_argument("name")
+    s.set_defaults(fn=cmd_init)
+
+    s = sub.add_parser("run", help="run an agent as a managed process")
+    s.add_argument("path")
+    s.add_argument("--name")
+    s.add_argument("--url", help="control plane URL for the agent")
+    s.set_defaults(fn=cmd_run)
+
+    s = sub.add_parser("dev", help="run an agent with restart-on-change")
+    s.add_argument("path")
+    s.set_defaults(fn=cmd_dev)
+
+    s = sub.add_parser("stop", help="stop managed process(es)")
+    s.add_argument("name", nargs="?")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("list", help="list managed processes")
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("logs", help="show a managed process's log")
+    s.add_argument("name")
+    s.add_argument("--tail", type=int, default=50)
+    s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("status", help="cluster status via the control plane")
+    s.add_argument("--url")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("version", help="print version")
+    s.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = load_config(args.config)
+    return args.fn(cfg, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Configuration system: YAML file + environment overrides.
+
+Mirrors the reference's config surface (internal/config/config.go:15-180 —
+server port, execution queue tuning, cleanup, storage, CORS/data dirs, with
+viper env overrides). Env vars use the AGENTFIELD_ prefix with __ as the
+section separator, e.g. AGENTFIELD_SERVER__PORT=9000 overrides server.port.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+ENV_PREFIX = "AGENTFIELD_"
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 8800
+    db_path: str = "~/.agentfield_tpu/control_plane.db"
+    webhook_secret: str | None = None
+
+
+@dataclasses.dataclass
+class ExecutionConfig:
+    agent_timeout: float = 90.0  # reference: execute.go:187
+    sync_wait_timeout: float = 600.0
+    async_workers: int = 8
+    queue_capacity: int = 1024  # reference: execute.go:1373
+    cleanup_interval: float = 60.0
+    stale_after: float = 3600.0
+    retention: float = 86400.0
+
+
+@dataclasses.dataclass
+class PresenceConfig:
+    heartbeat_ttl: float = 300.0  # reference: server.go:131-137
+    sweep_interval: float = 30.0
+    evict_after: float = 1800.0
+
+
+@dataclasses.dataclass
+class ModelNodeConfig:
+    model: str = "llama-3.2-1b"
+    checkpoint: str | None = None  # HF checkpoint dir (safetensors)
+    tokenizer: str | None = None
+    max_batch: int = 32
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 32
+    attn_impl: str = "ref"
+    prefill_impl: str = "ref"
+    tp: int = 1  # tensor-parallel degree over the `model` mesh axis
+
+
+@dataclasses.dataclass
+class Config:
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    execution: ExecutionConfig = dataclasses.field(default_factory=ExecutionConfig)
+    presence: PresenceConfig = dataclasses.field(default_factory=PresenceConfig)
+    model_node: ModelNodeConfig = dataclasses.field(default_factory=ModelNodeConfig)
+    data_dir: str = "~/.agentfield_tpu"
+
+    def expanded_data_dir(self) -> Path:
+        return Path(os.path.expanduser(self.data_dir))
+
+
+_SECTIONS = {
+    "server": ServerConfig,
+    "execution": ExecutionConfig,
+    "presence": PresenceConfig,
+    "model_node": ModelNodeConfig,
+}
+
+
+def _coerce(value: str, target_type: Any) -> Any:
+    if target_type is bool or target_type == "bool":
+        return value.lower() in ("1", "true", "yes")
+    for t in (int, float):
+        if target_type is t:
+            return t(value)
+    return value
+
+
+def load_config(path: str | None = None, env: dict[str, str] | None = None) -> Config:
+    """YAML (optional) then env overrides (AGENTFIELD_SECTION__FIELD)."""
+    cfg = Config()
+    if path:
+        doc = yaml.safe_load(Path(path).read_text()) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"config file {path} must contain a mapping")
+        for section, cls in _SECTIONS.items():
+            if section in doc and isinstance(doc[section], dict):
+                known = {f.name for f in dataclasses.fields(cls)}
+                unknown = set(doc[section]) - known
+                if unknown:
+                    raise ValueError(f"unknown keys in [{section}]: {sorted(unknown)}")
+                setattr(cfg, section, cls(**doc[section]))
+        if "data_dir" in doc:
+            cfg.data_dir = doc["data_dir"]
+
+    env = env if env is not None else dict(os.environ)
+    for key, value in env.items():
+        if not key.startswith(ENV_PREFIX) or "__" not in key:
+            continue
+        section_name, _, field_name = key[len(ENV_PREFIX) :].lower().partition("__")
+        if section_name not in _SECTIONS:
+            continue
+        section = getattr(cfg, section_name)
+        for f in dataclasses.fields(section):
+            if f.name == field_name:
+                setattr(section, f.name, _coerce(value, f.type if isinstance(f.type, type) else type(getattr(section, f.name))))
+    return cfg

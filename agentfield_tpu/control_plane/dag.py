@@ -83,24 +83,6 @@ def build_dag(storage: SQLiteStorage, run_id: str, lightweight: bool = False) ->
 
 
 def run_summaries(storage: SQLiteStorage, limit: int = 50) -> list[dict[str, Any]]:
-    """Most-recent runs with aggregate status/counts (the executions UI's
-    run list — reference: QueryRunSummaries, execution_records.go). Scans the
-    2000 NEWEST executions so fresh runs always appear."""
-    recent = storage.list_executions(limit=2000, newest_first=True)
-    by_run: dict[str, list[Execution]] = {}
-    for e in recent:
-        by_run.setdefault(e.run_id, []).append(e)
-    out = []
-    for run_id, exs in by_run.items():
-        out.append(
-            {
-                "run_id": run_id,
-                "overall_status": aggregate_status([e.status for e in exs]),
-                "executions": len(exs),
-                "started_at": min(e.created_at for e in exs),
-                "finished_at": max((e.finished_at or 0) for e in exs) or None,
-                "targets": sorted({e.target for e in exs}),
-            }
-        )
-    out.sort(key=lambda r: r["started_at"], reverse=True)
-    return out[:limit]
+    """Most-recent runs with aggregate status/counts — pure SQL GROUP BY in
+    the storage layer, exact regardless of table size."""
+    return storage.run_summaries(limit=limit)

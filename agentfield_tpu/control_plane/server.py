@@ -361,10 +361,12 @@ def create_app(cp: ControlPlane) -> web.Application:
             body = await _json_dict(req, allow_empty=False)
         except _BadBody as e:
             return _json_error(400, str(e))
+        if "note" not in body:
+            return _json_error(400, "field 'note' is required")
         ex = cp.storage.get_execution(req.match_info["execution_id"])
         if ex is None:
             return _json_error(404, "unknown execution")
-        ex.notes.append({"note": body.get("note"), "ts": now(), "actor": body.get("actor")})
+        ex.notes.append({"note": body["note"], "ts": now(), "actor": body.get("actor")})
         cp.storage.update_execution(ex)
         return web.json_response({"ok": True, "notes": len(ex.notes)})
 

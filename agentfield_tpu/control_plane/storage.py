@@ -198,6 +198,53 @@ class SQLiteStorage:
             rows = self._conn.execute(q, args).fetchall()
         return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
 
+    def run_summaries(self, limit: int = 50) -> list[dict[str, Any]]:
+        """Aggregate run rollups in SQL (GROUP BY run_id) — exact regardless of
+        table size, no doc deserialization (reference: QueryRunSummaries,
+        internal/storage/execution_records.go)."""
+        with self._lock:
+            rows = self._conn.execute(
+                """
+                SELECT run_id,
+                       COUNT(*) AS n,
+                       MIN(created_at) AS started_at,
+                       MAX(COALESCE(finished_at, 0)) AS finished_at,
+                       SUM(status = 'failed') AS failed,
+                       SUM(status = 'timeout') AS timed_out,
+                       SUM(status = 'running') AS running,
+                       SUM(status = 'queued') AS queued,
+                       GROUP_CONCAT(DISTINCT target) AS targets
+                FROM executions
+                GROUP BY run_id
+                ORDER BY started_at DESC
+                LIMIT ?
+                """,
+                (limit,),
+            ).fetchall()
+        out = []
+        for r in rows:
+            if r["failed"]:
+                status = "failed"
+            elif r["timed_out"]:
+                status = "timeout"
+            elif r["running"]:
+                status = "running"
+            elif r["queued"]:
+                status = "queued"
+            else:
+                status = "completed"
+            out.append(
+                {
+                    "run_id": r["run_id"],
+                    "overall_status": status,
+                    "executions": r["n"],
+                    "started_at": r["started_at"],
+                    "finished_at": r["finished_at"] or None,
+                    "targets": sorted((r["targets"] or "").split(",")),
+                }
+            )
+        return out
+
     def delete_executions_before(self, cutoff: float) -> int:
         with self._lock:
             cur = self._conn.execute(

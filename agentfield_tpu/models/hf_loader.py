@@ -1,0 +1,154 @@
+"""HF Llama checkpoint loading (safetensors → stacked pytree).
+
+The reference never touches weights (models live behind provider APIs); this
+is the TPU build's model-ingest path: read a HuggingFace Llama checkpoint
+directory (config.json + *.safetensors), emit the stacked-layer param pytree
+of ``models.llama`` (projections transposed to [in, out] for x @ w on the
+MXU), optionally placing shards straight onto a mesh.
+
+RoPE uses HF's rotate-half convention end to end, so no permutation of q/k
+weights is needed (models/llama.py::apply_rope).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentfield_tpu.models.configs import LlamaConfig
+
+
+def config_from_hf(path: str | Path) -> LlamaConfig:
+    doc = json.loads((Path(path) / "config.json").read_text())
+    if doc.get("model_type") not in ("llama", None):
+        raise ValueError(f"not a llama checkpoint: model_type={doc.get('model_type')!r}")
+    hidden = doc["hidden_size"]
+    heads = doc["num_attention_heads"]
+    return LlamaConfig(
+        vocab_size=doc["vocab_size"],
+        hidden_size=hidden,
+        intermediate_size=doc["intermediate_size"],
+        num_layers=doc["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=doc.get("num_key_value_heads", heads),
+        head_dim=doc.get("head_dim", hidden // heads),
+        rope_theta=doc.get("rope_theta", 10000.0),
+        rms_norm_eps=doc.get("rms_norm_eps", 1e-5),
+        max_seq_len=doc.get("max_position_embeddings", 8192),
+        tie_embeddings=doc.get("tie_word_embeddings", False),
+    )
+
+
+def _open_all(path: Path) -> dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    tensors: dict[str, Any] = {}
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    for f in files:
+        handle = safe_open(str(f), framework="numpy")
+        for name in handle.keys():
+            tensors[name] = (handle, name)
+    return tensors
+
+
+def load_hf_checkpoint(
+    path: str | Path,
+    cfg: LlamaConfig | None = None,
+    dtype: str = "bfloat16",
+) -> tuple[LlamaConfig, Any]:
+    """Returns (config, params). Tensors are read lazily per-layer to keep
+    peak host memory ~2 layers, cast to `dtype`."""
+    path = Path(path)
+    if cfg is None:
+        cfg = config_from_hf(path)
+    handles = _open_all(path)
+    dt = jnp.dtype(dtype)
+
+    def get(name: str) -> np.ndarray:
+        if name not in handles:
+            raise KeyError(f"tensor {name!r} missing from checkpoint {path}")
+        handle, key = handles[name]
+        return handle.get_tensor(key)
+
+    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        per_layer = []
+        for i in range(cfg.num_layers):
+            t = get(fmt.format(i=i))
+            per_layer.append(t.T if transpose else t)
+        return jnp.asarray(np.stack(per_layer)).astype(dt)
+
+    p = "model.layers.{i}."
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight")).astype(dt),
+        "layers": {
+            "attn_norm": stack(p + "input_layernorm.weight", transpose=False),
+            "mlp_norm": stack(p + "post_attention_layernorm.weight", transpose=False),
+            "wq": stack(p + "self_attn.q_proj.weight", transpose=True),
+            "wk": stack(p + "self_attn.k_proj.weight", transpose=True),
+            "wv": stack(p + "self_attn.v_proj.weight", transpose=True),
+            "wo": stack(p + "self_attn.o_proj.weight", transpose=True),
+            "w_gate": stack(p + "mlp.gate_proj.weight", transpose=True),
+            "w_up": stack(p + "mlp.up_proj.weight", transpose=True),
+            "w_down": stack(p + "mlp.down_proj.weight", transpose=True),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight")).astype(dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T).astype(dt)
+    return cfg, params
+
+
+def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
+    """Inverse mapping (for tests and for exporting fine-tuned weights)."""
+    from safetensors.numpy import save_file
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    names = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for ours, (theirs, transpose) in names.items():
+        stacked = np.asarray(params["layers"][ours], np.float32)
+        for i in range(cfg.num_layers):
+            t = stacked[i].T if transpose else stacked[i]
+            out[f"model.layers.{i}.{theirs}"] = np.ascontiguousarray(t)
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"], np.float32).T)
+    save_file(out, str(path / "model.safetensors"))
+    (path / "config.json").write_text(
+        json.dumps(
+            {
+                "model_type": "llama",
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "num_key_value_heads": cfg.num_kv_heads,
+                "head_dim": cfg.head_dim,
+                "rope_theta": cfg.rope_theta,
+                "rms_norm_eps": cfg.rms_norm_eps,
+                "max_position_embeddings": cfg.max_seq_len,
+                "tie_word_embeddings": cfg.tie_embeddings,
+            }
+        )
+    )

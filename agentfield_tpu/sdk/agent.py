@@ -383,6 +383,11 @@ class Agent:
 
         async def main():
             await self.start()
+            print(
+                f"[agentfield] {self.node_id} serving on {self.host}:{self.port} "
+                f"({len(self.components)} components), control plane {self.client.base_url}",
+                flush=True,
+            )
             stop = asyncio.Event()
             try:
                 await stop.wait()

@@ -183,11 +183,25 @@ def build_model_node(
     ecfg: EngineConfig | None = None,
     tokenizer=None,
     seed: int = 0,
+    checkpoint: str | None = None,
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
-    ``await backend.start(); await agent.start()``."""
-    cfg = get_config(model)
+    ``await backend.start(); await agent.start()``. With `checkpoint`, weights
+    (and config + tokenizer when present) come from a HF checkpoint dir;
+    otherwise random init of the named preset (demo mode)."""
+    if checkpoint:
+        from agentfield_tpu.models.hf_loader import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(checkpoint)
+        model = checkpoint
+        if tokenizer is None:
+            try:
+                tokenizer = HFTokenizer(checkpoint)
+            except Exception:
+                tokenizer = ByteTokenizer(cfg.vocab_size)
+    else:
+        cfg = get_config(model)
     if params is None:
         params = init_params(cfg, jax.random.PRNGKey(seed))
     if tokenizer is None:

@@ -1,0 +1,43 @@
+"""Training/serving checkpoints via orbax.
+
+The reference's durability is SQL rows + payload files (SURVEY §5
+checkpoint/resume: "no model checkpoints (no models)"); the TPU build adds
+real model checkpointing: orbax handles sharded pytrees natively, so a 70B
+TrainState saves/restores directly to/from its mesh placement.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from agentfield_tpu.training.trainer import TrainState
+
+
+def save_checkpoint(path: str | Path, state: TrainState) -> None:
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / f"step_{int(state.step)}", state)
+
+
+def latest_step(path: str | Path) -> int | None:
+    path = Path(path)
+    steps = [int(p.name.split("_", 1)[1]) for p in path.glob("step_*") if p.is_dir()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(path: str | Path, abstract_state: Any, step: int | None = None) -> TrainState:
+    """`abstract_state` carries shapes/dtypes/shardings (e.g. from
+    jax.eval_shape over init, with NamedShardings attached) so restore places
+    shards directly on the mesh without a host round-trip."""
+    path = Path(path).absolute()
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {path}")
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path / f"step_{step}", abstract_state)
+    return TrainState(*restored) if not isinstance(restored, TrainState) else restored

@@ -1,0 +1,122 @@
+"""HF loader round-trip, orbax checkpointing, config system, CLI surface."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from agentfield_tpu.config import load_config
+from agentfield_tpu.models import forward, get_config, init_params
+from agentfield_tpu.models.hf_loader import config_from_hf, load_hf_checkpoint, save_hf_checkpoint
+
+CFG = get_config("llama-tiny")
+
+
+def test_hf_round_trip(tmp_path):
+    """save → load reproduces identical forward logits (the name mapping and
+    transposes are exactly inverse)."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    save_hf_checkpoint(tmp_path / "ckpt", CFG, params)
+    cfg2, params2 = load_hf_checkpoint(tmp_path / "ckpt", dtype="float32")
+    assert cfg2.hidden_size == CFG.hidden_size
+    assert cfg2.num_kv_heads == CFG.num_kv_heads
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, CFG.vocab_size, jnp.int32)
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    a, _ = forward(params, CFG, toks, pos, collect_kv=False)
+    b, _ = forward(params2, cfg2, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_hf_loader_missing_tensor(tmp_path):
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    save_hf_checkpoint(tmp_path / "ckpt", CFG, params)
+    # corrupt: rewrite the safetensors without the final norm
+    from safetensors.numpy import save_file
+    from safetensors import safe_open
+
+    f = tmp_path / "ckpt" / "model.safetensors"
+    h = safe_open(str(f), framework="numpy")
+    tensors = {k: h.get_tensor(k) for k in h.keys() if k != "model.norm.weight"}
+    del h
+    save_file(tensors, str(f))
+    with pytest.raises(KeyError, match="model.norm.weight"):
+        load_hf_checkpoint(tmp_path / "ckpt")
+
+
+def test_orbax_checkpoint_round_trip(tmp_path):
+    from agentfield_tpu.training import init_train_state, make_train_step
+    from agentfield_tpu.training.checkpoint import restore_checkpoint, save_checkpoint
+
+    opt = optax.adamw(1e-3)
+    state = init_train_state(CFG, jax.random.PRNGKey(0), opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab_size, jnp.int32)
+    batch = {
+        "tokens": toks,
+        "positions": jnp.arange(16, dtype=jnp.int32)[None].repeat(2, 0),
+        "targets": jnp.roll(toks, -1, 1).at[:, -1].set(-1),
+    }
+    step = make_train_step(CFG, opt)
+    state, _ = step(state, batch)
+    save_checkpoint(tmp_path / "ck", state)
+
+    abstract = jax.tree.map(ocp_abstract := (lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)), state)
+    restored = restore_checkpoint(tmp_path / "ck", abstract)
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embed"]), np.asarray(state.params["embed"])
+    )
+
+
+def test_config_yaml_and_env(tmp_path):
+    cfgfile = tmp_path / "af.yaml"
+    cfgfile.write_text("server:\n  port: 9100\nexecution:\n  queue_capacity: 7\n")
+    cfg = load_config(str(cfgfile), env={})
+    assert cfg.server.port == 9100
+    assert cfg.execution.queue_capacity == 7
+    cfg = load_config(str(cfgfile), env={"AGENTFIELD_SERVER__PORT": "9200"})
+    assert cfg.server.port == 9200  # env beats file
+    with pytest.raises(ValueError, match="unknown keys"):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("server:\n  prot: 1\n")
+        load_config(str(bad), env={})
+
+
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+def _cli(*args, home: Path):
+    """Run the CLI hermetically: isolated HOME (pidfile registry/data dir live
+    under it) and the repo root derived from this file, never machine state."""
+    return subprocess.run(
+        [sys.executable, "-m", "agentfield_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        cwd=_REPO_ROOT,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": _REPO_ROOT, "HOME": str(home)},
+        timeout=60,
+    )
+
+
+def test_cli_version_and_init(tmp_path):
+    r = _cli("version", home=tmp_path)
+    assert r.returncode == 0 and "agentfield_tpu" in r.stdout
+    r = _cli("init", str(tmp_path / "myagent"), home=tmp_path)
+    assert r.returncode == 0
+    assert (tmp_path / "myagent" / "main.py").exists()
+    assert (tmp_path / "myagent" / "agentfield.yaml").exists()
+    # re-init refuses to clobber
+    r = _cli("init", str(tmp_path / "myagent"), home=tmp_path)
+    assert r.returncode == 1
+
+
+def test_cli_list_and_logs_empty(tmp_path):
+    r = _cli("list", home=tmp_path)
+    assert r.returncode == 0 and "no managed processes" in r.stdout
+    r = _cli("logs", "nonexistent", home=tmp_path)
+    assert r.returncode == 1

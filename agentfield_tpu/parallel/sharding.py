@@ -57,13 +57,19 @@ def shard_params(params: Any, cfg: LlamaConfig, mesh: Mesh) -> Any:
     return jax.device_put(params, named_sharding(mesh, param_pspecs(cfg)))
 
 
-def check_divisibility(cfg: LlamaConfig, tp: int) -> None:
-    """TP degree must divide every model-sharded dimension."""
-    for name, dim in [
+def check_divisibility(cfg: LlamaConfig, tp: int, paged_kv: bool = False) -> None:
+    """TP degree must divide every model-sharded dimension. The GSPMD forward
+    only needs the flattened projection dims; the serving engine's paged KV
+    cache additionally shards the *head* axes, so it requires head-count
+    divisibility too (`paged_kv=True`)."""
+    dims = [
         ("q_dim", cfg.q_dim),
         ("kv_dim", cfg.kv_dim),
         ("intermediate_size", cfg.intermediate_size),
         ("vocab_size", cfg.vocab_size),
-    ]:
+    ]
+    if paged_kv:
+        dims += [("num_heads", cfg.num_heads), ("num_kv_heads", cfg.num_kv_heads)]
+    for name, dim in dims:
         if dim % tp:
             raise ValueError(f"tp={tp} does not divide {name}={dim} for this config")

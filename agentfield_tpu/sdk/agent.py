@@ -132,6 +132,7 @@ class Agent:
         self.heartbeat_interval = heartbeat_interval
         self.client = ControlPlaneClient(control_plane)
         self.components: dict[str, ComponentDef] = {}
+        self.extra_routes: list[tuple[str, str, Any]] = []  # (method, path, handler)
         self._runner: web.AppRunner | None = None
         self._hb_task: asyncio.Task | None = None
         self._pending: set[asyncio.Task] = set()
@@ -215,7 +216,14 @@ class Agent:
         app.router.add_get("/health", health)
         app.router.add_get("/reasoners", list_components)
         app.router.add_get("/skills", list_components)
+        for method, path, handler in self.extra_routes:
+            app.router.add_route(method, path, handler)
         return app
+
+    def add_route(self, method: str, path: str, handler) -> None:
+        """Attach a raw aiohttp route (e.g. the model node's token-stream
+        endpoint). Must be called before start()."""
+        self.extra_routes.append((method, path, handler))
 
     async def _run(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> Any:
         token = set_context(ctx)
@@ -255,6 +263,18 @@ class Agent:
             raise RuntimeError(f"call {target} {doc['status']}: {doc.get('error')}")
         return doc["result"]
 
+    async def _resolve_model_node(self, model: str | None) -> dict[str, Any]:
+        nodes = await self.client.list_nodes()
+        if model is not None:
+            for n in nodes:
+                if n["node_id"] == model:
+                    return n
+            raise RuntimeError(f"model node {model!r} not registered")
+        candidates = [n for n in nodes if n.get("kind") == "model" and n["status"] == "active"]
+        if not candidates:
+            raise RuntimeError("no active model node registered")
+        return candidates[0]
+
     async def ai(
         self,
         prompt: str | None = None,
@@ -269,17 +289,10 @@ class Agent:
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
-        active model node (or `model` node id); the placement scheduler
-        arrives with multi-node support."""
-        node_id = model
-        if node_id is None:
-            nodes = await self.client.list_nodes()
-            candidates = [
-                n["node_id"] for n in nodes if n.get("kind") == "model" and n["status"] == "active"
-            ]
-            if not candidates:
-                raise RuntimeError("no active model node registered")
-            node_id = candidates[0]
+        active model node (or `model` node id, used directly — the gateway
+        validates it); the placement scheduler arrives with multi-node
+        support."""
+        node_id = model if model is not None else (await self._resolve_model_node(None))["node_id"]
         payload = {
             "prompt": prompt,
             "tokens": tokens,
@@ -298,6 +311,95 @@ class Agent:
         if doc["status"] != "completed":
             raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
         return doc["result"]
+
+    async def ai_stream(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        model: str | None = None,
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: list[int] | None = None,
+        timeout: float = 600.0,
+    ):
+        """Token-streaming LLM call: SSE straight from the model node (data
+        plane), with DAG visibility via workflow lifecycle events. Yields
+        {"token", "index", "finished", "finish_reason", "text"?} frames.
+
+        Early exit: a consumer that `break`s out is recorded as a *completed*
+        execution with finish_reason "client_stopped". Note that generator
+        finalization after `break` is deferred to GC unless you iterate under
+        ``contextlib.aclosing(...)`` — use that for deterministic DAG events."""
+        import aiohttp
+
+        node = await self._resolve_model_node(model)
+        ctx = self._outbound_ctx()
+        base = {
+            "event": "start",
+            "execution_id": ctx.execution_id,
+            "run_id": ctx.run_id,
+            "parent_execution_id": ctx.parent_execution_id,
+            "target": f"{node['node_id']}.generate",
+            "input": {"prompt": prompt, "max_new_tokens": max_new_tokens, "stream": True},
+        }
+        try:
+            await self.client.post_workflow_event(base)
+        except Exception:
+            pass  # tracking is best-effort; the stream itself must not fail
+        payload = {
+            "prompt": prompt,
+            "tokens": tokens,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "stop_token_ids": stop_token_ids or [],
+        }
+        collected: list[int] = []
+        finish_reason = None
+        failed = False
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=timeout)
+            ) as s:
+                async with s.post(
+                    f"{node['base_url'].rstrip('/')}/generate/stream", json=payload
+                ) as resp:
+                    if resp.status != 200:
+                        failed = True
+                        err = (await resp.text())[:300]
+                        raise RuntimeError(f"stream failed [{resp.status}]: {err}")
+                    async for line in resp.content:
+                        if not line.startswith(b"data: "):
+                            continue
+                        frame = json.loads(line[6:])
+                        collected.append(frame["token"])
+                        finish_reason = frame.get("finish_reason")
+                        yield frame
+                        if frame.get("finished"):
+                            break
+        except BaseException:
+            failed = failed or finish_reason is None and collected == []
+            raise
+        finally:
+            # A consumer break is a legitimate completion ("client_stopped");
+            # only genuine transport/model failures record an error event.
+            done = dict(base)
+            if failed:
+                done["event"] = "error"
+                done["error"] = "stream aborted"
+            else:
+                done["event"] = "complete"
+            done["result"] = {
+                "tokens": collected,
+                "finish_reason": finish_reason or "client_stopped",
+            }
+            try:
+                await self.client.post_workflow_event(done)
+            except Exception:
+                pass
 
     async def note(self, note: Any, actor: str | None = None) -> None:
         """Attach a note to the current execution (reference: Agent.note,

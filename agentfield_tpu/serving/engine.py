@@ -169,7 +169,13 @@ class InferenceEngine:
         cfg: LlamaConfig,
         ecfg: EngineConfig | None = None,
         seed: int = 0,
+        mesh=None,
     ):
+        """With `mesh`, the engine runs tensor-parallel: params shard per the
+        Megatron-style PartitionSpecs (parallel/sharding.py), KV pages over
+        the KV-head axis; XLA inserts the ICI collectives (north-star config
+        5: 70B TP=8). The scheduler/host side is unchanged — SPMD is invisible
+        to it."""
         self.cfg = cfg
         self.ecfg = ecfg or EngineConfig()
         if self.ecfg.max_pages_per_seq > self.ecfg.num_pages - 1:
@@ -178,9 +184,21 @@ class InferenceEngine:
                 f"num_pages-1={self.ecfg.num_pages - 1} (page 0 is reserved); "
                 "an admitted request could otherwise never obtain its pages"
             )
+        self.mesh = mesh
+        if mesh is not None:
+            from agentfield_tpu.parallel.mesh import AXIS_MODEL
+            from agentfield_tpu.parallel.sharding import check_divisibility, shard_params
+
+            if self.ecfg.attn_impl != "ref" or self.ecfg.prefill_impl != "ref":
+                raise ValueError(
+                    "pallas attention impls are single-chip in this version; "
+                    "use attn_impl=prefill_impl='ref' with a mesh (GSPMD path)"
+                )
+            check_divisibility(cfg, mesh.shape[AXIS_MODEL], paged_kv=True)
+            params = shard_params(params, cfg, mesh)
         self.params = params
         self.cache = PagedKVCache.create(
-            cfg, self.ecfg.num_pages, self.ecfg.page_size, self.ecfg.dtype
+            cfg, self.ecfg.num_pages, self.ecfg.page_size, self.ecfg.dtype, mesh=mesh
         )
         self.allocator = PageAllocator(self.ecfg.num_pages)
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq

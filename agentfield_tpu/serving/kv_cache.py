@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,13 +37,27 @@ class PagedKVCache:
 
     @staticmethod
     def create(
-        cfg: LlamaConfig, num_pages: int, page_size: int, dtype: str | None = None
+        cfg: LlamaConfig,
+        num_pages: int,
+        page_size: int,
+        dtype: str | None = None,
+        mesh=None,
     ) -> "PagedKVCache":
+        """With a mesh, pages shard over the KV-head axis on `model` (matching
+        the TP sharding of wk/wv, so K/V writes during decode are local — no
+        resharding on the hot path)."""
         dt = resolve_dtype(dtype or cfg.dtype)
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
-        return PagedKVCache(
-            k_pages=jnp.zeros(shape, dt), v_pages=jnp.zeros(shape, dt), page_size=page_size
-        )
+        k = jnp.zeros(shape, dt)
+        v = jnp.zeros(shape, dt)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+            s = NamedSharding(mesh, P(None, None, None, AXIS_MODEL, None))
+            k, v = jax.device_put(k, s), jax.device_put(v, s)
+        return PagedKVCache(k_pages=k, v_pages=v, page_size=page_size)
 
     def hbm_bytes(self) -> int:
         return 2 * self.k_pages.size * self.k_pages.dtype.itemsize

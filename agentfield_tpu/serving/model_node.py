@@ -61,6 +61,12 @@ class HFTokenizer:
         return self._tok.decode(tokens)
 
 
+def _error_event(rid: str, error: str):
+    from agentfield_tpu.serving.engine import TokenEvent
+
+    return TokenEvent(request_id=rid, token=-1, index=-1, finished=True, finish_reason=f"error: {error}")
+
+
 class ModelBackend:
     def __init__(
         self,
@@ -71,14 +77,16 @@ class ModelBackend:
         seed: int = 0,
         idle_sleep: float = 0.002,
         model_name: str = "custom",
+        mesh=None,
     ):
         self.cfg = cfg
         self.model_name = model_name
-        self.engine = InferenceEngine(params, cfg, ecfg, seed=seed)
+        self.engine = InferenceEngine(params, cfg, ecfg, seed=seed, mesh=mesh)
         self.tokenizer = tokenizer
         self.idle_sleep = idle_sleep
         self._buffers: dict[str, list[int]] = {}
         self._futures: dict[str, asyncio.Future] = {}
+        self._streams: dict[str, asyncio.Queue] = {}  # rid -> per-token queue
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._next = 0
@@ -119,9 +127,20 @@ class ModelBackend:
                         fut.set_exception(RuntimeError(f"engine step failed: {e!r}"))
                     self._futures.pop(rid, None)
                     self._buffers.pop(rid, None)
+                for rid, q in list(self._streams.items()):
+                    self._push_stream(rid, q, _error_event(rid, f"engine step failed: {e!r}"))
+                self._streams.clear()
                 await asyncio.sleep(0.1)
                 continue
             for ev in events:
+                stream = self._streams.get(ev.request_id)
+                if stream is not None:
+                    alive = self._push_stream(ev.request_id, stream, ev)
+                    if ev.finished or not alive:
+                        self._streams.pop(ev.request_id, None)
+                    if alive:
+                        continue
+                    # fall through: consumer gone, route to the discard path
                 buf = self._buffers.setdefault(ev.request_id, [])
                 buf.append(ev.token)
                 if ev.finished:
@@ -130,16 +149,30 @@ class ModelBackend:
                     if fut is not None and not fut.done():
                         fut.set_result({"tokens": tokens, "finish_reason": ev.finish_reason})
 
-    async def generate(
+    @staticmethod
+    def _push_stream(rid: str, q: asyncio.Queue, ev) -> bool:
+        """Non-blocking stream dispatch. A full queue means the consumer is
+        too slow or gone — drop the stream (returns False) rather than let
+        QueueFull kill the drive loop."""
+        try:
+            q.put_nowait(ev)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def _submit(
         self,
-        prompt: str | None = None,
-        tokens: list[int] | None = None,
-        max_new_tokens: int = 128,
-        temperature: float = 0.0,
-        top_k: int = 0,
-        top_p: float = 1.0,
-        stop_token_ids: list[int] | None = None,
-    ) -> dict[str, Any]:
+        prompt: str | None,
+        tokens: list[int] | None,
+        max_new_tokens: int,
+        temperature: float,
+        top_k: int,
+        top_p: float,
+        stop_token_ids: list[int] | None,
+        register,  # rid -> None; registers the completion sink before submit
+        unregister,  # rid -> None; rollback on submit failure
+    ) -> str:
+        """Shared tokenize/validate/submit path for both completion styles."""
         if tokens is None:
             if prompt is None:
                 raise ValueError("one of 'prompt' or 'tokens' is required")
@@ -148,8 +181,7 @@ class ModelBackend:
             tokens = self.tokenizer.encode(prompt)
         self._next += 1
         rid = f"gen_{self._next}"
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._futures[rid] = fut
+        register(rid)
         try:
             self.engine.submit(
                 Request(
@@ -164,15 +196,70 @@ class ModelBackend:
                     ),
                 )
             )
-        except (QueueFullError, RequestTooLongError):
-            self._futures.pop(rid, None)
+        except Exception:
+            unregister(rid)
             raise
         self._wake.set()
+        return rid
+
+    async def generate(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: list[int] | None = None,
+    ) -> dict[str, Any]:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._submit(
+            prompt,
+            tokens,
+            max_new_tokens,
+            temperature,
+            top_k,
+            top_p,
+            stop_token_ids,
+            register=lambda rid: self._futures.__setitem__(rid, fut),
+            unregister=lambda rid: self._futures.pop(rid, None),
+        )
         result = await fut
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(result["tokens"])
         result["model"] = self.model_name
         return result
+
+    def submit_stream(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: list[int] | None = None,
+    ) -> tuple[str, asyncio.Queue]:
+        """Streaming variant: returns (request_id, queue of TokenEvents).
+        Raises QueueFullError / RequestTooLongError like generate()."""
+        q: asyncio.Queue = asyncio.Queue(maxsize=4096)
+        rid = self._submit(
+            prompt,
+            tokens,
+            max_new_tokens,
+            temperature,
+            top_k,
+            top_p,
+            stop_token_ids,
+            register=lambda r: self._streams.__setitem__(r, q),
+            unregister=lambda r: self._streams.pop(r, None),
+        )
+        return rid, q
+
+    def release_stream(self, rid: str) -> None:
+        """Consumer gone: stop dispatching to its queue (remaining tokens take
+        the discard path)."""
+        self._streams.pop(rid, None)
 
 
 def build_model_node(
@@ -184,6 +271,7 @@ def build_model_node(
     tokenizer=None,
     seed: int = 0,
     checkpoint: str | None = None,
+    tp: int = 1,
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -206,7 +294,14 @@ def build_model_node(
         params = init_params(cfg, jax.random.PRNGKey(seed))
     if tokenizer is None:
         tokenizer = ByteTokenizer(cfg.vocab_size)
-    backend = ModelBackend(params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model)
+    mesh = None
+    if tp > 1:
+        from agentfield_tpu.parallel.mesh import AXIS_MODEL, make_mesh
+
+        mesh = make_mesh({AXIS_MODEL: tp})
+    backend = ModelBackend(
+        params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model, mesh=mesh
+    )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
     if control_plane:
@@ -217,4 +312,57 @@ def build_model_node(
     agent.reasoner(id="generate", description=f"TPU-served {model} generation")(
         backend.generate
     )
+
+    async def stream_handler(req):
+        """SSE token stream — the data-plane path: callers hit the model node
+        directly so tokens never proxy through the control plane (reference
+        streams pass through litellm, agent_ai.py:414-416; here the transport
+        is ours)."""
+        import json as _json
+
+        from aiohttp import web as _web
+
+        try:
+            body = await req.json()
+            if not isinstance(body, dict):
+                raise ValueError("JSON object body required")
+            gen_kwargs = {
+                k: body[k]
+                for k in (
+                    "prompt", "tokens", "stop_token_ids",
+                    "max_new_tokens", "temperature", "top_k", "top_p",
+                )
+                if body.get(k) is not None
+            }
+            rid, q = backend.submit_stream(**gen_kwargs)
+        except (QueueFullError,) as e:
+            return _web.json_response({"error": str(e)}, status=503)
+        except Exception as e:
+            return _web.json_response({"error": repr(e)}, status=400)
+        resp = _web.StreamResponse(
+            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+        )
+        await resp.prepare(req)
+        try:
+            while True:
+                ev = await q.get()
+                frame = {
+                    "token": ev.token,
+                    "index": ev.index,
+                    "finished": ev.finished,
+                    "finish_reason": ev.finish_reason,
+                }
+                if backend.tokenizer is not None and ev.token >= 0:
+                    frame["text"] = backend.tokenizer.decode([ev.token])
+                await resp.write(f"data: {_json.dumps(frame)}\n\n".encode())
+                if ev.finished:
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            backend.release_stream(rid)  # disconnected consumers must not
+            # accumulate in _streams (remaining tokens take the discard path)
+        return resp
+
+    agent.add_route("POST", "/generate/stream", stream_handler)
     return agent, backend

@@ -150,6 +150,41 @@ async def test_concurrent_ai_calls_share_engine():
 
 
 @async_test
+async def test_ai_stream_tokens_and_dag():
+    """Streaming ai(): tokens arrive incrementally over SSE from the model
+    node, match the non-streaming result, and the call is DAG-visible."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        caller = Agent("streamer", h.base_url)
+        await caller.start()
+        try:
+            frames = []
+            async for f in caller.ai_stream(prompt="stream me", max_new_tokens=5):
+                frames.append(f)
+            assert len(frames) == 5
+            assert frames[-1]["finished"] and frames[-1]["finish_reason"] == "length"
+            assert [f["index"] for f in frames] == list(range(5))
+            # same tokens as the non-streaming path (greedy, same engine state shape)
+            flat = await caller.ai(prompt="stream me", max_new_tokens=5)
+            assert [f["token"] for f in frames] == flat["tokens"]
+            # DAG saw the streamed call
+            runs = await caller.client.run_summaries()
+            streamed = [
+                r for r in runs if "model-tiny.generate" in r["targets"] and r["executions"] == 1
+            ]
+            assert streamed, runs
+            assert streamed[0]["overall_status"] == "completed"
+        finally:
+            await caller.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
 async def test_router_prefixing_and_skills():
     async with CPHarness() as h:
         app = Agent("routed", h.base_url)

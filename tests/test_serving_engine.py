@@ -109,6 +109,37 @@ def test_temperature_sampling_diverges_and_completes(params):
     assert all(0 <= t < CFG.vocab_size for v in results.values() for t in v)
 
 
+def test_tensor_parallel_engine_matches_oracle(params):
+    """TP=2 over the model axis (GSPMD): identical greedy tokens, KV pages
+    sharded over the KV-head axis (north-star config 5 in miniature)."""
+    from agentfield_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"model": 2})
+    engine = InferenceEngine(params, CFG, ECFG, mesh=mesh)
+    prompts = [_prompt(jax.random.PRNGKey(i), n) for i, n in enumerate([5, 9])]
+    results = engine.run_to_completion(
+        [_greedy_req(f"r{i}", p, max_new=5) for i, p in enumerate(prompts)]
+    )
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=5, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle
+    # pages actually sharded
+    assert "model" in str(engine.cache.k_pages.sharding)
+
+
+def test_tp_engine_rejects_pallas_impls(params):
+    from agentfield_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"model": 2})
+    ecfg = EngineConfig(
+        max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=4, attn_impl="pallas"
+    )
+    with pytest.raises(ValueError, match="single-chip"):
+        InferenceEngine(params, CFG, ecfg, mesh=mesh)
+
+
 def test_allocator_invariants():
     a = PageAllocator(8)
     got = a.alloc(7)

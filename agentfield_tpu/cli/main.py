@@ -94,6 +94,8 @@ def cmd_server(cfg: Config, args) -> int:
         Path(db).parent.mkdir(parents=True, exist_ok=True)
         cp = ControlPlane(
             db_path=db,
+            keystore_path=str(data_dir(cfg) / "keystore.bin"),
+            keystore_passphrase=cfg.server.keystore_passphrase,
             agent_timeout=cfg.execution.agent_timeout,
             sync_wait_timeout=cfg.execution.sync_wait_timeout,
             async_workers=cfg.execution.async_workers,

@@ -24,6 +24,7 @@ class ServerConfig:
     port: int = 8800
     db_path: str = "~/.agentfield_tpu/control_plane.db"
     webhook_secret: str | None = None
+    keystore_passphrase: str | None = None  # None → AGENTFIELD_KEYSTORE_PASSPHRASE env
 
 
 @dataclasses.dataclass

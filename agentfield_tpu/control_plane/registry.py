@@ -43,10 +43,12 @@ class NodeRegistry:
         heartbeat_ttl: float = 300.0,
         sweep_interval: float = 30.0,
         evict_after: float = 1800.0,
+        did_service=None,
     ):
         self.storage = storage
         self.bus = bus
         self.metrics = metrics
+        self.did_service = did_service
         self.heartbeat_ttl = heartbeat_ttl
         self.sweep_interval = sweep_interval
         self.evict_after = evict_after
@@ -107,6 +109,12 @@ class NodeRegistry:
             skills=comps("skill"),
             metadata=payload.get("metadata", {}),
         )
+        if self.did_service is not None:
+            # Mint the identity tree on registration (reference: nodes.go
+            # registration mints node + component DIDs via DIDService).
+            node.did = self.did_service.node_did(node_id)
+            for comp in node.reasoners + node.skills:
+                comp.did = self.did_service.component_did(node_id, comp.id)
         self.storage.upsert_node(node)
         self._last_persist[node_id] = now()
         self.metrics.inc("nodes_registered_total")

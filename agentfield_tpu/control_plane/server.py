@@ -46,8 +46,20 @@ class ControlPlane:
         cleanup_interval: float = 60.0,
         stale_after: float = 3600.0,  # reference cleanup defaults (config.go:48-55)
         retention: float = 86400.0,
+        keystore_path: str | None = None,  # None → ephemeral seed (tests/dev)
+        keystore_passphrase: str | None = None,  # None → env var or dev default
     ):
+        from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
+
         self.storage = SQLiteStorage(db_path)
+        if keystore_path:
+            seed = Keystore(keystore_path, keystore_passphrase).load_or_create_seed()
+        else:
+            import os as _os
+
+            seed = _os.urandom(32)
+        self.did_service = DIDService(seed)
+        self.vc_service = VCService(self.did_service)
         self.bus = EventBus()
         self.metrics = Metrics()
         self.webhooks = WebhookDispatcher(self.storage, self.metrics)
@@ -59,6 +71,7 @@ class ControlPlane:
             heartbeat_ttl=heartbeat_ttl,
             sweep_interval=sweep_interval,
             evict_after=evict_after,
+            did_service=self.did_service,
         )
         self.gateway = ExecutionGateway(
             self.storage,
@@ -85,6 +98,10 @@ class ControlPlane:
         await self.registry.start()
         await self.webhooks.start()
         self._cleanup_task = asyncio.create_task(self._cleanup_loop())
+        # Native scan kernel compiles off-loop; requests use numpy until ready.
+        from agentfield_tpu import native
+
+        asyncio.create_task(asyncio.to_thread(native.build))
 
     async def stop(self) -> None:
         if not self._started:
@@ -331,6 +348,68 @@ def create_app(cp: ControlPlane) -> web.Application:
             run_id=q.get("run_id"), status=status, limit=limit, offset=offset
         )
         return web.json_response({"executions": [e.to_dict() for e in exs]})
+
+    # -- DID / VC audit layer ------------------------------------------
+
+    @routes.get("/api/v1/did/org")
+    async def org_did(_req):
+        return web.json_response({"did": cp.did_service.org_did})
+
+    @routes.get("/api/v1/did/{node_id}")
+    async def node_did(req: web.Request):
+        node = cp.storage.get_node(req.match_info["node_id"])
+        if node is None:
+            return _json_error(404, "unknown node")
+        return web.json_response(
+            {
+                "node_id": node.node_id,
+                "did": node.did,
+                "components": {
+                    c.id: c.did for c in node.reasoners + node.skills
+                },
+                "org_did": cp.did_service.org_did,
+            }
+        )
+
+    @routes.post("/api/v1/vc/executions/{execution_id}")
+    async def issue_vc(req: web.Request):
+        ex = cp.storage.get_execution(req.match_info["execution_id"])
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        if not ex.status.terminal:
+            return _json_error(409, "execution not terminal yet")
+        return web.json_response({"vc": cp.vc_service.issue_execution_vc(ex.to_dict())})
+
+    @routes.post("/api/v1/vc/verify")
+    async def verify_vc(req: web.Request):
+        try:
+            body = await _json_dict(req, allow_empty=False)
+        except _BadBody as e:
+            return _json_error(400, str(e))
+        vc = body.get("vc")
+        if not isinstance(vc, dict):
+            return _json_error(400, "field 'vc' (object) is required")
+        ok, reason = cp.vc_service.verify(vc)
+        return web.json_response({"valid": ok, "reason": reason})
+
+    @routes.get("/api/v1/vc/workflows/{run_id}")
+    async def workflow_vc_chain(req: web.Request):
+        # Paginate to completeness: an org-SIGNED chain must never silently
+        # attest a truncated run.
+        run_id = req.match_info["run_id"]
+        exs, offset = [], 0
+        while True:
+            page = cp.storage.list_executions(run_id=run_id, limit=1000, offset=offset)
+            exs.extend(page)
+            if len(page) < 1000:
+                break
+            offset += 1000
+        if not exs:
+            return _json_error(404, "unknown run")
+        non_terminal = [e.execution_id for e in exs if not e.status.terminal]
+        if non_terminal:
+            return _json_error(409, f"run has non-terminal executions: {non_terminal[:5]}")
+        return web.json_response(cp.vc_service.workflow_chain([e.to_dict() for e in exs]))
 
     # -- workflow DAG / runs / notes -----------------------------------
 

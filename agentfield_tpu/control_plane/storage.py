@@ -347,16 +347,28 @@ class SQLiteStorage:
             metas.append(json.loads(r["metadata"]))
         if not keys:
             return []
+        if metric not in ("cosine", "dot", "l2"):
+            raise ValueError(f"unknown metric {metric!r}")
         m = np.stack(mats)  # [N, d]
+
+        # Native C++ scan when available (agentfield_tpu/native); numpy else.
+        from agentfield_tpu.native import vector_scan_topk
+
+        native = vector_scan_topk(m, q, metric=metric, k=top_k)
+        if native is not None:
+            idxs, scores = native
+            return [
+                {"key": keys[i], "score": float(s), "metadata": metas[i]}
+                for i, s in zip(idxs.tolist(), scores.tolist())
+            ]
+
         if metric == "cosine":
             denom = np.linalg.norm(m, axis=1) * (np.linalg.norm(q) + 1e-12) + 1e-12
             scores = (m @ q) / denom
         elif metric == "dot":
             scores = m @ q
-        elif metric == "l2":
-            scores = -np.linalg.norm(m - q, axis=1)
         else:
-            raise ValueError(f"unknown metric {metric!r}")
+            scores = -np.linalg.norm(m - q, axis=1)
         order = np.argsort(-scores)[:top_k]
         return [
             {"key": keys[i], "score": float(scores[i]), "metadata": metas[i]} for i in order

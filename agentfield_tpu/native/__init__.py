@@ -1,0 +1,101 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+First use triggers an in-tree `make` (g++ -O3, no external deps); failures
+fall back to the numpy implementations so a missing toolchain never breaks
+the control plane — the native path is a perf optimization, mirroring how
+the reference keeps its Go scan simple (vector_store_sqlite.go:79).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "libafnative.so"
+_METRICS = {"cosine": 0, "dot": 1, "l2": 2}
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def build(timeout: float = 120) -> bool:
+    """Compile the native library (blocking — call from a worker thread or at
+    process start, never from an event loop). Returns availability."""
+    global _tried
+    try:
+        if not _LIB_PATH.exists():
+            subprocess.run(
+                ["make", "-s"], cwd=_DIR, check=True, capture_output=True, timeout=timeout
+            )
+    except Exception:
+        return False
+    _tried = False  # allow _load to pick up the fresh artifact
+    return _load() is not None
+
+
+def _load() -> ctypes.CDLL | None:
+    """Load the library if ALREADY BUILT — never compiles (request paths call
+    this; a surprise 120s `make` inside the aiohttp event loop would stall
+    heartbeats and evict live agents)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not _LIB_PATH.exists():
+            return None
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.af_vector_scan_topk.restype = ctypes.c_int32
+        lib.af_vector_scan_topk.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None or build()
+
+
+def vector_scan_topk(
+    mat: np.ndarray, q: np.ndarray, metric: str = "cosine", k: int = 5
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Top-k (indices, scores) over rows of `mat` or None when the native
+    library is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    mat = np.ascontiguousarray(mat, np.float32)
+    q = np.ascontiguousarray(q, np.float32)
+    n, d = mat.shape
+    k = min(k, n) if n else 0
+    if k == 0:
+        return np.empty((0,), np.int32), np.empty((0,), np.float32)
+    out_idx = np.empty((k,), np.int32)
+    out_score = np.empty((k,), np.float32)
+    m = lib.af_vector_scan_topk(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        d,
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _METRICS[metric],
+        k,
+        out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_score.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if m < 0:
+        return None
+    return out_idx[:m], out_score[:m]

@@ -1,0 +1,91 @@
+// Native vector-similarity scan for the control plane's vector memory.
+//
+// The reference computes cosine/dot/L2 over all rows in Go
+// (internal/storage/vector_store_sqlite.go:79); here the scan is C++ built
+// -O3 so the compiler vectorizes the inner loops, with a bounded top-k
+// selection instead of a full sort. Exposed extern "C" for ctypes
+// (pybind11 is not in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Hit {
+    float score;
+    int32_t idx;
+};
+
+// Maintain the k best hits in a small array (k is tiny; linear insert beats
+// heap bookkeeping at these sizes).
+inline void push_topk(std::vector<Hit>& heap, int k, float score, int32_t idx) {
+    if ((int)heap.size() < k) {
+        heap.push_back({score, idx});
+        for (size_t i = heap.size() - 1; i > 0 && heap[i].score > heap[i - 1].score; --i) {
+            Hit t = heap[i];
+            heap[i] = heap[i - 1];
+            heap[i - 1] = t;
+        }
+        return;
+    }
+    if (score <= heap.back().score) return;
+    heap.back() = {score, idx};
+    for (size_t i = heap.size() - 1; i > 0 && heap[i].score > heap[i - 1].score; --i) {
+        Hit t = heap[i];
+        heap[i] = heap[i - 1];
+        heap[i - 1] = t;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// metric: 0 = cosine, 1 = dot, 2 = negative-L2
+// mat: [n, d] row-major float32; q: [d]; out_idx/out_score: [k]
+// returns the number of results written (min(n, k)), or -1 on bad args.
+int32_t af_vector_scan_topk(const float* mat, int32_t n, int32_t d, const float* q,
+                            int32_t metric, int32_t k, int32_t* out_idx,
+                            float* out_score) {
+    if (!mat || !q || !out_idx || !out_score || n < 0 || d <= 0 || k <= 0 || metric < 0 ||
+        metric > 2)
+        return -1;
+
+    float qnorm = 0.f;
+    if (metric == 0) {
+        for (int32_t j = 0; j < d; ++j) qnorm += q[j] * q[j];
+        qnorm = std::sqrt(qnorm) + 1e-12f;
+    }
+
+    std::vector<Hit> best;
+    best.reserve(k);
+    for (int32_t i = 0; i < n; ++i) {
+        const float* row = mat + (size_t)i * d;
+        float score;
+        if (metric == 2) {
+            float acc = 0.f;
+            for (int32_t j = 0; j < d; ++j) {
+                float diff = row[j] - q[j];
+                acc += diff * diff;
+            }
+            score = -std::sqrt(acc);
+        } else {
+            float dot = 0.f, rnorm = 0.f;
+            for (int32_t j = 0; j < d; ++j) {
+                dot += row[j] * q[j];
+                rnorm += row[j] * row[j];
+            }
+            score = (metric == 0) ? dot / (std::sqrt(rnorm) * qnorm + 1e-12f) : dot;
+        }
+        push_topk(best, k, score, i);
+    }
+    int32_t m = (int32_t)best.size();
+    for (int32_t i = 0; i < m; ++i) {
+        out_idx[i] = best[i].idx;
+        out_score[i] = best[i].score;
+    }
+    return m;
+}
+}

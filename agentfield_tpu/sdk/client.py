@@ -151,6 +151,20 @@ class ControlPlaneClient:
             await asyncio.sleep(interval)
             interval = min(interval * 1.5, 1.0)
 
+    # -- DID / VC -------------------------------------------------------
+
+    async def get_did(self, node_id: str = "org") -> dict[str, Any]:
+        return await self._req("GET", f"/api/v1/did/{node_id}")
+
+    async def issue_execution_vc(self, execution_id: str) -> dict[str, Any]:
+        return (await self._req("POST", f"/api/v1/vc/executions/{execution_id}"))["vc"]
+
+    async def verify_vc(self, vc: dict[str, Any]) -> dict[str, Any]:
+        return await self._req("POST", "/api/v1/vc/verify", json={"vc": vc})
+
+    async def workflow_vc_chain(self, run_id: str) -> dict[str, Any]:
+        return await self._req("GET", f"/api/v1/vc/workflows/{run_id}")
+
     # -- workflow / notes ----------------------------------------------
 
     async def add_note(self, execution_id: str, note: Any, actor: str | None = None) -> None:

@@ -1,0 +1,145 @@
+"""DID/VC audit layer: key derivation, did:key codec, keystore sealing,
+execution credentials and workflow chains over the live API."""
+
+import pytest
+
+from agentfield_tpu.control_plane.identity import (
+    DIDService,
+    Keystore,
+    VCService,
+    b58decode,
+    b58encode,
+    did_key_from_public,
+    public_from_did_key,
+)
+from agentfield_tpu.sdk import Agent
+from tests.helpers_cp import CPHarness, async_test
+
+
+def test_b58_round_trip():
+    for data in (b"", b"\x00\x00abc", b"hello world", bytes(range(32))):
+        assert b58decode(b58encode(data)) == data
+
+
+def test_did_key_round_trip():
+    svc = DIDService(b"\x01" * 32)
+    did = svc.node_did("agent-a")
+    assert did.startswith("did:key:z")
+    pub = public_from_did_key(did)
+    assert did_key_from_public(pub) == did
+    with pytest.raises(ValueError):
+        public_from_did_key("did:web:example.com")
+
+
+def test_did_determinism_and_separation():
+    a, b = DIDService(b"\x01" * 32), DIDService(b"\x01" * 32)
+    other = DIDService(b"\x02" * 32)
+    assert a.node_did("x") == b.node_did("x")  # recoverable from the seed
+    assert a.node_did("x") != a.node_did("y")
+    assert a.node_did("x") != other.node_did("x")
+    assert a.component_did("x", "r1") != a.node_did("x")
+
+
+def test_keystore_persistence(tmp_path):
+    ks = Keystore(tmp_path / "ks.bin", passphrase="pw")
+    seed1 = ks.load_or_create_seed()
+    seed2 = Keystore(tmp_path / "ks.bin", passphrase="pw").load_or_create_seed()
+    assert seed1 == seed2
+    with pytest.raises(Exception):
+        Keystore(tmp_path / "ks.bin", passphrase="wrong").load_or_create_seed()
+
+
+def test_vc_issue_verify_tamper():
+    svc = DIDService(b"\x03" * 32)
+    vcs = VCService(svc)
+    execution = {
+        "execution_id": "exec_1",
+        "run_id": "run_1",
+        "target": "agent-a.say_hello",
+        "target_type": "reasoner",
+        "status": "completed",
+        "input": {"name": "x"},
+        "result": "Hello x",
+    }
+    vc = vcs.issue_execution_vc(execution)
+    assert vc["issuer"] == svc.node_did("agent-a")
+    ok, reason = VCService.verify(vc)
+    assert ok, reason
+    # tamper with the subject → signature must fail
+    vc["credentialSubject"]["status"] = "failed"
+    ok, reason = VCService.verify(vc)
+    assert not ok and reason == "signature invalid"
+    ok, reason = VCService.verify({"no": "proof"})
+    assert not ok and reason == "missing proof"
+
+
+@async_test
+async def test_vc_end_to_end_over_api():
+    async with CPHarness() as h:
+        a = Agent("vcagent", h.base_url)
+
+        @a.reasoner()
+        def greet(name: str) -> str:
+            return f"hi {name}"
+
+        await a.start()
+        try:
+            # registration minted DIDs
+            doc = await a.client.get_did("vcagent")
+            assert doc["did"].startswith("did:key:z")
+            assert doc["components"]["greet"].startswith("did:key:z")
+            org = await a.client.get_did("org")
+            assert org["did"].startswith("did:key:z")
+
+            async with h.http.post(
+                "/api/v1/execute/vcagent.greet", json={"input": {"name": "v"}}
+            ) as r:
+                ex = await r.json()
+            vc = await a.client.issue_execution_vc(ex["execution_id"])
+            assert vc["credentialSubject"]["target"] == "vcagent.greet"
+            verdict = await a.client.verify_vc(vc)
+            assert verdict["valid"]
+
+            chain = await a.client.workflow_vc_chain(ex["run_id"])
+            assert chain["envelope"]["count"] == 1
+            assert (await a.client.verify_vc(chain["envelope"]))["valid"]
+            assert (await a.client.verify_vc(chain["credentials"][0]))["valid"]
+
+            # non-terminal / unknown handling
+            async with h.http.post("/api/v1/vc/executions/ghost") as r:
+                assert r.status == 404
+            async with h.http.get("/api/v1/vc/workflows/ghost") as r:
+                assert r.status == 404
+        finally:
+            await a.stop()
+
+
+def test_vc_rejects_foreign_key_resign():
+    """A tampered VC re-signed with an attacker's own key must NOT verify —
+    the proof key is bound to the claimed issuer."""
+    import base64
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    from agentfield_tpu.control_plane.identity import canonical_json, did_key_from_public
+
+    svc = DIDService(b"\x05" * 32)
+    vcs = VCService(svc)
+    vc = vcs.issue_execution_vc(
+        {
+            "execution_id": "e",
+            "run_id": "r",
+            "target": "n.fn",
+            "target_type": "reasoner",
+            "status": "completed",
+        }
+    )
+    attacker = Ed25519PrivateKey.generate()
+    vc["credentialSubject"]["status"] = "failed"
+    body = {k: v for k, v in vc.items() if k != "proof"}
+    vc["proof"]["verificationMethod"] = did_key_from_public(attacker.public_key())
+    vc["proof"]["proofValue"] = (
+        base64.urlsafe_b64encode(attacker.sign(canonical_json(body))).decode().rstrip("=")
+    )
+    ok, reason = VCService.verify(vc)
+    assert not ok and "issuer" in reason

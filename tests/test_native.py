@@ -1,0 +1,50 @@
+"""Native C++ vector scan vs numpy reference."""
+
+import numpy as np
+import pytest
+
+from agentfield_tpu.native import native_available, vector_scan_topk
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable (no toolchain?)"
+)
+
+
+def _ref_scores(m, q, metric):
+    if metric == "cosine":
+        return (m @ q) / (np.linalg.norm(m, axis=1) * (np.linalg.norm(q) + 1e-12) + 1e-12)
+    if metric == "dot":
+        return m @ q
+    return -np.linalg.norm(m - q, axis=1)
+
+
+@pytest.mark.parametrize("metric", ["cosine", "dot", "l2"])
+def test_native_matches_numpy(metric):
+    rng = np.random.default_rng(0)
+    m = rng.standard_normal((500, 64), dtype=np.float32)
+    q = rng.standard_normal((64,), dtype=np.float32)
+    idxs, scores = vector_scan_topk(m, q, metric=metric, k=10)
+    ref = _ref_scores(m, q, metric)
+    ref_order = np.argsort(-ref)[:10]
+    assert list(idxs) == list(ref_order)
+    np.testing.assert_allclose(scores, ref[ref_order], rtol=1e-4, atol=1e-4)
+
+
+def test_native_edge_cases():
+    m = np.zeros((0, 8), np.float32)
+    idxs, scores = vector_scan_topk(m, np.zeros(8, np.float32), k=5)
+    assert len(idxs) == 0
+    m = np.ones((3, 8), np.float32)
+    idxs, scores = vector_scan_topk(m, np.ones(8, np.float32), k=10)  # k > n
+    assert len(idxs) == 3
+
+
+def test_storage_uses_native(tmp_path):
+    from agentfield_tpu.control_plane.storage import SQLiteStorage
+
+    st = SQLiteStorage(str(tmp_path / "v.db"))
+    st.vector_set("global", "", "a", [1.0, 0.0], {"m": 1})
+    st.vector_set("global", "", "b", [0.0, 1.0], {"m": 2})
+    res = st.vector_search("global", "", [1.0, 0.1], top_k=1)
+    assert res[0]["key"] == "a"
+    st.close()

@@ -92,7 +92,17 @@ class Keystore:
 
     def __init__(self, path: str | Path, passphrase: str | None = None):
         if passphrase is None:
-            passphrase = os.environ.get("AGENTFIELD_KEYSTORE_PASSPHRASE", self.DEV_PASSPHRASE)
+            passphrase = os.environ.get("AGENTFIELD_KEYSTORE_PASSPHRASE")
+        if passphrase is None:
+            import sys
+
+            print(
+                "[agentfield] WARNING: keystore sealed with the PUBLIC dev "
+                "passphrase — set server.keystore_passphrase or "
+                "AGENTFIELD_KEYSTORE_PASSPHRASE before trusting any VC",
+                file=sys.stderr,
+            )
+            passphrase = self.DEV_PASSPHRASE
         self.path = Path(os.path.expanduser(str(path)))
         self._key = HKDF(
             algorithm=hashes.SHA256(), length=32, salt=b"agentfield-keystore", info=b"seal"

@@ -88,6 +88,7 @@ class ControlPlane:
         self.stale_after = stale_after
         self.retention = retention
         self._cleanup_task: asyncio.Task | None = None
+        self._native_build_task: asyncio.Task | None = None
         self._started = False
 
     async def start(self) -> None:
@@ -98,10 +99,11 @@ class ControlPlane:
         await self.registry.start()
         await self.webhooks.start()
         self._cleanup_task = asyncio.create_task(self._cleanup_loop())
-        # Native scan kernel compiles off-loop; requests use numpy until ready.
+        # Native scan kernel compiles off-loop; requests use numpy until
+        # ready. Keep a strong reference (loop tasks are weakly held).
         from agentfield_tpu import native
 
-        asyncio.create_task(asyncio.to_thread(native.build))
+        self._native_build_task = asyncio.create_task(asyncio.to_thread(native.build))
 
     async def stop(self) -> None:
         if not self._started:
@@ -110,6 +112,9 @@ class ControlPlane:
         if self._cleanup_task:
             self._cleanup_task.cancel()
             await asyncio.gather(self._cleanup_task, return_exceptions=True)
+        if self._native_build_task:
+            self._native_build_task.cancel()
+            await asyncio.gather(self._native_build_task, return_exceptions=True)
         await self.webhooks.stop()
         await self.registry.stop()
         await self.gateway.stop()
@@ -396,14 +401,10 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def workflow_vc_chain(req: web.Request):
         # Paginate to completeness: an org-SIGNED chain must never silently
         # attest a truncated run.
+        # One SQL statement = one snapshot: offset pagination could skip or
+        # duplicate rows while the run mutates, and a signed chain must not.
         run_id = req.match_info["run_id"]
-        exs, offset = [], 0
-        while True:
-            page = cp.storage.list_executions(run_id=run_id, limit=1000, offset=offset)
-            exs.extend(page)
-            if len(page) < 1000:
-                break
-            offset += 1000
+        exs = cp.storage.list_executions(run_id=run_id, limit=1_000_000)
         if not exs:
             return _json_error(404, "unknown run")
         non_terminal = [e.execution_id for e in exs if not e.status.terminal]

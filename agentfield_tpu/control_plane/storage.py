@@ -192,7 +192,8 @@ class SQLiteStorage:
             args.append(status.value)
         if cond:
             q += " WHERE " + " AND ".join(cond)
-        q += f" ORDER BY created_at {'DESC' if newest_first else 'ASC'} LIMIT ? OFFSET ?"
+        direction = "DESC" if newest_first else "ASC"
+        q += f" ORDER BY created_at {direction}, execution_id {direction} LIMIT ? OFFSET ?"
         args += [limit, offset]
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()

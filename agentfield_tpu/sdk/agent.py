@@ -63,8 +63,36 @@ class ComponentDef:
         self.fn = fn
         self.description = description
         self.input_model, self.input_schema, self.ctx_params = _schema_from_signature(fn)
+        self._passthrough = False
+
+    @classmethod
+    def passthrough(
+        cls, id: str, kind: str, handler: Callable, description: str, input_schema: dict
+    ) -> "ComponentDef":
+        """Component with an externally-supplied JSON schema whose handler
+        receives the raw payload dict (MCP tools: the server owns validation)."""
+        comp = object.__new__(cls)
+        comp.id = id
+        comp.kind = kind
+        comp.fn = handler
+        comp.description = description
+        comp.input_model = None
+        comp.input_schema = input_schema
+        comp.ctx_params = []
+        comp._passthrough = True
+        return comp
 
     async def invoke(self, payload: Any, ctx: "ExecutionContext | None" = None) -> Any:
+        if self._passthrough:
+            if payload is not None and not isinstance(payload, dict):
+                raise TypeError(
+                    f"{self.id}: payload must be a JSON object of tool arguments, "
+                    f"got {type(payload).__name__}"
+                )
+            result = self.fn(payload or {})
+            if inspect.isawaitable(result):
+                result = await result
+            return result
         if isinstance(payload, dict):
             kwargs = dict(self.input_model(**payload))
         elif payload is None:
@@ -286,12 +314,23 @@ class Agent:
         top_p: float = 1.0,
         stop_token_ids: list[int] | None = None,
         timeout: float = 600.0,
+        schema: dict[str, Any] | None = None,
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
         active model node (or `model` node id, used directly — the gateway
         validates it); the placement scheduler arrives with multi-node
-        support."""
+        support.
+
+        With `schema` (a JSON schema), the prompt gains a strict-JSON
+        instruction and the decoded text is parsed+validated; the result dict
+        gains a "parsed" key (sdk/structured.py)."""
+        if schema is not None:
+            if prompt is None:
+                raise ValueError("schema requires a text prompt")
+            from agentfield_tpu.sdk.structured import schema_instruction
+
+            prompt = prompt + schema_instruction(schema)
         node_id = model if model is not None else (await self._resolve_model_node(None))["node_id"]
         payload = {
             "prompt": prompt,
@@ -310,7 +349,12 @@ class Agent:
         )
         if doc["status"] != "completed":
             raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
-        return doc["result"]
+        result = doc["result"]
+        if schema is not None:
+            from agentfield_tpu.sdk.structured import parse_structured
+
+            result["parsed"] = parse_structured(result.get("text", ""), schema)
+        return result
 
     async def ai_stream(
         self,

@@ -1,0 +1,214 @@
+"""MCP (Model Context Protocol) integration.
+
+Capability parity with the reference's MCP stack — stdio servers spoken to
+over JSON-RPC (sdk/python/agentfield/mcp_stdio_bridge.py:24), client with
+initialize/tools-list/tools-call (mcp_client.py:9), config discovery from
+.mcp.json (mcp_manager.py:42), and every discovered tool auto-registered as
+an agent skill (dynamic_skills.py:33) — condensed: asyncio subprocesses speak
+newline-delimited JSON-RPC directly (no local HTTP bridge process needed; the
+reference's bridge exists because its stack was threaded FastAPI).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+from typing import Any
+
+
+class MCPError(Exception):
+    pass
+
+
+class MCPStdioClient:
+    """JSON-RPC 2.0 over a child process's stdio (MCP stdio transport:
+    one JSON message per line). Request ids correlate concurrent calls."""
+
+    def __init__(self, command: str, args: list[str] | None = None, env: dict | None = None):
+        self.command = command
+        self.args = args or []
+        self.env = env
+        self._proc: asyncio.subprocess.Process | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._reader: asyncio.Task | None = None
+        self.server_info: dict[str, Any] = {}
+
+    async def start(self) -> None:
+        import os
+
+        self._proc = await asyncio.create_subprocess_exec(
+            self.command,
+            *self.args,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+            env={**os.environ, **(self.env or {})},
+        )
+        self._reader = asyncio.create_task(self._read_loop())
+        init = await self.request(
+            "initialize",
+            {
+                "protocolVersion": "2024-11-05",
+                "clientInfo": {"name": "agentfield_tpu", "version": "0.1"},
+                "capabilities": {},
+            },
+        )
+        self.server_info = init.get("serverInfo", {})
+        await self.notify("notifications/initialized", {})
+
+    async def stop(self) -> None:
+        if self._reader:
+            self._reader.cancel()
+            await asyncio.gather(self._reader, return_exceptions=True)
+        if self._proc and self._proc.returncode is None:
+            self._proc.terminate()
+            try:
+                async with asyncio.timeout(5):
+                    await self._proc.wait()
+            except TimeoutError:
+                self._proc.kill()
+                await self._proc.wait()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(MCPError("server stopped"))
+        self._pending.clear()
+
+    async def _read_loop(self) -> None:
+        assert self._proc and self._proc.stdout
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(MCPError("server closed stdout"))
+                self._pending.clear()
+                return
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # non-protocol noise on stdout
+            fut = self._pending.pop(msg.get("id"), None)
+            if fut is None or fut.done():
+                continue
+            if "error" in msg:
+                fut.set_exception(MCPError(str(msg["error"])))
+            else:
+                fut.set_result(msg.get("result"))
+
+    async def _send(self, msg: dict[str, Any]) -> None:
+        assert self._proc and self._proc.stdin
+        self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
+        await self._proc.stdin.drain()
+
+    async def request(self, method: str, params: Any = None, timeout: float = 30.0) -> Any:
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send(
+                {"jsonrpc": "2.0", "id": rid, "method": method, "params": params or {}}
+            )
+            async with asyncio.timeout(timeout):
+                return await fut
+        finally:
+            self._pending.pop(rid, None)  # timed-out futures must not accumulate
+
+    async def notify(self, method: str, params: Any = None) -> None:
+        await self._send({"jsonrpc": "2.0", "method": method, "params": params or {}})
+
+    async def list_tools(self) -> list[dict[str, Any]]:
+        return (await self.request("tools/list")).get("tools", [])
+
+    async def call_tool(self, name: str, arguments: dict[str, Any]) -> Any:
+        result = await self.request("tools/call", {"name": name, "arguments": arguments})
+        # Per MCP spec, tool-level failures come back as a RESULT with
+        # isError=true (not a JSON-RPC error) — they must not masquerade as
+        # successful outputs.
+        if isinstance(result, dict) and result.get("isError"):
+            raise MCPError(f"tool {name!r} failed: {result.get('content')}")
+        # Unwrap MCP content envelopes to plain values where trivial.
+        content = result.get("content") if isinstance(result, dict) else None
+        if isinstance(content, list) and len(content) == 1 and content[0].get("type") == "text":
+            return content[0]["text"]
+        return result
+
+
+class MCPManager:
+    """Start/stop configured MCP servers and expose their tools as agent
+    skills (the tool's own inputSchema becomes the skill schema; invocation
+    forwards raw arguments)."""
+
+    def __init__(self, config: dict[str, Any] | None = None):
+        self.config = config or {}
+        self.clients: dict[str, MCPStdioClient] = {}
+        self.tools: dict[str, list[dict[str, Any]]] = {}
+
+    @staticmethod
+    def discover_config(project_dir: str | Path = ".") -> dict[str, Any]:
+        """Read .mcp.json ({"mcpServers": {name: {command, args, env}}}) —
+        the same file the reference SDK discovers (mcp_manager.py:42)."""
+        p = Path(project_dir) / ".mcp.json"
+        if not p.exists():
+            return {}
+        doc = json.loads(p.read_text())
+        return doc.get("mcpServers", {})
+
+    async def start_all(self) -> None:
+        for name, spec in self.config.items():
+            client = MCPStdioClient(
+                spec["command"], spec.get("args", []), spec.get("env")
+            )
+            try:
+                await client.start()
+                self.clients[name] = client
+                self.tools[name] = await client.list_tools()
+            except Exception:
+                await client.stop()  # never leak a half-started subprocess
+                raise
+
+    async def stop_all(self) -> None:
+        for client in self.clients.values():
+            await client.stop()
+        self.clients.clear()
+
+    def health(self) -> dict[str, Any]:
+        return {
+            name: {
+                "alive": c._proc is not None and c._proc.returncode is None,
+                "tools": len(self.tools.get(name, [])),
+                "server_info": c.server_info,
+            }
+            for name, c in self.clients.items()
+        }
+
+    def attach_to_agent(self, agent) -> list[str]:
+        """Register every discovered tool as `<server>_<tool>` skill on the
+        agent (reference: DynamicMCPSkillManager.discover_and_register_all_
+        skills, dynamic_skills.py:33). Returns the registered skill ids."""
+        from agentfield_tpu.sdk.agent import ComponentDef
+
+        registered = []
+        for server, tools in self.tools.items():
+            client = self.clients[server]
+            for tool in tools:
+                sid = f"{server}_{tool['name']}"
+
+                def make_handler(c: MCPStdioClient, tname: str):
+                    async def handler(payload):
+                        return await c.call_tool(tname, payload or {})
+
+                    return handler
+
+                comp = ComponentDef.passthrough(
+                    id=sid,
+                    kind="skill",
+                    handler=make_handler(client, tool["name"]),
+                    description=tool.get("description", f"MCP tool {tool['name']} ({server})"),
+                    input_schema=tool.get("inputSchema", {}),
+                )
+                agent._add_component(comp)
+                registered.append(sid)
+        return registered

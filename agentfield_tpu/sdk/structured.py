@@ -1,0 +1,80 @@
+"""Structured output for ai(): schema-instructed generation + robust parse.
+
+Parity with the reference's approach (agent_ai.py:221-245 injects a
+strict-JSON system instruction; :424-447 parses with a regex fallback), with
+two differences: parsing here is a real balanced-brace scanner rather than a
+regex, and results validate against the JSON schema (jsonschema). True
+constrained decoding (schema → token masking in the sampler) is the planned
+replacement on the TPU path — the engine's sampler already takes per-request
+masks conceptually; this module is the API-stable front for both.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jsonschema
+
+
+class StructuredOutputError(ValueError):
+    pass
+
+
+def schema_instruction(schema: dict[str, Any]) -> str:
+    return (
+        "\n\nRespond ONLY with a single JSON object that validates against "
+        f"this JSON schema, with no surrounding prose:\n{json.dumps(schema)}\nJSON:"
+    )
+
+
+def extract_json(text: str) -> Any:
+    """Parse the first complete JSON value in `text`: strict parse first, then
+    a balanced-delimiter scan (handles strings/escapes) for embedded objects."""
+    text = text.strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    for open_ch, close_ch in (("{", "}"), ("[", "]")):
+        start = text.find(open_ch)
+        while start != -1:
+            depth = 0
+            in_str = False
+            escape = False
+            for i in range(start, len(text)):
+                ch = text[i]
+                if escape:
+                    escape = False
+                    continue
+                if ch == "\\":
+                    escape = in_str
+                    continue
+                if ch == '"':
+                    in_str = not in_str
+                    continue
+                if in_str:
+                    continue
+                if ch == open_ch:
+                    depth += 1
+                elif ch == close_ch:
+                    depth -= 1
+                    if depth == 0:
+                        try:
+                            return json.loads(text[start : i + 1])
+                        except json.JSONDecodeError:
+                            break
+            start = text.find(open_ch, start + 1)
+    raise StructuredOutputError(f"no JSON value found in model output: {text[:200]!r}")
+
+
+def parse_structured(text: str, schema: dict[str, Any] | None = None) -> Any:
+    obj = extract_json(text)
+    if schema is not None:
+        try:
+            jsonschema.validate(obj, schema)
+        except jsonschema.ValidationError as e:
+            raise StructuredOutputError(
+                f"model output does not match schema: {e.message}"
+            ) from None
+    return obj

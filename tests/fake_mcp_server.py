@@ -1,0 +1,63 @@
+"""A minimal MCP stdio server for tests: newline-delimited JSON-RPC with
+initialize, tools/list (add + shout), tools/call."""
+
+import json
+import sys
+
+TOOLS = [
+    {
+        "name": "add",
+        "description": "Add two integers",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+            "required": ["a", "b"],
+        },
+    },
+    {
+        "name": "shout",
+        "description": "Uppercase a string",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}},
+            "required": ["text"],
+        },
+    },
+]
+
+
+def handle(msg):
+    method = msg.get("method")
+    if method == "initialize":
+        return {
+            "protocolVersion": "2024-11-05",
+            "serverInfo": {"name": "fake-mcp", "version": "1.0"},
+            "capabilities": {"tools": {}},
+        }
+    if method == "tools/list":
+        return {"tools": TOOLS}
+    if method == "tools/call":
+        name = msg["params"]["name"]
+        args = msg["params"].get("arguments", {})
+        if name == "add":
+            return {"content": [{"type": "text", "text": str(args["a"] + args["b"])}]}
+        if name == "shout":
+            return {"content": [{"type": "text", "text": args["text"].upper()}]}
+        raise ValueError(f"unknown tool {name}")
+    return None
+
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    msg = json.loads(line)
+    if "id" not in msg:
+        continue  # notification
+    try:
+        result = handle(msg)
+        out = {"jsonrpc": "2.0", "id": msg["id"], "result": result}
+    except Exception as e:
+        out = {"jsonrpc": "2.0", "id": msg["id"], "error": {"code": -32000, "message": str(e)}}
+    sys.stdout.write(json.dumps(out) + "\n")
+    sys.stdout.flush()

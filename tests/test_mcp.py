@@ -1,0 +1,66 @@
+"""MCP integration: stdio JSON-RPC client, manager, dynamic tool→skill
+registration, and a full gateway round-trip through an MCP skill."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from agentfield_tpu.sdk import Agent
+from agentfield_tpu.sdk.mcp import MCPError, MCPManager, MCPStdioClient
+from tests.helpers_cp import CPHarness, async_test
+
+FAKE = str(Path(__file__).parent / "fake_mcp_server.py")
+SPEC = {"fake": {"command": sys.executable, "args": [FAKE]}}
+
+
+@async_test
+async def test_stdio_client_lifecycle():
+    c = MCPStdioClient(sys.executable, [FAKE])
+    await c.start()
+    try:
+        assert c.server_info["name"] == "fake-mcp"
+        tools = await c.list_tools()
+        assert {t["name"] for t in tools} == {"add", "shout"}
+        assert await c.call_tool("add", {"a": 2, "b": 40}) == "42"
+        assert await c.call_tool("shout", {"text": "hey"}) == "HEY"
+        with pytest.raises(MCPError):
+            await c.call_tool("missing", {})
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_manager_and_dynamic_skills_through_gateway():
+    async with CPHarness() as h:
+        app = Agent("mcpagent", h.base_url)
+        mgr = MCPManager(SPEC)
+        await mgr.start_all()
+        try:
+            skills = mgr.attach_to_agent(app)
+            assert skills == ["fake_add", "fake_shout"]
+            await app.start()
+            # the MCP tool schema is advertised on the node
+            spec = app._node_spec()
+            add = [s for s in spec["skills"] if s["id"] == "fake_add"][0]
+            assert add["input_schema"]["required"] == ["a", "b"]
+            # full round-trip: gateway → agent → MCP server → back
+            async with h.http.post(
+                "/api/v1/execute/mcpagent.fake_add", json={"input": {"a": 3, "b": 4}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed" and doc["result"] == "7"
+            assert mgr.health()["fake"]["alive"]
+        finally:
+            await app.stop()
+            await mgr.stop_all()
+
+
+def test_discover_config(tmp_path):
+    (tmp_path / ".mcp.json").write_text(
+        json.dumps({"mcpServers": {"x": {"command": "foo", "args": ["--bar"]}}})
+    )
+    cfg = MCPManager.discover_config(tmp_path)
+    assert cfg == {"x": {"command": "foo", "args": ["--bar"]}}
+    assert MCPManager.discover_config(tmp_path / "nope") == {}

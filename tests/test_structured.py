@@ -1,0 +1,52 @@
+import pytest
+
+from agentfield_tpu.sdk.structured import (
+    StructuredOutputError,
+    extract_json,
+    parse_structured,
+    schema_instruction,
+)
+
+SCHEMA = {
+    "type": "object",
+    "properties": {"name": {"type": "string"}, "n": {"type": "integer"}},
+    "required": ["name"],
+}
+
+
+def test_extract_strict():
+    assert extract_json('{"a": 1}') == {"a": 1}
+    assert extract_json("[1, 2]") == [1, 2]
+
+
+def test_extract_embedded_with_prose():
+    text = 'Sure! Here is the answer:\n{"name": "x", "n": 3}\nHope that helps.'
+    assert extract_json(text) == {"name": "x", "n": 3}
+
+
+def test_extract_nested_and_strings_with_braces():
+    text = 'junk {"a": {"b": "close} brace in string", "c": [1, {"d": 2}]}} tail'
+    assert extract_json(text) == {"a": {"b": "close} brace in string", "c": [1, {"d": 2}]}}
+
+
+def test_extract_skips_broken_then_finds_valid():
+    text = "{not json} but then {\"ok\": true}"
+    assert extract_json(text) == {"ok": True}
+
+
+def test_extract_none_raises():
+    with pytest.raises(StructuredOutputError, match="no JSON"):
+        extract_json("there is nothing here")
+
+
+def test_validation():
+    assert parse_structured('{"name": "a", "n": 1}', SCHEMA) == {"name": "a", "n": 1}
+    with pytest.raises(StructuredOutputError, match="schema"):
+        parse_structured('{"n": 1}', SCHEMA)  # missing required name
+    with pytest.raises(StructuredOutputError, match="schema"):
+        parse_structured('{"name": "a", "n": "NaN"}', SCHEMA)
+
+
+def test_instruction_mentions_schema():
+    ins = schema_instruction(SCHEMA)
+    assert "JSON schema" in ins and '"name"' in ins

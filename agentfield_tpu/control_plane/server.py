@@ -404,7 +404,11 @@ def create_app(cp: ControlPlane) -> web.Application:
         # One SQL statement = one snapshot: offset pagination could skip or
         # duplicate rows while the run mutates, and a signed chain must not.
         run_id = req.match_info["run_id"]
-        exs = cp.storage.list_executions(run_id=run_id, limit=1_000_000)
+        limit = 1_000_000
+        exs = cp.storage.list_executions(run_id=run_id, limit=limit)
+        if len(exs) == limit:
+            # Refuse rather than org-sign a possibly-truncated chain.
+            return _json_error(413, f"run exceeds {limit} executions; chain refused")
         if not exs:
             return _json_error(404, "unknown run")
         non_terminal = [e.execution_id for e in exs if not e.status.terminal]

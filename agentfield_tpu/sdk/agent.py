@@ -332,6 +332,7 @@ class Agent:
 
             prompt = prompt + schema_instruction(schema)
         node_id = model if model is not None else (await self._resolve_model_node(None))["node_id"]
+        ctx = current_context()
         payload = {
             "prompt": prompt,
             "tokens": tokens,
@@ -340,6 +341,8 @@ class Agent:
             "top_k": top_k,
             "top_p": top_p,
             "stop_token_ids": stop_token_ids or [],
+            # Session affinity → model-node prefix-cache reuse across turns.
+            "session_id": ctx.session_id if ctx else None,
         }
         doc = await self.client.execute(
             f"{node_id}.generate",

@@ -45,6 +45,8 @@ class MCPStdioClient:
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.DEVNULL,
             env={**os.environ, **(self.env or {})},
+            limit=16 * 1024 * 1024,  # tool results can be one very long line;
+            # the 64KiB default would kill readline()
         )
         self._reader = asyncio.create_task(self._read_loop())
         init = await self.request(
@@ -78,7 +80,16 @@ class MCPStdioClient:
     async def _read_loop(self) -> None:
         assert self._proc and self._proc.stdout
         while True:
-            line = await self._proc.stdout.readline()
+            try:
+                line = await self._proc.stdout.readline()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # oversized line / broken pipe: fail loudly
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(MCPError(f"stdio read failed: {e!r}"))
+                self._pending.clear()
+                return
             if not line:
                 for fut in self._pending.values():
                     if not fut.done():
@@ -166,7 +177,8 @@ class MCPManager:
                 self.clients[name] = client
                 self.tools[name] = await client.list_tools()
             except Exception:
-                await client.stop()  # never leak a half-started subprocess
+                await client.stop()  # the failing one...
+                await self.stop_all()  # ...and every server started before it
                 raise
 
     async def stop_all(self) -> None:

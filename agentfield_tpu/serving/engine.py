@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import time
 from typing import Any
 
 import jax
@@ -52,6 +53,7 @@ class EngineConfig:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
     attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
     prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas)
+    enable_prefix_cache: bool = True  # retain session KV across turns
     dtype: str | None = None
 
     @property
@@ -70,6 +72,10 @@ class Request:
     id: str
     prompt: list[int]
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    # Session affinity for prefix-cache reuse (north-star config 4: agent→
+    # agent call chains share KV). Conversations grow monotonically, so a
+    # session's cached tokens are always a prefix of the next prompt.
+    session_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -88,6 +94,15 @@ class _Slot:
     length: int  # tokens whose K/V are (or will be) cached, incl. pending last token
     generated: int
     last_token: int
+    tokens: list[int] = dataclasses.field(default_factory=list)  # full history
+    # (prompt + generated) — retained for session prefix caching
+
+
+@dataclasses.dataclass
+class _SessionEntry:
+    pages: list[int]
+    tokens: list[int]  # tokens whose KV is resident (prompt + generated[:-1])
+    last_used: float
 
 
 @functools.lru_cache(maxsize=None)
@@ -153,6 +168,48 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+@functools.lru_cache(maxsize=None)
+def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
+    """Prefill `n_new` suffix tokens starting at absolute position `start`,
+    attending over the session's CACHED pages as well as the freshly written
+    ones (prefix-cache hit path: only the suffix pays prefill FLOPs)."""
+    ps = ecfg.page_size
+    maxp = ecfg.max_pages_per_seq
+    T = maxp * ps
+
+    def prefill(params, k_pages, v_pages, tokens, start, n_new, page_table_row):
+        positions = (start + jnp.arange(bucket, dtype=jnp.int32))[None]  # [1, B]
+        x = jnp.take(params["embed"], tokens, axis=0)
+        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+        pos = positions[0]
+        rel = jnp.arange(bucket, dtype=jnp.int32)
+        in_range = rel < n_new
+        page_ids = jnp.where(in_range, page_table_row[(pos // ps) % maxp], 0)
+        slot_ids = pos % ps
+        k_pos = jnp.arange(T, dtype=jnp.int32)[None]
+        k_valid = k_pos < (start + n_new)
+
+        def body(x, xs):
+            lp, kp, vp = xs
+            h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
+            kp = kp.at[page_ids, slot_ids].set(k[0])
+            vp = vp.at[page_ids, slot_ids].set(v[0])
+            kk = kp[page_table_row].reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+            vv = vp[page_table_row].reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+            attn = llama.attention_ref(q, kk, vv, positions, k_pos, k_valid)
+            x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
+            x = x + llama.mlp_block(lp, x, cfg)
+            return x, (kp, vp)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+        logits = llama.unembed(params, cfg, x)
+        last = logits[0, n_new - 1]
+        return last, kp, vp
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
 class QueueFullError(Exception):
     """Admission queue at capacity — surfaced as backpressure (the reference
     returns HTTP 503 from the async gateway, execute.go:333-346)."""
@@ -210,6 +267,7 @@ class InferenceEngine:
         self.top_ps = np.ones((B,), np.float32)
         self.slots: list[_Slot | None] = [None] * B
         self.pending: collections.deque[Request] = collections.deque()
+        self._sessions: dict[str, _SessionEntry] = {}
         self._rng = jax.random.PRNGKey(seed)
         self._decode_jit = _decode_fn(cfg, self.ecfg)
         # Device-resident copies of the control arrays; refreshed from the
@@ -224,6 +282,9 @@ class InferenceEngine:
             "decode_steps": 0,
             "requests_finished": 0,
             "backpressure_total": 0,
+            "prefix_cache_hits": 0,
+            "prefix_tokens_reused": 0,
+            "sessions_evicted": 0,
         }
 
     # ------------------------------------------------------------------
@@ -251,6 +312,14 @@ class InferenceEngine:
         total = len(req.prompt) + req.sampling.max_new_tokens
         return -(-total // self.ecfg.page_size)
 
+    def free_session(self, session_id: str) -> bool:
+        """Explicitly drop a session's cached prefix."""
+        sess = self._sessions.pop(session_id, None)
+        if sess is None:
+            return False
+        self.allocator.free(sess.pages)
+        return True
+
     @property
     def num_active(self) -> int:
         return sum(s is not None for s in self.slots)
@@ -262,38 +331,91 @@ class InferenceEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _alloc_with_eviction(self, n: int) -> list[int] | None:
+        """Allocate n pages, evicting LRU idle sessions if needed (cached
+        prefixes are a best-effort optimization; live requests win)."""
+        pages = self.allocator.alloc(n)
+        while pages is None and self._sessions:
+            lru_sid = min(self._sessions, key=lambda s: self._sessions[s].last_used)
+            self.allocator.free(self._sessions.pop(lru_sid).pages)
+            self.stats["sessions_evicted"] += 1
+            pages = self.allocator.alloc(n)
+        return pages
+
+    def _session_hit(self, req: Request) -> _SessionEntry | None:
+        if not req.session_id or not self.ecfg.enable_prefix_cache:
+            return None
+        sess = self._sessions.get(req.session_id)
+        if sess is None:
+            return None
+        cl = len(sess.tokens)
+        if 0 < cl < len(req.prompt) and req.prompt[:cl] == sess.tokens:
+            return sess
+        # Mismatched history (edited conversation, collision): drop the entry.
+        self.allocator.free(self._sessions.pop(req.session_id).pages)
+        return None
+
     def _try_admit(self) -> list[TokenEvent]:
-        """Admit one pending request: allocate pages, prefill, sample first
-        token. Returns its first TokenEvent (possibly already finished)."""
+        """Admit one pending request: allocate pages, prefill (full, or only
+        the suffix on a session prefix-cache hit), sample the first token."""
         if not self.pending:
             return []
         free_slot = next((i for i, s in enumerate(self.slots) if s is None), None)
         if free_slot is None:
             return []
         req = self.pending[0]
-        pages = self.allocator.alloc(self._pages_needed(req))
-        if pages is None:
-            # Page-starved: stay pending; decode steps will free pages.
-            # (Not counted as backpressure — that counter mirrors per-request
-            # queue-full rejections, the reference's 503 analogue.)
-            return []
+        sess = self._session_hit(req)
+        total_pages = self._pages_needed(req)
+
+        if sess is not None:
+            # Claim the session FIRST: the eviction loop below must never be
+            # able to free the very pages we are about to reuse.
+            self._sessions.pop(req.session_id, None)
+            extra_needed = total_pages - len(sess.pages)
+            extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
+            if extra is None:
+                self._sessions[req.session_id] = sess  # restore; retry later
+                return []  # page-starved; decode will free pages
+            pages = sess.pages + extra
+            start = len(sess.tokens)
+            suffix = req.prompt[start:]
+        else:
+            pages = self._alloc_with_eviction(total_pages)
+            if pages is None:
+                return []
+            start = 0
+            suffix = req.prompt
         self.pending.popleft()
 
-        prompt = np.asarray(req.prompt, np.int32)
-        bucket = self.ecfg.prefill_bucket(len(prompt))
+        suffix_arr = np.asarray(suffix, np.int32)
+        bucket = self.ecfg.prefill_bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prompt)] = prompt
+        padded[0, : len(suffix)] = suffix_arr
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
 
-        fn = _prefill_fn(self.cfg, self.ecfg, bucket)
-        last_logits, self.cache.k_pages, self.cache.v_pages = fn(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            jnp.asarray(padded),
-            jnp.int32(len(prompt)),
-            jnp.asarray(row),
-        )
+        if start > 0:
+            fn = _suffix_prefill_fn(self.cfg, self.ecfg, bucket)
+            last_logits, self.cache.k_pages, self.cache.v_pages = fn(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(padded),
+                jnp.int32(start),
+                jnp.int32(len(suffix)),
+                jnp.asarray(row),
+            )
+            self.stats["prefix_cache_hits"] += 1
+            self.stats["prefix_tokens_reused"] += start
+        else:
+            fn = _prefill_fn(self.cfg, self.ecfg, bucket)
+            last_logits, self.cache.k_pages, self.cache.v_pages = fn(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(padded),
+                jnp.int32(len(suffix)),
+                jnp.asarray(row),
+            )
         s = req.sampling
         tok = int(
             sample_tokens(
@@ -304,9 +426,16 @@ class InferenceEngine:
                 jnp.asarray([s.top_p], jnp.float32),
             )[0]
         )
-        self.stats["prefill_tokens"] += len(prompt)
+        self.stats["prefill_tokens"] += len(suffix)
 
-        slot = _Slot(req=req, pages=pages, length=len(prompt), generated=1, last_token=tok)
+        slot = _Slot(
+            req=req,
+            pages=pages,
+            length=len(req.prompt),
+            generated=1,
+            last_token=tok,
+            tokens=list(req.prompt) + [tok],
+        )
         event = self._emit(free_slot, slot, tok)
         if not event.finished:
             self.slots[free_slot] = slot
@@ -338,7 +467,19 @@ class InferenceEngine:
         return ev
 
     def _release(self, slot_idx: int, slot: _Slot) -> None:
-        self.allocator.free(slot.pages)
+        sid = slot.req.session_id
+        if sid and self.ecfg.enable_prefix_cache and len(slot.tokens) > 1:
+            # Retain the KV for the next turn. The last generated token's KV
+            # was never written (it is returned, not fed back), so the cached
+            # prefix is tokens[:-1].
+            old = self._sessions.pop(sid, None)
+            if old is not None:
+                self.allocator.free(old.pages)
+            self._sessions[sid] = _SessionEntry(
+                pages=slot.pages, tokens=slot.tokens[:-1], last_used=time.time()
+            )
+        else:
+            self.allocator.free(slot.pages)
         self.stats["requests_finished"] += 1
         if self.slots[slot_idx] is slot:
             self.slots[slot_idx] = None
@@ -392,6 +533,7 @@ class InferenceEngine:
             slot.generated += 1
             tok = int(next_np[i])
             slot.last_token = tok
+            slot.tokens.append(tok)
             self.seq_lens[i] = slot.length
             self.last_tokens[i] = tok
             self.stats["decode_tokens"] += 1

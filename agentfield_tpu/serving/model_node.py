@@ -33,7 +33,12 @@ from agentfield_tpu.sdk.agent import Agent
 
 class ByteTokenizer:
     """Trivial byte-level tokenizer for demos/tests with random-weight models
-    (real checkpoints use the HF tokenizer adapter)."""
+    (real checkpoints use the HF tokenizer adapter).
+
+    Caveat: decode(encode(x)) is lossy for ids >= 256, so TEXT-level
+    multi-turn prompts won't prefix-match the session KV cache through this
+    tokenizer — pass `tokens` for session reuse in demos (real tokenizers
+    round-trip their own output)."""
 
     def __init__(self, vocab_size: int):
         self.vocab_size = vocab_size
@@ -171,6 +176,7 @@ class ModelBackend:
         stop_token_ids: list[int] | None,
         register,  # rid -> None; registers the completion sink before submit
         unregister,  # rid -> None; rollback on submit failure
+        session_id: str | None = None,
     ) -> str:
         """Shared tokenize/validate/submit path for both completion styles."""
         if tokens is None:
@@ -194,6 +200,7 @@ class ModelBackend:
                         max_new_tokens=max_new_tokens,
                         stop_token_ids=tuple(stop_token_ids or ()),
                     ),
+                    session_id=session_id,
                 )
             )
         except Exception:
@@ -211,6 +218,7 @@ class ModelBackend:
         top_k: int = 0,
         top_p: float = 1.0,
         stop_token_ids: list[int] | None = None,
+        session_id: str | None = None,
     ) -> dict[str, Any]:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._submit(
@@ -223,6 +231,7 @@ class ModelBackend:
             stop_token_ids,
             register=lambda rid: self._futures.__setitem__(rid, fut),
             unregister=lambda rid: self._futures.pop(rid, None),
+            session_id=session_id,
         )
         result = await fut
         if self.tokenizer is not None:
@@ -365,4 +374,21 @@ def build_model_node(
         return resp
 
     agent.add_route("POST", "/generate/stream", stream_handler)
+
+    async def stats_handler(_req):
+        from aiohttp import web as _web
+
+        eng = backend.engine
+        return _web.json_response(
+            {
+                "model": backend.model_name,
+                **eng.stats,
+                "active_slots": eng.num_active,
+                "pending": len(eng.pending),
+                "free_pages": eng.allocator.free_pages,
+                "cached_sessions": len(eng._sessions),
+            }
+        )
+
+    agent.add_route("GET", "/stats", stats_handler)
     return agent, backend

@@ -1,0 +1,129 @@
+"""Session prefix-cache reuse: multi-turn conversations must produce exactly
+the tokens a fresh engine would, while only prefilling the suffix."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompt(key, n):
+    return jax.random.randint(jax.random.PRNGKey(key), (n,), 0, CFG.vocab_size, jnp.int32).tolist()
+
+
+def _run(engine, rid, prompt, max_new=4, session=None):
+    return engine.run_to_completion(
+        [
+            Request(
+                id=rid,
+                prompt=prompt,
+                sampling=SamplingParams(max_new_tokens=max_new),
+                session_id=session,
+            )
+        ]
+    )[rid]
+
+
+def test_two_turn_session_matches_fresh_engine(params):
+    turn1 = _prompt(1, 6)
+
+    engine = InferenceEngine(params, CFG, ECFG)
+    out1 = _run(engine, "t1", turn1, session="conv")
+    # conversation grows: full history + new user tokens
+    turn2 = turn1 + out1 + _prompt(2, 3)
+    out2 = _run(engine, "t2", turn2, session="conv")
+
+    assert engine.stats["prefix_cache_hits"] == 1
+    assert engine.stats["prefix_tokens_reused"] == len(turn1) + len(out1) - 1
+    # suffix prefill only: total prefilled < full history
+    assert engine.stats["prefill_tokens"] == len(turn1) + (len(turn2) - (len(turn1) + len(out1) - 1))
+
+    fresh = InferenceEngine(params, CFG, ECFG)
+    expected = _run(fresh, "f", turn2)
+    assert out2 == expected, "prefix-cached turn diverged from fresh engine"
+
+
+def test_three_turn_chain(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    history = _prompt(3, 5)
+    for turn in range(3):
+        out = _run(engine, f"t{turn}", history, session="chain")
+        history = history + out + _prompt(10 + turn, 2)
+    assert engine.stats["prefix_cache_hits"] == 2
+    # final turn still correct vs fresh
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert _run(engine, "last", history, session="chain") == _run(fresh, "last", history)
+
+
+def test_session_mismatch_falls_back(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    _run(engine, "a", _prompt(4, 6), session="s")
+    # different conversation under the same session id → full prefill, correct
+    other = _prompt(5, 7)
+    out = _run(engine, "b", other, session="s")
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out == _run(fresh, "b", other)
+    assert engine.stats["prefix_cache_hits"] == 0
+
+
+def test_eviction_under_page_pressure(params):
+    """Cached sessions are evicted LRU when live requests need pages."""
+    ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=9, max_pages_per_seq=8)
+    engine = InferenceEngine(params, CFG, ecfg)  # 8 allocatable pages
+    _run(engine, "a", _prompt(6, 8), max_new=4, session="hog")  # retains 2 pages
+    # a sessionless request needing all 8 pages forces eviction
+    out = _run(engine, "b", _prompt(7, 50), max_new=8)
+    assert len(out) == 8
+    assert engine.stats["sessions_evicted"] == 1
+    assert "hog" not in engine._sessions
+
+
+def test_session_hit_never_self_evicts(params):
+    """A prefix-cache hit whose extra-page allocation triggers eviction must
+    never evict (and corrupt) the session it is reusing."""
+    ecfg = EngineConfig(max_batch=1, page_size=8, num_pages=7, max_pages_per_seq=6)
+    engine = InferenceEngine(params, CFG, ecfg)  # 6 allocatable pages
+    t1 = _prompt(20, 6)
+    out1 = _run(engine, "a", t1, max_new=2, session="only")  # session holds 1 page
+    # turn 2 needs more pages than remain free; "only" is the sole (LRU)
+    # session — eviction must skip it, reuse must stay correct
+    t2 = t1 + out1 + _prompt(21, 8)
+    out2 = _run(engine, "b", t2, max_new=4, session="only")
+    fresh = InferenceEngine(params, CFG, ecfg)
+    assert out2 == _run(fresh, "b", t2, max_new=4)
+    assert engine.stats["prefix_cache_hits"] == 1
+    assert engine.stats["sessions_evicted"] == 0
+
+
+def test_free_session_and_page_accounting(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    _run(engine, "a", _prompt(8, 6), session="s2")
+    held = ECFG.num_pages - 1 - engine.allocator.free_pages
+    assert held > 0  # session retains pages
+    assert engine.free_session("s2")
+    assert not engine.free_session("s2")
+    assert engine.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_disabled_prefix_cache_frees_everything(params):
+    ecfg = dataclasses_replace(ECFG, enable_prefix_cache=False)
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "a", _prompt(9, 6), session="s3")
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+    assert engine._sessions == {}
+
+
+def dataclasses_replace(ecfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(ecfg, **kw)

@@ -1,0 +1,99 @@
+"""Mixture-of-Experts FFN with expert parallelism (the `expert` mesh axis).
+
+Completes the parallelism inventory (SURVEY §2.4 EP row: "only if MoE models
+are added; GSPMD `expert` axis"). Expert weights carry a leading [E, ...]
+axis sharded over ``expert``; each device computes its resident experts for
+all tokens and a psum combines router-weighted outputs — a soft-routing
+formulation (dense compute, exact) whose sharding layout is identical to
+sparse-dispatch MoE; capacity-based top-k token dropping is the planned
+optimization on the same layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentfield_tpu.parallel.mesh import AXIS_EXPERT
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    expert_intermediate: int
+    num_experts: int
+    top_k: int = 2  # router mass concentrates on k experts (soft weights)
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array, dtype=jnp.float32) -> dict[str, Any]:
+    ks = jax.random.split(key, 3)
+    d, f, e = cfg.hidden_size, cfg.expert_intermediate, cfg.num_experts
+    scale = 0.02
+    return {
+        "router": (jax.random.normal(ks[0], (d, e), jnp.float32) * scale).astype(dtype),
+        "w_in": (jax.random.normal(ks[1], (e, d, f), jnp.float32) * scale).astype(dtype),
+        "w_out": (jax.random.normal(ks[2], (e, f, d), jnp.float32) * scale).astype(dtype),
+    }
+
+
+def moe_pspecs() -> dict[str, P]:
+    ex = AXIS_EXPERT
+    return {"router": P(None, None), "w_in": P(ex, None, None), "w_out": P(ex, None, None)}
+
+
+def moe_ffn(params: dict[str, Any], cfg: MoEConfig, x: jax.Array) -> jax.Array:
+    """Reference (single-device) computation. x: [B, S, D] → [B, S, D]."""
+    logits = (x @ params["router"]).astype(jnp.float32)  # [B, S, E]
+    top, idx = jax.lax.top_k(logits, cfg.top_k)
+    mask = jnp.zeros_like(logits).at[
+        jnp.arange(x.shape[0])[:, None, None],
+        jnp.arange(x.shape[1])[None, :, None],
+        idx,
+    ].set(jax.nn.softmax(top, axis=-1))
+    h = jnp.einsum("bsd,edf->besf", x, params["w_in"])
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("besf,efd->besd", h, params["w_out"])
+    return jnp.einsum("besd,bse->bsd", y.astype(jnp.float32), mask).astype(x.dtype)
+
+
+def _moe_local(params, x, cfg: MoEConfig, axis: str):
+    """Per-device body: my expert shard computes for ALL tokens; the router
+    (replicated) masks non-resident experts' weights to zero and a psum
+    combines across the expert axis."""
+    e_local = params["w_in"].shape[0]
+    my_idx = jax.lax.axis_index(axis)
+    logits = (x @ params["router"]).astype(jnp.float32)  # [B, S, E_total]
+    E_total = logits.shape[-1]
+    top, idx = jax.lax.top_k(logits, cfg.top_k)
+    weights = jnp.zeros_like(logits).at[
+        jnp.arange(x.shape[0])[:, None, None],
+        jnp.arange(x.shape[1])[None, :, None],
+        idx,
+    ].set(jax.nn.softmax(top, axis=-1))
+    # Slice my experts' routing weights: experts [my_idx*e_local, ...).
+    my_w = jax.lax.dynamic_slice_in_dim(weights, my_idx * e_local, e_local, axis=2)
+    h = jnp.einsum("bsd,edf->besf", x, params["w_in"])
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("besf,efd->besd", h, params["w_out"])
+    mine = jnp.einsum("besd,bse->bsd", y.astype(jnp.float32), my_w)
+    return jax.lax.psum(mine, axis).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def moe_ffn_sharded(params: dict[str, Any], cfg: MoEConfig, x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Expert-parallel MoE FFN over the `expert` mesh axis."""
+    n = mesh.shape[AXIS_EXPERT]
+    if cfg.num_experts % n:
+        raise ValueError(f"{cfg.num_experts} experts not divisible by expert={n}")
+    fn = jax.shard_map(
+        functools.partial(_moe_local, cfg=cfg, axis=AXIS_EXPERT),
+        mesh=mesh,
+        in_specs=(moe_pspecs(), P()),
+        out_specs=P(),
+    )
+    return fn(params, x)

@@ -51,6 +51,15 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     return Mesh(dev_array, axis_names=names)
 
 
+def to_varying(x, axis_name: str):
+    """Mark a shard_map value as device-varying over `axis_name` (jax 0.9's
+    vma type system needs loop carries pre-marked). pvary→pcast rename compat."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)
+
+
 def use_mesh(mesh: Mesh):
     """Context manager making `mesh` the ambient mesh (jax>=0.9 renamed
     use_mesh → set_mesh; accept either)."""

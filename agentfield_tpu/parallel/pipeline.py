@@ -1,0 +1,134 @@
+"""Pipeline parallelism: GPipe-style stage execution over the `stage` axis.
+
+The transformer's stacked layers split into contiguous stage chunks, each
+resident on one ring position of the ``stage`` mesh axis (DCN-friendly:
+activations cross stages once per microbatch tick, weights never move).
+Microbatches flow through a ``lax.fori_loop`` of clock ticks; activations hop
+stages with ``ppermute``. Autodiff works through the collective (its
+transpose is the reverse permute), so the same function serves training —
+bubble-optimal schedules (1F1B) are a later optimization, correctness and
+memory locality come first (SURVEY §2.4 PP row: stage-sharded layer-scan
+across pods).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.models import llama
+from agentfield_tpu.parallel.mesh import AXIS_STAGE, to_varying
+
+
+def split_layers_for_stages(params, num_stages: int):
+    """Reshape stacked layer leaves [L, ...] → [num_stages, L/num_stages, ...]
+    (the leading stage axis is what shards over `stage`)."""
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible into {num_stages} stages")
+    return jax.tree.map(
+        lambda p: p.reshape(num_stages, L // num_stages, *p.shape[1:]), params["layers"]
+    )
+
+
+def _stage_body(cfg: LlamaConfig, stage_layers, x, positions):
+    """Run this device's chunk of layers over one microbatch activation."""
+
+    def body(x, lp):
+        h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+        q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
+        attn = llama.attention_ref(
+            q, k, v, positions, positions, jnp.ones_like(positions, dtype=bool)
+        )
+        x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
+        x = x + llama.mlp_block(lp, x, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, stage_layers)
+    return x
+
+
+def _pipeline_local(stage_layers, x_micro, positions, cfg: LlamaConfig, axis: str):
+    """Per-device body under shard_map. x_micro: [M, Bm, S, D] microbatches
+    (replicated); stage_layers: this device's [L/S, ...] chunk."""
+    n_stages = jax.lax.psum(1, axis)
+    my_stage = jax.lax.axis_index(axis)
+    M, Bm, S, D = x_micro.shape
+    ticks = M + n_stages - 1
+
+    def tick(t, carry):
+        buf, outputs = carry
+        # Stage 0 injects microbatch t (when in range); others take the buffer
+        # that arrived from the previous stage last tick.
+        m_in = jnp.where(t < M, t, 0)
+        inject = x_micro[m_in]
+        x_in = jnp.where(my_stage == 0, inject, buf)
+        active = (t - my_stage >= 0) & (t - my_stage < M)
+        y = _stage_body(cfg, stage_layers, x_in, positions)
+        y = jnp.where(active, y, x_in)  # idle ticks pass zeros along harmlessly
+        # Last stage emits microbatch (t - n_stages + 1) at this tick.
+        m_out = t - (n_stages - 1)
+        emit = (my_stage == n_stages - 1) & (m_out >= 0)
+        outputs = jax.lax.cond(
+            emit,
+            lambda o: jax.lax.dynamic_update_index_in_dim(o, y, jnp.maximum(m_out, 0), 0),
+            lambda o: o,
+            outputs,
+        )
+        # Rotate activations one stage forward (ring; last→first carries junk
+        # that stage 0 ignores because it always injects).
+        nxt = jax.lax.ppermute(
+            y, axis, [(s, (s + 1) % n_stages) for s in range(n_stages)]
+        )
+        return nxt, outputs
+
+    buf0 = to_varying(jnp.zeros((Bm, S, D), x_micro.dtype), axis)
+    out0 = to_varying(jnp.zeros_like(x_micro), axis)
+    _, outputs = jax.lax.fori_loop(0, ticks, tick, (buf0, out0))
+    # Only the last stage holds real outputs; zero-mask + psum broadcasts them
+    # to every ring position (out_specs replicate over stage).
+    is_last = (my_stage == n_stages - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * is_last, axis)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "num_microbatches"))
+def pipeline_forward(
+    params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S]
+    positions: jax.Array,  # [B, S]
+    mesh: Mesh,
+    num_microbatches: int = 2,
+):
+    """Full forward with the layer stack pipelined over `stage`. Embedding and
+    unembedding run replicated (they are small next to the stack). Returns
+    logits [B, S, V] identical to the dense forward."""
+    n_stages = mesh.shape[AXIS_STAGE]
+    B = tokens.shape[0]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
+    stage_layers = split_layers_for_stages(params, n_stages)
+
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, S, D]
+    Bm = B // num_microbatches
+    x_micro = x.reshape(num_microbatches, Bm, *x.shape[1:])
+    pos_m = positions[:Bm]  # positions identical across microbatches by construction
+
+    fn = jax.shard_map(
+        functools.partial(_pipeline_local, cfg=cfg, axis=AXIS_STAGE),
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(AXIS_STAGE), stage_layers),
+            P(),
+            P(),
+        ),
+        out_specs=P(),
+    )
+    y = fn(stage_layers, x_micro, pos_m)
+    y = y.reshape(B, *y.shape[2:])
+    return llama.unembed(params, cfg, y)

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from agentfield_tpu.parallel.mesh import AXIS_SEQ
+from agentfield_tpu.parallel.mesh import AXIS_SEQ, to_varying
 
 _NEG_INF = -1e30
 
@@ -94,9 +94,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
     # The stats depend on axis_index, so the initial carry must already be
     # marked device-varying for shard_map's vma type system (jax >= 0.9).
-    m0 = jax.lax.pvary(jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, H, Sq, 1), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Sq, H, hd), jnp.float32), axis_name)
+    m0 = to_varying(jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32), axis_name)
+    l0 = to_varying(jnp.zeros((B, H, Sq, 1), jnp.float32), axis_name)
+    acc0 = to_varying(jnp.zeros((B, Sq, H, hd), jnp.float32), axis_name)
     m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
     l = jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)  # [B, Sq, H, 1]
     return (acc / l).astype(q.dtype)

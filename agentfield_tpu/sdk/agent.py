@@ -403,6 +403,7 @@ class Agent:
             "top_k": top_k,
             "top_p": top_p,
             "stop_token_ids": stop_token_ids or [],
+            "session_id": ctx.session_id,
         }
         collected: list[int] = []
         finish_reason = None

@@ -33,6 +33,7 @@ class MCPStdioClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._reader: asyncio.Task | None = None
+        self._dead: str | None = None  # set when the reader exits; fail fast
         self.server_info: dict[str, Any] = {}
 
     async def start(self) -> None:
@@ -85,16 +86,10 @@ class MCPStdioClient:
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # oversized line / broken pipe: fail loudly
-                for fut in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(MCPError(f"stdio read failed: {e!r}"))
-                self._pending.clear()
+                self._fail_all(f"stdio read failed: {e!r}")
                 return
             if not line:
-                for fut in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(MCPError("server closed stdout"))
-                self._pending.clear()
+                self._fail_all("server closed stdout")
                 return
             try:
                 msg = json.loads(line)
@@ -113,7 +108,16 @@ class MCPStdioClient:
         self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
         await self._proc.stdin.drain()
 
+    def _fail_all(self, reason: str) -> None:
+        self._dead = reason  # subsequent requests fail fast, not by timeout
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(MCPError(reason))
+        self._pending.clear()
+
     async def request(self, method: str, params: Any = None, timeout: float = 30.0) -> Any:
+        if self._dead:
+            raise MCPError(f"server connection dead: {self._dead}")
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -185,6 +189,7 @@ class MCPManager:
         for client in self.clients.values():
             await client.stop()
         self.clients.clear()
+        self.tools.clear()  # keep clients/tools consistent for attach/health
 
     def health(self) -> dict[str, Any]:
         return {

@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Any
 
@@ -268,6 +269,10 @@ class InferenceEngine:
         self.slots: list[_Slot | None] = [None] * B
         self.pending: collections.deque[Request] = collections.deque()
         self._sessions: dict[str, _SessionEntry] = {}
+        # step() runs on a worker thread (ModelBackend) while submit()/
+        # free_session() run on the event loop: session+allocator mutations
+        # need mutual exclusion.
+        self._session_lock = threading.RLock()
         self._rng = jax.random.PRNGKey(seed)
         self._decode_jit = _decode_fn(cfg, self.ecfg)
         # Device-resident copies of the control arrays; refreshed from the
@@ -313,12 +318,13 @@ class InferenceEngine:
         return -(-total // self.ecfg.page_size)
 
     def free_session(self, session_id: str) -> bool:
-        """Explicitly drop a session's cached prefix."""
-        sess = self._sessions.pop(session_id, None)
-        if sess is None:
-            return False
-        self.allocator.free(sess.pages)
-        return True
+        """Explicitly drop a session's cached prefix (thread-safe vs step())."""
+        with self._session_lock:
+            sess = self._sessions.pop(session_id, None)
+            if sess is None:
+                return False
+            self.allocator.free(sess.pages)
+            return True
 
     @property
     def num_active(self) -> int:
@@ -364,27 +370,28 @@ class InferenceEngine:
         if free_slot is None:
             return []
         req = self.pending[0]
-        sess = self._session_hit(req)
-        total_pages = self._pages_needed(req)
+        with self._session_lock:
+            sess = self._session_hit(req)
+            total_pages = self._pages_needed(req)
 
-        if sess is not None:
-            # Claim the session FIRST: the eviction loop below must never be
-            # able to free the very pages we are about to reuse.
-            self._sessions.pop(req.session_id, None)
-            extra_needed = total_pages - len(sess.pages)
-            extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
-            if extra is None:
-                self._sessions[req.session_id] = sess  # restore; retry later
-                return []  # page-starved; decode will free pages
-            pages = sess.pages + extra
-            start = len(sess.tokens)
-            suffix = req.prompt[start:]
-        else:
-            pages = self._alloc_with_eviction(total_pages)
-            if pages is None:
-                return []
-            start = 0
-            suffix = req.prompt
+            if sess is not None:
+                # Claim the session FIRST: the eviction loop below must never
+                # be able to free the very pages we are about to reuse.
+                self._sessions.pop(req.session_id, None)
+                extra_needed = total_pages - len(sess.pages)
+                extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
+                if extra is None:
+                    self._sessions[req.session_id] = sess  # restore; retry later
+                    return []  # page-starved; decode will free pages
+                pages = sess.pages + extra
+                start = len(sess.tokens)
+                suffix = req.prompt[start:]
+            else:
+                pages = self._alloc_with_eviction(total_pages)
+                if pages is None:
+                    return []
+                start = 0
+                suffix = req.prompt
         self.pending.popleft()
 
         suffix_arr = np.asarray(suffix, np.int32)
@@ -468,18 +475,25 @@ class InferenceEngine:
 
     def _release(self, slot_idx: int, slot: _Slot) -> None:
         sid = slot.req.session_id
-        if sid and self.ecfg.enable_prefix_cache and len(slot.tokens) > 1:
-            # Retain the KV for the next turn. The last generated token's KV
-            # was never written (it is returned, not fed back), so the cached
-            # prefix is tokens[:-1].
-            old = self._sessions.pop(sid, None)
-            if old is not None:
-                self.allocator.free(old.pages)
-            self._sessions[sid] = _SessionEntry(
-                pages=slot.pages, tokens=slot.tokens[:-1], last_used=time.time()
-            )
-        else:
-            self.allocator.free(slot.pages)
+        with self._session_lock:
+            if sid and self.ecfg.enable_prefix_cache and len(slot.tokens) > 1:
+                # Retain the KV for the next turn. The last generated token's
+                # KV was never written (it is returned, not fed back), so the
+                # cached prefix is tokens[:-1]. Pages were sized for
+                # prompt+max_new_tokens; free the tail that holds no KV
+                # (early stop-token finishes would otherwise strand capacity).
+                cached = slot.tokens[:-1]
+                keep = -(-len(cached) // self.ecfg.page_size)
+                if keep < len(slot.pages):
+                    self.allocator.free(slot.pages[keep:])
+                old = self._sessions.pop(sid, None)
+                if old is not None:
+                    self.allocator.free(old.pages)
+                self._sessions[sid] = _SessionEntry(
+                    pages=slot.pages[:keep], tokens=cached, last_used=time.time()
+                )
+            else:
+                self.allocator.free(slot.pages)
         self.stats["requests_finished"] += 1
         if self.slots[slot_idx] is slot:
             self.slots[slot_idx] = None

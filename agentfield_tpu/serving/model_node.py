@@ -248,6 +248,7 @@ class ModelBackend:
         top_k: int = 0,
         top_p: float = 1.0,
         stop_token_ids: list[int] | None = None,
+        session_id: str | None = None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
@@ -262,6 +263,7 @@ class ModelBackend:
             stop_token_ids,
             register=lambda r: self._streams.__setitem__(r, q),
             unregister=lambda r: self._streams.pop(r, None),
+            session_id=session_id,
         )
         return rid, q
 
@@ -338,7 +340,7 @@ def build_model_node(
             gen_kwargs = {
                 k: body[k]
                 for k in (
-                    "prompt", "tokens", "stop_token_ids",
+                    "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
                 )
                 if body.get(k) is not None

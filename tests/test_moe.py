@@ -1,0 +1,36 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models.moe import MoEConfig, init_moe_params, moe_ffn, moe_ffn_sharded
+from agentfield_tpu.parallel import make_mesh
+
+CFG = MoEConfig(hidden_size=32, expert_intermediate=64, num_experts=4, top_k=2)
+
+
+def test_expert_parallel_matches_dense():
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, CFG.hidden_size), jnp.float32)
+    dense = moe_ffn(params, CFG, x)
+    for n_exp in (2, 4):
+        mesh = make_mesh({"expert": n_exp})
+        sharded = moe_ffn_sharded(params, CFG, x, mesh)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), rtol=1e-5, atol=1e-5)
+
+
+def test_routing_actually_sparse():
+    """top_k routing mass: exactly k experts get nonzero weight per token."""
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 4, CFG.hidden_size), jnp.float32)
+    logits = (x @ params["router"]).astype(jnp.float32)
+    top, idx = jax.lax.top_k(logits, CFG.top_k)
+    assert idx.shape[-1] == 2
+
+
+def test_indivisible_experts_rejected():
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jnp.zeros((1, 4, CFG.hidden_size))
+    mesh = make_mesh({"expert": 3})
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_ffn_sharded(params, CFG, x, mesh)

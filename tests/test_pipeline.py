@@ -1,0 +1,49 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import forward, get_config, init_params
+from agentfield_tpu.parallel import make_mesh
+from agentfield_tpu.parallel.pipeline import pipeline_forward, split_layers_for_stages
+
+CFG = get_config("llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _batch(bsz, seq):
+    toks = jax.random.randint(jax.random.PRNGKey(1), (bsz, seq), 0, CFG.vocab_size, jnp.int32)
+    pos = jnp.arange(seq, dtype=jnp.int32)[None].repeat(bsz, 0)
+    return toks, pos
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 2), (2, 4)])
+def test_pipeline_matches_dense(params, stages, micro):
+    mesh = make_mesh({"stage": stages})
+    toks, pos = _batch(4, 16)
+    dense, _ = forward(params, CFG, toks, pos, collect_kv=False)
+    piped = pipeline_forward(params, CFG, toks, pos, mesh, num_microbatches=micro)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(dense), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_grads_flow(params):
+    """Autodiff through the stage ppermutes: a training loss differentiates."""
+    mesh = make_mesh({"stage": 2})
+    toks, pos = _batch(2, 8)
+
+    def loss_fn(p):
+        logits = pipeline_forward(p, CFG, toks, pos, mesh, num_microbatches=2)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0]) * -1.0
+
+    g = jax.grad(loss_fn)(params)
+    gnorm = sum(float(jnp.sum(jnp.abs(x))) for x in jax.tree.leaves(g))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_split_layers_validation(params):
+    with pytest.raises(ValueError, match="not divisible"):
+        split_layers_for_stages(params, 3)  # tiny config has 2 layers

@@ -78,6 +78,10 @@ class ExecutionGateway:
         self._workers: list[asyncio.Task] = []
         self._session: aiohttp.ClientSession | None = None
 
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=self.agent_timeout)

@@ -533,6 +533,60 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def memory_events(req: web.Request):
         return await _sse(req, MEMORY_TOPIC)
 
+    @routes.get("/api/v1/memory/events/ws")
+    async def memory_events_ws(req: web.Request):
+        """WebSocket fan-out of memory change events (reference:
+        handlers/memory_events.go:38 + the SDK's pattern-subscribing client)."""
+        ws = web.WebSocketResponse(heartbeat=20)
+        await ws.prepare(req)
+        q = cp.bus.subscribe(MEMORY_TOPIC)
+
+        async def reader():
+            # aiohttp only processes ping/pong/close frames inside receive();
+            # without this task the 20s heartbeat force-closes every socket.
+            async for _msg in ws:
+                pass
+
+        reader_task = asyncio.create_task(reader())
+        try:
+            while not ws.closed:
+                try:
+                    async with asyncio.timeout(30):
+                        _, ev = await q.get()
+                except TimeoutError:
+                    continue
+                await ws.send_json(ev)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            reader_task.cancel()
+            await asyncio.gather(reader_task, return_exceptions=True)
+            cp.bus.unsubscribe(MEMORY_TOPIC, q)
+        return ws
+
+    # -- UI service layer ----------------------------------------------
+
+    @routes.get("/api/ui/v1/summary")
+    async def ui_summary(_req):
+        """Dashboard rollup (reference: UIService aggregated summaries,
+        internal/services/ui_service.go)."""
+        from agentfield_tpu.control_plane.dag import run_summaries
+
+        nodes = cp.storage.list_nodes()
+        return web.json_response(
+            {
+                "nodes": {
+                    "total": len(nodes),
+                    "active": sum(n.status.value == "active" for n in nodes),
+                    "models": sum(n.kind == "model" for n in nodes),
+                },
+                "executions_by_status": cp.storage.execution_counts(),
+                "recent_runs": run_summaries(cp.storage, limit=10),
+                "queue_depth": cp.gateway.queue_depth,
+                "backpressure_total": cp.metrics.counter_value("gateway_backpressure_total"),
+            }
+        )
+
     # -- memory (scoped KV + vectors) ----------------------------------
 
     def _scope(req: web.Request) -> tuple[str, str]:

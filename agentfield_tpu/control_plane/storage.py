@@ -199,6 +199,17 @@ class SQLiteStorage:
             rows = self._conn.execute(q, args).fetchall()
         return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
 
+    def execution_counts(self) -> dict[str, int]:
+        """Exact per-status counts via SQL aggregation (dashboard hot path)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT status, COUNT(*) AS n FROM executions GROUP BY status"
+            ).fetchall()
+        counts = {s.value: 0 for s in ExecutionStatus}
+        for r in rows:
+            counts[r["status"]] = r["n"]
+        return counts
+
     def run_summaries(self, limit: int = 50) -> list[dict[str, Any]]:
         """Aggregate run rollups in SQL (GROUP BY run_id) — exact regardless of
         table size, no doc deserialization (reference: QueryRunSummaries,

@@ -1,0 +1,82 @@
+"""Memory change-event subscriptions over WebSocket.
+
+Parity with the reference's MemoryEventClient (sdk/python/agentfield/
+memory_events.py:79: WS client to /api/v1/memory/events/ws, glob pattern
+matching, auto-reconnect, subscription registry) on aiohttp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+from typing import Any, Awaitable, Callable
+
+import aiohttp
+
+Handler = Callable[[dict[str, Any]], Awaitable[None] | None]
+
+
+class MemoryEventClient:
+    def __init__(self, base_url: str, reconnect_delay: float = 1.0, max_delay: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.reconnect_delay = reconnect_delay
+        self.max_delay = max_delay
+        self._subs: list[tuple[str, str | None, Handler]] = []  # (pattern, scope, fn)
+        self._task: asyncio.Task | None = None
+        self.connected = False
+
+    def on_change(self, pattern: str = "*", handler: Handler | None = None, scope: str | None = None):
+        """Subscribe a handler to keys matching a glob pattern; usable as a
+        decorator: ``@events.on_change("user_*")``."""
+
+        def register(fn: Handler) -> Handler:
+            self._subs.append((pattern, scope, fn))
+            return fn
+
+        return register(handler) if handler is not None else register
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        self.connected = False
+
+    async def _run(self) -> None:
+        delay = self.reconnect_delay
+        while True:
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.ws_connect(
+                        f"{self.base_url}/api/v1/memory/events/ws", heartbeat=20
+                    ) as ws:
+                        self.connected = True
+                        delay = self.reconnect_delay  # healthy: reset backoff
+                        async for msg in ws:
+                            if msg.type != aiohttp.WSMsgType.TEXT:
+                                continue
+                            await self._dispatch(msg.json())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # fall through to reconnect
+            self.connected = False
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, self.max_delay)
+
+    async def _dispatch(self, ev: dict[str, Any]) -> None:
+        key = ev.get("key", "")
+        scope = ev.get("scope")
+        for pattern, want_scope, fn in self._subs:
+            if want_scope is not None and scope != want_scope:
+                continue
+            if not fnmatch.fnmatch(key, pattern):
+                continue
+            try:
+                out = fn(ev)
+                if asyncio.iscoroutine(out):
+                    await out
+            except Exception:
+                pass  # one bad handler must not break the stream

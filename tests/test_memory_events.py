@@ -1,0 +1,91 @@
+"""Memory event WS fan-out + SDK pattern subscriptions + UI summary."""
+
+import asyncio
+
+from agentfield_tpu.sdk import Agent
+from agentfield_tpu.sdk.memory_events import MemoryEventClient
+from tests.helpers_cp import CPHarness, async_test
+
+
+@async_test
+async def test_ws_pattern_subscriptions():
+    async with CPHarness() as h:
+        events = MemoryEventClient(h.base_url, reconnect_delay=0.1)
+        got_user, got_all, got_scoped = [], [], []
+
+        events.on_change("user_*", lambda ev: got_user.append(ev["key"]))
+        events.on_change("*", lambda ev: got_all.append(ev["key"]))
+        events.on_change("*", lambda ev: got_scoped.append(ev["key"]), scope="session")
+
+        await events.start()
+        for _ in range(50):
+            if events.connected:
+                break
+            await asyncio.sleep(0.05)
+        assert events.connected
+
+        app = Agent("memev", h.base_url)
+        await app.start()
+        try:
+            await app.memory.memory_set("user_prefs", {"a": 1})
+            await app.memory.memory_set("other_key", 2)
+            await app.memory.memory_set("sess_key", 3, scope="session", scope_id="s1")
+            for _ in range(100):
+                if len(got_all) >= 3:
+                    break
+                await asyncio.sleep(0.02)
+            assert got_user == ["user_prefs"]
+            assert set(got_all) == {"user_prefs", "other_key", "sess_key"}
+            assert got_scoped == ["sess_key"]
+        finally:
+            await app.stop()
+            await events.stop()
+
+
+@async_test
+async def test_ws_reconnects_after_drop():
+    """The client must survive a dropped connection and keep dispatching."""
+    async with CPHarness() as h:
+        events = MemoryEventClient(h.base_url, reconnect_delay=0.05)
+        seen = []
+        events.on_change("*", lambda ev: seen.append(ev["key"]))
+        await events.start()
+        for _ in range(50):
+            if events.connected:
+                break
+            await asyncio.sleep(0.05)
+        # brutally kill the server-side subscriber by restarting its task:
+        # simulate by cancelling the client's task mid-flight and letting the
+        # reconnect loop recover
+        events._task.cancel()
+        await asyncio.gather(events._task, return_exceptions=True)
+        await events.start()
+        for _ in range(50):
+            if events.connected:
+                break
+            await asyncio.sleep(0.05)
+        app = Agent("memev2", h.base_url)
+        await app.start()
+        try:
+            await app.memory.memory_set("after_reconnect", 1)
+            for _ in range(100):
+                if seen:
+                    break
+                await asyncio.sleep(0.02)
+            assert "after_reconnect" in seen
+        finally:
+            await app.stop()
+            await events.stop()
+
+
+@async_test
+async def test_ui_summary():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/fake-agent.echo", json={"input": 1}) as r:
+            assert r.status == 200
+        async with h.http.get("/api/ui/v1/summary") as r:
+            doc = await r.json()
+        assert doc["nodes"]["total"] == 1 and doc["nodes"]["active"] == 1
+        assert doc["executions_by_status"]["completed"] == 1
+        assert len(doc["recent_runs"]) == 1

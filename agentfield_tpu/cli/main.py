@@ -92,10 +92,13 @@ def cmd_server(cfg: Config, args) -> int:
     async def main():
         db = os.path.expanduser(cfg.server.db_path)
         Path(db).parent.mkdir(parents=True, exist_ok=True)
+        port = args.port or cfg.server.port
         cp = ControlPlane(
             db_path=db,
             keystore_path=str(data_dir(cfg) / "keystore.bin"),
             keystore_passphrase=cfg.server.keystore_passphrase,
+            payload_dir=str(data_dir(cfg) / "payloads"),
+            admin_grpc_port=port + 100,  # reference convention: admin on port+100
             agent_timeout=cfg.execution.agent_timeout,
             sync_wait_timeout=cfg.execution.sync_wait_timeout,
             async_workers=cfg.execution.async_workers,
@@ -108,8 +111,8 @@ def cmd_server(cfg: Config, args) -> int:
             stale_after=cfg.execution.stale_after,
             retention=cfg.execution.retention,
         )
-        await run_server(cp, host=cfg.server.host, port=args.port or cfg.server.port)
-        print(f"control plane on {cfg.server.host}:{args.port or cfg.server.port} (db={db})", flush=True)
+        await run_server(cp, host=cfg.server.host, port=port)
+        print(f"control plane on {cfg.server.host}:{port} (admin gRPC :{port + 100}, db={db})", flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for s in (signal.SIGINT, signal.SIGTERM):

@@ -1,0 +1,91 @@
+"""Admin gRPC service.
+
+Parity with the reference's single-RPC admin surface (proto/admin/
+reasoner_admin.proto:8-11 `ListReasoners`, served on port+100 —
+internal/server/server.go:320-372). Implemented with grpc's generic handler
+and JSON-encoded messages (this image has grpcio but not grpcio-tools, so no
+codegen; the method path is stable and any JSON-capable gRPC client can call
+it). The surface will grow protos alongside the model-node hot path.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any
+
+import grpc
+
+SERVICE = "agentfield.admin.ReasonerAdmin"
+
+
+def _json_serializer(obj: Any) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _json_deserializer(data: bytes) -> Any:
+    return json.loads(data) if data else {}
+
+
+class AdminService(grpc.GenericRpcHandler):
+    def __init__(self, storage):
+        self.storage = storage
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/ListReasoners":
+            return grpc.unary_unary_rpc_method_handler(
+                self._list_reasoners,
+                request_deserializer=_json_deserializer,
+                response_serializer=_json_serializer,
+            )
+        if method == f"/{SERVICE}/ListNodes":
+            return grpc.unary_unary_rpc_method_handler(
+                self._list_nodes,
+                request_deserializer=_json_deserializer,
+                response_serializer=_json_serializer,
+            )
+        return None
+
+    def _list_reasoners(self, request, context):
+        node_filter = request.get("node_id") if isinstance(request, dict) else None
+        out = []
+        for node in self.storage.list_nodes():
+            if node_filter and node.node_id != node_filter:
+                continue
+            for r in node.reasoners:
+                out.append(
+                    {
+                        "node_id": node.node_id,
+                        "id": r.id,
+                        "description": r.description,
+                        "did": r.did,
+                    }
+                )
+        return {"reasoners": out}
+
+    def _list_nodes(self, request, context):
+        return {"nodes": [n.to_dict() for n in self.storage.list_nodes()]}
+
+
+def start_admin_grpc(storage, port: int) -> grpc.Server:
+    """Serve on `port` (callers use control-plane port + 100, as the
+    reference does)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((AdminService(storage),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        raise OSError(f"admin gRPC could not bind 127.0.0.1:{port} (port in use?)")
+    server.start()
+    return server
+
+
+def admin_client_call(port: int, method: str, request: dict | None = None) -> Any:
+    """Convenience JSON client for the admin service."""
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+        fn = channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=_json_serializer,
+            response_deserializer=_json_deserializer,
+        )
+        return fn(request or {}, timeout=10)

@@ -65,7 +65,9 @@ class ExecutionGateway:
         async_workers: int = 8,
         queue_capacity: int = 1024,  # reference default (execute.go:1373)
         webhook_notify=None,  # callable(execution) -> None
+        payloads=None,  # PayloadStore | None — large payloads offload to files
     ):
+        self.payloads = payloads
         self.storage = storage
         self.bus = bus
         self.metrics = metrics
@@ -124,6 +126,8 @@ class ExecutionGateway:
 
         # Normalize header casing (clients may send lowercase).
         headers = {k.title(): v for k, v in headers.items()}
+        if self.payloads is not None:
+            payload = self.payloads.offload(payload)
         ex = Execution(
             execution_id=headers.get("X-Execution-Id") or new_id("exec"),
             target=target,
@@ -167,11 +171,15 @@ class ExecutionGateway:
         }
         if ex.parent_execution_id:
             headers["X-Parent-Execution-ID"] = ex.parent_execution_id
+        agent_input = ex.input
+        if self.payloads is not None:
+            # agents get real bytes; file IO runs off the event loop
+            agent_input = await asyncio.to_thread(self.payloads.resolve, agent_input)
         t0 = time.perf_counter()
         try:
             async with self._session.post(
                 self._agent_url(node, ex),
-                json={"input": ex.input, "execution_id": ex.execution_id},
+                json={"input": agent_input, "execution_id": ex.execution_id},
                 headers=headers,
             ) as resp:
                 if resp.status == 200:
@@ -300,7 +308,10 @@ class ExecutionGateway:
             ex.error = error
         else:
             ex.status = ExecutionStatus.COMPLETED
-            ex.result = result
+            if self.payloads is not None:
+                ex.result = await asyncio.to_thread(self.payloads.offload, result)
+            else:
+                ex.result = result
         ex.finished_at = now()
         self.storage.update_execution(ex)
         self.metrics.inc(f"gateway_executions_{ex.status.value}_total")

@@ -48,6 +48,8 @@ class ControlPlane:
         retention: float = 86400.0,
         keystore_path: str | None = None,  # None → ephemeral seed (tests/dev)
         keystore_passphrase: str | None = None,  # None → env var or dev default
+        payload_dir: str | None = None,  # None → payloads stay inline
+        admin_grpc_port: int | None = None,  # reference serves admin gRPC on port+100
     ):
         from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
 
@@ -60,6 +62,13 @@ class ControlPlane:
             seed = _os.urandom(32)
         self.did_service = DIDService(seed)
         self.vc_service = VCService(self.did_service)
+        from agentfield_tpu.control_plane.payloads import PayloadStore
+
+        self.payloads = (
+            PayloadStore(payload_dir, secret=seed) if payload_dir else None
+        )
+        self.admin_grpc_port = admin_grpc_port
+        self._admin_grpc = None
         self.bus = EventBus()
         self.metrics = Metrics()
         self.webhooks = WebhookDispatcher(self.storage, self.metrics)
@@ -81,7 +90,8 @@ class ControlPlane:
             sync_wait_timeout=sync_wait_timeout,
             async_workers=async_workers,
             queue_capacity=queue_capacity,
-            webhook_notify=lambda ex: self.webhooks.notify(ex, self.webhook_secret),
+            webhook_notify=self._notify_webhook,
+            payloads=self.payloads,
         )
 
         self.cleanup_interval = cleanup_interval
@@ -90,6 +100,15 @@ class ControlPlane:
         self._cleanup_task: asyncio.Task | None = None
         self._native_build_task: asyncio.Task | None = None
         self._started = False
+
+    def _notify_webhook(self, ex) -> None:
+        if self.payloads is not None:
+            import dataclasses as _dc
+
+            ex = _dc.replace(
+                ex, result=self.payloads.resolve(ex.result), input=self.payloads.resolve(ex.input)
+            )
+        self.webhooks.notify(ex, self.webhook_secret)
 
     async def start(self) -> None:
         if self._started:  # create_app's startup hook + manual start() are both fine
@@ -104,6 +123,10 @@ class ControlPlane:
         from agentfield_tpu import native
 
         self._native_build_task = asyncio.create_task(asyncio.to_thread(native.build))
+        if self.admin_grpc_port:
+            from agentfield_tpu.control_plane.admin_grpc import start_admin_grpc
+
+            self._admin_grpc = start_admin_grpc(self.storage, self.admin_grpc_port)
 
     async def stop(self) -> None:
         if not self._started:
@@ -115,6 +138,8 @@ class ControlPlane:
         if self._native_build_task:
             self._native_build_task.cancel()
             await asyncio.gather(self._native_build_task, return_exceptions=True)
+        if self._admin_grpc is not None:
+            self._admin_grpc.stop(grace=0)
         await self.webhooks.stop()
         await self.registry.stop()
         await self.gateway.stop()
@@ -272,7 +297,11 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(400, str(e))
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        return web.json_response(ex.to_dict())
+        doc = ex.to_dict()
+        if cp.payloads is not None:
+            doc["input"] = cp.payloads.resolve(doc["input"])
+            doc["result"] = cp.payloads.resolve(doc["result"])
+        return web.json_response(doc)
 
     @routes.post("/api/v1/execute/async/{target}")
     async def execute_async(req: web.Request):
@@ -299,7 +328,11 @@ def create_app(cp: ControlPlane) -> web.Application:
         ex = cp.storage.get_execution(req.match_info["execution_id"])
         if ex is None:
             return _json_error(404, "unknown execution")
-        return web.json_response(ex.to_dict())
+        doc = ex.to_dict()
+        if cp.payloads is not None:
+            doc["input"] = await asyncio.to_thread(cp.payloads.resolve, doc["input"])
+            doc["result"] = await asyncio.to_thread(cp.payloads.resolve, doc["result"])
+        return web.json_response(doc)
 
     @routes.post("/api/v1/executions/{execution_id}/status")
     async def status_callback(req: web.Request):
@@ -333,9 +366,12 @@ def create_app(cp: ControlPlane) -> web.Application:
         for eid in ids:
             ex = cp.storage.get_execution(eid)
             if ex is not None:
+                result = ex.result if ex.status.terminal else None
+                if cp.payloads is not None:
+                    result = cp.payloads.resolve(result)
                 out[eid] = {
                     "status": ex.status.value,
-                    "result": ex.result if ex.status.terminal else None,
+                    "result": result,
                     "error": ex.error,
                 }
         return web.json_response({"executions": out})
@@ -352,7 +388,12 @@ def create_app(cp: ControlPlane) -> web.Application:
         exs = cp.storage.list_executions(
             run_id=q.get("run_id"), status=status, limit=limit, offset=offset
         )
-        return web.json_response({"executions": [e.to_dict() for e in exs]})
+        docs = [e.to_dict() for e in exs]
+        if cp.payloads is not None:
+            for d in docs:
+                d["input"] = cp.payloads.resolve(d["input"])
+                d["result"] = cp.payloads.resolve(d["result"])
+        return web.json_response({"executions": docs})
 
     # -- DID / VC audit layer ------------------------------------------
 
@@ -383,7 +424,11 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(404, "unknown execution")
         if not ex.status.terminal:
             return _json_error(409, "execution not terminal yet")
-        return web.json_response({"vc": cp.vc_service.issue_execution_vc(ex.to_dict())})
+        doc = ex.to_dict()
+        if cp.payloads is not None:
+            doc["input"] = cp.payloads.resolve(doc["input"])
+            doc["result"] = cp.payloads.resolve(doc["result"])
+        return web.json_response({"vc": cp.vc_service.issue_execution_vc(doc)})
 
     @routes.post("/api/v1/vc/verify")
     async def verify_vc(req: web.Request):
@@ -414,7 +459,12 @@ def create_app(cp: ControlPlane) -> web.Application:
         non_terminal = [e.execution_id for e in exs if not e.status.terminal]
         if non_terminal:
             return _json_error(409, f"run has non-terminal executions: {non_terminal[:5]}")
-        return web.json_response(cp.vc_service.workflow_chain([e.to_dict() for e in exs]))
+        docs = [e.to_dict() for e in exs]
+        if cp.payloads is not None:
+            for d in docs:
+                d["input"] = cp.payloads.resolve(d["input"])
+                d["result"] = cp.payloads.resolve(d["result"])
+        return web.json_response(cp.vc_service.workflow_chain(docs))
 
     # -- workflow DAG / runs / notes -----------------------------------
 

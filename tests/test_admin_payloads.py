@@ -1,0 +1,85 @@
+"""Admin gRPC service + payload offload store."""
+
+import json
+
+from agentfield_tpu.control_plane.admin_grpc import admin_client_call
+from agentfield_tpu.control_plane.payloads import PayloadStore
+from tests.helpers_cp import CPHarness, async_test, free_port
+
+
+@async_test
+async def test_admin_grpc_list_reasoners():
+    port = free_port()
+    async with CPHarness(admin_grpc_port=port) as h:
+        await h.register_agent()
+        import asyncio
+
+        res = await asyncio.to_thread(admin_client_call, port, "ListReasoners")
+        ids = {r["id"] for r in res["reasoners"]}
+        assert "echo" in ids and "deferred" in ids
+        assert all(r["node_id"] == "fake-agent" for r in res["reasoners"])
+        res = await asyncio.to_thread(
+            admin_client_call, port, "ListReasoners", {"node_id": "nope"}
+        )
+        assert res["reasoners"] == []
+        nodes = await asyncio.to_thread(admin_client_call, port, "ListNodes")
+        assert nodes["nodes"][0]["node_id"] == "fake-agent"
+
+
+def test_payload_store_round_trip(tmp_path):
+    store = PayloadStore(tmp_path, inline_threshold=100)
+    small = {"a": 1}
+    assert store.offload(small) == small  # inline
+    big = {"blob": "x" * 1000}
+    stub = store.offload(big)
+    assert set(stub) == {"__payload_uri__", "__payload_sig__"}
+    assert store.resolve(stub) == big
+    # content-addressed: same payload → same file
+    assert store.offload(big) == stub
+    # corrupt file surfaces as explicit error value, not an exception
+    import pathlib
+
+    pathlib.Path(stub["__payload_uri__"]).write_text("{not json")
+    assert "error" in store.resolve(stub)
+    pathlib.Path(stub["__payload_uri__"]).unlink()
+    assert "error" in store.resolve(stub)
+
+
+def test_payload_forged_stub_not_dereferenced(tmp_path):
+    """Client-supplied stub dicts are DATA, not file references — no
+    arbitrary server file read."""
+    import json as _json
+
+    secret_file = tmp_path / "secret.json"
+    secret_file.write_text(_json.dumps({"top": "secret"}))
+    store = PayloadStore(tmp_path / "store", inline_threshold=100)
+    forged = {"__payload_uri__": str(secret_file), "__payload_sig__": "0" * 32}
+    assert store.resolve(forged) == forged  # unsigned → passes through untouched
+    partial = {"__payload_uri__": str(secret_file)}
+    assert store.resolve(partial) == partial
+    # even a correctly-signed path outside the base dir is refused
+    evil = {"__payload_uri__": str(secret_file), "__payload_sig__": store._sign(str(secret_file))}
+    assert store.resolve(evil) == {"error": "offloaded payload outside store"}
+
+
+@async_test
+async def test_large_payload_through_gateway(tmp_path):
+    async with CPHarness(payload_dir=str(tmp_path)) as h:
+        h.cp.payloads.inline_threshold = 200
+        await h.register_agent()
+        big_input = {"data": "y" * 2000}
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo", json={"input": big_input}
+        ) as r:
+            doc = await r.json()
+        # the agent saw the REAL payload and the client gets it back resolved
+        assert doc["status"] == "completed"
+        assert doc["result"] == {"echo": big_input}
+        assert doc["input"] == big_input
+        # but the DB row holds a stub, not 2KB of JSON
+        raw = h.cp.storage.get_execution(doc["execution_id"])
+        assert "__payload_uri__" in json.dumps(raw.input)
+        # GET also resolves
+        async with h.http.get(f"/api/v1/executions/{doc['execution_id']}") as r:
+            got = await r.json()
+        assert got["input"] == big_input

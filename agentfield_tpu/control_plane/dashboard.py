@@ -1,0 +1,65 @@
+"""Minimal embedded web dashboard.
+
+The reference embeds a full React SPA in its binary (web/client, 302 TS
+files, ui_embed.go:15); this is the TPU build's v0 equivalent: one static
+page served at ``/`` polling /api/ui/v1/summary and the runs API — zero
+build step, zero assets. The richer SPA is roadmap (README component map).
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8"><title>agentfield_tpu</title>
+<style>
+  body { font-family: ui-monospace, monospace; background: #0d1117; color: #c9d1d9;
+         max-width: 960px; margin: 2rem auto; padding: 0 1rem; }
+  h1 { color: #58a6ff; font-size: 1.3rem; }
+  .cards { display: flex; gap: 1rem; flex-wrap: wrap; }
+  .card { background: #161b22; border: 1px solid #30363d; border-radius: 8px;
+          padding: 0.8rem 1.2rem; min-width: 130px; }
+  .card .num { font-size: 1.6rem; color: #58a6ff; }
+  table { width: 100%; border-collapse: collapse; margin-top: 1rem; }
+  th, td { text-align: left; padding: 0.35rem 0.6rem; border-bottom: 1px solid #21262d;
+           font-size: 0.85rem; }
+  .completed { color: #3fb950; } .failed, .timeout { color: #f85149; }
+  .running, .queued { color: #d29922; } .active { color: #3fb950; }
+  .inactive { color: #8b949e; }
+  small { color: #8b949e; }
+</style>
+</head>
+<body>
+<h1>agentfield_tpu</h1>
+<div class="cards" id="cards"></div>
+<h2 style="font-size:1rem">nodes</h2><table id="nodes"></table>
+<h2 style="font-size:1rem">recent runs</h2><table id="runs"></table>
+<small id="ts"></small>
+<script>
+async function refresh() {
+  try {
+    const s = await (await fetch('/api/ui/v1/summary')).json();
+    const n = await (await fetch('/api/v1/nodes')).json();
+    const ex = s.executions_by_status;
+    document.getElementById('cards').innerHTML = [
+      ['nodes', s.nodes.active + '/' + s.nodes.total],
+      ['models', s.nodes.models],
+      ['completed', ex.completed], ['failed', ex.failed + ex.timeout],
+      ['running', ex.running + ex.queued], ['queue', s.queue_depth],
+    ].map(([k, v]) => `<div class="card"><div class="num">${v}</div>${k}</div>`).join('');
+    document.getElementById('nodes').innerHTML =
+      '<tr><th>node</th><th>kind</th><th>status</th><th>components</th></tr>' +
+      n.nodes.map(x => `<tr><td>${x.node_id}</td><td>${x.kind}</td>
+        <td class="${x.status}">${x.status}</td>
+        <td>${(x.reasoners||[]).length + (x.skills||[]).length}</td></tr>`).join('');
+    document.getElementById('runs').innerHTML =
+      '<tr><th>run</th><th>status</th><th>executions</th><th>targets</th></tr>' +
+      s.recent_runs.map(r => `<tr><td>${r.run_id}</td>
+        <td class="${r.overall_status}">${r.overall_status}</td>
+        <td>${r.executions}</td><td>${r.targets.join(', ')}</td></tr>`).join('');
+    document.getElementById('ts').textContent = 'refreshed ' + new Date().toLocaleTimeString();
+  } catch (e) { document.getElementById('ts').textContent = 'refresh failed: ' + e; }
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

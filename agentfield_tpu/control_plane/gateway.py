@@ -101,7 +101,7 @@ class ExecutionGateway:
 
     # ------------------------------------------------------------------
 
-    def _prepare(
+    async def _prepare(
         self,
         target: str,
         payload: Any,
@@ -127,7 +127,7 @@ class ExecutionGateway:
         # Normalize header casing (clients may send lowercase).
         headers = {k.title(): v for k, v in headers.items()}
         if self.payloads is not None:
-            payload = self.payloads.offload(payload)
+            payload = await asyncio.to_thread(self.payloads.offload, payload)
         ex = Execution(
             execution_id=headers.get("X-Execution-Id") or new_id("exec"),
             target=target,
@@ -216,7 +216,7 @@ class ExecutionGateway:
     ) -> Execution:
         """Sync path: call agent, then wait on the event bus until the
         execution reaches a terminal state (execute.go:195-278)."""
-        ex, node = self._prepare(target, payload, headers, webhook_url, ExecutionStatus.RUNNING)
+        ex, node = await self._prepare(target, payload, headers, webhook_url, ExecutionStatus.RUNNING)
         await self._call_agent(node, ex)
         current = self.storage.get_execution(ex.execution_id)
         if current is not None and current.status.terminal:
@@ -240,7 +240,7 @@ class ExecutionGateway:
     ) -> Execution:
         """Async path: enqueue and 202 immediately; queue-full → 503
         backpressure (execute.go:327-367)."""
-        ex, _node = self._prepare(target, payload, headers, webhook_url, ExecutionStatus.QUEUED)
+        ex, _node = await self._prepare(target, payload, headers, webhook_url, ExecutionStatus.QUEUED)
         try:
             self._queue.put_nowait(ex)
         except asyncio.QueueFull:
@@ -308,6 +308,7 @@ class ExecutionGateway:
             ex.error = error
         else:
             ex.status = ExecutionStatus.COMPLETED
+            raw_result = result
             if self.payloads is not None:
                 ex.result = await asyncio.to_thread(self.payloads.offload, result)
             else:
@@ -319,7 +320,13 @@ class ExecutionGateway:
             self.metrics.observe("execution_duration_seconds", ex.finished_at - ex.started_at)
         self._publish(ex)
         if ex.webhook_url and self.webhook_notify:
-            self.webhook_notify(ex)
+            # Hand the webhook the in-memory result — no disk round-trip.
+            notify_ex = ex
+            if ex.status == ExecutionStatus.COMPLETED and self.payloads is not None:
+                import dataclasses as _dc
+
+                notify_ex = _dc.replace(ex, result=raw_result)
+            self.webhook_notify(notify_ex)
         return ex
 
     async def handle_status_update(

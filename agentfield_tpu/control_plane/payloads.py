@@ -23,6 +23,11 @@ URI_KEY = "__payload_uri__"
 SIG_KEY = "__payload_sig__"
 
 
+class PayloadMissingError(Exception):
+    """A genuine stub whose backing file is gone/corrupt (strict resolution —
+    callers that must not misattest content, e.g. VC issuance, use this)."""
+
+
 class PayloadStore:
     def __init__(
         self,
@@ -50,7 +55,11 @@ class PayloadStore:
         path = self.base / digest[:2] / f"{digest}.json"
         if not path.exists():
             path.parent.mkdir(parents=True, exist_ok=True)
-            tmp = path.with_suffix(".tmp")
+            # Unique tmp per writer: concurrent offloads of identical content
+            # must not truncate each other's in-flight file mid-rename.
+            import threading
+
+            tmp = path.with_suffix(f".{os.getpid()}.{threading.get_ident()}.tmp")
             tmp.write_bytes(blob)
             tmp.rename(path)  # atomic publish; content-addressed → idempotent
         return {URI_KEY: str(path), SIG_KEY: self._sign(str(path))}
@@ -64,19 +73,22 @@ class PayloadStore:
             )
         )
 
-    def resolve(self, payload: Any) -> Any:
+    def resolve(self, payload: Any, strict: bool = False) -> Any:
         """Inverse of offload. Only genuine (signed, in-base) stubs are
         dereferenced; anything else — including forged client dicts — passes
         through untouched. A missing/corrupt file surfaces as an explicit
-        error value rather than an exception."""
+        error value (or PayloadMissingError when ``strict`` — for callers
+        like VC issuance that must never attest placeholder content)."""
         if not self.is_stub(payload):
             return payload
         path = Path(payload[URI_KEY])
         try:
             if not path.resolve().is_relative_to(self.base):
-                return {"error": "offloaded payload outside store"}
+                raise OSError("outside store")
             return json.loads(path.read_bytes())
         except (OSError, ValueError):
+            if strict:
+                raise PayloadMissingError(str(path)) from None
             return {"error": f"offloaded payload missing or corrupt: {path}"}
 
     def gc(self, referenced: set[str]) -> int:

@@ -102,12 +102,7 @@ class ControlPlane:
         self._started = False
 
     def _notify_webhook(self, ex) -> None:
-        if self.payloads is not None:
-            import dataclasses as _dc
-
-            ex = _dc.replace(
-                ex, result=self.payloads.resolve(ex.result), input=self.payloads.resolve(ex.input)
-            )
+        # gateway.complete hands the raw in-memory result; nothing to resolve.
         self.webhooks.notify(ex, self.webhook_secret)
 
     async def start(self) -> None:
@@ -218,6 +213,12 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     # -- health / metrics ----------------------------------------------
 
+    @routes.get("/")
+    async def index(_req):
+        from agentfield_tpu.control_plane.dashboard import DASHBOARD_HTML
+
+        return web.Response(text=DASHBOARD_HTML, content_type="text/html")
+
     @routes.get("/health")
     async def health(_req):
         return web.json_response({"status": "ok", "ts": now()})
@@ -299,8 +300,8 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(e.status, e.message)
         doc = ex.to_dict()
         if cp.payloads is not None:
-            doc["input"] = cp.payloads.resolve(doc["input"])
-            doc["result"] = cp.payloads.resolve(doc["result"])
+            doc["input"] = await asyncio.to_thread(cp.payloads.resolve, doc["input"])
+            doc["result"] = await asyncio.to_thread(cp.payloads.resolve, doc["result"])
         return web.json_response(doc)
 
     @routes.post("/api/v1/execute/async/{target}")
@@ -368,7 +369,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             if ex is not None:
                 result = ex.result if ex.status.terminal else None
                 if cp.payloads is not None:
-                    result = cp.payloads.resolve(result)
+                    result = await asyncio.to_thread(cp.payloads.resolve, result)
                 out[eid] = {
                     "status": ex.status.value,
                     "result": result,
@@ -390,9 +391,13 @@ def create_app(cp: ControlPlane) -> web.Application:
         )
         docs = [e.to_dict() for e in exs]
         if cp.payloads is not None:
-            for d in docs:
-                d["input"] = cp.payloads.resolve(d["input"])
-                d["result"] = cp.payloads.resolve(d["result"])
+
+            def _resolve_list():
+                for d in docs:
+                    d["input"] = cp.payloads.resolve(d["input"])
+                    d["result"] = cp.payloads.resolve(d["result"])
+
+            await asyncio.to_thread(_resolve_list)
         return web.json_response({"executions": docs})
 
     # -- DID / VC audit layer ------------------------------------------
@@ -426,8 +431,13 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(409, "execution not terminal yet")
         doc = ex.to_dict()
         if cp.payloads is not None:
-            doc["input"] = cp.payloads.resolve(doc["input"])
-            doc["result"] = cp.payloads.resolve(doc["result"])
+            from agentfield_tpu.control_plane.payloads import PayloadMissingError
+
+            try:
+                doc["input"] = await asyncio.to_thread(cp.payloads.resolve, doc["input"], True)
+                doc["result"] = await asyncio.to_thread(cp.payloads.resolve, doc["result"], True)
+            except PayloadMissingError as e:
+                return _json_error(410, f"cannot attest: offloaded payload gone ({e})")
         return web.json_response({"vc": cp.vc_service.issue_execution_vc(doc)})
 
     @routes.post("/api/v1/vc/verify")
@@ -461,9 +471,17 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(409, f"run has non-terminal executions: {non_terminal[:5]}")
         docs = [e.to_dict() for e in exs]
         if cp.payloads is not None:
-            for d in docs:
-                d["input"] = cp.payloads.resolve(d["input"])
-                d["result"] = cp.payloads.resolve(d["result"])
+            from agentfield_tpu.control_plane.payloads import PayloadMissingError
+
+            def _resolve_all():
+                for d in docs:
+                    d["input"] = cp.payloads.resolve(d["input"], strict=True)
+                    d["result"] = cp.payloads.resolve(d["result"], strict=True)
+
+            try:
+                await asyncio.to_thread(_resolve_all)
+            except PayloadMissingError as e:
+                return _json_error(410, f"cannot attest: offloaded payload gone ({e})")
         return web.json_response(cp.vc_service.workflow_chain(docs))
 
     # -- workflow DAG / runs / notes -----------------------------------

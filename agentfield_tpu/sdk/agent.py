@@ -344,12 +344,34 @@ class Agent:
             # Session affinity → model-node prefix-cache reuse across turns.
             "session_id": ctx.session_id if ctx else None,
         }
-        doc = await self.client.execute(
-            f"{node_id}.generate",
-            payload,
-            headers=self._outbound_ctx().to_headers(),
-            timeout=timeout,
-        )
+        # Backpressure retry (the reference's rate limiter plays this role for
+        # provider 429s — rate_limiter.py). Engine exhaustion reaches us two
+        # ways: HTTP 503 (node inactive / async queue full) OR a FAILED
+        # execution whose error names QueueFullError (the model node's
+        # generate raised it and reported failure through the callback).
+        headers = self._outbound_ctx().to_headers()
+        attempts = 0
+        while True:
+            try:
+                doc = await self.client.execute(
+                    f"{node_id}.generate", payload, headers=headers, timeout=timeout
+                )
+            except ControlPlaneError as e:
+                if e.status != 503 or attempts >= 5:
+                    raise
+                attempts += 1
+                await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                continue
+            err = str(doc.get("error") or "")
+            if (
+                doc["status"] == "failed"
+                and ("QueueFullError" in err or "queue at capacity" in err)
+                and attempts < 5
+            ):
+                attempts += 1
+                await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                continue
+            break
         if doc["status"] != "completed":
             raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
         result = doc["result"]
@@ -448,6 +470,23 @@ class Agent:
                 await self.client.post_workflow_event(done)
             except Exception:
                 pass
+
+    async def handle_serverless(self, event: dict[str, Any]) -> dict[str, Any]:
+        """Process one invocation without a long-lived HTTP server (reference:
+        Agent.handle_serverless, agent.py:566 — the Lambda-style entrypoint;
+        the control plane registers such nodes with kind='serverless' and the
+        platform's URL as base_url). Event shape:
+        {"component": "<id>", "input": ..., "headers": {X-* context}}."""
+        comp = self.components.get(event.get("component", ""))
+        if comp is None:
+            return {"status": "failed", "error": f"unknown component {event.get('component')!r}"}
+        ctx = ExecutionContext.from_headers(event.get("headers", {})) or ExecutionContext.new_root()
+        try:
+            result = await self._run(comp, event.get("input"), ctx)
+            json.dumps(result)
+        except Exception as e:
+            return {"status": "failed", "error": repr(e), "execution_id": ctx.execution_id}
+        return {"status": "completed", "result": result, "execution_id": ctx.execution_id}
 
     async def note(self, note: Any, actor: str | None = None) -> None:
         """Attach a note to the current execution (reference: Agent.note,

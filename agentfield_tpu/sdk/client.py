@@ -27,6 +27,9 @@ class ControlPlaneClient:
         self.base_url = base_url.rstrip("/")
         self._timeout = aiohttp.ClientTimeout(total=timeout)
         self._session: aiohttp.ClientSession | None = None
+        from agentfield_tpu.sdk.result_cache import ResultCache
+
+        self._result_cache = ResultCache()
 
     async def _s(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -102,7 +105,15 @@ class ControlPlaneClient:
         )
 
     async def get_execution(self, execution_id: str) -> dict[str, Any]:
-        return await self._req("GET", f"/api/v1/executions/{execution_id}")
+        import copy
+
+        cached = self._result_cache.get(execution_id)
+        if cached is not None:
+            return copy.deepcopy(cached)  # caller mutations must not poison the cache
+        doc = await self._req("GET", f"/api/v1/executions/{execution_id}")
+        if doc.get("status") in ("completed", "failed", "timeout"):
+            self._result_cache.put(execution_id, copy.deepcopy(doc))  # terminal → immutable
+        return doc
 
     async def batch_status(self, execution_ids: list[str]) -> dict[str, Any]:
         return (
@@ -137,15 +148,61 @@ class ControlPlaneClient:
     async def wait_for_execution(
         self, execution_id: str, timeout: float = 600.0, poll_interval: float = 0.05
     ) -> dict[str, Any]:
-        """Adaptive polling until terminal (the reference prefers an SSE event
-        stream with polling fallback — async_execution_manager.py:644; v0
-        polls with backoff, SSE client lands with streaming support)."""
+        """SSE event-stream wait with adaptive-polling fallback (the
+        reference's async manager uses the same strategy —
+        async_execution_manager.py:644 + :869 batch-poll fallback). The
+        timeout budget is shared across both phases — never 2x."""
+        t0 = asyncio.get_event_loop().time()
+        try:
+            return await self._wait_sse(execution_id, timeout)
+        except (aiohttp.ClientError, TimeoutError, asyncio.TimeoutError):
+            pass  # SSE unavailable/raced: fall back to polling
+        remaining = timeout - (asyncio.get_event_loop().time() - t0)
+        if remaining <= 0:
+            raise TimeoutError(f"execution {execution_id} not terminal after {timeout}s")
+        return await self._wait_poll(execution_id, remaining, poll_interval)
+
+    async def _wait_sse(self, execution_id: str, timeout: float) -> dict[str, Any]:
+        s = await self._s()
+        async with asyncio.timeout(timeout):
+            async with s.get(
+                self.base_url + "/api/v1/events/executions",
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                # The terminal event may predate our subscription: check once
+                # AFTER the stream is open so nothing can slip between. A 404
+                # is not fatal — the execution may not exist YET (e.g. created
+                # by a workflow event moments from now).
+                try:
+                    doc = await self.get_execution(execution_id)
+                    if doc["status"] in ("completed", "failed", "timeout"):
+                        return doc
+                except ControlPlaneError as e:
+                    if e.status != 404:
+                        raise
+                import json as _json
+
+                async for line in resp.content:
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = _json.loads(line[6:])
+                    if ev.get("execution_id") == execution_id and ev.get("terminal"):
+                        return await self.get_execution(execution_id)
+        raise TimeoutError(f"execution {execution_id} not terminal after {timeout}s")
+
+    async def _wait_poll(
+        self, execution_id: str, timeout: float, poll_interval: float
+    ) -> dict[str, Any]:
         deadline = asyncio.get_event_loop().time() + timeout
         interval = poll_interval
         while True:
-            doc = await self.get_execution(execution_id)
-            if doc["status"] in ("completed", "failed", "timeout"):
-                return doc
+            try:
+                doc = await self.get_execution(execution_id)
+                if doc["status"] in ("completed", "failed", "timeout"):
+                    return doc
+            except ControlPlaneError as e:
+                if e.status != 404:  # not-yet-created: keep polling
+                    raise
             if asyncio.get_event_loop().time() > deadline:
                 raise TimeoutError(f"execution {execution_id} not terminal after {timeout}s")
             await asyncio.sleep(interval)

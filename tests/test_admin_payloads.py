@@ -59,7 +59,11 @@ def test_payload_forged_stub_not_dereferenced(tmp_path):
     assert store.resolve(partial) == partial
     # even a correctly-signed path outside the base dir is refused
     evil = {"__payload_uri__": str(secret_file), "__payload_sig__": store._sign(str(secret_file))}
-    assert store.resolve(evil) == {"error": "offloaded payload outside store"}
+    assert "error" in store.resolve(evil)  # outside base dir → refused
+    import pytest as _pytest
+    from agentfield_tpu.control_plane.payloads import PayloadMissingError
+    with _pytest.raises(PayloadMissingError):
+        store.resolve(evil, strict=True)
 
 
 @async_test

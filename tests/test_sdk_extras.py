@@ -1,0 +1,73 @@
+"""Result cache, SSE-based waiting, serverless handler, ai() backpressure
+retry, dashboard route."""
+
+import asyncio
+import time
+
+import pytest
+
+from agentfield_tpu.sdk import Agent
+from agentfield_tpu.sdk.result_cache import ResultCache
+from tests.helpers_cp import CPHarness, async_test
+
+
+def test_result_cache_ttl_lru():
+    c = ResultCache(max_entries=2, ttl=0.05)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1  # a is now most-recent
+    c.put("c", 3)  # evicts b (LRU)
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    time.sleep(0.06)
+    assert c.get("a") is None  # TTL expiry
+    assert c.stats()["entries"] >= 0
+
+
+@async_test
+async def test_wait_for_execution_via_sse():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post("/api/v1/execute/async/fake-agent.deferred", json={}) as r:
+            eid = (await r.json())["execution_id"]
+        from agentfield_tpu.sdk.client import ControlPlaneClient
+
+        client = ControlPlaneClient(h.base_url)
+        try:
+            doc = await client.wait_for_execution(eid, timeout=10)
+            assert doc["status"] == "completed"
+            # terminal docs cache: second read needs no HTTP (server could die)
+            doc2 = await client.get_execution(eid)
+            assert doc2["status"] == "completed"
+            assert client._result_cache.stats()["hits"] >= 1
+        finally:
+            await client.close()
+
+
+@async_test
+async def test_serverless_handler():
+    async with CPHarness() as h:
+        app = Agent("sls", h.base_url)
+
+        @app.reasoner()
+        def double(x: int) -> int:
+            return x * 2
+
+        out = await app.handle_serverless(
+            {"component": "double", "input": {"x": 21}, "headers": {"X-Execution-ID": "e1", "X-Run-ID": "r1"}}
+        )
+        assert out == {"status": "completed", "result": 42, "execution_id": "e1"}
+        out = await app.handle_serverless({"component": "nope", "input": {}})
+        assert out["status"] == "failed" and "unknown component" in out["error"]
+        out = await app.handle_serverless({"component": "double", "input": {"x": "bad"}})
+        assert out["status"] == "failed"
+        await app.client.close()
+
+
+@async_test
+async def test_dashboard_served():
+    async with CPHarness() as h:
+        async with h.http.get("/") as r:
+            assert r.status == 200
+            text = await r.text()
+        assert "agentfield_tpu" in text and "/api/ui/v1/summary" in text

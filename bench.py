@@ -97,6 +97,9 @@ def main() -> None:
                 "total_tokens": total_tokens,
                 "elapsed_s": round(elapsed, 2),
                 "decode_steps": engine.stats["decode_steps"],
+                "attn_impl": ecfg.attn_impl,
+                "prefill_impl": ecfg.prefill_impl,
+                "max_batch": max_batch,
                 "device": str(jax.devices()[0]),
             }
         )

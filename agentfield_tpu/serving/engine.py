@@ -55,6 +55,9 @@ class EngineConfig:
     attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
     prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas)
     enable_prefix_cache: bool = True  # retain session KV across turns
+    prefill_chunk: int | None = None  # chunk long prefills to this many tokens:
+    # bounds compiled bucket shapes and keeps decode latency fair under long
+    # prompts (chunks run through the cached-page attention path)
     dtype: str | None = None
 
     @property
@@ -236,6 +239,10 @@ class InferenceEngine:
         to it."""
         self.cfg = cfg
         self.ecfg = ecfg or EngineConfig()
+        if self.ecfg.prefill_chunk is not None and self.ecfg.prefill_chunk < 16:
+            raise ValueError(
+                f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 16 (one tile) or None"
+            )
         if self.ecfg.max_pages_per_seq > self.ecfg.num_pages - 1:
             raise ValueError(
                 f"max_pages_per_seq={self.ecfg.max_pages_per_seq} cannot exceed "
@@ -394,35 +401,11 @@ class InferenceEngine:
                 suffix = req.prompt
         self.pending.popleft()
 
-        suffix_arr = np.asarray(suffix, np.int32)
-        bucket = self.ecfg.prefill_bucket(len(suffix))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(suffix)] = suffix_arr
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
-
-        if start > 0:
-            fn = _suffix_prefill_fn(self.cfg, self.ecfg, bucket)
-            last_logits, self.cache.k_pages, self.cache.v_pages = fn(
-                self.params,
-                self.cache.k_pages,
-                self.cache.v_pages,
-                jnp.asarray(padded),
-                jnp.int32(start),
-                jnp.int32(len(suffix)),
-                jnp.asarray(row),
-            )
+        if sess is not None:
             self.stats["prefix_cache_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
-        else:
-            fn = _prefill_fn(self.cfg, self.ecfg, bucket)
-            last_logits, self.cache.k_pages, self.cache.v_pages = fn(
-                self.params,
-                self.cache.k_pages,
-                self.cache.v_pages,
-                jnp.asarray(padded),
-                jnp.int32(len(suffix)),
-                jnp.asarray(row),
-            )
+        last_logits = self._prefill(suffix, start, row)
         s = req.sampling
         tok = int(
             sample_tokens(
@@ -454,6 +437,48 @@ class InferenceEngine:
             self.top_ps[free_slot] = s.top_p
         self._dirty = True
         return [event]
+
+    def _prefill(self, tokens: list[int], start: int, row: np.ndarray):
+        """Prefill `tokens` beginning at absolute position `start`, optionally
+        in fixed-size chunks. start==0 with no chunking takes the flash-capable
+        whole-prompt path; everything else flows through the cached-page
+        attention path (which generalizes to any start). Returns the final
+        position's logits."""
+        chunk = self.ecfg.prefill_chunk
+        pieces: list[tuple[int, list[int]]] = []
+        if chunk is None or len(tokens) <= chunk:
+            pieces.append((start, list(tokens)))
+        else:
+            for off in range(0, len(tokens), chunk):
+                pieces.append((start + off, list(tokens[off : off + chunk])))
+
+        last_logits = None
+        for piece_start, piece in pieces:
+            bucket = self.ecfg.prefill_bucket(len(piece))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(piece)] = np.asarray(piece, np.int32)
+            if piece_start == 0 and len(pieces) == 1:
+                fn = _prefill_fn(self.cfg, self.ecfg, bucket)
+                last_logits, self.cache.k_pages, self.cache.v_pages = fn(
+                    self.params,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(padded),
+                    jnp.int32(len(piece)),
+                    jnp.asarray(row),
+                )
+            else:
+                fn = _suffix_prefill_fn(self.cfg, self.ecfg, bucket)
+                last_logits, self.cache.k_pages, self.cache.v_pages = fn(
+                    self.params,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(padded),
+                    jnp.int32(piece_start),
+                    jnp.int32(len(piece)),
+                    jnp.asarray(row),
+                )
+        return last_logits
 
     def _emit(self, slot_idx: int, slot: _Slot, tok: int) -> TokenEvent:
         s = slot.req.sampling

@@ -393,4 +393,48 @@ def build_model_node(
         )
 
     agent.add_route("GET", "/stats", stats_handler)
+
+    profile_state = {"active": False, "dir": None}
+
+    async def profile_handler(req):
+        """jax.profiler trace capture (the TPU-native answer to SURVEY §5's
+        tracing row: the reference leans on pprof/gops; here device traces
+        open in TensorBoard/XProf). POST /profile/start {"dir": ...} then
+        POST /profile/stop."""
+        from aiohttp import web as _web
+
+        action = req.match_info["action"]
+        if action == "start":
+            # Read the body BEFORE the check-and-set: an await between check
+            # and set would let two concurrent starts both pass (TOCTOU).
+            try:
+                body = await req.json() if req.can_read_body else {}
+            except Exception:
+                body = {}
+            if not isinstance(body, dict):
+                body = {}
+            if profile_state["active"]:
+                return _web.json_response({"error": "trace already active"}, status=409)
+            profile_state["active"] = True  # claim first; no awaits until done
+            trace_dir = body.get("dir") or "/tmp/agentfield_tpu_trace"
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:
+                profile_state["active"] = False
+                return _web.json_response({"error": f"start_trace failed: {e!r}"}, status=500)
+            profile_state["dir"] = trace_dir
+            return _web.json_response({"tracing": True, "dir": trace_dir})
+        if action == "stop":
+            if not profile_state["active"]:
+                return _web.json_response({"error": "no active trace"}, status=409)
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return _web.json_response({"error": f"stop_trace failed: {e!r}"}, status=500)
+            finally:
+                profile_state["active"] = False  # never wedge the endpoint
+            return _web.json_response({"tracing": False, "dir": profile_state["dir"]})
+        return _web.json_response({"error": "action must be start|stop"}, status=404)
+
+    agent.add_route("POST", "/profile/{action}", profile_handler)
     return agent, backend

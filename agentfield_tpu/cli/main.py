@@ -156,6 +156,7 @@ def cmd_model(cfg: Config, args) -> int:
             max_pages_per_seq=mn.max_pages_per_seq,
             attn_impl=mn.attn_impl,
             prefill_impl=mn.prefill_impl,
+            prefill_chunk=mn.prefill_chunk,
         )
         agent, backend = build_model_node(
             args.name or "model",
@@ -163,6 +164,7 @@ def cmd_model(cfg: Config, args) -> int:
             model=args.model or mn.model,
             ecfg=ecfg,
             checkpoint=args.checkpoint or mn.checkpoint,
+            tp=mn.tp,
         )
         await backend.start()
         await agent.start()
@@ -220,15 +222,55 @@ def cmd_init(cfg: Config, args) -> int:
 
 
 def cmd_run(cfg: Config, args) -> int:
-    entry = Path(args.path)
-    if entry.is_dir():
-        entry = entry / "main.py"
+    from agentfield_tpu.cli.packages import resolve_entrypoint
+
+    entry = resolve_entrypoint(args.path, data_dir(cfg))
+    if entry is None:
+        entry = Path(args.path)
+        if entry.is_dir():
+            entry = entry / "main.py"
     if not entry.exists():
-        print(f"no such agent entry {entry}", file=sys.stderr)
+        print(f"no such agent entry or installed package {args.path!r}", file=sys.stderr)
         return 1
     name = args.name or entry.resolve().parent.name
     env = {"AGENTFIELD_URL": args.url} if args.url else {}
     return _spawn(cfg, name, [PY, str(entry)], env=env)
+
+
+def cmd_install(cfg: Config, args) -> int:
+    """Install an agent package from a local path or git source (reference:
+    af install, internal/packages/installer.go:186)."""
+    from agentfield_tpu.cli.packages import PackageError, install
+
+    try:
+        entry = install(args.source, data_dir(cfg), force=args.force)
+    except PackageError as e:
+        print(f"install failed: {e}", file=sys.stderr)
+        return 1
+    print(f"installed {entry['name']} -> {entry['path']}")
+    return 0
+
+
+def cmd_uninstall(cfg: Config, args) -> int:
+    from agentfield_tpu.cli.packages import uninstall
+
+    if not uninstall(args.name, data_dir(cfg)):
+        print(f"unknown package {args.name!r}", file=sys.stderr)
+        return 1
+    print(f"uninstalled {args.name}")
+    return 0
+
+
+def cmd_packages(cfg: Config, args) -> int:
+    from agentfield_tpu.cli.packages import load_registry
+
+    reg = load_registry(data_dir(cfg))
+    if not reg:
+        print("no installed packages")
+        return 0
+    for name, e in sorted(reg.items()):
+        print(f"{name:24s} {e['origin']['type']:6s} {e['description'][:50]}")
+    return 0
 
 
 def cmd_dev(cfg: Config, args) -> int:
@@ -368,7 +410,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("name")
     s.set_defaults(fn=cmd_init)
 
-    s = sub.add_parser("run", help="run an agent as a managed process")
+    s = sub.add_parser("install", help="install an agent package (local path or git)")
+    s.add_argument("source")
+    s.add_argument("--force", action="store_true")
+    s.set_defaults(fn=cmd_install)
+
+    s = sub.add_parser("uninstall", help="remove an installed package")
+    s.add_argument("name")
+    s.set_defaults(fn=cmd_uninstall)
+
+    s = sub.add_parser("packages", help="list installed packages")
+    s.set_defaults(fn=cmd_packages)
+
+    s = sub.add_parser("run", help="run an agent (installed package name or path)")
     s.add_argument("path")
     s.add_argument("--name")
     s.add_argument("--url", help="control plane URL for the agent")

@@ -56,6 +56,7 @@ class ModelNodeConfig:
     max_pages_per_seq: int = 32
     attn_impl: str = "ref"
     prefill_impl: str = "ref"
+    prefill_chunk: int | None = None  # chunked prefill (>= 16) or whole-prompt
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 
 

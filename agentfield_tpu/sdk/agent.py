@@ -568,10 +568,35 @@ class Agent:
                 pass  # transient; keep heartbeating (reference ConnectionManager)
 
     def serve(self) -> None:
-        """Blocking entrypoint for standalone agent processes."""
+        """Blocking entrypoint for standalone agent processes. Registration
+        retries with backoff — a control plane that is still booting (or
+        briefly down) must not kill the agent (reference: ConnectionManager
+        retry loop, connection_manager.py:197)."""
+
+        import aiohttp
+
+        requested_port = self.port  # 0 → re-draw a fresh port on every retry
 
         async def main():
-            await self.start()
+            delay = 1.0
+            while True:
+                try:
+                    await self.start()
+                    break
+                except (ControlPlaneError, aiohttp.ClientError, ConnectionError, OSError) as e:
+                    # Transient cluster/network conditions only — a programming
+                    # error must still crash with its traceback.
+                    print(
+                        f"[agentfield] {self.node_id}: control plane not ready "
+                        f"({e!r}); retrying in {delay:.0f}s",
+                        flush=True,
+                    )
+                    if self._runner:  # unbind before retrying start()
+                        await self._runner.cleanup()
+                        self._runner = None
+                    self.port = requested_port
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 30.0)
             print(
                 f"[agentfield] {self.node_id} serving on {self.host}:{self.port} "
                 f"({len(self.components)} components), control plane {self.client.base_url}",

@@ -1,0 +1,121 @@
+"""Typed multimodal content wrappers.
+
+API parity with the reference's multimodal helpers (sdk/python/agentfield/
+multimodal.py: Text/Image/Audio/File content types, auto-detection of
+multimodal arguments, response wrapping with save helpers —
+agent_ai.py:449 `_process_multimodal_args`). The TPU build's in-tree models
+are text-only this round, so non-text content raises a clear capability
+error at the call site instead of being silently dropped; the typed surface
+is stable so multimodal model nodes slot in without SDK changes.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import mimetypes
+from pathlib import Path
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TextContent:
+    text: str
+
+    def to_part(self) -> dict[str, Any]:
+        return {"type": "text", "text": self.text}
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageContent:
+    data: bytes
+    mime: str = "image/png"
+
+    @staticmethod
+    def from_file(path: str | Path) -> "ImageContent":
+        p = Path(path)
+        mime = mimetypes.guess_type(str(p))[0] or "image/png"
+        return ImageContent(p.read_bytes(), mime)
+
+    def to_part(self) -> dict[str, Any]:
+        return {
+            "type": "image",
+            "mime": self.mime,
+            "data_b64": base64.b64encode(self.data).decode(),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class AudioContent:
+    data: bytes
+    mime: str = "audio/wav"
+
+    @staticmethod
+    def from_file(path: str | Path) -> "AudioContent":
+        p = Path(path)
+        mime = mimetypes.guess_type(str(p))[0] or "audio/wav"
+        return AudioContent(p.read_bytes(), mime)
+
+    def to_part(self) -> dict[str, Any]:
+        return {
+            "type": "audio",
+            "mime": self.mime,
+            "data_b64": base64.b64encode(self.data).decode(),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class FileContent:
+    data: bytes
+    name: str
+    mime: str = "application/octet-stream"
+
+    def to_part(self) -> dict[str, Any]:
+        return {
+            "type": "file",
+            "name": self.name,
+            "mime": self.mime,
+            "data_b64": base64.b64encode(self.data).decode(),
+        }
+
+
+Content = TextContent | ImageContent | AudioContent | FileContent
+
+
+class UnsupportedModalityError(NotImplementedError):
+    pass
+
+
+def classify(arg: Any) -> Content:
+    """Auto-detect content type the way the reference classifies ai() args
+    (agent_ai.py:449): str → text; bytes sniffed by magic numbers; Content
+    passes through."""
+    if isinstance(arg, (TextContent, ImageContent, AudioContent, FileContent)):
+        return arg
+    if isinstance(arg, str):
+        return TextContent(arg)
+    if isinstance(arg, bytes):
+        if arg[:8] == b"\x89PNG\r\n\x1a\n":
+            return ImageContent(arg, "image/png")
+        if arg[:3] == b"\xff\xd8\xff":
+            return ImageContent(arg, "image/jpeg")
+        if arg[:4] == b"RIFF" and arg[8:12] == b"WAVE":
+            return AudioContent(arg, "audio/wav")
+        return FileContent(arg, name="blob")
+    raise TypeError(f"cannot classify {type(arg).__name__} as content")
+
+
+def to_text_prompt(parts: list[Content]) -> str:
+    """Flatten content to a text prompt for text-only model nodes; non-text
+    parts raise UnsupportedModalityError naming the roadmap item."""
+    texts = []
+    for p in parts:
+        if isinstance(p, TextContent):
+            texts.append(p.text)
+        else:
+            raise UnsupportedModalityError(
+                f"{type(p).__name__} requires a multimodal model node "
+                "(text-only models are served this round; vision/audio model "
+                "nodes are roadmap)"
+            )
+    return "\n".join(texts)

@@ -1,0 +1,111 @@
+"""Package installer + multimodal content helpers."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from agentfield_tpu.cli.packages import (
+    PackageError,
+    install,
+    load_registry,
+    resolve_entrypoint,
+    uninstall,
+)
+from agentfield_tpu.sdk.multimodal import (
+    AudioContent,
+    ImageContent,
+    TextContent,
+    UnsupportedModalityError,
+    classify,
+    to_text_prompt,
+)
+
+
+def _make_pkg(path: Path, name: str):
+    path.mkdir(parents=True)
+    (path / "agentfield.yaml").write_text(f"name: {name}\nentry: main.py\ndescription: demo\n")
+    (path / "main.py").write_text("print('hi')\n")
+
+
+def test_install_local_and_resolve(tmp_path):
+    data = tmp_path / "data"
+    src = tmp_path / "src" / "mypkg"
+    _make_pkg(src, "mypkg")
+    entry = install(str(src), data)
+    assert entry["name"] == "mypkg"
+    assert (Path(entry["path"]) / "main.py").exists()
+    assert resolve_entrypoint("mypkg", data).name == "main.py"
+    assert resolve_entrypoint("unknown", data) is None
+    # duplicate install rejected without --force
+    with pytest.raises(PackageError, match="already installed"):
+        install(str(src), data)
+    install(str(src), data, force=True)
+    assert uninstall("mypkg", data)
+    assert not uninstall("mypkg", data)
+    assert load_registry(data) == {}
+
+
+def test_install_from_git(tmp_path):
+    data = tmp_path / "data"
+    repo = tmp_path / "gitpkg"
+    _make_pkg(repo, "gitpkg")
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "init"],
+    ):
+        subprocess.run(cmd, cwd=repo, check=True, capture_output=True)
+    entry = install(str(repo), data)
+    assert entry["origin"]["type"] == "git"
+    assert (Path(entry["path"]) / "agentfield.yaml").exists()
+    assert not (Path(entry["path"]) / ".git").exists()  # history stripped
+
+
+def test_install_bad_manifest(tmp_path):
+    src = tmp_path / "bad"
+    src.mkdir()
+    (src / "agentfield.yaml").write_text("entry: main.py\n")  # no name
+    with pytest.raises(PackageError, match="name"):
+        install(str(src), tmp_path / "data")
+
+
+def test_install_rejects_path_escape_names(tmp_path):
+    """A manifest name with separators must not escape the packages dir
+    (install writes there; uninstall rmtree's the recorded path)."""
+    for evil in ("../../escape", "/etc/pwned", "a/b", "..", ".hidden"):
+        src = tmp_path / "evil"
+        if src.exists():
+            import shutil
+
+            shutil.rmtree(src)
+        src.mkdir()
+        (src / "agentfield.yaml").write_text(f"name: '{evil}'\nentry: main.py\n")
+        (src / "main.py").write_text("pass\n")
+        with pytest.raises(PackageError, match="invalid package name"):
+            install(str(src), tmp_path / "data")
+
+
+def test_corrupt_registry_tolerated(tmp_path):
+    data = tmp_path / "data"
+    (data / "packages").mkdir(parents=True)
+    (data / "packages" / "installed.json").write_text("{trunc")
+    assert load_registry(data) == {}
+    assert resolve_entrypoint("anything", data) is None
+
+
+def test_multimodal_classify_and_flatten():
+    png = b"\x89PNG\r\n\x1a\n" + b"0" * 8
+    wav = b"RIFF" + b"\x00" * 4 + b"WAVE" + b"\x00" * 4
+    assert isinstance(classify("hello"), TextContent)
+    assert classify(png).mime == "image/png"
+    assert classify(b"\xff\xd8\xff123").mime == "image/jpeg"
+    assert isinstance(classify(wav), AudioContent)
+    part = ImageContent(png).to_part()
+    assert part["type"] == "image" and "data_b64" in part
+
+    assert to_text_prompt([TextContent("a"), TextContent("b")]) == "a\nb"
+    with pytest.raises(UnsupportedModalityError, match="multimodal model node"):
+        to_text_prompt([TextContent("a"), ImageContent(png)])
+    with pytest.raises(TypeError):
+        classify(123)

@@ -62,6 +62,8 @@ def read_manifest(pkg_dir: Path) -> dict:
         raise PackageError(
             f"invalid package name {name!r}: letters/digits/._- only, no separators"
         )
+    if name == "installed.json":  # would collide with the registry file
+        raise PackageError("package name 'installed.json' is reserved")
     doc["name"] = name
     doc.setdefault("entry", "main.py")
     return doc
@@ -74,7 +76,9 @@ def install(source: str, data_dir: Path, force: bool = False) -> dict:
     packages_dir.mkdir(parents=True, exist_ok=True)
 
     src = Path(source).expanduser()
-    if src.is_dir() and not (src / ".git").exists() and (src / "agentfield.yaml").exists():
+    if src.is_dir() and (src / "agentfield.yaml").exists():
+        # A local working tree wins over its git history — installing your
+        # edited-but-uncommitted agent must install what you see on disk.
         manifest = read_manifest(src)
         name = manifest["name"]
         dest = packages_dir / name
@@ -82,7 +86,7 @@ def install(source: str, data_dir: Path, force: bool = False) -> dict:
             if not force:
                 raise PackageError(f"package {name!r} already installed (use --force)")
             shutil.rmtree(dest)
-        shutil.copytree(src, dest)
+        shutil.copytree(src, dest, ignore=shutil.ignore_patterns(".git"))
         origin = {"type": "local", "path": str(src.resolve())}
     else:
         # git source (URL, or a local path that is a git repo)

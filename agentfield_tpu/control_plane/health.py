@@ -1,0 +1,107 @@
+"""Active health monitoring.
+
+The lease sweep (registry.py) only notices *silent* nodes; this monitor
+actively probes each active node's /health endpoint and aggregates the MCP
+health the agent reports — reference: HealthMonitor.checkAgentHealth
+(internal/services/health_monitor.go:190) and checkMCPHealthForNode (:331).
+Consecutive probe failures transition the node to INACTIVE through the same
+status machinery heartbeats use, so the gateway stops routing to it before
+its lease would have expired.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+import aiohttp
+
+from agentfield_tpu.control_plane.registry import NodeRegistry
+from agentfield_tpu.control_plane.types import NodeStatus
+
+
+class HealthMonitor:
+    def __init__(
+        self,
+        registry: NodeRegistry,
+        interval: float = 30.0,
+        probe_timeout: float = 5.0,
+        failure_threshold: int = 3,
+    ):
+        self.registry = registry
+        self.interval = interval
+        self.probe_timeout = probe_timeout
+        self.failure_threshold = failure_threshold
+        self._failures: dict[str, int] = {}
+        self.last_probe: dict[str, dict[str, Any]] = {}  # node_id -> probe doc
+        self._task: asyncio.Task | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.probe_timeout)
+        )
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        if self._session:
+            await self._session.close()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self.probe_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.registry.metrics.inc("health_probe_errors_total")
+
+    async def probe_all(self) -> dict[str, bool]:
+        all_nodes = self.registry.storage.list_nodes()
+        # Prune state for deregistered ids — churn must not grow these maps,
+        # and a re-registered id must not inherit a dead incarnation's probe.
+        known = {n.node_id for n in all_nodes}
+        for stale in set(self.last_probe) - known:
+            self.last_probe.pop(stale, None)
+            self._failures.pop(stale, None)
+        nodes = [n for n in all_nodes if n.status == NodeStatus.ACTIVE]
+        results = await asyncio.gather(*(self.probe_one(n) for n in nodes))
+        return {n.node_id: ok for n, ok in zip(nodes, results)}
+
+    async def probe_one(self, node) -> bool:
+        assert self._session is not None
+        doc: dict[str, Any] = {"ts": time.time(), "healthy": False}
+        try:
+            async with self._session.get(f"{node.base_url.rstrip('/')}/health") as resp:
+                body = await resp.json()
+                doc["healthy"] = resp.status == 200
+                if isinstance(body, dict):
+                    doc["mcp"] = body.get("mcp")  # agent-reported MCP summary
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            doc["error"] = repr(e)
+        self.last_probe[node.node_id] = doc
+
+        if doc["healthy"]:
+            self._failures.pop(node.node_id, None)
+            return True
+        n = self._failures.get(node.node_id, 0) + 1
+        self._failures[node.node_id] = n
+        if n >= self.failure_threshold:
+            # Same transition machinery heartbeats use — events fire and the
+            # gateway stops routing. The fence keeps the agent's own 2s
+            # heartbeats from instantly re-activating an unreachable node
+            # (flap guard); after the fence expires a heartbeat revives it
+            # and probing resumes.
+            try:
+                self.registry.fence(node.node_id, duration=self.interval * 2)
+                self.registry.heartbeat(node.node_id, {"status": "inactive"})
+            except Exception:
+                pass
+            self.registry.metrics.inc("health_deactivations_total")
+            self._failures.pop(node.node_id, None)
+        return False

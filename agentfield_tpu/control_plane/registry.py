@@ -57,6 +57,10 @@ class NodeRegistry:
         # heartbeat cadence doesn't hammer SQLite (the reference caches
         # heartbeats in memory for the same reason, nodes.go:290).
         self._last_persist: dict[str, float] = {}
+        # Health fences: while fenced, plain heartbeats may NOT auto-revive
+        # an INACTIVE node (prevents probe-deactivate / heartbeat-reactivate
+        # flapping for nodes whose advertised URL is unreachable).
+        self._fences: dict[str, float] = {}  # node_id -> fence expiry
 
     async def start(self) -> None:
         self._sweeper = asyncio.create_task(self._sweep_loop())
@@ -137,6 +141,8 @@ class NodeRegistry:
                 ) from None
         else:
             new_status = NodeStatus.ACTIVE
+            if node.status == NodeStatus.INACTIVE and self.is_fenced(node_id):
+                new_status = NodeStatus.INACTIVE  # health-fenced: stay down
         if NodeStatus.valid_transition(node.status, new_status):
             if node.status != new_status:
                 self._publish_status(node.node_id, node.status, new_status)
@@ -149,6 +155,18 @@ class NodeRegistry:
             self.storage.upsert_node(node)
             self._last_persist[node_id] = now()
         return node
+
+    def fence(self, node_id: str, duration: float) -> None:
+        self._fences[node_id] = now() + duration
+
+    def is_fenced(self, node_id: str) -> bool:
+        exp = self._fences.get(node_id)
+        if exp is None:
+            return False
+        if exp < now():
+            del self._fences[node_id]
+            return False
+        return True
 
     def deregister(self, node_id: str) -> bool:
         ok = self.storage.delete_node(node_id)

@@ -50,6 +50,7 @@ class ControlPlane:
         keystore_passphrase: str | None = None,  # None → env var or dev default
         payload_dir: str | None = None,  # None → payloads stay inline
         admin_grpc_port: int | None = None,  # reference serves admin gRPC on port+100
+        health_interval: float = 30.0,  # active probe cadence (health_monitor.go)
     ):
         from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
 
@@ -94,6 +95,9 @@ class ControlPlane:
             payloads=self.payloads,
         )
 
+        from agentfield_tpu.control_plane.health import HealthMonitor
+
+        self.health_monitor = HealthMonitor(self.registry, interval=health_interval)
         self.cleanup_interval = cleanup_interval
         self.stale_after = stale_after
         self.retention = retention
@@ -112,6 +116,7 @@ class ControlPlane:
         await self.gateway.start()
         await self.registry.start()
         await self.webhooks.start()
+        await self.health_monitor.start()
         self._cleanup_task = asyncio.create_task(self._cleanup_loop())
         # Native scan kernel compiles off-loop; requests use numpy until
         # ready. Keep a strong reference (loop tasks are weakly held).
@@ -135,6 +140,7 @@ class ControlPlane:
             await asyncio.gather(self._native_build_task, return_exceptions=True)
         if self._admin_grpc is not None:
             self._admin_grpc.stop(grace=0)
+        await self.health_monitor.stop()
         await self.webhooks.stop()
         await self.registry.stop()
         await self.gateway.stop()
@@ -260,6 +266,21 @@ def create_app(cp: ControlPlane) -> web.Application:
         except RegistryError as e:
             return _json_error(e.status, e.message)
         return web.json_response({"status": node.status.value, "ts": now()})
+
+    @routes.get("/api/v1/nodes/{node_id}/health")
+    async def node_health(req: web.Request):
+        nid = req.match_info["node_id"]
+        node = cp.storage.get_node(nid)
+        if node is None:
+            return _json_error(404, "unknown node")
+        return web.json_response(
+            {
+                "node_id": nid,
+                "status": node.status.value,
+                "last_heartbeat": node.last_heartbeat,
+                "last_probe": cp.health_monitor.last_probe.get(nid),
+            }
+        )
 
     @routes.delete("/api/v1/nodes/{node_id}")
     async def deregister(req: web.Request):

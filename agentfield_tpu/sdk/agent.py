@@ -160,6 +160,7 @@ class Agent:
         self.heartbeat_interval = heartbeat_interval
         self.client = ControlPlaneClient(control_plane)
         self.components: dict[str, ComponentDef] = {}
+        self.mcp = None  # set via attach_mcp()
         self.extra_routes: list[tuple[str, str, Any]] = []  # (method, path, handler)
         self._runner: web.AppRunner | None = None
         self._hb_task: asyncio.Task | None = None
@@ -189,6 +190,12 @@ class Agent:
     def include_router(self, router: AgentRouter) -> None:
         for comp in router.components:
             self._add_component(comp)
+
+    def attach_mcp(self, manager) -> list[str]:
+        """Register a started MCPManager's tools as skills and surface its
+        health through /health."""
+        self.mcp = manager
+        return manager.attach_to_agent(self)
 
     # -- HTTP surface ---------------------------------------------------
 
@@ -225,7 +232,11 @@ class Agent:
             return web.Response(status=202)
 
         async def health(_req):
-            return web.json_response({"status": "ok", "node_id": self.node_id})
+            doc = {"status": "ok", "node_id": self.node_id}
+            if self.mcp is not None:
+                doc["mcp"] = self.mcp.health()  # aggregated by the control
+                # plane's HealthMonitor (reference: checkMCPHealthForNode)
+            return web.json_response(doc)
 
         async def list_components(req: web.Request):
             kind = "reasoner" if req.path == "/reasoners" else "skill"
@@ -584,8 +595,14 @@ class Agent:
                     await self.start()
                     break
                 except (ControlPlaneError, aiohttp.ClientError, ConnectionError, OSError) as e:
-                    # Transient cluster/network conditions only — a programming
-                    # error must still crash with its traceback.
+                    # Retry only genuinely transient conditions. A 4xx from
+                    # registration is a config error; EADDRINUSE on a FIXED
+                    # port won't heal (port 0 re-draws, so that retries fine).
+                    if isinstance(e, ControlPlaneError) and e.status < 500:
+                        raise
+                    if isinstance(e, OSError) and not isinstance(e, ConnectionError):
+                        if requested_port != 0:
+                            raise
                     print(
                         f"[agentfield] {self.node_id}: control plane not ready "
                         f"({e!r}); retrying in {delay:.0f}s",

@@ -1,0 +1,93 @@
+"""Active health monitor + perf load generator."""
+
+import asyncio
+import sys
+
+from agentfield_tpu.control_plane.types import NodeStatus
+from agentfield_tpu.sdk import Agent
+from agentfield_tpu.sdk.mcp import MCPManager
+from tests.helpers_cp import CPHarness, async_test
+
+FAKE_MCP = {"fake": {"command": sys.executable, "args": ["tests/fake_mcp_server.py"]}}
+
+
+@async_test
+async def test_health_probe_and_deactivation():
+    async with CPHarness() as h:
+        app = Agent("probed", h.base_url)
+
+        @app.reasoner()
+        def fn() -> int:
+            return 1
+
+        await app.start()
+        try:
+            hm = h.cp.health_monitor
+            hm.failure_threshold = 2
+            res = await hm.probe_all()
+            assert res == {"probed": True}
+            assert hm.last_probe["probed"]["healthy"]
+            async with h.http.get("/api/v1/nodes/probed/health") as r:
+                doc = await r.json()
+            assert doc["last_probe"]["healthy"] and doc["status"] == "active"
+
+            # kill the agent's HTTP server but keep the registry row active
+            await app._runner.cleanup()
+            app._hb_task.cancel()
+            await hm.probe_all()  # failure 1
+            assert h.cp.storage.get_node("probed").status == NodeStatus.ACTIVE
+            await hm.probe_all()  # failure 2 → deactivated
+            assert h.cp.storage.get_node("probed").status == NodeStatus.INACTIVE
+            # routing now refuses
+            async with h.http.post("/api/v1/execute/probed.fn", json={}) as r:
+                assert r.status == 503
+            # fence: the agent's own heartbeat cannot instantly revive it
+            h.cp.registry.heartbeat("probed")
+            assert h.cp.storage.get_node("probed").status == NodeStatus.INACTIVE
+            # once the fence lapses, a heartbeat revives the node
+            h.cp.registry._fences["probed"] = 0.0
+            h.cp.registry.heartbeat("probed")
+            assert h.cp.storage.get_node("probed").status == NodeStatus.ACTIVE
+        finally:
+            await app.client.close()
+
+
+@async_test
+async def test_health_aggregates_mcp():
+    async with CPHarness() as h:
+        app = Agent("mcphealth", h.base_url)
+        mgr = MCPManager(FAKE_MCP)
+        await mgr.start_all()
+        try:
+            skills = app.attach_mcp(mgr)
+            assert "fake_add" in skills
+            await app.start()
+            await h.cp.health_monitor.probe_all()
+            probe = h.cp.health_monitor.last_probe["mcphealth"]
+            assert probe["healthy"]
+            assert probe["mcp"]["fake"]["alive"] and probe["mcp"]["fake"]["tools"] == 2
+        finally:
+            await app.stop()
+            await mgr.stop_all()
+
+
+@async_test
+async def test_load_generator_sync_and_async():
+    sys.path.insert(0, "tools/perf")
+    from tools.perf.load_gen import run_load, scrape_metrics
+
+    async with CPHarness() as h:
+        await h.register_agent()
+        report = await run_load(h.base_url, "fake-agent.echo", requests=12, concurrency=4)
+        assert report["success_rate"] == 1.0
+        assert report["statuses"] == {"completed": 12}
+        assert report["latency_ms"]["p50"] > 0
+        assert report["rps"] > 0
+
+        report = await run_load(
+            h.base_url, "fake-agent.deferred", requests=6, concurrency=3, mode="async"
+        )
+        assert report["statuses"].get("completed") == 6
+
+        metrics = await scrape_metrics(h.base_url)
+        assert any("executions_" in k for k in metrics)

@@ -56,10 +56,31 @@ def test_install_from_git(tmp_path):
         ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "init"],
     ):
         subprocess.run(cmd, cwd=repo, check=True, capture_output=True)
-    entry = install(str(repo), data)
+    # a file:// URL exercises the clone branch (a plain local dir with a
+    # manifest intentionally installs the WORKING TREE instead)
+    entry = install(f"file://{repo}", data)
     assert entry["origin"]["type"] == "git"
     assert (Path(entry["path"]) / "agentfield.yaml").exists()
     assert not (Path(entry["path"]) / ".git").exists()  # history stripped
+
+
+def test_install_local_working_tree_beats_git_history(tmp_path):
+    """Uncommitted edits install — a local dir with .git still copies the
+    working tree, not HEAD."""
+    data = tmp_path / "data"
+    repo = tmp_path / "wt"
+    _make_pkg(repo, "wt")
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "init"],
+    ):
+        subprocess.run(cmd, cwd=repo, check=True, capture_output=True)
+    (repo / "main.py").write_text("print('EDITED')\n")  # uncommitted
+    entry = install(str(repo), data)
+    assert entry["origin"]["type"] == "local"
+    assert "EDITED" in (Path(entry["path"]) / "main.py").read_text()
+    assert not (Path(entry["path"]) / ".git").exists()
 
 
 def test_install_bad_manifest(tmp_path):

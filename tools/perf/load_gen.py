@@ -1,0 +1,157 @@
+"""Async load generator for the execution gateway.
+
+Parity with the reference's perf harness (control-plane/tools/perf/
+nested_workflow_stress.py: sync/async modes, concurrency sweep, latency
+p50/p95/p99, status histograms, Prometheus pre/post scrape). Usage:
+
+    python tools/perf/load_gen.py --url http://127.0.0.1:8800 \\
+        --target mynode.myreasoner --requests 200 --concurrency 16 \\
+        [--mode sync|async] [--payload '{"x":1}'] [--scrape-metrics]
+
+Prints one JSON report to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import aiohttp
+
+
+def percentile(values: list[float], p: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(int(len(values) * p / 100), len(values) - 1)
+    return values[idx]
+
+
+async def run_load(
+    url: str,
+    target: str,
+    requests: int,
+    concurrency: int,
+    mode: str = "sync",
+    payload=None,
+    timeout: float = 120.0,
+) -> dict:
+    latencies: list[float] = []
+    statuses: dict[str, int] = {}
+    http_errors: dict[str, int] = {}
+    sem = asyncio.Semaphore(concurrency)
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout)
+    ) as session:
+
+        async def one(i: int) -> None:
+            async with sem:
+                t0 = time.perf_counter()
+                try:
+                    if mode == "sync":
+                        async with session.post(
+                            f"{url}/api/v1/execute/{target}", json={"input": payload}
+                        ) as resp:
+                            doc = await resp.json()
+                            status = doc.get("status", f"http_{resp.status}")
+                    else:
+                        async with session.post(
+                            f"{url}/api/v1/execute/async/{target}", json={"input": payload}
+                        ) as resp:
+                            if resp.status == 503:
+                                status = "backpressure_503"
+                            else:
+                                eid = (await resp.json())["execution_id"]
+                                status = await _poll(session, url, eid, timeout)
+                    statuses[status] = statuses.get(status, 0) + 1
+                    latencies.append(time.perf_counter() - t0)
+                except Exception as e:
+                    http_errors[type(e).__name__] = http_errors.get(type(e).__name__, 0) + 1
+
+        t_start = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(requests)))
+        elapsed = time.perf_counter() - t_start
+
+    ok = statuses.get("completed", 0)
+    return {
+        "target": target,
+        "mode": mode,
+        "requests": requests,
+        "concurrency": concurrency,
+        "elapsed_s": round(elapsed, 3),
+        "rps": round(len(latencies) / elapsed, 2) if elapsed else 0,
+        "success_rate": round(ok / requests, 4),
+        "latency_ms": {
+            "p50": round(percentile(latencies, 50) * 1e3, 1),
+            "p95": round(percentile(latencies, 95) * 1e3, 1),
+            "p99": round(percentile(latencies, 99) * 1e3, 1),
+        },
+        "statuses": statuses,
+        "errors": http_errors,
+    }
+
+
+async def _poll(session, url: str, eid: str, timeout: float) -> str:
+    deadline = time.monotonic() + timeout
+    interval = 0.02
+    while time.monotonic() < deadline:
+        async with session.get(f"{url}/api/v1/executions/{eid}") as resp:
+            doc = await resp.json()
+        if doc.get("status") in ("completed", "failed", "timeout"):
+            return doc["status"]
+        await asyncio.sleep(interval)
+        interval = min(interval * 1.5, 0.5)
+    return "poll_timeout"
+
+
+async def scrape_metrics(url: str) -> dict:
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/metrics") as resp:
+                text = await resp.text()
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name, value = line.rsplit(" ", 1)
+            if any(k in name for k in ("backpressure", "queue_depth", "executions_")):
+                out[name] = float(value)
+        return out
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:8800")
+    ap.add_argument("--target", required=True)
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--mode", choices=("sync", "async"), default="sync")
+    ap.add_argument("--payload", default=None, help="JSON input payload")
+    ap.add_argument("--scrape-metrics", action="store_true")
+    args = ap.parse_args()
+
+    payload = json.loads(args.payload) if args.payload else None
+    report = {}
+    if args.scrape_metrics:
+        report["metrics_before"] = await scrape_metrics(args.url)
+    report.update(
+        await run_load(
+            args.url, args.target, args.requests, args.concurrency, args.mode, payload
+        )
+    )
+    if args.scrape_metrics:
+        report["metrics_after"] = await scrape_metrics(args.url)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

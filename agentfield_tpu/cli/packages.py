@@ -62,8 +62,9 @@ def read_manifest(pkg_dir: Path) -> dict:
         raise PackageError(
             f"invalid package name {name!r}: letters/digits/._- only, no separators"
         )
-    if name == "installed.json":  # would collide with the registry file
-        raise PackageError("package name 'installed.json' is reserved")
+    if name in ("installed.json", "installed.tmp"):  # registry file + its
+        # atomic-write temp — a package dir at either path wedges the registry
+        raise PackageError(f"package name {name!r} is reserved")
     doc["name"] = name
     doc.setdefault("entry", "main.py")
     return doc
@@ -86,7 +87,14 @@ def install(source: str, data_dir: Path, force: bool = False) -> dict:
             if not force:
                 raise PackageError(f"package {name!r} already installed (use --force)")
             shutil.rmtree(dest)
-        shutil.copytree(src, dest, ignore=shutil.ignore_patterns(".git"))
+        shutil.copytree(
+            src,
+            dest,
+            ignore=shutil.ignore_patterns(
+                ".git", "__pycache__", "*.pyc", ".venv", "venv", ".env",
+                "node_modules", ".pytest_cache",
+            ),
+        )
         origin = {"type": "local", "path": str(src.resolve())}
     else:
         # git source (URL, or a local path that is a git repo)

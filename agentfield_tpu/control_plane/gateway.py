@@ -36,6 +36,10 @@ from agentfield_tpu.control_plane.types import (
     now,
 )
 
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("gateway")
+
 EXEC_TOPIC = "executions"
 
 CONTEXT_HEADERS = (
@@ -316,6 +320,13 @@ class ExecutionGateway:
         ex.finished_at = now()
         self.storage.update_execution(ex)
         self.metrics.inc(f"gateway_executions_{ex.status.value}_total")
+        log.info(
+            "execution terminal",
+            execution_id=ex.execution_id,
+            target=ex.target,
+            status=ex.status.value,
+            error=ex.error,
+        )
         if ex.started_at:
             self.metrics.observe("execution_duration_seconds", ex.finished_at - ex.started_at)
         self._publish(ex)

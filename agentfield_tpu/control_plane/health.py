@@ -103,5 +103,12 @@ class HealthMonitor:
             except Exception:
                 pass
             self.registry.metrics.inc("health_deactivations_total")
+            from agentfield_tpu.logging import get_logger
+
+            get_logger("health").warning(
+                "node deactivated by health probe",
+                node_id=node.node_id,
+                error=doc.get("error"),
+            )
             self._failures.pop(node.node_id, None)
         return False

@@ -24,6 +24,10 @@ from agentfield_tpu.control_plane.types import (
     now,
 )
 
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("registry")
+
 NODE_TOPIC = "nodes"
 
 
@@ -172,10 +176,12 @@ class NodeRegistry:
         ok = self.storage.delete_node(node_id)
         if ok:
             self._last_persist.pop(node_id, None)
+            self._fences.pop(node_id, None)
             self.bus.publish(NODE_TOPIC, {"type": "deregistered", "node_id": node_id, "ts": now()})
         return ok
 
     def _publish_status(self, node_id: str, old: NodeStatus, new: NodeStatus) -> None:
+        log.info("node status changed", node_id=node_id, old=old.value, new=new.value)
         self.bus.publish(
             NODE_TOPIC,
             {

@@ -1,0 +1,95 @@
+"""Structured logging.
+
+Parity with the reference's zerolog-based global logger (internal/logger/):
+key-value structured records, JSON or console rendering, level from config or
+AGENTFIELD_LOG_LEVEL / AGENTFIELD_LOG_FORMAT env. Stdlib-logging based so
+third-party handlers compose.
+
+Usage:
+    from agentfield_tpu.logging import get_logger
+    log = get_logger("gateway")
+    log.info("execution completed", execution_id=eid, duration_ms=12.3)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any
+
+_CONFIGURED = False
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        doc.update(getattr(record, "fields", {}))
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, default=str)
+
+
+class _ConsoleFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        fields = getattr(record, "fields", {})
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        base = f"{ts} {record.levelname[:4]} [{record.name}] {record.getMessage()}"
+        return f"{base} {kv}".rstrip()
+
+
+class StructuredAdapter(logging.LoggerAdapter):
+    """kwargs become structured fields: log.info("msg", key=value)."""
+
+    def _log_kv(self, level: int, msg: str, kwargs: dict[str, Any]) -> None:
+        exc_info = kwargs.pop("exc_info", None)
+        self.logger.log(level, msg, extra={"fields": kwargs}, exc_info=exc_info)
+
+    def debug(self, msg, *args, **kw):  # type: ignore[override]
+        self._log_kv(logging.DEBUG, msg, kw)
+
+    def info(self, msg, *args, **kw):  # type: ignore[override]
+        self._log_kv(logging.INFO, msg, kw)
+
+    def warning(self, msg, *args, **kw):  # type: ignore[override]
+        self._log_kv(logging.WARNING, msg, kw)
+
+    def error(self, msg, *args, **kw):  # type: ignore[override]
+        self._log_kv(logging.ERROR, msg, kw)
+
+
+class _DynamicStderrHandler(logging.StreamHandler):
+    """Always writes to the CURRENT sys.stderr — survives redirection and
+    pytest's per-test capture swapping (a cached stream goes stale)."""
+
+    def emit(self, record):
+        self.stream = sys.stderr
+        super().emit(record)
+
+
+def configure(level: str | None = None, fmt: str | None = None) -> None:
+    """Idempotent root setup. fmt: "json" | "console"."""
+    global _CONFIGURED
+    level = (level or os.environ.get("AGENTFIELD_LOG_LEVEL", "info")).upper()
+    fmt = fmt or os.environ.get("AGENTFIELD_LOG_FORMAT", "console")
+    root = logging.getLogger("agentfield")
+    root.setLevel(getattr(logging, level, logging.INFO))
+    if not _CONFIGURED:
+        handler = _DynamicStderrHandler()
+        handler.setFormatter(_JsonFormatter() if fmt == "json" else _ConsoleFormatter())
+        root.addHandler(handler)
+        root.propagate = False
+        _CONFIGURED = True
+
+
+def get_logger(name: str) -> StructuredAdapter:
+    configure()
+    return StructuredAdapter(logging.getLogger(f"agentfield.{name}"), {})

@@ -598,11 +598,17 @@ class Agent:
                     # Retry only genuinely transient conditions. A 4xx from
                     # registration is a config error; EADDRINUSE on a FIXED
                     # port won't heal (port 0 re-draws, so that retries fine).
+                    # Order matters: aiohttp.ClientError (network to the
+                    # control plane, incl. ClientConnectorError which IS an
+                    # OSError but not a ConnectionError) must retry.
                     if isinstance(e, ControlPlaneError) and e.status < 500:
                         raise
-                    if isinstance(e, OSError) and not isinstance(e, ConnectionError):
-                        if requested_port != 0:
-                            raise
+                    if (
+                        not isinstance(e, (aiohttp.ClientError, ConnectionError))
+                        and isinstance(e, OSError)
+                        and requested_port != 0
+                    ):
+                        raise
                     print(
                         f"[agentfield] {self.node_id}: control plane not ready "
                         f"({e!r}); retrying in {delay:.0f}s",

@@ -15,10 +15,39 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
+
+_done = threading.Event()
+
+
+def _watchdog(seconds: float) -> None:
+    """The TPU tunnel in this environment can wedge at first computation
+    (claim never granted). A hung bench must still honor the one-JSON-line
+    contract: report the outage and exit instead of blocking the driver."""
+    if not _done.wait(seconds):
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_throughput_unavailable",
+                    "value": 0,
+                    "unit": "tok/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"bench did not complete within {seconds:.0f}s "
+                    "(TPU backend likely unavailable/wedged)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
 
 
 def main() -> None:
+    threading.Thread(
+        target=_watchdog,
+        args=(float(os.environ.get("AGENTFIELD_BENCH_WATCHDOG", "900")),),
+        daemon=True,
+    ).start()
     if os.environ.get("AGENTFIELD_BENCH_CPU") == "1":
         from agentfield_tpu._compat import force_cpu_backend
 
@@ -104,6 +133,7 @@ def main() -> None:
             }
         )
     )
+    _done.set()
 
 
 if __name__ == "__main__":

@@ -73,7 +73,6 @@ async def test_health_aggregates_mcp():
 
 @async_test
 async def test_load_generator_sync_and_async():
-    sys.path.insert(0, "tools/perf")
     from tools.perf.load_gen import run_load, scrape_metrics
 
     async with CPHarness() as h:

@@ -1,0 +1,31 @@
+import json
+import logging
+
+from agentfield_tpu.logging import _JsonFormatter, configure, get_logger
+
+
+def test_structured_fields_and_json(capsys):
+    configure(level="debug", fmt="json")
+    log = get_logger("testmod")
+    # swap the handler formatter to JSON for this assertion regardless of env
+    for h in logging.getLogger("agentfield").handlers:
+        h.setFormatter(_JsonFormatter())
+    log.info("execution completed", execution_id="e1", duration_ms=12.3)
+    err = capsys.readouterr().err.strip().splitlines()[-1]
+    doc = json.loads(err)
+    assert doc["msg"] == "execution completed"
+    assert doc["execution_id"] == "e1" and doc["duration_ms"] == 12.3
+    assert doc["logger"] == "agentfield.testmod"
+    assert doc["level"] == "info"
+
+
+def test_console_format(capsys):
+    configure()
+    from agentfield_tpu.logging import _ConsoleFormatter
+
+    for h in logging.getLogger("agentfield").handlers:
+        h.setFormatter(_ConsoleFormatter())
+    log = get_logger("console")
+    log.warning("node down", node_id="n1")
+    err = capsys.readouterr().err
+    assert "node down" in err and "node_id=n1" in err and "WARN" in err

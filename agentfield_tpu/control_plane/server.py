@@ -288,6 +288,34 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(404, "unknown node")
         return web.json_response({"deleted": True})
 
+    # -- reasoners (REST complement to the admin gRPC surface) ----------
+
+    @routes.get("/api/v1/reasoners")
+    async def list_reasoners(_req):
+        out = []
+        for node in cp.storage.list_nodes():
+            for r in node.reasoners:
+                out.append(
+                    {
+                        "node_id": node.node_id,
+                        "id": r.id,
+                        "target": f"{node.node_id}.{r.id}",
+                        "description": r.description,
+                        "input_schema": r.input_schema,
+                        "did": r.did,
+                        "node_status": node.status.value,
+                    }
+                )
+        return web.json_response({"reasoners": out})
+
+    @routes.get("/api/v1/reasoners/{target}/metrics")
+    async def reasoner_metrics(req: web.Request):
+        target = req.match_info["target"]
+        doc = cp.storage.target_metrics(target)
+        if not doc["executions"]:
+            return _json_error(404, f"no executions recorded for target {target!r}")
+        return web.json_response(doc)
+
     # -- execution ------------------------------------------------------
 
     def _headers(req: web.Request) -> dict[str, str]:

@@ -199,6 +199,56 @@ class SQLiteStorage:
             rows = self._conn.execute(q, args).fetchall()
         return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
 
+    def target_metrics(self, target: str) -> dict[str, Any]:
+        """Per-reasoner/skill performance rollup in SQL (reference: per-
+        reasoner metrics, storage.go:116-118 + handlers/reasoners.go)."""
+        with self._lock:
+            row = self._conn.execute(
+                """
+                SELECT COUNT(*) AS n,
+                       SUM(status = 'completed') AS ok,
+                       SUM(status IN ('failed', 'timeout')) AS bad,
+                       MIN(created_at) AS first_seen,
+                       MAX(created_at) AS last_seen
+                FROM executions WHERE target = ?
+                """,
+                (target,),
+            ).fetchone()
+            durations = [
+                r["d"]
+                for r in self._conn.execute(
+                    """
+                    SELECT finished_at - created_at AS d FROM executions
+                    WHERE target = ? AND finished_at IS NOT NULL
+                    ORDER BY created_at DESC LIMIT 1000
+                    """,
+                    (target,),
+                ).fetchall()
+                if r["d"] is not None
+            ]
+        durations.sort()
+
+        def pct(p: float) -> float | None:
+            if not durations:
+                return None
+            return round(durations[min(int(len(durations) * p), len(durations) - 1)], 4)
+
+        ok, bad = row["ok"] or 0, row["bad"] or 0
+        terminal = ok + bad
+        return {
+            "target": target,
+            "executions": row["n"],
+            "completed": ok,
+            "failed": bad,
+            "in_flight": row["n"] - terminal,
+            # Rate over TERMINAL executions only — running work is neither
+            # success nor failure.
+            "success_rate": round(ok / terminal, 4) if terminal else None,
+            "duration_s": {"p50": pct(0.5), "p95": pct(0.95), "p99": pct(0.99)},
+            "first_seen": row["first_seen"],
+            "last_seen": row["last_seen"],
+        }
+
     def execution_counts(self) -> dict[str, int]:
         """Exact per-status counts via SQL aggregation (dashboard hot path)."""
         with self._lock:

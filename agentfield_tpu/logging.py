@@ -47,23 +47,24 @@ class _ConsoleFormatter(logging.Formatter):
 
 
 class StructuredAdapter(logging.LoggerAdapter):
-    """kwargs become structured fields: log.info("msg", key=value)."""
+    """kwargs become structured fields: log.info("msg", key=value). Stdlib
+    %-style positional args still interpolate: log.info("x %s", v)."""
 
-    def _log_kv(self, level: int, msg: str, kwargs: dict[str, Any]) -> None:
+    def _log_kv(self, level: int, msg: str, args: tuple, kwargs: dict[str, Any]) -> None:
         exc_info = kwargs.pop("exc_info", None)
-        self.logger.log(level, msg, extra={"fields": kwargs}, exc_info=exc_info)
+        self.logger.log(level, msg, *args, extra={"fields": kwargs}, exc_info=exc_info)
 
     def debug(self, msg, *args, **kw):  # type: ignore[override]
-        self._log_kv(logging.DEBUG, msg, kw)
+        self._log_kv(logging.DEBUG, msg, args, kw)
 
     def info(self, msg, *args, **kw):  # type: ignore[override]
-        self._log_kv(logging.INFO, msg, kw)
+        self._log_kv(logging.INFO, msg, args, kw)
 
     def warning(self, msg, *args, **kw):  # type: ignore[override]
-        self._log_kv(logging.WARNING, msg, kw)
+        self._log_kv(logging.WARNING, msg, args, kw)
 
     def error(self, msg, *args, **kw):  # type: ignore[override]
-        self._log_kv(logging.ERROR, msg, kw)
+        self._log_kv(logging.ERROR, msg, args, kw)
 
 
 class _DynamicStderrHandler(logging.StreamHandler):
@@ -76,18 +77,28 @@ class _DynamicStderrHandler(logging.StreamHandler):
 
 
 def configure(level: str | None = None, fmt: str | None = None) -> None:
-    """Idempotent root setup. fmt: "json" | "console"."""
+    """Root setup. First call (usually implicit via get_logger) applies env
+    defaults; later EXPLICIT calls re-apply level/formatter; later implicit
+    calls are no-ops — an operator's configure(level="debug", fmt="json")
+    sticks regardless of import order."""
     global _CONFIGURED
-    level = (level or os.environ.get("AGENTFIELD_LOG_LEVEL", "info")).upper()
-    fmt = fmt or os.environ.get("AGENTFIELD_LOG_FORMAT", "console")
     root = logging.getLogger("agentfield")
-    root.setLevel(getattr(logging, level, logging.INFO))
     if not _CONFIGURED:
+        eff_level = (level or os.environ.get("AGENTFIELD_LOG_LEVEL", "info")).upper()
+        eff_fmt = fmt or os.environ.get("AGENTFIELD_LOG_FORMAT", "console")
+        root.setLevel(getattr(logging, eff_level, logging.INFO))
         handler = _DynamicStderrHandler()
-        handler.setFormatter(_JsonFormatter() if fmt == "json" else _ConsoleFormatter())
+        handler.setFormatter(_JsonFormatter() if eff_fmt == "json" else _ConsoleFormatter())
         root.addHandler(handler)
         root.propagate = False
         _CONFIGURED = True
+        return
+    if level is not None:
+        root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    if fmt is not None:
+        formatter = _JsonFormatter() if fmt == "json" else _ConsoleFormatter()
+        for h in root.handlers:
+            h.setFormatter(formatter)
 
 
 def get_logger(name: str) -> StructuredAdapter:

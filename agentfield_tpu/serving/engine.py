@@ -58,6 +58,10 @@ class EngineConfig:
     prefill_chunk: int | None = None  # chunk long prefills to this many tokens:
     # bounds compiled bucket shapes and keeps decode latency fair under long
     # prompts (chunks run through the cached-page attention path)
+    decode_buckets: tuple[int, ...] | None = None  # e.g. (4, 16): when fewer
+    # slots are active, compact them into the smallest bucket width — the
+    # unembed/attention cost scales with batch width, so low-occupancy decode
+    # stops paying for max_batch (one extra compile per bucket)
     dtype: str | None = None
 
     @property
@@ -262,8 +266,13 @@ class InferenceEngine:
             check_divisibility(cfg, mesh.shape[AXIS_MODEL], paged_kv=True)
             params = shard_params(params, cfg, mesh)
         self.params = params
+        # KV pages must match the params' compute dtype (f32 params writing
+        # into bf16 pages is a lossy scatter and a future jax error).
+        cache_dtype = self.ecfg.dtype or str(
+            jax.tree.leaves(params)[0].dtype if jax.tree.leaves(params) else cfg.dtype
+        )
         self.cache = PagedKVCache.create(
-            cfg, self.ecfg.num_pages, self.ecfg.page_size, self.ecfg.dtype, mesh=mesh
+            cfg, self.ecfg.num_pages, self.ecfg.page_size, cache_dtype, mesh=mesh
         )
         self.allocator = PageAllocator(self.ecfg.num_pages)
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
@@ -286,6 +295,9 @@ class InferenceEngine:
         # numpy shadows only when admission/release dirties them.
         self._dirty = True
         self._dev: dict[str, jax.Array] = {}
+        # Compact-decode device state, valid while the active-slot membership
+        # is unchanged (admission/release invalidates it).
+        self._compact: dict[str, Any] | None = None
         # Counters (exported via the control plane's /metrics, mirroring the
         # reference's gateway gauges, internal/services/execution_metrics.go:14-44)
         self.stats = {
@@ -436,6 +448,7 @@ class InferenceEngine:
             self.top_ks[free_slot] = s.top_k
             self.top_ps[free_slot] = s.top_p
         self._dirty = True
+        self._compact = None  # membership changed
         return [event]
 
     def _prefill(self, tokens: list[int], start: int, row: np.ndarray):
@@ -528,6 +541,7 @@ class InferenceEngine:
         self.top_ks[slot_idx] = 0
         self.top_ps[slot_idx] = 1.0
         self._dirty = True
+        self._compact = None  # membership changed
 
     def step(self) -> list[TokenEvent]:
         """One scheduler tick: admit (prefill) if possible, else decode."""
@@ -537,6 +551,37 @@ class InferenceEngine:
         if self.num_active == 0:
             return []
 
+        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
+        bucket = self._pick_decode_bucket(len(active_idx))
+        if bucket is not None:
+            next_by_slot = self._decode_compact(active_idx, bucket)
+        else:
+            next_by_slot = self._decode_full()
+        self.stats["decode_steps"] += 1
+
+        out: list[TokenEvent] = []
+        for i in active_idx:
+            slot = self.slots[i]
+            slot.length += 1
+            slot.generated += 1
+            tok = next_by_slot[i]
+            slot.last_token = tok
+            slot.tokens.append(tok)
+            self.seq_lens[i] = slot.length
+            self.last_tokens[i] = tok
+            self.stats["decode_tokens"] += 1
+            out.append(self._emit(i, slot, tok))
+        return out
+
+    def _pick_decode_bucket(self, n_active: int) -> int | None:
+        if not self.ecfg.decode_buckets:
+            return None
+        for b in sorted(self.ecfg.decode_buckets):
+            if n_active <= b < self.ecfg.max_batch:
+                return b
+        return None
+
+    def _decode_full(self) -> dict[int, int]:
         if self._dirty:
             self._dev = {
                 "tokens": jnp.asarray(self.last_tokens),
@@ -562,22 +607,57 @@ class InferenceEngine:
         )
         d["tokens"], d["seq_lens"] = next_tokens, new_seq_lens
         next_np = np.asarray(next_tokens)
-        self.stats["decode_steps"] += 1
+        return {i: int(next_np[i]) for i, s in enumerate(self.slots) if s is not None}
 
-        out: list[TokenEvent] = []
-        for i, slot in enumerate(self.slots):
-            if slot is None:
-                continue
-            slot.length += 1
-            slot.generated += 1
-            tok = int(next_np[i])
-            slot.last_token = tok
-            slot.tokens.append(tok)
-            self.seq_lens[i] = slot.length
-            self.last_tokens[i] = tok
-            self.stats["decode_tokens"] += 1
-            out.append(self._emit(i, slot, tok))
-        return out
+    def _decode_compact(self, active_idx: list[int], bucket: int) -> dict[int, int]:
+        """Low-occupancy step: gather the active slots' control rows into a
+        [bucket]-wide batch (padding rows are inert: seq_len 0 writes to the
+        garbage page). The jitted decode retraces once per bucket width.
+        While membership is stable the compact control state stays
+        device-resident (tokens/seq_lens advance on-device via the decode
+        return); admission/release invalidates it."""
+        key = (tuple(active_idx), bucket)
+        c = self._compact
+        if c is None or c["key"] != key:
+            n = len(active_idx)
+            tokens = np.zeros((bucket,), np.int32)
+            seq_lens = np.zeros((bucket,), np.int32)
+            page_tables = np.zeros((bucket, self.ecfg.max_pages_per_seq), np.int32)
+            temps = np.zeros((bucket,), np.float32)
+            top_ks = np.zeros((bucket,), np.int32)
+            top_ps = np.ones((bucket,), np.float32)
+            tokens[:n] = self.last_tokens[active_idx]
+            seq_lens[:n] = self.seq_lens[active_idx]
+            page_tables[:n] = self.page_tables[active_idx]
+            temps[:n] = self.temps[active_idx]
+            top_ks[:n] = self.top_ks[active_idx]
+            top_ps[:n] = self.top_ps[active_idx]
+            c = self._compact = {
+                "key": key,
+                "tokens": jnp.asarray(tokens),
+                "seq_lens": jnp.asarray(seq_lens),
+                "page_tables": jnp.asarray(page_tables),
+                "temps": jnp.asarray(temps),
+                "top_ks": jnp.asarray(top_ks),
+                "top_ps": jnp.asarray(top_ps),
+            }
+
+        next_tokens, new_seq_lens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            c["tokens"],
+            c["seq_lens"],
+            c["page_tables"],
+            self._next_rng(),
+            c["temps"],
+            c["top_ks"],
+            c["top_ps"],
+        )
+        c["tokens"], c["seq_lens"] = next_tokens, new_seq_lens
+        self._dirty = True  # full-width device state is now stale
+        next_np = np.asarray(next_tokens)
+        return {slot_i: int(next_np[j]) for j, slot_i in enumerate(active_idx)}
 
     def run_to_completion(self, requests: list[Request]) -> dict[str, list[int]]:
         """Convenience driver: submit everything, step until drained, return

@@ -43,11 +43,9 @@ def _watchdog(seconds: float) -> None:
 
 
 def main() -> None:
-    threading.Thread(
-        target=_watchdog,
-        args=(float(os.environ.get("AGENTFIELD_BENCH_WATCHDOG", "900")),),
-        daemon=True,
-    ).start()
+    watchdog_s = float(os.environ.get("AGENTFIELD_BENCH_WATCHDOG", "900"))
+    if watchdog_s > 0:  # <= 0 disables the watchdog
+        threading.Thread(target=_watchdog, args=(watchdog_s,), daemon=True).start()
     if os.environ.get("AGENTFIELD_BENCH_CPU") == "1":
         from agentfield_tpu._compat import force_cpu_backend
 

@@ -293,6 +293,31 @@ async def test_restart_orphan_cleanup():
         assert h.cp.storage.get_execution("exec_orphan").status == ExecutionStatus.TIMEOUT
 
 
+@async_test
+async def test_reasoner_listing_and_metrics():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.get("/api/v1/reasoners") as r:
+            rs = (await r.json())["reasoners"]
+        targets = {x["target"] for x in rs}
+        assert "fake-agent.echo" in targets and "fake-agent.boom" in targets
+        # generate some history: 3 successes, 1 failure
+        for _ in range(3):
+            async with h.http.post("/api/v1/execute/fake-agent.echo", json={"input": 1}) as r:
+                assert (await r.json())["status"] == "completed"
+        async with h.http.post("/api/v1/execute/fake-agent.boom", json={}) as r:
+            assert (await r.json())["status"] == "failed"
+        async with h.http.get("/api/v1/reasoners/fake-agent.echo/metrics") as r:
+            m = await r.json()
+        assert m["executions"] == 3 and m["success_rate"] == 1.0
+        assert m["duration_s"]["p50"] is not None and m["duration_s"]["p50"] >= 0
+        async with h.http.get("/api/v1/reasoners/fake-agent.boom/metrics") as r:
+            m = await r.json()
+        assert m["failed"] == 1 and m["success_rate"] == 0.0
+        async with h.http.get("/api/v1/reasoners/ghost.fn/metrics") as r:
+            assert r.status == 404
+
+
 def test_node_status_transitions():
     ok = NodeStatus.valid_transition
     assert ok(NodeStatus.STARTING, NodeStatus.ACTIVE)

@@ -1,0 +1,90 @@
+"""Decode batch bucketing: low-occupancy compaction must be token-identical
+to the full-width path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.llama import generate_greedy
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+CFG = get_config("llama-tiny")
+BASE = EngineConfig(max_batch=8, page_size=8, num_pages=128, max_pages_per_seq=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompt(key, n):
+    return jax.random.randint(jax.random.PRNGKey(key), (n,), 0, CFG.vocab_size, jnp.int32).tolist()
+
+
+def test_bucketed_matches_oracle(params):
+    ecfg = dataclasses.replace(BASE, decode_buckets=(2, 4))
+    engine = InferenceEngine(params, CFG, ecfg)
+    prompts = [_prompt(i, 5 + i) for i in range(3)]  # 3 active → bucket 4
+    results = engine.run_to_completion(
+        [
+            Request(id=f"r{i}", prompt=p, sampling=SamplingParams(max_new_tokens=6))
+            for i, p in enumerate(prompts)
+        ]
+    )
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=6, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle, f"r{i} diverged under bucketed decode"
+
+
+def test_bucket_selection(params):
+    ecfg = dataclasses.replace(BASE, decode_buckets=(2, 4))
+    engine = InferenceEngine(params, CFG, ecfg)
+    assert engine._pick_decode_bucket(1) == 2
+    assert engine._pick_decode_bucket(2) == 2
+    assert engine._pick_decode_bucket(3) == 4
+    assert engine._pick_decode_bucket(5) is None  # falls back to full width
+    assert InferenceEngine(params, CFG, BASE)._pick_decode_bucket(1) is None
+
+
+def test_transition_between_bucket_and_full(params):
+    """Occupancy crossing the bucket boundary mid-run (full→compact→full)
+    stays correct — the dirty flag must resync device state."""
+    ecfg = dataclasses.replace(BASE, max_batch=4, decode_buckets=(2,))
+    engine = InferenceEngine(params, CFG, ecfg)
+    # 4 concurrent (full width), finishing at different times → drops to
+    # compact width as slots free
+    prompts = [_prompt(10 + i, 4) for i in range(4)]
+    reqs = [
+        Request(id=f"r{i}", prompt=p, sampling=SamplingParams(max_new_tokens=3 + 2 * i))
+        for i, p in enumerate(prompts)
+    ]
+    results = engine.run_to_completion(reqs)
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=3 + 2 * i, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle
+
+
+def test_bucketed_with_sessions(params):
+    ecfg = dataclasses.replace(BASE, decode_buckets=(2,))
+    engine = InferenceEngine(params, CFG, ecfg)
+    t1 = _prompt(20, 6)
+    out1 = engine.run_to_completion(
+        [Request(id="a", prompt=t1, sampling=SamplingParams(max_new_tokens=3), session_id="s")]
+    )["a"]
+    t2 = t1 + out1 + _prompt(21, 2)
+    out2 = engine.run_to_completion(
+        [Request(id="b", prompt=t2, sampling=SamplingParams(max_new_tokens=3), session_id="s")]
+    )["b"]
+    fresh = InferenceEngine(params, CFG, BASE)
+    expected = fresh.run_to_completion(
+        [Request(id="b", prompt=t2, sampling=SamplingParams(max_new_tokens=3))]
+    )["b"]
+    assert out2 == expected
+    assert engine.stats["prefix_cache_hits"] == 1

@@ -1,0 +1,99 @@
+"""ReAct-style tool-calling reasoner (north-star config 2 scaffold).
+
+The loop: the model proposes an action as JSON (`ai()` with a schema), the
+agent executes the matching SKILL (local or MCP-attached), appends the
+observation to the session-scoped history (prefix-cached on the model node),
+and repeats until the model emits a final answer or the step budget runs out.
+With a real checkpoint behind the model node this is the full ReAct pattern;
+with demo random weights the schema-parse fails fast and the agent reports
+how far it got — the orchestration scaffold is what this example shows.
+
+Usage: python examples/react_agent.py [control_plane_url]
+Then:  curl -X POST $CP/api/v1/execute/react-agent.solve \
+            -H 'X-Session-ID: demo' -d '{"input":{"question":"what is 2+40?"}}'
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from agentfield_tpu.sdk import Agent
+from agentfield_tpu.sdk.structured import StructuredOutputError
+
+ACTION_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "thought": {"type": "string"},
+        "action": {"type": "string", "enum": ["calculate", "lookup", "final"]},
+        "argument": {"type": "string"},
+    },
+    "required": ["action", "argument"],
+}
+
+
+def build(cp_url: str) -> Agent:
+    app = Agent("react-agent", cp_url)
+
+    @app.skill(description="Evaluate a basic arithmetic expression")
+    def calculate(expression: str) -> str:
+        allowed = set("0123456789+-*/(). ")
+        # '**' is in the charset via '*', but 9**9**9999 would grind the
+        # event loop; model-proposed inputs are untrusted.
+        if not set(expression) <= allowed or "**" in expression or len(expression) > 200:
+            return "error: only basic arithmetic allowed"
+        try:
+            return str(eval(expression, {"__builtins__": {}}, {}))  # noqa: S307
+        except Exception as e:
+            return f"error: {e}"
+
+    @app.skill(description="Look a term up in shared memory")
+    async def lookup(term: str) -> str:
+        value = await app.memory.memory_get(term, default=None)
+        return "not found" if value is None else str(value)
+
+    @app.reasoner(description="ReAct loop: reason + act with tools until final")
+    async def solve(question: str, max_steps: int = 4) -> dict:
+        history = f"Question: {question}"
+        trace = []
+        for step in range(max_steps):
+            try:
+                out = await app.ai(prompt=history, max_new_tokens=64, schema=ACTION_SCHEMA)
+                action = out["parsed"]
+            except (StructuredOutputError, RuntimeError) as e:
+                return {
+                    "answer": None,
+                    "trace": trace,
+                    "stopped": f"model output unparseable at step {step}: {e}",
+                }
+            trace.append(action)
+            await app.note({"step": step, "action": action})
+            if action["action"] == "final":
+                return {"answer": action["argument"], "trace": trace, "stopped": "final"}
+            if action["action"] == "calculate":
+                observation = await asyncio.to_thread(calculate, action["argument"])
+            else:
+                observation = await lookup(action["argument"])
+            history += (
+                f"\nThought: {action.get('thought', '')}"
+                f"\nAction: {action['action']}({action['argument']})"
+                f"\nObservation: {observation}"
+            )
+        return {"answer": None, "trace": trace, "stopped": "step budget exhausted"}
+
+    return app
+
+
+async def main() -> None:
+    cp_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8800"
+    app = build(cp_url)
+    await app.start()
+    print(f"react-agent registered at :{app.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await app.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

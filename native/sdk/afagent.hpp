@@ -1,0 +1,335 @@
+// agentfield_tpu C++ agent SDK (header-only).
+//
+// Parity role: the reference ships a minimal Go SDK alongside the Python one
+// (sdk/go/agent/agent.go:93: register reasoners, HTTP server, register with
+// the control plane, gateway Call()). This is the TPU build's second-language
+// SDK in C++ (no Go toolchain in the image): a blocking HTTP/1.1 server over
+// POSIX sockets dispatching reasoner callbacks, control-plane registration,
+// a 2s heartbeat thread, and a gateway execute() client.
+//
+// Wire contract (matches control_plane/gateway.py):
+//   inbound  POST /reasoners/<id>  body {"input":...,"execution_id":...}
+//            -> 200 {"result": <handler JSON>}   (direct completion)
+//   outbound POST <cp>/api/v1/nodes        registration
+//            POST <cp>/api/v1/nodes/<id>/heartbeat
+//            POST <cp>/api/v1/execute/<target>
+//
+// Handlers receive the raw request-body JSON and return a JSON value string;
+// bring your own JSON library for structured access (kept dependency-free).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cerrno>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace afield {
+
+struct Url {
+    std::string host;
+    int port;
+    std::string path;
+};
+
+inline Url parse_url(const std::string& url) {
+    Url u{"127.0.0.1", 80, "/"};
+    auto rest = url;
+    auto scheme = rest.find("://");
+    if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+    auto slash = rest.find('/');
+    std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+    if (slash != std::string::npos) u.path = rest.substr(slash);
+    auto colon = hostport.find(':');
+    if (colon != std::string::npos) {
+        u.host = hostport.substr(0, colon);
+        u.port = std::stoi(hostport.substr(colon + 1));
+    } else {
+        u.host = hostport;
+    }
+    return u;
+}
+
+struct HttpResponse {
+    int status = 0;
+    std::string body;
+};
+
+// Minimal HTTP/1.1 request over a fresh socket (Content-Length framing only —
+// the control plane always sends it for JSON responses).
+inline HttpResponse http_request(const std::string& method, const std::string& url,
+                                 const std::string& body,
+                                 const std::vector<std::string>& headers = {}) {
+    Url u = parse_url(url);
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(u.port);
+    if (inet_pton(AF_INET, u.host.c_str(), &addr.sin_addr) != 1) {
+        // getaddrinfo: thread-safe (heartbeat thread + user execute() race)
+        addrinfo hints{};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        addrinfo* res = nullptr;
+        if (getaddrinfo(u.host.c_str(), nullptr, &hints, &res) != 0 || !res) {
+            ::close(fd);
+            throw std::runtime_error("resolve failed: " + u.host);
+        }
+        addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+    }
+    timeval tv{90, 0};  // mirror the gateway's 90s agent timeout
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        ::close(fd);
+        throw std::runtime_error("connect failed: " + u.host + ":" + std::to_string(u.port));
+    }
+    std::ostringstream req;
+    req << method << " " << u.path << " HTTP/1.1\r\nHost: " << u.host
+        << "\r\nContent-Type: application/json\r\nContent-Length: " << body.size()
+        << "\r\nConnection: close\r\n";
+    for (auto& h : headers) req << h << "\r\n";
+    req << "\r\n" << body;
+    std::string data = req.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+        ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
+        if (n <= 0) { ::close(fd); throw std::runtime_error("send failed"); }
+        sent += (size_t)n;
+    }
+    std::string raw;
+    char buf[4096];
+    ssize_t n;
+    while ((n = ::recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, (size_t)n);
+    ::close(fd);
+    HttpResponse resp;
+    auto sp = raw.find(' ');
+    if (sp != std::string::npos) resp.status = std::atoi(raw.c_str() + sp + 1);
+    auto hdr_end = raw.find("\r\n\r\n");
+    if (hdr_end != std::string::npos) resp.body = raw.substr(hdr_end + 4);
+    return resp;
+}
+
+inline std::string json_escape(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if ((unsigned char)c < 0x20) {
+                    char esc[8];
+                    std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+                    out += esc;
+                } else {
+                    out += c;
+                }
+        }
+    }
+    return out;
+}
+
+// Handler: raw request-body JSON in, JSON value string out.
+using Handler = std::function<std::string(const std::string& body)>;
+
+class Agent {
+  public:
+    Agent(std::string node_id, std::string control_plane)
+        : node_id_(std::move(node_id)), cp_(std::move(control_plane)) {}
+
+    void register_reasoner(const std::string& id, Handler fn, const std::string& desc = "") {
+        reasoners_[id] = {std::move(fn), desc};
+    }
+
+    // Gateway execute() — the Call() of the reference Go SDK (agent.go:514).
+    HttpResponse execute(const std::string& target, const std::string& input_json) {
+        return http_request("POST", cp_ + "/api/v1/execute/" + target,
+                            "{\"input\":" + input_json + "}");
+    }
+
+    int port() const { return port_; }
+
+    // Bind, register with the control plane, start heartbeats. Returns once
+    // serving (the accept loop runs on background threads); call stop() to
+    // shut down.
+    void start() {
+        listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+        int one = 1;
+        setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = 0;  // kernel-assigned
+        if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+            throw std::runtime_error("bind failed");
+        socklen_t len = sizeof(addr);
+        getsockname(listen_fd_, (sockaddr*)&addr, &len);
+        port_ = ntohs(addr.sin_port);
+        if (::listen(listen_fd_, 64) != 0) throw std::runtime_error("listen failed");
+
+        running_ = true;
+        accept_thread_ = std::thread([this] { accept_loop(); });
+        // Registration retries with backoff — a control plane that is still
+        // booting must not kill the agent (same policy as the Python SDK's
+        // serve()). Registration 4xx (config error) still throws.
+        int delay_ms = 1000;
+        for (int attempt = 0;; ++attempt) {
+            try {
+                do_register();
+                break;
+            } catch (const std::exception& e) {
+                std::string msg = e.what();
+                bool permanent = msg.rfind("registration failed: 4", 0) == 0;
+                if (permanent || attempt >= 30) {
+                    running_ = false;
+                    ::shutdown(listen_fd_, SHUT_RDWR);
+                    ::close(listen_fd_);
+                    listen_fd_ = -1;
+                    if (accept_thread_.joinable()) accept_thread_.join();
+                    throw;
+                }
+                std::fprintf(stderr, "[afield-cpp] control plane not ready (%s); retry in %dms\n",
+                             msg.c_str(), delay_ms);
+                std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+                if (delay_ms < 30000) delay_ms *= 2;
+            }
+        }
+        hb_thread_ = std::thread([this] { heartbeat_loop(); });
+    }
+
+    void stop() {
+        running_ = false;
+        if (listen_fd_ >= 0) {
+            ::shutdown(listen_fd_, SHUT_RDWR);
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+        }
+        if (accept_thread_.joinable()) accept_thread_.join();
+        if (hb_thread_.joinable()) hb_thread_.join();
+    }
+
+  private:
+    struct Reasoner {
+        Handler fn;
+        std::string desc;
+    };
+
+    void do_register() {
+        std::ostringstream body;
+        body << "{\"node_id\":\"" << json_escape(node_id_) << "\",\"base_url\":\"http://127.0.0.1:"
+             << port_ << "\",\"metadata\":{\"sdk\":\"cpp\"},\"reasoners\":[";
+        bool first = true;
+        for (auto& [id, r] : reasoners_) {
+            if (!first) body << ",";
+            first = false;
+            body << "{\"id\":\"" << json_escape(id) << "\",\"description\":\""
+                 << json_escape(r.desc) << "\"}";
+        }
+        body << "]}";
+        auto resp = http_request("POST", cp_ + "/api/v1/nodes", body.str());
+        if (resp.status != 201)
+            throw std::runtime_error("registration failed: " + std::to_string(resp.status) +
+                                     " " + resp.body);
+    }
+
+    void heartbeat_loop() {
+        while (running_) {
+            for (int i = 0; i < 20 && running_; ++i)
+                std::this_thread::sleep_for(std::chrono::milliseconds(100));
+            if (!running_) break;
+            try {
+                http_request("POST", cp_ + "/api/v1/nodes/" + node_id_ + "/heartbeat", "{}");
+            } catch (...) {
+            }  // transient; keep heartbeating (mirrors the Python SDK)
+        }
+    }
+
+    void accept_loop() {
+        while (running_) {
+            int cfd = ::accept(listen_fd_, nullptr, nullptr);
+            if (cfd < 0) {
+                if (running_ && errno != EINTR)  // EMFILE etc: don't spin a core
+                    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+                continue;
+            }
+            std::thread([this, cfd] { handle_conn(cfd); }).detach();
+        }
+    }
+
+    void handle_conn(int fd) {
+        std::string raw;
+        char buf[8192];
+        size_t content_len = 0, hdr_end = std::string::npos;
+        while (true) {
+            ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+            if (n <= 0) break;
+            raw.append(buf, (size_t)n);
+            if (hdr_end == std::string::npos) {
+                hdr_end = raw.find("\r\n\r\n");
+                if (hdr_end != std::string::npos) {
+                    auto cl = raw.find("Content-Length:");
+                    if (cl == std::string::npos) cl = raw.find("content-length:");
+                    if (cl != std::string::npos) content_len = std::strtoul(raw.c_str() + cl + 15, nullptr, 10);
+                }
+            }
+            if (hdr_end != std::string::npos && raw.size() >= hdr_end + 4 + content_len) break;
+        }
+        std::string status = "404 Not Found", resp_body = "{\"error\":\"not found\"}";
+        if (!raw.empty()) {
+            std::string line = raw.substr(0, raw.find("\r\n"));
+            std::string body = hdr_end == std::string::npos ? "" : raw.substr(hdr_end + 4);
+            if (line.rfind("GET /health", 0) == 0) {
+                status = "200 OK";
+                resp_body = "{\"status\":\"ok\",\"node_id\":\"" + json_escape(node_id_) + "\"}";
+            } else if (line.rfind("POST /reasoners/", 0) == 0) {
+                auto path = line.substr(16, line.find(' ', 16) - 16);
+                auto it = reasoners_.find(path);
+                if (it != reasoners_.end()) {
+                    try {
+                        resp_body = "{\"result\":" + it->second.fn(body) + "}";
+                        status = "200 OK";
+                    } catch (const std::exception& e) {
+                        status = "500 Internal Server Error";
+                        resp_body = "{\"error\":\"" + json_escape(e.what()) + "\"}";
+                    }
+                }
+            }
+        }
+        std::ostringstream out;
+        out << "HTTP/1.1 " << status << "\r\nContent-Type: application/json\r\nContent-Length: "
+            << resp_body.size() << "\r\nConnection: close\r\n\r\n" << resp_body;
+        std::string data = out.str();
+        ::send(fd, data.data(), data.size(), 0);
+        ::close(fd);
+    }
+
+    std::string node_id_;
+    std::string cp_;
+    std::map<std::string, Reasoner> reasoners_;
+    int listen_fd_ = -1;
+    int port_ = 0;
+    std::atomic<bool> running_{false};
+    std::thread accept_thread_, hb_thread_;
+};
+
+}  // namespace afield

@@ -1,0 +1,68 @@
+// Example C++ agent: registers a reasoner with the control plane and serves
+// it over the gateway wire contract.
+//
+// Build:  g++ -O2 -std=c++17 -o cpp_agent example_agent.cpp -pthread
+// Run:    ./cpp_agent <control_plane_url> [node_id]
+
+#include <csignal>
+#include <cstdio>
+#include <string>
+
+#include "afagent.hpp"
+
+static volatile std::sig_atomic_t stop_flag = 0;
+
+int main(int argc, char** argv) {
+    std::string cp = argc > 1 ? argv[1] : "http://127.0.0.1:8800";
+    std::string node = argc > 2 ? argv[2] : "cpp-agent";
+
+    afield::Agent agent(node, cp);
+
+    // Handlers receive the raw request-body JSON ({"input":...,"execution_id":...})
+    // and return a JSON value. This one wraps the body it was given.
+    agent.register_reasoner(
+        "cpp_echo",
+        [](const std::string& body) {
+            return std::string("{\"echoed_request\":") +
+                   (body.empty() ? "null" : body) + "}";
+        },
+        "Echo the inbound request body (C++ SDK demo)");
+
+    agent.register_reasoner(
+        "cpp_sum",
+        [](const std::string& body) {
+            // Dependency-free scan: sums every integer inside the "input"
+            // value, bounded so the execution_id's digits never leak in.
+            long total = 0, cur = 0;
+            bool in_num = false;
+            size_t start = body.find("\"input\"");
+            size_t end = body.find("\"execution_id\"");
+            if (start == std::string::npos) start = 0;
+            if (end == std::string::npos || end < start) end = body.size();
+            for (size_t i = start; i < end; ++i) {
+                char c = body[i];
+                if (c >= '0' && c <= '9') {
+                    cur = cur * 10 + (c - '0');
+                    in_num = true;
+                } else {
+                    if (in_num) total += cur;
+                    cur = 0;
+                    in_num = false;
+                }
+            }
+            if (in_num) total += cur;
+            return std::to_string(total);
+        },
+        "Sum integers in the input array (C++ SDK demo)");
+
+    agent.start();
+    std::printf("[afield-cpp] %s serving on :%d against %s\n", node.c_str(), agent.port(),
+                cp.c_str());
+    std::fflush(stdout);
+
+    std::signal(SIGTERM, [](int) { stop_flag = 1; });
+    std::signal(SIGINT, [](int) { stop_flag = 1; });
+    while (!stop_flag) std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    agent.stop();
+    return 0;
+}

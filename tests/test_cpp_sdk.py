@@ -1,0 +1,95 @@
+"""C++ SDK: compile the example agent, run it against a live control plane,
+and exercise the full gateway round-trip (the reference's Go-SDK role)."""
+
+import asyncio
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from tests.helpers_cp import CPHarness, async_test
+
+SDK_DIR = Path(__file__).resolve().parent.parent / "native" / "sdk"
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+def _build() -> Path:
+    out = SDK_DIR / "cpp_agent"
+    src = SDK_DIR / "example_agent.cpp"
+    if not out.exists() or out.stat().st_mtime < max(
+        src.stat().st_mtime, (SDK_DIR / "afagent.hpp").stat().st_mtime
+    ):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-o", str(out), str(src), "-pthread"],
+            check=True,
+            capture_output=True,
+            cwd=SDK_DIR,
+            timeout=180,
+        )
+    return out
+
+
+@async_test
+async def test_cpp_agent_end_to_end():
+    binary = await asyncio.to_thread(_build)
+    async with CPHarness() as h:
+        proc = await asyncio.create_subprocess_exec(
+            str(binary),
+            h.base_url,
+            "cpp-agent",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        try:
+            # wait until registered
+            for _ in range(100):
+                nodes = {
+                    n["node_id"]: n
+                    for n in (await (await h.http.get("/api/v1/nodes")).json())["nodes"]
+                }
+                if "cpp-agent" in nodes and nodes["cpp-agent"]["status"] == "active":
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("cpp agent never registered")
+            node = nodes["cpp-agent"]
+            assert node["metadata"] == {"sdk": "cpp"}
+            assert {r["id"] for r in node["reasoners"]} == {"cpp_echo", "cpp_sum"}
+            assert node["did"].startswith("did:key:z")  # full identity parity
+
+            # gateway round-trip into C++ code
+            async with h.http.post(
+                "/api/v1/execute/cpp-agent.cpp_sum", json={"input": [1, 2, 39]}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert doc["result"] == 42
+
+            async with h.http.post(
+                "/api/v1/execute/cpp-agent.cpp_echo", json={"input": {"hi": "there"}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["result"]["echoed_request"]["input"] == {"hi": "there"}
+
+            # unknown reasoner on the C++ server → failed execution, not hang
+            async with h.http.post(
+                "/api/v1/execute/cpp-agent.nope", json={"input": 1}
+            ) as r:
+                assert r.status == 404  # gateway rejects unregistered component
+
+            # hit the C++ server DIRECTLY: its own 404 branch and /health
+            import aiohttp
+
+            base = node["base_url"]
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/reasoners/ghost", json={"input": 1}) as r:
+                    assert r.status == 404
+                    assert "error" in await r.json()
+                async with s.get(f"{base}/health") as r:
+                    assert (await r.json())["node_id"] == "cpp-agent"
+        finally:
+            proc.terminate()
+            await proc.wait()

@@ -62,6 +62,8 @@ class EngineConfig:
     # slots are active, compact them into the smallest bucket width — the
     # unembed/attention cost scales with batch width, so low-occupancy decode
     # stops paying for max_batch (one extra compile per bucket)
+    session_ttl: float = 600.0  # idle cached sessions release their pages
+    # after this long even without allocation pressure (0 disables)
     dtype: str | None = None
 
     @property
@@ -335,6 +337,21 @@ class InferenceEngine:
     def _pages_needed(self, req: Request) -> int:
         total = len(req.prompt) + req.sampling.max_new_tokens
         return -(-total // self.ecfg.page_size)
+
+    def gc_sessions(self, at: float | None = None) -> int:
+        """Release pages of sessions idle longer than session_ttl (eviction
+        under pressure remains the primary mechanism; this bounds idle
+        retention). Called opportunistically by the model-node drive loop."""
+        ttl = self.ecfg.session_ttl
+        if not ttl:
+            return 0
+        t = at if at is not None else time.time()
+        with self._session_lock:
+            dead = [sid for sid, s in self._sessions.items() if t - s.last_used > ttl]
+            for sid in dead:
+                self.allocator.free(self._sessions.pop(sid).pages)
+                self.stats["sessions_evicted"] += 1
+        return len(dead)
 
     def free_session(self, session_id: str) -> bool:
         """Explicitly drop a session's cached prefix (thread-safe vs step())."""

@@ -14,6 +14,7 @@ loop); completions resolve asyncio futures on the loop.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any
 
 import jax
@@ -112,8 +113,12 @@ class ModelBackend:
         events dispatched to waiting futures. A step failure must not kill the
         loop silently — it would strand every in-flight future (cf. the
         gateway worker-loop guard)."""
+        last_gc = 0.0
         while True:
             if not self.engine.has_work():
+                if time.monotonic() - last_gc > 30.0:
+                    last_gc = time.monotonic()
+                    self.engine.gc_sessions()  # bound idle KV retention
                 self._wake.clear()
                 try:
                     async with asyncio.timeout(self.idle_sleep * 50):

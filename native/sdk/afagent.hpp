@@ -226,7 +226,13 @@ class Agent {
         }
         if (accept_thread_.joinable()) accept_thread_.join();
         if (hb_thread_.joinable()) hb_thread_.join();
+        // Wait (bounded) for in-flight handler threads: they dereference
+        // `this`, so destruction while one runs would be a use-after-free.
+        for (int i = 0; i < 300 && inflight_.load() > 0; ++i)
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
     }
+
+    ~Agent() { stop(); }
 
   private:
     struct Reasoner {
@@ -258,9 +264,12 @@ class Agent {
                 std::this_thread::sleep_for(std::chrono::milliseconds(100));
             if (!running_) break;
             try {
-                http_request("POST", cp_ + "/api/v1/nodes/" + node_id_ + "/heartbeat", "{}");
+                auto resp =
+                    http_request("POST", cp_ + "/api/v1/nodes/" + node_id_ + "/heartbeat", "{}");
+                if (resp.status == 404) do_register();  // control plane restarted
+                // (mirrors the Python SDK's re-register-on-404, agent.py)
             } catch (...) {
-            }  // transient; keep heartbeating (mirrors the Python SDK)
+            }  // transient; keep heartbeating
         }
     }
 
@@ -277,6 +286,13 @@ class Agent {
     }
 
     void handle_conn(int fd) {
+        inflight_.fetch_add(1);
+        struct Guard {
+            std::atomic<int>& c;
+            ~Guard() { c.fetch_sub(1); }
+        } guard{inflight_};
+        timeval tv{30, 0};  // a silent client must not pin a thread forever
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         std::string raw;
         char buf[8192];
         size_t content_len = 0, hdr_end = std::string::npos;
@@ -329,6 +345,7 @@ class Agent {
     int listen_fd_ = -1;
     int port_ = 0;
     std::atomic<bool> running_{false};
+    std::atomic<int> inflight_{0};
     std::thread accept_thread_, hb_thread_;
 };
 

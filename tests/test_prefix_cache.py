@@ -149,6 +149,24 @@ def test_chunked_prefill_with_session(params):
     assert engine.stats["prefix_cache_hits"] == 1
 
 
+def test_session_ttl_gc(params):
+    import dataclasses
+    import time as _time
+
+    ecfg = dataclasses.replace(ECFG, session_ttl=60.0)
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "a", _prompt(40, 6), session="idle")
+    assert engine._sessions
+    assert engine.gc_sessions(at=_time.time() + 30) == 0  # not idle enough
+    assert engine.gc_sessions(at=_time.time() + 61) == 1
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+    # ttl=0 disables
+    engine2 = InferenceEngine(params, CFG, dataclasses.replace(ECFG, session_ttl=0))
+    _run(engine2, "a", _prompt(41, 6), session="keep")
+    assert engine2.gc_sessions(at=_time.time() + 10_000) == 0
+    assert "keep" in engine2._sessions
+
+
 def test_disabled_prefix_cache_frees_everything(params):
     ecfg = dataclasses_replace(ECFG, enable_prefix_cache=False)
     engine = InferenceEngine(params, CFG, ecfg)

@@ -162,6 +162,7 @@ def forward_impl(
     collect_kv: bool = True,
     remat: bool = False,
     attn_impl: str = "ref",
+    mesh=None,  # required (static) for attn_impl="ring"
 ):
     """Dense causal forward. tokens/positions: [B, S].
 
@@ -188,6 +189,19 @@ def forward_impl(
                 causal=True,
                 interpret=jax.default_backend() == "cpu",
             ).transpose(0, 2, 1, 3)
+        if attn_impl == "ring":
+            # Sequence/context parallelism: S shards over the mesh's `seq`
+            # axis — long-context training where no device holds the full
+            # sequence (positions must be per-row aranges, as in prefill).
+            from agentfield_tpu.parallel.mesh import AXIS_SEQ
+            from agentfield_tpu.parallel.ring_attention import ring_attention
+
+            if mesh is None or AXIS_SEQ not in getattr(mesh, "shape", {}):
+                raise ValueError(
+                    "attn_impl='ring' requires mesh= with a 'seq' axis "
+                    f"(got {mesh!r})"
+                )
+            return ring_attention(q, k, v, mesh, causal=True)
         return attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
 
     def body(x, lp):
@@ -204,7 +218,9 @@ def forward_impl(
     return unembed(params, cfg, x), kv
 
 
-forward = jax.jit(forward_impl, static_argnames=("cfg", "collect_kv", "remat", "attn_impl"))
+forward = jax.jit(
+    forward_impl, static_argnames=("cfg", "collect_kv", "remat", "attn_impl", "mesh")
+)
 
 
 def make_contiguous_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype: str | None = None):

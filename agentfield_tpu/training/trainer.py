@@ -29,11 +29,25 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
-def causal_lm_loss(params, cfg: LlamaConfig, batch: dict[str, jax.Array]):
+def causal_lm_loss(
+    params,
+    cfg: LlamaConfig,
+    batch: dict[str, jax.Array],
+    attn_impl: str = "ref",
+    mesh=None,
+):
     """Masked next-token cross-entropy. batch: tokens/positions/targets [B,S];
-    targets < 0 are ignored (padding)."""
+    targets < 0 are ignored (padding). attn_impl="ring" (+mesh) trains with
+    the sequence sharded over the `seq` axis — long-context fine-tuning."""
     logits, _ = forward(
-        params, cfg, batch["tokens"], batch["positions"], collect_kv=False, remat=True
+        params,
+        cfg,
+        batch["tokens"],
+        batch["positions"],
+        collect_kv=False,
+        remat=True,
+        attn_impl=attn_impl,
+        mesh=mesh,
     )
     targets = batch["targets"]
     mask = (targets >= 0).astype(jnp.float32)
@@ -65,11 +79,16 @@ def init_train_state(
     return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
 
-def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+def make_train_step(
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    attn_impl: str = "ref",
+    mesh=None,
+):
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: dict[str, jax.Array]):
         grad_fn = jax.value_and_grad(causal_lm_loss, has_aux=True)
-        (loss, metrics), grads = grad_fn(state.params, cfg, batch)
+        (loss, metrics), grads = grad_fn(state.params, cfg, batch, attn_impl, mesh)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), metrics

@@ -192,7 +192,9 @@ def forward_impl(
         if attn_impl == "ring":
             # Sequence/context parallelism: S shards over the mesh's `seq`
             # axis — long-context training where no device holds the full
-            # sequence (positions must be per-row aranges, as in prefill).
+            # sequence. Positions travel the ring with K/V, so offset/
+            # continuation layouts mask exactly like attention_ref (they must
+            # be strictly increasing along the sequence).
             from agentfield_tpu.parallel.mesh import AXIS_SEQ
             from agentfield_tpu.parallel.ring_attention import ring_attention
 
@@ -201,7 +203,7 @@ def forward_impl(
                     "attn_impl='ring' requires mesh= with a 'seq' axis "
                     f"(got {mesh!r})"
                 )
-            return ring_attention(q, k, v, mesh, causal=True)
+            return ring_attention(q, k, v, mesh, causal=True, positions=positions)
         return attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
 
     def body(x, lp):

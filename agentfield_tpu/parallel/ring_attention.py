@@ -30,7 +30,7 @@ _NEG_INF = -1e30
 
 def _block_attend(q, k, v, q_pos, k_pos, causal):
     """One Q-block × K-block partial attention. q: [B, Sq, H, hd];
-    k/v: [B, Sk, Kh, hd]; positions: [Sq]/[Sk] global. Returns
+    k/v: [B, Sk, Kh, hd]; positions: [B, Sq]/[B, Sk] global. Returns
     (scores_max [B,H,Sq,1], exp_sum [B,H,Sq,1], acc [B,Sq,H,hd])."""
     B, Sq, H, hd = q.shape
     Kh = k.shape[2]
@@ -38,8 +38,8 @@ def _block_attend(q, k, v, q_pos, k_pos, causal):
     qg = q.reshape(B, Sq, Kh, rep, hd).astype(jnp.float32) * (hd**-0.5)
     s = jnp.einsum("bskrh,btkh->bkrst", qg, k.astype(jnp.float32))  # [B,Kh,rep,Sq,Sk]
     if causal:
-        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
-        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        mask = k_pos[:, None, :] <= q_pos[:, :, None]  # [B, Sq, Sk]
+        s = jnp.where(mask[:, None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # [B,Kh,rep,Sq,1]
     # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
     m_safe = jnp.maximum(m, -1e29)
@@ -49,20 +49,24 @@ def _block_attend(q, k, v, q_pos, k_pos, causal):
     return m_safe, l, acc.reshape(B, Sq, H, hd)
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, positions, axis_name: str, causal: bool):
     """Body run per-device under shard_map. All inputs are local shards
-    [B, S_local, H|Kh, hd]; the device's ring index orders causality."""
+    [B, S_local, ...]; `positions` [B, S_local] are the GLOBAL positions of
+    this shard's tokens — they travel the ring alongside K/V, so the causal
+    mask is position-exact (identical semantics to attention_ref), including
+    offset/continuation position layouts. The whole-block skip assumes
+    positions are STRICTLY increasing along the global sequence (the
+    sharding contract; see ring_attention's docstring)."""
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Sq, H, hd = q.shape
-    s_local = k.shape[1]
 
     def step(i, carry):
-        m, l, acc, cur_k, cur_v = carry
+        m, l, acc, cur_k, cur_v, cur_pos = carry
         # K/V currently resident arrived from ring position (my_idx - i).
         src_idx = (my_idx - i) % n
-        q_pos = my_idx * Sq + jnp.arange(Sq, dtype=jnp.int32)
-        k_pos = src_idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+        q_pos = positions
+        k_pos = cur_pos
 
         def attend(args):
             m, l, acc = args
@@ -86,18 +90,19 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
             m, l, acc = jax.lax.cond(src_idx <= my_idx, attend, lambda a: a, (m, l, acc))
         else:
             m, l, acc = attend((m, l, acc))
-        # Rotate K/V to the next device (direction: ring neighbor +1).
+        # Rotate K/V (and their positions) to the next ring neighbor.
         perm = [(j, (j + 1) % n) for j in range(n)]
         nxt_k = jax.lax.ppermute(cur_k, axis_name, perm)
         nxt_v = jax.lax.ppermute(cur_v, axis_name, perm)
-        return m, l, acc, nxt_k, nxt_v
+        nxt_pos = jax.lax.ppermute(cur_pos, axis_name, perm)
+        return m, l, acc, nxt_k, nxt_v, nxt_pos
 
     # The stats depend on axis_index, so the initial carry must already be
     # marked device-varying for shard_map's vma type system (jax >= 0.9).
     m0 = to_varying(jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32), axis_name)
     l0 = to_varying(jnp.zeros((B, H, Sq, 1), jnp.float32), axis_name)
     acc0 = to_varying(jnp.zeros((B, Sq, H, hd), jnp.float32), axis_name)
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    m, l, acc, _, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v, positions))
     l = jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)  # [B, Sq, H, 1]
     return (acc / l).astype(q.dtype)
 
@@ -110,18 +115,36 @@ def ring_attention(
     mesh: Mesh,
     causal: bool = True,
     axis_name: str = AXIS_SEQ,
+    positions: jax.Array | None = None,  # [B, S] global positions; default
+    # arange(S) — provide explicitly for offset/continuation layouts so the
+    # causal mask stays position-exact (identical to attention_ref)
 ) -> jax.Array:
     """Full-sequence attention with S sharded over `axis_name`. S must divide
-    evenly by the axis size. Heads stay replicated across the seq axis (they
-    may simultaneously be sharded over `model` by the caller's outer pjit)."""
+    evenly by the axis size; positions must be STRICTLY increasing along the
+    sequence (the causal whole-block skip is ring-index-based, so tied
+    positions straddling a shard boundary would skip keys attention_ref
+    attends). Heads
+    stay replicated across the seq axis (they may simultaneously be sharded
+    over `model` by the caller's outer pjit)."""
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(f"sequence {q.shape[1]} not divisible by {axis_name}={n}")
+    if n == 1:
+        import warnings
+
+        warnings.warn(
+            f"ring_attention with {axis_name} axis of size 1 is plain attention "
+            "— size the axis to actually shard the sequence",
+            stacklevel=2,
+        )
+    if positions is None:
+        positions = jnp.arange(q.shape[1], dtype=jnp.int32)[None].repeat(q.shape[0], 0)
     spec = P(None, axis_name, None, None)
+    pos_spec = P(None, axis_name)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, pos_spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, positions)

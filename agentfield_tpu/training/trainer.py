@@ -96,6 +96,17 @@ def make_train_step(
     return train_step
 
 
+def make_lm_batch(tokens: jax.Array) -> dict[str, jax.Array]:
+    """Standard next-token LM batch from [B, S] tokens: arange positions,
+    roll(-1) targets with the final column masked (-1 sentinel)."""
+    B, S = tokens.shape
+    return {
+        "tokens": tokens,
+        "positions": jnp.arange(S, dtype=jnp.int32)[None].repeat(B, 0),
+        "targets": jnp.roll(tokens, -1, axis=1).at[:, -1].set(-1),
+    }
+
+
 def shard_batch(batch: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Array]:
     """Place a host batch with the batch dim split over the ``data`` axis."""
     sharding = jax.sharding.NamedSharding(mesh, P(AXIS_DATA, None))

@@ -55,12 +55,10 @@ def test_orbax_checkpoint_round_trip(tmp_path):
 
     opt = optax.adamw(1e-3)
     state = init_train_state(CFG, jax.random.PRNGKey(0), opt)
+    from agentfield_tpu.training.trainer import make_lm_batch
+
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab_size, jnp.int32)
-    batch = {
-        "tokens": toks,
-        "positions": jnp.arange(16, dtype=jnp.int32)[None].repeat(2, 0),
-        "targets": jnp.roll(toks, -1, 1).at[:, -1].set(-1),
-    }
+    batch = make_lm_batch(toks)
     step = make_train_step(CFG, opt)
     state, _ = step(state, batch)
     save_checkpoint(tmp_path / "ck", state)

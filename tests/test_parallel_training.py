@@ -15,12 +15,9 @@ CFG = get_config("llama-tiny")
 
 
 def _batch(key, bsz, seq):
-    tokens = jax.random.randint(key, (bsz, seq), 0, CFG.vocab_size, jnp.int32)
-    return {
-        "tokens": tokens,
-        "positions": jnp.arange(seq, dtype=jnp.int32)[None].repeat(bsz, 0),
-        "targets": jnp.roll(tokens, -1, axis=1).at[:, -1].set(-1),
-    }
+    from agentfield_tpu.training.trainer import make_lm_batch
+
+    return make_lm_batch(jax.random.randint(key, (bsz, seq), 0, CFG.vocab_size, jnp.int32))
 
 
 def test_auto_mesh_shape():

@@ -39,6 +39,21 @@ def test_ring_attention_non_causal():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+def test_ring_attention_offset_positions():
+    """Positions travel the ring with K/V: a continuation batch (positions
+    offset by a prompt length) masks identically to attention_ref."""
+    B, S, H, Kh, hd = 2, 32, 4, 2, 32
+    mesh = make_mesh({"seq": 4})
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    k = _rand(ks[1], (B, S, Kh, hd))
+    v = _rand(ks[2], (B, S, Kh, hd))
+    pos = (100 + jnp.arange(S, dtype=jnp.int32))[None].repeat(B, 0)
+    ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
+    out = ring_attention(q, k, v, mesh, causal=True, positions=pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
 def test_ring_attention_rejects_indivisible():
     mesh = make_mesh({"seq": 4})
     q = jnp.zeros((1, 30, 2, 32))
@@ -57,12 +72,10 @@ def test_ring_training_step_matches_dense():
     cfg = get_config("llama-tiny")
     mesh = make_mesh({"seq": 4})
     opt = optax.adamw(5e-3)
+    from agentfield_tpu.training.trainer import make_lm_batch
+
     toks = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, cfg.vocab_size, jnp.int32)
-    batch = {
-        "tokens": toks,
-        "positions": jnp.arange(32, dtype=jnp.int32)[None].repeat(2, 0),
-        "targets": jnp.roll(toks, -1, 1).at[:, -1].set(-1),
-    }
+    batch = make_lm_batch(toks)
 
     state_ring = init_train_state(cfg, jax.random.PRNGKey(0), opt)
     step_ring = make_train_step(cfg, opt, attn_impl="ring", mesh=mesh)

@@ -382,6 +382,61 @@ def cmd_status(cfg: Config, args) -> int:
     return 0
 
 
+def cmd_vc_verify(cfg: Config, args) -> int:
+    """Verify a VC document offline (reference: af vc verify)."""
+    from agentfield_tpu.control_plane.identity import VCService
+
+    try:
+        doc = json.loads(Path(args.file).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read VC: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(doc, dict):
+        print(f"INVALID: document is {type(doc).__name__}, expected a VC object")
+        return 1
+    vc = doc.get("vc", doc)  # accept both the API envelope and a bare VC
+    if not isinstance(vc, dict):
+        print("INVALID: 'vc' field is not an object")
+        return 1
+    ok, reason = VCService.verify(vc)
+    print(f"{'VALID' if ok else 'INVALID'}: {reason}")
+    if ok and "credentialSubject" in vc:
+        cs = vc["credentialSubject"]
+        print(f"  issuer:    {vc.get('issuer')}")
+        print(f"  target:    {cs.get('target')}  status: {cs.get('status')}")
+        print(f"  execution: {cs.get('execution_id')}  run: {cs.get('run_id')}")
+    return 0 if ok else 1
+
+
+def cmd_mcp_generate(cfg: Config, args) -> int:
+    """Generate typed Python skill stubs from an MCP server's tools
+    (reference: SkillGenerator.GenerateSkillsForServer, skill_generator.go:37)."""
+    from agentfield_tpu.sdk.mcp import MCPManager, generate_skill_file
+
+    spec = MCPManager.discover_config(args.project or ".")
+    if args.server not in spec:
+        print(
+            f"server {args.server!r} not in .mcp.json (known: {sorted(spec)})",
+            file=sys.stderr,
+        )
+        return 1
+
+    async def run():
+        mgr = MCPManager({args.server: spec[args.server]})
+        await mgr.start_all()
+        try:
+            tools = mgr.tools[args.server]
+            return generate_skill_file(args.server, tools), len(tools)
+        finally:
+            await mgr.stop_all()
+
+    code, n_tools = asyncio.run(run())
+    out = Path(args.project or ".") / f"mcp_{args.server}_skills.py"
+    out.write_text(code)
+    print(f"wrote {out} ({n_tools} skills)")
+    return 0
+
+
 def cmd_version(cfg: Config, args) -> int:
     print(f"agentfield_tpu {agentfield_tpu.__version__}")
     return 0
@@ -447,6 +502,19 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="cluster status via the control plane")
     s.add_argument("--url")
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("vc", help="verifiable-credential tools")
+    vc_sub = s.add_subparsers(dest="vc_command", required=True)
+    v = vc_sub.add_parser("verify", help="verify a VC JSON document offline")
+    v.add_argument("file")
+    v.set_defaults(fn=cmd_vc_verify)
+
+    s = sub.add_parser("mcp", help="MCP tools")
+    mcp_sub = s.add_subparsers(dest="mcp_command", required=True)
+    m = mcp_sub.add_parser("generate", help="generate typed skill stubs from a server's tools")
+    m.add_argument("server")
+    m.add_argument("--project", help="project dir containing .mcp.json (default .)")
+    m.set_defaults(fn=cmd_mcp_generate)
 
     s = sub.add_parser("version", help="print version")
     s.set_defaults(fn=cmd_version)

@@ -151,6 +151,79 @@ class MCPStdioClient:
         return result
 
 
+_JSON_TO_PY = {
+    "string": "str",
+    "integer": "int",
+    "number": "float",
+    "boolean": "bool",
+    "array": "list",
+    "object": "dict",
+}
+
+
+def _py_ident(name: str, taken: set[str]) -> str:
+    """Tool/param names are untrusted (hyphens, dots, keywords, shadowing):
+    coerce to a safe unique Python identifier."""
+    import keyword
+    import re
+
+    ident = re.sub(r"\W", "_", name)
+    if not ident or ident[0].isdigit():
+        ident = f"t_{ident}"
+    while keyword.iskeyword(ident) or ident in taken:
+        ident += "_"
+    taken.add(ident)
+    return ident
+
+
+def generate_skill_file(server: str, tools: list[dict[str, Any]]) -> str:
+    """Emit a Python module of typed skill functions, one per MCP tool, ready
+    to attach to an Agent (reference: skill-file code generation into the
+    agent project, internal/mcp/skill_generator.go:37). The generated module
+    exposes ``register(app, manager)`` wiring each function as a skill that
+    forwards to the live MCP client. Unset optional parameters are OMITTED
+    from tools/call arguments (absent != null for schema-validating servers)."""
+    lines = [
+        '"""Auto-generated MCP skill stubs — aftpu mcp generate. DO NOT EDIT."""',
+        "",
+        "from agentfield_tpu.sdk.mcp import MCPManager  # noqa: F401",
+        "",
+        "",
+        "def register(app, manager):",
+        f'    """Attach {server!r} tools to `app` using a STARTED MCPManager."""',
+        f"    client = manager.clients[{server!r}]",
+    ]
+    fn_names: set[str] = {"register", "app", "manager", "client"}
+    for tool in tools:
+        name = tool["name"]
+        fn = _py_ident(name, fn_names)
+        schema = tool.get("inputSchema", {})
+        props = schema.get("properties", {})
+        required = set(schema.get("required", []))
+        param_names: set[str] = set()
+        entries = []  # (py_param, wire_name, is_required, py_type)
+        for pname, pschema in props.items():
+            py = _JSON_TO_PY.get(pschema.get("type", ""), "object")
+            entries.append((_py_ident(pname, param_names), pname, pname in required, py))
+        entries.sort(key=lambda e: not e[2])  # required params must precede optional
+        sig = ", ".join(
+            f"{p}: {py}" if req else f"{p}: {py} | None = None"
+            for p, _, req, py in entries
+        )
+        doc = repr(tool.get("description") or f"MCP tool {name}")  # literal-safe
+        args = ", ".join(f"{wire!r}: {p}" for p, wire, _, _ in entries)
+        lines += [
+            "",
+            f"    @app.skill(id={f'{server}_{fn}'!r}, description={doc})",
+            f"    async def {fn}({sig}):",
+            f"        _args = {{{args}}}",
+            f"        return await client.call_tool({name!r}, "
+            "{k: v for k, v in _args.items() if v is not None})",
+        ]
+    lines.append("")
+    return "\n".join(lines)
+
+
 class MCPManager:
     """Start/stop configured MCP servers and expose their tools as agent
     skills (the tool's own inputSchema becomes the skill schema; invocation

@@ -57,6 +57,42 @@ async def test_manager_and_dynamic_skills_through_gateway():
             await mgr.stop_all()
 
 
+@async_test
+async def test_generate_skill_file_and_register(tmp_path):
+    """Generated stubs are valid Python, typed from the tool schema, and wire
+    live skills through register(app, manager)."""
+    from agentfield_tpu.sdk.mcp import generate_skill_file
+
+    mgr = MCPManager(SPEC)
+    await mgr.start_all()
+    try:
+        code = generate_skill_file("fake", mgr.tools["fake"])
+        assert "def add(a: int, b: int):" in code
+        assert "def shout(text: str):" in code
+        mod_path = tmp_path / "gen_skills.py"
+        mod_path.write_text(code)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("gen_skills", mod_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        async with CPHarness() as h:
+            app = Agent("genagent", h.base_url)
+            mod.register(app, mgr)
+            await app.start()
+            try:
+                async with h.http.post(
+                    "/api/v1/execute/genagent.fake_add", json={"input": {"a": 40, "b": 2}}
+                ) as r:
+                    doc = await r.json()
+                assert doc["status"] == "completed" and doc["result"] == "42"
+            finally:
+                await app.stop()
+    finally:
+        await mgr.stop_all()
+
+
 def test_discover_config(tmp_path):
     (tmp_path / ".mcp.json").write_text(
         json.dumps({"mcpServers": {"x": {"command": "foo", "args": ["--bar"]}}})
@@ -64,3 +100,36 @@ def test_discover_config(tmp_path):
     cfg = MCPManager.discover_config(tmp_path)
     assert cfg == {"x": {"command": "foo", "args": ["--bar"]}}
     assert MCPManager.discover_config(tmp_path / "nope") == {}
+
+
+def test_generate_skill_file_hostile_schemas():
+    """Hyphenated/keyword/shadowing names, multiline descriptions, and
+    optional-before-required orderings must still produce valid Python."""
+    from agentfield_tpu.sdk.mcp import generate_skill_file
+
+    tools = [
+        {
+            "name": "get-weather.v2",
+            "description": 'line1\nline2 "quoted" \\backslash',
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "opt": {"type": "string"},
+                    "from": {"type": "integer"},
+                    "class": {"type": "boolean"},
+                },
+                "required": ["from"],
+            },
+        },
+        {"name": "register", "inputSchema": {"type": "object", "properties": {}}},
+        {"name": "123bad", "inputSchema": {}},
+    ]
+    code = generate_skill_file("srv", tools)
+    compile(code, "<generated>", "exec")  # must be valid Python
+    # required params precede optional ones
+    assert "async def get_weather_v2(from_: int, opt: str | None = None, class_: bool | None = None)" in code
+    # shadow-avoidance: the tool literally named 'register' gets renamed
+    assert "async def register_(" in code
+    assert "async def t_123bad(" in code
+    # unset optionals are omitted from the wire call
+    assert "if v is not None" in code

@@ -168,7 +168,19 @@ def cmd_model(cfg: Config, args) -> int:
         )
         await backend.start()
         await agent.start()
-        print(f"model node '{agent.node_id}' ({args.model or mn.model}) on :{agent.port}", flush=True)
+        grpc_note = ""
+        grpc_server = None  # keep a strong reference: grpc.Server stops on GC
+        try:
+            from agentfield_tpu.serving.model_node import start_model_grpc
+
+            grpc_server = start_model_grpc(backend, agent.port + 100)
+            grpc_note = f", gRPC :{agent.port + 100}"
+        except OSError as e:
+            print(f"[aftpu] model gRPC disabled: {e}", file=sys.stderr)
+        print(
+            f"model node '{agent.node_id}' ({args.model or mn.model}) on :{agent.port}{grpc_note}",
+            flush=True,
+        )
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for s in (signal.SIGINT, signal.SIGTERM):
@@ -176,6 +188,8 @@ def cmd_model(cfg: Config, args) -> int:
         try:
             await stop.wait()
         finally:
+            if grpc_server is not None:
+                grpc_server.stop(grace=0)
             await agent.stop()
             await backend.stop()
 

@@ -203,6 +203,8 @@ class VCService:
         proof = vc.get("proof")
         if not proof:
             return False, "missing proof"
+        if not isinstance(proof, dict):
+            return False, "malformed proof"
         # The proof key MUST be the claimed issuer's — otherwise an attacker
         # re-signs a tampered credential with their own key and it "verifies".
         issuer = vc.get("issuer")

@@ -200,7 +200,10 @@ def generate_skill_file(server: str, tools: list[dict[str, Any]]) -> str:
         schema = tool.get("inputSchema", {})
         props = schema.get("properties", {})
         required = set(schema.get("required", []))
-        param_names: set[str] = set()
+        # Seed with closure names (shadowing would break the forward call)
+        # and the framework-reserved ctx/context (the SDK strips + injects
+        # those — a tool param by that name must be renamed to stay settable).
+        param_names: set[str] = {"client", "app", "manager", "register", "ctx", "context"}
         entries = []  # (py_param, wire_name, is_required, py_type)
         for pname, pschema in props.items():
             py = _JSON_TO_PY.get(pschema.get("type", ""), "object")
@@ -214,7 +217,10 @@ def generate_skill_file(server: str, tools: list[dict[str, Any]]) -> str:
         args = ", ".join(f"{wire!r}: {p}" for p, wire, _, _ in entries)
         lines += [
             "",
-            f"    @app.skill(id={f'{server}_{fn}'!r}, description={doc})",
+            # id derives from the RAW tool name — identical to
+            # MCPManager.attach_to_agent so both registration paths expose
+            # the same execute target; only the function name is sanitized.
+            f"    @app.skill(id={f'{server}_{name}'!r}, description={doc})",
             f"    async def {fn}({sig}):",
             f"        _args = {{{args}}}",
             f"        return await client.call_tool({name!r}, "

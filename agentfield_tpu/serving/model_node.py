@@ -443,3 +443,91 @@ def build_model_node(
 
     agent.add_route("POST", "/profile/{action}", profile_handler)
     return agent, backend
+
+
+class ModelGrpcService:
+    """gRPC surface for the model node's hot path (BASELINE.json north star:
+    ai() routes 'via gRPC to a JAX/XLA model node'). Generic-handler + JSON
+    messages like the admin service (no codegen in this image); the unary
+    Generate blocks until completion, mirroring backend.generate."""
+
+    SERVICE = "agentfield.model.Generate"
+
+    def __init__(self, backend: ModelBackend, loop: asyncio.AbstractEventLoop):
+        self.backend = backend
+        self.loop = loop
+
+    def service(self, handler_call_details):
+        import grpc
+
+        from agentfield_tpu.control_plane.admin_grpc import (
+            _json_deserializer,
+            _json_serializer,
+        )
+
+        if handler_call_details.method != f"/{self.SERVICE}/Generate":
+            return None
+
+        def generate(request, context):
+            kwargs = {
+                k: request[k]
+                for k in (
+                    "prompt", "tokens", "stop_token_ids", "session_id",
+                    "max_new_tokens", "temperature", "top_k", "top_p",
+                )
+                if isinstance(request, dict) and request.get(k) is not None
+            }
+            fut = asyncio.run_coroutine_threadsafe(
+                self.backend.generate(**kwargs), self.loop
+            )
+            try:
+                # Honor the caller's deadline (bounded default) and CANCEL the
+                # coroutine if it expires — a hung generation must release
+                # both this worker thread and its engine slot.
+                remaining = context.time_remaining()
+                timeout = min(remaining, 600.0) if remaining is not None else 600.0
+                return fut.result(timeout=timeout)
+            except TimeoutError:
+                fut.cancel()
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "generation timed out")
+            except Exception as e:
+                fut.cancel()
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        return grpc.unary_unary_rpc_method_handler(
+            generate,
+            request_deserializer=_json_deserializer,
+            response_serializer=_json_serializer,
+        )
+
+
+def start_model_grpc(backend: ModelBackend, port: int) -> "object":
+    """Serve Generate on `port`. Call from the event-loop thread (captures the
+    running loop for cross-thread coroutine dispatch)."""
+    from concurrent import futures as _futures
+
+    import grpc
+
+    loop = asyncio.get_running_loop()
+    server = grpc.server(_futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((ModelGrpcService(backend, loop),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        raise OSError(f"model gRPC could not bind 127.0.0.1:{port}")
+    server.start()
+    return server
+
+
+def model_grpc_generate(port: int, request: dict, timeout: float = 600.0) -> dict:
+    """Client helper for the gRPC Generate surface."""
+    import json as _json
+
+    import grpc
+
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+        fn = channel.unary_unary(
+            f"/{ModelGrpcService.SERVICE}/Generate",
+            request_serializer=lambda o: _json.dumps(o).encode(),
+            response_deserializer=lambda b: _json.loads(b) if b else {},
+        )
+        return fn(request, timeout=timeout)

@@ -1,0 +1,58 @@
+"""gRPC Generate surface on the model node."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import (
+    build_model_node,
+    model_grpc_generate,
+    start_model_grpc,
+)
+from tests.helpers_cp import CPHarness, async_test, free_port
+
+
+@async_test
+async def test_grpc_generate_round_trip():
+    async with CPHarness() as h:
+        agent, backend = build_model_node(
+            "grpc-model",
+            h.base_url,
+            model="llama-tiny",
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+        )
+        await backend.start()
+        await agent.start()
+        port = free_port()
+        server = start_model_grpc(backend, port)
+        try:
+            out = await asyncio.to_thread(
+                model_grpc_generate,
+                port,
+                {"tokens": [3, 4, 5], "max_new_tokens": 4, "session_id": "g1"},
+            )
+            assert len(out["tokens"]) == 4
+            assert out["finish_reason"] == "length"
+            # same engine, same session: gRPC and HTTP surfaces share state
+            out2 = await asyncio.to_thread(
+                model_grpc_generate,
+                port,
+                {"tokens": [3, 4, 5] + out["tokens"] + [6], "max_new_tokens": 2,
+                 "session_id": "g1"},
+            )
+            assert len(out2["tokens"]) == 2
+            assert backend.engine.stats["prefix_cache_hits"] == 1
+
+            # invalid request → clean INTERNAL error, server stays up
+            with pytest.raises(grpc.RpcError):
+                await asyncio.to_thread(model_grpc_generate, port, {"max_new_tokens": 2})
+            out3 = await asyncio.to_thread(
+                model_grpc_generate, port, {"tokens": [9], "max_new_tokens": 1}
+            )
+            assert len(out3["tokens"]) == 1
+        finally:
+            server.stop(grace=0)
+            await agent.stop()
+            await backend.stop()

@@ -135,6 +135,12 @@ class NodeRegistry:
             raise RegistryError(404, f"unknown node {node_id!r}; re-register")
         node.last_heartbeat = now()
         requested = (data or {}).get("status")
+        # Enhanced heartbeats may carry live node stats (e.g. a model node's
+        # engine counters — reference: enhanced heartbeat payload,
+        # agent_field_handler.py:459); surfaced via node metadata.
+        stats = (data or {}).get("stats")
+        if isinstance(stats, dict):
+            node.metadata["stats"] = stats
         old_status = node.status
         if requested is not None:
             try:

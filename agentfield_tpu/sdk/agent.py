@@ -564,11 +564,24 @@ class Agent:
             await self._runner.cleanup()
         await self.client.close()
 
+    # Optional provider of live stats shipped with each heartbeat (model
+    # nodes set this to their engine counters).
+    heartbeat_stats: Any = None  # callable -> dict | None
+
     async def _heartbeat_loop(self) -> None:
         while True:
             await asyncio.sleep(self.heartbeat_interval)
+            # A broken stats provider must degrade to a stats-less heartbeat,
+            # never suppress the heartbeat itself (the node would be marked
+            # dead while perfectly healthy).
+            stats = None
             try:
-                await self.client.heartbeat(self.node_id)
+                if callable(self.heartbeat_stats):
+                    stats = self.heartbeat_stats()
+            except Exception:
+                pass
+            try:
+                await self.client.heartbeat(self.node_id, stats=stats)
             except ControlPlaneError as e:
                 if e.status == 404:  # control plane restarted: re-register
                     try:

@@ -58,8 +58,14 @@ class ControlPlaneClient:
     async def register_node(self, spec: dict[str, Any]) -> dict[str, Any]:
         return await self._req("POST", "/api/v1/nodes", json=spec)
 
-    async def heartbeat(self, node_id: str, status: str | None = None) -> dict[str, Any]:
-        body = {"status": status} if status else {}
+    async def heartbeat(
+        self, node_id: str, status: str | None = None, stats: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        body: dict[str, Any] = {}
+        if status:
+            body["status"] = status
+        if stats:
+            body["stats"] = stats
         return await self._req("POST", f"/api/v1/nodes/{node_id}/heartbeat", json=body)
 
     async def deregister_node(self, node_id: str) -> None:

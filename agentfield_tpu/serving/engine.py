@@ -287,6 +287,10 @@ class InferenceEngine:
         self.slots: list[_Slot | None] = [None] * B
         self.pending: collections.deque[Request] = collections.deque()
         self._sessions: dict[str, _SessionEntry] = {}
+        # Cancellation requests (thread-safe set): drained inside step() on
+        # the worker thread — mutating slots from other threads mid-step
+        # would race the decode batch.
+        self._cancels: set[str] = set()
         # step() runs on a worker thread (ModelBackend) while submit()/
         # free_session() run on the event loop: session+allocator mutations
         # need mutual exclusion.
@@ -560,8 +564,35 @@ class InferenceEngine:
         self._dirty = True
         self._compact = None  # membership changed
 
+    def request_cancel(self, request_id: str) -> None:
+        """Cancel a pending or active request (client gone / deadline hit):
+        its slot and pages release at the next step() — work for a reader
+        that no longer exists must not keep decoding."""
+        self._cancels.add(request_id)
+
+    def _drain_cancels(self) -> None:
+        if not self._cancels:
+            return
+        cancels, self._cancels = self._cancels, set()
+        self.pending = collections.deque(r for r in self.pending if r.id not in cancels)
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.req.id in cancels:
+                # Incomplete output: release WITHOUT session retention.
+                with self._session_lock:
+                    self.allocator.free(slot.pages)
+                self.slots[i] = None
+                self.page_tables[i] = 0
+                self.seq_lens[i] = 0
+                self.temps[i] = 0.0
+                self.top_ks[i] = 0
+                self.top_ps[i] = 1.0
+                self._dirty = True
+                self._compact = None
+                self.stats["requests_cancelled"] = self.stats.get("requests_cancelled", 0) + 1
+
     def step(self) -> list[TokenEvent]:
         """One scheduler tick: admit (prefill) if possible, else decode."""
+        self._drain_cancels()
         events = self._try_admit()
         if events:
             return events

@@ -226,7 +226,7 @@ class ModelBackend:
         session_id: str | None = None,
     ) -> dict[str, Any]:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._submit(
+        rid = self._submit(
             prompt,
             tokens,
             max_new_tokens,
@@ -234,11 +234,20 @@ class ModelBackend:
             top_k,
             top_p,
             stop_token_ids,
-            register=lambda rid: self._futures.__setitem__(rid, fut),
-            unregister=lambda rid: self._futures.pop(rid, None),
+            register=lambda r: self._futures.__setitem__(r, fut),
+            unregister=lambda r: self._futures.pop(r, None),
             session_id=session_id,
         )
-        result = await fut
+        try:
+            result = await fut
+        except asyncio.CancelledError:
+            # Caller gone (gRPC deadline, disconnect): free the engine slot —
+            # decoding for a dead reader wastes TPU steps and pins pages.
+            self._futures.pop(rid, None)
+            self._buffers.pop(rid, None)
+            self.engine.request_cancel(rid)
+            self._wake.set()
+            raise
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(result["tokens"])
         result["model"] = self.model_name
@@ -328,6 +337,13 @@ def build_model_node(
     agent.reasoner(id="generate", description=f"TPU-served {model} generation")(
         backend.generate
     )
+    # Engine counters ride the 2s heartbeats → cluster-visible via
+    # /api/v1/nodes metadata and the dashboard.
+    agent.heartbeat_stats = lambda: {
+        **backend.engine.stats,
+        "active_slots": backend.engine.num_active,
+        "free_pages": backend.engine.allocator.free_pages,
+    }
 
     async def stream_handler(req):
         """SSE token stream — the data-plane path: callers hit the model node
@@ -520,14 +536,17 @@ def start_model_grpc(backend: ModelBackend, port: int) -> "object":
 
 def model_grpc_generate(port: int, request: dict, timeout: float = 600.0) -> dict:
     """Client helper for the gRPC Generate surface."""
-    import json as _json
-
     import grpc
+
+    from agentfield_tpu.control_plane.admin_grpc import (
+        _json_deserializer,
+        _json_serializer,
+    )
 
     with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
         fn = channel.unary_unary(
             f"/{ModelGrpcService.SERVICE}/Generate",
-            request_serializer=lambda o: _json.dumps(o).encode(),
-            response_deserializer=lambda b: _json.loads(b) if b else {},
+            request_serializer=_json_serializer,
+            response_deserializer=_json_deserializer,
         )
         return fn(request, timeout=timeout)

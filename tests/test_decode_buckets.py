@@ -71,6 +71,43 @@ def test_transition_between_bucket_and_full(params):
         assert results[f"r{i}"] == oracle
 
 
+def test_request_cancel_releases_slot(params):
+    """Cancelling an active request frees its slot+pages at the next step;
+    remaining requests continue correctly."""
+    engine = InferenceEngine(params, CFG, BASE)
+    engine.submit(Request(id="keep", prompt=_prompt(50, 4), sampling=SamplingParams(max_new_tokens=4)))
+    engine.submit(Request(id="drop", prompt=_prompt(51, 4), sampling=SamplingParams(max_new_tokens=32)))
+    # admit both (two steps = two prefills, each emitting the first token)
+    results: dict[str, list[int]] = {}
+    for _ in range(2):
+        for ev in engine.step():
+            results.setdefault(ev.request_id, []).append(ev.token)
+    assert engine.num_active == 2
+    engine.request_cancel("drop")
+    while engine.has_work():
+        for ev in engine.step():
+            results.setdefault(ev.request_id, []).append(ev.token)
+    assert "drop" not in results or len(results.get("drop", [])) <= 1
+    assert engine.stats["requests_cancelled"] == 1
+    assert engine.num_active == 0
+    assert engine.allocator.free_pages == BASE.num_pages - 1  # everything freed
+    # the surviving request matches the oracle
+    from agentfield_tpu.models.llama import generate_greedy
+
+    oracle = generate_greedy(
+        params, CFG, jnp.asarray([_prompt(50, 4)], jnp.int32), num_steps=4, max_len=64
+    )[0].tolist()
+    assert results["keep"] == oracle
+
+
+def test_cancel_pending_request(params):
+    engine = InferenceEngine(params, CFG, BASE)
+    engine.submit(Request(id="p1", prompt=_prompt(52, 4), sampling=SamplingParams(max_new_tokens=2)))
+    engine.request_cancel("p1")
+    assert engine.step() == []  # drained from pending before admission
+    assert not engine.has_work()
+
+
 def test_bucketed_with_sessions(params):
     ecfg = dataclasses.replace(BASE, decode_buckets=(2,))
     engine = InferenceEngine(params, CFG, ecfg)

@@ -72,6 +72,43 @@ async def test_health_aggregates_mcp():
 
 
 @async_test
+async def test_model_stats_ride_heartbeats():
+    """Model-node engine counters become cluster-visible via heartbeats."""
+    import asyncio
+
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "statmodel",
+            h.base_url,
+            model="llama-tiny",
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+        )
+        model_agent.heartbeat_interval = 0.1
+        await backend.start()
+        await model_agent.start()
+        try:
+            await backend.generate(tokens=[1, 2, 3], max_new_tokens=2)
+            stats = None
+            for _ in range(50):
+                # stats persist under the heartbeat write throttle (≤10s
+                # stale in prod); zero it so the test observes promptly
+                h.cp.registry._last_persist["statmodel"] = 0
+                node = h.cp.storage.get_node("statmodel")
+                stats = node.metadata.get("stats") if node else None
+                if stats and stats.get("decode_tokens", 0) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert stats["requests_finished"] == 1
+            assert "free_pages" in stats and "active_slots" in stats
+        finally:
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
 async def test_load_generator_sync_and_async():
     from tools.perf.load_gen import run_load, scrape_metrics
 

@@ -23,6 +23,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
+    attn_bias: bool = False  # Qwen2-style QKV projection biases
     # dtype name, resolved lazily so configs stay hashable / serializable
     dtype: str = "bfloat16"
 
@@ -39,6 +40,8 @@ class LlamaConfig:
         """Approximate parameter count (for memory planning)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
         per_layer = d * (self.q_dim + 2 * self.kv_dim) + self.q_dim * d + 3 * d * f + 2 * d
+        if self.attn_bias:
+            per_layer += self.q_dim + 2 * self.kv_dim
         embed = v * d * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + embed + d
 
@@ -89,6 +92,33 @@ PRESETS: dict[str, LlamaConfig] = {
         num_kv_heads=8,
         head_dim=128,
         max_seq_len=8192,
+    ),
+    # Mistral-7B: same decoder family (GQA, rotate-half RoPE, SwiGLU) —
+    # served by the identical code path.
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=10000.0,
+        max_seq_len=32768,
+    ),
+    # Qwen2-7B: adds QKV projection biases (attn_bias).
+    "qwen2-7b": LlamaConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1_000_000.0,
+        rms_norm_eps=1e-6,
+        max_seq_len=32768,
+        attn_bias=True,
     ),
     # Llama 3 70B (TP=8 over ICI, north-star config 5).
     "llama-3-70b": LlamaConfig(

@@ -25,8 +25,19 @@ from agentfield_tpu.models.configs import LlamaConfig
 
 def config_from_hf(path: str | Path) -> LlamaConfig:
     doc = json.loads((Path(path) / "config.json").read_text())
-    if doc.get("model_type") not in ("llama", None):
-        raise ValueError(f"not a llama checkpoint: model_type={doc.get('model_type')!r}")
+    if doc.get("model_type") not in ("llama", "mistral", "qwen2", None):
+        raise ValueError(
+            f"unsupported model_type={doc.get('model_type')!r} (llama/mistral/qwen2)"
+        )
+    if doc.get("sliding_window"):
+        import warnings
+
+        warnings.warn(
+            f"checkpoint declares sliding_window={doc['sliding_window']} which this "
+            "build does not implement — attention is full-causal, so logits "
+            "diverge from the reference beyond that window length",
+            stacklevel=2,
+        )
     hidden = doc["hidden_size"]
     heads = doc["num_attention_heads"]
     return LlamaConfig(
@@ -38,6 +49,7 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         num_kv_heads=doc.get("num_key_value_heads", heads),
         head_dim=doc.get("head_dim", hidden // heads),
         rope_theta=doc.get("rope_theta", 10000.0),
+        attn_bias=doc.get("attention_bias", doc.get("model_type") == "qwen2"),
         rms_norm_eps=doc.get("rms_norm_eps", 1e-5),
         max_seq_len=doc.get("max_position_embeddings", 8192),
         tie_embeddings=doc.get("tie_word_embeddings", False),
@@ -100,6 +112,10 @@ def load_hf_checkpoint(
         },
         "final_norm": jnp.asarray(get("model.norm.weight")).astype(dt),
     }
+    if cfg.attn_bias:
+        params["layers"]["bq"] = stack(p + "self_attn.q_proj.bias", transpose=False)
+        params["layers"]["bk"] = stack(p + "self_attn.k_proj.bias", transpose=False)
+        params["layers"]["bv"] = stack(p + "self_attn.v_proj.bias", transpose=False)
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T).astype(dt)
     return cfg, params
@@ -126,6 +142,10 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
         "w_up": ("mlp.up_proj.weight", True),
         "w_down": ("mlp.down_proj.weight", True),
     }
+    if cfg.attn_bias:
+        names["bq"] = ("self_attn.q_proj.bias", False)
+        names["bk"] = ("self_attn.k_proj.bias", False)
+        names["bv"] = ("self_attn.v_proj.bias", False)
     for ours, (theirs, transpose) in names.items():
         stacked = np.asarray(params["layers"][ours], np.float32)
         for i in range(cfg.num_layers):
@@ -149,6 +169,7 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
                 "rms_norm_eps": cfg.rms_norm_eps,
                 "max_position_embeddings": cfg.max_seq_len,
                 "tie_word_embeddings": cfg.tie_embeddings,
+                "attention_bias": cfg.attn_bias,
             }
         )
     )

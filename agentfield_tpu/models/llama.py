@@ -65,6 +65,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype: str | None = None) -> P
         },
         "final_norm": jnp.ones((d,), dt),
     }
+    if cfg.attn_bias:  # Qwen2-style QKV biases
+        params["layers"]["bq"] = jnp.zeros((L, cfg.q_dim), dt)
+        params["layers"]["bk"] = jnp.zeros((L, cfg.kv_dim), dt)
+        params["layers"]["bv"] = jnp.zeros((L, cfg.kv_dim), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(keys[8], (d, v), scale)
     return params
@@ -129,11 +133,15 @@ def attention_ref(
 
 
 def qkv_proj(lp: Params, x_normed: jax.Array, cfg: LlamaConfig, cos, sin):
-    """Project + rope. Returns q [B,S,H,hd], k/v [B,S,Kh,hd]."""
+    """Project (+bias for Qwen2-style configs) + rope.
+    Returns q [B,S,H,hd], k/v [B,S,Kh,hd]."""
     B, S, _ = x_normed.shape
-    q = (x_normed @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = (x_normed @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = (x_normed @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q, k, v = x_normed @ lp["wq"], x_normed @ lp["wk"], x_normed @ lp["wv"]
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 

@@ -38,6 +38,10 @@ def param_pspecs(cfg: LlamaConfig) -> dict[str, Any]:
         },
         "final_norm": P(None),
     }
+    if cfg.attn_bias:
+        specs["layers"]["bq"] = P(None, m)
+        specs["layers"]["bk"] = P(None, m)
+        specs["layers"]["bv"] = P(None, m)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, m)
     return specs

@@ -29,7 +29,9 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         raise ValueError(
             f"unsupported model_type={doc.get('model_type')!r} (llama/mistral/qwen2)"
         )
-    if doc.get("sliding_window"):
+    if doc.get("sliding_window") and doc.get("use_sliding_window", True):
+        # (Qwen2 configs carry sliding_window but disable it via
+        # use_sliding_window=false — full attention matches the reference.)
         import warnings
 
         warnings.warn(

@@ -95,6 +95,8 @@ class TokenEvent:
     index: int  # 0-based index among generated tokens
     finished: bool
     finish_reason: str | None = None  # "stop" | "length"
+    logprob: float | None = None  # log P(token) under the UNMODIFIED (pre-
+    # temperature/top-k/top-p) distribution — raw-logit log-softmax
 
 
 @dataclasses.dataclass
@@ -147,10 +149,13 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
         x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
         logits = llama.unembed(params, cfg, x)[:, 0]  # [B, V]
         next_tokens = sample_tokens(logits, rng, temps, top_ks, top_ps)
+        logprobs = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), next_tokens[:, None], axis=-1
+        )[:, 0]
         # Advance lengths on-device (active slots have seq_len > 0) so the
         # host never re-uploads control state during steady-state decode.
         new_seq_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
-        return next_tokens, new_seq_lens, kp, vp
+        return next_tokens, logprobs, new_seq_lens, kp, vp
 
     return jax.jit(decode, donate_argnums=(1, 2))
 
@@ -440,15 +445,15 @@ class InferenceEngine:
             self.stats["prefix_tokens_reused"] += start
         last_logits = self._prefill(suffix, start, row)
         s = req.sampling
-        tok = int(
-            sample_tokens(
-                last_logits[None],
-                self._next_rng(),
-                jnp.asarray([s.temperature], jnp.float32),
-                jnp.asarray([s.top_k], jnp.int32),
-                jnp.asarray([s.top_p], jnp.float32),
-            )[0]
+        tok_arr = sample_tokens(
+            last_logits[None],
+            self._next_rng(),
+            jnp.asarray([s.temperature], jnp.float32),
+            jnp.asarray([s.top_k], jnp.int32),
+            jnp.asarray([s.top_p], jnp.float32),
         )
+        tok = int(tok_arr[0])
+        first_logprob = float(jax.nn.log_softmax(last_logits)[tok])
         self.stats["prefill_tokens"] += len(suffix)
 
         slot = _Slot(
@@ -459,7 +464,7 @@ class InferenceEngine:
             last_token=tok,
             tokens=list(req.prompt) + [tok],
         )
-        event = self._emit(free_slot, slot, tok)
+        event = self._emit(free_slot, slot, tok, first_logprob)
         if not event.finished:
             self.slots[free_slot] = slot
             self.page_tables[free_slot] = row
@@ -514,7 +519,9 @@ class InferenceEngine:
                 )
         return last_logits
 
-    def _emit(self, slot_idx: int, slot: _Slot, tok: int) -> TokenEvent:
+    def _emit(
+        self, slot_idx: int, slot: _Slot, tok: int, logprob: float | None = None
+    ) -> TokenEvent:
         s = slot.req.sampling
         reason = None
         if tok in s.stop_token_ids:
@@ -527,6 +534,7 @@ class InferenceEngine:
             index=slot.generated - 1,
             finished=reason is not None,
             finish_reason=reason,
+            logprob=logprob,
         )
         if ev.finished:
             self._release(slot_idx, slot)
@@ -612,13 +620,13 @@ class InferenceEngine:
             slot = self.slots[i]
             slot.length += 1
             slot.generated += 1
-            tok = next_by_slot[i]
+            tok, logprob = next_by_slot[i]
             slot.last_token = tok
             slot.tokens.append(tok)
             self.seq_lens[i] = slot.length
             self.last_tokens[i] = tok
             self.stats["decode_tokens"] += 1
-            out.append(self._emit(i, slot, tok))
+            out.append(self._emit(i, slot, tok, logprob))
         return out
 
     def _pick_decode_bucket(self, n_active: int) -> int | None:
@@ -629,7 +637,7 @@ class InferenceEngine:
                 return b
         return None
 
-    def _decode_full(self) -> dict[int, int]:
+    def _decode_full(self) -> dict[int, tuple[int, float]]:
         if self._dirty:
             self._dev = {
                 "tokens": jnp.asarray(self.last_tokens),
@@ -641,23 +649,30 @@ class InferenceEngine:
             }
             self._dirty = False
         d = self._dev
-        next_tokens, new_seq_lens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            d["tokens"],
-            d["seq_lens"],
-            d["page_tables"],
-            self._next_rng(),
-            d["temps"],
-            d["top_ks"],
-            d["top_ps"],
+        next_tokens, logprobs, new_seq_lens, self.cache.k_pages, self.cache.v_pages = (
+            self._decode_jit(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                d["tokens"],
+                d["seq_lens"],
+                d["page_tables"],
+                self._next_rng(),
+                d["temps"],
+                d["top_ks"],
+                d["top_ps"],
+            )
         )
         d["tokens"], d["seq_lens"] = next_tokens, new_seq_lens
         next_np = np.asarray(next_tokens)
-        return {i: int(next_np[i]) for i, s in enumerate(self.slots) if s is not None}
+        lp_np = np.asarray(logprobs)
+        return {
+            i: (int(next_np[i]), float(lp_np[i]))
+            for i, s in enumerate(self.slots)
+            if s is not None
+        }
 
-    def _decode_compact(self, active_idx: list[int], bucket: int) -> dict[int, int]:
+    def _decode_compact(self, active_idx: list[int], bucket: int) -> dict[int, tuple[int, float]]:
         """Low-occupancy step: gather the active slots' control rows into a
         [bucket]-wide batch (padding rows are inert: seq_len 0 writes to the
         garbage page). The jitted decode retraces once per bucket width.
@@ -690,22 +705,28 @@ class InferenceEngine:
                 "top_ps": jnp.asarray(top_ps),
             }
 
-        next_tokens, new_seq_lens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            c["tokens"],
-            c["seq_lens"],
-            c["page_tables"],
-            self._next_rng(),
-            c["temps"],
-            c["top_ks"],
-            c["top_ps"],
+        next_tokens, logprobs, new_seq_lens, self.cache.k_pages, self.cache.v_pages = (
+            self._decode_jit(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                c["tokens"],
+                c["seq_lens"],
+                c["page_tables"],
+                self._next_rng(),
+                c["temps"],
+                c["top_ks"],
+                c["top_ps"],
+            )
         )
         c["tokens"], c["seq_lens"] = next_tokens, new_seq_lens
         self._dirty = True  # full-width device state is now stale
         next_np = np.asarray(next_tokens)
-        return {slot_i: int(next_np[j]) for j, slot_i in enumerate(active_idx)}
+        lp_np = np.asarray(logprobs)
+        return {
+            slot_i: (int(next_np[j]), float(lp_np[j]))
+            for j, slot_i in enumerate(active_idx)
+        }
 
     def run_to_completion(self, requests: list[Request]) -> dict[str, list[int]]:
         """Convenience driver: submit everything, step until drained, return

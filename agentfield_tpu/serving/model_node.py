@@ -91,6 +91,7 @@ class ModelBackend:
         self.tokenizer = tokenizer
         self.idle_sleep = idle_sleep
         self._buffers: dict[str, list[int]] = {}
+        self._logprob_buffers: dict[str, list[float]] = {}
         self._futures: dict[str, asyncio.Future] = {}
         self._streams: dict[str, asyncio.Queue] = {}  # rid -> per-token queue
         self._wake = asyncio.Event()
@@ -137,6 +138,7 @@ class ModelBackend:
                         fut.set_exception(RuntimeError(f"engine step failed: {e!r}"))
                     self._futures.pop(rid, None)
                     self._buffers.pop(rid, None)
+                    self._logprob_buffers.pop(rid, None)
                 for rid, q in list(self._streams.items()):
                     self._push_stream(rid, q, _error_event(rid, f"engine step failed: {e!r}"))
                 self._streams.clear()
@@ -151,13 +153,24 @@ class ModelBackend:
                     if alive:
                         continue
                     # fall through: consumer gone, route to the discard path
+                if ev.request_id not in self._futures:
+                    continue  # cancelled/unknown rid: never recreate buffers
+                    # (a setdefault here would leak entries forever)
                 buf = self._buffers.setdefault(ev.request_id, [])
                 buf.append(ev.token)
+                self._logprob_buffers.setdefault(ev.request_id, []).append(ev.logprob)
                 if ev.finished:
                     fut = self._futures.pop(ev.request_id, None)
                     tokens = self._buffers.pop(ev.request_id, [])
+                    logprobs = self._logprob_buffers.pop(ev.request_id, [])
                     if fut is not None and not fut.done():
-                        fut.set_result({"tokens": tokens, "finish_reason": ev.finish_reason})
+                        fut.set_result(
+                            {
+                                "tokens": tokens,
+                                "logprobs": logprobs,
+                                "finish_reason": ev.finish_reason,
+                            }
+                        )
 
     @staticmethod
     def _push_stream(rid: str, q: asyncio.Queue, ev) -> bool:
@@ -245,6 +258,7 @@ class ModelBackend:
             # decoding for a dead reader wastes TPU steps and pins pages.
             self._futures.pop(rid, None)
             self._buffers.pop(rid, None)
+            self._logprob_buffers.pop(rid, None)
             self.engine.request_cancel(rid)
             self._wake.set()
             raise
@@ -383,6 +397,7 @@ def build_model_node(
                     "index": ev.index,
                     "finished": ev.finished,
                     "finish_reason": ev.finish_reason,
+                    "logprob": ev.logprob,
                 }
                 if backend.tokenizer is not None and ev.token >= 0:
                     frame["text"] = backend.tokenizer.decode([ev.token])

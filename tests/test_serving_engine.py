@@ -140,6 +140,22 @@ def test_tp_engine_rejects_pallas_impls(params):
         InferenceEngine(params, CFG, ecfg, mesh=mesh)
 
 
+def test_logprobs_emitted(params):
+    """Every token event carries log P(token); greedy logprobs are the max
+    log-softmax entry (finite, <= 0)."""
+    import math
+
+    engine = InferenceEngine(params, CFG, ECFG)
+    engine.submit(_greedy_req("lp", _prompt(jax.random.PRNGKey(0), 5), max_new=4))
+    events = []
+    while engine.has_work():
+        events.extend(engine.step())
+    assert len(events) == 4
+    for ev in events:
+        assert ev.logprob is not None and math.isfinite(ev.logprob)
+        assert ev.logprob <= 0.0
+
+
 def test_allocator_invariants():
     a = PageAllocator(8)
     got = a.alloc(7)

@@ -320,6 +320,7 @@ class InferenceEngine:
             "prefix_cache_hits": 0,
             "prefix_tokens_reused": 0,
             "sessions_evicted": 0,
+            "requests_cancelled": 0,
         }
 
     # ------------------------------------------------------------------
@@ -582,7 +583,9 @@ class InferenceEngine:
         if not self._cancels:
             return
         cancels, self._cancels = self._cancels, set()
+        n_before = len(self.pending)
         self.pending = collections.deque(r for r in self.pending if r.id not in cancels)
+        self.stats["requests_cancelled"] += n_before - len(self.pending)
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
                 # Incomplete output: release WITHOUT session retention.
@@ -596,7 +599,7 @@ class InferenceEngine:
                 self.top_ps[i] = 1.0
                 self._dirty = True
                 self._compact = None
-                self.stats["requests_cancelled"] = self.stats.get("requests_cancelled", 0) + 1
+                self.stats["requests_cancelled"] += 1
 
     def step(self) -> list[TokenEvent]:
         """One scheduler tick: admit (prefill) if possible, else decode."""

@@ -90,8 +90,9 @@ class ModelBackend:
         self.engine = InferenceEngine(params, cfg, ecfg, seed=seed, mesh=mesh)
         self.tokenizer = tokenizer
         self.idle_sleep = idle_sleep
-        self._buffers: dict[str, list[int]] = {}
-        self._logprob_buffers: dict[str, list[float]] = {}
+        # One accumulation dict: (token, logprob) records per request —
+        # parallel dicts would need mirrored lifecycle at every cleanup site.
+        self._buffers: dict[str, list[tuple[int, float | None]]] = {}
         self._futures: dict[str, asyncio.Future] = {}
         self._streams: dict[str, asyncio.Queue] = {}  # rid -> per-token queue
         self._wake = asyncio.Event()
@@ -138,7 +139,6 @@ class ModelBackend:
                         fut.set_exception(RuntimeError(f"engine step failed: {e!r}"))
                     self._futures.pop(rid, None)
                     self._buffers.pop(rid, None)
-                    self._logprob_buffers.pop(rid, None)
                 for rid, q in list(self._streams.items()):
                     self._push_stream(rid, q, _error_event(rid, f"engine step failed: {e!r}"))
                 self._streams.clear()
@@ -156,18 +156,15 @@ class ModelBackend:
                 if ev.request_id not in self._futures:
                     continue  # cancelled/unknown rid: never recreate buffers
                     # (a setdefault here would leak entries forever)
-                buf = self._buffers.setdefault(ev.request_id, [])
-                buf.append(ev.token)
-                self._logprob_buffers.setdefault(ev.request_id, []).append(ev.logprob)
+                self._buffers.setdefault(ev.request_id, []).append((ev.token, ev.logprob))
                 if ev.finished:
                     fut = self._futures.pop(ev.request_id, None)
-                    tokens = self._buffers.pop(ev.request_id, [])
-                    logprobs = self._logprob_buffers.pop(ev.request_id, [])
+                    records = self._buffers.pop(ev.request_id, [])
                     if fut is not None and not fut.done():
                         fut.set_result(
                             {
-                                "tokens": tokens,
-                                "logprobs": logprobs,
+                                "tokens": [t for t, _ in records],
+                                "logprobs": [lp for _, lp in records],
                                 "finish_reason": ev.finish_reason,
                             }
                         )
@@ -258,7 +255,6 @@ class ModelBackend:
             # decoding for a dead reader wastes TPU steps and pins pages.
             self._futures.pop(rid, None)
             self._buffers.pop(rid, None)
-            self._logprob_buffers.pop(rid, None)
             self.engine.request_cancel(rid)
             self._wake.set()
             raise

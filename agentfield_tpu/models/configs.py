@@ -11,6 +11,19 @@ import dataclasses
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1/3.2-style RoPE frequency rescaling (HF ``rope_scaling`` with
+    ``rope_type="llama3"``). Wavelengths past ``original_max_position_embeddings
+    / low_freq_factor`` are divided by ``factor``; a smooth ramp interpolates
+    between the high- and low-frequency cutoffs."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
     hidden_size: int = 4096
@@ -20,6 +33,7 @@ class LlamaConfig:
     num_kv_heads: int = 8
     head_dim: int = 128
     rope_theta: float = 500_000.0
+    rope_scaling: RopeScaling | None = None  # llama3-style frequency rescaling
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
@@ -81,6 +95,13 @@ PRESETS: dict[str, LlamaConfig] = {
         head_dim=64,
         tie_embeddings=True,
         max_seq_len=8192,
+        # HF meta-llama/Llama-3.2-1B config.json rope_scaling (rope_type=llama3)
+        rope_scaling=RopeScaling(
+            factor=32.0,
+            low_freq_factor=1.0,
+            high_freq_factor=4.0,
+            original_max_position_embeddings=8192,
+        ),
     ),
     # Llama 3 8B (primary north-star model).
     "llama-3-8b": LlamaConfig(

@@ -40,6 +40,26 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
             "diverge from the reference beyond that window length",
             stacklevel=2,
         )
+    rope_scaling = None
+    rs = doc.get("rope_scaling")
+    if rs:
+        kind = rs.get("rope_type", rs.get("type", "default"))
+        if kind == "llama3":
+            from agentfield_tpu.models.configs import RopeScaling
+
+            rope_scaling = RopeScaling(
+                factor=float(rs["factor"]),
+                low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                original_max_position_embeddings=int(
+                    rs.get("original_max_position_embeddings", 8192)
+                ),
+            )
+        elif kind not in ("default", None):
+            raise ValueError(
+                f"unsupported rope_scaling type {kind!r} (only 'llama3'/'default'); "
+                "loading would silently produce wrong logits"
+            )
     hidden = doc["hidden_size"]
     heads = doc["num_attention_heads"]
     return LlamaConfig(
@@ -51,6 +71,7 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         num_kv_heads=doc.get("num_key_value_heads", heads),
         head_dim=doc.get("head_dim", hidden // heads),
         rope_theta=doc.get("rope_theta", 10000.0),
+        rope_scaling=rope_scaling,
         attn_bias=doc.get("attention_bias", doc.get("model_type") == "qwen2"),
         rms_norm_eps=doc.get("rms_norm_eps", 1e-5),
         max_seq_len=doc.get("max_position_embeddings", 8192),
@@ -168,6 +189,19 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
                 "num_key_value_heads": cfg.num_kv_heads,
                 "head_dim": cfg.head_dim,
                 "rope_theta": cfg.rope_theta,
+                **(
+                    {
+                        "rope_scaling": {
+                            "rope_type": "llama3",
+                            "factor": cfg.rope_scaling.factor,
+                            "low_freq_factor": cfg.rope_scaling.low_freq_factor,
+                            "high_freq_factor": cfg.rope_scaling.high_freq_factor,
+                            "original_max_position_embeddings": cfg.rope_scaling.original_max_position_embeddings,
+                        }
+                    }
+                    if cfg.rope_scaling
+                    else {}
+                ),
                 "rms_norm_eps": cfg.rms_norm_eps,
                 "max_position_embeddings": cfg.max_seq_len,
                 "tie_word_embeddings": cfg.tie_embeddings,

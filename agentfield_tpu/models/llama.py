@@ -85,10 +85,29 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def rope_sincos(positions: jax.Array, head_dim: int, theta: float):
-    """cos/sin tables for the given absolute positions. positions: [...]."""
+def rope_sincos(positions: jax.Array, head_dim: int, theta: float, scaling=None):
+    """cos/sin tables for the given absolute positions. positions: [...].
+
+    ``scaling`` is an optional :class:`~agentfield_tpu.models.configs.RopeScaling`
+    applying Llama-3.1/3.2-style frequency rescaling (HF ``rope_scaling`` with
+    ``rope_type="llama3"``): long wavelengths are stretched by ``factor`` with
+    a smooth ramp between the high-/low-frequency cutoffs, so 3.1/3.2
+    checkpoints produce reference-exact logits at all positions.
+    """
     half = head_dim // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        wavelen = 2.0 * jnp.pi / inv_freq
+        orig = float(scaling.original_max_position_embeddings)
+        low_wl = orig / scaling.low_freq_factor  # longest unscaled wavelength
+        high_wl = orig / scaling.high_freq_factor
+        smooth = (orig / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        interp = (1.0 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < high_wl, inv_freq, jnp.where(wavelen > low_wl, inv_freq / scaling.factor, interp)
+        )
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -181,7 +200,7 @@ def forward_impl(
     (rematerialize the layer body in backward, trading FLOPs for HBM).
     """
     x = jnp.take(params["embed"], tokens, axis=0)
-    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def attend(q, k, v):
         if attn_impl == "flash":
@@ -255,7 +274,7 @@ def forward_with_cache(
     T = cache["k"].shape[2]
     positions = offset + jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
     x = jnp.take(params["embed"], tokens, axis=0)
-    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     k_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
     k_valid = k_pos < (offset + S)
 

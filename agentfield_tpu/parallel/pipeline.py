@@ -40,7 +40,7 @@ def _stage_body(cfg: LlamaConfig, stage_layers, x, positions):
 
     def body(x, lp):
         h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
         attn = llama.attention_ref(
             q, k, v, positions, positions, jnp.ones_like(positions, dtype=bool)

@@ -127,7 +127,7 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
         B = tokens.shape[0]
         positions = seq_lens  # 0-based position of the incoming token
         x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
-        cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta)
+        cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         page_idx = jnp.take_along_axis(
             page_tables, (seq_lens // ps)[:, None], axis=1
         )[:, 0]  # [B] page holding this token (garbage page 0 when inactive)
@@ -195,7 +195,7 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     def prefill(params, k_pages, v_pages, tokens, start, n_new, page_table_row):
         positions = (start + jnp.arange(bucket, dtype=jnp.int32))[None]  # [1, B]
         x = jnp.take(params["embed"], tokens, axis=0)
-        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         pos = positions[0]
         rel = jnp.arange(bucket, dtype=jnp.int32)
         in_range = rel < n_new
@@ -300,6 +300,11 @@ class InferenceEngine:
         # free_session() run on the event loop: session+allocator mutations
         # need mutual exclusion.
         self._session_lock = threading.RLock()
+        # Guards self.pending: submit() appends from the event-loop thread
+        # while _drain_cancels() rebuilds the deque on the worker thread —
+        # unguarded, an append during the rebuild raises RuntimeError or is
+        # silently dropped (its future would never resolve).
+        self._pending_lock = threading.Lock()
         self._rng = jax.random.PRNGKey(seed)
         self._decode_jit = _decode_fn(cfg, self.ecfg)
         # Device-resident copies of the control arrays; refreshed from the
@@ -339,10 +344,11 @@ class InferenceEngine:
                 f"{req.sampling.max_new_tokens} new tokens needs {needed} pages "
                 f"> max_pages_per_seq={self.ecfg.max_pages_per_seq}"
             )
-        if len(self.pending) >= self.ecfg.max_pending:
-            self.stats["backpressure_total"] += 1
-            raise QueueFullError(f"pending queue at capacity {self.ecfg.max_pending}")
-        self.pending.append(req)
+        with self._pending_lock:
+            if len(self.pending) >= self.ecfg.max_pending:
+                self.stats["backpressure_total"] += 1
+                raise QueueFullError(f"pending queue at capacity {self.ecfg.max_pending}")
+            self.pending.append(req)
 
     def _pages_needed(self, req: Request) -> int:
         total = len(req.prompt) + req.sampling.max_new_tokens
@@ -402,6 +408,14 @@ class InferenceEngine:
             return None
         cl = len(sess.tokens)
         if 0 < cl < len(req.prompt) and req.prompt[:cl] == sess.tokens:
+            return sess
+        if 0 < len(req.prompt) <= cl and sess.tokens[: len(req.prompt)] == req.prompt:
+            # The prompt is fully resident (exact match or a prefix of the
+            # cached history — e.g. a client retry of the same turn). We still
+            # need last-token logits to sample, so mark the final prompt token
+            # as uncached and re-prefill just that one token (KV rewrite is
+            # idempotent); stale KV past the prompt is masked by seq_len.
+            sess.tokens = req.prompt[:-1]
             return sess
         # Mismatched history (edited conversation, collision): drop the entry.
         self.allocator.free(self._sessions.pop(req.session_id).pages)
@@ -583,9 +597,11 @@ class InferenceEngine:
         if not self._cancels:
             return
         cancels, self._cancels = self._cancels, set()
-        n_before = len(self.pending)
-        self.pending = collections.deque(r for r in self.pending if r.id not in cancels)
-        self.stats["requests_cancelled"] += n_before - len(self.pending)
+        with self._pending_lock:
+            n_before = len(self.pending)
+            kept = collections.deque(r for r in self.pending if r.id not in cancels)
+            self.pending = kept
+            self.stats["requests_cancelled"] += n_before - len(kept)
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
                 # Incomplete output: release WITHOUT session retention.

@@ -517,6 +517,14 @@ class ModelGrpcService:
             except TimeoutError:
                 fut.cancel()
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "generation timed out")
+            except QueueFullError as e:
+                # Admission backpressure is retryable — mirror the SSE path's
+                # 503 (reference queue-full semantics, execute.go:1373-1410).
+                fut.cancel()
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except RequestTooLongError as e:
+                fut.cancel()
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except Exception as e:
                 fut.cancel()
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))

@@ -39,9 +39,17 @@ def sample_tokens(
 ) -> jax.Array:
     """Vectorized mixed-strategy sampling. Rows with temperature<=0 take the
     argmax. Rows with plain temperature sampling (top_k=0, top_p>=1) sample the
-    FULL tempered vocab. Rows requesting top-k/top-p truncation sample inside a
-    static ``k_max``-wide candidate set (one lax.top_k scan, no vocab sort);
-    requested top_k values larger than k_max are clamped to k_max."""
+    FULL tempered vocab. Rows requesting top-k and/or top-p truncation sample
+    inside a static ``k_max``-wide candidate set (one lax.top_k scan, no vocab
+    sort). This is a stated contract, not just an optimization:
+
+    - requested ``top_k`` values larger than ``k_max`` are clamped to ``k_max``;
+    - ``top_p``-only rows (top_k=0, top_p<1) are ALSO bounded by the ``k_max``
+      most likely tokens — if the nucleus is wider than ``k_max`` (high
+      temperature / flat distribution), the realized distribution is narrower
+      than requested. Raise ``k_max`` if exact wide-nucleus sampling matters;
+      cost grows with one [B, k_max] top_k + softmax.
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 

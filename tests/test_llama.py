@@ -104,3 +104,69 @@ def test_generate_greedy_consistent(params):
     logits, _ = forward(params, CFG, seq, pos)
     for i in range(4):
         assert int(gen[0, i]) == int(jnp.argmax(logits[0, 4 + i]))
+
+
+def test_rope_llama3_scaling_matches_hf_formula():
+    """rope_sincos with RopeScaling must reproduce HF's _compute_llama3_parameters
+    (transformers modeling_rope_utils): per-band inv_freq rescaling."""
+    import numpy as np
+
+    from agentfield_tpu.models.configs import RopeScaling
+    from agentfield_tpu.models.llama import rope_sincos
+
+    head_dim, theta = 64, 500_000.0
+    sc = RopeScaling(
+        factor=32.0, low_freq_factor=1.0, high_freq_factor=4.0,
+        original_max_position_embeddings=8192,
+    )
+    # independent numpy implementation of the HF formula
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim // 2) / (head_dim // 2)))
+    wavelen = 2 * np.pi / inv_freq
+    scaled = np.empty_like(inv_freq)
+    for i, (f, wl) in enumerate(zip(inv_freq, wavelen)):
+        if wl < sc.original_max_position_embeddings / sc.high_freq_factor:
+            scaled[i] = f  # high-frequency band untouched
+        elif wl > sc.original_max_position_embeddings / sc.low_freq_factor:
+            scaled[i] = f / sc.factor
+        else:
+            smooth = (sc.original_max_position_embeddings / wl - sc.low_freq_factor) / (
+                sc.high_freq_factor - sc.low_freq_factor
+            )
+            scaled[i] = (1 - smooth) * f / sc.factor + smooth * f
+    pos = np.array([0.0, 1.0, 17.0, 100.0, 1000.0], dtype=np.float32)
+    want_cos = np.cos(pos[:, None] * scaled.astype(np.float32)[None, :])
+    cos, sin = rope_sincos(jnp.asarray(pos), head_dim, theta, sc)
+    np.testing.assert_allclose(np.asarray(cos), want_cos, rtol=1e-4, atol=1e-4)
+    # and scaling actually changes the tables at long positions
+    cos0, _ = rope_sincos(jnp.asarray(pos), head_dim, theta, None)
+    assert not np.allclose(np.asarray(cos), np.asarray(cos0))
+
+
+def test_hf_config_rope_scaling_round_trip(tmp_path):
+    """config.json rope_scaling (rope_type=llama3) survives save→load; unknown
+    rope types are rejected instead of silently mis-loading."""
+    import json
+
+    import pytest as _pytest
+
+    from agentfield_tpu.models.hf_loader import config_from_hf
+
+    doc = {
+        "model_type": "llama",
+        "vocab_size": 512, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 32,
+        "rope_theta": 500000.0,
+        "rope_scaling": {
+            "rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+        },
+    }
+    (tmp_path / "config.json").write_text(json.dumps(doc))
+    cfg = config_from_hf(tmp_path)
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.factor == 32.0
+
+    doc["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    (tmp_path / "config.json").write_text(json.dumps(doc))
+    with _pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(tmp_path)

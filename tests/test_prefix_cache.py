@@ -179,3 +179,17 @@ def dataclasses_replace(ecfg, **kw):
     import dataclasses
 
     return dataclasses.replace(ecfg, **kw)
+
+
+def test_exact_prompt_match_reuses_cache(params):
+    """Resubmitting the identical prompt (client retry) reuses the cached
+    session — only the final token is re-prefilled — and stays token-exact."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    prompt = _prompt(6, 9)
+    out1 = _run(engine, "a", prompt, session="retry")
+    before = engine.stats["prefill_tokens"]
+    out2 = _run(engine, "b", prompt, session="retry")
+    assert engine.stats["prefix_cache_hits"] == 1
+    assert engine.stats["prefill_tokens"] == before + 1  # only the last token
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "b", prompt)

@@ -54,6 +54,10 @@ class EngineConfig:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
     attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
     prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas)
+    prefill_batch: int = 4  # admit up to this many fresh requests per tick as
+    # ONE padded prefill batch (burst TTFT: N admissions cost one kernel call
+    # instead of N serial prefills). 1 restores one-at-a-time admission.
+    # Session-hit and chunked prefills still take the single-request path.
     enable_prefix_cache: bool = True  # retain session KV across turns
     prefill_chunk: int | None = None  # chunk long prefills to this many tokens:
     # bounds compiled bucket shapes and keeps decode latency fair under long
@@ -64,6 +68,11 @@ class EngineConfig:
     # stops paying for max_batch (one extra compile per bucket)
     session_ttl: float = 600.0  # idle cached sessions release their pages
     # after this long even without allocation pressure (0 disables)
+    async_decode: bool = True  # pipeline decode: dispatch step N before
+    # reading step N-1's sampled tokens, so the device never idles on the
+    # host's device→host round trip (token events arrive one tick later;
+    # greedy streams are bit-identical either way). False restores the
+    # dispatch-and-wait scheduler.
     dtype: str | None = None
 
     @property
@@ -128,9 +137,17 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
         positions = seq_lens  # 0-based position of the incoming token
         x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
         cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        # Page lookup clamps + routes past-the-table writes to the garbage
+        # page: the pipelined scheduler can dispatch ONE speculative step past
+        # a request's budget (its output is discarded at harvest), and that
+        # step's KV write must not clobber a live page (XLA would otherwise
+        # silently clamp the out-of-range index onto the last table entry).
+        lookup = seq_lens // ps
+        in_table = lookup < page_tables.shape[1]
         page_idx = jnp.take_along_axis(
-            page_tables, (seq_lens // ps)[:, None], axis=1
+            page_tables, jnp.minimum(lookup, page_tables.shape[1] - 1)[:, None], axis=1
         )[:, 0]  # [B] page holding this token (garbage page 0 when inactive)
+        page_idx = jnp.where(in_table, page_idx, 0)
         slot_idx = seq_lens % ps
 
         def body(x, xs):
@@ -178,6 +195,40 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
         k_pages = k_pages.at[:, page_ids, slot_ids].set(ks[:, 0])
         v_pages = v_pages.at[:, page_ids, slot_ids].set(vs[:, 0])
         last = logits[0, length - 1]
+        return last, k_pages, v_pages
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
+    """Prefill up to ``ecfg.prefill_batch`` fresh prompts in ONE forward pass
+    (rows are independent batch entries; per-row K/V scatter into each row's
+    own pages). Rows past the live count have length 0: every write routes to
+    the garbage page and their logits are ignored. One compilation per bucket
+    (the row count is static), so a 256-request burst costs ceil(256/N)
+    kernel calls instead of 256 serial prefills."""
+    ps = ecfg.page_size
+    N = ecfg.prefill_batch
+
+    def prefill(params, k_pages, v_pages, tokens, lengths, rows):
+        # tokens [N, bucket]; lengths [N]; rows [N, max_pages_per_seq]
+        positions = jnp.arange(bucket, dtype=jnp.int32)[None].repeat(N, 0)
+        logits, (ks, vs) = llama.forward_impl(
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl
+        )
+        in_range = positions < lengths[:, None]
+        page_ids = jnp.where(
+            in_range, jnp.take_along_axis(rows, positions // ps, axis=1), 0
+        )  # [N, bucket]
+        slot_ids = positions % ps
+        # ks/vs: [L, N, bucket, Kh, hd] → rows scatter into disjoint pages
+        # (padding rows all hit garbage page 0; last-write-wins there is fine).
+        k_pages = k_pages.at[:, page_ids, slot_ids].set(ks)
+        v_pages = v_pages.at[:, page_ids, slot_ids].set(vs)
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]  # [N, V]
         return last, k_pages, v_pages
 
     return jax.jit(prefill, donate_argnums=(1, 2))
@@ -314,6 +365,8 @@ class InferenceEngine:
         # Compact-decode device state, valid while the active-slot membership
         # is unchanged (admission/release invalidates it).
         self._compact: dict[str, Any] | None = None
+        # One-deep decode pipeline: the dispatched-but-unread step (async_decode).
+        self._inflight: dict[str, Any] | None = None
         # Counters (exported via the control plane's /metrics, mirroring the
         # reference's gateway gauges, internal/services/execution_metrics.go:14-44)
         self.stats = {
@@ -326,6 +379,7 @@ class InferenceEngine:
             "prefix_tokens_reused": 0,
             "sessions_evicted": 0,
             "requests_cancelled": 0,
+            "prefill_batches": 0,
         }
 
     # ------------------------------------------------------------------
@@ -383,7 +437,7 @@ class InferenceEngine:
         return sum(s is not None for s in self.slots)
 
     def has_work(self) -> bool:
-        return bool(self.pending) or self.num_active > 0
+        return bool(self.pending) or self.num_active > 0 or self._inflight is not None
 
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
@@ -400,7 +454,10 @@ class InferenceEngine:
             pages = self.allocator.alloc(n)
         return pages
 
-    def _session_hit(self, req: Request) -> _SessionEntry | None:
+    def _session_hit(self, req: Request) -> tuple[_SessionEntry, int] | None:
+        """Returns (entry, reusable-token count) on a prefix-cache hit, without
+        mutating the entry — admission may still fail on page starvation and
+        must be able to restore the session untouched."""
         if not req.session_id or not self.ecfg.enable_prefix_cache:
             return None
         sess = self._sessions.get(req.session_id)
@@ -408,33 +465,124 @@ class InferenceEngine:
             return None
         cl = len(sess.tokens)
         if 0 < cl < len(req.prompt) and req.prompt[:cl] == sess.tokens:
-            return sess
+            return sess, cl
         if 0 < len(req.prompt) <= cl and sess.tokens[: len(req.prompt)] == req.prompt:
             # The prompt is fully resident (exact match or a prefix of the
             # cached history — e.g. a client retry of the same turn). We still
-            # need last-token logits to sample, so mark the final prompt token
-            # as uncached and re-prefill just that one token (KV rewrite is
-            # idempotent); stale KV past the prompt is masked by seq_len.
-            sess.tokens = req.prompt[:-1]
-            return sess
+            # need last-token logits to sample, so treat the final prompt
+            # token as uncached and re-prefill just that one token (KV
+            # rewrite is idempotent); stale KV past the prompt is masked by
+            # seq_len.
+            return sess, len(req.prompt) - 1
         # Mismatched history (edited conversation, collision): drop the entry.
         self.allocator.free(self._sessions.pop(req.session_id).pages)
         return None
 
     def _try_admit(self) -> list[TokenEvent]:
-        """Admit one pending request: allocate pages, prefill (full, or only
-        the suffix on a session prefix-cache hit), sample the first token."""
+        """Admit pending requests. Up to ``prefill_batch`` fresh prompts
+        coalesce into ONE padded prefill call (burst TTFT is bounded by
+        ceil(burst/N) kernel calls, not the burst size); session-hit and
+        chunked prompts take the single-request path, one per tick."""
         if not self.pending:
             return []
-        free_slot = next((i for i, s in enumerate(self.slots) if s is None), None)
-        if free_slot is None:
+        N = max(1, self.ecfg.prefill_batch)
+        batch: list[tuple[Request, int, list[int]]] = []  # (req, slot, pages)
+        claimed: set[int] = set()
+        while self.pending and len(batch) < N:
+            free_slot = next(
+                (i for i, s in enumerate(self.slots) if s is None and i not in claimed),
+                None,
+            )
+            if free_slot is None:
+                break
+            req = self.pending[0]
+            chunked = (
+                self.ecfg.prefill_chunk is not None
+                and len(req.prompt) > self.ecfg.prefill_chunk
+            )
+            has_sess = (
+                req.session_id is not None
+                and self.ecfg.enable_prefix_cache
+                and req.session_id in self._sessions
+            )
+            if chunked or has_sess:
+                if batch:
+                    break  # flush the fresh batch first; single path next tick
+                return self._admit_single(req, free_slot)
+            with self._session_lock:
+                pages = self._alloc_with_eviction(self._pages_needed(req))
+            if pages is None:
+                break  # page-starved; decode will free pages
+            self.pending.popleft()
+            claimed.add(free_slot)
+            batch.append((req, free_slot, pages))
+        if not batch:
             return []
-        req = self.pending[0]
+        if len(batch) == 1:
+            req, slot_idx, pages = batch[0]
+            row = build_page_table(pages, self.ecfg.max_pages_per_seq)
+            last_logits = self._prefill(req.prompt, 0, row)
+            self.stats["prefill_tokens"] += len(req.prompt)
+            return [self._sample_first_and_install(req, slot_idx, pages, row, last_logits)]
+        return self._admit_batch(batch)
+
+    def _admit_batch(self, batch: list[tuple[Request, int, list[int]]]) -> list[TokenEvent]:
+        """One padded multi-row prefill for ≥2 fresh requests, then one
+        vectorized first-token sample across all rows."""
+        N = self.ecfg.prefill_batch
+        maxp = self.ecfg.max_pages_per_seq
+        bucket = self.ecfg.prefill_bucket(max(len(r.prompt) for r, _, _ in batch))
+        tokens = np.zeros((N, bucket), np.int32)
+        lengths = np.zeros((N,), np.int32)
+        rows = np.zeros((N, maxp), np.int32)
+        temps = np.zeros((N,), np.float32)
+        top_ks = np.zeros((N,), np.int32)
+        top_ps = np.ones((N,), np.float32)
+        row_tables = []
+        for j, (req, _, pages) in enumerate(batch):
+            row = build_page_table(pages, maxp)
+            row_tables.append(row)
+            tokens[j, : len(req.prompt)] = np.asarray(req.prompt, np.int32)
+            lengths[j] = len(req.prompt)
+            rows[j] = row
+            s = req.sampling
+            temps[j], top_ks[j], top_ps[j] = s.temperature, s.top_k, s.top_p
+        fn = _batch_prefill_fn(self.cfg, self.ecfg, bucket)
+        last, self.cache.k_pages, self.cache.v_pages = fn(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(rows),
+        )
+        toks = sample_tokens(
+            last,
+            self._next_rng(),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        lps = jnp.take_along_axis(
+            jax.nn.log_softmax(last, axis=-1), toks[:, None], axis=1
+        )[:, 0]
+        toks_np, lps_np = np.asarray(toks), np.asarray(lps)
+        self.stats["prefill_tokens"] += int(lengths.sum())
+        self.stats["prefill_batches"] += 1
+        return [
+            self._install(req, slot_idx, pages, row_tables[j], int(toks_np[j]), float(lps_np[j]))
+            for j, (req, slot_idx, pages) in enumerate(batch)
+        ]
+
+    def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
+        """Single-request admission: session prefix-cache reuse (suffix-only
+        prefill) and chunked long prompts flow through here."""
         with self._session_lock:
-            sess = self._session_hit(req)
+            hit = self._session_hit(req)
             total_pages = self._pages_needed(req)
 
-            if sess is not None:
+            if hit is not None:
+                sess, start = hit
                 # Claim the session FIRST: the eviction loop below must never
                 # be able to free the very pages we are about to reuse.
                 self._sessions.pop(req.session_id, None)
@@ -444,7 +592,6 @@ class InferenceEngine:
                     self._sessions[req.session_id] = sess  # restore; retry later
                     return []  # page-starved; decode will free pages
                 pages = sess.pages + extra
-                start = len(sess.tokens)
                 suffix = req.prompt[start:]
             else:
                 pages = self._alloc_with_eviction(total_pages)
@@ -455,10 +602,16 @@ class InferenceEngine:
         self.pending.popleft()
 
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
-        if sess is not None:
+        if hit is not None:
             self.stats["prefix_cache_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
         last_logits = self._prefill(suffix, start, row)
+        self.stats["prefill_tokens"] += len(suffix)
+        return [self._sample_first_and_install(req, free_slot, pages, row, last_logits)]
+
+    def _sample_first_and_install(
+        self, req: Request, slot_idx: int, pages: list[int], row: np.ndarray, last_logits
+    ) -> TokenEvent:
         s = req.sampling
         tok_arr = sample_tokens(
             last_logits[None],
@@ -469,8 +622,17 @@ class InferenceEngine:
         )
         tok = int(tok_arr[0])
         first_logprob = float(jax.nn.log_softmax(last_logits)[tok])
-        self.stats["prefill_tokens"] += len(suffix)
+        return self._install(req, slot_idx, pages, row, tok, first_logprob)
 
+    def _install(
+        self,
+        req: Request,
+        slot_idx: int,
+        pages: list[int],
+        row: np.ndarray,
+        tok: int,
+        logprob: float,
+    ) -> TokenEvent:
         slot = _Slot(
             req=req,
             pages=pages,
@@ -479,18 +641,19 @@ class InferenceEngine:
             last_token=tok,
             tokens=list(req.prompt) + [tok],
         )
-        event = self._emit(free_slot, slot, tok, first_logprob)
+        event = self._emit(slot_idx, slot, tok, logprob)
         if not event.finished:
-            self.slots[free_slot] = slot
-            self.page_tables[free_slot] = row
-            self.seq_lens[free_slot] = slot.length
-            self.last_tokens[free_slot] = tok
-            self.temps[free_slot] = s.temperature
-            self.top_ks[free_slot] = s.top_k
-            self.top_ps[free_slot] = s.top_p
+            s = req.sampling
+            self.slots[slot_idx] = slot
+            self.page_tables[slot_idx] = row
+            self.seq_lens[slot_idx] = slot.length
+            self.last_tokens[slot_idx] = tok
+            self.temps[slot_idx] = s.temperature
+            self.top_ks[slot_idx] = s.top_k
+            self.top_ps[slot_idx] = s.top_p
         self._dirty = True
         self._compact = None  # membership changed
-        return [event]
+        return event
 
     def _prefill(self, tokens: list[int], start: int, row: np.ndarray):
         """Prefill `tokens` beginning at absolute position `start`, optionally
@@ -618,28 +781,93 @@ class InferenceEngine:
                 self.stats["requests_cancelled"] += 1
 
     def step(self) -> list[TokenEvent]:
-        """One scheduler tick: admit (prefill) if possible, else decode."""
-        self._drain_cancels()
-        events = self._try_admit()
-        if events:
-            return events
-        if self.num_active == 0:
-            return []
+        """One scheduler tick: admit (prefill) if possible, else decode.
 
+        With ``async_decode`` the decode path is a one-deep pipeline: dispatch
+        step N, then read step N-1's tokens while the device runs N. Any
+        control-flow change (admission, cancel, all-finished) harvests the
+        outstanding step first, so host bookkeeping and the device state agree
+        before membership changes. A slot that finishes at step N-1 has one
+        speculative token in flight; its output is discarded at harvest
+        (dispatch order on the device stream makes its stale KV write land
+        before any re-use of the freed pages)."""
+        events: list[TokenEvent] = []
+        if self._cancels and self._inflight is not None:
+            # Cancels mutate slots/host shadows: drain the pipeline first so
+            # a post-cancel rebuild starts from harvested (current) state.
+            events += self._harvest_inflight()
+        self._drain_cancels()
+        if self.pending and any(s is None for s in self.slots):
+            # Admission needs current state: drain the pipeline first. Only
+            # do this when a slot is actually free — under full occupancy the
+            # drain would serialize the pipeline every tick for an admission
+            # that cannot happen (finishes surface via the normal
+            # post-dispatch harvest, freeing a slot for the next tick).
+            events += self._harvest_inflight()
+            admitted = self._try_admit()
+            if admitted:
+                return events + admitted
+        if self.num_active == 0:
+            return events + self._harvest_inflight()
+
+        inf = self._inflight
+        if inf is not None and (
+            len(inf["slots"]) != self.num_active
+            or any(self.slots[i] is not slot for i, slot in inf["slots"])
+        ):
+            # Membership changed since dispatch (a slot finished last
+            # harvest): the device-chained control state no longer matches
+            # the host shadows a rebuild would read. Sync: harvest the
+            # outstanding step, then dispatch from current state.
+            events += self._harvest_inflight()
+            if self.num_active == 0:
+                return events  # that harvest finished the last active slot
+        prev, self._inflight = self._inflight, None
+        self._dispatch_decode()
+        events += self._apply_harvest(prev)
+        if not self.ecfg.async_decode:
+            events += self._harvest_inflight()
+        return events
+
+    def _dispatch_decode(self) -> None:
+        """Dispatch one decode step (no host sync) and record it in-flight."""
         active_idx = [i for i, s in enumerate(self.slots) if s is not None]
         bucket = self._pick_decode_bucket(len(active_idx))
         if bucket is not None:
-            next_by_slot = self._decode_compact(active_idx, bucket)
+            toks, lps = self._decode_compact_dispatch(active_idx, bucket)
+            compact = True
         else:
-            next_by_slot = self._decode_full()
+            toks, lps = self._decode_full_dispatch()
+            compact = False
         self.stats["decode_steps"] += 1
+        self._inflight = {
+            "tokens": toks,
+            "logprobs": lps,
+            "slots": [(i, self.slots[i]) for i in active_idx],
+            "compact": compact,
+        }
 
+    def _harvest_inflight(self) -> list[TokenEvent]:
+        prev, self._inflight = self._inflight, None
+        return self._apply_harvest(prev)
+
+    def _apply_harvest(self, inf: dict | None) -> list[TokenEvent]:
+        """Read a dispatched step's sampled tokens and apply them: advance
+        host bookkeeping, emit events, release finished slots. Slots replaced
+        since dispatch (finished or cancelled) discard their speculative
+        token — object identity is the liveness check."""
+        if inf is None:
+            return []
+        toks = np.asarray(inf["tokens"])
+        lps = np.asarray(inf["logprobs"])
         out: list[TokenEvent] = []
-        for i in active_idx:
-            slot = self.slots[i]
+        for j, (i, slot) in enumerate(inf["slots"]):
+            if self.slots[i] is not slot:
+                continue
+            row = j if inf["compact"] else i
+            tok, logprob = int(toks[row]), float(lps[row])
             slot.length += 1
             slot.generated += 1
-            tok, logprob = next_by_slot[i]
             slot.last_token = tok
             slot.tokens.append(tok)
             self.seq_lens[i] = slot.length
@@ -656,7 +884,7 @@ class InferenceEngine:
                 return b
         return None
 
-    def _decode_full(self) -> dict[int, tuple[int, float]]:
+    def _decode_full_dispatch(self) -> tuple[jax.Array, jax.Array]:
         if self._dirty:
             self._dev = {
                 "tokens": jnp.asarray(self.last_tokens),
@@ -683,15 +911,11 @@ class InferenceEngine:
             )
         )
         d["tokens"], d["seq_lens"] = next_tokens, new_seq_lens
-        next_np = np.asarray(next_tokens)
-        lp_np = np.asarray(logprobs)
-        return {
-            i: (int(next_np[i]), float(lp_np[i]))
-            for i, s in enumerate(self.slots)
-            if s is not None
-        }
+        return next_tokens, logprobs
 
-    def _decode_compact(self, active_idx: list[int], bucket: int) -> dict[int, tuple[int, float]]:
+    def _decode_compact_dispatch(
+        self, active_idx: list[int], bucket: int
+    ) -> tuple[jax.Array, jax.Array]:
         """Low-occupancy step: gather the active slots' control rows into a
         [bucket]-wide batch (padding rows are inert: seq_len 0 writes to the
         garbage page). The jitted decode retraces once per bucket width.
@@ -740,12 +964,7 @@ class InferenceEngine:
         )
         c["tokens"], c["seq_lens"] = next_tokens, new_seq_lens
         self._dirty = True  # full-width device state is now stale
-        next_np = np.asarray(next_tokens)
-        lp_np = np.asarray(logprobs)
-        return {
-            slot_i: (int(next_np[j]), float(lp_np[j]))
-            for j, slot_i in enumerate(active_idx)
-        }
+        return next_tokens, logprobs
 
     def run_to_completion(self, requests: list[Request]) -> dict[str, list[int]]:
         """Convenience driver: submit everything, step until drained, return

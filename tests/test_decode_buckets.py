@@ -77,17 +77,16 @@ def test_request_cancel_releases_slot(params):
     engine = InferenceEngine(params, CFG, BASE)
     engine.submit(Request(id="keep", prompt=_prompt(50, 4), sampling=SamplingParams(max_new_tokens=4)))
     engine.submit(Request(id="drop", prompt=_prompt(51, 4), sampling=SamplingParams(max_new_tokens=32)))
-    # admit both (two steps = two prefills, each emitting the first token)
+    # one tick admits both (batched prefill), each emitting its first token
     results: dict[str, list[int]] = {}
-    for _ in range(2):
-        for ev in engine.step():
-            results.setdefault(ev.request_id, []).append(ev.token)
+    for ev in engine.step():
+        results.setdefault(ev.request_id, []).append(ev.token)
     assert engine.num_active == 2
     engine.request_cancel("drop")
     while engine.has_work():
         for ev in engine.step():
             results.setdefault(ev.request_id, []).append(ev.token)
-    assert "drop" not in results or len(results.get("drop", [])) <= 1
+    assert len(results.get("drop", [])) <= 1  # only the pre-cancel first token
     assert engine.stats["requests_cancelled"] == 1
     assert engine.num_active == 0
     assert engine.allocator.free_pages == BASE.num_pages - 1  # everything freed

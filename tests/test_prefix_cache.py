@@ -193,3 +193,17 @@ def test_exact_prompt_match_reuses_cache(params):
     assert engine.stats["prefill_tokens"] == before + 1  # only the last token
     fresh = InferenceEngine(params, CFG, ECFG)
     assert out2 == _run(fresh, "b", prompt)
+
+
+def test_session_hit_probe_does_not_mutate_entry(params):
+    """_session_hit must not mutate the cached entry: a page-starved admission
+    restores the session, which must keep its full cached history."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    prompt = _prompt(7, 9)
+    _run(engine, "a", prompt, session="s")
+    before = list(engine._sessions["s"].tokens)
+    hit = engine._session_hit(
+        Request(id="probe", prompt=prompt, sampling=SamplingParams(max_new_tokens=2), session_id="s")
+    )
+    assert hit is not None and hit[1] == len(prompt) - 1
+    assert engine._sessions["s"].tokens == before, "probe truncated the cached history"

@@ -166,3 +166,127 @@ def test_allocator_invariants():
         a.free(got[:1])  # double free
     with pytest.raises(ValueError):
         a.free([0])  # reserved page
+
+
+def test_batched_prefill_multi_admission_per_tick(params):
+    """≥2 fresh pending requests admit in ONE step() via the padded batch
+    prefill — and the tokens still match the contiguous-cache oracle."""
+    ecfg = EngineConfig(
+        max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8, prefill_batch=4
+    )
+    engine = InferenceEngine(params, CFG, ecfg)
+    prompts = [_prompt(jax.random.PRNGKey(40 + i), n) for i, n in enumerate([5, 9, 12, 7])]
+    for i, p in enumerate(prompts):
+        engine.submit(_greedy_req(f"r{i}", p, max_new=5))
+    first = engine.step()
+    assert len(first) == 4, "one tick must admit the whole burst"
+    assert {ev.request_id for ev in first} == {f"r{i}" for i in range(4)}
+    assert all(ev.index == 0 for ev in first)
+    assert engine.stats["prefill_batches"] == 1
+    results = {ev.request_id: [ev.token] for ev in first}
+    while engine.has_work():
+        for ev in engine.step():
+            results[ev.request_id].append(ev.token)
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=5, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle, f"batched r{i} diverged from oracle"
+
+
+def test_batched_prefill_respects_slot_and_batch_limits(params):
+    """A 6-request burst with prefill_batch=4 and 4 slots admits 4 in the
+    first tick; the rest wait for free slots."""
+    ecfg = EngineConfig(
+        max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8, prefill_batch=4
+    )
+    engine = InferenceEngine(params, CFG, ecfg)
+    for i in range(6):
+        engine.submit(_greedy_req(f"r{i}", _prompt(jax.random.PRNGKey(60 + i), 6), max_new=3))
+    first = engine.step()
+    assert len(first) == 4
+    assert len(engine.pending) == 2
+    results = {ev.request_id: [ev.token] for ev in first}
+    while engine.has_work():
+        for ev in engine.step():
+            results.setdefault(ev.request_id, []).append(ev.token)
+    assert all(len(v) == 3 for v in results.values()) and len(results) == 6
+
+
+def test_batched_prefill_session_hit_takes_single_path(params):
+    """A session-hit request at the queue head goes through the suffix-prefill
+    single path; fresh requests behind it still batch afterwards."""
+    ecfg = EngineConfig(
+        max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8, prefill_batch=4
+    )
+    engine = InferenceEngine(params, CFG, ecfg)
+    turn1 = _prompt(jax.random.PRNGKey(70), 6)
+    out1 = engine.run_to_completion(
+        [Request(id="t1", prompt=turn1, sampling=SamplingParams(max_new_tokens=4), session_id="s")]
+    )["t1"]
+    turn2 = turn1 + out1 + _prompt(jax.random.PRNGKey(71), 2)
+    engine.submit(
+        Request(id="t2", prompt=turn2, sampling=SamplingParams(max_new_tokens=4), session_id="s")
+    )
+    fresh = [_prompt(jax.random.PRNGKey(72 + i), 5) for i in range(2)]
+    for i, p in enumerate(fresh):
+        engine.submit(_greedy_req(f"f{i}", p, max_new=4))
+    ev1 = engine.step()  # session-hit single admission
+    assert [e.request_id for e in ev1] == ["t2"]
+    assert engine.stats["prefix_cache_hits"] == 1
+    ev2 = engine.step()  # the two fresh ones batch
+    assert {e.request_id for e in ev2} == {"f0", "f1"}
+    results = {e.request_id: [e.token] for e in ev1 + ev2}
+    while engine.has_work():
+        for ev in engine.step():
+            results[ev.request_id].append(ev.token)
+    ref = InferenceEngine(params, CFG, ecfg)
+    assert results["t2"] == ref.run_to_completion(
+        [Request(id="t2", prompt=turn2, sampling=SamplingParams(max_new_tokens=4))]
+    )["t2"]
+    for i, p in enumerate(fresh):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=4, max_len=64
+        )[0].tolist()
+        assert results[f"f{i}"] == oracle
+
+
+def test_async_decode_stream_identical_to_sync(params):
+    """The one-deep decode pipeline (async_decode) must emit exactly the same
+    greedy token streams as dispatch-and-wait, across staggered finishes."""
+    import dataclasses as _dc
+
+    base = EngineConfig(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8)
+    prompts = [_prompt(jax.random.PRNGKey(80 + i), n) for i, n in enumerate([5, 9, 12, 7])]
+    reqs = lambda: [  # noqa: E731
+        Request(id=f"r{i}", prompt=p, sampling=SamplingParams(max_new_tokens=3 + 2 * i))
+        for i, p in enumerate(prompts)
+    ]
+    sync_eng = InferenceEngine(params, CFG, _dc.replace(base, async_decode=False))
+    async_eng = InferenceEngine(params, CFG, _dc.replace(base, async_decode=True))
+    assert sync_eng.run_to_completion(reqs()) == async_eng.run_to_completion(reqs())
+
+
+def test_async_decode_speculative_step_respects_page_budget(params):
+    """A request sized exactly to its page budget must survive the pipeline's
+    one speculative extra step without clobbering a neighbor's KV pages."""
+    ecfg = EngineConfig(
+        max_batch=2, page_size=8, num_pages=16, max_pages_per_seq=4, async_decode=True
+    )
+    engine = InferenceEngine(params, CFG, ecfg)
+    # prompt 16 + 16 new = 32 tokens = exactly 4 pages (the per-seq budget)
+    full = Request(
+        id="full",
+        prompt=_prompt(jax.random.PRNGKey(90), 16),
+        sampling=SamplingParams(max_new_tokens=16),
+    )
+    buddy_prompt = _prompt(jax.random.PRNGKey(91), 6)
+    buddy = Request(
+        id="buddy", prompt=buddy_prompt, sampling=SamplingParams(max_new_tokens=24)
+    )
+    results = engine.run_to_completion([full, buddy])
+    assert len(results["full"]) == 16
+    oracle = generate_greedy(
+        params, CFG, jnp.asarray([buddy_prompt], jnp.int32), num_steps=24, max_len=64
+    )[0].tolist()
+    assert results["buddy"] == oracle, "speculative overflow corrupted a neighbor"

@@ -207,14 +207,32 @@ def forward_impl(
             # Pallas flash kernel: causal-from-zero layout [B, H, S, hd].
             # Valid whenever positions are per-row aranges (prefill), which is
             # what the serving engine guarantees. Interpreted on CPU backends.
+            # With a TP mesh the kernel runs under shard_map over the head
+            # axis (each shard: full sequence, H/tp query + Kh/tp KV heads;
+            # zero collectives — the wo psum downstream is the only traffic).
+            import functools
+
             from agentfield_tpu.ops.pallas.flash_attention_kernel import flash_attention
 
-            return flash_attention(
+            fa = functools.partial(
+                flash_attention, causal=True, interpret=jax.default_backend() == "cpu"
+            )
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                from jax.experimental.shard_map import shard_map
+
+                from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+                if mesh.shape.get(AXIS_MODEL, 1) > 1:
+                    spec = P(None, AXIS_MODEL, None, None)
+                    fa = shard_map(
+                        fa, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_rep=False,
+                    )
+            return fa(
                 q.transpose(0, 2, 1, 3),
                 k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3),
-                causal=True,
-                interpret=jax.default_backend() == "cpu",
             ).transpose(0, 2, 1, 3)
         if attn_impl == "ring":
             # Sequence/context parallelism: S shards over the mesh's `seq`

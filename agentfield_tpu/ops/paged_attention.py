@@ -23,19 +23,20 @@ _NEG_INF = -1e30
 
 def paged_attention_ref(
     q: jax.Array,  # [B, H, hd]       — one query token per sequence
-    k_pages: jax.Array,  # [P, ps, Kh, hd]  — one layer's page pool
-    v_pages: jax.Array,  # [P, ps, Kh, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd]  — one layer's page pool
+    v_pages: jax.Array,  # [P, Kh, ps, hd]
     page_tables: jax.Array,  # [B, maxp] int32 page ids (0 = garbage page)
     seq_lens: jax.Array,  # [B] int32 — #valid tokens (incl. current) per sequence
 ) -> jax.Array:
     """Reference implementation via page gather. Returns [B, H, hd]."""
     B, H, hd = q.shape
-    P, ps, Kh, _ = k_pages.shape
+    P, Kh, ps, _ = k_pages.shape
     maxp = page_tables.shape[1]
     T = maxp * ps
 
-    k = k_pages[page_tables].reshape(B, T, Kh, hd)
-    v = v_pages[page_tables].reshape(B, T, Kh, hd)
+    # [B, maxp, Kh, ps, hd] → [B, T, Kh, hd]
+    k = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, Kh, hd)
+    v = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, Kh, hd)
 
     rep = H // Kh
     qg = q.reshape(B, Kh, rep, hd)
@@ -48,7 +49,16 @@ def paged_attention_ref(
     return out.reshape(B, H, hd).astype(q.dtype)
 
 
-def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref"):
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref", mesh=None):
+    """Dispatch decode attention.
+
+    With `mesh` (tensor parallelism), the Pallas kernel runs under shard_map
+    over the KV-head axis: each shard owns its slice of the page pool
+    ([P, Kh/tp, ps, hd] — KV pages shard on Kh, matching wk/wv's TP sharding)
+    and computes its heads' attention with NO collectives — the psum over the
+    output projection downstream is the only cross-chip traffic, exactly as
+    in the ref GSPMD path. The `ref` impl needs no wrapper (XLA partitions
+    the gather itself)."""
     if impl == "ref":
         return paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens)
     if impl == "pallas":
@@ -57,6 +67,28 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref
         # Mosaic kernels only compile for TPU; on CPU backends (tests, local
         # demos) run the same kernel in the Pallas interpreter.
         interpret = jax.default_backend() == "cpu"
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+            if mesh.shape.get(AXIS_MODEL, 1) > 1:
+                import functools
+
+                return shard_map(
+                    functools.partial(paged_attention_pallas, interpret=interpret),
+                    mesh=mesh,
+                    in_specs=(
+                        P(None, AXIS_MODEL, None),  # q [B, H, hd] on heads
+                        P(None, AXIS_MODEL, None, None),  # k_pages [P, Kh, ps, hd]
+                        P(None, AXIS_MODEL, None, None),
+                        P(None, None),  # page_tables replicated
+                        P(None),  # seq_lens replicated
+                    ),
+                    out_specs=P(None, AXIS_MODEL, None),
+                    check_rep=False,
+                )(q, k_pages, v_pages, page_tables, seq_lens)
         return paged_attention_pallas(
             q, k_pages, v_pages, page_tables, seq_lens, interpret=interpret
         )

@@ -27,8 +27,8 @@ def _paged_kernel(
     page_tables_ref,  # [B, maxp] int32 (scalar prefetch)
     seq_lens_ref,  # [B] int32 (scalar prefetch)
     q_ref,  # [1, 1, rep, hd]
-    k_ref,  # [1, ps, 1, hd]  — the page picked by the index map
-    v_ref,  # [1, ps, 1, hd]
+    k_ref,  # [1, 1, ps, hd]  — the (page, kv-head) tile picked by the index map
+    v_ref,  # [1, 1, ps, hd]
     o_ref,  # [1, 1, rep, hd]
     m_scr,  # [rep, 1] f32
     l_scr,  # [rep, 1] f32
@@ -54,7 +54,7 @@ def _paged_kernel(
     @pl.when(pi * page_size < seq_len)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [rep, hd]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [ps, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [rep, ps]
@@ -68,7 +68,7 @@ def _paged_kernel(
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p,
-            v_ref[0, :, 0, :].astype(jnp.float32),
+            v_ref[0, 0].astype(jnp.float32),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -83,15 +83,15 @@ def _paged_kernel(
 @functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
 def paged_attention_pallas(
     q: jax.Array,  # [B, H, hd]
-    k_pages: jax.Array,  # [P, ps, Kh, hd]
-    v_pages: jax.Array,  # [P, ps, Kh, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,  # [P, Kh, ps, hd]
     page_tables: jax.Array,  # [B, maxp] int32
     seq_lens: jax.Array,  # [B] int32 (valid tokens incl. current)
     sm_scale: float | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     B, H, hd = q.shape
-    P, ps, Kh, _ = k_pages.shape
+    P, Kh, ps, _ = k_pages.shape
     maxp = page_tables.shape[1]
     if H % Kh:
         raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
@@ -112,13 +112,13 @@ def paged_attention_pallas(
                 (1, 1, rep, hd), lambda b, kvh, pi, pt, sl: (b, kvh, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (1, ps, 1, hd),
-                lambda b, kvh, pi, pt, sl: (pt[b, pi], 0, kvh, 0),
+                (1, 1, ps, hd),
+                lambda b, kvh, pi, pt, sl: (pt[b, pi], kvh, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, ps, 1, hd),
-                lambda b, kvh, pi, pt, sl: (pt[b, pi], 0, kvh, 0),
+                (1, 1, ps, hd),
+                lambda b, kvh, pi, pt, sl: (pt[b, pi], kvh, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],

@@ -303,16 +303,22 @@ class Agent:
         return doc["result"]
 
     async def _resolve_model_node(self, model: str | None) -> dict[str, Any]:
+        return (await self._model_candidates(model))[0]
+
+    async def _model_candidates(self, model: str | None) -> list[dict[str, Any]]:
+        """Failover set: the named node alone, or every active model node in
+        registration order (the reference's fallback chain iterates provider
+        models, agent_ai.py:345-384 — here the units of failure are nodes)."""
         nodes = await self.client.list_nodes()
         if model is not None:
             for n in nodes:
                 if n["node_id"] == model:
-                    return n
+                    return [n]
             raise RuntimeError(f"model node {model!r} not registered")
         candidates = [n for n in nodes if n.get("kind") == "model" and n["status"] == "active"]
         if not candidates:
             raise RuntimeError("no active model node registered")
-        return candidates[0]
+        return candidates
 
     async def ai(
         self,
@@ -326,23 +332,33 @@ class Agent:
         stop_token_ids: list[int] | None = None,
         timeout: float = 600.0,
         schema: dict[str, Any] | None = None,
+        context_overflow: str = "truncate_left",
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
         active model node (or `model` node id, used directly — the gateway
-        validates it); the placement scheduler arrives with multi-node
-        support.
+        validates it), with node-down failover across the remaining active
+        model nodes.
 
-        With `schema` (a JSON schema), the prompt gains a strict-JSON
-        instruction and the decoded text is parsed+validated; the result dict
-        gains a "parsed" key (sdk/structured.py)."""
+        `context_overflow` defaults to "truncate_left" — over-long prompts
+        keep their most recent tokens, mirroring the reference's token-aware
+        trimming (agent_ai.py:262-325); pass "error" for a hard
+        RequestTooLongError instead. A truncated call reports
+        `truncated_prompt_tokens` in its result.
+
+        With `schema` (a JSON schema), decoding is CONSTRAINED on the model
+        node: the schema compiles to a token-level DFA whose mask makes
+        invalid tokens unsampleable (serving/grammar.py), so the decoded text
+        is schema-valid JSON by construction — no regex salvage (the
+        reference's failure mode, agent_ai.py:424-447). The prompt still
+        gains a strict-JSON instruction (steers content quality; correctness
+        comes from the mask), and the result dict gains a "parsed" key."""
         if schema is not None:
             if prompt is None:
                 raise ValueError("schema requires a text prompt")
             from agentfield_tpu.sdk.structured import schema_instruction
 
             prompt = prompt + schema_instruction(schema)
-        node_id = model if model is not None else (await self._resolve_model_node(None))["node_id"]
         ctx = current_context()
         payload = {
             "prompt": prompt,
@@ -354,41 +370,101 @@ class Agent:
             "stop_token_ids": stop_token_ids or [],
             # Session affinity → model-node prefix-cache reuse across turns.
             "session_id": ctx.session_id if ctx else None,
+            "response_schema": schema,
+            "context_overflow": context_overflow,
         }
         # Backpressure retry (the reference's rate limiter plays this role for
         # provider 429s — rate_limiter.py). Engine exhaustion reaches us two
         # ways: HTTP 503 (node inactive / async queue full) OR a FAILED
         # execution whose error names QueueFullError (the model node's
         # generate raised it and reported failure through the callback).
-        headers = self._outbound_ctx().to_headers()
-        attempts = 0
-        while True:
-            try:
-                doc = await self.client.execute(
-                    f"{node_id}.generate", payload, headers=headers, timeout=timeout
-                )
-            except ControlPlaneError as e:
-                if e.status != 503 or attempts >= 5:
-                    raise
-                attempts += 1
-                await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
-                continue
+        # Node-down failures (unreachable / 5xx / vanished mid-call) fail over
+        # to the next active model node — the reference's fallback-model chain
+        # (agent_ai.py:345-384) re-designed for in-tree serving, where the
+        # unit of failure is a node, not a provider model.
+        candidates = await self._model_candidates(model)
+        node_errors: list[str] = []
+        doc: dict[str, Any] = {}
+        for ci, cand in enumerate(candidates):
+            node_id = cand["node_id"]
+            attempts = 0
+            while True:
+                try:
+                    # Fresh execution id per attempt: a failed/retried
+                    # execution's id is already recorded, and replaying it
+                    # would 409.
+                    doc = await self.client.execute(
+                        f"{node_id}.generate",
+                        payload,
+                        headers=self._outbound_ctx().to_headers(),
+                        timeout=timeout,
+                    )
+                except ControlPlaneError as e:
+                    has_next = ci + 1 < len(candidates)
+                    msg = str(e)
+                    gone = any(
+                        s in msg for s in ("is inactive", "is stopping", "is starting")
+                    )
+                    if e.status in (404, 410) or (e.status == 503 and gone):
+                        # Node deregistered or marked inactive at the gateway
+                        # — a down NODE, not backpressure: fail over now
+                        # (retrying a dead node 5x first would defeat the
+                        # failover this path exists for).
+                        if has_next:
+                            doc = {"status": "node_down", "error": str(e)}
+                            break
+                        raise
+                    if e.status != 503 or attempts >= 5:
+                        if e.status == 503 and has_next:
+                            # persistent backpressure on this node: another
+                            # candidate may have capacity
+                            doc = {"status": "node_down", "error": str(e)}
+                            break
+                        raise
+                    attempts += 1
+                    await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                    continue
+                err = str(doc.get("error") or "")
+                if (
+                    doc["status"] == "failed"
+                    and ("QueueFullError" in err or "queue at capacity" in err)
+                    and attempts < 5
+                ):
+                    attempts += 1
+                    await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                    continue
+                break
             err = str(doc.get("error") or "")
-            if (
-                doc["status"] == "failed"
-                and ("QueueFullError" in err or "queue at capacity" in err)
-                and attempts < 5
-            ):
-                attempts += 1
-                await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+            node_down = doc.get("status") == "node_down" or (
+                doc.get("status") == "failed"
+                and (
+                    "agent call failed" in err
+                    or "vanished" in err
+                    or "agent returned 5" in err
+                )
+            )
+            if node_down and ci + 1 < len(candidates):
+                node_errors.append(f"{node_id}: {err}")
                 continue
             break
-        if doc["status"] != "completed":
-            raise RuntimeError(f"ai() {doc['status']}: {doc.get('error')}")
+        if doc.get("status") != "completed":
+            detail = f"; failed over from {node_errors}" if node_errors else ""
+            raise RuntimeError(f"ai() {doc.get('status')}: {doc.get('error')}{detail}")
         result = doc["result"]
         if schema is not None:
-            from agentfield_tpu.sdk.structured import parse_structured
+            from agentfield_tpu.sdk.structured import (
+                StructuredOutputError,
+                parse_structured,
+            )
 
+            if result.get("finish_reason") == "length":
+                # The mask guarantees a valid *prefix*; only an EOS finish
+                # guarantees a complete value.
+                raise StructuredOutputError(
+                    "constrained generation hit max_new_tokens before the "
+                    "value completed — raise max_new_tokens (or bound the "
+                    "schema, e.g. maxLength/maxItems)"
+                )
             result["parsed"] = parse_structured(result.get("text", ""), schema)
         return result
 
@@ -519,10 +595,44 @@ class Agent:
 
     # -- lifecycle ------------------------------------------------------
 
+    def _callback_candidates(self) -> list[str]:
+        """Candidate callback URLs in preference order, mirroring the
+        reference's container-IP cooperation (sdk agent.py:66-303 detects
+        candidates; the control plane probes them, nodes.go:205-276):
+        explicit env override > bound host > detected outbound IP >
+        hostname > loopback."""
+        import os
+        import socket
+
+        out: list[str] = []
+
+        def add(url: str | None) -> None:
+            if url and url not in out:
+                out.append(url)
+
+        add(os.environ.get("AGENT_CALLBACK_URL"))
+        if self.host not in ("0.0.0.0", "::", ""):
+            add(f"http://{self.host}:{self.port}")
+        try:
+            # UDP connect never sends a packet; it just resolves the route,
+            # yielding the address a remote control plane could reach us on.
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("10.255.255.255", 1))
+                add(f"http://{s.getsockname()[0]}:{self.port}")
+        except OSError:
+            pass
+        try:
+            add(f"http://{socket.gethostbyname(socket.gethostname())}:{self.port}")
+        except OSError:
+            pass
+        add(f"http://127.0.0.1:{self.port}")
+        return out
+
     def _node_spec(self) -> dict[str, Any]:
         return {
             "node_id": self.node_id,
             "base_url": f"http://{self.host}:{self.port}",
+            "callback_candidates": self._callback_candidates(),
             "kind": self.kind,
             "metadata": self.metadata,
             "reasoners": [

@@ -1,8 +1,16 @@
 from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache  # noqa: F401
 from agentfield_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
+    GrammarCapacityError,
     InferenceEngine,
+    QueueFullError,
     Request,
+    RequestTooLongError,
     TokenEvent,
+)
+from agentfield_tpu.serving.grammar import (  # noqa: F401
+    Grammar,
+    SchemaError,
+    compile_json_schema,
 )
 from agentfield_tpu.serving.sampler import SamplingParams  # noqa: F401

@@ -40,8 +40,12 @@ import numpy as np
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
 from agentfield_tpu.ops.paged_attention import paged_attention
+from agentfield_tpu.serving.grammar import Grammar
 from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache, build_page_table
 from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
+
+_MASKED = -1e30  # logit value for grammar-disallowed tokens
+_MAX_STOP_IDS = 8  # per-request stop ids carried into the decode-step EOS mask
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +72,11 @@ class EngineConfig:
     # stops paying for max_batch (one extra compile per bucket)
     session_ttl: float = 600.0  # idle cached sessions release their pages
     # after this long even without allocation pressure (0 disables)
+    grammar_slots: int = 0  # constrained-decoding state capacity (rows of the
+    # device-resident token-transition bank). 0 disables the masking path —
+    # the decode step then skips the [B, V] mask gather entirely. Each
+    # submitted Request.grammar occupies grammar.n_states rows (shared across
+    # requests carrying the same Grammar object).
     async_decode: bool = True  # pipeline decode: dispatch step N before
     # reading step N-1's sampled tokens, so the device never idles on the
     # host's device→host round trip (token events arrive one tick later;
@@ -95,6 +104,11 @@ class Request:
     # agent call chains share KV). Conversations grow monotonically, so a
     # session's cached tokens are always a prefix of the next prompt.
     session_id: str | None = None
+    # Constrained decoding: schema-invalid tokens are masked before sampling
+    # (serving/grammar.py). Requires sampling.stop_token_ids — EOS is the only
+    # way a completed value can terminate. Replaces the reference's prompt-
+    # injection + regex-salvage structured output (agent_ai.py:221-245,424-447).
+    grammar: Grammar | None = None
 
 
 @dataclasses.dataclass
@@ -127,12 +141,15 @@ class _SessionEntry:
 
 
 @functools.lru_cache(maxsize=None)
-def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
-    """Jitted decode step, cached per (model, engine) config so every engine
-    instance shares one compilation."""
+def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
+    """Jitted decode step, cached per (model, engine, mesh) config so every
+    engine instance shares one compilation."""
     ps = ecfg.page_size
 
-    def decode(params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps, top_ks, top_ps):
+    def decode(
+        params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps,
+        top_ks, top_ps, gstates, trans_bank, accept_bank, eos_ids,
+    ):
         B = tokens.shape[0]
         positions = seq_lens  # 0-based position of the incoming token
         x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
@@ -154,10 +171,14 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
-            kp = kp.at[page_idx, slot_idx].set(k[:, 0])
-            vp = vp.at[page_idx, slot_idx].set(v[:, 0])
+            # kp: [P, Kh, ps, hd]; write row b's new K at (page_idx[b], :,
+            # slot_idx[b], :) — non-adjacent advanced indices put the batch
+            # dim first, matching k[:, 0]'s [B, Kh, hd].
+            kp = kp.at[page_idx, :, slot_idx].set(k[:, 0])
+            vp = vp.at[page_idx, :, slot_idx].set(v[:, 0])
             attn = paged_attention(
-                q[:, 0], kp, vp, page_tables, seq_lens + 1, impl=ecfg.attn_impl
+                q[:, 0], kp, vp, page_tables, seq_lens + 1,
+                impl=ecfg.attn_impl, mesh=mesh,
             )
             x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
@@ -165,20 +186,52 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig):
 
         x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
         logits = llama.unembed(params, cfg, x)[:, 0]  # [B, V]
-        next_tokens = sample_tokens(logits, rng, temps, top_ks, top_ps)
+        if ecfg.grammar_slots > 0:
+            B_, V = logits.shape
+
+            def constrained(_):
+                # Constrained decoding: one [B, V] row gather from the
+                # transition bank masks schema-invalid tokens; the request's
+                # stop ids are additionally allowed in accepting states. Free
+                # rows sit in bank row 0 (all-zero: every token allowed,
+                # state stays 0), so they ride the same step.
+                rows = jnp.take(trans_bank, gstates, axis=0).astype(jnp.int32)
+                allowed = rows >= 0
+                stop_allow = jnp.zeros((B_, V), jnp.bool_).at[
+                    jnp.arange(B_)[:, None], jnp.clip(eos_ids, 0, V - 1)
+                ].max(eos_ids >= 0)
+                allowed = allowed | (stop_allow & accept_bank[gstates][:, None])
+                toks = sample_tokens(
+                    jnp.where(allowed, logits, _MASKED), rng, temps, top_ks, top_ps
+                )
+                new_g = jnp.maximum(
+                    jnp.take_along_axis(rows, toks[:, None], axis=1)[:, 0], 0
+                )
+                return toks, new_g
+
+            def free(_):
+                return sample_tokens(logits, rng, temps, top_ks, top_ps), gstates
+
+            # Unconstrained steps skip the bank gather entirely at runtime.
+            next_tokens, new_gstates = jax.lax.cond(
+                jnp.any(gstates > 0), constrained, free, None
+            )
+        else:
+            next_tokens = sample_tokens(logits, rng, temps, top_ks, top_ps)
+            new_gstates = gstates
         logprobs = jnp.take_along_axis(
             jax.nn.log_softmax(logits, axis=-1), next_tokens[:, None], axis=-1
         )[:, 0]
         # Advance lengths on-device (active slots have seq_len > 0) so the
         # host never re-uploads control state during steady-state decode.
         new_seq_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
-        return next_tokens, logprobs, new_seq_lens, kp, vp
+        return next_tokens, logprobs, new_seq_lens, new_gstates, kp, vp
 
     return jax.jit(decode, donate_argnums=(1, 2))
 
 
 @functools.lru_cache(maxsize=None)
-def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
+def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
     ps = ecfg.page_size
 
     def prefill(params, k_pages, v_pages, tokens, length, page_table_row):
@@ -186,14 +239,16 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
         # K/V are routed to the garbage page.
         positions = jnp.arange(bucket, dtype=jnp.int32)[None]
         logits, (ks, vs) = llama.forward_impl(
-            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh
         )
         pos = positions[0]
         in_range = pos < length
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
         slot_ids = pos % ps
-        k_pages = k_pages.at[:, page_ids, slot_ids].set(ks[:, 0])
-        v_pages = v_pages.at[:, page_ids, slot_ids].set(vs[:, 0])
+        # pages: [L, P, Kh, ps, hd]; advanced indices at dims 1,3 put the
+        # token dim first → value layout [bucket, L, Kh, hd].
+        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(ks[:, 0], 0, 1))
+        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(vs[:, 0], 0, 1))
         last = logits[0, length - 1]
         return last, k_pages, v_pages
 
@@ -201,7 +256,7 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
+def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
     """Prefill up to ``ecfg.prefill_batch`` fresh prompts in ONE forward pass
     (rows are independent batch entries; per-row K/V scatter into each row's
     own pages). Rows past the live count have length 0: every write routes to
@@ -215,7 +270,7 @@ def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
         # tokens [N, bucket]; lengths [N]; rows [N, max_pages_per_seq]
         positions = jnp.arange(bucket, dtype=jnp.int32)[None].repeat(N, 0)
         logits, (ks, vs) = llama.forward_impl(
-            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh
         )
         in_range = positions < lengths[:, None]
         page_ids = jnp.where(
@@ -224,8 +279,10 @@ def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
         slot_ids = positions % ps
         # ks/vs: [L, N, bucket, Kh, hd] → rows scatter into disjoint pages
         # (padding rows all hit garbage page 0; last-write-wins there is fine).
-        k_pages = k_pages.at[:, page_ids, slot_ids].set(ks)
-        v_pages = v_pages.at[:, page_ids, slot_ids].set(vs)
+        # Advanced [N, bucket] indices at dims 1,3 of [L, P, Kh, ps, hd] put
+        # the broadcast dims first → value layout [N, bucket, L, Kh, hd].
+        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.moveaxis(ks, 0, 2))
+        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.moveaxis(vs, 0, 2))
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0]  # [N, V]
@@ -259,10 +316,11 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
-            kp = kp.at[page_ids, slot_ids].set(k[0])
-            vp = vp.at[page_ids, slot_ids].set(v[0])
-            kk = kp[page_table_row].reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-            vv = vp[page_table_row].reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+            kp = kp.at[page_ids, :, slot_ids].set(k[0])
+            vp = vp.at[page_ids, :, slot_ids].set(v[0])
+            # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
+            kk = kp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+            vv = vp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
             attn = llama.attention_ref(q, kk, vv, positions, k_pos, k_valid)
             x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
@@ -279,6 +337,10 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
 class QueueFullError(Exception):
     """Admission queue at capacity — surfaced as backpressure (the reference
     returns HTTP 503 from the async gateway, execute.go:333-346)."""
+
+
+class GrammarCapacityError(Exception):
+    """The engine's grammar bank has no room for another schema's states."""
 
 
 class RequestTooLongError(Exception):
@@ -316,11 +378,10 @@ class InferenceEngine:
             from agentfield_tpu.parallel.mesh import AXIS_MODEL
             from agentfield_tpu.parallel.sharding import check_divisibility, shard_params
 
-            if self.ecfg.attn_impl != "ref" or self.ecfg.prefill_impl != "ref":
-                raise ValueError(
-                    "pallas attention impls are single-chip in this version; "
-                    "use attn_impl=prefill_impl='ref' with a mesh (GSPMD path)"
-                )
+            # Pallas impls run under shard_map over the (KV-)head axis —
+            # see ops/paged_attention.py and models/llama.py attend() — so TP
+            # composes with both the ref GSPMD path and the kernels
+            # (north-star config 5: 70B TP=8 on the paged kernel).
             check_divisibility(cfg, mesh.shape[AXIS_MODEL], paged_kv=True)
             params = shard_params(params, cfg, mesh)
         self.params = params
@@ -340,6 +401,27 @@ class InferenceEngine:
         self.temps = np.zeros((B,), np.float32)
         self.top_ks = np.zeros((B,), np.int32)
         self.top_ps = np.ones((B,), np.float32)
+        # Constrained decoding (grammar_slots > 0): per-slot bank-global DFA
+        # state (0 = unconstrained) + per-slot stop-id rows (-1 padded); the
+        # transition bank is host-built (rows shifted to bank-global ids) and
+        # device-mirrored with row-range incremental uploads. int16 keeps the
+        # bank at 2 bytes/entry (state ids are bounded by grammar_slots).
+        self.grammar_states = np.zeros((B,), np.int32)
+        self.eos_ids = np.full((B, _MAX_STOP_IDS), -1, np.int32)
+        S = max(1, self.ecfg.grammar_slots)
+        if S > np.iinfo(np.int16).max:
+            raise ValueError(f"grammar_slots={S} exceeds int16 bank capacity")
+        self._gbank_trans = np.zeros((S, cfg.vocab_size), np.int16)  # row 0: free
+        self._gbank_accept = np.zeros((S,), bool)
+        self._gbank_accept[0] = True
+        # Entries hold a STRONG reference to each Grammar: the id() key stays
+        # valid, and refcounts gate eviction (rows of a grammar still used by
+        # a pending/slotted request must never be reallocated).
+        self._gbank_entries: dict[int, dict[str, Any]] = {}
+        self._gbank_free: list[tuple[int, int]] = [(1, S - 1)] if S > 1 else []
+        self._gbank_dev: dict[str, jax.Array] | None = None
+        self._gbank_dirty_rows: list[tuple[int, int]] = []  # (offset, n) to upload
+        self._gbank_clock = 0.0  # LRU tiebreaker for eviction
         self.slots: list[_Slot | None] = [None] * B
         self.pending: collections.deque[Request] = collections.deque()
         self._sessions: dict[str, _SessionEntry] = {}
@@ -357,7 +439,7 @@ class InferenceEngine:
         # silently dropped (its future would never resolve).
         self._pending_lock = threading.Lock()
         self._rng = jax.random.PRNGKey(seed)
-        self._decode_jit = _decode_fn(cfg, self.ecfg)
+        self._decode_jit = _decode_fn(cfg, self.ecfg, mesh)
         # Device-resident copies of the control arrays; refreshed from the
         # numpy shadows only when admission/release dirties them.
         self._dirty = True
@@ -391,6 +473,27 @@ class InferenceEngine:
         RequestTooLongError if it can never fit the page budget."""
         if not req.prompt:
             raise ValueError(f"request {req.id}: prompt must be non-empty")
+        if req.grammar is not None:
+            if self.ecfg.grammar_slots <= 0:
+                raise ValueError(
+                    f"request {req.id}: carries a grammar but the engine was "
+                    "built with grammar_slots=0 (constrained decoding disabled)"
+                )
+            if not req.sampling.stop_token_ids:
+                raise ValueError(
+                    f"request {req.id}: grammar-constrained requests need "
+                    "stop_token_ids — EOS is the only legal terminator once "
+                    "the value is complete"
+                )
+            if len(req.sampling.stop_token_ids) > _MAX_STOP_IDS:
+                # The decode-step EOS allowance is a fixed-width row; silently
+                # truncating would mask some terminators forever and run the
+                # request to max_new_tokens.
+                raise ValueError(
+                    f"request {req.id}: at most {_MAX_STOP_IDS} stop_token_ids "
+                    f"are supported with a grammar (got "
+                    f"{len(req.sampling.stop_token_ids)})"
+                )
         needed = self._pages_needed(req)
         if needed > self.ecfg.max_pages_per_seq:
             raise RequestTooLongError(
@@ -398,15 +501,141 @@ class InferenceEngine:
                 f"{req.sampling.max_new_tokens} new tokens needs {needed} pages "
                 f"> max_pages_per_seq={self.ecfg.max_pages_per_seq}"
             )
-        with self._pending_lock:
-            if len(self.pending) >= self.ecfg.max_pending:
-                self.stats["backpressure_total"] += 1
-                raise QueueFullError(f"pending queue at capacity {self.ecfg.max_pending}")
-            self.pending.append(req)
+        if req.grammar is not None:
+            # Acquire LAST so a rejected request never pins bank rows; may
+            # raise GrammarCapacityError (after evicting idle grammars).
+            with self._session_lock:
+                self._grammar_acquire(req.grammar)
+        try:
+            with self._pending_lock:
+                if len(self.pending) >= self.ecfg.max_pending:
+                    self.stats["backpressure_total"] += 1
+                    raise QueueFullError(
+                        f"pending queue at capacity {self.ecfg.max_pending}"
+                    )
+                self.pending.append(req)
+        except QueueFullError:
+            with self._session_lock:
+                self._grammar_release(req.grammar)
+            raise
 
     def _pages_needed(self, req: Request) -> int:
         total = len(req.prompt) + req.sampling.max_new_tokens
         return -(-total // self.ecfg.page_size)
+
+    def _gbank_alloc_range(self, n: int) -> int | None:
+        """First-fit over the free list (ranges never move, so active bank-
+        global state ids stay valid across other grammars' lifecycles)."""
+        for i, (off, size) in enumerate(self._gbank_free):
+            if size >= n:
+                if size == n:
+                    self._gbank_free.pop(i)
+                else:
+                    self._gbank_free[i] = (off + n, size - n)
+                return off
+        return None
+
+    def _gbank_free_range(self, off: int, n: int) -> None:
+        self._gbank_free.append((off, n))
+        # merge adjacent ranges to fight fragmentation
+        self._gbank_free.sort()
+        merged: list[tuple[int, int]] = []
+        for o, s in self._gbank_free:
+            if merged and merged[-1][0] + merged[-1][1] == o:
+                merged[-1] = (merged[-1][0], merged[-1][1] + s)
+            else:
+                merged.append((o, s))
+        self._gbank_free = merged
+
+    def _grammar_acquire(self, g: Grammar) -> int:
+        """Register (if new) and take a reference on a Grammar's bank rows.
+        Under capacity pressure, unreferenced grammars evict LRU. Every
+        acquire is balanced by a _grammar_release when the request leaves the
+        engine (finished, cancelled, or failed admission)."""
+        self._gbank_clock += 1.0
+        ent = self._gbank_entries.get(id(g))
+        if ent is not None:
+            ent["refs"] += 1
+            ent["used"] = self._gbank_clock
+            return ent["off"]
+        if g.trans.shape[1] != self.cfg.vocab_size:
+            raise ValueError(
+                f"grammar vocab {g.trans.shape[1]} != model vocab {self.cfg.vocab_size}"
+            )
+        n = g.n_states
+        off = self._gbank_alloc_range(n)
+        while off is None:
+            idle = [k for k, e in self._gbank_entries.items() if e["refs"] <= 0]
+            if not idle:
+                raise GrammarCapacityError(
+                    f"grammar needs {n} states; bank capacity "
+                    f"{self.ecfg.grammar_slots} is exhausted by in-use grammars"
+                )
+            victim = min(idle, key=lambda k: self._gbank_entries[k]["used"])
+            ve = self._gbank_entries.pop(victim)
+            self._gbank_free_range(ve["off"], ve["n"])
+            off = self._gbank_alloc_range(n)
+        self._gbank_trans[off : off + n] = np.where(
+            g.trans >= 0, g.trans + off, -1
+        ).astype(np.int16)
+        self._gbank_accept[off : off + n] = g.accept
+        self._gbank_entries[id(g)] = {
+            "grammar": g,  # strong ref: keeps id() stable while registered
+            "off": off,
+            "n": n,
+            "refs": 1,
+            "used": self._gbank_clock,
+        }
+        self._gbank_dirty_rows.append((off, n))
+        return off
+
+    def _grammar_release(self, g: Grammar | None) -> None:
+        if g is None:
+            return
+        ent = self._gbank_entries.get(id(g))
+        if ent is not None and ent["refs"] > 0:
+            ent["refs"] -= 1
+        # rows stay cached (warm) until capacity pressure evicts them
+
+    def _gbank_device(self) -> dict[str, jax.Array]:
+        with self._session_lock:
+            return self._gbank_device_locked()
+
+    def _gbank_device_locked(self) -> dict[str, jax.Array]:
+        if self._gbank_dev is None:
+            self._gbank_dev = {
+                "trans": jnp.asarray(self._gbank_trans),
+                "accept": jnp.asarray(self._gbank_accept),
+            }
+            self._gbank_dirty_rows.clear()
+        elif self._gbank_dirty_rows:
+            # Upload only the newly written row ranges; the device-side
+            # .at[].set copy is cheap next to a full-bank host transfer.
+            trans, accept = self._gbank_dev["trans"], self._gbank_dev["accept"]
+            for off, n in self._gbank_dirty_rows:
+                trans = trans.at[off : off + n].set(
+                    jnp.asarray(self._gbank_trans[off : off + n])
+                )
+                accept = accept.at[off : off + n].set(
+                    jnp.asarray(self._gbank_accept[off : off + n])
+                )
+            self._gbank_dev = {"trans": trans, "accept": accept}
+            self._gbank_dirty_rows.clear()
+        return self._gbank_dev
+
+    def _first_token_mask(self, req: Request) -> tuple[np.ndarray, int] | None:
+        """Host-side mask for the token sampled from prefill logits. Returns
+        (allowed [V] bool, bank offset) or None for unconstrained requests.
+        The grammar already holds a reference (acquired at submit)."""
+        if req.grammar is None:
+            return None
+        ent = self._gbank_entries[id(req.grammar)]
+        row = req.grammar.trans[req.grammar.start]
+        allowed = row >= 0
+        if req.grammar.accept[req.grammar.start]:
+            allowed = allowed.copy()
+            allowed[list(req.sampling.stop_token_ids)] = True
+        return allowed, ent["off"]
 
     def gc_sessions(self, at: float | None = None) -> int:
         """Release pages of sessions idle longer than session_ttl (eviction
@@ -547,7 +776,7 @@ class InferenceEngine:
             rows[j] = row
             s = req.sampling
             temps[j], top_ks[j], top_ps[j] = s.temperature, s.top_k, s.top_p
-        fn = _batch_prefill_fn(self.cfg, self.ecfg, bucket)
+        fn = _batch_prefill_fn(self.cfg, self.ecfg, bucket, self.mesh)
         last, self.cache.k_pages, self.cache.v_pages = fn(
             self.params,
             self.cache.k_pages,
@@ -556,8 +785,16 @@ class InferenceEngine:
             jnp.asarray(lengths),
             jnp.asarray(rows),
         )
+        masks = None
+        for j, (req, _, _) in enumerate(batch):
+            m = self._first_token_mask(req)
+            if m is not None:
+                if masks is None:
+                    masks = np.ones((N, self.cfg.vocab_size), bool)
+                masks[j] = m[0]
+        sample_from = jnp.where(jnp.asarray(masks), last, _MASKED) if masks is not None else last
         toks = sample_tokens(
-            last,
+            sample_from,
             self._next_rng(),
             jnp.asarray(temps),
             jnp.asarray(top_ks),
@@ -613,8 +850,14 @@ class InferenceEngine:
         self, req: Request, slot_idx: int, pages: list[int], row: np.ndarray, last_logits
     ) -> TokenEvent:
         s = req.sampling
+        masked = self._first_token_mask(req)
+        sample_from = (
+            jnp.where(jnp.asarray(masked[0]), last_logits, _MASKED)
+            if masked is not None
+            else last_logits
+        )
         tok_arr = sample_tokens(
-            last_logits[None],
+            sample_from[None],
             self._next_rng(),
             jnp.asarray([s.temperature], jnp.float32),
             jnp.asarray([s.top_k], jnp.int32),
@@ -651,6 +894,14 @@ class InferenceEngine:
             self.temps[slot_idx] = s.temperature
             self.top_ks[slot_idx] = s.top_k
             self.top_ps[slot_idx] = s.top_p
+            if req.grammar is not None:
+                g = req.grammar
+                with self._session_lock:
+                    off = self._gbank_entries[id(g)]["off"]
+                local = int(g.trans[g.start, tok])
+                self.grammar_states[slot_idx] = off + local if local >= 0 else 0
+                ids = list(s.stop_token_ids)[:_MAX_STOP_IDS]
+                self.eos_ids[slot_idx, : len(ids)] = ids
         self._dirty = True
         self._compact = None  # membership changed
         return event
@@ -675,7 +926,7 @@ class InferenceEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(piece)] = np.asarray(piece, np.int32)
             if piece_start == 0 and len(pieces) == 1:
-                fn = _prefill_fn(self.cfg, self.ecfg, bucket)
+                fn = _prefill_fn(self.cfg, self.ecfg, bucket, self.mesh)
                 last_logits, self.cache.k_pages, self.cache.v_pages = fn(
                     self.params,
                     self.cache.k_pages,
@@ -747,6 +998,10 @@ class InferenceEngine:
         self.temps[slot_idx] = 0.0
         self.top_ks[slot_idx] = 0
         self.top_ps[slot_idx] = 1.0
+        self.grammar_states[slot_idx] = 0
+        self.eos_ids[slot_idx] = -1
+        with self._session_lock:
+            self._grammar_release(slot.req.grammar)
         self._dirty = True
         self._compact = None  # membership changed
 
@@ -762,20 +1017,28 @@ class InferenceEngine:
         cancels, self._cancels = self._cancels, set()
         with self._pending_lock:
             n_before = len(self.pending)
+            dropped = [r for r in self.pending if r.id in cancels]
             kept = collections.deque(r for r in self.pending if r.id not in cancels)
             self.pending = kept
             self.stats["requests_cancelled"] += n_before - len(kept)
+        if dropped:
+            with self._session_lock:
+                for r in dropped:
+                    self._grammar_release(r.grammar)
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
                 # Incomplete output: release WITHOUT session retention.
                 with self._session_lock:
                     self.allocator.free(slot.pages)
+                    self._grammar_release(slot.req.grammar)
                 self.slots[i] = None
                 self.page_tables[i] = 0
                 self.seq_lens[i] = 0
                 self.temps[i] = 0.0
                 self.top_ks[i] = 0
                 self.top_ps[i] = 1.0
+                self.grammar_states[i] = 0
+                self.eos_ids[i] = -1
                 self._dirty = True
                 self._compact = None
                 self.stats["requests_cancelled"] += 1
@@ -872,6 +1135,12 @@ class InferenceEngine:
             slot.tokens.append(tok)
             self.seq_lens[i] = slot.length
             self.last_tokens[i] = tok
+            if slot.req.grammar is not None:
+                # Mirror the device-side DFA advance so a dirty rebuild of the
+                # control arrays starts from the current state.
+                self.grammar_states[i] = max(
+                    int(self._gbank_trans[self.grammar_states[i], tok]), 0
+                )
             self.stats["decode_tokens"] += 1
             out.append(self._emit(i, slot, tok, logprob))
         return out
@@ -893,10 +1162,13 @@ class InferenceEngine:
                 "temps": jnp.asarray(self.temps),
                 "top_ks": jnp.asarray(self.top_ks),
                 "top_ps": jnp.asarray(self.top_ps),
+                "gstates": jnp.asarray(self.grammar_states),
+                "eos_ids": jnp.asarray(self.eos_ids),
             }
             self._dirty = False
         d = self._dev
-        next_tokens, logprobs, new_seq_lens, self.cache.k_pages, self.cache.v_pages = (
+        bank = self._gbank_device()
+        next_tokens, logprobs, new_seq_lens, new_gstates, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(
                 self.params,
                 self.cache.k_pages,
@@ -908,9 +1180,13 @@ class InferenceEngine:
                 d["temps"],
                 d["top_ks"],
                 d["top_ps"],
+                d["gstates"],
+                bank["trans"],
+                bank["accept"],
+                d["eos_ids"],
             )
         )
-        d["tokens"], d["seq_lens"] = next_tokens, new_seq_lens
+        d["tokens"], d["seq_lens"], d["gstates"] = next_tokens, new_seq_lens, new_gstates
         return next_tokens, logprobs
 
     def _decode_compact_dispatch(
@@ -938,6 +1214,10 @@ class InferenceEngine:
             temps[:n] = self.temps[active_idx]
             top_ks[:n] = self.top_ks[active_idx]
             top_ps[:n] = self.top_ps[active_idx]
+            gstates = np.zeros((bucket,), np.int32)
+            eos_ids = np.full((bucket, _MAX_STOP_IDS), -1, np.int32)
+            gstates[:n] = self.grammar_states[active_idx]
+            eos_ids[:n] = self.eos_ids[active_idx]
             c = self._compact = {
                 "key": key,
                 "tokens": jnp.asarray(tokens),
@@ -946,9 +1226,12 @@ class InferenceEngine:
                 "temps": jnp.asarray(temps),
                 "top_ks": jnp.asarray(top_ks),
                 "top_ps": jnp.asarray(top_ps),
+                "gstates": jnp.asarray(gstates),
+                "eos_ids": jnp.asarray(eos_ids),
             }
 
-        next_tokens, logprobs, new_seq_lens, self.cache.k_pages, self.cache.v_pages = (
+        bank = self._gbank_device()
+        next_tokens, logprobs, new_seq_lens, new_gstates, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(
                 self.params,
                 self.cache.k_pages,
@@ -960,9 +1243,13 @@ class InferenceEngine:
                 c["temps"],
                 c["top_ks"],
                 c["top_ps"],
+                c["gstates"],
+                bank["trans"],
+                bank["accept"],
+                c["eos_ids"],
             )
         )
-        c["tokens"], c["seq_lens"] = next_tokens, new_seq_lens
+        c["tokens"], c["seq_lens"], c["gstates"] = next_tokens, new_seq_lens, new_gstates
         self._dirty = True  # full-width device state is now stale
         return next_tokens, logprobs
 

@@ -6,10 +6,13 @@ sdk/python/agentfield/agent_ai.py:262-325). Here long sessions keep their KV
 resident in HBM pages so agent→agent call chains never re-prefill
 (SURVEY §5 "long-context" row, §7 step 7).
 
-Layout: ``[num_layers, num_pages, page_size, num_kv_heads, head_dim]`` —
+Layout: ``[num_layers, num_pages, num_kv_heads, page_size, head_dim]`` —
 layers stacked on axis 0 so the decode step scans over them; the trailing
-``num_kv_heads * head_dim`` is lane-aligned (multiple of 128) for all real
-configs. Page 0 is reserved as a garbage sink: inactive decode slots write
+``(page_size, head_dim)`` block is a whole VMEM tile per (page, kv-head), which
+is exactly the unit the Pallas paged-decode kernel DMAs (Mosaic requires the
+last two block dims be full array dims or (8,128)-aligned — the former
+``[.., ps, Kh, hd]`` layout forced (1, hd) blocks and failed TPU lowering).
+Page 0 is reserved as a garbage sink: inactive decode slots write
 there, which keeps the decode step shape-static with no host branching.
 """
 
@@ -27,8 +30,8 @@ from agentfield_tpu.models.llama import resolve_dtype
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: jnp.ndarray  # [L, P, ps, Kh, hd]
-    v_pages: jnp.ndarray  # [L, P, ps, Kh, hd]
+    k_pages: jnp.ndarray  # [L, P, Kh, ps, hd]
+    v_pages: jnp.ndarray  # [L, P, Kh, ps, hd]
     page_size: int
 
     @property
@@ -47,7 +50,7 @@ class PagedKVCache:
         the TP sharding of wk/wv, so K/V writes during decode are local — no
         resharding on the hot path)."""
         dt = resolve_dtype(dtype or cfg.dtype)
-        shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim)
         k = jnp.zeros(shape, dt)
         v = jnp.zeros(shape, dt)
         if mesh is not None:
@@ -55,7 +58,7 @@ class PagedKVCache:
 
             from agentfield_tpu.parallel.mesh import AXIS_MODEL
 
-            s = NamedSharding(mesh, P(None, None, None, AXIS_MODEL, None))
+            s = NamedSharding(mesh, P(None, None, AXIS_MODEL, None, None))
             k, v = jax.device_put(k, s), jax.device_put(v, s)
         return PagedKVCache(k_pages=k, v_pages=v, page_size=page_size)
 

@@ -14,6 +14,7 @@ loop); completions resolve asyncio futures on the loop.
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import Any
 
@@ -43,12 +44,22 @@ class ByteTokenizer:
 
     def __init__(self, vocab_size: int):
         self.vocab_size = vocab_size
+        self.eos_token_id = 0  # NUL: never legal inside generated text
 
     def encode(self, text: str) -> list[int]:
         return [b % self.vocab_size for b in text.encode("utf-8")]
 
     def decode(self, tokens: list[int]) -> str:
         return bytes(t % 256 for t in tokens).decode("utf-8", errors="replace")
+
+    def token_bytes(self, vocab_size: int) -> list[bytes]:
+        """Per-id byte strings for grammar compilation (serving/grammar.py).
+        Ids ≥ 256 alias low bytes through decode(), but for constrained
+        decoding they are redundant — map them to NUL so the grammar only
+        ever selects the canonical single-byte ids."""
+        out = [bytes([i]) for i in range(min(256, vocab_size))]
+        out += [b"\x00"] * (vocab_size - len(out))
+        return out
 
 
 class HFTokenizer:
@@ -59,12 +70,55 @@ class HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(path)
         self.vocab_size = self._tok.vocab_size
+        self.eos_token_id = self._tok.eos_token_id
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text)
 
     def decode(self, tokens: list[int]) -> str:
         return self._tok.decode(tokens)
+
+    def token_bytes(self, vocab_size: int) -> list[bytes]:
+        """Per-id byte strings for grammar compilation. Handles the two HF
+        vocab conventions: byte-level BPE (GPT-2/Llama-3 — chars map through
+        the bytes↔unicode table) and SentencePiece (▁ = space, <0xXX> = raw
+        byte). Special tokens map to NUL (never legal inside JSON), so the
+        grammar can't select them; EOS reaches the sampler via the accept-
+        state allowance instead."""
+        out = [b"\x00"] * vocab_size
+        special = set(self._tok.all_special_ids or [])
+        # GPT-2 byte-level BPE unicode → byte inverse table (the canonical
+        # bytes_to_unicode mapping, inverted).
+        bs = list(range(0x21, 0x7F)) + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100))
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        uni2byte = {chr(c): b for b, c in zip(bs, cs)}
+        vocab = self._tok.get_vocab()
+        byte_level = any(tok.startswith("Ġ") for tok in vocab)
+        for tok, idx in vocab.items():
+            if idx >= vocab_size:
+                continue
+            if idx in special:
+                continue  # stays NUL
+            if tok.startswith("<0x") and tok.endswith(">") and len(tok) == 6:
+                try:
+                    out[idx] = bytes([int(tok[3:5], 16)])
+                    continue
+                except ValueError:
+                    pass
+            if byte_level:
+                try:
+                    out[idx] = bytes(uni2byte[c] for c in tok)
+                    continue
+                except KeyError:
+                    pass
+            out[idx] = tok.replace("▁", " ").encode("utf-8")
+        return out
 
 
 def _error_event(rid: str, error: str):
@@ -98,6 +152,14 @@ class ModelBackend:
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._next = 0
+        # Compiled-grammar cache: canonical schema JSON -> Grammar (LRU,
+        # bounded — each entry is an [n_states, vocab] table, tens of MB at a
+        # real vocab). Grammar objects are also the engine's bank-dedup key,
+        # so reusing the cached instance means one bank registration per
+        # schema. In-flight compiles dedup through _grammar_futs.
+        self._grammars: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        self._grammars_max = 8
+        self._grammar_futs: dict[str, asyncio.Future] = {}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._drive_loop())
@@ -156,7 +218,13 @@ class ModelBackend:
                 if ev.request_id not in self._futures:
                     continue  # cancelled/unknown rid: never recreate buffers
                     # (a setdefault here would leak entries forever)
-                self._buffers.setdefault(ev.request_id, []).append((ev.token, ev.logprob))
+                if not (ev.finished and ev.finish_reason == "stop"):
+                    # Stop tokens terminate, they are not content: buffering
+                    # one would append EOS text to result["text"] (breaking
+                    # e.g. strict parses of constrained scalar outputs).
+                    self._buffers.setdefault(ev.request_id, []).append((ev.token, ev.logprob))
+                else:
+                    self._buffers.setdefault(ev.request_id, [])
                 if ev.finished:
                     fut = self._futures.pop(ev.request_id, None)
                     records = self._buffers.pop(ev.request_id, [])
@@ -180,6 +248,52 @@ class ModelBackend:
         except asyncio.QueueFull:
             return False
 
+    @staticmethod
+    def _schema_key(schema: dict[str, Any]) -> str:
+        import json as _json
+
+        return _json.dumps(schema, sort_keys=True)
+
+    def _grammar_for(self, schema: dict[str, Any]):
+        """Compile (and cache) the token-level grammar for a JSON schema.
+        The cache key is the canonical schema text, so identical schemas from
+        different callers share one Grammar and one engine-bank registration.
+        Synchronous — async request paths pre-warm via ensure_grammar() so the
+        O(vocab × states) compile never blocks the event loop."""
+        from agentfield_tpu.serving.grammar import compile_json_schema
+
+        if self.tokenizer is None:
+            raise ValueError("constrained decoding needs a tokenizer on this node")
+        key = self._schema_key(schema)
+        g = self._grammars.get(key)
+        if g is None:
+            vocab = self.tokenizer.token_bytes(self.cfg.vocab_size)
+            g = compile_json_schema(schema, vocab)
+            self._grammars[key] = g
+        self._grammars.move_to_end(key)
+        while len(self._grammars) > self._grammars_max:
+            self._grammars.popitem(last=False)  # LRU out; the engine bank
+            # keeps its own strong ref until its rows evict, so in-flight
+            # requests are unaffected
+        return g
+
+    async def ensure_grammar(self, schema: dict[str, Any]):
+        """Pre-compile a schema's grammar OFF the event loop (dedup'd across
+        concurrent callers) and RETURN it — callers hand the object to
+        _submit directly, so LRU churn between pre-warm and submit can never
+        force a synchronous recompile on the event loop."""
+        key = self._schema_key(schema)
+        g = self._grammars.get(key)
+        if g is not None:
+            self._grammars.move_to_end(key)
+            return g
+        fut = self._grammar_futs.get(key)
+        if fut is None:
+            fut = asyncio.ensure_future(asyncio.to_thread(self._grammar_for, schema))
+            self._grammar_futs[key] = fut
+            fut.add_done_callback(lambda _f: self._grammar_futs.pop(key, None))
+        return await asyncio.shield(fut)
+
     def _submit(
         self,
         prompt: str | None,
@@ -192,14 +306,52 @@ class ModelBackend:
         register,  # rid -> None; registers the completion sink before submit
         unregister,  # rid -> None; rollback on submit failure
         session_id: str | None = None,
-    ) -> str:
-        """Shared tokenize/validate/submit path for both completion styles."""
+        response_schema: dict[str, Any] | None = None,
+        context_overflow: str = "error",
+        grammar_obj=None,  # pre-compiled Grammar from ensure_grammar()
+    ) -> tuple[str, int]:
+        """Shared tokenize/validate/submit path for both completion styles.
+
+        context_overflow: what to do when prompt + max_new_tokens exceeds the
+        engine's context budget — "error" raises RequestTooLongError;
+        "truncate_left" keeps the most recent tokens that fit (the TPU-native
+        analogue of the reference's token-aware oldest-first trimming,
+        agent_ai.py:262-325)."""
         if tokens is None:
             if prompt is None:
                 raise ValueError("one of 'prompt' or 'tokens' is required")
             if self.tokenizer is None:
                 raise ValueError("no tokenizer loaded on this model node; pass 'tokens'")
             tokens = self.tokenizer.encode(prompt)
+        if context_overflow not in ("error", "truncate_left"):
+            raise ValueError(f"unknown context_overflow policy {context_overflow!r}")
+        truncated = 0
+        if context_overflow == "truncate_left":
+            budget = self.engine.ecfg.max_context - max_new_tokens
+            if budget < 1:
+                raise ValueError(
+                    f"max_new_tokens={max_new_tokens} leaves no room for a "
+                    f"prompt in a {self.engine.ecfg.max_context}-token context"
+                )
+            if len(tokens) > budget:
+                # Keep the tail: the most recent turns matter most, matching
+                # the reference's drop-oldest trim. Truncation invalidates
+                # session-prefix reuse for this call (different prefix), so
+                # the engine simply treats it as a fresh prompt.
+                truncated = len(tokens) - budget
+                tokens = tokens[-budget:]
+        grammar = grammar_obj
+        if response_schema is not None:
+            if grammar is None:
+                grammar = self._grammar_for(response_schema)
+            if not stop_token_ids:
+                eos = getattr(self.tokenizer, "eos_token_id", None)
+                if eos is None:
+                    raise ValueError(
+                        "constrained decoding needs stop_token_ids (tokenizer "
+                        "has no eos_token_id)"
+                    )
+                stop_token_ids = [eos]
         self._next += 1
         rid = f"gen_{self._next}"
         register(rid)
@@ -216,13 +368,14 @@ class ModelBackend:
                         stop_token_ids=tuple(stop_token_ids or ()),
                     ),
                     session_id=session_id,
+                    grammar=grammar,
                 )
             )
         except Exception:
             unregister(rid)
             raise
         self._wake.set()
-        return rid
+        return rid, truncated
 
     async def generate(
         self,
@@ -234,9 +387,14 @@ class ModelBackend:
         top_p: float = 1.0,
         stop_token_ids: list[int] | None = None,
         session_id: str | None = None,
+        response_schema: dict[str, Any] | None = None,
+        context_overflow: str = "error",
     ) -> dict[str, Any]:
+        grammar_obj = None
+        if response_schema is not None:
+            grammar_obj = await self.ensure_grammar(response_schema)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        rid = self._submit(
+        rid, truncated = self._submit(
             prompt,
             tokens,
             max_new_tokens,
@@ -247,6 +405,9 @@ class ModelBackend:
             register=lambda r: self._futures.__setitem__(r, fut),
             unregister=lambda r: self._futures.pop(r, None),
             session_id=session_id,
+            response_schema=response_schema,
+            context_overflow=context_overflow,
+            grammar_obj=grammar_obj,
         )
         try:
             result = await fut
@@ -261,6 +422,8 @@ class ModelBackend:
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(result["tokens"])
         result["model"] = self.model_name
+        if truncated:
+            result["truncated_prompt_tokens"] = truncated
         return result
 
     def submit_stream(
@@ -273,11 +436,14 @@ class ModelBackend:
         top_p: float = 1.0,
         stop_token_ids: list[int] | None = None,
         session_id: str | None = None,
+        response_schema: dict[str, Any] | None = None,
+        context_overflow: str = "error",
+        grammar_obj=None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
         q: asyncio.Queue = asyncio.Queue(maxsize=4096)
-        rid = self._submit(
+        rid, _ = self._submit(
             prompt,
             tokens,
             max_new_tokens,
@@ -288,6 +454,9 @@ class ModelBackend:
             register=lambda r: self._streams.__setitem__(r, q),
             unregister=lambda r: self._streams.pop(r, None),
             session_id=session_id,
+            response_schema=response_schema,
+            context_overflow=context_overflow,
+            grammar_obj=grammar_obj,
         )
         return rid, q
 
@@ -329,6 +498,11 @@ def build_model_node(
         params = init_params(cfg, jax.random.PRNGKey(seed))
     if tokenizer is None:
         tokenizer = ByteTokenizer(cfg.vocab_size)
+    if ecfg is None:
+        # Default node config serves constrained decoding out of the box —
+        # 256 int16 bank rows (~66 MB at a 128k vocab) cover several live
+        # schemas; idle ones evict LRU under pressure.
+        ecfg = EngineConfig(grammar_slots=256)
     mesh = None
     if tp > 1:
         from agentfield_tpu.parallel.mesh import AXIS_MODEL, make_mesh
@@ -373,9 +547,14 @@ def build_model_node(
                 for k in (
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
+                    "response_schema", "context_overflow",
                 )
                 if body.get(k) is not None
             }
+            if gen_kwargs.get("response_schema") is not None:
+                gen_kwargs["grammar_obj"] = await backend.ensure_grammar(
+                    gen_kwargs["response_schema"]
+                )
             rid, q = backend.submit_stream(**gen_kwargs)
         except (QueueFullError,) as e:
             return _web.json_response({"error": str(e)}, status=503)
@@ -501,6 +680,7 @@ class ModelGrpcService:
                 for k in (
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
+                    "response_schema", "context_overflow",
                 )
                 if isinstance(request, dict) and request.get(k) is not None
             }

@@ -1,0 +1,129 @@
+"""ai() resilience: model-node failover + context-overflow policy.
+
+VERDICT item 9 — the reference handles provider failure with a fallback-model
+chain (agent_ai.py:345-384) and over-long prompts with token-aware trimming
+(agent_ai.py:262-325); here the failover unit is a model NODE and trimming is
+a server-side truncate-left with an explicit report."""
+
+import pytest
+
+from agentfield_tpu.sdk.agent import Agent
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import build_model_node
+from tests.helpers_cp import CPHarness, async_test, free_port
+
+ECFG = EngineConfig(max_batch=2, page_size=16, num_pages=64, max_pages_per_seq=4)
+
+
+@async_test
+async def test_ai_fails_over_to_live_model_node():
+    """A dead-but-registered model node (first in order) must not fail the
+    call: ai() retries the next active model node."""
+    async with CPHarness() as h:
+        dead_port = free_port()  # nothing listens here
+        async with h.http.post(
+            "/api/v1/nodes",
+            json={
+                "node_id": "model-dead",
+                "base_url": f"http://127.0.0.1:{dead_port}",
+                "kind": "model",
+                "reasoners": [{"id": "generate"}],
+            },
+        ) as r:
+            assert r.status in (200, 201), await r.text()
+
+        model_agent, backend = build_model_node(
+            "model-live", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("caller", h.base_url)
+        await app.start()
+        try:
+            out = await app.ai(prompt="hi", max_new_tokens=4)
+            assert len(out["tokens"]) == 4
+            assert out["model"] == "llama-tiny"  # served by the live node
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_ai_named_dead_node_still_fails():
+    """Explicit model= pins the node: no silent failover behind the caller's
+    back."""
+    async with CPHarness() as h:
+        dead_port = free_port()
+        async with h.http.post(
+            "/api/v1/nodes",
+            json={
+                "node_id": "model-dead",
+                "base_url": f"http://127.0.0.1:{dead_port}",
+                "kind": "model",
+                "reasoners": [{"id": "generate"}],
+            },
+        ) as r:
+            assert r.status in (200, 201)
+        app = Agent("caller", h.base_url)
+        await app.start()
+        try:
+            with pytest.raises(RuntimeError, match="ai\\(\\) failed"):
+                await app.ai(prompt="hi", max_new_tokens=4, model="model-dead")
+        finally:
+            await app.stop()
+
+
+@async_test
+async def test_context_overflow_truncate_left():
+    """Over-long prompts keep their most recent tokens (default policy) and
+    the result reports how many were dropped; context_overflow='error'
+    surfaces the hard failure instead."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-live", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("caller", h.base_url)
+        await app.start()
+        try:
+            max_ctx = ECFG.max_context  # 64
+            long_prompt = list(range(1, 101))  # 100 tokens > 64-token budget
+            out = await app.ai(tokens=long_prompt, max_new_tokens=8)
+            assert len(out["tokens"]) == 8
+            # budget = 64 - 8 = 56 kept; 44 dropped from the FRONT
+            assert out["truncated_prompt_tokens"] == 44
+            with pytest.raises(RuntimeError, match="RequestTooLongError"):
+                await app.ai(
+                    tokens=long_prompt, max_new_tokens=8, context_overflow="error"
+                )
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_truncated_prompt_same_as_explicit_tail():
+    """Greedy generation from a truncated prompt must equal generation from
+    the explicitly passed tail (truncation is exact, not approximate)."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-live", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("caller", h.base_url)
+        await app.start()
+        try:
+            long_prompt = [(i * 7) % 500 for i in range(90)]
+            budget = ECFG.max_context - 8
+            out_trunc = await app.ai(tokens=long_prompt, max_new_tokens=8)
+            out_tail = await app.ai(tokens=long_prompt[-budget:], max_new_tokens=8)
+            assert out_trunc["tokens"] == out_tail["tokens"]
+            assert "truncated_prompt_tokens" not in out_tail
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()

@@ -1,0 +1,401 @@
+"""Constrained decoding: JSON schema → DFA → token masks in the sampler.
+
+Replaces the reference's prompt-injection + regex-salvage structured output
+(sdk/python/agentfield/agent_ai.py:221-245, 424-447) with masks that make
+schema-invalid tokens unsampleable (VERDICT item 6)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import jsonschema
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import (
+    EngineConfig,
+    GrammarCapacityError,
+    InferenceEngine,
+    Request,
+    SamplingParams,
+    compile_json_schema,
+)
+from agentfield_tpu.serving.grammar import (
+    _NFA,
+    SchemaError,
+    build_schema_nfa,
+    close_over_vocab,
+    match_bytes,
+    nfa_to_dfa,
+)
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "ok": {"type": "boolean"},
+    },
+}
+
+
+def _dfa(schema):
+    n = _NFA()
+    frag = build_schema_nfa(n, schema)
+    return nfa_to_dfa(n, frag[0], frag[1])
+
+
+class TestByteDFA:
+    def test_accepts_valid_documents(self):
+        T, acc = _dfa(SCHEMA)
+        for doc in [
+            {"name": "x", "age": 0, "ok": True},
+            {"name": 'he said "hi" \\ done', "age": -12, "ok": False},
+            {"name": "", "age": 1234567, "ok": True},
+        ]:
+            data = json.dumps(doc, separators=(",", ":")).encode()
+            assert match_bytes(T, acc, data), data
+
+    def test_rejects_invalid_documents(self):
+        T, acc = _dfa(SCHEMA)
+        good = b'{"name":"x","age":1,"ok":true}'
+        assert match_bytes(T, acc, good)
+        for bad in [
+            b"{}",  # missing properties
+            b'{"name":"x","age":1.5,"ok":true}',  # float for integer
+            good[:-1],  # truncated
+            good + b"x",  # trailing garbage
+            b'{"age":1,"name":"x","ok":true}',  # wrong order (canonical form)
+            b'{"name": "x","age":1,"ok":true}',  # whitespace
+        ]:
+            assert not match_bytes(T, acc, bad), bad
+
+    def test_enum_const_array_null_number(self):
+        schema = {
+            "type": "object",
+            "properties": {
+                "kind": {"enum": ["alpha", "beta", 3]},
+                "v": {"const": "fixed"},
+                "xs": {"type": "array", "items": {"type": "number"}},
+                "z": {"type": "null"},
+            },
+        }
+        T, acc = _dfa(schema)
+        ok = b'{"kind":"beta","v":"fixed","xs":[1,-2.5e3,0.25],"z":null}'
+        assert match_bytes(T, acc, ok)
+        assert match_bytes(T, acc, b'{"kind":3,"v":"fixed","xs":[],"z":null}')
+        assert not match_bytes(T, acc, b'{"kind":"gamma","v":"fixed","xs":[],"z":null}')
+        assert not match_bytes(T, acc, b'{"kind":3,"v":"other","xs":[],"z":null}')
+
+    def test_array_min_max_items(self):
+        schema = {"type": "array", "items": {"type": "integer"}, "minItems": 1, "maxItems": 3}
+        T, acc = _dfa(schema)
+        assert not match_bytes(T, acc, b"[]")
+        assert match_bytes(T, acc, b"[1]")
+        assert match_bytes(T, acc, b"[1,2,3]")
+        assert not match_bytes(T, acc, b"[1,2,3,4]")
+
+    def test_array_max_items_rejects_leading_comma(self):
+        # regression: flat opt(item) opt(',item') accepted '[,1]'
+        for schema in [
+            {"type": "array", "items": {"type": "integer"}, "maxItems": 2},
+            {"type": "array", "items": {"type": "integer"}, "minItems": 0, "maxItems": 3},
+        ]:
+            T, acc = _dfa(schema)
+            assert match_bytes(T, acc, b"[]")
+            assert match_bytes(T, acc, b"[1,2]")
+            assert not match_bytes(T, acc, b"[,1]")
+            assert not match_bytes(T, acc, b"[1,]")
+            assert not match_bytes(T, acc, b"[1,,2]")
+
+    def test_string_max_length_allows_unicode_escape(self):
+        T, acc = _dfa({"type": "string", "maxLength": 2})
+        assert match_bytes(T, acc, b'"\\u0000a"')
+        assert not match_bytes(T, acc, b'"\\u00"')
+
+    def test_string_max_length(self):
+        schema = {"type": "string", "maxLength": 3}
+        T, acc = _dfa(schema)
+        assert match_bytes(T, acc, b'""')
+        assert match_bytes(T, acc, b'"abc"')
+        assert match_bytes(T, acc, '"aé"'.encode())  # multibyte char = 1 char
+        assert not match_bytes(T, acc, b'"abcd"')  # regression: shared NFA
+        # fragment across positions looped and accepted unbounded strings
+        assert not match_bytes(T, acc, b'"' + b"x" * 50 + b'"')
+
+    def test_unsupported_schema_raises(self):
+        with pytest.raises(SchemaError):
+            _dfa({"type": "frobnicate"})
+
+
+class TestTokenClosure:
+    def test_matches_bruteforce_walk(self):
+        T, acc = _dfa(SCHEMA)
+        vocab = [
+            b"{", b"}", b'"', b"na", b"me", b'":', b",", b"x", b'{"name":"',
+            b"age", b'","age":', b"1", b"23", b'],"', b"true", b"false",
+            b'","ok":', b"", b"\xff",
+        ]
+        g = close_over_vocab(T, acc, vocab)
+        n_states = T.shape[0]
+        for s in range(n_states):
+            for vi, tok in enumerate(vocab):
+                cur = s
+                for b in tok:
+                    cur = int(T[cur, b]) if cur >= 0 else -1
+                    if cur < 0:
+                        break
+                expect = cur if tok else -1  # empty tokens are forbidden
+                assert g.trans[s, vi] == expect, (s, tok)
+
+
+def _byte_vocab(vocab_size: int) -> list[bytes]:
+    """Token i ↔ byte i for i<256; the rest are multi-byte filler that JSON
+    never needs (exercises the 'token invalid from every state' path)."""
+    out = [bytes([i]) for i in range(256)]
+    out += [b"\x00\x01" for _ in range(vocab_size - 256)]
+    return out
+
+
+# Bounded variant for engine runs: maxLength caps the string so a random-
+# weights model completes the document well inside the token budget (an
+# unbounded string may never sample the closing quote).
+ENGINE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 6},
+        "age": {"type": "integer"},
+        "ok": {"type": "boolean"},
+    },
+}
+
+
+def _assert_valid_or_valid_prefix(toks, grammar, schema):
+    """EOS-terminated streams must parse + validate; length-capped streams
+    must still be an exact prefix of the schema language (every sampled token
+    was legal)."""
+    if 0 in toks:
+        body = bytes(toks[: toks.index(0)])
+        jsonschema.validate(json.loads(body.decode("utf-8")), schema)
+        return True
+    state = grammar.start
+    for t in toks:
+        state = int(grammar.trans[state, t])
+        assert state >= 0, f"illegal token {t} in {bytes(toks)!r}"
+    return False
+
+
+class TestEngineConstrainedDecoding:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = get_config("llama-tiny")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        vocab = _byte_vocab(cfg.vocab_size)
+        grammar = compile_json_schema(ENGINE_SCHEMA, vocab)
+        return cfg, params, vocab, grammar
+
+    def _run(self, cfg, params, grammar, temps, ecfg_kwargs=None, n=2):
+        ecfg = EngineConfig(
+            max_batch=4,
+            page_size=16,
+            num_pages=64,
+            max_pages_per_seq=8,
+            grammar_slots=grammar.n_states + 1,
+            **(ecfg_kwargs or {}),
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        eos = 0  # byte 0 never appears in JSON text
+        reqs = [
+            Request(
+                id=f"g{i}",
+                prompt=[65 + i, 66, 67],
+                sampling=SamplingParams(
+                    temperature=temps[i % len(temps)],
+                    max_new_tokens=100,
+                    stop_token_ids=(eos,),
+                ),
+                grammar=grammar,
+            )
+            for i in range(n)
+        ]
+        return engine, engine.run_to_completion(reqs)
+
+    def test_output_always_validates(self, setup):
+        cfg, params, vocab, grammar = setup
+        # High temperature: unconstrained sampling would emit junk with
+        # overwhelming probability; every decoded stream must be exact
+        # schema-valid JSON (EOS-terminated) or a legal prefix (length cap).
+        engine, results = self._run(cfg, params, grammar, temps=[1.5, 0.0], n=4)
+        assert len(results) == 4
+        completed = sum(
+            _assert_valid_or_valid_prefix(toks, grammar, ENGINE_SCHEMA)
+            for toks in results.values()
+        )
+        # The bounded schema forces completion well inside the budget for the
+        # greedy rows at minimum.
+        assert completed >= 1
+
+    def test_eos_only_at_accept(self, setup):
+        cfg, params, vocab, grammar = setup
+        engine, results = self._run(cfg, params, grammar, temps=[1.0], n=2)
+        for toks in results.values():
+            if 0 in toks:  # EOS emitted → everything before it is complete
+                cut = toks.index(0)
+                jsonschema.validate(
+                    json.loads(bytes(toks[:cut]).decode()), ENGINE_SCHEMA
+                )
+
+    def test_mixed_constrained_and_free_rows(self, setup):
+        cfg, params, vocab, grammar = setup
+        ecfg = EngineConfig(
+            max_batch=4, page_size=16, num_pages=64, max_pages_per_seq=8,
+            grammar_slots=grammar.n_states + 1,
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+        free = Request(id="free", prompt=[1, 2, 3], sampling=SamplingParams(max_new_tokens=8))
+        con = Request(
+            id="con", prompt=[4, 5, 6],
+            sampling=SamplingParams(max_new_tokens=100, stop_token_ids=(0,)),
+            grammar=grammar,
+        )
+        results = engine.run_to_completion([free, con])
+        # free row: greedy unconstrained must match a no-grammar engine
+        ref_engine = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=4, page_size=16, num_pages=64, max_pages_per_seq=8,
+        ))
+        ref = ref_engine.run_to_completion(
+            [Request(id="free", prompt=[1, 2, 3], sampling=SamplingParams(max_new_tokens=8))]
+        )
+        assert results["free"] == ref["free"]
+        _assert_valid_or_valid_prefix(results["con"], grammar, ENGINE_SCHEMA)
+
+    def test_grammar_requires_stop_ids_and_slots(self, setup):
+        cfg, params, vocab, grammar = setup
+        engine = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4,
+        ))
+        with pytest.raises(ValueError, match="grammar_slots=0"):
+            engine.submit(Request(
+                id="x", prompt=[1],
+                sampling=SamplingParams(stop_token_ids=(0,)), grammar=grammar,
+            ))
+        engine2 = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4,
+            grammar_slots=grammar.n_states + 1,
+        ))
+        with pytest.raises(ValueError, match="stop_token_ids"):
+            engine2.submit(Request(id="x", prompt=[1], grammar=grammar))
+
+    def test_bank_capacity(self, setup):
+        cfg, params, vocab, grammar = setup
+        engine = InferenceEngine(params, cfg, EngineConfig(
+            max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4,
+            grammar_slots=4,  # far too small
+        ))
+        with pytest.raises(GrammarCapacityError):
+            engine.submit(Request(
+                id="x", prompt=[1],
+                sampling=SamplingParams(max_new_tokens=4, stop_token_ids=(0,)),
+                grammar=grammar,
+            ))
+
+    def test_shared_grammar_registers_once(self, setup):
+        cfg, params, vocab, grammar = setup
+        engine, results = self._run(cfg, params, grammar, temps=[0.8], n=3)
+        assert len(engine._gbank_entries) == 1  # one registration, shared
+        ent = engine._gbank_entries[id(grammar)]
+        assert ent["refs"] == 0  # all requests finished → references returned
+        assert ent["n"] == grammar.n_states
+
+    def test_bank_eviction_and_id_reuse_safety(self, setup):
+        """Idle grammars evict under pressure (no permanent bank leak), and a
+        registered grammar is strongly referenced so CPython id() reuse can
+        never alias a new grammar onto stale rows."""
+        cfg, params, vocab, grammar = setup
+        ecfg = EngineConfig(
+            max_batch=2, page_size=16, num_pages=64, max_pages_per_seq=8,
+            grammar_slots=grammar.n_states + 6,  # room for ONE grammar + a tiny one
+        )
+        engine = InferenceEngine(params, cfg, ecfg)
+
+        def run_one(g, rid):
+            engine.submit(Request(
+                id=rid, prompt=[1, 2, 3],
+                sampling=SamplingParams(max_new_tokens=4, stop_token_ids=(0,)),
+                grammar=g,
+            ))
+            while engine.has_work():
+                engine.step()
+
+        run_one(grammar, "a")
+        # A second schema that doesn't fit alongside: must evict the idle one.
+        small = compile_json_schema({"type": "boolean"}, vocab)
+        run_one(small, "b")
+        assert id(grammar) not in engine._gbank_entries  # evicted
+        assert id(small) in engine._gbank_entries
+        # Entries keep strong refs: every registered grammar object is alive.
+        for ent in engine._gbank_entries.values():
+            assert ent["grammar"] is not None
+
+
+class TestAiSchemaEndToEnd:
+    def test_ai_schema_returns_validated_json(self):
+        """ai(schema=...) → control plane → model node → constrained decode →
+        parsed result, with zero re-parse salvage (VERDICT item 6 done-bar).
+        The schema is fully bounded (enum + boolean) so even a random-weights
+        greedy model must complete the value and emit EOS."""
+        import asyncio
+
+        from agentfield_tpu.sdk.agent import Agent
+        from agentfield_tpu.serving.model_node import build_model_node
+        from tests.helpers_cp import CPHarness, async_test
+
+        schema = {
+            "type": "object",
+            "properties": {
+                "kind": {"enum": ["alpha", "beta"]},
+                "sure": {"type": "boolean"},
+            },
+        }
+
+        @async_test
+        async def run():
+            async with CPHarness() as h:
+                model_agent, backend = build_model_node(
+                    "model-tiny", h.base_url, model="llama-tiny",
+                    ecfg=EngineConfig(
+                        max_batch=4, page_size=16, num_pages=256,
+                        max_pages_per_seq=32, grammar_slots=64,
+                    ),
+                )
+                await backend.start()
+                await model_agent.start()
+                app = Agent("caller", h.base_url)
+                await app.start()
+                try:
+                    out = await app.ai(
+                        prompt="Pick a kind.", schema=schema, max_new_tokens=64
+                    )
+                    assert out["finish_reason"] == "stop"
+                    parsed = out["parsed"]
+                    jsonschema.validate(parsed, schema)
+                    assert parsed["kind"] in ("alpha", "beta")
+                    assert isinstance(parsed["sure"], bool)
+                    # Top-level SCALAR schema: the stop token must not leak
+                    # into result text (strict json.loads has no salvage
+                    # scanner for non-object values).
+                    out2 = await app.ai(
+                        prompt="True or false?",
+                        schema={"type": "boolean"},
+                        max_new_tokens=16,
+                    )
+                    assert out2["finish_reason"] == "stop"
+                    assert out2["text"] in ("true", "false")
+                    assert isinstance(out2["parsed"], bool)
+                finally:
+                    await app.stop()
+                    await model_agent.stop()
+                    await backend.stop()
+
+        run()

@@ -123,8 +123,8 @@ def test_paged_attention_matches_ref():
     B, H, Kh, hd, P, ps, maxp = 4, 4, 2, 64, 32, 16, 6
     ks = jax.random.split(jax.random.PRNGKey(2), 4)
     q = _rand(ks[0], (B, H, hd))
-    k_pages = _rand(ks[1], (P, ps, Kh, hd))
-    v_pages = _rand(ks[2], (P, ps, Kh, hd))
+    k_pages = _rand(ks[1], (P, Kh, ps, hd))
+    v_pages = _rand(ks[2], (P, Kh, ps, hd))
     # distinct non-zero pages per sequence, like the allocator hands out
     perm = np.asarray(jax.random.permutation(ks[3], P - 1) + 1)
     page_tables = jnp.asarray(perm[: B * maxp].reshape(B, maxp), jnp.int32)

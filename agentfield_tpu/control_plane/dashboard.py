@@ -1,9 +1,21 @@
-"""Minimal embedded web dashboard.
+"""Embedded multi-page web UI — zero build step, zero assets.
 
 The reference embeds a full React SPA in its binary (web/client, 302 TS
-files, ui_embed.go:15); this is the TPU build's v0 equivalent: one static
-page served at ``/`` polling /api/ui/v1/summary and the runs API — zero
-build step, zero assets. The richer SPA is roadmap (README component map).
+files, ui_embed.go:15) with pages for dashboard, nodes, executions,
+workflows (DAG viz), reasoners, DID explorer and credentials. This is the
+TPU build's equivalent page inventory as ONE hash-routed HTML document
+driven entirely by the existing REST/SSE surface:
+
+  #/          dashboard   /api/ui/v1/summary + /api/v1/nodes
+  #/nodes     nodes       /api/v1/nodes (+ per-node detail w/ engine stats)
+  #/execs     executions  /api/v1/executions (+ detail, live SSE tail)
+  #/runs      workflows   /api/v1/runs → /api/v1/workflows/{run}/dag (SVG DAG)
+  #/reasoners reasoners   /api/v1/reasoners (+ per-target metrics)
+  #/did       DID / VC    /api/v1/did/* + /api/v1/vc/verify (paste-to-verify)
+  #/memory    memory      /api/v1/memory?scope=... browser
+
+(The reference's packages page manages `af install`ed bundles; package state
+here is CLI-local — see cli/packages.py — so there is no server API to render.)
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -11,54 +23,296 @@ DASHBOARD_HTML = """<!doctype html>
 <head>
 <meta charset="utf-8"><title>agentfield_tpu</title>
 <style>
-  body { font-family: ui-monospace, monospace; background: #0d1117; color: #c9d1d9;
-         max-width: 960px; margin: 2rem auto; padding: 0 1rem; }
-  h1 { color: #58a6ff; font-size: 1.3rem; }
-  .cards { display: flex; gap: 1rem; flex-wrap: wrap; }
-  .card { background: #161b22; border: 1px solid #30363d; border-radius: 8px;
-          padding: 0.8rem 1.2rem; min-width: 130px; }
-  .card .num { font-size: 1.6rem; color: #58a6ff; }
-  table { width: 100%; border-collapse: collapse; margin-top: 1rem; }
-  th, td { text-align: left; padding: 0.35rem 0.6rem; border-bottom: 1px solid #21262d;
-           font-size: 0.85rem; }
-  .completed { color: #3fb950; } .failed, .timeout { color: #f85149; }
-  .running, .queued { color: #d29922; } .active { color: #3fb950; }
-  .inactive { color: #8b949e; }
-  small { color: #8b949e; }
+  :root { --bg:#0d1117; --panel:#161b22; --line:#30363d; --fg:#c9d1d9;
+          --dim:#8b949e; --blue:#58a6ff; --green:#3fb950; --red:#f85149;
+          --amber:#d29922; }
+  body { font-family: ui-monospace, SFMono-Regular, monospace; background:var(--bg);
+         color:var(--fg); max-width:1100px; margin:1.2rem auto; padding:0 1rem; }
+  nav { display:flex; gap:0.2rem; border-bottom:1px solid var(--line);
+        margin-bottom:1rem; flex-wrap:wrap; }
+  nav a { color:var(--dim); text-decoration:none; padding:0.45rem 0.8rem; }
+  nav a.on { color:var(--blue); border-bottom:2px solid var(--blue); }
+  h1 { color:var(--blue); font-size:1.15rem; display:inline-block; margin:0 1rem 0 0; }
+  .cards { display:flex; gap:1rem; flex-wrap:wrap; margin:0.5rem 0 1rem; }
+  .card { background:var(--panel); border:1px solid var(--line); border-radius:8px;
+          padding:0.7rem 1.1rem; min-width:120px; }
+  .card .num { font-size:1.5rem; color:var(--blue); }
+  table { width:100%; border-collapse:collapse; margin-top:0.6rem; }
+  th, td { text-align:left; padding:0.32rem 0.55rem; border-bottom:1px solid #21262d;
+           font-size:0.84rem; vertical-align:top; }
+  tr.click { cursor:pointer; } tr.click:hover td { background:#1c2128; }
+  .completed,.active,.ok { color:var(--green); } .failed,.timeout,.error { color:var(--red); }
+  .running,.queued,.starting { color:var(--amber); } .inactive,.stopping { color:var(--dim); }
+  small, .dim { color:var(--dim); }
+  pre { background:var(--panel); border:1px solid var(--line); border-radius:6px;
+        padding:0.6rem; overflow-x:auto; font-size:0.8rem; white-space:pre-wrap; }
+  input, textarea, select, button {
+        background:var(--panel); color:var(--fg); border:1px solid var(--line);
+        border-radius:6px; padding:0.35rem 0.5rem; font-family:inherit; font-size:0.84rem; }
+  textarea { width:100%; min-height:90px; }
+  button { cursor:pointer; } button:hover { border-color:var(--blue); }
+  svg text { font-family:inherit; }
+  .row { display:flex; gap:1rem; align-items:baseline; flex-wrap:wrap; margin:0.4rem 0; }
+  #live { color:var(--green); font-size:0.78rem; }
 </style>
 </head>
 <body>
-<h1>agentfield_tpu</h1>
-<div class="cards" id="cards"></div>
-<h2 style="font-size:1rem">nodes</h2><table id="nodes"></table>
-<h2 style="font-size:1rem">recent runs</h2><table id="runs"></table>
+<div><h1>agentfield_tpu</h1><span id="live"></span></div>
+<nav id="nav"></nav>
+<div id="page"></div>
 <small id="ts"></small>
 <script>
-async function refresh() {
-  try {
-    const s = await (await fetch('/api/ui/v1/summary')).json();
-    const n = await (await fetch('/api/v1/nodes')).json();
-    const ex = s.executions_by_status;
-    document.getElementById('cards').innerHTML = [
-      ['nodes', s.nodes.active + '/' + s.nodes.total],
-      ['models', s.nodes.models],
-      ['completed', ex.completed], ['failed', ex.failed + ex.timeout],
-      ['running', ex.running + ex.queued], ['queue', s.queue_depth],
-    ].map(([k, v]) => `<div class="card"><div class="num">${v}</div>${k}</div>`).join('');
-    document.getElementById('nodes').innerHTML =
-      '<tr><th>node</th><th>kind</th><th>status</th><th>components</th></tr>' +
-      n.nodes.map(x => `<tr><td>${x.node_id}</td><td>${x.kind}</td>
-        <td class="${x.status}">${x.status}</td>
-        <td>${(x.reasoners||[]).length + (x.skills||[]).length}</td></tr>`).join('');
-    document.getElementById('runs').innerHTML =
-      '<tr><th>run</th><th>status</th><th>executions</th><th>targets</th></tr>' +
-      s.recent_runs.map(r => `<tr><td>${r.run_id}</td>
-        <td class="${r.overall_status}">${r.overall_status}</td>
-        <td>${r.executions}</td><td>${r.targets.join(', ')}</td></tr>`).join('');
-    document.getElementById('ts').textContent = 'refreshed ' + new Date().toLocaleTimeString();
-  } catch (e) { document.getElementById('ts').textContent = 'refresh failed: ' + e; }
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? '').replace(/[&<>"']/g, c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const J = async (url, opts) => { const r = await fetch(url, opts);
+  if (!r.ok) throw new Error(url + ' -> ' + r.status); return r.json(); };
+const fmtT = (t) => t ? new Date(t * 1000).toLocaleTimeString() : '';
+const stat = (s) => `<span class="${esc(s)}">${esc(s)}</span>`;
+
+const PAGES = [['','dashboard'],['nodes','nodes'],['execs','executions'],
+  ['runs','workflows'],['reasoners','reasoners'],['did','did / vc'],['memory','memory']];
+function nav() {
+  const cur = location.hash.replace(/^#\\/?/, '').split('/')[0];
+  $('nav').innerHTML = PAGES.map(([p, label]) =>
+    `<a href="#/${p}" class="${cur === p ? 'on' : ''}">${label}</a>`).join('');
 }
-refresh(); setInterval(refresh, 3000);
+
+let timer = null, sse = null;
+function setRefresh(fn, ms) {
+  if (timer) clearInterval(timer); timer = null;
+  if (sse) { sse.close(); sse = null; $('live').textContent = ''; }
+  if (fn && ms) timer = setInterval(fn, ms);
+}
+const done = () => $('ts').textContent = 'refreshed ' + new Date().toLocaleTimeString();
+
+// ---- dashboard --------------------------------------------------------
+async function pgDash() {
+  const s = await J('/api/ui/v1/summary'), n = await J('/api/v1/nodes');
+  const ex = s.executions_by_status;
+  $('page').innerHTML = `
+    <div class="cards">${[['nodes', s.nodes.active + '/' + s.nodes.total],
+      ['models', s.nodes.models], ['completed', ex.completed],
+      ['failed', ex.failed + ex.timeout], ['running', ex.running + ex.queued],
+      ['queue', s.queue_depth]]
+      .map(([k, v]) => `<div class="card"><div class="num">${v}</div>${k}</div>`).join('')}</div>
+    <h2 style="font-size:1rem">nodes</h2><table>${n.nodes.map(x =>
+      `<tr class="click" data-go="#/nodes/${esc(x.node_id)}">
+       <td>${esc(x.node_id)}</td><td>${esc(x.kind)}</td><td>${stat(x.status)}</td>
+       <td>${(x.reasoners || []).length + (x.skills || []).length} components</td></tr>`).join('')}</table>
+    <h2 style="font-size:1rem">recent runs</h2><table>${s.recent_runs.map(r =>
+      `<tr class="click" data-go="#/runs/${esc(r.run_id)}">
+       <td>${esc(r.run_id)}</td><td>${stat(r.overall_status)}</td>
+       <td>${r.executions} exec</td><td class="dim">${esc(r.targets.join(', '))}</td></tr>`).join('')}</table>`;
+  done();
+}
+
+// ---- nodes ------------------------------------------------------------
+async function pgNodes(id) {
+  if (id) {
+    const n = (await J('/api/v1/nodes/' + id)).node;
+    const hb = n.metadata && n.metadata.stats ? n.metadata.stats : null;
+    $('page').innerHTML = `
+      <div class="row"><b>${esc(n.node_id)}</b> ${stat(n.status)}
+        <span class="dim">${esc(n.kind)} @ ${esc(n.base_url)}</span>
+        <span class="dim">heartbeat ${fmtT(n.last_heartbeat)}</span></div>
+      <div class="row dim">did: ${esc(n.did || '—')}</div>
+      ${hb ? `<h3 style="font-size:0.9rem">engine stats</h3><pre>${esc(JSON.stringify(hb, null, 1))}</pre>` : ''}
+      <h3 style="font-size:0.9rem">components</h3>
+      <table><tr><th>id</th><th>kind</th><th>description</th><th>did</th></tr>
+      ${[...(n.reasoners || []), ...(n.skills || [])].map(c =>
+        `<tr><td>${esc(c.id)}</td><td>${esc(c.kind)}</td><td class="dim">${esc(c.description)}</td>
+         <td class="dim">${esc((c.did || '').slice(0, 24))}…</td></tr>`).join('')}</table>`;
+  } else {
+    const n = await J('/api/v1/nodes');
+    $('page').innerHTML = `<table><tr><th>node</th><th>kind</th><th>status</th>
+      <th>reasoners</th><th>skills</th><th>last heartbeat</th></tr>
+      ${n.nodes.map(x => `<tr class="click" data-go="#/nodes/${esc(x.node_id)}">
+        <td>${esc(x.node_id)}</td><td>${esc(x.kind)}</td><td>${stat(x.status)}</td>
+        <td>${(x.reasoners || []).length}</td><td>${(x.skills || []).length}</td>
+        <td class="dim">${fmtT(x.last_heartbeat)}</td></tr>`).join('')}</table>`;
+  }
+  done();
+}
+
+// ---- executions -------------------------------------------------------
+async function pgExecs(id) {
+  if (id) {
+    const e = await J('/api/v1/executions/' + id);
+    $('page').innerHTML = `
+      <div class="row"><b>${esc(e.execution_id)}</b> ${stat(e.status)}
+        <span class="dim">${esc(e.target)}</span>
+        <a href="#/runs/${esc(e.run_id)}">run ${esc(e.run_id)}</a></div>
+      <h3 style="font-size:0.9rem">input</h3><pre>${esc(JSON.stringify(e.input, null, 1))}</pre>
+      <h3 style="font-size:0.9rem">result</h3><pre>${esc(JSON.stringify(e.result, null, 1))}</pre>
+      ${e.error ? `<h3 style="font-size:0.9rem" class="error">error</h3><pre>${esc(e.error)}</pre>` : ''}
+      ${(e.notes || []).length ? `<h3 style="font-size:0.9rem">notes</h3><pre>${esc(
+        e.notes.map(n => JSON.stringify(n)).join('\\n'))}</pre>` : ''}`;
+    done(); return;
+  }
+  const render = async () => {
+    const d = await J('/api/v1/executions?limit=50');
+    $('page').innerHTML = `<table><tr><th>execution</th><th>target</th><th>status</th>
+      <th>run</th><th>created</th></tr>
+      ${d.executions.map(e => `<tr class="click" data-go="#/execs/${esc(e.execution_id)}">
+        <td>${esc(e.execution_id)}</td><td>${esc(e.target)}</td><td>${stat(e.status)}</td>
+        <td class="dim">${esc(e.run_id)}</td><td class="dim">${fmtT(e.created_at)}</td></tr>`).join('')}</table>`;
+    done();
+  };
+  await render();
+  sse = new EventSource('/api/v1/events/executions');
+  sse.onmessage = () => { $('live').textContent = '· live'; render(); };
+}
+
+// ---- workflows / DAG --------------------------------------------------
+function dagSvg(dag) {
+  const nodes = dag.nodes, byId = {};
+  nodes.forEach(n => byId[n.execution_id] = n);
+  const depth = {}, children = {};
+  nodes.forEach(n => {
+    const p = n.parent_execution_id;
+    (children[p] = children[p] || []).push(n.execution_id);
+  });
+  const roots = nodes.filter(n => !n.parent_execution_id || !byId[n.parent_execution_id]);
+  const layers = []; let frontier = roots.map(n => n.execution_id); const seen = {};
+  while (frontier.length) {
+    layers.push(frontier); frontier.forEach(id => seen[id] = layers.length - 1);
+    frontier = frontier.flatMap(id => children[id] || []).filter(id => !(id in seen));
+  }
+  const W = 170, H = 52, GX = 30, GY = 26, pos = {};
+  layers.forEach((ids, li) => ids.forEach((id, i) =>
+    pos[id] = { x: 20 + i * (W + GX), y: 16 + li * (H + GY) }));
+  const colors = { completed: 'var(--green)', failed: 'var(--red)', timeout: 'var(--red)',
+                   running: 'var(--amber)', queued: 'var(--amber)' };
+  const edges = nodes.filter(n => n.parent_execution_id && pos[n.parent_execution_id])
+    .map(n => { const a = pos[n.parent_execution_id], b = pos[n.execution_id];
+      return `<line x1="${a.x + W / 2}" y1="${a.y + H}" x2="${b.x + W / 2}" y2="${b.y}"
+        stroke="var(--line)" stroke-width="1.5"/>`; }).join('');
+  const boxes = nodes.filter(n => pos[n.execution_id]).map(n => { const p = pos[n.execution_id];
+    return `<g class="click" data-go="#/execs/${esc(n.execution_id)}" cursor="pointer">
+      <rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="7" fill="var(--panel)"
+        stroke="${colors[n.status] || 'var(--line)'}" stroke-width="1.6"/>
+      <text x="${p.x + 9}" y="${p.y + 20}" fill="var(--fg)" font-size="11">${esc(n.target)}</text>
+      <text x="${p.x + 9}" y="${p.y + 38}" fill="${colors[n.status] || 'var(--dim)'}"
+        font-size="10">${esc(n.status)}</text></g>`; }).join('');
+  const w = Math.max(...Object.values(pos).map(p => p.x + W + 20), 300);
+  const h = Math.max(...Object.values(pos).map(p => p.y + H + 20), 120);
+  return `<svg width="${w}" height="${h}" id="dag">${edges}${boxes}</svg>`;
+}
+async function pgRuns(id) {
+  if (id) {
+    const dag = await J('/api/v1/workflows/' + id + '/dag');
+    $('page').innerHTML = `<div class="row"><b>run ${esc(id)}</b>
+      ${stat(dag.overall_status)} <span class="dim">${dag.nodes.length} executions</span>
+      <button id="chainbtn">verify VC chain</button></div>
+      <div id="chain"></div>${dagSvg(dag)}`;
+    $('chainbtn').onclick = () => vcChain(id);
+    done(); return;
+  }
+  const d = await J('/api/v1/runs');
+  $('page').innerHTML = `<table><tr><th>run</th><th>status</th><th>executions</th>
+    <th>started</th></tr>${d.runs.map(r =>
+    `<tr class="click" data-go="#/runs/${esc(r.run_id)}">
+     <td>${esc(r.run_id)}</td><td>${stat(r.overall_status)}</td>
+     <td>${r.executions}</td><td class="dim">${fmtT(r.started_at)}</td></tr>`).join('')}</table>`;
+  done();
+}
+async function vcChain(runId) {
+  try { const c = await J('/api/v1/vc/workflows/' + runId);
+    $('chain').innerHTML = `<pre>${esc(JSON.stringify(c, null, 1))}</pre>`; }
+  catch (e) { $('chain').innerHTML = `<pre class="error">${esc(e)}</pre>`; }
+}
+
+// ---- reasoners --------------------------------------------------------
+async function pgReasoners() {
+  const d = await J('/api/v1/reasoners');
+  const rows = await Promise.all(d.reasoners.map(async r => {
+    let m = null;
+    try { m = await J('/api/v1/reasoners/' + r.node_id + '.' + r.id + '/metrics'); }
+    catch (e) {}
+    const d50 = m && m.duration_s && m.duration_s.p50 != null ? m.duration_s : null;
+    return `<tr><td>${esc(r.node_id)}.${esc(r.id)}</td><td class="dim">${esc(r.description)}</td>
+      <td>${m ? m.executions : '—'}</td>
+      <td>${m && m.success_rate != null ? (m.success_rate * 100).toFixed(0) + '%' : '—'}</td>
+      <td>${d50 ? (d50.p50 * 1000).toFixed(0) + ' / ' + (d50.p95 * 1000).toFixed(0) : '—'}</td></tr>`;
+  }));
+  $('page').innerHTML = `<table><tr><th>reasoner</th><th>description</th><th>calls</th>
+    <th>success</th><th>p50 / p95 ms</th></tr>${rows.join('')}</table>`;
+  done();
+}
+
+// ---- DID / VC ---------------------------------------------------------
+async function pgDid() {
+  let org = null; try { org = await J('/api/v1/did/org'); } catch (e) {}
+  const n = await J('/api/v1/nodes');
+  $('page').innerHTML = `
+    <h3 style="font-size:0.9rem">organization</h3>
+    <pre>${esc(org ? JSON.stringify(org, null, 1) : 'DID layer disabled')}</pre>
+    <h3 style="font-size:0.9rem">node identities</h3>
+    <table><tr><th>node</th><th>did</th></tr>${n.nodes.map(x =>
+      `<tr><td>${esc(x.node_id)}</td><td class="dim">${esc(x.did || '—')}</td></tr>`).join('')}</table>
+    <h3 style="font-size:0.9rem">verify a credential</h3>
+    <textarea id="vcin" placeholder='paste a verifiable credential JSON'></textarea>
+    <div class="row"><button onclick="vcVerify()">verify</button><span id="vcout"></span></div>`;
+  done();
+}
+async function vcVerify() {
+  try {
+    const vc = JSON.parse($('vcin').value);
+    const r = await J('/api/v1/vc/verify', { method: 'POST',
+      headers: { 'Content-Type': 'application/json' }, body: JSON.stringify({ vc }) });
+    $('vcout').innerHTML = r.valid ? '<span class="ok">valid ✓</span>'
+      : `<span class="error">invalid: ${esc(r.reason || '')}</span>`;
+  } catch (e) { $('vcout').innerHTML = `<span class="error">${esc(e)}</span>`; }
+}
+
+// ---- memory -----------------------------------------------------------
+async function pgMemory() {
+  const q = location.hash.split('?')[1] || '';
+  const params = new URLSearchParams(q);
+  const scope = params.get('scope') || 'global';
+  const sid = params.get('scope_id') || '';
+  const url = '/api/v1/memory?scope=' + scope + (sid ? '&scope_id=' + encodeURIComponent(sid) : '');
+  let items = {};
+  let err = null;
+  try { items = (await J(url)).items || {}; } catch (e) { err = e; }
+  const keys = Object.keys(items);
+  $('page').innerHTML = `
+    <div class="row">scope: ${['global', 'session', 'actor', 'workflow'].map(s =>
+      `<a href="#/memory?scope=${s}" class="${s === scope ? 'on' : 'dim'}">${s}</a>`).join(' ')}
+      ${scope !== 'global' ? `<input id="sid" placeholder="scope_id" value="${esc(sid)}">
+        <button id="sidload">load</button>` : ''}
+    </div>
+    ${err ? `<p class="dim">${esc(err.message || err)}</p>` : `
+    <table><tr><th>key</th><th>value</th></tr>
+    ${keys.map(k => `<tr><td>${esc(k)}</td>
+      <td class="dim"><pre style="margin:0">${esc(JSON.stringify(items[k])).slice(0, 400)}</pre></td></tr>`).join('')}
+    </table>${keys.length ? '' : '<p class="dim">no keys in scope</p>'}`}`;
+  if ($('sidload')) $('sidload').onclick = () =>
+    location.hash = '#/memory?scope=' + scope + '&scope_id=' + encodeURIComponent($('sid').value);
+  done();
+}
+
+// ---- router -----------------------------------------------------------
+async function route() {
+  nav(); setRefresh(null, 0);
+  const parts = location.hash.replace(/^#\\/?/, '').split('?')[0].split('/');
+  const [p, id] = [parts[0], parts.slice(1).join('/') || null];
+  try {
+    if (p === 'nodes') { await pgNodes(id); setRefresh(() => pgNodes(id), 4000); }
+    else if (p === 'execs') await pgExecs(id);
+    else if (p === 'runs') { await pgRuns(id); if (id) setRefresh(() => pgRuns(id), 4000); }
+    else if (p === 'reasoners') { await pgReasoners(); setRefresh(pgReasoners, 6000); }
+    else if (p === 'did') await pgDid();
+    else if (p === 'memory') await pgMemory();
+    else { await pgDash(); setRefresh(pgDash, 3000); }
+  } catch (e) { $('page').innerHTML = `<pre class="error">${esc(e)}</pre>`; }
+}
+document.addEventListener('click', (e) => {
+  const el = e.target.closest && e.target.closest('[data-go]');
+  if (el) location.hash = el.getAttribute('data-go');
+});
+window.addEventListener('hashchange', route);
+route();
 </script>
 </body>
 </html>

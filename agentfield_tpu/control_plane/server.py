@@ -235,10 +235,46 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     # -- nodes ----------------------------------------------------------
 
+    async def _resolve_callback(candidates: list, fallback, node_id) -> str | None:
+        """Probe candidate callback URLs (GET /health, 1s budget each) and
+        pick the first that answers 200 AND identifies itself as the
+        registering node — the reference's registration-time callback
+        discovery (nodes.go:205-276 probeCandidate /
+        resolveCallbackCandidates), hardened: identity-checking the body
+        means a loopback candidate can never be satisfied by some unrelated
+        process that happens to share the port. All-unreachable keeps the
+        declared base_url: the agent may simply not be routable *yet* (it is
+        still inside its own registration call for in-process test
+        topologies), and the health monitor owns liveness from here on."""
+        import aiohttp as _aiohttp
+
+        for cand in candidates:
+            if not isinstance(cand, str) or not cand.startswith("http"):
+                continue
+            try:
+                async with _aiohttp.ClientSession(
+                    timeout=_aiohttp.ClientTimeout(total=1.0)
+                ) as s:
+                    async with s.get(cand.rstrip("/") + "/health") as r:
+                        if r.status != 200:
+                            continue
+                        doc = await r.json()
+                        if isinstance(doc, dict) and doc.get("node_id") == node_id:
+                            return cand
+            except Exception:
+                continue
+        return fallback
+
     @routes.post("/api/v1/nodes")
     async def register_node(req: web.Request):
         try:
-            node = cp.registry.register(await _json_dict(req, allow_empty=False))
+            body = await _json_dict(req, allow_empty=False)
+            cands = body.get("callback_candidates")
+            if isinstance(cands, list) and cands:
+                body["base_url"] = await _resolve_callback(
+                    cands, body.get("base_url"), body.get("node_id")
+                )
+            node = cp.registry.register(body)
         except RegistryError as e:
             return _json_error(e.status, e.message)
         except (_BadBody, TypeError) as e:

@@ -29,6 +29,105 @@ async def test_register_heartbeat_list():
 
 
 @async_test
+async def test_register_probes_callback_candidates():
+    """Registration-time callback discovery (reference nodes.go:205-276):
+    the control plane probes each candidate URL and stores the first
+    reachable one as base_url instead of trusting the declaration blindly."""
+    async with CPHarness() as h:
+        # a live /health endpoint identifying itself as the registering node
+        live_port = free_port()
+        app = web.Application()
+        app.router.add_get(
+            "/health", lambda _r: web.json_response({"status": "ok", "node_id": "probed"})
+        )
+        # an imposter service on another port: answers /health but with a
+        # DIFFERENT node identity — must not be selected
+        imposter_port = free_port()
+        imp = web.Application()
+        imp.router.add_get(
+            "/health", lambda _r: web.json_response({"status": "ok", "node_id": "someone-else"})
+        )
+        imp_runner = web.AppRunner(imp)
+        await imp_runner.setup()
+        await web.TCPSite(imp_runner, "127.0.0.1", imposter_port).start()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", live_port).start()
+        dead = f"http://127.0.0.1:{free_port()}"
+        live = f"http://127.0.0.1:{live_port}"
+        try:
+            async with h.http.post(
+                "/api/v1/nodes",
+                json={
+                    "node_id": "probed",
+                    "base_url": dead,  # declared URL is dead
+                    "callback_candidates": [
+                        dead,
+                        f"http://127.0.0.1:{imposter_port}",  # wrong identity
+                        live,
+                    ],
+                    "reasoners": [{"id": "r"}],
+                },
+            ) as r:
+                assert r.status == 201
+                doc = await r.json()
+            # probe skipped the dead AND the imposter, picked the live one
+            assert doc["node"]["base_url"] == live
+            # no candidates → declared base_url trusted as before
+            async with h.http.post(
+                "/api/v1/nodes",
+                json={"node_id": "plain", "base_url": dead, "reasoners": [{"id": "r"}]},
+            ) as r:
+                assert r.status == 201
+                assert (await r.json())["node"]["base_url"] == dead
+            # all candidates dead → falls back to the declared base_url
+            async with h.http.post(
+                "/api/v1/nodes",
+                json={
+                    "node_id": "unreachable",
+                    "base_url": dead,
+                    "callback_candidates": [f"http://127.0.0.1:{free_port()}"],
+                    "reasoners": [{"id": "r"}],
+                },
+            ) as r:
+                assert r.status == 201
+                assert (await r.json())["node"]["base_url"] == dead
+        finally:
+            await runner.cleanup()
+            await imp_runner.cleanup()
+
+
+@async_test
+async def test_sdk_registration_sends_candidates():
+    """The SDK advertises its candidate callback URLs and the stored
+    base_url is one of them (probed reachable — the agent's server is up
+    before registration)."""
+    from agentfield_tpu.sdk.agent import Agent
+
+    async with CPHarness() as h:
+        app = Agent("cand-agent", h.base_url)
+
+        @app.reasoner()
+        async def ping() -> str:
+            return "pong"
+
+        await app.start()
+        try:
+            cands = app._callback_candidates()
+            assert f"http://127.0.0.1:{app.port}" in cands
+            async with h.http.get("/api/v1/nodes/cand-agent") as r:
+                node = (await r.json())["node"]
+            assert node["base_url"] in cands
+            # and the gateway can actually reach it
+            async with h.http.post(
+                "/api/v1/execute/cand-agent.ping", json={"input": {}}
+            ) as r:
+                assert (await r.json())["status"] == "completed"
+        finally:
+            await app.stop()
+
+
+@async_test
 async def test_sync_execute_direct_200():
     async with CPHarness() as h:
         await h.register_agent()

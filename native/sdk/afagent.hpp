@@ -148,6 +148,74 @@ inline std::string json_escape(const std::string& s) {
     return out;
 }
 
+// Scan helpers for the narrow, known response formats of the control plane
+// (json.dumps with default separators: `"key": value`). Not a JSON parser —
+// the SDK stays dependency-free, and tests pin the wire format.
+inline std::string json_scan_string(const std::string& body, const std::string& key,
+                                    size_t from = 0, size_t* end_out = nullptr) {
+    std::string needle = "\"" + key + "\": \"";
+    size_t at = body.find(needle, from);
+    if (at == std::string::npos) return "";
+    size_t start = at + needle.size();
+    std::string out;
+    for (size_t i = start; i < body.size(); ++i) {
+        char c = body[i];
+        if (c == '\\' && i + 1 < body.size()) {
+            char n = body[++i];
+            switch (n) {
+                case 'n': out += '\n'; break;
+                case 't': out += '\t'; break;
+                case 'r': out += '\r'; break;
+                case 'u': {  // decode BMP escapes as UTF-8; malformed hex
+                    // passes through literally and surrogate halves are
+                    // dropped (never emit invalid UTF-8, never throw —
+                    // std::stoul on bad input would std::terminate the agent)
+                    unsigned cp = 0;
+                    bool valid = i + 4 < body.size();
+                    for (int k = 1; valid && k <= 4; ++k) {
+                        char h = body[i + k];
+                        cp <<= 4;
+                        if (h >= '0' && h <= '9') cp |= h - '0';
+                        else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                        else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                        else valid = false;
+                    }
+                    if (!valid) { out += "\\u"; break; }
+                    i += 4;
+                    if (cp >= 0xD800 && cp <= 0xDFFF) break;  // surrogate half
+                    if (cp < 0x80) out += (char)cp;
+                    else if (cp < 0x800) {
+                        out += (char)(0xC0 | (cp >> 6));
+                        out += (char)(0x80 | (cp & 0x3F));
+                    } else {
+                        out += (char)(0xE0 | (cp >> 12));
+                        out += (char)(0x80 | ((cp >> 6) & 0x3F));
+                        out += (char)(0x80 | (cp & 0x3F));
+                    }
+                    break;
+                }
+                default: out += n;
+            }
+        } else if (c == '"') {
+            if (end_out) *end_out = i;
+            return out;
+        } else {
+            out += c;
+        }
+    }
+    return out;
+}
+
+// Result of an ai() call (the reference Go SDK's ai.Client response role,
+// sdk/go/ai/client.go — here served by an in-tree TPU model node).
+struct AiResponse {
+    bool ok = false;
+    std::string error;   // failure detail when !ok
+    std::string text;    // decoded completion text
+    std::string model;   // serving model name
+    std::string raw;     // full execution response JSON (tokens, logprobs, …)
+};
+
 // Handler: raw request-body JSON in, JSON value string out.
 using Handler = std::function<std::string(const std::string& body)>;
 
@@ -164,6 +232,72 @@ class Agent {
     HttpResponse execute(const std::string& target, const std::string& input_json) {
         return http_request("POST", cp_ + "/api/v1/execute/" + target,
                             "{\"input\":" + input_json + "}");
+    }
+
+    // LLM call through the gateway to an in-tree model node — the second-
+    // language SDK's ai() (reference: sdk/go/ai/client.go + Agent.ai()).
+    // `model_node` pins a node id; empty resolves the first active
+    // kind=model node. Retries 503 backpressure with capped backoff.
+    AiResponse ai(const std::string& prompt, int max_new_tokens = 64,
+                  double temperature = 0.0, std::string model_node = "") {
+        AiResponse out;
+        if (model_node.empty()) {
+            auto nodes = http_request("GET", cp_ + "/api/v1/nodes", "");
+            if (nodes.status != 200) {
+                out.error = "list_nodes failed: " + std::to_string(nodes.status);
+                return out;
+            }
+            // Scan node blocks: each starts at "node_id"; pick the first
+            // whose block carries kind=model and status=active.
+            size_t pos = 0;
+            while (true) {
+                size_t at = nodes.body.find("\"node_id\": \"", pos);
+                if (at == std::string::npos) break;
+                size_t next = nodes.body.find("\"node_id\": \"", at + 12);
+                std::string block = nodes.body.substr(
+                    at, (next == std::string::npos ? nodes.body.size() : next) - at);
+                if (block.find("\"kind\": \"model\"") != std::string::npos &&
+                    block.find("\"status\": \"active\"") != std::string::npos) {
+                    model_node = json_scan_string(block, "node_id");
+                    break;
+                }
+                pos = at + 12;
+            }
+            if (model_node.empty()) {
+                out.error = "no active model node registered";
+                return out;
+            }
+        }
+        std::ostringstream body;
+        body << "{\"prompt\":\"" << json_escape(prompt)
+             << "\",\"max_new_tokens\":" << max_new_tokens
+             << ",\"temperature\":" << temperature << "}";
+        HttpResponse resp;
+        int delay_ms = 200;
+        for (int attempt = 0; attempt < 6; ++attempt) {
+            resp = execute(model_node + ".generate", body.str());
+            bool backpressure =
+                resp.status == 503 ||
+                (resp.body.find("QueueFullError") != std::string::npos &&
+                 resp.body.find("\"status\": \"failed\"") != std::string::npos);
+            if (!backpressure) break;
+            std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+            if (delay_ms < 5000) delay_ms *= 2;
+        }
+        out.raw = resp.body;
+        if (resp.status != 200) {
+            out.error = "gateway returned " + std::to_string(resp.status);
+            return out;
+        }
+        if (json_scan_string(resp.body, "status") != "completed") {
+            out.error = json_scan_string(resp.body, "error");
+            if (out.error.empty()) out.error = "execution did not complete";
+            return out;
+        }
+        out.text = json_scan_string(resp.body, "text");
+        out.model = json_scan_string(resp.body, "model");
+        out.ok = true;
+        return out;
     }
 
     int port() const { return port_; }

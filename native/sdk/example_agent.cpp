@@ -55,6 +55,18 @@ int main(int argc, char** argv) {
         },
         "Sum integers in the input array (C++ SDK demo)");
 
+    agent.register_reasoner(
+        "cpp_ai_greet",
+        [&agent](const std::string&) {
+            // C++ ai() parity: resolve a model node, generate, return the
+            // completion (reference Go SDK: ai.Client).
+            afield::AiResponse r = agent.ai("Hello from C++", 6, 0.0);
+            if (!r.ok) return std::string("{\"error\":\"") + afield::json_escape(r.error) + "\"}";
+            return std::string("{\"text\":\"") + afield::json_escape(r.text) +
+                   "\",\"model\":\"" + afield::json_escape(r.model) + "\"}";
+        },
+        "Greet via the TPU model node (C++ ai() demo)");
+
     agent.start();
     std::printf("[afield-cpp] %s serving on :%d against %s\n", node.c_str(), agent.port(),
                 cp.c_str());

@@ -127,3 +127,40 @@ async def test_load_generator_sync_and_async():
 
         metrics = await scrape_metrics(h.base_url)
         assert any("executions_" in k for k in metrics)
+
+
+@async_test
+async def test_nested_workflow_scenario_and_payload_sweep():
+    """Reference perf-harness parity (nested_workflow_stress.py): nested
+    depth/width fanout producing a real DAG, and a payload-size sweep."""
+    import argparse
+
+    from tools.perf.load_gen import run_scenario
+    from tools.perf.stress_agent import build_stress_agent
+
+    async with CPHarness() as h:
+        app = build_stress_agent("stress", h.base_url)
+        await app.start()
+        try:
+            ns = argparse.Namespace(
+                url=h.base_url, target="stress.fanout", requests=2, concurrency=2,
+                mode="sync", payload=None, timeout=60.0, scenario="nested",
+                depth=2, width=2, payload_bytes_sweep=None,
+            )
+            report = await run_scenario(ns)
+            assert report["success_rate"] == 1.0, report
+            assert report["scenario"]["dag_nodes_per_request"] == 7  # 1+2+4
+            # the DAG really materialized: one run holds the whole tree
+            runs = (await (await h.http.get("/api/v1/runs")).json())["runs"]
+            assert max(r["executions"] for r in runs) == 7
+
+            ns2 = argparse.Namespace(
+                url=h.base_url, target="stress.blob", requests=2, concurrency=2,
+                mode="sync", payload=None, timeout=60.0, scenario="plain",
+                depth=0, width=0, payload_bytes_sweep="64,4096",
+            )
+            sweep = await run_scenario(ns2)
+            assert [r["payload_bytes"] for r in sweep["sweep"]] == [64, 4096]
+            assert all(r["success_rate"] == 1.0 for r in sweep["sweep"])
+        finally:
+            await app.stop()

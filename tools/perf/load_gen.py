@@ -1,12 +1,18 @@
 """Async load generator for the execution gateway.
 
 Parity with the reference's perf harness (control-plane/tools/perf/
-nested_workflow_stress.py: sync/async modes, concurrency sweep, latency
+nested_workflow_stress.py: sync/async modes, concurrency sweep, nested
+depth/width scenarios, payload-size sweeps, scenario files, latency
 p50/p95/p99, status histograms, Prometheus pre/post scrape). Usage:
 
     python tools/perf/load_gen.py --url http://127.0.0.1:8800 \\
         --target mynode.myreasoner --requests 200 --concurrency 16 \\
         [--mode sync|async] [--payload '{"x":1}'] [--scrape-metrics]
+
+Scenarios (pair with tools/perf/stress_agent.py):
+    --scenario nested --depth 2 --width 3     # width^depth call tree per req
+    --payload-bytes-sweep 1024,65536,1048576  # one run per payload size
+    --scenario-file scenarios.json            # list of run configs
 
 Prints one JSON report to stdout.
 """
@@ -128,26 +134,92 @@ async def scrape_metrics(url: str) -> dict:
         return {"error": repr(e)}
 
 
+def _scenario_payload(args_ns, payload_bytes: int | None = None):
+    """Build the request payload for a scenario run."""
+    if args_ns.scenario == "nested":
+        return {
+            "depth": args_ns.depth,
+            "width": args_ns.width,
+            "payload_bytes": payload_bytes or 0,
+        }
+    if payload_bytes:
+        return {"payload_bytes": payload_bytes}
+    return json.loads(args_ns.payload) if args_ns.payload else None
+
+
+async def run_scenario(args_ns) -> dict:
+    """One or more run_load rounds per the CLI scenario flags."""
+    sweeps = (
+        [int(x) for x in args_ns.payload_bytes_sweep.split(",")]
+        if args_ns.payload_bytes_sweep
+        else [None]
+    )
+    rounds = []
+    for size in sweeps:
+        r = await run_load(
+            args_ns.url,
+            args_ns.target,
+            args_ns.requests,
+            args_ns.concurrency,
+            args_ns.mode,
+            _scenario_payload(args_ns, size),
+            timeout=args_ns.timeout,
+        )
+        if args_ns.scenario == "nested":
+            r["scenario"] = {
+                "kind": "nested",
+                "depth": args_ns.depth,
+                "width": args_ns.width,
+                "dag_nodes_per_request": sum(
+                    args_ns.width**d for d in range(args_ns.depth + 1)
+                ),
+            }
+        if size is not None:
+            r["payload_bytes"] = size
+        rounds.append(r)
+    return rounds[0] if len(rounds) == 1 else {"sweep": rounds}
+
+
 async def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default="http://127.0.0.1:8800")
-    ap.add_argument("--target", required=True)
+    ap.add_argument("--target", required=False)
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--mode", choices=("sync", "async"), default="sync")
     ap.add_argument("--payload", default=None, help="JSON input payload")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--scenario", choices=("plain", "nested"), default="plain")
+    ap.add_argument("--depth", type=int, default=1, help="nested: recursion depth")
+    ap.add_argument("--width", type=int, default=2, help="nested: fanout per level")
+    ap.add_argument(
+        "--payload-bytes-sweep",
+        default=None,
+        help="comma-separated sizes; one load round per size",
+    )
+    ap.add_argument(
+        "--scenario-file",
+        default=None,
+        help="JSON file: list of objects overriding these flags per run",
+    )
     ap.add_argument("--scrape-metrics", action="store_true")
     args = ap.parse_args()
 
-    payload = json.loads(args.payload) if args.payload else None
-    report = {}
+    report: dict = {}
     if args.scrape_metrics:
         report["metrics_before"] = await scrape_metrics(args.url)
-    report.update(
-        await run_load(
-            args.url, args.target, args.requests, args.concurrency, args.mode, payload
-        )
-    )
+    if args.scenario_file:
+        runs = []
+        for i, spec in enumerate(json.loads(Path(args.scenario_file).read_text())):
+            ns = argparse.Namespace(**{**vars(args), **spec})
+            if not ns.target:
+                ap.error(f"scenario-file entry {i} has no 'target' (and no --target default)")
+            runs.append(await run_scenario(ns))
+        report["runs"] = runs
+    else:
+        if not args.target:
+            ap.error("--target is required without --scenario-file")
+        report.update(await run_scenario(args))
     if args.scrape_metrics:
         report["metrics_after"] = await scrape_metrics(args.url)
     print(json.dumps(report, indent=2))

@@ -1,0 +1,70 @@
+"""Stress agent for the perf harness: a self-recursing fanout reasoner.
+
+The reference's nested_workflow_stress.py drives a workflow that spawns
+nested child calls; this is the agent side of that scenario for the TPU
+build. `fanout` calls itself `width` times at each of `depth` levels through
+the gateway (app.call), so a single top-level execution produces a
+(width^depth)-node DAG — exercising the async queue, DAG projection, and
+completion serialization under fan-out load.
+
+Usage:
+    python tools/perf/stress_agent.py --url http://127.0.0.1:8800 [--node stress]
+then:
+    python tools/perf/load_gen.py --url ... --target stress.fanout \\
+        --scenario nested --depth 2 --width 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from agentfield_tpu.sdk.agent import Agent
+
+
+def build_stress_agent(node_id: str, control_plane: str) -> Agent:
+    app = Agent(node_id, control_plane)
+
+    @app.reasoner(description="recursive fanout: width^depth nested calls")
+    async def fanout(depth: int = 0, width: int = 1, payload_bytes: int = 0) -> dict:
+        blob = "x" * payload_bytes
+        if depth <= 0:
+            return {"leaf": True, "bytes": len(blob)}
+        children = await asyncio.gather(
+            *(
+                app.call(
+                    f"{node_id}.fanout",
+                    {"depth": depth - 1, "width": width, "payload_bytes": payload_bytes},
+                )
+                for _ in range(width)
+            )
+        )
+        return {"depth": depth, "children": len(children), "bytes": len(blob)}
+
+    @app.reasoner(description="echo with a size-controlled response")
+    async def blob(payload_bytes: int = 0) -> dict:
+        return {"blob": "x" * payload_bytes}
+
+    return app
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:8800")
+    ap.add_argument("--node", default="stress")
+    args = ap.parse_args()
+    app = build_stress_agent(args.node, args.url)
+    await app.start()
+    print(f"stress agent '{args.node}' serving on port {app.port} against {args.url}")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await app.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -96,13 +96,16 @@ class ControlPlane:
         )
 
         from agentfield_tpu.control_plane.health import HealthMonitor
+        from agentfield_tpu.control_plane.mcp_service import MCPService
 
         self.health_monitor = HealthMonitor(self.registry, interval=health_interval)
+        self.mcp = MCPService(self.storage)
         self.cleanup_interval = cleanup_interval
         self.stale_after = stale_after
         self.retention = retention
         self._cleanup_task: asyncio.Task | None = None
         self._native_build_task: asyncio.Task | None = None
+        self._mcp_autostart_task: asyncio.Task | None = None
         self._started = False
 
     def _notify_webhook(self, ex) -> None:
@@ -117,6 +120,9 @@ class ControlPlane:
         await self.registry.start()
         await self.webhooks.start()
         await self.health_monitor.start()
+        # autostart MCP servers off the startup path: a hung child binary
+        # must not delay /health and the gateway coming up
+        self._mcp_autostart_task = asyncio.create_task(self.mcp.start_autostart())
         self._cleanup_task = asyncio.create_task(self._cleanup_loop())
         # Native scan kernel compiles off-loop; requests use numpy until
         # ready. Keep a strong reference (loop tasks are weakly held).
@@ -140,6 +146,10 @@ class ControlPlane:
             await asyncio.gather(self._native_build_task, return_exceptions=True)
         if self._admin_grpc is not None:
             self._admin_grpc.stop(grace=0)
+        if self._mcp_autostart_task:
+            self._mcp_autostart_task.cancel()
+            await asyncio.gather(self._mcp_autostart_task, return_exceptions=True)
+        await self.mcp.stop_all()
         await self.health_monitor.stop()
         await self.webhooks.stop()
         await self.registry.stop()
@@ -739,6 +749,100 @@ def create_app(cp: ControlPlane) -> web.Application:
                 "backpressure_total": cp.metrics.counter_value("gateway_backpressure_total"),
             }
         )
+
+    # -- MCP manager (reference: internal/mcp + ui mcp handlers,
+    # server.go:794-798) ------------------------------------------------
+
+    def _mcp_err(e) -> web.Response:
+        return _json_error(404 if "unknown MCP server" in str(e) else 400, str(e))
+
+    @routes.get("/api/v1/mcp/servers")
+    async def mcp_list(_req):
+        return web.json_response({"servers": cp.mcp.status()})
+
+    @routes.post("/api/v1/mcp/servers")
+    async def mcp_add(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServerSpec, MCPServiceError
+
+        try:
+            body = await _json_dict(req, allow_empty=False)
+            spec = MCPServerSpec(
+                alias=body.get("alias", ""),
+                command=body.get("command", ""),
+                args=list(body.get("args") or []),
+                env=dict(body.get("env") or {}),
+                autostart=bool(body.get("autostart", False)),
+            )
+            cp.mcp.add(spec)
+            if body.get("start", False):
+                await cp.mcp.start(spec.alias)
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        except _BadBody as e:
+            return _json_error(400, str(e))
+        return web.json_response({"status": "created", "alias": spec.alias}, status=201)
+
+    @routes.delete("/api/v1/mcp/servers/{alias}")
+    async def mcp_remove(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServiceError
+
+        try:
+            await cp.mcp.remove(req.match_info["alias"])
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        return web.json_response({"status": "removed"})
+
+    @routes.post("/api/v1/mcp/servers/{alias}/{action:start|stop|restart}")
+    async def mcp_action(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServiceError
+
+        alias, action = req.match_info["alias"], req.match_info["action"]
+        try:
+            await getattr(cp.mcp, action)(alias)
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        return web.json_response({"status": action, "alias": alias})
+
+    @routes.get("/api/v1/mcp/servers/{alias}/tools")
+    async def mcp_tools(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServiceError
+
+        try:
+            manifest = await cp.mcp.discover(
+                req.match_info["alias"], refresh=req.query.get("refresh") == "1"
+            )
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        return web.json_response(manifest)
+
+    @routes.get("/api/v1/mcp/servers/{alias}/logs")
+    async def mcp_logs(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServiceError
+
+        try:
+            lines = max(int(req.query.get("lines", "50")), 0)
+        except ValueError:
+            return _json_error(400, "lines must be an integer")
+        try:
+            lines = cp.mcp.logs(req.match_info["alias"], lines)
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        return web.json_response({"lines": lines})
+
+    @routes.post("/api/v1/mcp/servers/{alias}/skills/generate")
+    async def mcp_generate(req: web.Request):
+        from agentfield_tpu.control_plane.mcp_service import MCPServiceError
+
+        alias = req.match_info["alias"]
+        try:
+            code = await cp.mcp.generate_skills(alias)
+        except MCPServiceError as e:
+            return _mcp_err(e)
+        return web.json_response({"alias": alias, "module": code})
+
+    @routes.get("/api/ui/v1/mcp/status")
+    async def mcp_status(_req):
+        return web.json_response(cp.mcp.health_summary())
 
     # -- memory (scoped KV + vectors) ----------------------------------
 

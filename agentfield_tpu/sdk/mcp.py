@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+from collections import deque
 from pathlib import Path
 from typing import Any
 
@@ -25,7 +26,13 @@ class MCPStdioClient:
     """JSON-RPC 2.0 over a child process's stdio (MCP stdio transport:
     one JSON message per line). Request ids correlate concurrent calls."""
 
-    def __init__(self, command: str, args: list[str] | None = None, env: dict | None = None):
+    def __init__(
+        self,
+        command: str,
+        args: list[str] | None = None,
+        env: dict | None = None,
+        capture_stderr: int = 0,  # >0 → keep the last N stderr lines (CP logs)
+    ):
         self.command = command
         self.args = args or []
         self.env = env
@@ -33,6 +40,9 @@ class MCPStdioClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._reader: asyncio.Task | None = None
+        self._stderr_reader: asyncio.Task | None = None
+        self._capture_stderr = capture_stderr
+        self.stderr_lines: "deque[str]" = deque(maxlen=max(capture_stderr, 1))
         self._dead: str | None = None  # set when the reader exits; fail fast
         self.server_info: dict[str, Any] = {}
 
@@ -44,12 +54,16 @@ class MCPStdioClient:
             *self.args,
             stdin=asyncio.subprocess.PIPE,
             stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.PIPE
+            if self._capture_stderr
+            else asyncio.subprocess.DEVNULL,
             env={**os.environ, **(self.env or {})},
             limit=16 * 1024 * 1024,  # tool results can be one very long line;
             # the 64KiB default would kill readline()
         )
         self._reader = asyncio.create_task(self._read_loop())
+        if self._capture_stderr:
+            self._stderr_reader = asyncio.create_task(self._stderr_loop())
         init = await self.request(
             "initialize",
             {
@@ -62,9 +76,10 @@ class MCPStdioClient:
         await self.notify("notifications/initialized", {})
 
     async def stop(self) -> None:
-        if self._reader:
-            self._reader.cancel()
-            await asyncio.gather(self._reader, return_exceptions=True)
+        for task in (self._reader, self._stderr_reader):
+            if task:
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
         if self._proc and self._proc.returncode is None:
             self._proc.terminate()
             try:
@@ -103,6 +118,19 @@ class MCPStdioClient:
             else:
                 fut.set_result(msg.get("result"))
 
+    async def _stderr_loop(self) -> None:
+        assert self._proc and self._proc.stderr
+        while True:
+            try:
+                line = await self._proc.stderr.readline()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                return
+            if not line:
+                return
+            self.stderr_lines.append(line.decode(errors="replace").rstrip("\n"))
+
     async def _send(self, msg: dict[str, Any]) -> None:
         assert self._proc and self._proc.stdin
         self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
@@ -135,7 +163,16 @@ class MCPStdioClient:
         await self._send({"jsonrpc": "2.0", "method": method, "params": params or {}})
 
     async def list_tools(self) -> list[dict[str, Any]]:
-        return (await self.request("tools/list")).get("tools", [])
+        return ((await self.request("tools/list")) or {}).get("tools", [])
+
+    async def list_resources(self) -> list[dict[str, Any]]:
+        """resources/list — optional per the MCP spec; servers without the
+        capability answer method-not-found (or a null result), which maps to
+        an empty list."""
+        try:
+            return ((await self.request("resources/list")) or {}).get("resources", [])
+        except MCPError:
+            return []
 
     async def call_tool(self, name: str, arguments: dict[str, Any]) -> Any:
         result = await self.request("tools/call", {"name": name, "arguments": arguments})

@@ -4,6 +4,8 @@ initialize, tools/list (add + shout), tools/call."""
 import json
 import sys
 
+print("fake-mcp starting", file=sys.stderr, flush=True)
+
 TOOLS = [
     {
         "name": "add",

@@ -1,0 +1,167 @@
+"""Control-plane-side MCP manager tests: REST lifecycle, process
+supervision (crash → auto-restart), capability caching, persistence.
+
+Reference analogue: internal/mcp/manager.go (Add/Start/Stop/Status/Logs),
+process.go:155 (MonitorProcess restart), capability_discovery.go:306
+(CacheCapabilities)."""
+
+import asyncio
+import os
+import signal
+import sys
+
+from agentfield_tpu.control_plane.mcp_service import (
+    MCPServerSpec,
+    MCPService,
+    MCPServiceError,
+)
+from agentfield_tpu.control_plane.storage import SQLiteStorage
+from tests.helpers_cp import CPHarness, async_test
+
+FAKE = os.path.join(os.path.dirname(__file__), "fake_mcp_server.py")
+
+
+def _spec(alias="fake", **kw):
+    return MCPServerSpec(alias=alias, command=sys.executable, args=[FAKE], **kw)
+
+
+@async_test
+async def test_mcp_api_lifecycle():
+    async with CPHarness() as h:
+        async with h.http.post(
+            "/api/v1/mcp/servers",
+            json={
+                "alias": "calc",
+                "command": sys.executable,
+                "args": [FAKE],
+                "start": True,
+            },
+        ) as r:
+            assert r.status == 201
+        async with h.http.get("/api/v1/mcp/servers") as r:
+            [srv] = (await r.json())["servers"]
+            assert srv["state"] == "running" and srv["pid"]
+            assert srv["server_info"]["name"] == "fake-mcp"
+        async with h.http.get("/api/v1/mcp/servers/calc/tools") as r:
+            manifest = await r.json()
+            assert [t["name"] for t in manifest["tools"]] == ["add", "shout"]
+        async with h.http.post("/api/v1/mcp/servers/calc/skills/generate") as r:
+            module = (await r.json())["module"]
+            assert "def add(" in module and "calc_add" in module
+        async with h.http.get("/api/v1/mcp/servers/calc/logs") as r:
+            assert "fake-mcp starting" in (await r.json())["lines"]
+        async with h.http.post("/api/v1/mcp/servers/calc/stop") as r:
+            assert r.status == 200
+        async with h.http.get("/api/ui/v1/mcp/status") as r:
+            body = await r.json()
+            assert body["servers"]["calc"] == "stopped"
+        async with h.http.delete("/api/v1/mcp/servers/calc") as r:
+            assert r.status == 200
+        async with h.http.get("/api/v1/mcp/servers/calc/tools") as r:
+            assert r.status == 404
+
+
+@async_test
+async def test_mcp_bad_command_fails_cleanly():
+    async with CPHarness() as h:
+        async with h.http.post(
+            "/api/v1/mcp/servers",
+            json={"alias": "broken", "command": "/nonexistent-mcp", "start": True},
+        ) as r:
+            assert r.status == 400
+        async with h.http.get("/api/v1/mcp/servers") as r:
+            [srv] = (await r.json())["servers"]
+            assert srv["state"] == "failed" and srv["last_error"]
+
+
+@async_test
+async def test_mcp_supervision_restarts_crashed_server():
+    svc = MCPService(SQLiteStorage(), restart_backoff=0.05)
+    svc.add(_spec())
+    await svc.start("fake")
+    [st] = svc.status()
+    pid = st["pid"]
+    os.kill(pid, signal.SIGKILL)
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        [st] = svc.status()
+        if st["state"] == "running" and st["pid"] != pid:
+            break
+    assert st["state"] == "running" and st["restarts"] == 1
+    # discovery still works on the replacement process
+    manifest = await svc.discover("fake")
+    assert len(manifest["tools"]) == 2
+    await svc.stop_all()
+
+
+# Completes the MCP handshake (so start() succeeds), then exits — every
+# spawn "crashes" right after coming up, driving the watchdog restart path.
+_DIE_AFTER_INIT = (
+    "import json,sys\n"
+    "m=json.loads(sys.stdin.readline())\n"
+    'print(json.dumps({"jsonrpc":"2.0","id":m["id"],"result":'
+    '{"serverInfo":{"name":"dier"},"capabilities":{}}}),flush=True)\n'
+    "sys.stdin.readline()\n"  # consume the initialized notification
+)
+
+
+@async_test
+async def test_mcp_restart_budget_exhausts_to_failed():
+    svc = MCPService(SQLiteStorage(), max_restarts=2, restart_backoff=0.02)
+    svc.add(
+        MCPServerSpec(alias="dier", command=sys.executable, args=["-c", _DIE_AFTER_INIT])
+    )
+    await svc.start("dier")  # handshake succeeds; the crash comes after
+    for _ in range(200):
+        await asyncio.sleep(0.05)
+        [st] = svc.status()
+        if st["state"] == "failed":
+            break
+    assert st["state"] == "failed"
+    assert st["restarts"] == 2  # budget consumed by the watchdog, not spawn
+    assert "exited rc=" in st["last_error"]
+    await svc.stop_all()
+
+    # immediate first-spawn failure (no handshake at all) also parks failed
+    svc2 = MCPService(SQLiteStorage(), max_restarts=1, restart_backoff=0.02)
+    svc2.add(MCPServerSpec(alias="dead", command=sys.executable, args=["-c", "pass"]))
+    try:
+        await svc2.start("dead")
+    except MCPServiceError:
+        pass
+    [st] = svc2.status()
+    assert st["state"] == "failed"
+    await svc2.stop_all()
+
+
+@async_test
+async def test_mcp_capability_cache_survives_stop():
+    svc = MCPService(SQLiteStorage())
+    svc.add(_spec())
+    await svc.start("fake")
+    live = await svc.discover("fake")
+    assert live["ts"] > 0
+    await svc.stop("fake")
+    cached = await svc.discover("fake")  # stopped → served from cache
+    assert cached["ts"] == live["ts"]
+    assert [t["name"] for t in cached["tools"]] == ["add", "shout"]
+    await svc.stop_all()
+
+
+@async_test
+async def test_mcp_specs_persist_and_autostart(tmp_path):
+    db = str(tmp_path / "cp.db")
+    store1 = SQLiteStorage(db)
+    svc1 = MCPService(store1)
+    svc1.add(_spec(autostart=True))
+    store1.close()
+
+    store2 = SQLiteStorage(db)
+    svc2 = MCPService(store2)
+    [st] = svc2.status()
+    assert st["alias"] == "fake" and st["autostart"]
+    await svc2.start_autostart()
+    [st] = svc2.status()
+    assert st["state"] == "running"
+    await svc2.stop_all()
+    store2.close()

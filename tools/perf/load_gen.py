@@ -142,7 +142,7 @@ def _scenario_payload(args_ns, payload_bytes: int | None = None):
             "width": args_ns.width,
             "payload_bytes": payload_bytes or 0,
         }
-    if payload_bytes:
+    if payload_bytes is not None:
         return {"payload_bytes": payload_bytes}
     return json.loads(args_ns.payload) if args_ns.payload else None
 

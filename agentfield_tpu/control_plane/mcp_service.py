@@ -68,6 +68,11 @@ class _Managed:
         self.last_error: str | None = None
         self.started_at: float | None = None
         self.stopping = False
+        # capability-manifest summary mirrored from the storage cache, so the
+        # UI-polled status() never touches SQLite
+        self.tools = 0
+        self.resources = 0
+        self.capabilities_ts: float | None = None
 
 
 class MCPService:
@@ -89,7 +94,16 @@ class MCPService:
         self._servers: dict[str, _Managed] = {}
         for doc in (storage.config_get(_CONFIG_KEY) or {}).values():
             spec = MCPServerSpec.from_doc(doc)
-            self._servers[spec.alias] = _Managed(spec)
+            m = _Managed(spec)
+            self._apply_manifest(m, storage.config_get(_CACHE_PREFIX + spec.alias))
+            self._servers[spec.alias] = m
+
+    @staticmethod
+    def _apply_manifest(m: _Managed, manifest: dict | None) -> None:
+        if manifest:
+            m.tools = len(manifest.get("tools", []))
+            m.resources = len(manifest.get("resources", []))
+            m.capabilities_ts = manifest.get("ts")
 
     # ---- config -----------------------------------------------------------
 
@@ -133,6 +147,13 @@ class MCPService:
         m = self._get(alias)
         if m.state == "running":
             return
+        if m.watchdog and not m.watchdog.done():
+            # A crashed server's watchdog may be sleeping out its restart
+            # backoff; left alive it would respawn a SECOND, unsupervised
+            # process after this start() installs its own.
+            m.watchdog.cancel()
+            await asyncio.gather(m.watchdog, return_exceptions=True)
+            m.watchdog = None
         m.stopping = False
         m.restarts = 0
         await self._spawn(m)
@@ -208,7 +229,6 @@ class MCPService:
     def status(self) -> list[dict[str, Any]]:
         out = []
         for alias, m in sorted(self._servers.items()):
-            cached = self.storage.config_get(_CACHE_PREFIX + alias) or {}
             proc = m.client._proc if m.client else None
             out.append(
                 {
@@ -222,16 +242,16 @@ class MCPService:
                     "last_error": m.last_error,
                     "started_at": m.started_at,
                     "server_info": m.client.server_info if m.client else {},
-                    "tools": len(cached.get("tools", [])),
-                    "resources": len(cached.get("resources", [])),
-                    "capabilities_ts": cached.get("ts"),
+                    "tools": m.tools,
+                    "resources": m.resources,
+                    "capabilities_ts": m.capabilities_ts,
                 }
             )
         return out
 
     def logs(self, alias: str, lines: int = 50) -> list[str]:
         m = self._get(alias)
-        if not m.client:
+        if not m.client or lines <= 0:
             return []
         return list(m.client.stderr_lines)[-lines:]
 
@@ -260,6 +280,7 @@ class MCPService:
             raise MCPServiceError(f"discovery on {alias!r} failed: {e}") from e
         manifest = {"alias": alias, "tools": tools, "resources": resources, "ts": time.time()}
         self.storage.config_set(_CACHE_PREFIX + alias, manifest)
+        self._apply_manifest(m, manifest)
         return manifest
 
     async def generate_skills(self, alias: str) -> str:

@@ -190,8 +190,15 @@ def forward_impl(
     remat: bool = False,
     attn_impl: str = "ref",
     mesh=None,  # required (static) for attn_impl="ring"
+    embeds_override: tuple[jax.Array, jax.Array] | None = None,
 ):
     """Dense causal forward. tokens/positions: [B, S].
+
+    ``embeds_override=(inject [B, S, D], mask [B, S] bool)`` substitutes
+    non-token embeddings at masked positions (multimodal early fusion: the
+    vision tower's patch embeddings replace placeholder tokens —
+    models/vision.py; reference analogue: image parts forwarded to external
+    providers, agent_ai.py:449-520).
 
     Returns (logits [B, S, V] float32, (k, v) each [L, B, S, Kh, hd]) — the
     per-layer K/V are the scan outputs, free to collect, and are what a
@@ -200,6 +207,9 @@ def forward_impl(
     (rematerialize the layer body in backward, trading FLOPs for HBM).
     """
     x = jnp.take(params["embed"], tokens, axis=0)
+    if embeds_override is not None:
+        inject, inj_mask = embeds_override
+        x = jnp.where(inj_mask[..., None], inject.astype(x.dtype), x)
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def attend(q, k, v):

@@ -1,0 +1,146 @@
+"""Vision tower: ViT patch encoder + projector for multimodal prompts.
+
+The reference's multimodal path hands images to external providers
+(sdk/python/agentfield/agent_ai.py:449-520 classifies args and forwards
+base64 parts via litellm). Here the modality is SERVED in-tree: a compact
+ViT encodes image patches into LLM-space embeddings that the serving engine
+injects at placeholder positions of the prompt (LLaVA-style early fusion).
+
+TPU-first: patchify is a reshape (no conv unrolling), the encoder is one
+``lax.scan`` over stacked layer weights like the LM (models/llama.py), all
+matmuls land on the MXU in bf16, and the patch count is static per config so
+serving buckets stay compile-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 12
+    num_heads: int = 16
+    mlp_ratio: int = 4
+    out_dim: int = 2048  # LLM hidden size the projector maps into
+    layer_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+CONFIGS = {
+    # capacity-parity tower for the flagship 1B preset
+    "vit-base-224": VisionConfig(),
+    # hermetic test tower: compiles in seconds on CPU; out_dim matches
+    # llama-tiny's hidden_size so engine tests fuse without adapters
+    "vit-tiny": VisionConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+        num_heads=4, out_dim=128,
+    ),
+}
+
+
+def get_vision_config(name: str) -> VisionConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown vision config {name!r}; have {sorted(CONFIGS)}")
+    return CONFIGS[name]
+
+
+def init_vision_params(cfg: VisionConfig, key: jax.Array) -> Params:
+    dt = jnp.dtype(cfg.dtype)
+    d, L = cfg.hidden_size, cfg.num_layers
+    f = d * cfg.mlp_ratio
+    keys = jax.random.split(key, 8)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "patch_embed": norm(keys[0], (cfg.patch_dim, d)),
+        "pos_embed": norm(keys[1], (cfg.num_patches, d)),
+        "layers": {
+            "ln1_w": jnp.ones((L, d), dt),
+            "ln1_b": jnp.zeros((L, d), dt),
+            "ln2_w": jnp.ones((L, d), dt),
+            "ln2_b": jnp.zeros((L, d), dt),
+            "wqkv": norm(keys[2], (L, d, 3 * d)),
+            "wo": norm(keys[3], (L, d, d)),
+            "w1": norm(keys[4], (L, d, f)),
+            "w2": norm(keys[5], (L, f, d)),
+        },
+        "final_ln_w": jnp.ones((d,), dt),
+        "final_ln_b": jnp.zeros((d,), dt),
+        # two-layer GELU projector into LLM space (LLaVA-1.5-style mlp2x)
+        "proj_w1": norm(keys[6], (d, cfg.out_dim)),
+        "proj_w2": norm(keys[7], (cfg.out_dim, cfg.out_dim)),
+    }
+
+
+def _layer_norm(x: jax.Array, w: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def patchify(images: jax.Array, cfg: VisionConfig) -> jax.Array:
+    """[B, H, W, 3] float in [0, 1] → [B, num_patches, patch_dim].
+    Pure reshape/transpose — no gather, no conv."""
+    B = images.shape[0]
+    g, p = cfg.image_size // cfg.patch_size, cfg.patch_size
+    x = images.reshape(B, g, p, g, p, 3)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, g * g, cfg.patch_dim)
+
+
+def vision_encode(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """Encode images into LLM-space patch embeddings.
+
+    images: [B, image_size, image_size, 3] float32 in [0, 1]
+    returns: [B, num_patches, out_dim] in the tower dtype
+    """
+    dt = jnp.dtype(cfg.dtype)
+    x = patchify(images.astype(dt), cfg) @ params["patch_embed"]
+    x = x + params["pos_embed"]
+    B, N, d = x.shape
+    H = cfg.num_heads
+    hd = d // H
+
+    def body(x, lp):
+        h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
+        qkv = (h @ lp["wqkv"]).reshape(B, N, 3, H, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum(
+            "bnhd,bmhd->bhnm", q, k, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum(
+            "bhnm,bmhd->bnhd", probs, v, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        x = x + attn.reshape(B, N, d) @ lp["wo"]
+        h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
+        x = x + jax.nn.gelu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu((x @ params["proj_w1"]).astype(jnp.float32)).astype(x.dtype)
+    return h @ params["proj_w2"]
+
+
+vision_encode_jit = jax.jit(vision_encode, static_argnames=("cfg",))

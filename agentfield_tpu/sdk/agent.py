@@ -333,6 +333,7 @@ class Agent:
         timeout: float = 600.0,
         schema: dict[str, Any] | None = None,
         context_overflow: str = "truncate_left",
+        images: list[Any] | None = None,
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -353,6 +354,20 @@ class Agent:
         reference's failure mode, agent_ai.py:424-447). The prompt still
         gains a strict-JSON instruction (steers content quality; correctness
         comes from the mask), and the result dict gains a "parsed" key."""
+        if images:
+            if prompt is None:
+                raise ValueError("images require a text prompt")
+            images = _normalize_images(images)
+            # Each image needs an <image> marker in the prompt; unmarked
+            # images append at the end (reference: image parts are appended
+            # in argument order, agent_ai.py:449).
+            missing = len(images) - prompt.count("<image>")
+            if missing < 0:
+                raise ValueError(
+                    f"prompt has {prompt.count('<image>')} <image> markers "
+                    f"but only {len(images)} images were passed"
+                )
+            prompt = prompt + "\n<image>" * missing
         if schema is not None:
             if prompt is None:
                 raise ValueError("schema requires a text prompt")
@@ -363,6 +378,7 @@ class Agent:
         payload = {
             "prompt": prompt,
             "tokens": tokens,
+            "images": images or None,
             "max_new_tokens": max_new_tokens,
             "temperature": temperature,
             "top_k": top_k,
@@ -466,7 +482,40 @@ class Agent:
                     "schema, e.g. maxLength/maxItems)"
                 )
             result["parsed"] = parse_structured(result.get("text", ""), schema)
+        if isinstance(result, dict) and result.get("parts"):
+            from agentfield_tpu.sdk.multimodal import detect_multimodal_response
+
+            return detect_multimodal_response(result)
         return result
+
+    async def ai_with_vision(self, prompt: str, image: Any, **kw) -> dict[str, Any]:
+        """Image-understanding sugar (reference: ai_with_vision,
+        agent_ai.py:1004 — there image *generation* via providers; here the
+        served direction is image INPUT through the model node's vision
+        tower)."""
+        return await self.ai(prompt, images=[image], **kw)
+
+    async def ai_with_multimodal(self, *parts: Any, **kw) -> dict[str, Any]:
+        """Mixed-content call (reference: ai_with_multimodal,
+        agent_ai.py:1069): args classify in order — text joins the prompt,
+        images ride to the vision tower, audio raises until an audio tower
+        lands."""
+        from agentfield_tpu.sdk.multimodal import split_prompt_and_images
+
+        prompt, images = split_prompt_and_images(list(parts))
+        return await self.ai(prompt, images=images or None, **kw)
+
+    async def ai_with_audio(self, *_a, **_kw):
+        """Audio chat/TTS is not a served modality yet (reference:
+        ai_with_audio, agent_ai.py:750). Raises UnsupportedModalityError —
+        the typed content surface (sdk/multimodal.py) is already stable for
+        an audio tower to slot in."""
+        from agentfield_tpu.sdk.multimodal import UnsupportedModalityError
+
+        raise UnsupportedModalityError(
+            "audio generation/understanding needs an audio-tower model node; "
+            "text + image inputs are served today"
+        )
 
     async def ai_stream(
         self,
@@ -755,3 +804,34 @@ class Agent:
                 await self.stop()
 
         asyncio.run(main())
+
+
+def _normalize_images(items: list[Any]) -> list[dict[str, Any]]:
+    """ai(images=...) accepts ImageContent, raw bytes, file paths, pre-built
+    {"b64": ...} wire dicts, or pixel arrays; everything normalizes to the
+    model node's wire forms (base64 blob or nested array)."""
+    import base64 as _b64
+    from pathlib import Path as _Path
+
+    from agentfield_tpu.sdk.multimodal import ImageContent, classify
+
+    out: list[dict[str, Any]] = []
+    for item in items:
+        if isinstance(item, dict) and "b64" in item:
+            out.append(item)
+            continue
+        if isinstance(item, (str, _Path)):
+            item = ImageContent.from_file(item)
+        elif isinstance(item, bytes):
+            item = classify(item)
+        if isinstance(item, ImageContent):
+            out.append({"b64": _b64.b64encode(item.data).decode()})
+        elif isinstance(item, (list, tuple)) or hasattr(item, "__array__"):
+            import numpy as _np
+
+            # tolist() all the way down: a shallow list() of a 3-D ndarray
+            # would put ndarrays inside the JSON payload
+            out.append(_np.asarray(item).tolist())
+        else:
+            raise TypeError(f"cannot use {type(item).__name__} as an image input")
+    return out

@@ -1,12 +1,15 @@
-"""Typed multimodal content wrappers.
+"""Typed multimodal content wrappers + response detection.
 
 API parity with the reference's multimodal helpers (sdk/python/agentfield/
 multimodal.py: Text/Image/Audio/File content types, auto-detection of
 multimodal arguments, response wrapping with save helpers —
-agent_ai.py:449 `_process_multimodal_args`). The TPU build's in-tree models
-are text-only this round, so non-text content raises a clear capability
-error at the call site instead of being silently dropped; the typed surface
-is stable so multimodal model nodes slot in without SDK changes.
+agent_ai.py:449 `_process_multimodal_args`, multimodal_response.py).
+
+IMAGE INPUT is a served modality: ``Agent.ai(images=[...])`` routes image
+parts to a vision-tower model node (models/vision.py — ViT patch embeddings
+fused into the prompt, served by serving/model_node.py `_fuse_images`).
+Audio stays a clear capability error until an audio tower lands; the typed
+surface is stable so it slots in without SDK changes.
 """
 
 from __future__ import annotations
@@ -115,7 +118,92 @@ def to_text_prompt(parts: list[Content]) -> str:
         else:
             raise UnsupportedModalityError(
                 f"{type(p).__name__} requires a multimodal model node "
-                "(text-only models are served this round; vision/audio model "
-                "nodes are roadmap)"
+                "(text and image inputs are served; audio model nodes are "
+                "roadmap)"
             )
     return "\n".join(texts)
+
+
+def split_prompt_and_images(args: list[Any]) -> tuple[str, list[dict[str, Any]]]:
+    """Classify mixed ai() args (reference `_process_multimodal_args`,
+    agent_ai.py:449): text parts join into the prompt with an ``<image>``
+    marker standing in for each image at its argument position; image parts
+    become the wire payload the model node's vision tower consumes. Audio/
+    file parts raise UnsupportedModalityError."""
+    pieces: list[str] = []
+    images: list[dict[str, Any]] = []
+    for arg in args:
+        part = classify(arg)
+        if isinstance(part, TextContent):
+            pieces.append(part.text)
+        elif isinstance(part, ImageContent):
+            pieces.append("<image>")
+            images.append({"b64": base64.b64encode(part.data).decode()})
+        else:
+            raise UnsupportedModalityError(
+                f"{type(part).__name__} is not a servable input modality "
+                "(text + image are; audio model nodes are roadmap)"
+            )
+    return "\n".join(pieces), images
+
+
+# ---------------------------------------------------------------------------
+# Response detection / wrapping (reference: multimodal_response.py —
+# detect_multimodal_response wraps provider outputs carrying image/audio
+# payloads so callers get typed objects with save helpers)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MultimodalResponse:
+    """A model result carrying non-text payloads alongside its text."""
+
+    text: str
+    parts: list[Content]
+    raw: dict[str, Any]
+
+    def save_all(self, directory: str | Path, stem: str = "output") -> list[Path]:
+        """Write every binary part to ``directory`` (reference: the response
+        wrappers' save helpers). Returns the written paths."""
+        out = []
+        d = Path(directory)
+        d.mkdir(parents=True, exist_ok=True)
+        for i, p in enumerate(self.parts):
+            if isinstance(p, TextContent):
+                continue
+            ext = mimetypes.guess_extension(p.mime) or ".bin"
+            path = d / f"{stem}_{i}{ext}"
+            path.write_bytes(p.data)
+            out.append(path)
+        return out
+
+
+def detect_multimodal_response(result: dict[str, Any]) -> MultimodalResponse | dict[str, Any]:
+    """Inspect a model-node result for binary output parts. Text-only results
+    pass through unchanged; results with a ``parts`` list of typed content
+    dicts (``{"type": "image"|"audio"|"file", "data_b64": ...}``) wrap into a
+    MultimodalResponse with save helpers."""
+    raw_parts = result.get("parts")
+    if not isinstance(raw_parts, list) or not raw_parts:
+        return result
+    parts: list[Content] = []
+    for rp in raw_parts:
+        if not isinstance(rp, dict):
+            return result  # not the typed-part shape; leave untouched
+        kind = rp.get("type")
+        if kind == "text":
+            parts.append(TextContent(rp.get("text", "")))
+            continue
+        try:
+            data = base64.b64decode(rp.get("data_b64", ""))
+        except Exception:
+            return result
+        if kind == "image":
+            parts.append(ImageContent(data, rp.get("mime", "image/png")))
+        elif kind == "audio":
+            parts.append(AudioContent(data, rp.get("mime", "audio/wav")))
+        elif kind == "file":
+            parts.append(FileContent(data, rp.get("name", "blob"), rp.get("mime", "application/octet-stream")))
+        else:
+            return result
+    return MultimodalResponse(text=result.get("text", ""), parts=parts, raw=result)

@@ -77,6 +77,15 @@ class EngineConfig:
     # the decode step then skips the [B, V] mask gather entirely. Each
     # submitted Request.grammar occupies grammar.n_states rows (shared across
     # requests carrying the same Grammar object).
+    decode_span: int = 1  # decode steps per dispatch: the jitted decode runs
+    # a lax.scan of this many steps and returns [span, B] tokens, so the host
+    # pays ONE device→host readback per span tokens instead of per token.
+    # Sized for high-latency links (the axon tunnel's readback is ~100ms —
+    # round-1 bench's 210ms/step was mostly this): span 8-16 amortizes it to
+    # noise. Finished rows keep decoding to the end of their span (their
+    # extra tokens are discarded at harvest; stale writes land on pages the
+    # host hasn't freed yet or on the garbage page) — the waste is bounded by
+    # span-1 steps per finished request. 1 restores per-token dispatch.
     async_decode: bool = True  # pipeline decode: dispatch step N before
     # reading step N-1's sampled tokens, so the device never idles on the
     # host's device→host round trip (token events arrive one tick later;
@@ -109,6 +118,12 @@ class Request:
     # way a completed value can terminate. Replaces the reference's prompt-
     # injection + regex-salvage structured output (agent_ai.py:221-245,424-447).
     grammar: Grammar | None = None
+    # Multimodal early fusion: [(offset, embeds [k, hidden_size])] — the
+    # embeddings replace the prompt's placeholder tokens at those positions
+    # during prefill (vision tower output, models/vision.py). MM requests skip
+    # session prefix caching: cache identity keys on token ids, which cannot
+    # distinguish two images behind identical placeholders.
+    mm_embeds: list[tuple[int, Any]] | None = None
 
 
 @dataclasses.dataclass
@@ -142,11 +157,12 @@ class _SessionEntry:
 
 @functools.lru_cache(maxsize=None)
 def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
-    """Jitted decode step, cached per (model, engine, mesh) config so every
-    engine instance shares one compilation."""
+    """Jitted decode dispatch, cached per (model, engine, mesh) config so
+    every engine instance shares one compilation. Runs ``ecfg.decode_span``
+    steps as one on-device scan; returns [span, B] tokens/logprobs."""
     ps = ecfg.page_size
 
-    def decode(
+    def one_step(
         params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps,
         top_ks, top_ps, gstates, trans_bank, accept_bank, eos_ids,
     ):
@@ -227,6 +243,28 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
         new_seq_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
         return next_tokens, logprobs, new_seq_lens, new_gstates, kp, vp
 
+    span = max(1, ecfg.decode_span)
+
+    def decode(
+        params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps,
+        top_ks, top_ps, gstates, trans_bank, accept_bank, eos_ids,
+    ):
+        def body(carry, step_rng):
+            toks, lens, gs, kp, vp = carry
+            nt, lp, lens, gs, kp, vp = one_step(
+                params, kp, vp, toks, lens, page_tables, step_rng, temps,
+                top_ks, top_ps, gs, trans_bank, accept_bank, eos_ids,
+            )
+            return (nt, lens, gs, kp, vp), (nt, lp)
+
+        (tokens, seq_lens, gstates, kp, vp), (toks, lps) = jax.lax.scan(
+            body,
+            (tokens, seq_lens, gstates, k_pages, v_pages),
+            jax.random.split(rng, span),
+        )
+        # toks/lps: [span, B]; tokens (= toks[-1]) seeds the next dispatch.
+        return toks, lps, seq_lens, gstates, tokens, kp, vp
+
     return jax.jit(decode, donate_argnums=(1, 2))
 
 
@@ -286,6 +324,31 @@ def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=No
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0]  # [N, V]
+        return last, k_pages, v_pages
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def _prefill_inject_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
+    """Whole-prompt prefill with embedding injection (multimodal): like
+    ``_prefill_fn`` plus an [1, bucket, D] inject buffer substituted at
+    masked positions before the transformer stack."""
+    ps = ecfg.page_size
+
+    def prefill(params, k_pages, v_pages, tokens, inject, inj_mask, length, page_table_row):
+        positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+        logits, (ks, vs) = llama.forward_impl(
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl,
+            mesh=mesh, embeds_override=(inject, inj_mask),
+        )
+        pos = positions[0]
+        in_range = pos < length
+        page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
+        slot_ids = pos % ps
+        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(ks[:, 0], 0, 1))
+        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(vs[:, 0], 0, 1))
+        last = logits[0, length - 1]
         return last, k_pages, v_pages
 
     return jax.jit(prefill, donate_argnums=(1, 2))
@@ -367,6 +430,8 @@ class InferenceEngine:
             raise ValueError(
                 f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 16 (one tile) or None"
             )
+        if self.ecfg.decode_span < 1:
+            raise ValueError(f"decode_span={self.ecfg.decode_span} must be >= 1")
         if self.ecfg.max_pages_per_seq > self.ecfg.num_pages - 1:
             raise ValueError(
                 f"max_pages_per_seq={self.ecfg.max_pages_per_seq} cannot exceed "
@@ -473,6 +538,20 @@ class InferenceEngine:
         RequestTooLongError if it can never fit the page budget."""
         if not req.prompt:
             raise ValueError(f"request {req.id}: prompt must be non-empty")
+        if req.mm_embeds:
+            D = self.cfg.hidden_size
+            for off, emb in req.mm_embeds:
+                arr = np.asarray(emb)
+                if arr.ndim != 2 or arr.shape[1] != D:
+                    raise ValueError(
+                        f"request {req.id}: mm_embeds must be [k, {D}] arrays, "
+                        f"got shape {arr.shape}"
+                    )
+                if off < 0 or off + arr.shape[0] > len(req.prompt):
+                    raise ValueError(
+                        f"request {req.id}: mm span [{off}, {off + arr.shape[0]}) "
+                        f"outside the {len(req.prompt)}-token prompt"
+                    )
         if req.grammar is not None:
             if self.ecfg.grammar_slots <= 0:
                 raise ValueError(
@@ -687,7 +766,7 @@ class InferenceEngine:
         """Returns (entry, reusable-token count) on a prefix-cache hit, without
         mutating the entry — admission may still fail on page starvation and
         must be able to restore the session untouched."""
-        if not req.session_id or not self.ecfg.enable_prefix_cache:
+        if not req.session_id or not self.ecfg.enable_prefix_cache or req.mm_embeds:
             return None
         sess = self._sessions.get(req.session_id)
         if sess is None:
@@ -734,7 +813,7 @@ class InferenceEngine:
                 and self.ecfg.enable_prefix_cache
                 and req.session_id in self._sessions
             )
-            if chunked or has_sess:
+            if chunked or has_sess or req.mm_embeds:
                 if batch:
                     break  # flush the fresh batch first; single path next tick
                 return self._admit_single(req, free_slot)
@@ -842,7 +921,12 @@ class InferenceEngine:
         if hit is not None:
             self.stats["prefix_cache_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
-        last_logits = self._prefill(suffix, start, row)
+        if req.mm_embeds:
+            # Whole-prompt injection prefill (chunking doesn't apply: the
+            # inject buffer is positioned against the full prompt).
+            last_logits = self._prefill_mm(req.prompt, row, req.mm_embeds)
+        else:
+            last_logits = self._prefill(suffix, start, row)
         self.stats["prefill_tokens"] += len(suffix)
         return [self._sample_first_and_install(req, free_slot, pages, row, last_logits)]
 
@@ -948,6 +1032,31 @@ class InferenceEngine:
                 )
         return last_logits
 
+    def _prefill_mm(self, tokens: list[int], row: np.ndarray, mm_embeds) -> jax.Array:
+        """Multimodal whole-prompt prefill: placeholder positions take the
+        provided embeddings instead of token-table rows."""
+        bucket = self.ecfg.prefill_bucket(len(tokens))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(tokens)] = np.asarray(tokens, np.int32)
+        inject = np.zeros((1, bucket, self.cfg.hidden_size), np.float32)
+        mask = np.zeros((1, bucket), bool)
+        for off, emb in mm_embeds:
+            arr = np.asarray(emb, np.float32)
+            inject[0, off : off + arr.shape[0]] = arr
+            mask[0, off : off + arr.shape[0]] = True
+        fn = _prefill_inject_fn(self.cfg, self.ecfg, bucket, self.mesh)
+        last, self.cache.k_pages, self.cache.v_pages = fn(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(padded),
+            jnp.asarray(inject),
+            jnp.asarray(mask),
+            jnp.int32(len(tokens)),
+            jnp.asarray(row),
+        )
+        return last
+
     def _emit(
         self, slot_idx: int, slot: _Slot, tok: int, logprob: float | None = None
     ) -> TokenEvent:
@@ -972,7 +1081,12 @@ class InferenceEngine:
     def _release(self, slot_idx: int, slot: _Slot) -> None:
         sid = slot.req.session_id
         with self._session_lock:
-            if sid and self.ecfg.enable_prefix_cache and len(slot.tokens) > 1:
+            if (
+                sid
+                and self.ecfg.enable_prefix_cache
+                and len(slot.tokens) > 1
+                and not slot.req.mm_embeds
+            ):
                 # Retain the KV for the next turn. The last generated token's
                 # KV was never written (it is returned, not fed back), so the
                 # cached prefix is tokens[:-1]. Pages were sized for
@@ -1102,7 +1216,7 @@ class InferenceEngine:
         else:
             toks, lps = self._decode_full_dispatch()
             compact = False
-        self.stats["decode_steps"] += 1
+        self.stats["decode_steps"] += max(1, self.ecfg.decode_span)
         self._inflight = {
             "tokens": toks,
             "logprobs": lps,
@@ -1121,28 +1235,29 @@ class InferenceEngine:
         token — object identity is the liveness check."""
         if inf is None:
             return []
-        toks = np.asarray(inf["tokens"])
+        toks = np.asarray(inf["tokens"])  # [span, B]
         lps = np.asarray(inf["logprobs"])
         out: list[TokenEvent] = []
-        for j, (i, slot) in enumerate(inf["slots"]):
-            if self.slots[i] is not slot:
-                continue
-            row = j if inf["compact"] else i
-            tok, logprob = int(toks[row]), float(lps[row])
-            slot.length += 1
-            slot.generated += 1
-            slot.last_token = tok
-            slot.tokens.append(tok)
-            self.seq_lens[i] = slot.length
-            self.last_tokens[i] = tok
-            if slot.req.grammar is not None:
-                # Mirror the device-side DFA advance so a dirty rebuild of the
-                # control arrays starts from the current state.
-                self.grammar_states[i] = max(
-                    int(self._gbank_trans[self.grammar_states[i], tok]), 0
-                )
-            self.stats["decode_tokens"] += 1
-            out.append(self._emit(i, slot, tok, logprob))
+        for t in range(toks.shape[0]):
+            for j, (i, slot) in enumerate(inf["slots"]):
+                if self.slots[i] is not slot:
+                    continue  # finished/cancelled: discard its later span tokens
+                row = j if inf["compact"] else i
+                tok, logprob = int(toks[t, row]), float(lps[t, row])
+                slot.length += 1
+                slot.generated += 1
+                slot.last_token = tok
+                slot.tokens.append(tok)
+                self.seq_lens[i] = slot.length
+                self.last_tokens[i] = tok
+                if slot.req.grammar is not None:
+                    # Mirror the device-side DFA advance so a dirty rebuild of
+                    # the control arrays starts from the current state.
+                    self.grammar_states[i] = max(
+                        int(self._gbank_trans[self.grammar_states[i], tok]), 0
+                    )
+                self.stats["decode_tokens"] += 1
+                out.append(self._emit(i, slot, tok, logprob))
         return out
 
     def _pick_decode_bucket(self, n_active: int) -> int | None:
@@ -1168,7 +1283,7 @@ class InferenceEngine:
             self._dirty = False
         d = self._dev
         bank = self._gbank_device()
-        next_tokens, logprobs, new_seq_lens, new_gstates, self.cache.k_pages, self.cache.v_pages = (
+        toks, lps, new_seq_lens, new_gstates, last_toks, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(
                 self.params,
                 self.cache.k_pages,
@@ -1186,8 +1301,8 @@ class InferenceEngine:
                 d["eos_ids"],
             )
         )
-        d["tokens"], d["seq_lens"], d["gstates"] = next_tokens, new_seq_lens, new_gstates
-        return next_tokens, logprobs
+        d["tokens"], d["seq_lens"], d["gstates"] = last_toks, new_seq_lens, new_gstates
+        return toks, lps
 
     def _decode_compact_dispatch(
         self, active_idx: list[int], bucket: int
@@ -1231,7 +1346,7 @@ class InferenceEngine:
             }
 
         bank = self._gbank_device()
-        next_tokens, logprobs, new_seq_lens, new_gstates, self.cache.k_pages, self.cache.v_pages = (
+        toks, lps, new_seq_lens, new_gstates, last_toks, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(
                 self.params,
                 self.cache.k_pages,
@@ -1249,9 +1364,9 @@ class InferenceEngine:
                 c["eos_ids"],
             )
         )
-        c["tokens"], c["seq_lens"], c["gstates"] = next_tokens, new_seq_lens, new_gstates
+        c["tokens"], c["seq_lens"], c["gstates"] = last_toks, new_seq_lens, new_gstates
         self._dirty = True  # full-width device state is now stale
-        return next_tokens, logprobs
+        return toks, lps
 
     def run_to_completion(self, requests: list[Request]) -> dict[str, list[int]]:
         """Convenience driver: submit everything, step until drained, return

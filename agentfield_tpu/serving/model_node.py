@@ -138,11 +138,37 @@ class ModelBackend:
         idle_sleep: float = 0.002,
         model_name: str = "custom",
         mesh=None,
+        vision=None,  # vision tower: config name, VisionConfig, or
+        # (VisionConfig, params). A name/config gets random-init params
+        # (plumbing + tests; checkpoint loading hands params in directly).
+        # None → image inputs are rejected with a clear error.
     ):
         self.cfg = cfg
         self.model_name = model_name
         self.engine = InferenceEngine(params, cfg, ecfg, seed=seed, mesh=mesh)
         self.tokenizer = tokenizer
+        self.vision_cfg = self.vision_params = None
+        if vision is not None:
+            import jax as _jax
+
+            from agentfield_tpu.models.vision import (
+                VisionConfig,
+                get_vision_config,
+                init_vision_params,
+            )
+
+            if isinstance(vision, str):
+                vision = get_vision_config(vision)
+            if isinstance(vision, VisionConfig):
+                if vision.out_dim != cfg.hidden_size:
+                    raise ValueError(
+                        f"vision out_dim={vision.out_dim} must match the LM "
+                        f"hidden_size={cfg.hidden_size}"
+                    )
+                self.vision_cfg = vision
+                self.vision_params = init_vision_params(vision, _jax.random.PRNGKey(seed + 1))
+            else:
+                self.vision_cfg, self.vision_params = vision
         self.idle_sleep = idle_sleep
         # One accumulation dict: (token, logprob) records per request —
         # parallel dicts would need mirrored lifecycle at every cleanup site.
@@ -294,6 +320,71 @@ class ModelBackend:
             fut.add_done_callback(lambda _f: self._grammar_futs.pop(key, None))
         return await asyncio.shield(fut)
 
+    def _decode_image(self, item) -> "np.ndarray":
+        """One wire image → [S, S, 3] float32 in [0, 1]. Accepts
+        {"b64": <base64 PNG/JPEG>} (the SDK's ImageContent wire form) or a
+        nested list / array of pixels (tests, pre-decoded callers)."""
+        import numpy as np
+
+        S = self.vision_cfg.image_size
+        if isinstance(item, dict) and "b64" in item:
+            import base64
+            import io
+
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(base64.b64decode(item["b64"])))
+            img = img.convert("RGB").resize((S, S))
+            return np.asarray(img, np.float32) / 255.0
+        arr = np.asarray(item, np.float32)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            raise ValueError(f"image array must be [H, W, 3], got {arr.shape}")
+        if arr.shape[0] != S or arr.shape[1] != S:
+            from PIL import Image
+
+            img = Image.fromarray(
+                (np.clip(arr, 0.0, 1.0) * 255).astype("uint8")
+            ).resize((S, S))
+            arr = np.asarray(img, np.float32) / 255.0
+        return arr
+
+    def _fuse_images(self, prompt: str, images: list) -> tuple[list[int], list]:
+        """Tokenize a prompt with ``<image>`` markers, encoding each image
+        through the vision tower and splicing placeholder tokens + embedding
+        spans at the marker positions (LLaVA-style early fusion). Returns
+        (tokens, mm_embeds for the engine)."""
+        import numpy as np
+
+        from agentfield_tpu.models.vision import vision_encode_jit
+
+        if self.vision_cfg is None:
+            raise ValueError(
+                "this model node has no vision tower (images unsupported); "
+                "start it with vision=<config> to serve image inputs"
+            )
+        if self.tokenizer is None:
+            raise ValueError("image inputs need a tokenizer (text prompt)")
+        pieces = prompt.split("<image>")
+        if len(pieces) - 1 != len(images):
+            raise ValueError(
+                f"prompt has {len(pieces) - 1} <image> markers for "
+                f"{len(images)} images"
+            )
+        batch = np.stack([self._decode_image(im) for im in images])
+        embs = np.asarray(
+            vision_encode_jit(self.vision_params, self.vision_cfg, batch),
+            np.float32,
+        )  # [N, patches, D]
+        tokens: list[int] = []
+        mm: list[tuple[int, Any]] = []
+        for i, piece in enumerate(pieces):
+            if piece:
+                tokens.extend(self.tokenizer.encode(piece))
+            if i < len(images):
+                mm.append((len(tokens), embs[i]))
+                tokens.extend([0] * embs.shape[1])
+        return tokens, mm
+
     def _submit(
         self,
         prompt: str | None,
@@ -309,6 +400,7 @@ class ModelBackend:
         response_schema: dict[str, Any] | None = None,
         context_overflow: str = "error",
         grammar_obj=None,  # pre-compiled Grammar from ensure_grammar()
+        images: list | None = None,
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -317,7 +409,14 @@ class ModelBackend:
         "truncate_left" keeps the most recent tokens that fit (the TPU-native
         analogue of the reference's token-aware oldest-first trimming,
         agent_ai.py:262-325)."""
-        if tokens is None:
+        mm_embeds = None
+        if images:
+            if tokens is not None:
+                raise ValueError("images require a text 'prompt', not 'tokens'")
+            if prompt is None:
+                raise ValueError("images require a text 'prompt'")
+            tokens, mm_embeds = self._fuse_images(prompt, images)
+        elif tokens is None:
             if prompt is None:
                 raise ValueError("one of 'prompt' or 'tokens' is required")
             if self.tokenizer is None:
@@ -326,7 +425,17 @@ class ModelBackend:
         if context_overflow not in ("error", "truncate_left"):
             raise ValueError(f"unknown context_overflow policy {context_overflow!r}")
         truncated = 0
-        if context_overflow == "truncate_left":
+        if mm_embeds and context_overflow == "truncate_left":
+            # Left-truncation would sever image spans / shift their offsets;
+            # an over-budget multimodal prompt is a hard error instead.
+            budget = self.engine.ecfg.max_context - max_new_tokens
+            if len(tokens) > budget:
+                raise RequestTooLongError(
+                    f"multimodal prompt ({len(tokens)} tokens incl. image "
+                    f"patches) exceeds the {budget}-token budget and cannot "
+                    "be truncated"
+                )
+        elif context_overflow == "truncate_left":
             budget = self.engine.ecfg.max_context - max_new_tokens
             if budget < 1:
                 raise ValueError(
@@ -369,6 +478,7 @@ class ModelBackend:
                     ),
                     session_id=session_id,
                     grammar=grammar,
+                    mm_embeds=mm_embeds,
                 )
             )
         except Exception:
@@ -389,6 +499,7 @@ class ModelBackend:
         session_id: str | None = None,
         response_schema: dict[str, Any] | None = None,
         context_overflow: str = "error",
+        images: list | None = None,
     ) -> dict[str, Any]:
         grammar_obj = None
         if response_schema is not None:
@@ -408,6 +519,7 @@ class ModelBackend:
             response_schema=response_schema,
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
+            images=images,
         )
         try:
             result = await fut
@@ -439,6 +551,7 @@ class ModelBackend:
         response_schema: dict[str, Any] | None = None,
         context_overflow: str = "error",
         grammar_obj=None,
+        images: list | None = None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
@@ -457,6 +570,7 @@ class ModelBackend:
             response_schema=response_schema,
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
+            images=images,
         )
         return rid, q
 
@@ -476,6 +590,8 @@ def build_model_node(
     seed: int = 0,
     checkpoint: str | None = None,
     tp: int = 1,
+    vision=None,  # vision tower config name/VisionConfig/(cfg, params) —
+    # enables image inputs on this node (ModelBackend vision contract)
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -509,7 +625,8 @@ def build_model_node(
 
         mesh = make_mesh({AXIS_MODEL: tp})
     backend = ModelBackend(
-        params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model, mesh=mesh
+        params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model,
+        mesh=mesh, vision=vision,
     )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
@@ -547,7 +664,7 @@ def build_model_node(
                 for k in (
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
-                    "response_schema", "context_overflow",
+                    "response_schema", "context_overflow", "images",
                 )
                 if body.get(k) is not None
             }
@@ -680,7 +797,7 @@ class ModelGrpcService:
                 for k in (
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
-                    "response_schema", "context_overflow",
+                    "response_schema", "context_overflow", "images",
                 )
                 if isinstance(request, dict) and request.get(k) is not None
             }

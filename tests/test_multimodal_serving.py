@@ -1,0 +1,230 @@
+"""Multimodal serving: vision tower, embedding-injection prefill, model-node
+image fusion, SDK content classification + response wrapping.
+
+Reference analogue: agent_ai.py:449 `_process_multimodal_args` /
+`ai_with_vision`:1004 / multimodal_response.py — there images leave via
+litellm; here image input is SERVED by the in-tree vision tower
+(models/vision.py) fused into the prompt (model_node._fuse_images)."""
+
+import asyncio
+import base64
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.vision import (
+    get_vision_config,
+    init_vision_params,
+    vision_encode_jit,
+)
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+from agentfield_tpu.serving.model_node import ByteTokenizer, ModelBackend
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8)
+VCFG = get_vision_config("vit-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def vparams():
+    return init_vision_params(VCFG, jax.random.PRNGKey(1))
+
+
+def test_vision_encoder_shapes(vparams):
+    imgs = jnp.ones((2, 32, 32, 3), jnp.float32) * 0.5
+    out = vision_encode_jit(vparams, VCFG, imgs)
+    assert out.shape == (2, VCFG.num_patches, CFG.hidden_size)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_mm_prefill_changes_output_and_is_deterministic(params, vparams):
+    imgs = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    embs = np.asarray(vision_encode_jit(vparams, VCFG, imgs), np.float32)
+    prompt = [5] * VCFG.num_patches + [9, 11, 13]
+
+    def run(mm):
+        eng = InferenceEngine(params, CFG, ECFG)
+        return eng.run_to_completion(
+            [Request(id="r", prompt=prompt, mm_embeds=mm,
+                     sampling=SamplingParams(max_new_tokens=6))]
+        )["r"]
+
+    plain = run(None)
+    with_img = run([(0, embs[0])])
+    with_img2 = run([(0, embs[0])])
+    assert with_img == with_img2  # deterministic
+    assert with_img != plain  # the injected embeddings reach the logits
+
+    # Image-dependence at the logits level (greedy tokens can tie between
+    # two random images through a random-init tower): inject each image's
+    # embeddings into the dense forward and compare the last position.
+    from agentfield_tpu.models import llama
+
+    toks = jnp.asarray([prompt], jnp.int32)
+    pos = jnp.arange(len(prompt), dtype=jnp.int32)[None]
+    mask = jnp.asarray([[True] * VCFG.num_patches + [False] * 3])
+
+    def logits_for(e):
+        inj = jnp.asarray(e, jnp.float32)[None]
+        pad = jnp.zeros((1, 3, CFG.hidden_size), jnp.float32)
+        l, _ = llama.forward_impl(
+            params, CFG, toks, pos,
+            embeds_override=(jnp.concatenate([inj, pad], axis=1), mask),
+        )
+        return l[0, -1]
+
+    d = float(jnp.max(jnp.abs(logits_for(embs[0]) - logits_for(embs[1]))))
+    assert d > 1e-4, f"logits insensitive to image content (max diff {d})"
+
+
+def test_mm_request_validation(params):
+    eng = InferenceEngine(params, CFG, ECFG)
+    bad_dim = np.zeros((4, CFG.hidden_size + 1), np.float32)
+    with pytest.raises(ValueError, match="mm_embeds"):
+        eng.submit(Request(id="a", prompt=[1, 2, 3, 4, 5], mm_embeds=[(0, bad_dim)]))
+    too_far = np.zeros((4, CFG.hidden_size), np.float32)
+    with pytest.raises(ValueError, match="outside"):
+        eng.submit(Request(id="b", prompt=[1, 2, 3], mm_embeds=[(1, too_far)]))
+
+
+def test_mm_requests_skip_session_cache(params):
+    emb = np.zeros((2, CFG.hidden_size), np.float32)
+    eng = InferenceEngine(params, CFG, ECFG)
+    eng.run_to_completion(
+        [Request(id="a", prompt=[7, 7, 3, 4], mm_embeds=[(0, emb)], session_id="s",
+                 sampling=SamplingParams(max_new_tokens=3))]
+    )
+    assert "s" not in eng._sessions  # no retention keyed on placeholder ids
+    assert eng.allocator.free_pages == ECFG.num_pages - 1
+
+
+def _png_b64(color=(255, 0, 0)):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (8, 8), color).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def test_model_node_serves_image_prompt(params):
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            vision="vit-tiny",
+        )
+        await backend.start()
+        try:
+            r1 = await backend.generate(
+                prompt="look: <image> describe", images=[{"b64": _png_b64()}],
+                max_new_tokens=4,
+            )
+            assert len(r1["tokens"]) == 4 and "text" in r1
+            # a different image must be able to change the continuation
+            r2 = await backend.generate(
+                prompt="look: <image> describe",
+                images=[np.full((8, 8, 3), 0.03, np.float32)],
+                max_new_tokens=4,
+            )
+            assert len(r2["tokens"]) == 4
+            # marker/image count mismatch
+            with pytest.raises(ValueError, match="markers"):
+                await backend.generate(prompt="no marker", images=[{"b64": _png_b64()}, {"b64": _png_b64()}])
+            # tokens + images is invalid
+            with pytest.raises(ValueError, match="text 'prompt'"):
+                await backend.generate(tokens=[1, 2, 3], images=[{"b64": _png_b64()}])
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_model_node_without_vision_rejects_images(params):
+    async def main():
+        backend = ModelBackend(params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size))
+        await backend.start()
+        try:
+            with pytest.raises(ValueError, match="vision tower"):
+                await backend.generate(prompt="<image>", images=[{"b64": _png_b64()}])
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_vision_dim_mismatch_rejected(params):
+    with pytest.raises(ValueError, match="out_dim"):
+        ModelBackend(
+            params, get_config("llama-smoke"), ECFG, vision="vit-tiny",
+        )
+
+
+# -- SDK surface ------------------------------------------------------------
+
+
+def test_split_prompt_and_images():
+    from agentfield_tpu.sdk.multimodal import (
+        ImageContent,
+        UnsupportedModalityError,
+        AudioContent,
+        split_prompt_and_images,
+    )
+
+    png = base64.b64decode(_png_b64())
+    prompt, images = split_prompt_and_images(["what is", ImageContent(png), "?"])
+    assert prompt == "what is\n<image>\n?"
+    assert len(images) == 1 and "b64" in images[0]
+    with pytest.raises(UnsupportedModalityError):
+        split_prompt_and_images([AudioContent(b"RIFFxxxxWAVE")])
+
+
+def test_normalize_images_forms(tmp_path):
+    from agentfield_tpu.sdk.agent import _normalize_images
+    from agentfield_tpu.sdk.multimodal import ImageContent
+
+    png = base64.b64decode(_png_b64())
+    p = tmp_path / "x.png"
+    p.write_bytes(png)
+    out = _normalize_images(
+        [{"b64": "abc"}, png, str(p), ImageContent(png), [[0.0, 0.0, 0.0]],
+         np.zeros((2, 2, 3), np.float32)]
+    )
+    assert out[0] == {"b64": "abc"}
+    assert all("b64" in o for o in out[1:4])
+    assert out[4] == [[0.0, 0.0, 0.0]]
+    # ndarrays must flatten to pure lists (JSON-serializable payload)
+    import json as _json
+
+    assert _json.dumps(out[5]) and out[5][0][0] == [0.0, 0.0, 0.0]
+
+
+def test_detect_multimodal_response_wraps_and_saves(tmp_path):
+    from agentfield_tpu.sdk.multimodal import (
+        MultimodalResponse,
+        detect_multimodal_response,
+    )
+
+    plain = {"text": "hi", "tokens": [1]}
+    assert detect_multimodal_response(plain) is plain
+    png = base64.b64decode(_png_b64())
+    wrapped = detect_multimodal_response(
+        {
+            "text": "an image",
+            "parts": [
+                {"type": "text", "text": "an image"},
+                {"type": "image", "mime": "image/png",
+                 "data_b64": base64.b64encode(png).decode()},
+            ],
+        }
+    )
+    assert isinstance(wrapped, MultimodalResponse)
+    paths = wrapped.save_all(tmp_path)
+    assert len(paths) == 1 and paths[0].read_bytes() == png

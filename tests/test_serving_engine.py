@@ -304,3 +304,56 @@ def test_async_decode_speculative_step_respects_page_budget(params):
         params, CFG, jnp.asarray([buddy_prompt], jnp.int32), num_steps=24, max_len=64
     )[0].tolist()
     assert results["buddy"] == oracle, "speculative overflow corrupted a neighbor"
+
+
+@pytest.mark.parametrize("span", [2, 4, 7])
+def test_decode_span_greedy_matches_span1(params, span):
+    """Multi-step decode (one readback per span tokens — sized for
+    high-latency device links) must stream the exact same greedy tokens as
+    per-token dispatch, including early stop-token finishes mid-span."""
+    prompts = [_prompt(jax.random.PRNGKey(i), n) for i, n in enumerate([5, 9, 12])]
+    base = InferenceEngine(params, CFG, ECFG)
+    want = base.run_to_completion(
+        [_greedy_req(f"r{i}", p, max_new=9) for i, p in enumerate(prompts)]
+    )
+    ecfg = EngineConfig(**{**ECFG.__dict__, "decode_span": span})
+    eng = InferenceEngine(params, CFG, ecfg)
+    got = eng.run_to_completion(
+        [_greedy_req(f"r{i}", p, max_new=9) for i, p in enumerate(prompts)]
+    )
+    assert got == want
+    assert eng.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_decode_span_stop_token_discards_overshoot(params):
+    prompt = _prompt(jax.random.PRNGKey(0), 5)
+    oracle = generate_greedy(params, CFG, jnp.asarray([prompt], jnp.int32), 8, 64)[0].tolist()
+    stop = oracle[2]
+    ecfg = EngineConfig(**{**ECFG.__dict__, "decode_span": 4})
+    eng = InferenceEngine(params, CFG, ecfg)
+    req = Request(
+        id="r", prompt=prompt,
+        sampling=SamplingParams(max_new_tokens=8, stop_token_ids=(stop,)),
+    )
+    results = eng.run_to_completion([req])
+    assert results["r"] == oracle[:3]  # tokens past the stop are discarded
+    assert eng.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_decode_span_with_sessions_and_second_turn(params):
+    """A span-finished slot retains a correct session prefix: the next turn's
+    suffix prefill must produce oracle tokens (garbage written into retained
+    pages by span overshoot is masked/overwritten)."""
+    p1 = _prompt(jax.random.PRNGKey(3), 6)
+    ecfg = EngineConfig(**{**ECFG.__dict__, "decode_span": 4})
+    eng = InferenceEngine(params, CFG, ecfg)
+    r1 = Request(id="a", prompt=p1, session_id="s",
+                 sampling=SamplingParams(max_new_tokens=5))
+    out1 = eng.run_to_completion([r1])["a"]
+    p2 = p1 + out1 + _prompt(jax.random.PRNGKey(4), 3)
+    r2 = Request(id="b", prompt=p2, session_id="s",
+                 sampling=SamplingParams(max_new_tokens=5))
+    out2 = eng.run_to_completion([r2])["b"]
+    assert eng.stats["prefix_cache_hits"] == 1
+    oracle = generate_greedy(params, CFG, jnp.asarray([p2], jnp.int32), 5, 64)[0].tolist()
+    assert out2 == oracle

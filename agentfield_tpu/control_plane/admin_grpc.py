@@ -1,30 +1,24 @@
-"""Admin gRPC service.
+"""Admin gRPC service — real protobuf wire format.
 
-Parity with the reference's single-RPC admin surface (proto/admin/
-reasoner_admin.proto:8-11 `ListReasoners`, served on port+100 —
-internal/server/server.go:320-372). Implemented with grpc's generic handler
-and JSON-encoded messages (this image has grpcio but not grpcio-tools, so no
-codegen; the method path is stable and any JSON-capable gRPC client can call
-it). The surface will grow protos alongside the model-node hot path.
+Wire-compatible with the reference's admin surface (proto/admin/
+reasoner_admin.proto `admin.v1.AdminReasonerService/ListReasoners`, served
+on port+100 — internal/server/server.go:320-372): messages are generated
+from the vendored mirror proto (proto/admin.proto, protoc --python_out),
+so any client built against the reference .proto interops unchanged.
+``ListNodes`` is an additive extension. (Round 1 spoke JSON-encoded
+messages because grpcio-tools is absent; plain protoc + the protobuf
+runtime cover message codegen without it.)
 """
 
 from __future__ import annotations
 
-import json
 from concurrent import futures
-from typing import Any
 
 import grpc
 
-SERVICE = "agentfield.admin.ReasonerAdmin"
+from agentfield_tpu.control_plane.proto import admin_pb2
 
-
-def _json_serializer(obj: Any) -> bytes:
-    return json.dumps(obj).encode()
-
-
-def _json_deserializer(data: bytes) -> Any:
-    return json.loads(data) if data else {}
+SERVICE = "admin.v1.AdminReasonerService"
 
 
 class AdminService(grpc.GenericRpcHandler):
@@ -36,36 +30,46 @@ class AdminService(grpc.GenericRpcHandler):
         if method == f"/{SERVICE}/ListReasoners":
             return grpc.unary_unary_rpc_method_handler(
                 self._list_reasoners,
-                request_deserializer=_json_deserializer,
-                response_serializer=_json_serializer,
+                request_deserializer=admin_pb2.ListReasonersRequest.FromString,
+                response_serializer=admin_pb2.ListReasonersResponse.SerializeToString,
             )
         if method == f"/{SERVICE}/ListNodes":
             return grpc.unary_unary_rpc_method_handler(
                 self._list_nodes,
-                request_deserializer=_json_deserializer,
-                response_serializer=_json_serializer,
+                request_deserializer=admin_pb2.ListNodesRequest.FromString,
+                response_serializer=admin_pb2.ListNodesResponse.SerializeToString,
             )
         return None
 
     def _list_reasoners(self, request, context):
-        node_filter = request.get("node_id") if isinstance(request, dict) else None
-        out = []
+        resp = admin_pb2.ListReasonersResponse()
         for node in self.storage.list_nodes():
-            if node_filter and node.node_id != node_filter:
-                continue
             for r in node.reasoners:
-                out.append(
-                    {
-                        "node_id": node.node_id,
-                        "id": r.id,
-                        "description": r.description,
-                        "did": r.did,
-                    }
+                resp.reasoners.add(
+                    reasoner_id=r.id,
+                    agent_node_id=node.node_id,
+                    name=r.id,
+                    description=r.description or "",
+                    status=node.status.value,
+                    node_version=str(node.metadata.get("version", "")),
+                    last_heartbeat=str(node.last_heartbeat),
                 )
-        return {"reasoners": out}
+        return resp
 
     def _list_nodes(self, request, context):
-        return {"nodes": [n.to_dict() for n in self.storage.list_nodes()]}
+        resp = admin_pb2.ListNodesResponse()
+        for n in self.storage.list_nodes():
+            resp.nodes.add(
+                node_id=n.node_id,
+                kind=n.kind,
+                status=n.status.value,
+                base_url=n.base_url,
+                did=n.did or "",
+                last_heartbeat=n.last_heartbeat,
+                reasoner_count=len(n.reasoners),
+                skill_count=len(n.skills),
+            )
+        return resp
 
 
 def start_admin_grpc(storage, port: int) -> grpc.Server:
@@ -80,12 +84,15 @@ def start_admin_grpc(storage, port: int) -> grpc.Server:
     return server
 
 
-def admin_client_call(port: int, method: str, request: dict | None = None) -> Any:
-    """Convenience JSON client for the admin service."""
+def admin_client_call(port: int, method: str, request=None):
+    """Typed proto client for the admin service. Returns the decoded
+    response message."""
+    req_cls = getattr(admin_pb2, f"{method}Request")
+    resp_cls = getattr(admin_pb2, f"{method}Response")
     with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
         fn = channel.unary_unary(
             f"/{SERVICE}/{method}",
-            request_serializer=_json_serializer,
-            response_deserializer=_json_deserializer,
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
         )
-        return fn(request or {}, timeout=10)
+        return fn(request or req_cls(), timeout=10)

@@ -1,0 +1,5 @@
+"""Vendored admin proto + generated message module.
+
+Regenerate after editing admin.proto:
+    cd agentfield_tpu/control_plane/proto && protoc --python_out=. admin.proto
+"""

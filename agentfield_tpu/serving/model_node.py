@@ -160,15 +160,15 @@ class ModelBackend:
             if isinstance(vision, str):
                 vision = get_vision_config(vision)
             if isinstance(vision, VisionConfig):
-                if vision.out_dim != cfg.hidden_size:
-                    raise ValueError(
-                        f"vision out_dim={vision.out_dim} must match the LM "
-                        f"hidden_size={cfg.hidden_size}"
-                    )
                 self.vision_cfg = vision
                 self.vision_params = init_vision_params(vision, _jax.random.PRNGKey(seed + 1))
             else:
                 self.vision_cfg, self.vision_params = vision
+            if self.vision_cfg.out_dim != cfg.hidden_size:
+                raise ValueError(
+                    f"vision out_dim={self.vision_cfg.out_dim} must match the "
+                    f"LM hidden_size={cfg.hidden_size}"
+                )
         self.idle_sleep = idle_sleep
         # One accumulation dict: (token, logprob) records per request —
         # parallel dicts would need mirrored lifecycle at every cleanup site.
@@ -321,30 +321,35 @@ class ModelBackend:
         return await asyncio.shield(fut)
 
     def _decode_image(self, item) -> "np.ndarray":
-        """One wire image → [S, S, 3] float32 in [0, 1]. Accepts
-        {"b64": <base64 PNG/JPEG>} (the SDK's ImageContent wire form) or a
-        nested list / array of pixels (tests, pre-decoded callers)."""
+        """One wire image → [S, S, 3] float32 in [0, 1]. Accepts raw encoded
+        bytes (the gRPC proto form), {"b64": <base64 PNG/JPEG>} (the HTTP/SDK
+        wire form), or a nested list / array of pixels in [0, 1] (tests,
+        pre-decoded callers; out-of-range values clip)."""
         import numpy as np
 
         S = self.vision_cfg.image_size
-        if isinstance(item, dict) and "b64" in item:
+        raw = None
+        if isinstance(item, (bytes, bytearray)):
+            raw = bytes(item)
+        elif isinstance(item, dict) and "b64" in item:
             import base64
+
+            raw = base64.b64decode(item["b64"])
+        if raw is not None:
             import io
 
             from PIL import Image
 
-            img = Image.open(io.BytesIO(base64.b64decode(item["b64"])))
-            img = img.convert("RGB").resize((S, S))
+            img = Image.open(io.BytesIO(raw)).convert("RGB").resize((S, S))
             return np.asarray(img, np.float32) / 255.0
         arr = np.asarray(item, np.float32)
         if arr.ndim != 3 or arr.shape[-1] != 3:
             raise ValueError(f"image array must be [H, W, 3], got {arr.shape}")
+        arr = np.clip(arr, 0.0, 1.0)
         if arr.shape[0] != S or arr.shape[1] != S:
             from PIL import Image
 
-            img = Image.fromarray(
-                (np.clip(arr, 0.0, 1.0) * 255).astype("uint8")
-            ).resize((S, S))
+            img = Image.fromarray((arr * 255).astype("uint8")).resize((S, S))
             arr = np.asarray(img, np.float32) / 255.0
         return arr
 
@@ -768,13 +773,51 @@ def build_model_node(
     return agent, backend
 
 
+def _grpc_request_to_kwargs(request) -> dict[str, Any]:
+    """GenerateRequest proto → backend.generate kwargs. `optional` fields
+    pass through only when present, so server-side defaults (top_p=1 etc.)
+    stay authoritative."""
+    import json as _json
+
+    kwargs: dict[str, Any] = {}
+    for f in ("prompt", "max_new_tokens", "temperature", "top_k", "top_p",
+              "session_id", "context_overflow"):
+        if request.HasField(f):
+            kwargs[f] = getattr(request, f)
+    if request.tokens:
+        kwargs["tokens"] = list(request.tokens)
+    if request.stop_token_ids:
+        kwargs["stop_token_ids"] = list(request.stop_token_ids)
+    if request.HasField("response_schema_json"):
+        kwargs["response_schema"] = _json.loads(request.response_schema_json)
+    if request.images:
+        # raw encoded bytes straight through — _decode_image takes them
+        # as-is (no base64 round trip on the data-plane hot path)
+        kwargs["images"] = list(request.images)
+    return kwargs
+
+
+def _result_to_grpc_response(result: dict[str, Any]):
+    from agentfield_tpu.control_plane.proto import modelnode_pb2
+
+    return modelnode_pb2.GenerateResponse(
+        tokens=result.get("tokens", []),
+        text=result.get("text", ""),
+        finish_reason=result.get("finish_reason") or "",
+        model=result.get("model", ""),
+        logprobs=[lp for lp in (result.get("logprobs") or []) if lp is not None],
+        truncated_prompt_tokens=int(result.get("truncated_prompt_tokens", 0)),
+    )
+
+
 class ModelGrpcService:
     """gRPC surface for the model node's hot path (BASELINE.json north star:
-    ai() routes 'via gRPC to a JAX/XLA model node'). Generic-handler + JSON
-    messages like the admin service (no codegen in this image); the unary
-    Generate blocks until completion, mirroring backend.generate."""
+    ai() routes 'via gRPC to a JAX/XLA model node'). Real protobuf messages
+    (vendored proto/modelnode.proto, protoc-generated like the admin
+    service); the unary Generate blocks until completion, mirroring
+    backend.generate."""
 
-    SERVICE = "agentfield.model.Generate"
+    SERVICE = "agentfield.model.v1.ModelNode"
 
     def __init__(self, backend: ModelBackend, loop: asyncio.AbstractEventLoop):
         self.backend = backend
@@ -783,24 +826,13 @@ class ModelGrpcService:
     def service(self, handler_call_details):
         import grpc
 
-        from agentfield_tpu.control_plane.admin_grpc import (
-            _json_deserializer,
-            _json_serializer,
-        )
+        from agentfield_tpu.control_plane.proto import modelnode_pb2
 
         if handler_call_details.method != f"/{self.SERVICE}/Generate":
             return None
 
         def generate(request, context):
-            kwargs = {
-                k: request[k]
-                for k in (
-                    "prompt", "tokens", "stop_token_ids", "session_id",
-                    "max_new_tokens", "temperature", "top_k", "top_p",
-                    "response_schema", "context_overflow", "images",
-                )
-                if isinstance(request, dict) and request.get(k) is not None
-            }
+            kwargs = _grpc_request_to_kwargs(request)
             fut = asyncio.run_coroutine_threadsafe(
                 self.backend.generate(**kwargs), self.loop
             )
@@ -810,7 +842,7 @@ class ModelGrpcService:
                 # both this worker thread and its engine slot.
                 remaining = context.time_remaining()
                 timeout = min(remaining, 600.0) if remaining is not None else 600.0
-                return fut.result(timeout=timeout)
+                return _result_to_grpc_response(fut.result(timeout=timeout))
             except TimeoutError:
                 fut.cancel()
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "generation timed out")
@@ -828,8 +860,8 @@ class ModelGrpcService:
 
         return grpc.unary_unary_rpc_method_handler(
             generate,
-            request_deserializer=_json_deserializer,
-            response_serializer=_json_serializer,
+            request_deserializer=modelnode_pb2.GenerateRequest.FromString,
+            response_serializer=modelnode_pb2.GenerateResponse.SerializeToString,
         )
 
 
@@ -851,18 +883,47 @@ def start_model_grpc(backend: ModelBackend, port: int) -> "object":
 
 
 def model_grpc_generate(port: int, request: dict, timeout: float = 600.0) -> dict:
-    """Client helper for the gRPC Generate surface."""
+    """Client helper for the gRPC Generate surface. Accepts the same dict
+    shape as the HTTP body (response_schema as a dict, images as
+    {"b64": ...} entries) and converts to/from the proto messages."""
+    import base64 as _b64
+    import json as _json
+
     import grpc
 
-    from agentfield_tpu.control_plane.admin_grpc import (
-        _json_deserializer,
-        _json_serializer,
-    )
+    from agentfield_tpu.control_plane.proto import modelnode_pb2
+
+    msg = modelnode_pb2.GenerateRequest()
+    for f in ("prompt", "max_new_tokens", "temperature", "top_k", "top_p",
+              "session_id", "context_overflow"):
+        if request.get(f) is not None:
+            setattr(msg, f, request[f])
+    if request.get("tokens"):
+        msg.tokens.extend(request["tokens"])
+    if request.get("stop_token_ids"):
+        msg.stop_token_ids.extend(request["stop_token_ids"])
+    if request.get("response_schema") is not None:
+        msg.response_schema_json = _json.dumps(request["response_schema"])
+    for im in request.get("images") or []:
+        if not (isinstance(im, dict) and "b64" in im):
+            raise ValueError("gRPC images must be {'b64': <base64 bytes>} entries")
+        msg.images.append(_b64.b64decode(im["b64"]))
 
     with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
         fn = channel.unary_unary(
             f"/{ModelGrpcService.SERVICE}/Generate",
-            request_serializer=_json_serializer,
-            response_deserializer=_json_deserializer,
+            request_serializer=modelnode_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=modelnode_pb2.GenerateResponse.FromString,
         )
-        return fn(request, timeout=timeout)
+        resp = fn(msg, timeout=timeout)
+    out: dict[str, Any] = {
+        "tokens": list(resp.tokens),
+        "text": resp.text,
+        "finish_reason": resp.finish_reason or None,
+        "model": resp.model,
+    }
+    if resp.logprobs:
+        out["logprobs"] = list(resp.logprobs)
+    if resp.truncated_prompt_tokens:
+        out["truncated_prompt_tokens"] = resp.truncated_prompt_tokens
+    return out

@@ -15,15 +15,21 @@ async def test_admin_grpc_list_reasoners():
         import asyncio
 
         res = await asyncio.to_thread(admin_client_call, port, "ListReasoners")
-        ids = {r["id"] for r in res["reasoners"]}
+        ids = {r.reasoner_id for r in res.reasoners}
         assert "echo" in ids and "deferred" in ids
-        assert all(r["node_id"] == "fake-agent" for r in res["reasoners"])
-        res = await asyncio.to_thread(
-            admin_client_call, port, "ListReasoners", {"node_id": "nope"}
-        )
-        assert res["reasoners"] == []
+        assert all(r.agent_node_id == "fake-agent" for r in res.reasoners)
+        assert all(r.status == "active" for r in res.reasoners)
         nodes = await asyncio.to_thread(admin_client_call, port, "ListNodes")
-        assert nodes["nodes"][0]["node_id"] == "fake-agent"
+        assert nodes.nodes[0].node_id == "fake-agent"
+        assert nodes.nodes[0].reasoner_count == len(ids)
+
+        # Wire-format interop: the response decodes against a message class
+        # generated from the REFERENCE proto field numbers/types.
+        raw = res.SerializeToString()
+        from agentfield_tpu.control_plane.proto import admin_pb2
+
+        again = admin_pb2.ListReasonersResponse.FromString(raw)
+        assert {r.reasoner_id for r in again.reasoners} == ids
 
 
 def test_payload_store_round_trip(tmp_path):

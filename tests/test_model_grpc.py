@@ -56,3 +56,43 @@ async def test_grpc_generate_round_trip():
             server.stop(grace=0)
             await agent.stop()
             await backend.stop()
+
+
+@async_test
+async def test_grpc_generate_with_image_bytes():
+    """Raw encoded image bytes travel the proto `images` field straight into
+    the vision tower (no base64 on the gRPC data plane)."""
+    import base64
+    import io
+
+    from PIL import Image
+
+    async with CPHarness() as h:
+        agent, backend = build_model_node(
+            "grpc-vlm",
+            h.base_url,
+            model="llama-tiny",
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+            vision="vit-tiny",
+        )
+        await backend.start()
+        await agent.start()
+        port = free_port()
+        server = start_model_grpc(backend, port)
+        try:
+            buf = io.BytesIO()
+            Image.new("RGB", (8, 8), (10, 200, 30)).save(buf, format="PNG")
+            res = await asyncio.to_thread(
+                model_grpc_generate,
+                port,
+                {
+                    "prompt": "see <image> now",
+                    "images": [{"b64": base64.b64encode(buf.getvalue()).decode()}],
+                    "max_new_tokens": 3,
+                },
+            )
+            assert len(res["tokens"]) == 3 and res["model"] == "llama-tiny"
+        finally:
+            server.stop(grace=0)
+            await agent.stop()
+            await backend.stop()

@@ -57,7 +57,11 @@ class EngineConfig:
     max_pending: int = 1024  # admission queue bound (reference queue default:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
     attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
-    prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas)
+    prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas) |
+    # "ring" (sequence-parallel prefill over the mesh's `seq` axis — the
+    # long-context serving path: no device materializes full-context
+    # attention; requires mesh= with a seq axis, prompt buckets divide by
+    # the axis size since they are powers of two >= 16)
     prefill_batch: int = 4  # admit up to this many fresh requests per tick as
     # ONE padded prefill batch (burst TTFT: N admissions cost one kernel call
     # instead of N serial prefills). 1 restores one-at-a-time admission.
@@ -440,15 +444,35 @@ class InferenceEngine:
             )
         self.mesh = mesh
         if mesh is not None:
-            from agentfield_tpu.parallel.mesh import AXIS_MODEL
+            from agentfield_tpu.parallel.mesh import AXIS_MODEL, AXIS_SEQ
             from agentfield_tpu.parallel.sharding import check_divisibility, shard_params
 
-            # Pallas impls run under shard_map over the (KV-)head axis —
-            # see ops/paged_attention.py and models/llama.py attend() — so TP
-            # composes with both the ref GSPMD path and the kernels
-            # (north-star config 5: 70B TP=8 on the paged kernel).
-            check_divisibility(cfg, mesh.shape[AXIS_MODEL], paged_kv=True)
-            params = shard_params(params, cfg, mesh)
+            tp = mesh.shape.get(AXIS_MODEL, 1)
+            if tp > 1:
+                # Pallas impls run under shard_map over the (KV-)head axis —
+                # see ops/paged_attention.py and models/llama.py attend() — so
+                # TP composes with both the ref GSPMD path and the kernels
+                # (north-star config 5: 70B TP=8 on the paged kernel).
+                check_divisibility(cfg, tp, paged_kv=True)
+                params = shard_params(params, cfg, mesh)
+            if self.ecfg.prefill_impl == "ring":
+                sp = mesh.shape.get(AXIS_SEQ, 1)
+                if sp < 2:
+                    raise ValueError(
+                        "prefill_impl='ring' needs a mesh with a 'seq' axis "
+                        f"of size >= 2 (got axes {dict(mesh.shape)})"
+                    )
+                # Every prefill bucket (powers of two >= 16, clamped to
+                # max_context) must divide by the seq axis, else the first
+                # long request dies mid-tick in ring_attention.
+                if sp & (sp - 1) or sp > 16 or self.ecfg.max_context % sp:
+                    raise ValueError(
+                        f"seq axis size {sp} must be a power of two <= 16 "
+                        f"dividing max_context={self.ecfg.max_context} "
+                        "(prefill buckets are powers of two >= 16)"
+                    )
+        elif self.ecfg.prefill_impl == "ring":
+            raise ValueError("prefill_impl='ring' requires a mesh (sequence-parallel)")
         self.params = params
         # KV pages must match the params' compute dtype (f32 params writing
         # into bf16 pages is a lossy scatter and a future jax error).

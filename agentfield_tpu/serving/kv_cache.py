@@ -58,8 +58,9 @@ class PagedKVCache:
 
             from agentfield_tpu.parallel.mesh import AXIS_MODEL
 
-            s = NamedSharding(mesh, P(None, None, AXIS_MODEL, None, None))
-            k, v = jax.device_put(k, s), jax.device_put(v, s)
+            if mesh.shape.get(AXIS_MODEL, 1) > 1:
+                s = NamedSharding(mesh, P(None, None, AXIS_MODEL, None, None))
+                k, v = jax.device_put(k, s), jax.device_put(v, s)
         return PagedKVCache(k_pages=k, v_pages=v, page_size=page_size)
 
     def hbm_bytes(self) -> int:

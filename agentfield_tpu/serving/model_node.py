@@ -773,6 +773,12 @@ def build_model_node(
     return agent, backend
 
 
+# Optional scalar fields of GenerateRequest, shared by the server-side
+# decode and the client-side encode so the two cannot drift.
+_GRPC_SCALAR_FIELDS = ("prompt", "max_new_tokens", "temperature", "top_k",
+                       "top_p", "session_id", "context_overflow")
+
+
 def _grpc_request_to_kwargs(request) -> dict[str, Any]:
     """GenerateRequest proto → backend.generate kwargs. `optional` fields
     pass through only when present, so server-side defaults (top_p=1 etc.)
@@ -780,8 +786,7 @@ def _grpc_request_to_kwargs(request) -> dict[str, Any]:
     import json as _json
 
     kwargs: dict[str, Any] = {}
-    for f in ("prompt", "max_new_tokens", "temperature", "top_k", "top_p",
-              "session_id", "context_overflow"):
+    for f in _GRPC_SCALAR_FIELDS:
         if request.HasField(f):
             kwargs[f] = getattr(request, f)
     if request.tokens:
@@ -832,7 +837,10 @@ class ModelGrpcService:
             return None
 
         def generate(request, context):
-            kwargs = _grpc_request_to_kwargs(request)
+            try:
+                kwargs = _grpc_request_to_kwargs(request)
+            except ValueError as e:  # malformed response_schema_json etc.
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             fut = asyncio.run_coroutine_threadsafe(
                 self.backend.generate(**kwargs), self.loop
             )
@@ -894,8 +902,7 @@ def model_grpc_generate(port: int, request: dict, timeout: float = 600.0) -> dic
     from agentfield_tpu.control_plane.proto import modelnode_pb2
 
     msg = modelnode_pb2.GenerateRequest()
-    for f in ("prompt", "max_new_tokens", "temperature", "top_k", "top_p",
-              "session_id", "context_overflow"):
+    for f in _GRPC_SCALAR_FIELDS:
         if request.get(f) is not None:
             setattr(msg, f, request[f])
     if request.get("tokens"):

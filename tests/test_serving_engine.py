@@ -357,3 +357,45 @@ def test_decode_span_with_sessions_and_second_turn(params):
     assert eng.stats["prefix_cache_hits"] == 1
     oracle = generate_greedy(params, CFG, jnp.asarray([p2], jnp.int32), 5, 64)[0].tolist()
     assert out2 == oracle
+
+
+def test_sequence_parallel_ring_prefill_matches_oracle(params):
+    """Long-context serving path: whole-prompt prefill runs ring attention
+    sequence-parallel over the mesh's `seq` axis (SURVEY §5 long-context
+    row — the reference trims prompts to the provider window instead;
+    agent_ai.py:262-325). Greedy tokens must match the single-device oracle."""
+    from agentfield_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"seq": 2})
+    ecfg = EngineConfig(
+        max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8,
+        prefill_impl="ring",
+    )
+    engine = InferenceEngine(params, CFG, ecfg, mesh=mesh)
+    prompts = [_prompt(jax.random.PRNGKey(i), n) for i, n in enumerate([21, 33])]
+    results = engine.run_to_completion(
+        [_greedy_req(f"r{i}", p, max_new=5) for i, p in enumerate(prompts)]
+    )
+    for i, p in enumerate(prompts):
+        oracle = generate_greedy(
+            params, CFG, jnp.asarray([p], jnp.int32), num_steps=5, max_len=64
+        )[0].tolist()
+        assert results[f"r{i}"] == oracle
+
+
+def test_ring_prefill_requires_seq_mesh(params):
+    from agentfield_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="seq"):
+        InferenceEngine(
+            params, CFG,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_impl="ring"),
+        )
+    with pytest.raises(ValueError, match="seq"):
+        InferenceEngine(
+            params, CFG,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64,
+                         max_pages_per_seq=8, prefill_impl="ring"),
+            mesh=make_mesh({"model": 2}),
+        )

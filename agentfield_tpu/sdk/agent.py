@@ -165,6 +165,7 @@ class Agent:
         self._runner: web.AppRunner | None = None
         self._hb_task: asyncio.Task | None = None
         self._pending: set[asyncio.Task] = set()
+        self._reconnect_cbs: list[Any] = []
 
     # -- decorators -----------------------------------------------------
 
@@ -232,7 +233,14 @@ class Agent:
             return web.Response(status=202)
 
         async def health(_req):
-            doc = {"status": "ok", "node_id": self.node_id}
+            doc = {
+                "status": "ok",
+                "node_id": self.node_id,
+                # control-plane link state (reference: ConnectionManager's
+                # degraded-mode flag, connection_manager.py) — the agent
+                # keeps serving locally even while the link is down
+                "control_plane": self.connection_state,
+            }
             if self.mcp is not None:
                 doc["mcp"] = self.mcp.health()  # aggregated by the control
                 # plane's HealthMonitor (reference: checkMCPHealthForNode)
@@ -726,8 +734,38 @@ class Agent:
     # Optional provider of live stats shipped with each heartbeat (model
     # nodes set this to their engine counters).
     heartbeat_stats: Any = None  # callable -> dict | None
+    # Link-state machine (reference: ConnectionManager, connection_manager.py
+    # :197 — background reconnect loop + degraded-mode flag): "connected" |
+    # "degraded" (heartbeats failing, local serving continues) — transitions
+    # are driven by the heartbeat loop; on_reconnect callbacks fire when the
+    # link heals after a degraded stretch.
+    connection_state: str = "connected"
+    _DEGRADED_AFTER = 3  # consecutive heartbeat failures
+
+    def on_reconnect(self, cb) -> None:
+        """Register a callback (sync or async, no args) fired after the
+        control-plane link recovers from a degraded stretch."""
+        self._reconnect_cbs.append(cb)
+
+    def _fire_reconnect(self) -> None:
+        """Run observers off the heartbeat loop — a slow callback must never
+        stall heartbeating (the node would flap dead again immediately)."""
+
+        async def run() -> None:
+            for cb in self._reconnect_cbs:
+                try:
+                    r = cb()
+                    if inspect.isawaitable(r):
+                        await r
+                except Exception:
+                    pass  # observer errors must not break heartbeating
+
+        task = asyncio.create_task(run())
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
 
     async def _heartbeat_loop(self) -> None:
+        failures = 0
         while True:
             await asyncio.sleep(self.heartbeat_interval)
             # A broken stats provider must degrade to a stats-less heartbeat,
@@ -742,13 +780,30 @@ class Agent:
             try:
                 await self.client.heartbeat(self.node_id, stats=stats)
             except ControlPlaneError as e:
+                failures += 1
                 if e.status == 404:  # control plane restarted: re-register
                     try:
                         await self.client.register_node(self._node_spec())
                     except Exception:
                         pass
+                    else:
+                        # the node is live on the fresh plane NOW — that is
+                        # the recovery, not the next heartbeat
+                        failures = 0
+                        if self.connection_state == "degraded":
+                            self.connection_state = "connected"
+                            self._fire_reconnect()
             except Exception:
-                pass  # transient; keep heartbeating (reference ConnectionManager)
+                failures += 1  # transient; keep heartbeating
+            else:
+                if self.connection_state == "degraded":
+                    self.connection_state = "connected"
+                    # a proxy blip heals silently (no 404) — observers still
+                    # hear about the recovery
+                    self._fire_reconnect()
+                failures = 0
+            if failures >= self._DEGRADED_AFTER:
+                self.connection_state = "degraded"
 
     def serve(self) -> None:
         """Blocking entrypoint for standalone agent processes. Registration

@@ -447,15 +447,9 @@ class InferenceEngine:
             from agentfield_tpu.parallel.mesh import AXIS_MODEL, AXIS_SEQ
             from agentfield_tpu.parallel.sharding import check_divisibility, shard_params
 
-            tp = mesh.shape.get(AXIS_MODEL, 1)
-            if tp > 1:
-                # Pallas impls run under shard_map over the (KV-)head axis —
-                # see ops/paged_attention.py and models/llama.py attend() — so
-                # TP composes with both the ref GSPMD path and the kernels
-                # (north-star config 5: 70B TP=8 on the paged kernel).
-                check_divisibility(cfg, tp, paged_kv=True)
-                params = shard_params(params, cfg, mesh)
             if self.ecfg.prefill_impl == "ring":
+                # Pure config checks first — rejecting AFTER shard_params
+                # would pay a full 70B weight placement for nothing.
                 sp = mesh.shape.get(AXIS_SEQ, 1)
                 if sp < 2:
                     raise ValueError(
@@ -471,6 +465,14 @@ class InferenceEngine:
                         f"dividing max_context={self.ecfg.max_context} "
                         "(prefill buckets are powers of two >= 16)"
                     )
+            tp = mesh.shape.get(AXIS_MODEL, 1)
+            if tp > 1:
+                # Pallas impls run under shard_map over the (KV-)head axis —
+                # see ops/paged_attention.py and models/llama.py attend() — so
+                # TP composes with both the ref GSPMD path and the kernels
+                # (north-star config 5: 70B TP=8 on the paged kernel).
+                check_divisibility(cfg, tp, paged_kv=True)
+                params = shard_params(params, cfg, mesh)
         elif self.ecfg.prefill_impl == "ring":
             raise ValueError("prefill_impl='ring' requires a mesh (sequence-parallel)")
         self.params = params

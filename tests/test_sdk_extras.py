@@ -89,3 +89,64 @@ async def test_dashboard_served():
 
         js = re.search(r"<script>(.*)</script>", text, re.S).group(1)
         assert js.count("{") == js.count("}") and js.count("`") % 2 == 0
+
+
+@async_test
+async def test_connection_manager_degraded_and_reconnect():
+    """Link-state machine (reference ConnectionManager): heartbeat failures
+    flip the agent to degraded (surfaced in /health) while it keeps serving;
+    when the control plane comes back — fresh process, same address — the
+    agent re-registers, returns to connected, and fires on_reconnect."""
+    import aiohttp
+    from aiohttp import web as _web
+
+    from agentfield_tpu.control_plane.server import ControlPlane, create_app
+    from tests.helpers_cp import free_port
+
+    port = free_port()
+
+    async def boot_cp():
+        cp = ControlPlane()
+        runner = _web.AppRunner(create_app(cp))
+        await runner.setup()
+        site = _web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return cp, runner
+
+    cp1, runner1 = await boot_cp()
+    agent = Agent("flaky", control_plane=f"http://127.0.0.1:{port}",
+                  heartbeat_interval=0.05)
+    agent.reasoner(id="ping")(lambda: "pong")
+    events: list[str] = []
+    agent.on_reconnect(lambda: events.append("reconnected"))
+    await agent.start()
+    try:
+        assert agent.connection_state == "connected"
+        # control plane goes away -> degraded after a few missed beats
+        await cp1.stop()
+        await runner1.cleanup()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if agent.connection_state == "degraded":
+                break
+        assert agent.connection_state == "degraded"
+        # agent keeps serving locally while degraded
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{agent.port}/health") as r:
+                doc = await r.json()
+                assert doc["status"] == "ok" and doc["control_plane"] == "degraded"
+        # a NEW control plane at the same address: 404 -> re-register -> connected
+        cp2, runner2 = await boot_cp()
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.05)
+                if agent.connection_state == "connected":
+                    break
+            assert agent.connection_state == "connected"
+            assert events == ["reconnected"]
+            assert cp2.storage.get_node("flaky") is not None  # re-registered
+        finally:
+            await cp2.stop()
+            await runner2.cleanup()
+    finally:
+        await agent.stop()

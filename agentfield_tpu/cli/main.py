@@ -90,8 +90,10 @@ def cmd_server(cfg: Config, args) -> int:
     from agentfield_tpu.control_plane.server import ControlPlane, run_server
 
     async def main():
-        db = os.path.expanduser(cfg.server.db_path)
-        Path(db).parent.mkdir(parents=True, exist_ok=True)
+        db = cfg.server.db_path
+        if "://" not in db:  # a postgres:// DSN is not a filesystem path
+            db = os.path.expanduser(db)
+            Path(db).parent.mkdir(parents=True, exist_ok=True)
         port = args.port or cfg.server.port
         cp = ControlPlane(
             db_path=db,

@@ -18,7 +18,6 @@ from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.gateway import EXEC_TOPIC, ExecutionGateway, GatewayError
 from agentfield_tpu.control_plane.metrics import Metrics
 from agentfield_tpu.control_plane.registry import NODE_TOPIC, NodeRegistry, RegistryError
-from agentfield_tpu.control_plane.storage import SQLiteStorage
 from agentfield_tpu.control_plane.types import ExecutionStatus, now
 from agentfield_tpu.control_plane.webhooks import WebhookDispatcher
 
@@ -53,8 +52,12 @@ class ControlPlane:
         health_interval: float = 30.0,  # active probe cadence (health_monitor.go)
     ):
         from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
+        from agentfield_tpu.control_plane.storage_pg import create_storage
 
-        self.storage = SQLiteStorage(db_path)
+        # db_path doubles as a storage URL: a postgres:// DSN selects the
+        # shared-database provider (multi-instance deployments), anything
+        # else is a SQLite path (reference: StorageFactory.CreateStorage).
+        self.storage = create_storage(db_path)
         if keystore_path:
             seed = Keystore(keystore_path, keystore_passphrase).load_or_create_seed()
         else:

@@ -787,12 +787,14 @@ class Agent:
                     except Exception:
                         pass
                     else:
-                        # the node is live on the fresh plane NOW — that is
-                        # the recovery, not the next heartbeat
+                        # The node is live on the fresh plane NOW — that is
+                        # the recovery, not the next heartbeat. A 404 proves
+                        # the plane lost our registration (restart), so
+                        # observers resync even if we never went degraded
+                        # (fast restart between two heartbeats).
                         failures = 0
-                        if self.connection_state == "degraded":
-                            self.connection_state = "connected"
-                            self._fire_reconnect()
+                        self.connection_state = "connected"
+                        self._fire_reconnect()
             except Exception:
                 failures += 1  # transient; keep heartbeating
             else:

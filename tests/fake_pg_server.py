@@ -1,0 +1,236 @@
+"""A minimal PostgreSQL server for tests: v3 wire protocol with real
+SCRAM-SHA-256 authentication, executing translated SQL on an in-process
+SQLite database.
+
+This lets the Postgres storage provider + pure-Python wire client
+(control_plane/pgwire.py, storage_pg.py) be exercised end-to-end over a
+real socket — startup, SASL exchange, simple queries, text-format rows,
+error cycles — without a postgres install (none exists in this image).
+SQL dialect differences vs real PG remain untested by design; the provider
+keeps its statements dialect-neutral."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+
+def _to_sqlite(sql: str) -> str:
+    sql = re.sub(r"'\\x([0-9a-fA-F]*)'::bytea", lambda m: f"X'{m.group(1)}'", sql)
+    sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
+    sql = re.sub(r"\bDOUBLE PRECISION\b", "REAL", sql)
+    sql = re.sub(r"\bTRUE\b", "1", sql)
+    sql = re.sub(r"\bFALSE\b", "0", sql)
+    return sql
+
+
+def _oid_for(values) -> int:
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bytes):
+            return 17
+        if isinstance(v, bool):
+            return 16
+        if isinstance(v, int):
+            return 20
+        if isinstance(v, float):
+            return 701
+        return 25
+    return 25
+
+
+def _text(v) -> bytes | None:
+    if v is None:
+        return None
+    if isinstance(v, bytes):
+        return b"\\x" + v.hex().encode()
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+class _Reader:
+    """Per-connection byte buffer — recv() chunks don't align to messages."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._buf = b""
+
+    def exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._conn.recv(65536)
+            if not chunk:
+                raise ConnectionError("client gone")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+
+class FakePgServer:
+    """One-database fake. `password` is what SCRAM verifies against."""
+
+    def __init__(self, password: str = "hunter2"):
+        self.password = password
+        self._db = sqlite3.connect(":memory:", check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.auth_log: list[str] = []
+
+    def start(self) -> "FakePgServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- framing --------------------------------------------------------
+
+    @staticmethod
+    def _send(conn, type_: bytes, payload: bytes) -> None:
+        conn.sendall(type_ + struct.pack("!I", len(payload) + 4) + payload)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    # -- auth -----------------------------------------------------------
+
+    def _scram(self, conn, rd: _Reader) -> bool:
+        self._send(conn, b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00")
+        type_, payload = self._recv_msg(rd)
+        assert type_ == b"p"
+        mech_end = payload.index(b"\x00")
+        assert payload[:mech_end] == b"SCRAM-SHA-256"
+        (ln,) = struct.unpack("!I", payload[mech_end + 1 : mech_end + 5])
+        client_first = payload[mech_end + 5 : mech_end + 5 + ln].decode()
+        bare = client_first.split(",", 2)[2]
+        cnonce = dict(p.split("=", 1) for p in bare.split(","))["r"]
+        snonce = cnonce + base64.b64encode(os.urandom(12)).decode()
+        salt, iters = os.urandom(16), 4096
+        server_first = f"r={snonce},s={base64.b64encode(salt).decode()},i={iters}"
+        self._send(conn, b"R", struct.pack("!I", 11) + server_first.encode())
+
+        type_, payload = self._recv_msg(rd)
+        assert type_ == b"p"
+        client_final = payload.decode()
+        fields = dict(p.split("=", 1) for p in client_final.split(","))
+        wo_proof = client_final.rsplit(",p=", 1)[0]
+        auth_msg = ",".join([bare, server_first, wo_proof]).encode()
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt, iters)
+        client_key = hmac.digest(salted, b"Client Key", "sha256")
+        stored = hashlib.sha256(client_key).digest()
+        sig = hmac.digest(stored, auth_msg, "sha256")
+        expect = bytes(a ^ b for a, b in zip(client_key, sig))
+        if base64.b64decode(fields["p"]) != expect or fields["r"] != snonce:
+            self.auth_log.append("scram-fail")
+            self._send(
+                conn,
+                b"E",
+                b"SFATAL\x00C28P01\x00Mpassword authentication failed\x00\x00",
+            )
+            return False
+        self.auth_log.append("scram-ok")
+        server_key = hmac.digest(salted, b"Server Key", "sha256")
+        v = base64.b64encode(hmac.digest(server_key, auth_msg, "sha256")).decode()
+        self._send(conn, b"R", struct.pack("!I", 12) + f"v={v}".encode())
+        self._send(conn, b"R", struct.pack("!I", 0))
+        return True
+
+    def _recv_msg(self, rd: _Reader) -> tuple[bytes, bytes]:
+        head = rd.exact(5)
+        (length,) = struct.unpack("!I", head[1:])
+        return head[:1], rd.exact(length - 4)
+
+    # -- session --------------------------------------------------------
+
+    def _serve(self, conn) -> None:
+        rd = _Reader(conn)
+        try:
+            (length,) = struct.unpack("!I", rd.exact(4))
+            body = rd.exact(length - 4)
+            (proto,) = struct.unpack("!I", body[:4])
+            if proto == 80877103:  # SSLRequest → not supported
+                conn.sendall(b"N")
+                (length,) = struct.unpack("!I", rd.exact(4))
+                body = rd.exact(length - 4)
+            if not self._scram(conn, rd):
+                conn.close()
+                return
+            self._send(conn, b"S", b"server_version\x00fake-16\x00")
+            self._send(conn, b"Z", b"I")
+            while True:
+                type_, payload = self._recv_msg(rd)
+                if type_ == b"X":
+                    conn.close()
+                    return
+                if type_ != b"Q":
+                    continue
+                sql = payload.rstrip(b"\x00").decode()
+                self._run_query(conn, sql)
+                self._send(conn, b"Z", b"I")
+        except (ConnectionError, OSError):
+            pass
+
+    def _run_query(self, conn, sql: str) -> None:
+        verb = (sql.split() or ["?"])[0].upper()
+        try:
+            with self._db_lock:
+                cur = self._db.execute(_to_sqlite(sql))
+                rows = cur.fetchall() if cur.description else []
+                self._db.commit()
+        except sqlite3.Error as e:
+            self._send(
+                conn,
+                b"E",
+                b"SERROR\x00CXX000\x00M" + str(e).encode() + b"\x00\x00",
+            )
+            return
+        if cur.description:
+            names = [d[0] for d in cur.description]
+            cols = b"" + struct.pack("!H", len(names))
+            for i, name in enumerate(names):
+                oid = _oid_for([r[i] for r in rows])
+                cols += name.encode() + b"\x00"
+                cols += struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+            self._send(conn, b"T", cols)
+            for r in rows:
+                out = struct.pack("!H", len(r))
+                for v in r:
+                    t = _text(v)
+                    if t is None:
+                        out += struct.pack("!i", -1)
+                    else:
+                        out += struct.pack("!i", len(t)) + t
+                self._send(conn, b"D", out)
+            tag = f"SELECT {len(rows)}"
+        elif verb == "INSERT":
+            tag = f"INSERT 0 {cur.rowcount if cur.rowcount > 0 else 0}"
+        elif verb in ("UPDATE", "DELETE"):
+            tag = f"{verb} {max(cur.rowcount, 0)}"
+        else:
+            tag = verb
+        self._send(conn, b"C", tag.encode() + b"\x00")

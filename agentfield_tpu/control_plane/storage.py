@@ -206,8 +206,8 @@ class SQLiteStorage:
             row = self._conn.execute(
                 """
                 SELECT COUNT(*) AS n,
-                       SUM(status = 'completed') AS ok,
-                       SUM(status IN ('failed', 'timeout')) AS bad,
+                       SUM(CASE WHEN status = 'completed' THEN 1 ELSE 0 END) AS ok,
+                       SUM(CASE WHEN status IN ('failed', 'timeout') THEN 1 ELSE 0 END) AS bad,
                        MIN(created_at) AS first_seen,
                        MAX(created_at) AS last_seen
                 FROM executions WHERE target = ?
@@ -271,11 +271,11 @@ class SQLiteStorage:
                        COUNT(*) AS n,
                        MIN(created_at) AS started_at,
                        MAX(COALESCE(finished_at, 0)) AS finished_at,
-                       SUM(status = 'failed') AS failed,
-                       SUM(status = 'timeout') AS timed_out,
-                       SUM(status = 'running') AS running,
-                       SUM(status = 'queued') AS queued,
-                       GROUP_CONCAT(DISTINCT target) AS targets
+                       SUM(CASE WHEN status = 'failed' THEN 1 ELSE 0 END) AS failed,
+                       SUM(CASE WHEN status = 'timeout' THEN 1 ELSE 0 END) AS timed_out,
+                       SUM(CASE WHEN status = 'running' THEN 1 ELSE 0 END) AS running,
+                       SUM(CASE WHEN status = 'queued' THEN 1 ELSE 0 END) AS queued,
+                       MIN(target) AS a_target
                 FROM executions
                 GROUP BY run_id
                 ORDER BY started_at DESC
@@ -283,6 +283,17 @@ class SQLiteStorage:
                 """,
                 (limit,),
             ).fetchall()
+            # distinct targets per run in a second portable query
+            # (GROUP_CONCAT is SQLite-only; string_agg is PG-only)
+            targets: dict[str, list[str]] = {}
+            if rows:
+                run_ids = [r["run_id"] for r in rows]
+                ph = ",".join("?" * len(run_ids))
+                for tr in self._conn.execute(
+                    f"SELECT DISTINCT run_id, target FROM executions WHERE run_id IN ({ph})",
+                    run_ids,
+                ).fetchall():
+                    targets.setdefault(tr["run_id"], []).append(tr["target"])
         out = []
         for r in rows:
             if r["failed"]:
@@ -302,7 +313,7 @@ class SQLiteStorage:
                     "executions": r["n"],
                     "started_at": r["started_at"],
                     "finished_at": r["finished_at"] or None,
-                    "targets": sorted((r["targets"] or "").split(",")),
+                    "targets": sorted(targets.get(r["run_id"], [])),
                 }
             )
         return out
@@ -495,22 +506,23 @@ class SQLiteStorage:
     # -- distributed locks ---------------------------------------------
 
     def acquire_lock(self, name: str, owner: str, ttl: float) -> bool:
-        """DB-backed lock with TTL (reference: internal/storage/locks.go)."""
+        """DB-backed lock with TTL (reference: internal/storage/locks.go).
+
+        ONE atomic upsert — the steal/renew condition lives in the DO UPDATE
+        WHERE clause, so two instances racing on a shared database (the
+        Postgres deployment path) cannot both win: the second one's UPDATE
+        matches zero rows and rowcount reports it lost."""
         t = time.time()
         with self._lock:
-            row = self._conn.execute(
-                "SELECT owner, expires_at FROM locks WHERE name=?", (name,)
-            ).fetchone()
-            if row and row["expires_at"] > t and row["owner"] != owner:
-                return False
-            self._conn.execute(
+            cur = self._conn.execute(
                 "INSERT INTO locks(name,owner,expires_at) VALUES(?,?,?) "
                 "ON CONFLICT(name) DO UPDATE SET owner=excluded.owner, "
-                "expires_at=excluded.expires_at",
-                (name, owner, t + ttl),
+                "expires_at=excluded.expires_at "
+                "WHERE locks.expires_at <= ? OR locks.owner = excluded.owner",
+                (name, owner, t + ttl, t),
             )
             self._conn.commit()
-        return True
+        return cur.rowcount > 0
 
     def release_lock(self, name: str, owner: str) -> bool:
         with self._lock:

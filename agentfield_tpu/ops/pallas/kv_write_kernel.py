@@ -1,0 +1,124 @@
+"""Pallas TPU paged KV-cache write (decode hot path).
+
+Each decode step appends one token's K/V per sequence into its current
+page: a [B]-row scatter at (page_idx[b], :, slot_idx[b], :). XLA lowers
+that advanced-index scatter poorly on TPU (row-serialized scatter loop);
+this kernel instead walks the batch on the grid, DMAs each sequence's
+single page to VMEM, patches one slot, and writes it back — with
+input/output aliasing so the pool is updated in place.
+
+Page-collision note: live sequences own their pages exclusively, so grid
+steps touch disjoint pages — except the garbage page 0 shared by inactive
+rows, whose content is meaningless by contract (any write order is fine).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kv_write_kernel(
+    page_idx_ref,  # [B] int32 (scalar prefetch)
+    slot_idx_ref,  # [B] int32 (scalar prefetch)
+    kp_ref,  # [1, Kh, ps, hd] — the page this row writes into
+    vp_ref,  # [1, Kh, ps, hd]
+    kn_ref,  # [1, Kh, hd]
+    vn_ref,  # [1, Kh, hd]
+    kp_out,  # [1, Kh, ps, hd] (aliased with the pool)
+    vp_out,  # [1, Kh, ps, hd]
+):
+    b = pl.program_id(0)
+    slot = slot_idx_ref[b]
+    # Carry the page through (out VMEM blocks start uninitialized), then
+    # patch the one slot this token occupies.
+    kp_out[...] = kp_ref[...]
+    vp_out[...] = vp_ref[...]
+    kp_out[0, :, pl.dslice(slot, 1), :] = kn_ref[0][:, None, :]
+    vp_out[0, :, pl.dslice(slot, 1), :] = vn_ref[0][:, None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def kv_write_pallas(
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [B, Kh, hd]
+    v_new: jax.Array,
+    page_idx: jax.Array,  # [B] int32 (page 0 = garbage for inactive rows)
+    slot_idx: jax.Array,  # [B] int32
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    P, Kh, ps, hd = k_pages.shape
+    B = k_new.shape[0]
+    spec_page = pl.BlockSpec(
+        (1, Kh, ps, hd), lambda b, pi, si: (pi[b], 0, 0, 0), memory_space=pltpu.VMEM
+    )
+    spec_new = pl.BlockSpec(
+        (1, Kh, hd), lambda b, pi, si: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[spec_page, spec_page, spec_new, spec_new],
+        out_specs=[spec_page, spec_page],
+    )
+    return pl.pallas_call(
+        _kv_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # operand numbering includes the two scalar-prefetch args
+        input_output_aliases={2: 0, 3: 1},
+        cost_estimate=pl.CostEstimate(
+            flops=0,
+            bytes_accessed=4 * B * Kh * ps * hd * k_pages.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(page_idx, slot_idx, k_pages, v_pages, k_new, v_new)
+
+
+def kv_write(k_pages, v_pages, k_new, v_new, page_idx, slot_idx, impl="ref", mesh=None):
+    """Dispatch the decode-step KV append. impl='ref' is the XLA scatter;
+    'pallas' is the per-page patch kernel. With a TP mesh the kernel runs
+    under shard_map over the KV-head axis — the pool and the new K/V shard
+    identically, so each shard patches its own heads with no collectives."""
+    if impl == "ref":
+        k_pages = k_pages.at[page_idx, :, slot_idx].set(k_new)
+        v_pages = v_pages.at[page_idx, :, slot_idx].set(v_new)
+        return k_pages, v_pages
+    if impl != "pallas":
+        raise ValueError(f"unknown kv_write impl {impl!r}")
+    interpret = jax.default_backend() == "cpu"
+    fn = functools.partial(kv_write_pallas, interpret=interpret)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+        if mesh.shape.get(AXIS_MODEL, 1) > 1:
+            fn = shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, AXIS_MODEL, None),
+                    P(None, AXIS_MODEL, None),
+                    P(None),
+                    P(None),
+                ),
+                out_specs=(
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, AXIS_MODEL, None, None),
+                ),
+                check_rep=False,
+            )
+    return fn(k_pages, v_pages, k_new, v_new, page_idx, slot_idx)

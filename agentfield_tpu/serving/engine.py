@@ -40,6 +40,7 @@ import numpy as np
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
 from agentfield_tpu.ops.paged_attention import paged_attention
+from agentfield_tpu.ops.pallas.kv_write_kernel import kv_write
 from agentfield_tpu.serving.grammar import Grammar
 from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache, build_page_table
 from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
@@ -57,6 +58,10 @@ class EngineConfig:
     max_pending: int = 1024  # admission queue bound (reference queue default:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
     attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
+    kv_write_impl: str = "ref"  # decode KV append: "ref" (XLA scatter) |
+    # "pallas" (per-page patch kernel — XLA lowers the [B]-row advanced-index
+    # scatter as a serialized loop on TPU; the kernel DMAs each row's page,
+    # patches one slot, writes back in place)
     prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas) |
     # "ring" (sequence-parallel prefill over the mesh's `seq` axis — the
     # long-context serving path: no device materializes full-context
@@ -192,10 +197,12 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
             # kp: [P, Kh, ps, hd]; write row b's new K at (page_idx[b], :,
-            # slot_idx[b], :) — non-adjacent advanced indices put the batch
-            # dim first, matching k[:, 0]'s [B, Kh, hd].
-            kp = kp.at[page_idx, :, slot_idx].set(k[:, 0])
-            vp = vp.at[page_idx, :, slot_idx].set(v[:, 0])
+            # slot_idx[b], :) — ref: advanced-index scatter (batch dim first,
+            # matching k[:, 0]'s [B, Kh, hd]); pallas: per-page patch kernel.
+            kp, vp = kv_write(
+                kp, vp, k[:, 0], v[:, 0], page_idx, slot_idx,
+                impl=ecfg.kv_write_impl, mesh=mesh,
+            )
             attn = paged_attention(
                 q[:, 0], kp, vp, page_tables, seq_lens + 1,
                 impl=ecfg.attn_impl, mesh=mesh,

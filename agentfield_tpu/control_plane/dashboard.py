@@ -70,7 +70,7 @@ const fmtT = (t) => t ? new Date(t * 1000).toLocaleTimeString() : '';
 const stat = (s) => `<span class="${esc(s)}">${esc(s)}</span>`;
 
 const PAGES = [['','dashboard'],['nodes','nodes'],['execs','executions'],
-  ['runs','workflows'],['reasoners','reasoners'],['did','did / vc'],['memory','memory']];
+  ['runs','workflows'],['reasoners','reasoners'],['mcp','mcp'],['did','did / vc'],['memory','memory']];
 function nav() {
   const cur = location.hash.replace(/^#\\/?/, '').split('/')[0];
   $('nav').innerHTML = PAGES.map(([p, label]) =>
@@ -292,6 +292,37 @@ async function pgMemory() {
   done();
 }
 
+async function pgMcp() {
+  const doc = await J('/api/v1/mcp/servers');
+  const servers = doc.servers || [];
+  $('page').innerHTML = `
+    <h2>mcp servers</h2>
+    <table><tr><th>alias</th><th>state</th><th>pid</th><th>restarts</th>
+      <th>tools</th><th>resources</th><th>last error</th><th></th></tr>
+    ${servers.map(s => `<tr>
+      <td>${esc(s.alias)}</td>
+      <td class="${s.state === 'running' ? 'ok' : s.state === 'failed' ? 'error' : 'dim'}">${esc(s.state)}</td>
+      <td class="dim">${s.pid ?? ''}</td><td class="dim">${s.restarts}</td>
+      <td>${s.tools}</td><td>${s.resources}</td>
+      <td class="dim">${esc(s.last_error || '')}</td>
+      <td>${s.state === 'running'
+        ? `<button data-mcp="stop" data-alias="${esc(s.alias)}">stop</button>
+           <button data-mcp="restart" data-alias="${esc(s.alias)}">restart</button>`
+        : `<button data-mcp="start" data-alias="${esc(s.alias)}">start</button>`}</td>
+    </tr>`).join('')}</table>
+    ${servers.length ? '' : '<p class="dim">no MCP servers configured (POST /api/v1/mcp/servers)</p>'}
+    <div id="mcptools"></div>`;
+  document.querySelectorAll('[data-mcp]').forEach(b => b.onclick = async () => {
+    const r = await fetch('/api/v1/mcp/servers/' + encodeURIComponent(b.getAttribute('data-alias')) +
+      '/' + b.getAttribute('data-mcp'), {method: 'POST'});
+    if (!location.hash.startsWith('#/mcp')) return;  // user navigated away
+    if (!r.ok) { $('page').insertAdjacentHTML('afterbegin',
+      `<p class="error">${esc((await r.json()).error || r.status)}</p>`); return; }
+    pgMcp();
+  });
+  done();
+}
+
 // ---- router -----------------------------------------------------------
 async function route() {
   nav(); setRefresh(null, 0);
@@ -302,6 +333,7 @@ async function route() {
     else if (p === 'execs') await pgExecs(id);
     else if (p === 'runs') { await pgRuns(id); if (id) setRefresh(() => pgRuns(id), 4000); }
     else if (p === 'reasoners') { await pgReasoners(); setRefresh(pgReasoners, 6000); }
+    else if (p === 'mcp') { await pgMcp(); setRefresh(pgMcp, 5000); }
     else if (p === 'did') await pgDid();
     else if (p === 'memory') await pgMemory();
     else { await pgDash(); setRefresh(pgDash, 3000); }

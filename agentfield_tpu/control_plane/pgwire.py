@@ -157,6 +157,7 @@ class PgClient:
         # control plane's event loop forever (storage calls are synchronous)
     ):
         self.parameters: dict[str, str] = {}
+        self._dead: str | None = None
         self._sock = socket.create_connection((host, port), timeout=connect_timeout)
         self._sock.settimeout(read_timeout)
         self._buf = b""
@@ -176,14 +177,25 @@ class PgClient:
             try:
                 chunk = self._sock.recv(65536)
             except TimeoutError as e:
-                # mid-message timeout: the stream position is lost — the
-                # connection is unusable, fail it rather than hang
+                # Mid-message timeout: the stream position is lost. POISON
+                # the connection — a late-arriving reply consumed by the
+                # next query would silently return wrong results.
+                self._poison("postgres read timed out")
                 raise ConnectionError("postgres read timed out") from e
             if not chunk:
+                self._poison("server closed the connection")
                 raise ConnectionError("postgres server closed the connection")
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
+
+    def _poison(self, reason: str) -> None:
+        self._dead = reason
+        self._buf = b""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def _recv_msg(self) -> tuple[bytes, bytes]:
         head = self._recv_exact(5)
@@ -258,6 +270,8 @@ class PgClient:
     def query(self, sql: str) -> tuple[list[tuple[str, int]], list[list[Any]], str]:
         """Run one statement. Returns (columns [(name, oid)], rows with
         OID-cast values, command tag)."""
+        if self._dead:
+            raise ConnectionError(f"postgres connection is dead: {self._dead}")
         self._send(b"Q", sql.encode() + b"\x00")
         cols: list[tuple[str, int]] = []
         rows: list[list[Any]] = []

@@ -274,8 +274,7 @@ class SQLiteStorage:
                        SUM(CASE WHEN status = 'failed' THEN 1 ELSE 0 END) AS failed,
                        SUM(CASE WHEN status = 'timeout' THEN 1 ELSE 0 END) AS timed_out,
                        SUM(CASE WHEN status = 'running' THEN 1 ELSE 0 END) AS running,
-                       SUM(CASE WHEN status = 'queued' THEN 1 ELSE 0 END) AS queued,
-                       MIN(target) AS a_target
+                       SUM(CASE WHEN status = 'queued' THEN 1 ELSE 0 END) AS queued
                 FROM executions
                 GROUP BY run_id
                 ORDER BY started_at DESC

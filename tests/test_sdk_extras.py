@@ -77,7 +77,7 @@ async def test_dashboard_served():
         # page inventory (hash routes) + the APIs they consume
         for marker in (
             "pgDash", "pgNodes", "pgExecs", "pgRuns", "pgReasoners", "pgDid",
-            "pgMemory",
+            "pgMemory", "pgMcp", "/api/v1/mcp/servers",
             "/api/ui/v1/summary", "/api/v1/nodes", "/api/v1/executions",
             "/api/v1/workflows/", "/api/v1/reasoners", "/api/v1/did/org",
             "/api/v1/vc/verify", "/api/v1/memory", "/api/v1/events/executions",

@@ -310,14 +310,17 @@ async function pgMcp() {
            <button data-mcp="restart" data-alias="${esc(s.alias)}">restart</button>`
         : `<button data-mcp="start" data-alias="${esc(s.alias)}">start</button>`}</td>
     </tr>`).join('')}</table>
-    ${servers.length ? '' : '<p class="dim">no MCP servers configured (POST /api/v1/mcp/servers)</p>'}
-    <div id="mcptools"></div>`;
+    ${servers.length ? '' : '<p class="dim">no MCP servers configured (POST /api/v1/mcp/servers)</p>'}`;
   document.querySelectorAll('[data-mcp]').forEach(b => b.onclick = async () => {
     const r = await fetch('/api/v1/mcp/servers/' + encodeURIComponent(b.getAttribute('data-alias')) +
       '/' + b.getAttribute('data-mcp'), {method: 'POST'});
     if (!location.hash.startsWith('#/mcp')) return;  // user navigated away
-    if (!r.ok) { $('page').insertAdjacentHTML('afterbegin',
-      `<p class="error">${esc((await r.json()).error || r.status)}</p>`); return; }
+    if (!r.ok) {
+      let msg = 'HTTP ' + r.status;
+      try { msg = (await r.json()).error || msg; } catch (_) {}
+      $('page').insertAdjacentHTML('afterbegin', `<p class="error">${esc(msg)}</p>`);
+      return;
+    }
     pgMcp();
   });
   done();

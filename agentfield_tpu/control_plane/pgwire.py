@@ -273,6 +273,18 @@ class PgClient:
         if self._dead:
             raise ConnectionError(f"postgres connection is dead: {self._dead}")
         self._send(b"Q", sql.encode() + b"\x00")
+        try:
+            return self._read_query_cycle()
+        except PgError:
+            raise  # clean cycle: the stream was consumed through ReadyForQuery
+        except Exception as e:
+            # Any OTHER mid-response failure (unexpected message type, decode
+            # error, reset) leaves the stream position unknown — poison, or
+            # the next query would consume this one's leftover reply.
+            self._poison(f"protocol failure mid-query: {e!r}")
+            raise
+
+    def _read_query_cycle(self) -> tuple[list[tuple[str, int]], list[list[Any]], str]:
         cols: list[tuple[str, int]] = []
         rows: list[list[Any]] = []
         tag = ""

@@ -1,0 +1,150 @@
+"""Pallas TPU paged CHUNK attention (suffix / chunked prefill).
+
+A chunk of C query tokens (one sequence) at absolute positions
+[start, start+C) attends over the sequence's paged K/V — including the
+chunk's own freshly-written keys — with an exact causal mask on absolute
+positions. This replaces the suffix-prefill path's per-layer page gather
+(engine `_suffix_prefill_fn` materializes [max_context, Kh, hd] K/V in HBM
+for EVERY layer of EVERY chunk — VERDICT weak #7: chunked long-prompt
+prefill pays O(chunks × T × L) bandwidth); here pages stream HBM→VMEM once
+per (kv-head, page) grid step and the gathered context never exists.
+
+Same online-softmax page walk as the decode kernel
+(paged_attention_kernel.py), widened to C query rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _chunk_kernel(
+    page_table_ref,  # [maxp] int32 (scalar prefetch)
+    start_ref,  # [1] int32 — absolute position of the chunk's first token
+    k_len_ref,  # [1] int32 — total valid keys (start + n_new)
+    q_ref,  # [1, C, rep, hd]
+    k_ref,  # [1, 1, ps, hd] — the (kv-head, page) tile
+    v_ref,  # [1, 1, ps, hd]
+    o_ref,  # [1, C, rep, hd]
+    m_scr,  # [C * rep, 1] f32
+    l_scr,  # [C * rep, 1] f32
+    acc_scr,  # [C * rep, hd] f32
+    *,
+    sm_scale: float,
+    page_size: int,
+    num_page_steps: int,
+    rep: int,
+):
+    pi = pl.program_id(1)
+    start = start_ref[0]
+    k_len = k_len_ref[0]
+    C = q_ref.shape[1]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Page is relevant iff it holds any key with pos < k_len (valid) — keys
+    # past every query position mask out below anyway.
+    @pl.when(pi * page_size < k_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32).reshape(C * rep, -1) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [C*rep, ps]
+        k_pos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
+        s = jnp.where((k_pos <= q_pos) & (k_pos < k_len), s, _NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(pi == num_page_steps - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, ...] = (acc_scr[...] / l).reshape(C, rep, -1).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def paged_chunk_attention_pallas(
+    q: jax.Array,  # [C, H, hd] — one sequence's chunk of query tokens
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,
+    page_table_row: jax.Array,  # [maxp] int32
+    start: jax.Array,  # scalar int32 — absolute position of q[0]
+    k_len: jax.Array,  # scalar int32 — valid keys (= start + n_new)
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    C, H, hd = q.shape
+    P, Kh, ps, _ = k_pages.shape
+    maxp = page_table_row.shape[0]
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    qg = q.reshape(C, Kh, rep, hd).transpose(1, 0, 2, 3)  # [Kh, C, rep, hd]
+    kernel = functools.partial(
+        _chunk_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp, rep=rep
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(Kh, maxp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, C, rep, hd), lambda kvh, pi, pt, st, kl: (kvh, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, ps, hd), lambda kvh, pi, pt, st, kl: (pt[pi], kvh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, ps, hd), lambda kvh, pi, pt, st, kl: (pt[pi], kvh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, C, rep, hd), lambda kvh, pi, pt, st, kl: (kvh, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((C * rep, 1), jnp.float32),
+            pltpu.VMEM((C * rep, 1), jnp.float32),
+            pltpu.VMEM((C * rep, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Kh, C, rep, hd), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * C * H * maxp * ps * hd,
+            bytes_accessed=2 * maxp * ps * Kh * hd * k_pages.dtype.itemsize,
+            transcendentals=C * H * maxp * ps,
+        ),
+        interpret=interpret,
+    )(page_table_row, start[None], k_len[None], qg, k_pages, v_pages)
+    return out.transpose(1, 0, 2, 3).reshape(C, H, hd)

@@ -369,7 +369,11 @@ def _prefill_inject_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=N
 def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     """Prefill `n_new` suffix tokens starting at absolute position `start`,
     attending over the session's CACHED pages as well as the freshly written
-    ones (prefix-cache hit path: only the suffix pays prefill FLOPs)."""
+    ones (prefix-cache hit path: only the suffix pays prefill FLOPs).
+
+    With ``attn_impl="pallas"`` the per-layer attention is the paged CHUNK
+    kernel — pages stream HBM→VMEM and the gathered [max_context] context is
+    never materialized (the ref path gathers it per layer per chunk)."""
     ps = ecfg.page_size
     maxp = ecfg.max_pages_per_seq
     T = maxp * ps
@@ -392,10 +396,24 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
             q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
             kp = kp.at[page_ids, :, slot_ids].set(k[0])
             vp = vp.at[page_ids, :, slot_ids].set(v[0])
-            # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
-            kk = kp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-            vv = vp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-            attn = llama.attention_ref(q, kk, vv, positions, k_pos, k_valid)
+            # Kernel VMEM (q/o blocks + f32 accumulator) scales with the
+            # chunk width; past ~512 rows it blows the ~16MB budget, so wide
+            # suffixes fall back to the gather path (set prefill_chunk to
+            # keep long prompts on the kernel).
+            if ecfg.attn_impl == "pallas" and bucket <= 512:
+                from agentfield_tpu.ops.pallas.paged_chunk_attention_kernel import (
+                    paged_chunk_attention_pallas,
+                )
+
+                attn = paged_chunk_attention_pallas(
+                    q[0], kp, vp, page_table_row, start, start + n_new,
+                    interpret=jax.default_backend() == "cpu",
+                )[None]
+            else:
+                # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
+                kk = kp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+                vv = vp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
+                attn = llama.attention_ref(q, kk, vv, positions, k_pos, k_valid)
             x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
             return x, (kp, vp)

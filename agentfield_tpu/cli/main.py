@@ -159,6 +159,9 @@ def cmd_model(cfg: Config, args) -> int:
             attn_impl=mn.attn_impl,
             prefill_impl=mn.prefill_impl,
             prefill_chunk=mn.prefill_chunk,
+            decode_span=mn.decode_span,
+            kv_write_impl=mn.kv_write_impl,
+            grammar_slots=mn.grammar_slots,
         )
         agent, backend = build_model_node(
             args.name or "model",
@@ -167,6 +170,7 @@ def cmd_model(cfg: Config, args) -> int:
             ecfg=ecfg,
             checkpoint=args.checkpoint or mn.checkpoint,
             tp=mn.tp,
+            vision=mn.vision,
         )
         await backend.start()
         await agent.start()

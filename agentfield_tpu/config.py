@@ -57,6 +57,11 @@ class ModelNodeConfig:
     attn_impl: str = "ref"
     prefill_impl: str = "ref"
     prefill_chunk: int | None = None  # chunked prefill (>= 16) or whole-prompt
+    decode_span: int = 1  # decode steps per device dispatch (one token
+    # readback per span — set 8-16 on high-latency device links)
+    kv_write_impl: str = "ref"  # "ref" scatter | "pallas" page-patch kernel
+    grammar_slots: int = 256  # constrained-decoding bank rows (0 disables)
+    vision: str | None = None  # vision tower config name → serve image inputs
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 
 

@@ -55,24 +55,38 @@ def sample_tokens(
 
     temps = jnp.maximum(temperatures, 1e-6)[:, None]
     rng_full, rng_trunc = jax.random.split(rng)
-
-    # Full-vocab tempered sampling (exact for untruncated rows).
-    full = jax.random.categorical(rng_full, logits / temps, axis=-1).astype(jnp.int32)
-
-    # Truncated path inside the k_max candidate set.
-    vals, idxs = jax.lax.top_k(logits, k_max)  # [B, k_max] descending
-    scaled = vals / temps
-    ranks = jnp.arange(k_max, dtype=jnp.int32)[None, :]
-    k_eff = jnp.where(top_ks[:, None] > 0, jnp.minimum(top_ks[:, None], k_max), k_max)
-    k_mask = ranks < k_eff
-    # nucleus mask on the tempered distribution (keep first token always)
-    probs = jax.nn.softmax(jnp.where(k_mask, scaled, -jnp.inf), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    p_mask = (cum - probs) < jnp.minimum(top_ps, 1.0)[:, None]
-    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
-    choice = jax.random.categorical(rng_trunc, masked, axis=-1)
-    trunc = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
-
     truncated_row = (top_ks > 0) | (top_ps < 1.0)
-    sampled = jnp.where(truncated_row, trunc, full)
-    return jnp.where(temperatures <= 0, greedy, sampled)
+
+    def _sampled(_):
+        # Full-vocab tempered sampling (exact for untruncated rows).
+        full = jax.random.categorical(rng_full, logits / temps, axis=-1).astype(jnp.int32)
+
+        def _with_trunc(_):
+            # Truncated path inside the k_max candidate set.
+            vals, idxs = jax.lax.top_k(logits, k_max)  # [B, k_max] descending
+            scaled = vals / temps
+            ranks = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+            k_eff = jnp.where(
+                top_ks[:, None] > 0, jnp.minimum(top_ks[:, None], k_max), k_max
+            )
+            k_mask = ranks < k_eff
+            # nucleus mask on the tempered distribution (keep first token)
+            probs = jax.nn.softmax(jnp.where(k_mask, scaled, -jnp.inf), axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            p_mask = (cum - probs) < jnp.minimum(top_ps, 1.0)[:, None]
+            masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+            choice = jax.random.categorical(rng_trunc, masked, axis=-1)
+            trunc = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+            return jnp.where(truncated_row, trunc, full)
+
+        sampled = jax.lax.cond(
+            jnp.any(truncated_row), _with_trunc, lambda _: full, None
+        )
+        return jnp.where(temperatures <= 0, greedy, sampled)
+
+    # Data-dependent runtime skips: an all-greedy batch (the agentic common
+    # case) pays neither the categorical nor the top-k machinery; a batch
+    # with no truncated rows skips the top-k sort.
+    return jax.lax.cond(
+        jnp.any(temperatures > 0), _sampled, lambda _: greedy, None
+    )

@@ -25,7 +25,7 @@ import aiohttp
 
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
-from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
 from agentfield_tpu.control_plane.types import (
     AgentNode,
     Execution,
@@ -68,11 +68,21 @@ class ExecutionGateway:
         sync_wait_timeout: float = 600.0,
         async_workers: int = 8,
         queue_capacity: int = 1024,  # reference default (execute.go:1373)
-        webhook_notify=None,  # callable(execution) -> None
+        webhook_notify=None,  # async callable(execution) -> None
         payloads=None,  # PayloadStore | None — large payloads offload to files
+        db: AsyncStorage | None = None,  # shared async facade (built if absent)
     ):
         self.payloads = payloads
         self.storage = storage
+        # Awaitable storage: Postgres calls hop to a worker thread so a slow
+        # database can't stall the event loop (SQLite stays on-loop).
+        self.db = db if db is not None else AsyncStorage(storage)
+        # Completion serialization: with the thread-offloaded provider the
+        # event loop no longer serializes complete()'s read-check-write (the
+        # awaits yield), so a status callback racing the sync-wait timeout
+        # could double-complete. The reference dedicates one completion
+        # goroutine for the same reason (execute.go:1404-1429).
+        self._complete_lock = asyncio.Lock()
         self.bus = bus
         self.metrics = metrics
         self.agent_timeout = agent_timeout
@@ -118,7 +128,7 @@ class ExecutionGateway:
         if "." not in target:
             raise GatewayError(400, f"target {target!r} must be '<node>.<component>'")
         node_id, comp_name = target.split(".", 1)
-        node = self.storage.get_node(node_id)
+        node = await self.db.get_node(node_id)
         if node is None:
             raise GatewayError(404, f"unknown node {node_id!r}")
         if node.status not in (NodeStatus.ACTIVE, NodeStatus.STARTING):
@@ -146,9 +156,15 @@ class ExecutionGateway:
             started_at=now(),
         )
         try:
-            self.storage.create_execution(ex)
+            await self.db.create_execution(ex)
         except Exception as e:
-            if "UNIQUE" in str(e) or "PRIMARY KEY" in str(e):
+            # SQLite spells it "UNIQUE constraint failed"; Postgres raises
+            # SQLSTATE 23505 ("duplicate key value violates unique constraint")
+            if (
+                "UNIQUE" in str(e)
+                or "PRIMARY KEY" in str(e)
+                or getattr(e, "sqlstate", "") == "23505"
+            ):
                 raise GatewayError(
                     409, f"execution id {ex.execution_id!r} already exists"
                 ) from None
@@ -222,7 +238,7 @@ class ExecutionGateway:
         execution reaches a terminal state (execute.go:195-278)."""
         ex, node = await self._prepare(target, payload, headers, webhook_url, ExecutionStatus.RUNNING)
         await self._call_agent(node, ex)
-        current = self.storage.get_execution(ex.execution_id)
+        current = await self.db.get_execution(ex.execution_id)
         if current is not None and current.status.terminal:
             return current
         try:
@@ -233,7 +249,7 @@ class ExecutionGateway:
             )
         except TimeoutError:
             await self.complete(ex.execution_id, error="sync wait timeout", timeout=True)
-        return self.storage.get_execution(ex.execution_id)  # type: ignore[return-value]
+        return await self.db.get_execution(ex.execution_id)  # type: ignore[return-value]
 
     async def execute_async(
         self,
@@ -251,7 +267,7 @@ class ExecutionGateway:
             ex.status = ExecutionStatus.FAILED
             ex.error = "async queue at capacity"
             ex.finished_at = now()
-            self.storage.update_execution(ex)
+            await self.db.update_execution(ex)
             self.metrics.inc("gateway_backpressure_total")
             raise GatewayError(503, "async execution queue is full") from None
         self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
@@ -265,17 +281,17 @@ class ExecutionGateway:
                 self.metrics.inc("worker_dispatch_total")
                 # Re-read: the row may have gone terminal while queued (client
                 # status callback, cleanup) — never resurrect it.
-                fresh = self.storage.get_execution(ex.execution_id)
+                fresh = await self.db.get_execution(ex.execution_id)
                 if fresh is None or fresh.status.terminal:
                     continue
                 ex = fresh
                 node_id = ex.target.split(".", 1)[0]
-                node = self.storage.get_node(node_id)
+                node = await self.db.get_node(node_id)
                 if node is None:
                     await self.complete(ex.execution_id, error=f"node {node_id} vanished")
                     continue
                 ex.status = ExecutionStatus.RUNNING
-                self.storage.update_execution(ex)
+                await self.db.update_execution(ex)
                 self._publish(ex)
                 await self._call_agent(node, ex)
             except asyncio.CancelledError:
@@ -298,8 +314,20 @@ class ExecutionGateway:
     ) -> Execution | None:
         """Terminal-state transition: persist once, publish once, fire webhook
         (reference: completeExecution/failExecution, execute.go:831-919;
-        completions serialized — here by the event loop)."""
-        ex = self.storage.get_execution(execution_id)
+        completions serialized by _complete_lock — the thread-offloaded
+        storage provider yields the loop mid-transition, so loop ordering
+        alone no longer guarantees exactly-once)."""
+        async with self._complete_lock:
+            return await self._complete_locked(execution_id, result, error, timeout)
+
+    async def _complete_locked(
+        self,
+        execution_id: str,
+        result: Any = None,
+        error: str | None = None,
+        timeout: bool = False,
+    ) -> Execution | None:
+        ex = await self.db.get_execution(execution_id)
         if ex is None:
             return None
         if ex.status.terminal:
@@ -318,7 +346,7 @@ class ExecutionGateway:
             else:
                 ex.result = result
         ex.finished_at = now()
-        self.storage.update_execution(ex)
+        await self.db.update_execution(ex)
         self.metrics.inc(f"gateway_executions_{ex.status.value}_total")
         log.info(
             "execution terminal",
@@ -337,7 +365,7 @@ class ExecutionGateway:
                 import dataclasses as _dc
 
                 notify_ex = _dc.replace(ex, result=raw_result)
-            self.webhook_notify(notify_ex)
+            await self.webhook_notify(notify_ex)
         return ex
 
     async def handle_status_update(
@@ -349,10 +377,10 @@ class ExecutionGateway:
         if status in ("failed", "error"):
             return await self.complete(execution_id, error=error or "agent reported failure")
         if status == "running":
-            ex = self.storage.get_execution(execution_id)
+            ex = await self.db.get_execution(execution_id)
             if ex is not None and not ex.status.terminal:
                 ex.status = ExecutionStatus.RUNNING
-                self.storage.update_execution(ex)
+                await self.db.update_execution(ex)
                 self._publish(ex)
             return ex
         raise GatewayError(400, f"unknown status {status!r}")

@@ -62,7 +62,7 @@ class HealthMonitor:
                 self.registry.metrics.inc("health_probe_errors_total")
 
     async def probe_all(self) -> dict[str, bool]:
-        all_nodes = self.registry.storage.list_nodes()
+        all_nodes = await self.registry.db.list_nodes()
         # Prune state for deregistered ids — churn must not grow these maps,
         # and a re-registered id must not inherit a dead incarnation's probe.
         known = {n.node_id for n in all_nodes}
@@ -99,7 +99,7 @@ class HealthMonitor:
             # and probing resumes.
             try:
                 self.registry.fence(node.node_id, duration=self.interval * 2)
-                self.registry.heartbeat(node.node_id, {"status": "inactive"})
+                await self.registry.heartbeat(node.node_id, {"status": "inactive"})
             except Exception:
                 pass
             self.registry.metrics.inc("health_deactivations_total")

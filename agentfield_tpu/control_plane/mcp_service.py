@@ -85,8 +85,11 @@ class MCPService:
     """
 
     def __init__(self, storage, max_restarts: int = 3, restart_backoff: float = 0.5,
-                 capability_ttl: float = 300.0, log_lines: int = 200):
+                 capability_ttl: float = 300.0, log_lines: int = 200, db=None):
+        from agentfield_tpu.control_plane.storage import AsyncStorage
+
         self.storage = storage
+        self.db = db if db is not None else AsyncStorage(storage)
         self.max_restarts = max_restarts
         self.restart_backoff = restart_backoff
         self.capability_ttl = capability_ttl
@@ -124,7 +127,7 @@ class MCPService:
         m = self._get(alias)
         await self.stop(alias)
         del self._servers[alias]
-        self.storage.config_set(_CACHE_PREFIX + alias, None)
+        await self.db.config_set(_CACHE_PREFIX + alias, None)
         self._persist()
 
     def _get(self, alias: str) -> _Managed:
@@ -262,7 +265,7 @@ class MCPService:
         fresh (TTL) unless refresh=True; live discovery requires the server
         to be running and re-caches on success."""
         m = self._get(alias)
-        cached = self.storage.config_get(_CACHE_PREFIX + alias)
+        cached = await self.db.config_get(_CACHE_PREFIX + alias)
         if (
             not refresh
             and cached
@@ -279,7 +282,7 @@ class MCPService:
         except MCPError as e:
             raise MCPServiceError(f"discovery on {alias!r} failed: {e}") from e
         manifest = {"alias": alias, "tools": tools, "resources": resources, "ts": time.time()}
-        self.storage.config_set(_CACHE_PREFIX + alias, manifest)
+        await self.db.config_set(_CACHE_PREFIX + alias, manifest)
         self._apply_manifest(m, manifest)
         return manifest
 

@@ -18,8 +18,10 @@ import base64
 import hashlib
 import hmac
 import os
+import queue
 import socket
 import struct
+import threading
 from typing import Any
 from urllib.parse import unquote, urlparse
 
@@ -259,6 +261,21 @@ class PgClient:
             elif type_ == b"K":  # BackendKeyData
                 pass
             elif type_ == b"Z":  # ReadyForQuery
+                # escape_literal assumes standard_conforming_strings=on (''
+                # doubling, backslashes literal). A legacy server with it off
+                # would turn backslash sequences in user strings into escape
+                # sequences — data corruption and a client-side injection
+                # vector — so refuse the connection outright.
+                scs = self.parameters.get("standard_conforming_strings")
+                if scs != "on":
+                    self._poison("standard_conforming_strings is not on")
+                    raise PgError(
+                        {
+                            "M": "server reports standard_conforming_strings="
+                            f"{scs!r}; this client requires 'on' (PostgreSQL "
+                            "9.1+ default) for safe literal escaping"
+                        }
+                    )
                 return
             elif type_ == b"N":  # NoticeResponse
                 pass
@@ -375,31 +392,119 @@ def _tag_rowcount(tag: str) -> int:
     return -1
 
 
+class PgPool:
+    """Fixed-size lazy connection pool (reference rides pgx v5 pools,
+    control-plane/go.mod): concurrent storage calls each check out their own
+    connection instead of serializing on one socket. Connections are created
+    on demand up to ``size``; a poisoned/dead connection is discarded on
+    release and replaced lazily. The first connection is opened eagerly so a
+    bad DSN fails at startup, not on the first request."""
+
+    def __init__(self, dsn: str, size: int = 4, **connect_kw):
+        if size < 1:
+            raise ValueError(f"pool size must be >= 1, got {size}")
+        self._dsn = dsn
+        self._kw = connect_kw
+        self._size = size
+        self._q: queue.Queue[PgClient] = queue.Queue()
+        self._created = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._q.put(self._connect())
+
+    def _connect(self) -> PgClient:
+        with self._lock:
+            self._created += 1
+        try:
+            return PgClient.from_dsn(self._dsn, **self._kw)
+        except BaseException:
+            with self._lock:
+                self._created -= 1
+            raise
+
+    def acquire(self, timeout: float = 30.0) -> PgClient:
+        if self._closed:
+            raise ConnectionError("postgres pool is closed")
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            grow = self._created < self._size
+            if grow:
+                self._created += 1
+        if grow:
+            try:
+                return PgClient.from_dsn(self._dsn, **self._kw)
+            except BaseException:
+                with self._lock:
+                    self._created -= 1
+                raise
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise ConnectionError(
+                f"no free postgres connection within {timeout:.0f}s "
+                f"(pool size {self._size})"
+            ) from None
+
+    def release(self, client: PgClient) -> None:
+        if client._dead or self._closed:
+            with self._lock:
+                self._created -= 1
+            try:
+                client.close()
+            except Exception:
+                pass
+            return
+        self._q.put(client)
+
+    def close(self) -> None:
+        self._closed = True
+        while True:
+            try:
+                self._q.get_nowait().close()
+            except queue.Empty:
+                return
+            except Exception:
+                pass
+
+
 class PgConnection:
-    """sqlite3-connection-shaped facade over PgClient, so the storage
+    """sqlite3-connection-shaped facade over a PgPool, so the storage
     provider's query code runs unchanged: '?' placeholders inline as
     escaped literals, rows answer row['col'], commits are no-ops (each
-    simple-protocol statement auto-commits)."""
+    simple-protocol statement auto-commits). Each execute() checks a
+    connection out of the pool, so concurrent callers (the AsyncStorage
+    thread offload) don't serialize on one socket."""
 
-    def __init__(self, dsn: str, **kw):
-        self._client = PgClient.from_dsn(dsn, **kw)
+    def __init__(self, dsn: str, pool_size: int = 4, **kw):
+        self._pool = PgPool(dsn, size=pool_size, **kw)
 
     def execute(self, sql: str, params: tuple | list = ()) -> _PgCursor:
         sql = _inline(sql, params)
-        cols, rows, tag = self._client.query(sql)
+        client = self._pool.acquire()
+        try:
+            cols, rows, tag = client.query(sql)
+        finally:
+            self._pool.release(client)
         names = [c[0] for c in cols]
         return _PgCursor([PgRow(names, r) for r in rows], _tag_rowcount(tag))
 
     def executescript(self, script: str) -> None:
-        for stmt in script.split(";"):
-            if stmt.strip():
-                self._client.query(stmt)
+        client = self._pool.acquire()
+        try:
+            for stmt in script.split(";"):
+                if stmt.strip():
+                    client.query(stmt)
+        finally:
+            self._pool.release(client)
 
     def commit(self) -> None:
         pass  # simple-protocol statements auto-commit
 
     def close(self) -> None:
-        self._client.close()
+        self._pool.close()
 
 
 def _inline(sql: str, params: tuple | list) -> str:

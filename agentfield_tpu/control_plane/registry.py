@@ -16,7 +16,7 @@ from typing import Any
 
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
-from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
 from agentfield_tpu.control_plane.types import (
     AgentNode,
     ComponentMeta,
@@ -48,8 +48,10 @@ class NodeRegistry:
         sweep_interval: float = 30.0,
         evict_after: float = 1800.0,
         did_service=None,
+        db=None,  # shared AsyncStorage facade (built if absent)
     ):
         self.storage = storage
+        self.db = db if db is not None else AsyncStorage(storage)
         self.bus = bus
         self.metrics = metrics
         self.did_service = did_service
@@ -76,7 +78,7 @@ class NodeRegistry:
 
     # ------------------------------------------------------------------
 
-    def register(self, payload: dict[str, Any]) -> AgentNode:
+    async def register(self, payload: dict[str, Any]) -> AgentNode:
         """Idempotent registration: re-registering an existing node refreshes
         its components and lease (the reference treats re-registration the
         same way, nodes.go:363)."""
@@ -123,14 +125,14 @@ class NodeRegistry:
             node.did = self.did_service.node_did(node_id)
             for comp in node.reasoners + node.skills:
                 comp.did = self.did_service.component_did(node_id, comp.id)
-        self.storage.upsert_node(node)
+        await self.db.upsert_node(node)
         self._last_persist[node_id] = now()
         self.metrics.inc("nodes_registered_total")
         self.bus.publish(NODE_TOPIC, {"type": "registered", "node_id": node_id, "ts": now()})
         return node
 
-    def heartbeat(self, node_id: str, data: dict[str, Any] | None = None) -> AgentNode:
-        node = self.storage.get_node(node_id)
+    async def heartbeat(self, node_id: str, data: dict[str, Any] | None = None) -> AgentNode:
+        node = await self.db.get_node(node_id)
         if node is None:
             raise RegistryError(404, f"unknown node {node_id!r}; re-register")
         node.last_heartbeat = now()
@@ -162,7 +164,7 @@ class NodeRegistry:
         # cadence must not hammer SQLite. The lease check tolerates the
         # staleness (TTL is 300s >> 10s).
         if node.status != old_status or now() - self._last_persist.get(node_id, 0) > 10.0:
-            self.storage.upsert_node(node)
+            await self.db.upsert_node(node)
             self._last_persist[node_id] = now()
         return node
 
@@ -178,8 +180,8 @@ class NodeRegistry:
             return False
         return True
 
-    def deregister(self, node_id: str) -> bool:
-        ok = self.storage.delete_node(node_id)
+    async def deregister(self, node_id: str) -> bool:
+        ok = await self.db.delete_node(node_id)
         if ok:
             self._last_persist.pop(node_id, None)
             self._fences.pop(node_id, None)
@@ -201,20 +203,20 @@ class NodeRegistry:
 
     # ------------------------------------------------------------------
 
-    def sweep_once(self, at: float | None = None) -> dict[str, int]:
+    async def sweep_once(self, at: float | None = None) -> dict[str, int]:
         """Expire leases: TTL → inactive; hard evict after `evict_after`
         (reference: PresenceManager.checkExpirations, presence_manager.go:113)."""
         t = at or now()
         marked = evicted = active = 0
-        for node in self.storage.list_nodes():  # single pass; gauge derived inline
+        for node in await self.db.list_nodes():  # single pass; gauge derived inline
             age = t - node.last_heartbeat
             if age > self.evict_after:
-                self.deregister(node.node_id)
+                await self.deregister(node.node_id)
                 evicted += 1
             elif age > self.heartbeat_ttl and node.status == NodeStatus.ACTIVE:
                 self._publish_status(node.node_id, node.status, NodeStatus.INACTIVE)
                 node.status = NodeStatus.INACTIVE
-                self.storage.upsert_node(node)
+                await self.db.upsert_node(node)
                 marked += 1
             elif node.status == NodeStatus.ACTIVE:
                 active += 1
@@ -225,6 +227,6 @@ class NodeRegistry:
         while True:
             await asyncio.sleep(self.sweep_interval)
             try:
-                self.sweep_once()
+                await self.sweep_once()
             except Exception:  # pragma: no cover - sweep must never die
                 self.metrics.inc("sweep_errors_total")

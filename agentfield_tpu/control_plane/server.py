@@ -58,6 +58,11 @@ class ControlPlane:
         # shared-database provider (multi-instance deployments), anything
         # else is a SQLite path (reference: StorageFactory.CreateStorage).
         self.storage = create_storage(db_path)
+        from agentfield_tpu.control_plane.storage import AsyncStorage
+
+        # Awaitable mirror: handlers await this so a slow Postgres can never
+        # stall the event loop (SQLite passes through on-loop).
+        self.db = AsyncStorage(self.storage)
         if keystore_path:
             seed = Keystore(keystore_path, keystore_passphrase).load_or_create_seed()
         else:
@@ -75,7 +80,7 @@ class ControlPlane:
         self._admin_grpc = None
         self.bus = EventBus()
         self.metrics = Metrics()
-        self.webhooks = WebhookDispatcher(self.storage, self.metrics)
+        self.webhooks = WebhookDispatcher(self.storage, self.metrics, db=self.db)
         self.webhook_secret = webhook_secret
         self.registry = NodeRegistry(
             self.storage,
@@ -85,6 +90,7 @@ class ControlPlane:
             sweep_interval=sweep_interval,
             evict_after=evict_after,
             did_service=self.did_service,
+            db=self.db,
         )
         self.gateway = ExecutionGateway(
             self.storage,
@@ -96,13 +102,15 @@ class ControlPlane:
             queue_capacity=queue_capacity,
             webhook_notify=self._notify_webhook,
             payloads=self.payloads,
+            db=self.db,
         )
 
         from agentfield_tpu.control_plane.health import HealthMonitor
         from agentfield_tpu.control_plane.mcp_service import MCPService
 
         self.health_monitor = HealthMonitor(self.registry, interval=health_interval)
-        self.mcp = MCPService(self.storage)
+        self.mcp = MCPService(self.storage, db=self.db)
+        self._notes_lock = asyncio.Lock()
         self.cleanup_interval = cleanup_interval
         self.stale_after = stale_after
         self.retention = retention
@@ -111,9 +119,9 @@ class ControlPlane:
         self._mcp_autostart_task: asyncio.Task | None = None
         self._started = False
 
-    def _notify_webhook(self, ex) -> None:
+    async def _notify_webhook(self, ex) -> None:
         # gateway.complete hands the raw in-memory result; nothing to resolve.
-        self.webhooks.notify(ex, self.webhook_secret)
+        await self.webhooks.notify(ex, self.webhook_secret)
 
     async def start(self) -> None:
         if self._started:  # create_app's startup hook + manual start() are both fine
@@ -167,14 +175,14 @@ class ControlPlane:
         t = now()
         stale = 0
         for status in (ExecutionStatus.RUNNING, ExecutionStatus.QUEUED):
-            for ex in self.storage.list_executions(status=status, limit=10_000):
+            for ex in await self.db.list_executions(status=status, limit=10_000):
                 if ex.created_at < t - self.stale_after:
                     await self.gateway.complete(
                         ex.execution_id, error="marked stale by cleanup", timeout=True
                     )
                     stale += 1
-        deleted = self.storage.delete_executions_before(t - self.retention)
-        wh = self.storage.delete_webhooks_before(t - self.retention)
+        deleted = await self.db.delete_executions_before(t - self.retention)
+        wh = await self.db.delete_webhooks_before(t - self.retention)
         if stale:
             self.metrics.inc("executions_marked_stale_total", stale)
         if deleted:
@@ -287,7 +295,7 @@ def create_app(cp: ControlPlane) -> web.Application:
                 body["base_url"] = await _resolve_callback(
                     cands, body.get("base_url"), body.get("node_id")
                 )
-            node = cp.registry.register(body)
+            node = await cp.registry.register(body)
         except RegistryError as e:
             return _json_error(e.status, e.message)
         except (_BadBody, TypeError) as e:
@@ -296,11 +304,11 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.get("/api/v1/nodes")
     async def list_nodes(_req):
-        return web.json_response({"nodes": [n.to_dict() for n in cp.storage.list_nodes()]})
+        return web.json_response({"nodes": [n.to_dict() for n in await cp.db.list_nodes()]})
 
     @routes.get("/api/v1/nodes/{node_id}")
     async def get_node(req: web.Request):
-        node = cp.storage.get_node(req.match_info["node_id"])
+        node = await cp.db.get_node(req.match_info["node_id"])
         if node is None:
             return _json_error(404, "unknown node")
         return web.json_response({"node": node.to_dict()})
@@ -309,7 +317,7 @@ def create_app(cp: ControlPlane) -> web.Application:
     async def heartbeat(req: web.Request):
         try:
             body = await _json_dict(req)
-            node = cp.registry.heartbeat(req.match_info["node_id"], body)
+            node = await cp.registry.heartbeat(req.match_info["node_id"], body)
         except _BadBody as e:
             return _json_error(400, str(e))
         except RegistryError as e:
@@ -319,7 +327,7 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.get("/api/v1/nodes/{node_id}/health")
     async def node_health(req: web.Request):
         nid = req.match_info["node_id"]
-        node = cp.storage.get_node(nid)
+        node = await cp.db.get_node(nid)
         if node is None:
             return _json_error(404, "unknown node")
         return web.json_response(
@@ -333,7 +341,7 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.delete("/api/v1/nodes/{node_id}")
     async def deregister(req: web.Request):
-        if not cp.registry.deregister(req.match_info["node_id"]):
+        if not await cp.registry.deregister(req.match_info["node_id"]):
             return _json_error(404, "unknown node")
         return web.json_response({"deleted": True})
 
@@ -342,7 +350,7 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.get("/api/v1/reasoners")
     async def list_reasoners(_req):
         out = []
-        for node in cp.storage.list_nodes():
+        for node in await cp.db.list_nodes():
             for r in node.reasoners:
                 out.append(
                     {
@@ -360,7 +368,7 @@ def create_app(cp: ControlPlane) -> web.Application:
     @routes.get("/api/v1/reasoners/{target}/metrics")
     async def reasoner_metrics(req: web.Request):
         target = req.match_info["target"]
-        doc = cp.storage.target_metrics(target)
+        doc = await cp.db.target_metrics(target)
         if not doc["executions"]:
             return _json_error(404, f"no executions recorded for target {target!r}")
         return web.json_response(doc)
@@ -424,7 +432,7 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.get("/api/v1/executions/{execution_id}")
     async def get_execution(req: web.Request):
-        ex = cp.storage.get_execution(req.match_info["execution_id"])
+        ex = await cp.db.get_execution(req.match_info["execution_id"])
         if ex is None:
             return _json_error(404, "unknown execution")
         doc = ex.to_dict()
@@ -463,7 +471,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(400, "execution_ids must be a list of at most 1000 ids")
         out = {}
         for eid in ids:
-            ex = cp.storage.get_execution(eid)
+            ex = await cp.db.get_execution(eid)
             if ex is not None:
                 result = ex.result if ex.status.terminal else None
                 if cp.payloads is not None:
@@ -484,7 +492,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             offset = max(int(q.get("offset", "0")), 0)
         except ValueError as e:
             return _json_error(400, f"invalid query parameter: {e}")
-        exs = cp.storage.list_executions(
+        exs = await cp.db.list_executions(
             run_id=q.get("run_id"), status=status, limit=limit, offset=offset
         )
         docs = [e.to_dict() for e in exs]
@@ -506,7 +514,7 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.get("/api/v1/did/{node_id}")
     async def node_did(req: web.Request):
-        node = cp.storage.get_node(req.match_info["node_id"])
+        node = await cp.db.get_node(req.match_info["node_id"])
         if node is None:
             return _json_error(404, "unknown node")
         return web.json_response(
@@ -522,7 +530,7 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.post("/api/v1/vc/executions/{execution_id}")
     async def issue_vc(req: web.Request):
-        ex = cp.storage.get_execution(req.match_info["execution_id"])
+        ex = await cp.db.get_execution(req.match_info["execution_id"])
         if ex is None:
             return _json_error(404, "unknown execution")
         if not ex.status.terminal:
@@ -558,7 +566,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         # duplicate rows while the run mutates, and a signed chain must not.
         run_id = req.match_info["run_id"]
         limit = 1_000_000
-        exs = cp.storage.list_executions(run_id=run_id, limit=limit)
+        exs = await cp.db.list_executions(run_id=run_id, limit=limit)
         if len(exs) == limit:
             # Refuse rather than org-sign a possibly-truncated chain.
             return _json_error(413, f"run exceeds {limit} executions; chain refused")
@@ -589,7 +597,9 @@ def create_app(cp: ControlPlane) -> web.Application:
         from agentfield_tpu.control_plane.dag import build_dag
 
         light = req.query.get("lightweight", "") in ("1", "true")
-        dag = build_dag(cp.storage, req.match_info["run_id"], lightweight=light)
+        dag = await asyncio.to_thread(
+            build_dag, cp.storage, req.match_info["run_id"], lightweight=light
+        )
         if not dag["nodes"]:
             return _json_error(404, "unknown run")
         return web.json_response(dag)
@@ -602,7 +612,9 @@ def create_app(cp: ControlPlane) -> web.Application:
             limit = min(max(int(req.query.get("limit", "50")), 1), 500)
         except ValueError:
             return _json_error(400, "invalid limit")
-        return web.json_response({"runs": run_summaries(cp.storage, limit=limit)})
+        return web.json_response(
+            {"runs": await asyncio.to_thread(run_summaries, cp.storage, limit=limit)}
+        )
 
     @routes.post("/api/v1/executions/{execution_id}/notes")
     async def add_note(req: web.Request):
@@ -613,11 +625,15 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(400, str(e))
         if "note" not in body:
             return _json_error(400, "field 'note' is required")
-        ex = cp.storage.get_execution(req.match_info["execution_id"])
-        if ex is None:
-            return _json_error(404, "unknown execution")
-        ex.notes.append({"note": body["note"], "ts": now(), "actor": body.get("actor")})
-        cp.storage.update_execution(ex)
+        # Serialize the read-modify-write: with the thread-offloaded provider
+        # two concurrent notes would otherwise each rewrite the doc from
+        # their own snapshot and silently drop one.
+        async with cp._notes_lock:
+            ex = await cp.db.get_execution(req.match_info["execution_id"])
+            if ex is None:
+                return _json_error(404, "unknown execution")
+            ex.notes.append({"note": body["note"], "ts": now(), "actor": body.get("actor")})
+            await cp.db.update_execution(ex)
         return web.json_response({"ok": True, "notes": len(ex.notes)})
 
     @routes.post("/api/v1/workflow/executions/events")
@@ -641,7 +657,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             return _json_error(400, str(e))
         if event not in ("start", "complete", "error"):
             return _json_error(400, f"unknown event {event!r}")
-        ex = cp.storage.get_execution(eid)
+        ex = await cp.db.get_execution(eid)
         if ex is None:
             ex = Execution(
                 execution_id=eid,
@@ -654,7 +670,7 @@ def create_app(cp: ControlPlane) -> web.Application:
                 actor_id=body.get("actor_id"),
                 input=body.get("input"),
             )
-            cp.storage.create_execution(ex)
+            await cp.db.create_execution(ex)
         if event == "complete" and not ex.status.terminal:
             await cp.gateway.complete(eid, result=body.get("result"))
         elif event == "error" and not ex.status.terminal:
@@ -738,7 +754,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         internal/services/ui_service.go)."""
         from agentfield_tpu.control_plane.dag import run_summaries
 
-        nodes = cp.storage.list_nodes()
+        nodes = await cp.db.list_nodes()
         return web.json_response(
             {
                 "nodes": {
@@ -746,8 +762,8 @@ def create_app(cp: ControlPlane) -> web.Application:
                     "active": sum(n.status.value == "active" for n in nodes),
                     "models": sum(n.kind == "model" for n in nodes),
                 },
-                "executions_by_status": cp.storage.execution_counts(),
-                "recent_runs": run_summaries(cp.storage, limit=10),
+                "executions_by_status": await cp.db.execution_counts(),
+                "recent_runs": await asyncio.to_thread(run_summaries, cp.storage, limit=10),
                 "queue_depth": cp.gateway.queue_depth,
                 "backpressure_total": cp.metrics.counter_value("gateway_backpressure_total"),
             }
@@ -868,7 +884,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         except _BadBody as e:
             return _json_error(400, str(e))
         key = req.match_info["key"]
-        cp.storage.memory_set(scope, scope_id, key, body.get("value"))
+        await cp.db.memory_set(scope, scope_id, key, body.get("value"))
         cp.bus.publish(
             MEMORY_TOPIC,
             {"type": "set", "scope": scope, "scope_id": scope_id, "key": key, "ts": now()},
@@ -881,7 +897,7 @@ def create_app(cp: ControlPlane) -> web.Application:
             scope, scope_id = _scope(req)
         except GatewayError as e:
             return _json_error(e.status, e.message)
-        value = cp.storage.memory_get(scope, scope_id, req.match_info["key"])
+        value = await cp.db.memory_get(scope, scope_id, req.match_info["key"])
         if value is None:
             return _json_error(404, "key not found")
         return web.json_response({"value": value})
@@ -893,7 +909,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         except GatewayError as e:
             return _json_error(e.status, e.message)
         key = req.match_info["key"]
-        if not cp.storage.memory_delete(scope, scope_id, key):
+        if not await cp.db.memory_delete(scope, scope_id, key):
             return _json_error(404, "key not found")
         cp.bus.publish(
             MEMORY_TOPIC,
@@ -908,7 +924,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         except GatewayError as e:
             return _json_error(e.status, e.message)
         return web.json_response(
-            {"items": cp.storage.memory_list(scope, scope_id, req.query.get("prefix", ""))}
+            {"items": await cp.db.memory_list(scope, scope_id, req.query.get("prefix", ""))}
         )
 
     @routes.post("/api/v1/memory/vectors/set")
@@ -916,7 +932,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             scope, scope_id = _scope(req)
             body = await _json_dict(req, allow_empty=False)
-            cp.storage.vector_set(
+            await cp.db.vector_set(
                 scope, scope_id, body["key"], body["embedding"], body.get("metadata")
             )
         except GatewayError as e:
@@ -930,7 +946,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             scope, scope_id = _scope(req)
             body = await _json_dict(req, allow_empty=False)
-            results = cp.storage.vector_search(
+            results = await cp.db.vector_search(
                 scope,
                 scope_id,
                 body["embedding"],
@@ -948,7 +964,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             scope, scope_id = _scope(req)
             body = await _json_dict(req, allow_empty=False)
-            ok = cp.storage.vector_delete(scope, scope_id, body["key"])
+            ok = await cp.db.vector_delete(scope, scope_id, body["key"])
         except GatewayError as e:
             return _json_error(e.status, e.message)
         except (_BadBody, KeyError, TypeError) as e:

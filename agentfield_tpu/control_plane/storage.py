@@ -15,6 +15,8 @@ by callers.
 
 from __future__ import annotations
 
+import asyncio
+import functools
 import json
 import sqlite3
 import threading
@@ -91,8 +93,51 @@ CREATE TABLE IF NOT EXISTS kv_config (
 """
 
 
+class AsyncStorage:
+    """Awaitable mirror of a storage provider, for use from asyncio code.
+
+    For networked providers (``offload_to_thread = True``, i.e. Postgres)
+    every call runs on a worker thread, so a slow or stalled database can
+    never stall the control plane's event loop — heartbeats, SSE, and the
+    gateway stay live (the reference gets this for free from pgx pools +
+    goroutines; round-2 advisor finding pgwire.py:156). The local SQLite
+    provider stays on-loop: its ops are sub-ms and a thread hop would
+    roughly double their cost."""
+
+    def __init__(self, storage: "SQLiteStorage"):
+        self._s = storage
+        self._offload = bool(getattr(storage, "offload_to_thread", False))
+
+    @property
+    def sync(self) -> "SQLiteStorage":
+        """The underlying synchronous provider (for non-loop contexts)."""
+        return self._s
+
+    def __getattr__(self, name: str):
+        fn = getattr(self._s, name)
+        if not callable(fn):
+            return fn
+        if self._offload:
+
+            async def call(*a, **kw):
+                return await asyncio.to_thread(fn, *a, **kw)
+
+        else:
+
+            async def call(*a, **kw):
+                return fn(*a, **kw)
+
+        functools.update_wrapper(call, fn)
+        setattr(self, name, call)  # cache: next lookup skips __getattr__
+        return call
+
+
 class SQLiteStorage:
     """StorageProvider over a single SQLite file (":memory:" for tests)."""
+
+    # Whether AsyncStorage should run this provider's calls on a worker
+    # thread (True for networked providers; local SQLite stays on-loop).
+    offload_to_thread = False
 
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
@@ -361,11 +406,15 @@ class SQLiteStorage:
         return cur.rowcount > 0
 
     def memory_list(self, scope: str, scope_id: str, prefix: str = "") -> dict[str, Any]:
+        # substr() comparison instead of LIKE: case-SENSITIVE on both SQLite
+        # and Postgres (LIKE is ASCII-case-insensitive on SQLite only), and
+        # '%'/'_' in a caller-supplied prefix stay literal instead of acting
+        # as wildcards (round-2 advisor finding storage.py:366).
         with self._lock:
             rows = self._conn.execute(
-                "SELECT key, value FROM memory WHERE scope=? AND scope_id=? AND key LIKE ? "
-                "ORDER BY key",
-                (scope, scope_id, prefix + "%"),
+                "SELECT key, value FROM memory WHERE scope=? AND scope_id=? "
+                "AND substr(key, 1, ?) = ? ORDER BY key",
+                (scope, scope_id, len(prefix), prefix),
             ).fetchall()
         return {r["key"]: json.loads(r["value"]) for r in rows}
 

@@ -19,7 +19,7 @@ from typing import Any
 import aiohttp
 
 from agentfield_tpu.control_plane.metrics import Metrics
-from agentfield_tpu.control_plane.storage import SQLiteStorage
+from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
 from agentfield_tpu.control_plane.types import Execution, new_id
 
 SIGNATURE_HEADER = "X-AgentField-Signature"
@@ -39,8 +39,10 @@ class WebhookDispatcher:
         base_backoff: float = 2.0,
         max_backoff: float = 300.0,
         request_timeout: float = 15.0,
+        db=None,  # shared AsyncStorage facade (built if absent)
     ):
         self.storage = storage
+        self.db = db if db is not None else AsyncStorage(storage)
         self.metrics = metrics
         self.poll_interval = poll_interval
         self.max_attempts = max_attempts
@@ -64,12 +66,12 @@ class WebhookDispatcher:
         if self._session:
             await self._session.close()
 
-    def notify(self, ex: Execution, secret: str | None = None) -> None:
+    async def notify(self, ex: Execution, secret: str | None = None) -> None:
         """Persist a delivery row for a finished execution and wake the poller
         (reference: Notify, webhook_dispatcher.go:150)."""
         if not ex.webhook_url:
             return
-        self.storage.webhook_create(
+        await self.db.webhook_create(
             {
                 "id": new_id("wh"),
                 "execution_id": ex.execution_id,
@@ -111,7 +113,7 @@ class WebhookDispatcher:
     async def process_due(self, at: float | None = None, concurrency: int = 16) -> int:
         """Deliver all due rows concurrently (bounded) — one slow endpoint
         must not head-of-line-block healthy ones."""
-        due = self.storage.webhook_due(at or time.time())
+        due = await self.db.webhook_due(at or time.time())
         sem = asyncio.Semaphore(concurrency)
 
         async def one(row):
@@ -132,17 +134,17 @@ class WebhookDispatcher:
         try:
             async with self._session.post(row["url"], data=body, headers=headers) as resp:
                 if 200 <= resp.status < 300:
-                    self.storage.webhook_update(row["id"], "delivered", attempts, 0, None)
+                    await self.db.webhook_update(row["id"], "delivered", attempts, 0, None)
                     self.metrics.inc("webhook_delivered_total")
                     return
                 err = f"status {resp.status}"
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             err = repr(e)
         if attempts >= self.max_attempts:
-            self.storage.webhook_update(row["id"], "failed", attempts, 0, err)
+            await self.db.webhook_update(row["id"], "failed", attempts, 0, err)
             self.metrics.inc("webhook_failed_total")
         else:
-            self.storage.webhook_update(
+            await self.db.webhook_update(
                 row["id"], "pending", attempts, time.time() + self.backoff(attempts), err
             )
             self.metrics.inc("webhook_retries_total")

@@ -71,10 +71,29 @@ class EngineConfig:
     # ONE padded prefill batch (burst TTFT: N admissions cost one kernel call
     # instead of N serial prefills). 1 restores one-at-a-time admission.
     # Session-hit and chunked prefills still take the single-request path.
+    admit_window: int = 8  # admission fairness: look up to this many requests
+    # past a page-starved head each tick (FIFO head-of-line: a large request
+    # waiting for pages must not starve smaller ones behind it — the
+    # reference's async pool has no such hazard because its jobs don't hold
+    # device memory, execute.go:1341). 1 restores strict FIFO.
+    head_starve_fifo_ticks: int = 256  # anti-starvation for the head itself:
+    # after this many consecutive ticks with the head page-starved while
+    # later requests admit, the window collapses to 1 (strict FIFO) until
+    # the head gets its pages — freed pages then flow to the head first.
     enable_prefix_cache: bool = True  # retain session KV across turns
     prefill_chunk: int | None = None  # chunk long prefills to this many tokens:
     # bounds compiled bucket shapes and keeps decode latency fair under long
-    # prompts (chunks run through the cached-page attention path)
+    # prompts (chunks run through the cached-page attention path). None
+    # auto-resolves to 512 when chunk_attn_impl resolves to "pallas" (the
+    # chunk kernel's VMEM budget caps at ~512 rows; without a default, long
+    # prompts silently fell back to the O(T)-materializing gather) and to
+    # no chunking otherwise.
+    chunk_attn_impl: str = "auto"  # suffix/chunked-prefill attention:
+    # "pallas" (paged chunk kernel streams pages HBM→VMEM) | "ref" (per-layer
+    # full-context page gather) | "auto" (pallas when the engine already runs
+    # pallas anywhere: attn_impl=="pallas" or prefill_impl=="flash").
+    # Previously this was keyed on attn_impl alone, which silently kept
+    # prefill_impl="flash", attn_impl="ref" configs on the gather path.
     decode_buckets: tuple[int, ...] | None = None  # e.g. (4, 16): when fewer
     # slots are active, compact them into the smallest bucket width — the
     # unembed/attention cost scales with batch width, so low-occupancy decode
@@ -398,9 +417,9 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
             vp = vp.at[page_ids, :, slot_ids].set(v[0])
             # Kernel VMEM (q/o blocks + f32 accumulator) scales with the
             # chunk width; past ~512 rows it blows the ~16MB budget, so wide
-            # suffixes fall back to the gather path (set prefill_chunk to
-            # keep long prompts on the kernel).
-            if ecfg.attn_impl == "pallas" and bucket <= 512:
+            # suffixes fall back to the gather path (prefill_chunk defaults
+            # to <=512 when the kernel is on, keeping long prompts here).
+            if ecfg.chunk_attn_impl == "pallas" and bucket <= 512:
                 from agentfield_tpu.ops.pallas.paged_chunk_attention_kernel import (
                     paged_chunk_attention_pallas,
                 )
@@ -455,6 +474,26 @@ class InferenceEngine:
         to it."""
         self.cfg = cfg
         self.ecfg = ecfg or EngineConfig()
+        # Normalize the "auto" knobs ONCE so every jit cache key (the ecfg is
+        # part of the lru_cache key) sees resolved values.
+        if self.ecfg.chunk_attn_impl == "auto":
+            resolved = (
+                "pallas"
+                if (self.ecfg.attn_impl == "pallas" or self.ecfg.prefill_impl == "flash")
+                else "ref"
+            )
+            self.ecfg = dataclasses.replace(self.ecfg, chunk_attn_impl=resolved)
+        if self.ecfg.chunk_attn_impl not in ("pallas", "ref"):
+            raise ValueError(
+                f"chunk_attn_impl={self.ecfg.chunk_attn_impl!r} must be "
+                "'auto', 'pallas', or 'ref'"
+            )
+        if self.ecfg.prefill_chunk is None and self.ecfg.chunk_attn_impl == "pallas":
+            # Long prompts default onto the chunk kernel instead of the
+            # gather fallback (the kernel caps at 512-wide chunks).
+            self.ecfg = dataclasses.replace(
+                self.ecfg, prefill_chunk=min(512, self.ecfg.max_context)
+            )
         if self.ecfg.prefill_chunk is not None and self.ecfg.prefill_chunk < 16:
             raise ValueError(
                 f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 16 (one tile) or None"
@@ -578,7 +617,11 @@ class InferenceEngine:
             "sessions_evicted": 0,
             "requests_cancelled": 0,
             "prefill_batches": 0,
+            "admission_reorders": 0,
         }
+        # Consecutive ticks the queue head has been page-starved while later
+        # requests admitted (see _try_admit's fairness fence).
+        self._head_starved_ticks = 0
 
     # ------------------------------------------------------------------
     # host-side scheduling
@@ -841,20 +884,37 @@ class InferenceEngine:
         """Admit pending requests. Up to ``prefill_batch`` fresh prompts
         coalesce into ONE padded prefill call (burst TTFT is bounded by
         ceil(burst/N) kernel calls, not the burst size); session-hit and
-        chunked prompts take the single-request path, one per tick."""
+        chunked prompts take the single-request path, one per tick.
+
+        Fairness: a page-starved request does not block the queue — admission
+        scans up to ``admit_window`` entries past it (bounded reorder). The
+        head is always tried first, so freed pages reach it before anyone
+        behind it; if later requests keep admitting around a starved head for
+        ``head_starve_fifo_ticks`` consecutive ticks, the window collapses to
+        strict FIFO until the head admits."""
         if not self.pending:
             return []
         N = max(1, self.ecfg.prefill_batch)
+        window = max(1, self.ecfg.admit_window)
+        if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
+            window = 1  # anti-starvation fence: freed pages go to the head
         batch: list[tuple[Request, int, list[int]]] = []  # (req, slot, pages)
         claimed: set[int] = set()
-        while self.pending and len(batch) < N:
+        head = self.pending[0]
+        head_starved = False
+        skipped_starved = False
+        idx = 0
+        while len(batch) < N and idx < window:
+            with self._pending_lock:
+                if idx >= len(self.pending):
+                    break
+                req = self.pending[idx]
             free_slot = next(
                 (i for i, s in enumerate(self.slots) if s is None and i not in claimed),
                 None,
             )
             if free_slot is None:
                 break
-            req = self.pending[0]
             chunked = (
                 self.ecfg.prefill_chunk is not None
                 and len(req.prompt) > self.ecfg.prefill_chunk
@@ -867,14 +927,40 @@ class InferenceEngine:
             if chunked or has_sess or req.mm_embeds:
                 if batch:
                     break  # flush the fresh batch first; single path next tick
-                return self._admit_single(req, free_slot)
+                single = self._admit_single(req, free_slot)
+                if single:
+                    if skipped_starved:
+                        self.stats["admission_reorders"] += 1
+                    if req is head:
+                        self._head_starved_ticks = 0
+                    elif head_starved:
+                        # a single-path admission bypassed the starved head:
+                        # it must age the fence like batch bypasses do
+                        self._head_starved_ticks += 1
+                    return single
+                # page-starved single: scan past it
+                skipped_starved = True
+                head_starved = head_starved or req is head
+                idx += 1
+                continue
             with self._session_lock:
                 pages = self._alloc_with_eviction(self._pages_needed(req))
             if pages is None:
-                break  # page-starved; decode will free pages
-            self.pending.popleft()
+                # page-starved: scan past it (decode will free pages)
+                skipped_starved = True
+                head_starved = head_starved or req is head
+                idx += 1
+                continue
+            with self._pending_lock:
+                self.pending.remove(req)
             claimed.add(free_slot)
             batch.append((req, free_slot, pages))
+        if head_starved and batch:
+            self.stats["admission_reorders"] += 1
+        if head_starved and self.pending and self.pending[0] is head:
+            self._head_starved_ticks += 1
+        else:
+            self._head_starved_ticks = 0
         if not batch:
             return []
         if len(batch) == 1:
@@ -966,7 +1052,9 @@ class InferenceEngine:
                     return []
                 start = 0
                 suffix = req.prompt
-        self.pending.popleft()
+        with self._pending_lock:
+            self.pending.remove(req)  # by identity: the fairness window may
+            # admit from behind a page-starved head, not just pending[0]
 
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
         if hit is not None:

@@ -189,11 +189,33 @@ class ModelBackend:
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._drive_loop())
+        if self.vision_cfg is not None:
+            # Pre-warm the vision-tower jit off the event loop: the first
+            # image request otherwise pays the compile (seconds on CPU,
+            # minutes through a TPU tunnel) while /health and heartbeats
+            # block (round-2 advisor finding, model_node.py:423).
+            self._vision_warm = asyncio.create_task(
+                asyncio.to_thread(self._warm_vision)
+            )
+
+    def _warm_vision(self) -> None:
+        import numpy as np
+
+        from agentfield_tpu.models.vision import vision_encode_jit
+
+        S = self.vision_cfg.image_size
+        vision_encode_jit(
+            self.vision_params, self.vision_cfg, np.zeros((1, S, S, 3), np.float32)
+        )
 
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
             await asyncio.gather(self._task, return_exceptions=True)
+        warm = getattr(self, "_vision_warm", None)
+        if warm is not None:
+            warm.cancel()
+            await asyncio.gather(warm, return_exceptions=True)
         for fut in self._futures.values():
             if not fut.done():
                 fut.cancel()
@@ -320,6 +342,13 @@ class ModelBackend:
             fut.add_done_callback(lambda _f: self._grammar_futs.pop(key, None))
         return await asyncio.shield(fut)
 
+    async def ensure_images(self, prompt: str, images: list) -> tuple[list[int], list]:
+        """Run image decode + vision encoding OFF the event loop (mirrors
+        ensure_grammar): PIL decode plus a jitted tower forward — a compile
+        on first use — must not block heartbeats and /health. Returns the
+        (tokens, mm_embeds) pair _submit accepts as ``prefused``."""
+        return await asyncio.to_thread(self._fuse_images, prompt, images)
+
     def _decode_image(self, item) -> "np.ndarray":
         """One wire image → [S, S, 3] float32 in [0, 1]. Accepts raw encoded
         bytes (the gRPC proto form), {"b64": <base64 PNG/JPEG>} (the HTTP/SDK
@@ -406,6 +435,7 @@ class ModelBackend:
         context_overflow: str = "error",
         grammar_obj=None,  # pre-compiled Grammar from ensure_grammar()
         images: list | None = None,
+        prefused: tuple | None = None,  # (tokens, mm_embeds) from ensure_images()
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -420,7 +450,11 @@ class ModelBackend:
                 raise ValueError("images require a text 'prompt', not 'tokens'")
             if prompt is None:
                 raise ValueError("images require a text 'prompt'")
-            tokens, mm_embeds = self._fuse_images(prompt, images)
+            # async callers pre-fuse off-loop via ensure_images(); the
+            # synchronous fallback keeps direct/test callers working
+            tokens, mm_embeds = prefused if prefused is not None else self._fuse_images(
+                prompt, images
+            )
         elif tokens is None:
             if prompt is None:
                 raise ValueError("one of 'prompt' or 'tokens' is required")
@@ -509,6 +543,9 @@ class ModelBackend:
         grammar_obj = None
         if response_schema is not None:
             grammar_obj = await self.ensure_grammar(response_schema)
+        prefused = None
+        if images and prompt is not None and tokens is None:
+            prefused = await self.ensure_images(prompt, images)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         rid, truncated = self._submit(
             prompt,
@@ -525,6 +562,7 @@ class ModelBackend:
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
             images=images,
+            prefused=prefused,
         )
         try:
             result = await fut
@@ -557,6 +595,7 @@ class ModelBackend:
         context_overflow: str = "error",
         grammar_obj=None,
         images: list | None = None,
+        prefused: tuple | None = None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
@@ -576,6 +615,7 @@ class ModelBackend:
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
             images=images,
+            prefused=prefused,
         )
         return rid, q
 
@@ -676,6 +716,11 @@ def build_model_node(
             if gen_kwargs.get("response_schema") is not None:
                 gen_kwargs["grammar_obj"] = await backend.ensure_grammar(
                     gen_kwargs["response_schema"]
+                )
+            if gen_kwargs.get("images") and gen_kwargs.get("prompt") is not None \
+                    and gen_kwargs.get("tokens") is None:
+                gen_kwargs["prefused"] = await backend.ensure_images(
+                    gen_kwargs["prompt"], gen_kwargs["images"]
                 )
             rid, q = backend.submit_stream(**gen_kwargs)
         except (QueueFullError,) as e:

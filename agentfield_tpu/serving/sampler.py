@@ -41,16 +41,17 @@ def sample_tokens(
     argmax. Rows with plain temperature sampling (top_k=0, top_p>=1) sample the
     FULL tempered vocab. Rows requesting top-k and/or top-p truncation sample
     inside a static ``k_max``-wide candidate set (one lax.top_k scan, no vocab
-    sort). This is a stated contract, not just an optimization:
+    sort), with one exception that keeps the realized distribution honest:
 
     - requested ``top_k`` values larger than ``k_max`` are clamped to ``k_max``;
-    - ``top_p``-only rows (top_k=0, top_p<1) are ALSO bounded by the ``k_max``
-      most likely tokens — if the nucleus is wider than ``k_max`` (high
-      temperature / flat distribution), the realized distribution is narrower
-      than requested. Raise ``k_max`` if exact wide-nucleus sampling matters;
-      cost grows with one [B, k_max] top_k + softmax.
+    - ``top_p``-only rows (top_k=0, top_p<1) whose nucleus is WIDER than the
+      ``k_max`` most likely tokens (high temperature / flat distribution) fall
+      back to exact full-vocab nucleus sampling — a [B, V] sort, paid only on
+      steps where such a row exists (lax.cond), instead of silently narrowing
+      the distribution to k_max candidates as pre-round-3 versions did.
     """
     B, V = logits.shape
+    k_max = min(k_max, V)  # tiny vocabs: the prefilter can't exceed V
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temps = jnp.maximum(temperatures, 1e-6)[:, None]
@@ -77,6 +78,34 @@ def sample_tokens(
             masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
             choice = jax.random.categorical(rng_trunc, masked, axis=-1)
             trunc = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+            # Exact wide-nucleus fallback: how much FULL-vocab tempered
+            # probability mass do the k_max candidates hold? A top_p-only row
+            # whose candidates hold less than its top_p has a nucleus wider
+            # than the prefilter; sample it over the full sorted vocab.
+            cand_mass = jnp.exp(
+                jax.nn.logsumexp(scaled, axis=-1)
+                - jax.nn.logsumexp(logits / temps, axis=-1)
+            )
+            need_exact = (top_ks == 0) & (top_ps < 1.0) & (cand_mass < top_ps)
+
+            def _exact_rows(_):
+                order = jnp.argsort(-logits, axis=-1)  # [B, V] descending
+                svals = jnp.take_along_axis(logits, order, axis=-1) / temps
+                p_full = jax.nn.softmax(svals, axis=-1)
+                cum_f = jnp.cumsum(p_full, axis=-1)
+                keep = (cum_f - p_full) < top_ps[:, None]
+                ch = jax.random.categorical(
+                    rng_trunc, jnp.where(keep, svals, -jnp.inf), axis=-1
+                )
+                exact = jnp.take_along_axis(order, ch[:, None], axis=-1)[:, 0].astype(
+                    jnp.int32
+                )
+                return jnp.where(need_exact, exact, trunc)
+
+            trunc = jax.lax.cond(
+                jnp.any(need_exact), _exact_rows, lambda _: trunc, None
+            )
             return jnp.where(truncated_row, trunc, full)
 
         sampled = jax.lax.cond(

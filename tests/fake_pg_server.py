@@ -28,7 +28,38 @@ def _to_sqlite(sql: str) -> str:
     sql = re.sub(r"\bDOUBLE PRECISION\b", "REAL", sql)
     sql = re.sub(r"\bTRUE\b", "1", sql)
     sql = re.sub(r"\bFALSE\b", "0", sql)
+    # pgvector emulation: '[..]'::vector casts become plain text values, the
+    # distance operators become registered SQLite functions over that text.
+    sql = re.sub(r"('\[[^']*\]')::vector", r"\1", sql)
+    ops = {"<=>": "pgv_cosine", "<#>": "pgv_negdot", "<->": "pgv_l2"}
+    sql = re.sub(
+        r"([\w.]+|'\[[^']*\]')\s*(<=>|<#>|<->)\s*([\w.]+|'\[[^']*\]')",
+        lambda m: f"{ops[m.group(2)]}({m.group(1)}, {m.group(3)})",
+        sql,
+    )
     return sql
+
+
+def _pgv_parse(t):
+    import json as _json
+
+    return _json.loads(t)
+
+
+def _pgv_cosine(a, b):
+    va, vb = _pgv_parse(a), _pgv_parse(b)
+    dot = sum(x * y for x, y in zip(va, vb))
+    na = sum(x * x for x in va) ** 0.5
+    nb = sum(x * x for x in vb) ** 0.5
+    return 1.0 - dot / ((na * nb) or 1e-12)
+
+
+def _pgv_negdot(a, b):
+    return -sum(x * y for x, y in zip(_pgv_parse(a), _pgv_parse(b)))
+
+
+def _pgv_l2(a, b):
+    return sum((x - y) ** 2 for x, y in zip(_pgv_parse(a), _pgv_parse(b))) ** 0.5
 
 
 def _oid_for(values) -> int:
@@ -79,10 +110,20 @@ class _Reader:
 class FakePgServer:
     """One-database fake. `password` is what SCRAM verifies against."""
 
-    def __init__(self, password: str = "hunter2"):
+    def __init__(self, password: str = "hunter2", vector: bool = False,
+                 conforming_strings: str = "on"):
         self.password = password
+        self.conforming_strings = conforming_strings  # tests can claim "off"
+        self.stall_on: tuple[str, float] | None = None  # (sql substring, seconds)
         self._db = sqlite3.connect(":memory:", check_same_thread=False)
         self._db_lock = threading.Lock()
+        # pg_extension catalog (the provider probes it for pgvector)
+        self._db.execute("CREATE TABLE pg_extension (extname TEXT)")
+        if vector:
+            self._db.execute("INSERT INTO pg_extension VALUES ('vector')")
+            self._db.create_function("pgv_cosine", 2, _pgv_cosine)
+            self._db.create_function("pgv_negdot", 2, _pgv_negdot)
+            self._db.create_function("pgv_l2", 2, _pgv_l2)
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
@@ -181,6 +222,13 @@ class FakePgServer:
                 conn.close()
                 return
             self._send(conn, b"S", b"server_version\x00fake-16\x00")
+            self._send(
+                conn,
+                b"S",
+                b"standard_conforming_strings\x00"
+                + self.conforming_strings.encode()
+                + b"\x00",
+            )
             self._send(conn, b"Z", b"I")
             while True:
                 type_, payload = self._recv_msg(rd)
@@ -196,7 +244,38 @@ class FakePgServer:
             pass
 
     def _run_query(self, conn, sql: str) -> None:
+        if self.stall_on is not None:
+            pat, delay = self.stall_on
+            if pat in sql:
+                import time as _time
+
+                _time.sleep(delay)  # simulate a stalled server/slow query
         verb = (sql.split() or ["?"])[0].upper()
+        # CREATE EXTENSION → no-op; ALTER TABLE ... ADD COLUMN IF NOT EXISTS
+        # → drop the clause (sqlite lacks it), swallowing duplicate-column.
+        if verb == "CREATE" and re.search(r"\bEXTENSION\b", sql, re.I):
+            self._send(conn, b"C", b"CREATE EXTENSION\x00")
+            return
+        m = re.match(
+            r"\s*ALTER\s+TABLE\s+(\S+)\s+ADD\s+COLUMN\s+IF\s+NOT\s+EXISTS\s+(.*)",
+            sql,
+            re.I | re.S,
+        )
+        if m:
+            try:
+                with self._db_lock:
+                    self._db.execute(
+                        _to_sqlite(f"ALTER TABLE {m.group(1)} ADD COLUMN {m.group(2)}")
+                    )
+            except sqlite3.Error as e:
+                if "duplicate column" not in str(e):
+                    self._send(
+                        conn, b"E",
+                        b"SERROR\x00CXX000\x00M" + str(e).encode() + b"\x00\x00",
+                    )
+                    return
+            self._send(conn, b"C", b"ALTER TABLE\x00")
+            return
         try:
             with self._db_lock:
                 cur = self._db.execute(_to_sqlite(sql))

@@ -440,7 +440,7 @@ async def test_registry_sweep_marks_and_evicts():
         n2 = st.get_node("n2")
         n2.last_heartbeat -= 500  # past hard evict
         st.upsert_node(n2)
-        res = reg.sweep_once()
+        res = await reg.sweep_once()
         assert res == {"marked_inactive": 1, "evicted": 1}
         assert st.get_node("n1").status == NodeStatus.INACTIVE
         assert st.get_node("n2") is None

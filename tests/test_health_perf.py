@@ -42,11 +42,11 @@ async def test_health_probe_and_deactivation():
             async with h.http.post("/api/v1/execute/probed.fn", json={}) as r:
                 assert r.status == 503
             # fence: the agent's own heartbeat cannot instantly revive it
-            h.cp.registry.heartbeat("probed")
+            await h.cp.registry.heartbeat("probed")
             assert h.cp.storage.get_node("probed").status == NodeStatus.INACTIVE
             # once the fence lapses, a heartbeat revives the node
             h.cp.registry._fences["probed"] = 0.0
-            h.cp.registry.heartbeat("probed")
+            await h.cp.registry.heartbeat("probed")
             assert h.cp.storage.get_node("probed").status == NodeStatus.ACTIVE
         finally:
             await app.client.close()

@@ -1,0 +1,122 @@
+"""Sampler contracts: mixed-strategy batch, truncation bounds, and the
+exact wide-nucleus fallback (VERDICT r2 item 10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
+
+
+def _sample_batch(logits_row, n, temperature=1.0, top_k=0, top_p=1.0, k_max=64, seed=0):
+    """Draw n samples by stacking the row n times (one vectorized call)."""
+    B = n
+    logits = jnp.tile(jnp.asarray(logits_row, jnp.float32)[None], (B, 1))
+    toks = sample_tokens(
+        logits,
+        jax.random.PRNGKey(seed),
+        jnp.full((B,), temperature, jnp.float32),
+        jnp.full((B,), top_k, jnp.int32),
+        jnp.full((B,), top_p, jnp.float32),
+        k_max=k_max,
+    )
+    return np.asarray(toks)
+
+
+def test_greedy_rows_take_argmax():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]], jnp.float32)
+    toks = sample_tokens(
+        logits,
+        jax.random.PRNGKey(0),
+        jnp.zeros((2,), jnp.float32),
+        jnp.zeros((2,), jnp.int32),
+        jnp.ones((2,), jnp.float32),
+    )
+    assert toks.tolist() == [1, 0]
+
+
+def test_top_k_restricts_support():
+    V = 128
+    row = np.zeros(V, np.float32)
+    row[:4] = 10.0  # four dominant tokens
+    toks = _sample_batch(row, 512, top_k=2)
+    assert set(toks.tolist()) <= {0, 1}  # only the 2 most likely
+
+
+def test_top_p_narrow_nucleus_within_prefilter():
+    V = 128
+    row = np.full(V, -10.0, np.float32)
+    row[:3] = np.log([0.6, 0.3, 0.09]).astype(np.float32)
+    toks = _sample_batch(row, 512, top_p=0.7)
+    # nucleus = {0} plus the boundary token 1 (kept: cum-before < p)
+    assert set(toks.tolist()) <= {0, 1}
+    counts = np.bincount(toks, minlength=3)
+    assert counts[0] > counts[1] > 0
+
+
+def test_top_p_wide_nucleus_exact_fallback():
+    """Flat logits, top_p=0.5 over V=512: the nucleus is 256 tokens — wider
+    than k_max=64. Pre-round-3 this silently sampled only 64 distinct tokens;
+    the exact fallback must realize (about) the full 256-token support."""
+    V = 512
+    row = np.zeros(V, np.float32)  # perfectly flat
+    toks = _sample_batch(row, 4096, top_p=0.5, k_max=64)
+    distinct = len(set(toks.tolist()))
+    # draws land uniformly over ~256 tokens; 4096 draws cover most of them.
+    # (argsort over ties keeps index order, so the kept set is SOME 256
+    # tokens; >64 distinct alone proves the k_max ceiling is gone.)
+    assert distinct > 200, f"only {distinct} distinct tokens — k_max ceiling still applied"
+    counts = np.bincount(toks, minlength=V)
+    seen = counts[counts > 0]
+    # roughly uniform over the realized support (no mass spike)
+    assert seen.max() / max(seen.mean(), 1) < 3.0
+
+
+def test_top_p_exact_fallback_matches_reference_distribution():
+    """Distribution check vs a numpy exact nucleus sampler on a random row
+    whose nucleus is wider than k_max."""
+    rngv = np.random.default_rng(3)
+    V = 256
+    row = rngv.normal(0, 0.1, V).astype(np.float32)  # near-flat → wide nucleus
+    top_p = 0.8
+    # numpy reference nucleus support
+    order = np.argsort(-row, kind="stable")
+    p = np.exp(row[order]) / np.exp(row[order]).sum()
+    cum = np.cumsum(p)
+    keep = (cum - p) < top_p
+    support = set(order[keep].tolist())
+    assert len(support) > 64  # wider than the prefilter, by construction
+    toks = _sample_batch(row, 4096, top_p=top_p, k_max=64)
+    assert set(toks.tolist()) <= support, "sampled outside the true nucleus"
+    distinct = len(set(toks.tolist()))
+    assert distinct > 64, "support still clipped at k_max"
+
+
+def test_mixed_batch_rows_stay_independent():
+    """One batch mixing greedy, plain temperature, top-k, and wide-nucleus
+    top_p rows: each row honors its own strategy."""
+    V = 256
+    flat = np.zeros(V, np.float32)
+    peaked = np.full(V, -20.0, np.float32)
+    peaked[7] = 10.0
+    logits = jnp.asarray(np.stack([peaked, flat, peaked, flat]), jnp.float32)
+    toks = sample_tokens(
+        logits,
+        jax.random.PRNGKey(1),
+        jnp.asarray([0.0, 1.0, 1.0, 1.0], jnp.float32),
+        jnp.asarray([0, 0, 1, 0], jnp.int32),
+        jnp.asarray([1.0, 1.0, 1.0, 0.5], jnp.float32),
+    )
+    t = np.asarray(toks)
+    assert t[0] == 7  # greedy
+    assert 0 <= t[1] < V  # full-vocab temperature
+    assert t[2] == 7  # top_k=1 on the peaked row
+    assert 0 <= t[3] < V  # wide-nucleus row (exact fallback path)
+
+
+def test_sampling_params_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        SamplingParams(max_new_tokens=0)

@@ -138,3 +138,104 @@ async def test_control_plane_boots_on_postgres_dsn(pg):
         # the execution record landed in "postgres"
         rows = h.cp.storage.list_executions(limit=10)
         assert any(e.target == "fake-agent.echo" for e in rows)
+
+
+def test_rejects_non_conforming_strings():
+    """escape_literal assumes standard_conforming_strings=on; a legacy server
+    with it off must be refused at startup (round-2 advisor, pgwire.py:700)."""
+    srv = FakePgServer(conforming_strings="off").start()
+    try:
+        with pytest.raises(PgError, match="standard_conforming_strings"):
+            PgClient.from_dsn(_dsn(srv))
+    finally:
+        srv.stop()
+
+
+def test_memory_list_prefix_is_literal_and_case_sensitive(pg):
+    """'%'/'_' in a prefix are literal, and matching is case-sensitive on
+    both providers (round-2 advisor, storage.py:366)."""
+    for s in (SQLiteStorage(":memory:"), PostgresStorage(_dsn(pg))):
+        s.memory_set("global", "", "a%b", 1)
+        s.memory_set("global", "", "axb", 2)
+        s.memory_set("global", "", "A%b", 3)
+        assert set(s.memory_list("global", "", "a%")) == {"a%b"}  # % literal
+        assert set(s.memory_list("global", "", "A")) == {"A%b"}  # case exact
+        assert set(s.memory_list("global", "", "")) == {"a%b", "axb", "A%b"}
+        s.close()
+
+
+def test_pgvector_db_side_search():
+    """With pgvector present the provider searches DB-side: the base class's
+    fetch-everything scan must never run (VERDICT r2 missing #2)."""
+    srv = FakePgServer(vector=True).start()
+    try:
+        s = PostgresStorage(_dsn(srv))
+        assert s._pgvector is True
+        s.vector_set("global", "", "v1", [1.0, 0.0], {"tag": "a"})
+        s.vector_set("global", "", "v2", [0.0, 1.0], {"tag": "b"})
+        s.vector_set("global", "", "v3", [0.9, 0.1], {"tag": "c"})
+
+        # prove the SQL path: poison the python-scan fallback
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            SQLiteStorage, "vector_search", side_effect=AssertionError("fetched all rows")
+        ):
+            hits = s.vector_search("global", "", [1.0, 0.05], top_k=2)
+        assert [h["key"] for h in hits] == ["v1", "v3"]
+        assert hits[0]["score"] > hits[1]["score"]  # higher-is-better contract
+        assert hits[0]["metadata"] == {"tag": "a"}
+        # dot + l2 metrics ride the operators too
+        with mock.patch.object(
+            SQLiteStorage, "vector_search", side_effect=AssertionError("fetched all rows")
+        ):
+            assert s.vector_search("global", "", [1.0, 0.0], top_k=1, metric="dot")[0]["key"] == "v1"
+            assert s.vector_search("global", "", [0.0, 1.0], top_k=1, metric="l2")[0]["key"] == "v2"
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_pg_pool_replaces_dead_connections(pg):
+    from agentfield_tpu.control_plane.pgwire import PgPool
+
+    pool = PgPool(_dsn(pg), size=2)
+    a = pool.acquire()
+    b = pool.acquire()  # lazily created second connection
+    a._poison("test kill")
+    pool.release(a)  # discarded, not requeued
+    pool.release(b)
+    c = pool.acquire()  # healthy survivor
+    _, rows, _ = c.query("SELECT 7 AS n")
+    assert rows == [[7]]
+    pool.release(c)
+    pool.close()
+    with pytest.raises(ConnectionError):
+        pool.acquire()
+
+
+@async_test
+async def test_stalled_pg_does_not_stall_heartbeats(pg):
+    """The done-bar for VERDICT r2 item 4: with the Postgres provider, a
+    stalled query must not freeze the event loop — heartbeats keep flowing
+    (AsyncStorage thread offload + connection pool)."""
+    import asyncio
+
+    async with CPHarness(db_path=_dsn(pg)) as h:
+        await h.register_agent()
+        # stall every executions-list query for 2.5s
+        pg.stall_on = ("SELECT doc FROM executions", 2.5)
+
+        async def slow_list():
+            async with h.http.get("/api/v1/executions") as r:
+                return r.status
+
+        t_slow = asyncio.create_task(slow_list())
+        await asyncio.sleep(0.3)  # the stalled query is now holding a thread
+        t0 = time.perf_counter()
+        async with h.http.post("/api/v1/nodes/fake-agent/heartbeat", json={}) as r:
+            assert r.status == 200
+        hb_latency = time.perf_counter() - t0
+        assert hb_latency < 1.0, f"heartbeat stalled {hb_latency:.2f}s behind the slow query"
+        assert await t_slow == 200
+        pg.stall_on = None

@@ -171,6 +171,7 @@ def cmd_model(cfg: Config, args) -> int:
             checkpoint=args.checkpoint or mn.checkpoint,
             tp=mn.tp,
             vision=mn.vision,
+            grammar_whitespace=mn.grammar_whitespace,
         )
         await backend.start()
         await agent.start()

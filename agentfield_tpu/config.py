@@ -61,6 +61,9 @@ class ModelNodeConfig:
     # readback per span — set 8-16 on high-latency device links)
     kv_write_impl: str = "ref"  # "ref" scatter | "pallas" page-patch kernel
     grammar_slots: int = 256  # constrained-decoding bank rows (0 disables)
+    grammar_whitespace: bool = False  # accept bounded whitespace in
+    # schema-constrained output (pretty-printed JSON) instead of canonical
+    # compact form
     vision: str | None = None  # vision tower config name → serve image inputs
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 

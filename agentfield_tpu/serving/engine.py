@@ -618,6 +618,8 @@ class InferenceEngine:
             "requests_cancelled": 0,
             "prefill_batches": 0,
             "admission_reorders": 0,
+            "grammar_evictions": 0,
+            "grammar_capacity_errors": 0,
         }
         # Consecutive ticks the queue head has been page-starved while later
         # requests admitted (see _try_admit's fairness fence).
@@ -696,6 +698,22 @@ class InferenceEngine:
         total = len(req.prompt) + req.sampling.max_new_tokens
         return -(-total // self.ecfg.page_size)
 
+    def grammar_bank_stats(self) -> dict[str, int]:
+        """Capacity gauges for the constrained-decoding bank (VERDICT r2 item
+        8): how close the int16 row bank is to exhaustion, how many grammars
+        are resident, and how many are pinned by in-flight requests."""
+        free = sum(s for _, s in self._gbank_free)
+        total = max(1, self.ecfg.grammar_slots)
+        return {
+            "grammar_bank_rows": total,
+            "grammar_bank_rows_free": free,
+            "grammar_bank_rows_used": total - free,
+            "grammar_bank_grammars": len(self._gbank_entries),
+            "grammar_bank_grammars_in_use": sum(
+                1 for e in self._gbank_entries.values() if e["refs"] > 0
+            ),
+        }
+
     def _gbank_alloc_range(self, n: int) -> int | None:
         """First-fit over the free list (ranges never move, so active bank-
         global state ids stay valid across other grammars' lifecycles)."""
@@ -740,6 +758,7 @@ class InferenceEngine:
         while off is None:
             idle = [k for k, e in self._gbank_entries.items() if e["refs"] <= 0]
             if not idle:
+                self.stats["grammar_capacity_errors"] += 1
                 raise GrammarCapacityError(
                     f"grammar needs {n} states; bank capacity "
                     f"{self.ecfg.grammar_slots} is exhausted by in-use grammars"
@@ -747,6 +766,7 @@ class InferenceEngine:
             victim = min(idle, key=lambda k: self._gbank_entries[k]["used"])
             ve = self._gbank_entries.pop(victim)
             self._gbank_free_range(ve["off"], ve["n"])
+            self.stats["grammar_evictions"] += 1
             off = self._gbank_alloc_range(n)
         self._gbank_trans[off : off + n] = np.where(
             g.trans >= 0, g.trans + off, -1

@@ -16,9 +16,19 @@ Pipeline:
          --(subset construction)--> DFA over byte classes
          --(close_over_vocab, numpy-vectorized)--> Grammar(trans, accept)
 
-Generation is canonical compact JSON: object properties in schema order, all
-required, no whitespace — a deliberate restriction that keeps the automaton
-small and the output deterministic to validate.
+Generation defaults to canonical compact JSON: object properties in schema
+order, no whitespace — a deliberate restriction that keeps the automaton small
+and the output deterministic to validate. Two v2 relaxations are available:
+
+- ``required``: when a schema object carries a ``required`` list, only those
+  properties must appear; the rest are optional (still in declaration order,
+  comma placement handled by the automaton). Without ``required`` every
+  declared property is emitted (v1-compatible canonical form).
+- ``whitespace=True`` (``compile_json_schema``): accepts up to ``max_ws``
+  whitespace bytes (space/tab/CR/LF) after ``{`` ``[`` ``,`` ``:`` and before
+  ``}`` ``]`` — enough for pretty-printed output. Bounded repetition (not a
+  Kleene star) so masked generation can never stall in an infinite-whitespace
+  loop: after ``max_ws`` blanks the only legal continuation is real JSON.
 """
 
 from __future__ import annotations
@@ -200,9 +210,35 @@ def _json_number(n: _NFA, integer: bool = False) -> tuple[int, int]:
     return n.concat(sign, int_part, frac, exp)
 
 
-def build_schema_nfa(n: _NFA, schema: dict[str, Any], depth: int = 0) -> tuple[int, int]:
+_WS_RANGES = [(0x09, 0x0A), (0x0D, 0x0D), (0x20, 0x20)]  # \t \n \r space
+
+
+def _make_ws(n: _NFA, max_ws: int):
+    """Returns a factory for fresh optional-whitespace fragments (≤ max_ws
+    blanks), or a None-returning factory when whitespace is disabled.
+    Fragments are graph nodes, so every insertion point needs its own."""
+    if max_ws <= 0:
+        return lambda: None
+
+    def ws() -> tuple[int, int]:
+        frag = None
+        for _ in range(max_ws):
+            piece = n.opt(n.char_class(_WS_RANGES))
+            frag = piece if frag is None else n.concat(frag, piece)
+        return frag
+
+    return ws
+
+
+def build_schema_nfa(
+    n: _NFA, schema: dict[str, Any], depth: int = 0, ws=None
+) -> tuple[int, int]:
     """Recursively build the NFA fragment for one schema node. Canonical
-    compact JSON: properties in declaration order, all emitted, no spaces."""
+    compact JSON (properties in declaration order); `required` marks the
+    mandatory subset, `ws()` (when enabled) yields optional-whitespace
+    fragments inserted at structural boundaries."""
+    if ws is None:
+        ws = lambda: None
     if depth > 16:
         raise SchemaError("schema nesting deeper than 16")
     if "enum" in schema:
@@ -211,7 +247,7 @@ def build_schema_nfa(n: _NFA, schema: dict[str, Any], depth: int = 0) -> tuple[i
         return n.lit(json.dumps(schema["const"], separators=(",", ":")))
     t = schema.get("type")
     if isinstance(t, list):
-        return n.alt(*[build_schema_nfa(n, {**schema, "type": one}, depth) for one in t])
+        return n.alt(*[build_schema_nfa(n, {**schema, "type": one}, depth, ws) for one in t])
     if t == "string":
         return _json_string(n, schema.get("maxLength"))
     if t == "integer":
@@ -224,9 +260,15 @@ def build_schema_nfa(n: _NFA, schema: dict[str, Any], depth: int = 0) -> tuple[i
         return n.lit("null")
     if t == "array":
         items = schema.get("items", {"type": ["string", "number", "boolean", "null"]})
-        item = build_schema_nfa(n, items, depth + 1)
         min_items = schema.get("minItems", 0)
         max_items = schema.get("maxItems")
+
+        def item():
+            return build_schema_nfa(n, items, depth + 1, ws)
+
+        def comma_item():
+            return n.concat(n.lit(","), ws(), item())
+
         if max_items is not None:
             if max_items < min_items:
                 raise SchemaError("maxItems < minItems")
@@ -235,34 +277,74 @@ def build_schema_nfa(n: _NFA, schema: dict[str, Any], depth: int = 0) -> tuple[i
             # optional tail inside-out from the last position.
             tail = None  # optional ',item' chain after position i
             for _ in range(max_items - max(min_items, 1)):
-                piece = n.concat(n.lit(","), build_schema_nfa(n, items, depth + 1))
+                piece = comma_item()
                 tail = n.opt(piece if tail is None else n.concat(piece, tail))
             if min_items >= 1:
                 frag = None
                 for i in range(min_items):
-                    piece = build_schema_nfa(n, items, depth + 1)
-                    if i > 0:
-                        piece = n.concat(n.lit(","), piece)
+                    piece = item() if i == 0 else comma_item()
                     frag = piece if frag is None else n.concat(frag, piece)
                 body = frag if tail is None else n.concat(frag, tail)
             else:
-                first = build_schema_nfa(n, items, depth + 1)
+                first = item()
                 body = n.opt(first if tail is None else n.concat(first, tail))
-            return n.concat(n.lit("["), body, n.lit("]"))
-        head = item
-        tail = n.star(n.concat(n.lit(","), build_schema_nfa(n, items, depth + 1)))
-        nonempty = n.concat(head, tail)
+            return n.concat(n.lit("["), ws(), body, ws(), n.lit("]"))
+        nonempty = n.concat(item(), n.star(comma_item()))
         body = nonempty if min_items >= 1 else n.opt(nonempty)
-        return n.concat(n.lit("["), body, n.lit("]"))
+        return n.concat(n.lit("["), ws(), body, ws(), n.lit("]"))
     if t == "object" or "properties" in schema:
-        props = schema.get("properties", {})
+        props = list(schema.get("properties", {}).items())
         if not props:
-            return n.lit("{}")
-        frag = n.lit("{")
-        for i, (name, sub) in enumerate(props.items()):
-            key = n.lit(("," if i else "") + json.dumps(name) + ":")
-            frag = n.concat(frag, key, build_schema_nfa(n, sub, depth + 1))
-        return n.concat(frag, n.lit("}"))
+            return n.concat(n.lit("{"), ws(), n.lit("}"))
+        req = schema.get("required")
+        if req is None:
+            # v1-compatible canonical form: every declared property emitted.
+            required = {name for name, _ in props}
+        else:
+            required = set(req)
+            unknown = required - {name for name, _ in props}
+            if unknown:
+                raise SchemaError(f"required names undeclared properties: {sorted(unknown)}")
+
+        def prop(name: str, sub: dict, lead_comma: bool) -> tuple[int, int]:
+            parts = [n.lit(","), ws()] if lead_comma else []
+            parts += [
+                n.lit(json.dumps(name)),
+                n.lit(":"),
+                ws(),
+                build_schema_nfa(n, sub, depth + 1, ws),
+            ]
+            return n.concat(*[p for p in parts if p is not None])
+
+        # tails[i]: properties i.. given one already emitted (comma-led); an
+        # optional property alternates between appearing and falling through
+        # to the rest. Shared-subgraph NFA, built inside-out like arrays.
+        tails: list[tuple[int, int] | None] = [None] * (len(props) + 1)
+        for i in range(len(props) - 1, -1, -1):
+            name, sub = props[i]
+            full = prop(name, sub, True)
+            if tails[i + 1] is not None:
+                full = n.concat(full, tails[i + 1])
+            if name in required:
+                tails[i] = full
+            elif tails[i + 1] is None:
+                tails[i] = n.opt(full)
+            else:
+                tails[i] = n.alt(full, tails[i + 1])
+        # heads: alternation over which property is emitted FIRST (no comma);
+        # only properties preceded exclusively by optionals can be first.
+        heads = []
+        for i, (name, sub) in enumerate(props):
+            h = prop(name, sub, False)
+            if tails[i + 1] is not None:
+                h = n.concat(h, tails[i + 1])
+            heads.append(h)
+            if name in required:
+                break
+        body = heads[0] if len(heads) == 1 else n.alt(*heads)
+        if not required:  # fully-optional object may be empty
+            body = n.opt(body)
+        return n.concat(n.lit("{"), ws(), body, ws(), n.lit("}"))
     raise SchemaError(f"unsupported schema node: {schema!r}")
 
 
@@ -394,10 +476,20 @@ def close_over_vocab(
     return Grammar(trans=trans, accept=accept.copy(), start=0)
 
 
-def compile_json_schema(schema: dict[str, Any], vocab: list[bytes]) -> Grammar:
-    """schema + tokenizer vocabulary → token-level Grammar."""
+def compile_json_schema(
+    schema: dict[str, Any],
+    vocab: list[bytes],
+    *,
+    whitespace: bool = False,
+    max_ws: int = 8,
+) -> Grammar:
+    """schema + tokenizer vocabulary → token-level Grammar.
+
+    whitespace=True additionally accepts ≤ max_ws blanks at structural
+    boundaries (pretty-printed output); bounded so generation cannot stall
+    sampling whitespace forever."""
     n = _NFA()
-    frag = build_schema_nfa(n, schema)
+    frag = build_schema_nfa(n, schema, ws=_make_ws(n, max_ws if whitespace else 0))
     T, accept = nfa_to_dfa(n, frag[0], frag[1])
     return close_over_vocab(T, accept, vocab)
 

@@ -142,7 +142,10 @@ class ModelBackend:
         # (VisionConfig, params). A name/config gets random-init params
         # (plumbing + tests; checkpoint loading hands params in directly).
         # None → image inputs are rejected with a clear error.
+        grammar_whitespace: bool = False,  # constrained output may carry
+        # bounded whitespace (grammar.py v2) instead of canonical compact JSON
     ):
+        self.grammar_whitespace = grammar_whitespace
         self.cfg = cfg
         self.model_name = model_name
         self.engine = InferenceEngine(params, cfg, ecfg, seed=seed, mesh=mesh)
@@ -316,7 +319,7 @@ class ModelBackend:
         g = self._grammars.get(key)
         if g is None:
             vocab = self.tokenizer.token_bytes(self.cfg.vocab_size)
-            g = compile_json_schema(schema, vocab)
+            g = compile_json_schema(schema, vocab, whitespace=self.grammar_whitespace)
             self._grammars[key] = g
         self._grammars.move_to_end(key)
         while len(self._grammars) > self._grammars_max:
@@ -637,6 +640,7 @@ def build_model_node(
     tp: int = 1,
     vision=None,  # vision tower config name/VisionConfig/(cfg, params) —
     # enables image inputs on this node (ModelBackend vision contract)
+    grammar_whitespace: bool = False,
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -671,7 +675,7 @@ def build_model_node(
         mesh = make_mesh({AXIS_MODEL: tp})
     backend = ModelBackend(
         params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model,
-        mesh=mesh, vision=vision,
+        mesh=mesh, vision=vision, grammar_whitespace=grammar_whitespace,
     )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
@@ -687,6 +691,7 @@ def build_model_node(
     # /api/v1/nodes metadata and the dashboard.
     agent.heartbeat_stats = lambda: {
         **backend.engine.stats,
+        **backend.engine.grammar_bank_stats(),
         "active_slots": backend.engine.num_active,
         "free_pages": backend.engine.allocator.free_pages,
     }

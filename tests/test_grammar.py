@@ -399,3 +399,147 @@ class TestAiSchemaEndToEnd:
                     await backend.stop()
 
         run()
+
+
+class TestGrammarV2:
+    """Round-4 relaxations: `required` subsets (optional properties) and
+    bounded whitespace tolerance (VERDICT round-2 item 8)."""
+
+    def _dfa_ws(self, schema, max_ws=8):
+        from agentfield_tpu.serving.grammar import _make_ws
+
+        n = _NFA()
+        frag = build_schema_nfa(n, schema, ws=_make_ws(n, max_ws))
+        return nfa_to_dfa(n, frag[0], frag[1])
+
+    OPT_SCHEMA = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "ok": {"type": "boolean"},
+        },
+        "required": ["name"],
+    }
+
+    def test_optional_properties_accept_subsets(self):
+        T, acc = _dfa(self.OPT_SCHEMA)
+        for doc in [
+            {"name": "x"},
+            {"name": "x", "age": 3},
+            {"name": "x", "ok": True},
+            {"name": "x", "age": 3, "ok": False},
+        ]:
+            data = json.dumps(doc, separators=(",", ":")).encode()
+            assert match_bytes(T, acc, data), data
+
+    def test_optional_properties_reject_bad_forms(self):
+        T, acc = _dfa(self.OPT_SCHEMA)
+        for bad in [
+            b"{}",  # missing required name
+            b'{"age":3}',  # missing required name
+            b'{"name":"x",}',  # trailing comma
+            b'{"name":"x",,"age":3}',  # double comma
+            b'{,"name":"x"}',  # leading comma
+            b'{"age":3,"name":"x"}',  # declaration order violated
+            b'{"name":"x","age":3,"age":4}',  # duplicate property
+        ]:
+            assert not match_bytes(T, acc, bad), bad
+
+    def test_all_optional_accepts_empty_object(self):
+        schema = {
+            "type": "object",
+            "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+            "required": [],
+        }
+        T, acc = _dfa(schema)
+        for doc in [b"{}", b'{"a":1}', b'{"b":true}', b'{"a":1,"b":false}']:
+            assert match_bytes(T, acc, doc), doc
+        assert not match_bytes(T, acc, b'{"a":1,}')
+
+    def test_required_middle_property(self):
+        schema = {
+            "type": "object",
+            "properties": {
+                "a": {"type": "integer"},
+                "b": {"type": "boolean"},
+                "c": {"type": "string"},
+            },
+            "required": ["b"],
+        }
+        T, acc = _dfa(schema)
+        for doc in [
+            {"b": True},
+            {"a": 1, "b": False},
+            {"b": True, "c": "x"},
+            {"a": 1, "b": True, "c": "y"},
+        ]:
+            assert match_bytes(T, acc, json.dumps(doc, separators=(",", ":")).encode())
+        for bad in [b"{}", b'{"a":1}', b'{"a":1,"c":"x"}', b'{"c":"x","b":true}']:
+            assert not match_bytes(T, acc, bad), bad
+
+    def test_required_undeclared_raises(self):
+        with pytest.raises(SchemaError):
+            _dfa({"type": "object", "properties": {"a": {"type": "integer"}}, "required": ["z"]})
+
+    def test_whitespace_accepts_pretty_printed(self):
+        T, acc = self._dfa_ws(self.OPT_SCHEMA)
+        doc = {"name": "x", "age": 3, "ok": True}
+        for dump in [
+            json.dumps(doc, separators=(",", ":")),  # compact still accepted
+            json.dumps(doc),  # ", " / ": " separators
+            json.dumps(doc, indent=2),  # newline + 2-space indent
+            '{ "name" :  "x"}'.replace(" :", ":"),  # ws after { and :
+        ]:
+            assert match_bytes(T, acc, dump.encode()), dump
+
+    def test_whitespace_bounded(self):
+        T, acc = self._dfa_ws(self.OPT_SCHEMA, max_ws=2)
+        assert match_bytes(T, acc, b'{  "name":"x"}')
+        assert not match_bytes(T, acc, b'{    "name":"x"}')  # 4 blanks > max_ws=2
+        # disabled ws still rejects any blank
+        T0, acc0 = _dfa(self.OPT_SCHEMA)
+        assert not match_bytes(T0, acc0, b'{ "name":"x"}')
+
+    def test_whitespace_arrays_and_nested(self):
+        schema = {
+            "type": "object",
+            "properties": {
+                "tags": {"type": "array", "items": {"type": "integer"}},
+                "sub": {
+                    "type": "object",
+                    "properties": {"v": {"type": "number"}},
+                    "required": [],
+                },
+            },
+            "required": ["tags"],
+        }
+        T, acc = self._dfa_ws(schema)
+        for dump in [
+            json.dumps({"tags": [1, 2, 3], "sub": {"v": 1.5}}, indent=2),
+            json.dumps({"tags": []}, indent=4),
+            '{"tags": [ 1, 2 ]}',
+        ]:
+            assert match_bytes(T, acc, dump.encode()), dump
+
+    def test_token_closure_with_optionals_validates(self):
+        vocab = [bytes([b]) for b in range(256)] + [
+            b'{"', b'"}', b'":', b'","', b"name", b"age", b"ok",
+            b"true", b"false", b'{"name":"', b'",led',
+        ]
+        g = compile_json_schema(self.OPT_SCHEMA, vocab, whitespace=True)
+        # greedy-walk a few valid docs through the token automaton
+        for doc in [{"name": "a"}, {"name": "a", "age": 7}]:
+            data = json.dumps(doc, separators=(",", ":")).encode()
+            s, i = g.start, 0
+            while i < len(data):
+                # longest vocab token that advances
+                best = None
+                for tid, tok in enumerate(vocab):
+                    if tok and data[i : i + len(tok)] == tok and g.trans[s, tid] >= 0:
+                        if best is None or len(tok) > len(vocab[best]):
+                            best = tid
+                assert best is not None, (data, i)
+                s = g.trans[s, best]
+                i += len(vocab[best])
+            assert g.accept[s], data

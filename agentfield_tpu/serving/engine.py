@@ -702,17 +702,27 @@ class InferenceEngine:
         """Capacity gauges for the constrained-decoding bank (VERDICT r2 item
         8): how close the int16 row bank is to exhaustion, how many grammars
         are resident, and how many are pinned by in-flight requests."""
-        free = sum(s for _, s in self._gbank_free)
-        total = max(1, self.ecfg.grammar_slots)
-        return {
-            "grammar_bank_rows": total,
-            "grammar_bank_rows_free": free,
-            "grammar_bank_rows_used": total - free,
-            "grammar_bank_grammars": len(self._gbank_entries),
-            "grammar_bank_grammars_in_use": sum(
-                1 for e in self._gbank_entries.values() if e["refs"] > 0
-            ),
-        }
+        if self.ecfg.grammar_slots <= 0:  # constrained decoding disabled
+            return {
+                "grammar_bank_rows": 0,
+                "grammar_bank_rows_free": 0,
+                "grammar_bank_rows_used": 0,
+                "grammar_bank_grammars": 0,
+                "grammar_bank_grammars_in_use": 0,
+            }
+        with self._session_lock:  # acquire/release mutate the bank on the
+            # event-loop and worker threads under this lock
+            free = sum(s for _, s in self._gbank_free)
+            usable = self.ecfg.grammar_slots - 1  # row 0 = unconstrained state
+            return {
+                "grammar_bank_rows": usable,
+                "grammar_bank_rows_free": free,
+                "grammar_bank_rows_used": usable - free,
+                "grammar_bank_grammars": len(self._gbank_entries),
+                "grammar_bank_grammars_in_use": sum(
+                    1 for e in self._gbank_entries.values() if e["refs"] > 0
+                ),
+            }
 
     def _gbank_alloc_range(self, n: int) -> int | None:
         """First-fit over the free list (ranges never move, so active bank-

@@ -294,17 +294,20 @@ def build_schema_nfa(
         return n.concat(n.lit("["), ws(), body, ws(), n.lit("]"))
     if t == "object" or "properties" in schema:
         props = list(schema.get("properties", {}).items())
+        req = schema.get("required")
+        if req is not None:
+            unknown = set(req) - {name for name, _ in props}
+            if unknown:
+                # Checked before the empty-props early-out: an unsatisfiable
+                # schema must fail loudly, not compile to a {}-only grammar.
+                raise SchemaError(f"required names undeclared properties: {sorted(unknown)}")
         if not props:
             return n.concat(n.lit("{"), ws(), n.lit("}"))
-        req = schema.get("required")
         if req is None:
             # v1-compatible canonical form: every declared property emitted.
             required = {name for name, _ in props}
         else:
             required = set(req)
-            unknown = required - {name for name, _ in props}
-            if unknown:
-                raise SchemaError(f"required names undeclared properties: {sorted(unknown)}")
 
         def prop(name: str, sub: dict, lead_comma: bool) -> tuple[int, int]:
             parts = [n.lit(","), ws()] if lead_comma else []
@@ -320,7 +323,8 @@ def build_schema_nfa(
         # optional property alternates between appearing and falling through
         # to the rest. Shared-subgraph NFA, built inside-out like arrays.
         tails: list[tuple[int, int] | None] = [None] * (len(props) + 1)
-        for i in range(len(props) - 1, -1, -1):
+        # heads only consume tails[1..] — property 0 is never comma-led
+        for i in range(len(props) - 1, 0, -1):
             name, sub = props[i]
             full = prop(name, sub, True)
             if tails[i + 1] is not None:

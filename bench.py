@@ -48,6 +48,8 @@ _done = threading.Event()
 _partial: dict = {}
 _t_start = time.monotonic()
 _deadline = [0.0]  # set in main()
+_emitted = threading.Lock()  # ONE JSON line, ever: first emitter wins
+_emitted_flag = [False]
 
 
 def _remaining() -> float:
@@ -55,6 +57,10 @@ def _remaining() -> float:
 
 
 def _emit(payload: dict) -> None:
+    with _emitted:
+        if _emitted_flag[0]:
+            return
+        _emitted_flag[0] = True
     print(json.dumps(payload), flush=True)
 
 
@@ -202,7 +208,69 @@ def _probe_device(cpu: bool, budget_s: float) -> str | None:
     return last
 
 
+def _cpu_fallback(reason: str) -> bool:
+    """TPU unreachable: re-run the bench on the CPU backend in a subprocess
+    (llama-tiny, small burst — one core) and ship ITS measured number, clearly
+    labeled, instead of a zero. CPU children are kill-safe (no tunnel claim).
+    Returns True if a JSON line was emitted."""
+    if os.environ.get("AGENTFIELD_BENCH_CPU") == "1":
+        return False  # already the CPU path — nothing further to fall back to
+    budget = _remaining() - 20
+    if budget < 180:
+        return False  # not enough budget for a CPU compile + run
+    _partial["stage"] = "cpu fallback"
+    env = dict(os.environ)
+    env.update(
+        AGENTFIELD_BENCH_CPU="1",
+        AGENTFIELD_BENCH_SKIP_PROBE="1",
+        AGENTFIELD_BENCH_MODEL="llama-tiny",
+        AGENTFIELD_BENCH_REQUESTS="32",
+        AGENTFIELD_BENCH_BATCH="8",
+        AGENTFIELD_BENCH_WATCHDOG=str(int(budget)),
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=budget + 15,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        payload = json.loads(line)
+    except Exception as e:  # noqa: BLE001 — any failure falls through to 0
+        _partial["cpu_fallback_error"] = repr(e)[:300]
+        return False
+    if not payload.get("value"):
+        _partial["cpu_fallback_error"] = payload.get("error", "cpu run returned 0")
+        return False
+    payload["headline_degraded"] = (
+        f"TPU unavailable ({reason}); measured on the CPU backend instead "
+        "(llama-tiny, 32-request burst) — NOT a chip number"
+    )
+    payload["device_fallback"] = "cpu"
+    _emit(payload)
+    return True
+
+
 def main() -> None:
+    try:
+        _run_bench()
+    except Exception as e:  # the one-JSON-line contract holds even when a
+        # stage raises (e.g. the TPU plugin throwing UNAVAILABLE out of
+        # jax.default_backend(), which round 4 hit). KeyboardInterrupt /
+        # SystemExit propagate — an operator's Ctrl-C must not trigger a
+        # multi-minute CPU re-bench.
+        reason = f"unhandled at stage {_partial.get('stage', 'init')}: {e!r}"[:400]
+        # A TPU-measured compile-gate number (from _partial["fallback"]) beats
+        # a CPU re-bench: only fall back to CPU when there is no real
+        # datapoint at all AND the device itself was the problem.
+        if _partial.get("fallback") is not None or not _cpu_fallback(reason):
+            _emit(_fallback_payload(reason))
+        _done.set()
+
+
+def _run_bench() -> None:
     watchdog_s = float(os.environ.get("AGENTFIELD_BENCH_WATCHDOG", "840"))
     _deadline[0] = time.monotonic() + (watchdog_s if watchdog_s > 0 else 86400.0) - 30.0
     if watchdog_s > 0:  # <= 0 disables the watchdog
@@ -219,7 +287,8 @@ def main() -> None:
         probe_budget = min(390.0, _remaining() * 0.45) if not cpu else 90.0
         err = _probe_device(cpu, probe_budget)
         if err is not None:
-            _emit(_fallback_payload(f"device probe failed: {err}"))
+            if not _cpu_fallback(f"device probe failed: {err}"):
+                _emit(_fallback_payload(f"device probe failed: {err}"))
             _done.set()
             return
 

@@ -543,3 +543,11 @@ class TestGrammarV2:
                 s = g.trans[s, best]
                 i += len(vocab[best])
             assert g.accept[s], data
+
+    def test_required_without_properties_raises(self):
+        for schema in [
+            {"type": "object", "required": ["x"]},
+            {"type": "object", "properties": {}, "required": ["x"]},
+        ]:
+            with pytest.raises(SchemaError):
+                _dfa(schema)

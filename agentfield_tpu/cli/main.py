@@ -172,6 +172,8 @@ def cmd_model(cfg: Config, args) -> int:
             tp=mn.tp,
             vision=mn.vision,
             grammar_whitespace=mn.grammar_whitespace,
+            audio=mn.audio,
+            tts=mn.tts,
         )
         await backend.start()
         await agent.start()

@@ -65,6 +65,8 @@ class ModelNodeConfig:
     # schema-constrained output (pretty-printed JSON) instead of canonical
     # compact form
     vision: str | None = None  # vision tower config name → serve image inputs
+    audio: str | None = None  # audio tower config name → serve audio inputs
+    tts: str | None = None  # TTS head config name → serve audio OUTPUT
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 
 

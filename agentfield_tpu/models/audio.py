@@ -1,0 +1,364 @@
+"""Audio: log-mel encoder tower (input modality) + TTS head (output modality).
+
+The reference serves audio through external providers — transcription rides
+chat parts and TTS/chat-audio hit speech APIs (sdk/python/agentfield/
+agent_ai.py:750-1002). Here both directions are SERVED in-tree:
+
+- INPUT — ``audio_encode``: waveform → log-mel spectrogram → frame-grouped
+  transformer encoder → LLM-space embeddings, injected at ``<audio>`` marker
+  positions of the prompt exactly like the vision tower's patches
+  (models/vision.py, LLaVA-style early fusion). The engine's ``mm_embeds``
+  seam is modality-agnostic, so audio rides the same injection path.
+- OUTPUT — ``tts_synthesize``: byte-level text → transformer encoder →
+  per-character frame upsampling → waveform head. With trained weights this
+  is a compact non-autoregressive TTS (FastSpeech-family shape); with random
+  init it proves the served-output seam end to end (WAV bytes leave ai()).
+
+TPU-first: framing/grouping are reshapes where possible, the mel filterbank
+is a constant matmul, encoders are one ``lax.scan`` over stacked layer
+weights (models/llama.py idiom), everything lands on the MXU in bf16, and
+all shapes are static per config so serving stays compile-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import struct
+import wave as _wave
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AudioConfig:
+    """Input tower: waveform → LLM-space embeddings."""
+
+    sample_rate: int = 16000
+    n_fft: int = 400  # 25 ms window
+    hop: int = 160  # 10 ms hop
+    n_mels: int = 80
+    max_seconds: float = 10.0  # static waveform budget (pad/trim)
+    frame_group: int = 4  # consecutive mel frames per encoder token
+    hidden_size: int = 512
+    num_layers: int = 6
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    out_dim: int = 2048  # LLM hidden size the projector maps into
+    layer_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def max_samples(self) -> int:
+        return int(self.sample_rate * self.max_seconds)
+
+    @property
+    def n_frames(self) -> int:
+        return 1 + (self.max_samples - self.n_fft) // self.hop
+
+    @property
+    def n_tokens(self) -> int:
+        return self.n_frames // self.frame_group
+
+
+@dataclasses.dataclass(frozen=True)
+class TTSConfig:
+    """Output head: byte-level text → waveform."""
+
+    sample_rate: int = 16000
+    vocab_size: int = 256  # byte-level input (self-contained, any tokenizer)
+    max_chars: int = 256  # static text budget
+    frames_per_char: int = 8  # upsampling factor (≈ phoneme duration)
+    samples_per_frame: int = 160  # 10 ms of audio per frame
+    hidden_size: int = 384
+    num_layers: int = 4
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    layer_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def max_samples(self) -> int:
+        return self.max_chars * self.frames_per_char * self.samples_per_frame
+
+
+CONFIGS = {
+    # capacity tower for the flagship 1B preset (Whisper-base-ish encoder)
+    "audio-base": AudioConfig(),
+    # hermetic test tower: ~1 s budget, compiles in seconds on CPU; out_dim
+    # matches llama-tiny's hidden_size so engine tests fuse without adapters
+    "audio-tiny": AudioConfig(
+        n_fft=128, hop=64, n_mels=16, max_seconds=1.0, frame_group=4,
+        hidden_size=32, num_layers=2, num_heads=2, out_dim=128,
+    ),
+}
+
+TTS_CONFIGS = {
+    "tts-base": TTSConfig(),
+    # hermetic test head: ~0.5 s ceiling, tiny encoder
+    "tts-tiny": TTSConfig(
+        max_chars=32, frames_per_char=4, samples_per_frame=40,
+        hidden_size=32, num_layers=2, num_heads=2,
+    ),
+}
+
+
+def get_audio_config(name: str) -> AudioConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown audio config {name!r}; have {sorted(CONFIGS)}")
+    return CONFIGS[name]
+
+
+def get_tts_config(name: str) -> TTSConfig:
+    if name not in TTS_CONFIGS:
+        raise KeyError(f"unknown tts config {name!r}; have {sorted(TTS_CONFIGS)}")
+    return TTS_CONFIGS[name]
+
+
+# ---------------------------------------------------------------------------
+# log-mel front end
+# ---------------------------------------------------------------------------
+
+
+def _mel_filterbank(cfg: AudioConfig) -> np.ndarray:
+    """[n_fft//2+1, n_mels] triangular mel filterbank (HTK mel scale).
+    Host-built constant — closes into the jitted encoder as a matmul."""
+    n_bins = cfg.n_fft // 2 + 1
+    f_max = cfg.sample_rate / 2.0
+    mel_max = 2595.0 * np.log10(1.0 + f_max / 700.0)
+    mel_pts = np.linspace(0.0, mel_max, cfg.n_mels + 2)
+    hz_pts = 700.0 * (10.0 ** (mel_pts / 2595.0) - 1.0)
+    bins = np.floor((cfg.n_fft + 1) * hz_pts / cfg.sample_rate).astype(int)
+    fb = np.zeros((n_bins, cfg.n_mels), np.float32)
+    for m in range(1, cfg.n_mels + 1):
+        lo, c, hi = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(lo, c):
+            if c > lo:
+                fb[k, m - 1] = (k - lo) / (c - lo)
+        for k in range(c, hi):
+            if hi > c:
+                fb[k, m - 1] = (hi - k) / (hi - c)
+    return fb
+
+
+def log_mel(cfg: AudioConfig, wave: jax.Array) -> jax.Array:
+    """[B, max_samples] float in [-1, 1] → [B, n_frames, n_mels] log-mel.
+
+    Overlapping frames are one strided gather (static index matrix), the DFT
+    is ``jnp.fft.rfft`` over the last axis, and the filterbank is a matmul —
+    no Python loops inside jit."""
+    idx = (
+        np.arange(cfg.n_frames)[:, None] * cfg.hop + np.arange(cfg.n_fft)[None, :]
+    )  # [n_frames, n_fft] static
+    frames = wave[:, idx]  # [B, n_frames, n_fft]
+    window = jnp.asarray(np.hanning(cfg.n_fft).astype(np.float32))
+    spec = jnp.fft.rfft(frames.astype(jnp.float32) * window, axis=-1)
+    power = jnp.abs(spec) ** 2
+    mel = power @ jnp.asarray(_mel_filterbank(cfg))
+    return jnp.log(mel + 1e-6)
+
+
+# ---------------------------------------------------------------------------
+# shared transformer encoder (scan over stacked layers, vision.py idiom)
+# ---------------------------------------------------------------------------
+
+
+def _layer_norm(x: jax.Array, w: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _init_encoder_layers(key: jax.Array, L: int, d: int, f: int, dt) -> Params:
+    ks = jax.random.split(key, 4)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "ln1_w": jnp.ones((L, d), dt),
+        "ln1_b": jnp.zeros((L, d), dt),
+        "ln2_w": jnp.ones((L, d), dt),
+        "ln2_b": jnp.zeros((L, d), dt),
+        "wqkv": norm(ks[0], (L, d, 3 * d)),
+        "wo": norm(ks[1], (L, d, d)),
+        "w1": norm(ks[2], (L, d, f)),
+        "w2": norm(ks[3], (L, f, d)),
+    }
+
+
+def _encoder(x: jax.Array, layers: Params, num_heads: int, eps: float) -> jax.Array:
+    """Bidirectional pre-LN transformer over [B, N, d]; one lax.scan."""
+    B, N, d = x.shape
+    hd = d // num_heads
+
+    def body(x, lp):
+        h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], eps)
+        qkv = (h @ lp["wqkv"]).reshape(B, N, 3, num_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum(
+            "bnhd,bmhd->bhnm", q, k, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum(
+            "bhnm,bmhd->bnhd", probs, v, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        x = x + attn.reshape(B, N, d) @ lp["wo"]
+        h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], eps)
+        x = x + jax.nn.gelu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, layers)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# input tower: waveform → LLM-space embeddings
+# ---------------------------------------------------------------------------
+
+
+def init_audio_params(cfg: AudioConfig, key: jax.Array) -> Params:
+    dt = jnp.dtype(cfg.dtype)
+    d = cfg.hidden_size
+    keys = jax.random.split(key, 5)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "frame_embed": norm(keys[0], (cfg.frame_group * cfg.n_mels, d)),
+        "pos_embed": norm(keys[1], (cfg.n_tokens, d)),
+        "layers": _init_encoder_layers(keys[2], cfg.num_layers, d, d * cfg.mlp_ratio, dt),
+        "final_ln_w": jnp.ones((d,), dt),
+        "final_ln_b": jnp.zeros((d,), dt),
+        # two-layer GELU projector into LLM space (vision.py idiom)
+        "proj_w1": norm(keys[3], (d, cfg.out_dim)),
+        "proj_w2": norm(keys[4], (cfg.out_dim, cfg.out_dim)),
+    }
+
+
+def audio_encode(params: Params, cfg: AudioConfig, wave: jax.Array) -> jax.Array:
+    """Encode waveforms into LLM-space embeddings.
+
+    wave: [B, max_samples] float32 in [-1, 1] (pad/trim on host)
+    returns: [B, n_tokens, out_dim] in the tower dtype
+    """
+    dt = jnp.dtype(cfg.dtype)
+    mel = log_mel(cfg, wave)  # [B, n_frames, n_mels]
+    B = mel.shape[0]
+    # group consecutive frames into one token — a reshape, no conv unrolling
+    usable = cfg.n_tokens * cfg.frame_group
+    x = mel[:, :usable].reshape(B, cfg.n_tokens, cfg.frame_group * cfg.n_mels)
+    x = x.astype(dt) @ params["frame_embed"] + params["pos_embed"]
+    x = _encoder(x, params["layers"], cfg.num_heads, cfg.layer_norm_eps)
+    x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    h = jax.nn.gelu((x @ params["proj_w1"]).astype(jnp.float32)).astype(x.dtype)
+    return h @ params["proj_w2"]
+
+
+audio_encode_jit = jax.jit(audio_encode, static_argnames=("cfg",))
+
+
+# ---------------------------------------------------------------------------
+# output head: text bytes → waveform
+# ---------------------------------------------------------------------------
+
+
+def init_tts_params(cfg: TTSConfig, key: jax.Array) -> Params:
+    dt = jnp.dtype(cfg.dtype)
+    d = cfg.hidden_size
+    keys = jax.random.split(key, 5)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "char_embed": norm(keys[0], (cfg.vocab_size, d)),
+        "pos_embed": norm(keys[1], (cfg.max_chars, d)),
+        "layers": _init_encoder_layers(keys[2], cfg.num_layers, d, d * cfg.mlp_ratio, dt),
+        "final_ln_w": jnp.ones((d,), dt),
+        "final_ln_b": jnp.zeros((d,), dt),
+        # upsample: one char token → frames_per_char frame vectors
+        "up_w": norm(keys[3], (d, cfg.frames_per_char * d)),
+        # waveform head: one frame vector → samples_per_frame samples
+        "wav_w": norm(keys[4], (d, cfg.samples_per_frame)),
+    }
+
+
+def tts_synthesize(params: Params, cfg: TTSConfig, char_ids: jax.Array) -> jax.Array:
+    """Non-autoregressive synthesis: [B, max_chars] int32 byte ids (0-padded)
+    → [B, max_samples] float32 waveform in (-1, 1). Trim to the speakable
+    length (chars * frames_per_char * samples_per_frame) on the host."""
+    B = char_ids.shape[0]
+    d = cfg.hidden_size
+    x = params["char_embed"][char_ids] + params["pos_embed"]
+    x = _encoder(x, params["layers"], cfg.num_heads, cfg.layer_norm_eps)
+    x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    frames = (x @ params["up_w"]).reshape(B, cfg.max_chars * cfg.frames_per_char, d)
+    wav = (frames @ params["wav_w"]).astype(jnp.float32).reshape(B, cfg.max_samples)
+    return jnp.tanh(wav)
+
+
+tts_synthesize_jit = jax.jit(tts_synthesize, static_argnames=("cfg",))
+
+
+# ---------------------------------------------------------------------------
+# WAV codec (host side, stdlib only)
+# ---------------------------------------------------------------------------
+
+
+def wav_to_float(data: bytes, target_rate: int, max_samples: int) -> np.ndarray:
+    """Decode a PCM WAV to [max_samples] float32 in [-1, 1]: mono-mix,
+    nearest-neighbour resample to target_rate, pad/trim to the static
+    budget. Raises ValueError on non-PCM or malformed input."""
+    try:
+        with _wave.open(io.BytesIO(data), "rb") as w:
+            n_ch, width, rate, n_frames = (
+                w.getnchannels(), w.getsampwidth(), w.getframerate(), w.getnframes(),
+            )
+            raw = w.readframes(n_frames)
+    except (_wave.Error, EOFError, struct.error) as e:
+        raise ValueError(f"not a decodable PCM WAV: {e}") from e
+    if width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 1:  # unsigned 8-bit
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    if n_ch > 1:
+        x = x[: (len(x) // n_ch) * n_ch].reshape(-1, n_ch).mean(axis=1)
+    if rate != target_rate and len(x):
+        idx = np.clip(
+            (np.arange(int(len(x) * target_rate / rate)) * rate / target_rate),
+            0, len(x) - 1,
+        ).astype(np.int64)
+        x = x[idx]
+    out = np.zeros((max_samples,), np.float32)
+    n = min(len(x), max_samples)
+    out[:n] = x[:n]
+    return out
+
+
+def float_to_wav(wave_f32: np.ndarray, rate: int) -> bytes:
+    """[-1, 1] float32 → 16-bit mono PCM WAV bytes."""
+    pcm = (np.clip(wave_f32, -1.0, 1.0) * 32767.0).astype("<i2")
+    buf = io.BytesIO()
+    with _wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+    return buf.getvalue()

@@ -342,6 +342,8 @@ class Agent:
         schema: dict[str, Any] | None = None,
         context_overflow: str = "truncate_left",
         images: list[Any] | None = None,
+        audio: list[Any] | None = None,
+        output: str = "text",
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -376,6 +378,25 @@ class Agent:
                     f"but only {len(images)} images were passed"
                 )
             prompt = prompt + "\n<image>" * missing
+        if audio:
+            if prompt is None:
+                raise ValueError("audio inputs require a text prompt")
+            audio = _normalize_audio(audio)
+            missing = len(audio) - prompt.count("<audio>")
+            if missing < 0:
+                raise ValueError(
+                    f"prompt has {prompt.count('<audio>')} <audio> markers "
+                    f"but only {len(audio)} audio parts were passed"
+                )
+            prompt = prompt + "\n<audio>" * missing
+        if output not in ("text", "audio", "speech"):
+            raise ValueError(
+                f"unknown output modality {output!r}: 'text' | 'audio' "
+                "(speak the prompt, reference agent_ai.py:750 TTS) | "
+                "'speech' (generate text, then speak it — chat-audio)"
+            )
+        if output != "text" and schema is not None:
+            raise ValueError("schema-constrained decoding is text-only")
         if schema is not None:
             if prompt is None:
                 raise ValueError("schema requires a text prompt")
@@ -387,6 +408,8 @@ class Agent:
             "prompt": prompt,
             "tokens": tokens,
             "images": images or None,
+            "audios": audio or None,
+            "output": output,
             "max_new_tokens": max_new_tokens,
             "temperature": temperature,
             "top_k": top_k,
@@ -506,24 +529,25 @@ class Agent:
     async def ai_with_multimodal(self, *parts: Any, **kw) -> dict[str, Any]:
         """Mixed-content call (reference: ai_with_multimodal,
         agent_ai.py:1069): args classify in order — text joins the prompt,
-        images ride to the vision tower, audio raises until an audio tower
-        lands."""
-        from agentfield_tpu.sdk.multimodal import split_prompt_and_images
+        images ride to the vision tower, audio to the audio tower."""
+        from agentfield_tpu.sdk.multimodal import split_prompt_and_media
 
-        prompt, images = split_prompt_and_images(list(parts))
-        return await self.ai(prompt, images=images or None, **kw)
-
-    async def ai_with_audio(self, *_a, **_kw):
-        """Audio chat/TTS is not a served modality yet (reference:
-        ai_with_audio, agent_ai.py:750). Raises UnsupportedModalityError —
-        the typed content surface (sdk/multimodal.py) is already stable for
-        an audio tower to slot in."""
-        from agentfield_tpu.sdk.multimodal import UnsupportedModalityError
-
-        raise UnsupportedModalityError(
-            "audio generation/understanding needs an audio-tower model node; "
-            "text + image inputs are served today"
+        prompt, images, audios = split_prompt_and_media(list(parts))
+        return await self.ai(
+            prompt, images=images or None, audio=audios or None, **kw
         )
+
+    async def ai_with_audio(
+        self, prompt: str, audio: Any = None, **kw
+    ) -> dict[str, Any]:
+        """Audio sugar (reference: ai_with_audio, agent_ai.py:750). With an
+        ``audio`` input the clip is understood through the node's audio tower
+        (``<audio>`` early fusion); without one the call is TTS — the node's
+        TTS head speaks the generated text (output='speech')."""
+        if audio is not None:
+            return await self.ai(prompt, audio=[audio], **kw)
+        kw.setdefault("output", "speech")
+        return await self.ai(prompt, **kw)
 
     async def ai_stream(
         self,
@@ -891,4 +915,33 @@ def _normalize_images(items: list[Any]) -> list[dict[str, Any]]:
             out.append(_np.asarray(item).tolist())
         else:
             raise TypeError(f"cannot use {type(item).__name__} as an image input")
+    return out
+
+
+def _normalize_audio(items: list[Any]) -> list[dict[str, Any]]:
+    """ai(audio=...) accepts AudioContent, raw WAV bytes, file paths,
+    pre-built {"b64": ...} wire dicts, or sample arrays; everything
+    normalizes to the model node's wire forms (base64 WAV or sample list)."""
+    import base64 as _b64
+    from pathlib import Path as _Path
+
+    from agentfield_tpu.sdk.multimodal import AudioContent, classify
+
+    out: list[dict[str, Any]] = []
+    for item in items:
+        if isinstance(item, dict) and "b64" in item:
+            out.append(item)
+            continue
+        if isinstance(item, (str, _Path)):
+            item = AudioContent.from_file(item)
+        elif isinstance(item, bytes):
+            item = classify(item)
+        if isinstance(item, AudioContent):
+            out.append({"b64": _b64.b64encode(item.data).decode()})
+        elif isinstance(item, (list, tuple)) or hasattr(item, "__array__"):
+            import numpy as _np
+
+            out.append(_np.asarray(item, _np.float32).reshape(-1).tolist())
+        else:
+            raise TypeError(f"cannot use {type(item).__name__} as an audio input")
     return out

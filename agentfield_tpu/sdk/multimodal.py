@@ -5,11 +5,13 @@ multimodal.py: Text/Image/Audio/File content types, auto-detection of
 multimodal arguments, response wrapping with save helpers —
 agent_ai.py:449 `_process_multimodal_args`, multimodal_response.py).
 
-IMAGE INPUT is a served modality: ``Agent.ai(images=[...])`` routes image
-parts to a vision-tower model node (models/vision.py — ViT patch embeddings
-fused into the prompt, served by serving/model_node.py `_fuse_images`).
-Audio stays a clear capability error until an audio tower lands; the typed
-surface is stable so it slots in without SDK changes.
+IMAGE and AUDIO INPUT are served modalities: ``Agent.ai(images=[...])``
+routes image parts to a vision-tower model node (models/vision.py — ViT
+patch embeddings fused into the prompt) and ``Agent.ai(audio=[...])`` routes
+audio parts to an audio-tower node (models/audio.py — log-mel frame
+embeddings, same ``_fuse_media`` early-fusion path). AUDIO OUTPUT is served
+by the TTS head (``ai(output="audio"|"speech")`` → WAV parts in the
+response). Generic files remain a capability error.
 """
 
 from __future__ import annotations
@@ -118,20 +120,22 @@ def to_text_prompt(parts: list[Content]) -> str:
         else:
             raise UnsupportedModalityError(
                 f"{type(p).__name__} requires a multimodal model node "
-                "(text and image inputs are served; audio model nodes are "
-                "roadmap)"
+                "(this call path flattens to text only)"
             )
     return "\n".join(texts)
 
 
-def split_prompt_and_images(args: list[Any]) -> tuple[str, list[dict[str, Any]]]:
+def split_prompt_and_media(
+    args: list[Any],
+) -> tuple[str, list[dict[str, Any]], list[dict[str, Any]]]:
     """Classify mixed ai() args (reference `_process_multimodal_args`,
-    agent_ai.py:449): text parts join into the prompt with an ``<image>``
-    marker standing in for each image at its argument position; image parts
-    become the wire payload the model node's vision tower consumes. Audio/
-    file parts raise UnsupportedModalityError."""
+    agent_ai.py:449): text parts join into the prompt with an ``<image>`` /
+    ``<audio>`` marker standing in for each media part at its argument
+    position; media parts become the wire payloads the model node's towers
+    consume. File parts raise UnsupportedModalityError."""
     pieces: list[str] = []
     images: list[dict[str, Any]] = []
+    audios: list[dict[str, Any]] = []
     for arg in args:
         part = classify(arg)
         if isinstance(part, TextContent):
@@ -139,12 +143,26 @@ def split_prompt_and_images(args: list[Any]) -> tuple[str, list[dict[str, Any]]]
         elif isinstance(part, ImageContent):
             pieces.append("<image>")
             images.append({"b64": base64.b64encode(part.data).decode()})
+        elif isinstance(part, AudioContent):
+            pieces.append("<audio>")
+            audios.append({"b64": base64.b64encode(part.data).decode()})
         else:
             raise UnsupportedModalityError(
                 f"{type(part).__name__} is not a servable input modality "
-                "(text + image are; audio model nodes are roadmap)"
+                "(text, image, and audio are)"
             )
-    return "\n".join(pieces), images
+    return "\n".join(pieces), images, audios
+
+
+def split_prompt_and_images(args: list[Any]) -> tuple[str, list[dict[str, Any]]]:
+    """Image-only compatibility wrapper over split_prompt_and_media; audio
+    parts here raise (the caller asked for an images-only split)."""
+    prompt, images, audios = split_prompt_and_media(args)
+    if audios:
+        raise UnsupportedModalityError(
+            "audio parts need split_prompt_and_media / ai(audio=[...])"
+        )
+    return prompt, images
 
 
 # ---------------------------------------------------------------------------
@@ -168,10 +186,12 @@ class MultimodalResponse:
         out = []
         d = Path(directory)
         d.mkdir(parents=True, exist_ok=True)
+        # stdlib mimetypes lacks audio/wav on some platforms (only x-wav)
+        _EXT = {"audio/wav": ".wav", "audio/x-wav": ".wav"}
         for i, p in enumerate(self.parts):
             if isinstance(p, TextContent):
                 continue
-            ext = mimetypes.guess_extension(p.mime) or ".bin"
+            ext = _EXT.get(p.mime) or mimetypes.guess_extension(p.mime) or ".bin"
             path = d / f"{stem}_{i}{ext}"
             path.write_bytes(p.data)
             out.append(path)

@@ -144,6 +144,10 @@ class ModelBackend:
         # None → image inputs are rejected with a clear error.
         grammar_whitespace: bool = False,  # constrained output may carry
         # bounded whitespace (grammar.py v2) instead of canonical compact JSON
+        audio=None,  # audio input tower: config name, AudioConfig, or
+        # (AudioConfig, params) — serve <audio> prompt parts (models/audio.py)
+        tts=None,  # audio OUTPUT head: config name, TTSConfig, or
+        # (TTSConfig, params) — serve output="audio"/"speech" synthesis
     ):
         self.grammar_whitespace = grammar_whitespace
         self.cfg = cfg
@@ -172,6 +176,47 @@ class ModelBackend:
                     f"vision out_dim={self.vision_cfg.out_dim} must match the "
                     f"LM hidden_size={cfg.hidden_size}"
                 )
+        # Audio towers mirror the vision contract: a name/config random-inits
+        # (plumbing + tests), (cfg, params) serves trained weights.
+        self.audio_cfg = self.audio_params = None
+        if audio is not None:
+            import jax as _jax
+
+            from agentfield_tpu.models.audio import (
+                AudioConfig,
+                get_audio_config,
+                init_audio_params,
+            )
+
+            if isinstance(audio, str):
+                audio = get_audio_config(audio)
+            if isinstance(audio, AudioConfig):
+                self.audio_cfg = audio
+                self.audio_params = init_audio_params(audio, _jax.random.PRNGKey(seed + 2))
+            else:
+                self.audio_cfg, self.audio_params = audio
+            if self.audio_cfg.out_dim != cfg.hidden_size:
+                raise ValueError(
+                    f"audio out_dim={self.audio_cfg.out_dim} must match the "
+                    f"LM hidden_size={cfg.hidden_size}"
+                )
+        self.tts_cfg = self.tts_params = None
+        if tts is not None:
+            import jax as _jax
+
+            from agentfield_tpu.models.audio import (
+                TTSConfig,
+                get_tts_config,
+                init_tts_params,
+            )
+
+            if isinstance(tts, str):
+                tts = get_tts_config(tts)
+            if isinstance(tts, TTSConfig):
+                self.tts_cfg = tts
+                self.tts_params = init_tts_params(tts, _jax.random.PRNGKey(seed + 3))
+            else:
+                self.tts_cfg, self.tts_params = tts
         self.idle_sleep = idle_sleep
         # One accumulation dict: (token, logprob) records per request —
         # parallel dicts would need mirrored lifecycle at every cleanup site.
@@ -346,11 +391,52 @@ class ModelBackend:
         return await asyncio.shield(fut)
 
     async def ensure_images(self, prompt: str, images: list) -> tuple[list[int], list]:
-        """Run image decode + vision encoding OFF the event loop (mirrors
-        ensure_grammar): PIL decode plus a jitted tower forward — a compile
-        on first use — must not block heartbeats and /health. Returns the
-        (tokens, mm_embeds) pair _submit accepts as ``prefused``."""
-        return await asyncio.to_thread(self._fuse_images, prompt, images)
+        return await self.ensure_media(prompt, images, None)
+
+    async def ensure_media(
+        self, prompt: str, images: list | None, audios: list | None
+    ) -> tuple[list[int], list]:
+        """Run media decode + tower encoding OFF the event loop (mirrors
+        ensure_grammar): PIL/WAV decode plus a jitted tower forward — a
+        compile on first use — must not block heartbeats and /health. Returns
+        the (tokens, mm_embeds) pair _submit accepts as ``prefused``."""
+        return await asyncio.to_thread(self._fuse_media, prompt, images, audios)
+
+    def _synthesize_wav_b64(self, text: str) -> tuple[str, int]:
+        """Text → (WAV base64, truncated-byte count) through the TTS head;
+        the jitted synth runs on a worker thread (asyncio.to_thread at the
+        call sites). Text beyond the head's static max_chars budget is
+        dropped — reported so callers see the speech/text mismatch (mirrors
+        truncated_prompt_tokens)."""
+        import base64
+
+        import numpy as np
+
+        from agentfield_tpu.models.audio import (
+            float_to_wav,
+            tts_synthesize_jit,
+        )
+
+        if self.tts_cfg is None:
+            raise ValueError(
+                "this model node has no TTS head (audio output unsupported); "
+                "start it with tts=<config> to serve output='audio'/'speech'"
+            )
+        cfg = self.tts_cfg
+        full = text.encode("utf-8")
+        data = full[: cfg.max_chars]
+        while data and (data[-1] & 0xC0) == 0x80:
+            data = data[:-1]  # don't feed a dangling UTF-8 continuation run
+        if data and data[-1] >= 0xC0:
+            data = data[:-1]  # ...or its orphaned lead byte
+        truncated = len(full) - len(data)
+        ids = np.zeros((1, cfg.max_chars), np.int32)
+        if data:
+            ids[0, : len(data)] = np.frombuffer(data, np.uint8)
+        wav = np.asarray(tts_synthesize_jit(self.tts_params, cfg, ids)[0], np.float32)
+        # trim the static budget to the speakable span of THIS text
+        n = max(1, len(data)) * cfg.frames_per_char * cfg.samples_per_frame
+        return base64.b64encode(float_to_wav(wav[:n], cfg.sample_rate)).decode(), truncated
 
     def _decode_image(self, item) -> "np.ndarray":
         """One wire image → [S, S, 3] float32 in [0, 1]. Accepts raw encoded
@@ -385,41 +471,99 @@ class ModelBackend:
             arr = np.asarray(img, np.float32) / 255.0
         return arr
 
-    def _fuse_images(self, prompt: str, images: list) -> tuple[list[int], list]:
-        """Tokenize a prompt with ``<image>`` markers, encoding each image
-        through the vision tower and splicing placeholder tokens + embedding
-        spans at the marker positions (LLaVA-style early fusion). Returns
-        (tokens, mm_embeds for the engine)."""
+    def _decode_audio(self, item) -> "np.ndarray":
+        """One wire audio part → [max_samples] float32 in [-1, 1]. Accepts
+        raw WAV bytes (gRPC proto form), {"b64": <base64 WAV>} (HTTP/SDK wire
+        form), or a float array of samples (tests, pre-decoded callers)."""
         import numpy as np
 
-        from agentfield_tpu.models.vision import vision_encode_jit
+        from agentfield_tpu.models.audio import wav_to_float
 
-        if self.vision_cfg is None:
+        cfg = self.audio_cfg
+        raw = None
+        if isinstance(item, (bytes, bytearray)):
+            raw = bytes(item)
+        elif isinstance(item, dict) and "b64" in item:
+            import base64
+
+            raw = base64.b64decode(item["b64"])
+        if raw is not None:
+            return wav_to_float(raw, cfg.sample_rate, cfg.max_samples)
+        x = np.asarray(item, np.float32).reshape(-1)
+        out = np.zeros((cfg.max_samples,), np.float32)
+        n = min(len(x), cfg.max_samples)
+        out[:n] = np.clip(x[:n], -1.0, 1.0)
+        return out
+
+    def _fuse_images(self, prompt: str, images: list) -> tuple[list[int], list]:
+        return self._fuse_media(prompt, images, None)
+
+    def _fuse_media(
+        self, prompt: str, images: list | None, audios: list | None
+    ) -> tuple[list[int], list]:
+        """Tokenize a prompt with ``<image>``/``<audio>`` markers, encoding
+        each part through its tower and splicing placeholder tokens +
+        embedding spans at the marker positions (LLaVA-style early fusion).
+        The engine's mm_embeds seam is modality-agnostic, so image patch
+        embeddings and audio frame embeddings ride the same injection path.
+        Returns (tokens, mm_embeds for the engine)."""
+        import re
+
+        import numpy as np
+
+        images, audios = images or [], audios or []
+        if images and self.vision_cfg is None:
             raise ValueError(
                 "this model node has no vision tower (images unsupported); "
                 "start it with vision=<config> to serve image inputs"
             )
-        if self.tokenizer is None:
-            raise ValueError("image inputs need a tokenizer (text prompt)")
-        pieces = prompt.split("<image>")
-        if len(pieces) - 1 != len(images):
+        if audios and self.audio_cfg is None:
             raise ValueError(
-                f"prompt has {len(pieces) - 1} <image> markers for "
-                f"{len(images)} images"
+                "this model node has no audio tower (audio inputs "
+                "unsupported); start it with audio=<config> to serve them"
             )
-        batch = np.stack([self._decode_image(im) for im in images])
-        embs = np.asarray(
-            vision_encode_jit(self.vision_params, self.vision_cfg, batch),
-            np.float32,
-        )  # [N, patches, D]
+        if self.tokenizer is None:
+            raise ValueError("multimodal inputs need a tokenizer (text prompt)")
+        pieces = re.split(r"(<image>|<audio>)", prompt)
+        n_img = sum(1 for p in pieces if p == "<image>")
+        n_aud = sum(1 for p in pieces if p == "<audio>")
+        if n_img != len(images) or n_aud != len(audios):
+            raise ValueError(
+                f"prompt has {n_img} <image> + {n_aud} <audio> markers for "
+                f"{len(images)} images + {len(audios)} audio parts"
+            )
+        img_embs = aud_embs = None
+        if images:
+            from agentfield_tpu.models.vision import vision_encode_jit
+
+            batch = np.stack([self._decode_image(im) for im in images])
+            img_embs = np.asarray(
+                vision_encode_jit(self.vision_params, self.vision_cfg, batch),
+                np.float32,
+            )  # [N, patches, D]
+        if audios:
+            from agentfield_tpu.models.audio import audio_encode_jit
+
+            batch = np.stack([self._decode_audio(a) for a in audios])
+            aud_embs = np.asarray(
+                audio_encode_jit(self.audio_params, self.audio_cfg, batch),
+                np.float32,
+            )  # [N, frames, D]
         tokens: list[int] = []
         mm: list[tuple[int, Any]] = []
-        for i, piece in enumerate(pieces):
-            if piece:
-                tokens.extend(self.tokenizer.encode(piece))
-            if i < len(images):
-                mm.append((len(tokens), embs[i]))
-                tokens.extend([0] * embs.shape[1])
+        it_img = iter(range(len(images)))
+        it_aud = iter(range(len(audios)))
+        for piece in pieces:
+            if piece == "<image>":
+                emb = img_embs[next(it_img)]
+            elif piece == "<audio>":
+                emb = aud_embs[next(it_aud)]
+            else:
+                if piece:
+                    tokens.extend(self.tokenizer.encode(piece))
+                continue
+            mm.append((len(tokens), emb))
+            tokens.extend([0] * emb.shape[0])
         return tokens, mm
 
     def _submit(
@@ -438,7 +582,8 @@ class ModelBackend:
         context_overflow: str = "error",
         grammar_obj=None,  # pre-compiled Grammar from ensure_grammar()
         images: list | None = None,
-        prefused: tuple | None = None,  # (tokens, mm_embeds) from ensure_images()
+        audios: list | None = None,
+        prefused: tuple | None = None,  # (tokens, mm_embeds) from ensure_media()
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -448,15 +593,15 @@ class ModelBackend:
         analogue of the reference's token-aware oldest-first trimming,
         agent_ai.py:262-325)."""
         mm_embeds = None
-        if images:
+        if images or audios:
             if tokens is not None:
-                raise ValueError("images require a text 'prompt', not 'tokens'")
+                raise ValueError("media inputs require a text 'prompt', not 'tokens'")
             if prompt is None:
-                raise ValueError("images require a text 'prompt'")
-            # async callers pre-fuse off-loop via ensure_images(); the
+                raise ValueError("media inputs require a text 'prompt'")
+            # async callers pre-fuse off-loop via ensure_media(); the
             # synchronous fallback keeps direct/test callers working
-            tokens, mm_embeds = prefused if prefused is not None else self._fuse_images(
-                prompt, images
+            tokens, mm_embeds = prefused if prefused is not None else self._fuse_media(
+                prompt, images, audios
             )
         elif tokens is None:
             if prompt is None:
@@ -468,14 +613,14 @@ class ModelBackend:
             raise ValueError(f"unknown context_overflow policy {context_overflow!r}")
         truncated = 0
         if mm_embeds and context_overflow == "truncate_left":
-            # Left-truncation would sever image spans / shift their offsets;
+            # Left-truncation would sever media spans / shift their offsets;
             # an over-budget multimodal prompt is a hard error instead.
             budget = self.engine.ecfg.max_context - max_new_tokens
             if len(tokens) > budget:
                 raise RequestTooLongError(
-                    f"multimodal prompt ({len(tokens)} tokens incl. image "
-                    f"patches) exceeds the {budget}-token budget and cannot "
-                    "be truncated"
+                    f"multimodal prompt ({len(tokens)} tokens incl. media "
+                    f"embeddings) exceeds the {budget}-token budget and "
+                    "cannot be truncated"
                 )
         elif context_overflow == "truncate_left":
             budget = self.engine.ecfg.max_context - max_new_tokens
@@ -542,13 +687,51 @@ class ModelBackend:
         response_schema: dict[str, Any] | None = None,
         context_overflow: str = "error",
         images: list | None = None,
+        audios: list | None = None,
+        output: str = "text",
     ) -> dict[str, Any]:
+        if output not in ("text", "audio", "speech"):
+            raise ValueError(
+                f"unknown output modality {output!r}: 'text' | 'audio' "
+                "(synthesize the prompt) | 'speech' (generate, then "
+                "synthesize the generated text)"
+            )
+        if output != "text" and self.tts_cfg is None:
+            # Fail in milliseconds, not after a full LM decode.
+            raise ValueError(
+                "this model node has no TTS head (audio output unsupported); "
+                "start it with tts=<config> to serve output='audio'/'speech'"
+            )
+        if output == "audio":
+            # Pure TTS (reference: agent_ai.py:750 hands text to a speech
+            # API): no LM decode, the prompt itself is spoken.
+            if images or audios:
+                raise ValueError(
+                    "output='audio' speaks the prompt verbatim — media "
+                    "inputs would be silently dropped; use output='speech' "
+                    "to understand media and speak the response"
+                )
+            if not prompt:
+                raise ValueError("output='audio' requires a text prompt")
+            wav_b64, tts_trunc = await asyncio.to_thread(
+                self._synthesize_wav_b64, prompt
+            )
+            out = {
+                "text": prompt,
+                "parts": [{"type": "audio", "mime": "audio/wav", "data_b64": wav_b64}],
+                "model": self.model_name,
+                "finish_reason": "tts",
+                "tokens": [],
+            }
+            if tts_trunc:
+                out["tts_truncated_chars"] = tts_trunc
+            return out
         grammar_obj = None
         if response_schema is not None:
             grammar_obj = await self.ensure_grammar(response_schema)
         prefused = None
-        if images and prompt is not None and tokens is None:
-            prefused = await self.ensure_images(prompt, images)
+        if (images or audios) and prompt is not None and tokens is None:
+            prefused = await self.ensure_media(prompt, images, audios)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         rid, truncated = self._submit(
             prompt,
@@ -565,6 +748,7 @@ class ModelBackend:
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
             images=images,
+            audios=audios,
             prefused=prefused,
         )
         try:
@@ -582,6 +766,24 @@ class ModelBackend:
         result["model"] = self.model_name
         if truncated:
             result["truncated_prompt_tokens"] = truncated
+        if output == "speech":
+            # Speak the GENERATED text (reference chat-audio shape,
+            # agent_ai.py:864: text response + audio of that response).
+            if self.tokenizer is None:
+                raise ValueError(
+                    "output='speech' needs a tokenizer on this node (the "
+                    "generated text is what gets synthesized)"
+                )
+            # An empty generation (immediate EOS) speaks as near-silence —
+            # the synth pads to one frame span; not an error.
+            wav_b64, tts_trunc = await asyncio.to_thread(
+                self._synthesize_wav_b64, result.get("text", "")
+            )
+            result["parts"] = [
+                {"type": "audio", "mime": "audio/wav", "data_b64": wav_b64}
+            ]
+            if tts_trunc:
+                result["tts_truncated_chars"] = tts_trunc
         return result
 
     def submit_stream(
@@ -598,6 +800,7 @@ class ModelBackend:
         context_overflow: str = "error",
         grammar_obj=None,
         images: list | None = None,
+        audios: list | None = None,
         prefused: tuple | None = None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
@@ -618,6 +821,7 @@ class ModelBackend:
             context_overflow=context_overflow,
             grammar_obj=grammar_obj,
             images=images,
+            audios=audios,
             prefused=prefused,
         )
         return rid, q
@@ -641,6 +845,8 @@ def build_model_node(
     vision=None,  # vision tower config name/VisionConfig/(cfg, params) —
     # enables image inputs on this node (ModelBackend vision contract)
     grammar_whitespace: bool = False,
+    audio=None,  # audio input tower (ModelBackend audio contract)
+    tts=None,  # audio output head (ModelBackend tts contract)
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -676,6 +882,7 @@ def build_model_node(
     backend = ModelBackend(
         params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model,
         mesh=mesh, vision=vision, grammar_whitespace=grammar_whitespace,
+        audio=audio, tts=tts,
     )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
@@ -714,18 +921,25 @@ def build_model_node(
                 for k in (
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
-                    "response_schema", "context_overflow", "images",
+                    "response_schema", "context_overflow", "images", "audios",
                 )
                 if body.get(k) is not None
             }
+            if body.get("output") not in (None, "text"):
+                raise ValueError(
+                    "the token stream is text-only; use the unary generate "
+                    "path for output='audio'/'speech'"
+                )
             if gen_kwargs.get("response_schema") is not None:
                 gen_kwargs["grammar_obj"] = await backend.ensure_grammar(
                     gen_kwargs["response_schema"]
                 )
-            if gen_kwargs.get("images") and gen_kwargs.get("prompt") is not None \
+            if (gen_kwargs.get("images") or gen_kwargs.get("audios")) \
+                    and gen_kwargs.get("prompt") is not None \
                     and gen_kwargs.get("tokens") is None:
-                gen_kwargs["prefused"] = await backend.ensure_images(
-                    gen_kwargs["prompt"], gen_kwargs["images"]
+                gen_kwargs["prefused"] = await backend.ensure_media(
+                    gen_kwargs["prompt"], gen_kwargs.get("images"),
+                    gen_kwargs.get("audios"),
                 )
             rid, q = backend.submit_stream(**gen_kwargs)
         except (QueueFullError,) as e:

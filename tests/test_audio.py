@@ -1,0 +1,340 @@
+"""Audio serving: log-mel encoder tower (input), TTS head (output), model-node
+fusion, SDK wiring.
+
+Reference analogue: agent_ai.py:750-1002 (TTS + chat-audio via speech APIs)
+and the audio halves of `_process_multimodal_args`:449. Here both directions
+are SERVED in-tree (models/audio.py): clips fuse into the prompt via the
+``<audio>`` marker like images, and output='audio'/'speech' returns WAV parts
+synthesized by the TTS head."""
+
+import asyncio
+import base64
+
+import jax
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.audio import (
+    AudioConfig,
+    audio_encode_jit,
+    float_to_wav,
+    get_audio_config,
+    get_tts_config,
+    init_audio_params,
+    init_tts_params,
+    log_mel,
+    tts_synthesize_jit,
+    wav_to_float,
+)
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import ByteTokenizer, ModelBackend
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=128, max_pages_per_seq=16)
+ACFG = get_audio_config("audio-tiny")
+TCFG = get_tts_config("tts-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def aparams():
+    return init_audio_params(ACFG, jax.random.PRNGKey(1))
+
+
+def _tone(freq=440.0, seconds=None, rate=None):
+    rate = rate or ACFG.sample_rate
+    n = int((seconds or ACFG.max_seconds) * rate)
+    return np.sin(2 * np.pi * freq * np.arange(n) / rate).astype(np.float32)
+
+
+# -- front end ---------------------------------------------------------------
+
+
+def test_log_mel_shapes_and_tone_peak(aparams):
+    wave = _tone()[None, : ACFG.max_samples]
+    mel = np.asarray(log_mel(ACFG, wave))
+    assert mel.shape == (1, ACFG.n_frames, ACFG.n_mels)
+    assert np.isfinite(mel).all()
+    # a pure tone's energy concentrates: the hottest mel bin beats the median
+    frame = mel[0, ACFG.n_frames // 2]
+    assert frame.max() > np.median(frame) + 1.0
+
+
+def test_audio_encoder_shapes(aparams):
+    waves = np.stack([_tone(440.0), _tone(880.0)])[:, : ACFG.max_samples]
+    out = audio_encode_jit(aparams, ACFG, waves)
+    assert out.shape == (2, ACFG.n_tokens, CFG.hidden_size)
+    a = np.asarray(out, np.float32)
+    assert np.isfinite(a).all()
+    # different clips → different embeddings (the tower hears the input)
+    assert np.abs(a[0] - a[1]).max() > 1e-3
+
+
+# -- WAV codec ---------------------------------------------------------------
+
+
+def test_wav_round_trip_and_resample():
+    w = _tone(seconds=0.5)
+    data = float_to_wav(w, ACFG.sample_rate)
+    assert data[:4] == b"RIFF" and data[8:12] == b"WAVE"
+    back = wav_to_float(data, ACFG.sample_rate, ACFG.max_samples)
+    n = len(w)
+    assert np.abs(back[:n] - w).max() < 1e-3
+    assert (back[n:] == 0).all()  # zero-padded to the static budget
+    # 8 kHz stereo input resamples + mono-mixes without error
+    import io
+    import wave as W
+
+    buf = io.BytesIO()
+    with W.open(buf, "wb") as f:
+        f.setnchannels(2)
+        f.setsampwidth(2)
+        f.setframerate(8000)
+        st = (np.stack([w[:4000], w[:4000]], 1) * 32767).astype("<i2")
+        f.writeframes(st.tobytes())
+    r = wav_to_float(buf.getvalue(), ACFG.sample_rate, ACFG.max_samples)
+    assert r.shape == (ACFG.max_samples,)
+
+
+def test_wav_decode_rejects_garbage():
+    with pytest.raises(ValueError, match="PCM WAV"):
+        wav_to_float(b"not audio at all", 16000, 100)
+
+
+# -- TTS head ----------------------------------------------------------------
+
+
+def test_tts_synthesize_shapes_and_determinism():
+    tp = init_tts_params(TCFG, jax.random.PRNGKey(2))
+    ids = np.zeros((2, TCFG.max_chars), np.int32)
+    for b, text in enumerate([b"hello", b"world!"]):
+        ids[b, : len(text)] = np.frombuffer(text, np.uint8)
+    w1 = np.asarray(tts_synthesize_jit(tp, TCFG, ids))
+    w2 = np.asarray(tts_synthesize_jit(tp, TCFG, ids))
+    assert w1.shape == (2, TCFG.max_samples)
+    assert np.array_equal(w1, w2)
+    assert (np.abs(w1) < 1.0).all()  # tanh-bounded
+    assert np.abs(w1[0] - w1[1]).max() > 1e-4  # text-dependent
+
+
+# -- model node --------------------------------------------------------------
+
+
+def _wav_b64(freq=440.0):
+    return base64.b64encode(
+        float_to_wav(_tone(freq, seconds=0.5), ACFG.sample_rate)
+    ).decode()
+
+
+def test_model_node_serves_audio_prompt(params):
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            audio="audio-tiny",
+        )
+        await backend.start()
+        try:
+            r1 = await backend.generate(
+                prompt="transcribe: <audio>", audios=[{"b64": _wav_b64()}],
+                max_new_tokens=4,
+            )
+            assert len(r1["tokens"]) == 4 and "text" in r1
+            # raw float sample arrays work too (pre-decoded callers)
+            r2 = await backend.generate(
+                prompt="transcribe: <audio>",
+                audios=[_tone(880.0, seconds=0.25).tolist()],
+                max_new_tokens=4,
+            )
+            assert len(r2["tokens"]) == 4
+            # marker/count mismatch
+            with pytest.raises(ValueError, match="markers"):
+                await backend.generate(
+                    prompt="no marker", audios=[{"b64": _wav_b64()}] * 2
+                )
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_model_node_mixes_image_and_audio(params):
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            vision="vit-tiny", audio="audio-tiny",
+        )
+        await backend.start()
+        try:
+            img = np.full((8, 8, 3), 0.25, np.float32)
+            r = await backend.generate(
+                prompt="see <image> hear <audio> go",
+                images=[img], audios=[{"b64": _wav_b64()}],
+                max_new_tokens=3,
+            )
+            assert len(r["tokens"]) == 3
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_model_node_without_audio_tower_rejects(params):
+    async def main():
+        backend = ModelBackend(params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size))
+        await backend.start()
+        try:
+            with pytest.raises(ValueError, match="audio tower"):
+                await backend.generate(prompt="<audio>", audios=[{"b64": _wav_b64()}])
+            with pytest.raises(ValueError, match="TTS head"):
+                await backend.generate(prompt="say this", output="audio")
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_audio_dim_mismatch_rejected(params):
+    with pytest.raises(ValueError, match="out_dim"):
+        ModelBackend(params, get_config("llama-smoke"), ECFG, audio="audio-tiny")
+
+
+def test_model_node_tts_output(params):
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            tts="tts-tiny",
+        )
+        await backend.start()
+        try:
+            # output='audio': the prompt itself is spoken, no LM decode
+            r = await backend.generate(prompt="hello tpu", output="audio")
+            assert r["finish_reason"] == "tts"
+            [part] = r["parts"]
+            wav = base64.b64decode(part["data_b64"])
+            assert wav[:4] == b"RIFF" and wav[8:12] == b"WAVE"
+            # duration scales with the text (trimmed to the speakable span)
+            n_expected = len(b"hello tpu") * TCFG.frames_per_char * TCFG.samples_per_frame
+            decoded = wav_to_float(wav, TCFG.sample_rate, TCFG.max_samples)
+            assert (decoded[:n_expected] != 0).any()
+            assert (decoded[n_expected:] == 0).all()
+            # output='speech': generate text, then speak the GENERATED text
+            r2 = await backend.generate(prompt="abc", max_new_tokens=4, output="speech")
+            assert len(r2["tokens"]) == 4
+            [part2] = r2["parts"]
+            assert base64.b64decode(part2["data_b64"])[:4] == b"RIFF"
+            # unknown modality
+            with pytest.raises(ValueError, match="output modality"):
+                await backend.generate(prompt="x", output="video")
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+# -- SDK surface -------------------------------------------------------------
+
+
+def test_sdk_normalize_and_split():
+    from agentfield_tpu.sdk.agent import _normalize_audio
+    from agentfield_tpu.sdk.multimodal import (
+        AudioContent,
+        split_prompt_and_media,
+    )
+
+    wav = float_to_wav(_tone(seconds=0.1), ACFG.sample_rate)
+    out = _normalize_audio([AudioContent(wav), wav, {"b64": "QUJD"}, [0.0, 0.5]])
+    assert [sorted(o) if isinstance(o, dict) else "arr" for o in out] == [
+        ["b64"], ["b64"], ["b64"], "arr",
+    ]
+    prompt, images, audios = split_prompt_and_media(["listen", AudioContent(wav)])
+    assert prompt == "listen\n<audio>" and not images and len(audios) == 1
+
+
+def test_ai_audio_end_to_end(params):
+    """Full stack: control plane + audio/TTS model node + caller agent —
+    ai(audio=[...]) fuses the clip; ai(output='speech') returns WAV parts
+    wrapped as a MultimodalResponse."""
+    from tests.helpers_cp import CPHarness, async_test
+
+    from agentfield_tpu.sdk.agent import Agent
+    from agentfield_tpu.sdk.multimodal import MultimodalResponse
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    @async_test
+    async def run():
+        async with CPHarness() as h:
+            model_agent, backend = build_model_node(
+                "model", h.base_url, model="llama-tiny", params=params,
+                ecfg=ECFG, audio="audio-tiny", tts="tts-tiny",
+            )
+            await backend.start()
+            await model_agent.start()
+            app = Agent("caller", h.base_url)
+            await app.start()
+            try:
+                wav = float_to_wav(_tone(seconds=0.3), ACFG.sample_rate)
+                r = await app.ai(
+                    prompt="what do you hear? <audio>", audio=[wav],
+                    max_new_tokens=4, timeout=50,
+                )
+                assert len(r["tokens"]) == 4
+                r2 = await app.ai(prompt="hi", max_new_tokens=4, output="speech", timeout=50)
+                assert isinstance(r2, MultimodalResponse)
+                assert r2.parts and r2.parts[0].data[:4] == b"RIFF"
+                r3 = await app.ai_with_audio(
+                    "speak just this", max_new_tokens=4, timeout=50
+                )
+                assert isinstance(r3, MultimodalResponse)
+            finally:
+                await app.stop()
+                await model_agent.stop()
+                await backend.stop()
+
+    run()
+
+
+def test_tts_truncation_reported_and_media_rejected(params):
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            audio="audio-tiny", tts="tts-tiny",
+        )
+        await backend.start()
+        try:
+            # text beyond the head's 32-char budget → truncation is reported
+            long_text = "x" * 100
+            r = await backend.generate(prompt=long_text, output="audio")
+            assert r["tts_truncated_chars"] == 100 - TCFG.max_chars
+            # media + output='audio' would silently drop the clip → hard error
+            with pytest.raises(ValueError, match="speech"):
+                await backend.generate(
+                    prompt="<audio>", audios=[{"b64": _wav_b64()}], output="audio"
+                )
+            # utf-8 never splits mid-codepoint at the budget edge
+            multi = "é" * TCFG.max_chars  # 2 bytes each; budget cuts mid-char
+            r2 = await backend.generate(prompt=multi, output="audio")
+            assert r2["tts_truncated_chars"] % 2 == 0
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_speech_without_tts_fails_before_decode(params):
+    async def main():
+        backend = ModelBackend(params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size))
+        await backend.start()
+        try:
+            before = backend.engine.stats["decode_steps"]
+            with pytest.raises(ValueError, match="TTS head"):
+                await backend.generate(prompt="x", max_new_tokens=64, output="speech")
+            assert backend.engine.stats["decode_steps"] == before  # no LM run
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())

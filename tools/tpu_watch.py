@@ -1,0 +1,173 @@
+"""Background TPU-tunnel watcher: retry a tiny probe until the chip is
+reachable, then immediately run the full on-chip validation (bench + pallas
+kernels non-interpret) and save the results.
+
+The axon tunnel is single-slot and can be wedged for 30+ minutes (or report
+UNAVAILABLE while down); a round that only tries at bench time loses its one
+shot. This watcher turns "try once, lose the round" into "try all round".
+
+Claim discipline (memory: never kill a claim-holding process):
+- the probe runs in a subprocess; while it has NOT yet claimed the backend it
+  is safe to terminate (nothing in flight on the chip);
+- once CLAIMED it is never signalled — we wait it out.
+
+Usage:  python tools/tpu_watch.py [--interval 600] [--out /tmp/tpu_results]
+Writes: <out>/probe_log.txt   — per-attempt outcomes
+        <out>/bench.json      — bench.py output once the chip is reachable
+        <out>/kernels.json    — pallas-vs-ref numerics from the bench payload
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = """
+import sys, time
+phase_path = sys.argv[1]
+def phase(p):
+    with open(phase_path, 'a') as f:
+        f.write(p + '\\n'); f.flush()
+t0 = time.time()
+import jax
+devs = jax.devices()
+phase('CLAIMED %s %.1fs' % (devs[0].platform, time.time() - t0))
+import jax.numpy as jnp
+import numpy as np
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float(np.asarray((x @ x)[0, 0]))  # real readback through the tunnel
+phase('PROBE-OK %s %.1fs' % (jax.default_backend(), time.time() - t0))
+"""
+
+
+def probe_once(claim_budget: float = 420.0, run_budget: float = 900.0) -> str:
+    """One probe attempt. Returns 'ok' or a failure description."""
+    with tempfile.NamedTemporaryFile("r", suffix=".phase", delete=False) as pf:
+        phase_path = pf.name
+    p = subprocess.Popen(
+        [sys.executable, "-c", _PROBE, phase_path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    t0 = time.monotonic()
+    claimed = None
+    try:
+        while True:
+            rc = p.poll()
+            phases = open(phase_path).read()
+            if claimed is None and "CLAIMED" in phases:
+                claimed = time.monotonic()
+            if rc is not None:
+                if "PROBE-OK" in phases:
+                    return "ok"
+                err = (p.stderr.read() or "").strip()[-300:]
+                return f"rc={rc}: {err or phases.strip() or 'no output'}"
+            el = time.monotonic() - t0
+            if claimed is None and el > claim_budget:
+                p.terminate()  # unclaimed: nothing on the chip, safe to stop
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                return f"claim not granted in {claim_budget:.0f}s"
+            if claimed is not None and el > run_budget:
+                # claimed but slow: NEVER kill; abandon (it exits on its own)
+                return "claimed but matmul overran; child left unkilled"
+            time.sleep(2)
+    finally:
+        try:
+            os.unlink(phase_path)
+        except OSError:
+            pass
+
+
+def run_validation(out_dir: str) -> None:
+    """Chip reachable: run the full bench (probe skipped — we just proved the
+    claim works) with a generous in-process watchdog. The bench emits its one
+    JSON line even on failure; the pallas numerics ride in the payload."""
+    env = dict(os.environ)
+    env.update(
+        AGENTFIELD_BENCH_SKIP_PROBE="1",
+        AGENTFIELD_BENCH_WATCHDOG="3000",
+        AGENTFIELD_BENCH_ATTN="pallas",
+    )
+    # NEVER kill this child: it holds the TPU claim. Its own in-process
+    # watchdog emits the JSON line and exits at 3000s; we wait patiently and
+    # if it somehow outlives even that, we abandon it UNKILLED (it releases
+    # the claim when it exits) and record the overrun.
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    t0 = time.monotonic()
+    while p.poll() is None and time.monotonic() - t0 < 3900:
+        time.sleep(5)
+    if p.poll() is None:
+        payload = {"error": "bench outlived its own watchdog; left unkilled"}
+        out_stdout = out_stderr = ""
+    else:
+        out_stdout, out_stderr = p.communicate()
+    lines = [l for l in (out_stdout or "").strip().splitlines() if l.startswith("{")]
+    if lines:
+        payload = json.loads(lines[-1])
+    elif p.poll() is not None:
+        payload = {"error": "no JSON line", "stderr": (out_stderr or "")[-1000:]}
+    with open(os.path.join(out_dir, "bench.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    kernels = {
+        k: payload.get(k)
+        for k in (
+            "attn_impl", "attn_demoted", "pallas_prefill_rel_err",
+            "pallas_decode_abs_err", "paged_decode_ref_ms", "paged_decode_pallas_ms",
+            "device",
+        )
+    }
+    with open(os.path.join(out_dir, "kernels.json"), "w") as f:
+        json.dump(kernels, f, indent=1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600.0)
+    ap.add_argument("--out", default="/tmp/tpu_results")
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    log_path = os.path.join(args.out, "probe_log.txt")
+    t_end = time.monotonic() + args.max_hours * 3600
+    attempt = 0
+    while time.monotonic() < t_end:
+        attempt += 1
+        res = probe_once()
+        with open(log_path, "a") as f:
+            f.write(f"{time.strftime('%H:%M:%S')} attempt {attempt}: {res}\n")
+        if res == "ok":
+            try:
+                run_validation(args.out)
+                note = "validation complete -> bench.json"
+            except Exception as e:  # keep watching; a crashed validation
+                # run must not kill the watcher after its one good probe
+                note = f"validation crashed: {e!r}"
+            with open(log_path, "a") as f:
+                f.write(f"{time.strftime('%H:%M:%S')} {note}\n")
+            if note.startswith("validation complete"):
+                return 0
+            time.sleep(args.interval)
+            continue
+        if "left unkilled" in res:
+            time.sleep(1200)  # a live orphan holds the claim; back way off
+        else:
+            time.sleep(args.interval)
+    with open(log_path, "a") as f:
+        f.write("gave up: max-hours reached without a successful probe\n")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

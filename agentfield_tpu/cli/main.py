@@ -97,6 +97,7 @@ def cmd_server(cfg: Config, args) -> int:
         port = args.port or cfg.server.port
         cp = ControlPlane(
             db_path=db,
+            data_dir=str(data_dir(cfg)),
             keystore_path=str(data_dir(cfg) / "keystore.bin"),
             keystore_passphrase=cfg.server.keystore_passphrase,
             payload_dir=str(data_dir(cfg) / "payloads"),

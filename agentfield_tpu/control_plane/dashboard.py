@@ -6,16 +6,21 @@ workflows (DAG viz), reasoners, DID explorer and credentials. This is the
 TPU build's equivalent page inventory as ONE hash-routed HTML document
 driven entirely by the existing REST/SSE surface:
 
-  #/          dashboard   /api/ui/v1/summary + /api/v1/nodes
-  #/nodes     nodes       /api/v1/nodes (+ per-node detail w/ engine stats)
-  #/execs     executions  /api/v1/executions (+ detail, live SSE tail)
+  #/          dashboard   /api/ui/v1/summary + /api/ui/v1/nodes
+  #/nodes     nodes       /api/ui/v1/nodes (+ per-node detail w/ SQL metrics)
+  #/execs     executions  /api/ui/v1/executions (server-side pagination/
+                          filters/groups; detail live-updates over SSE)
   #/runs      workflows   /api/v1/runs → /api/v1/workflows/{run}/dag (SVG DAG)
   #/reasoners reasoners   /api/v1/reasoners (+ per-target metrics)
+  #/pkgs      packages    /api/v1/packages (`af install` registry)
+  #/creds     credentials /api/ui/v1/credentials (persisted issued VCs)
   #/did       DID / VC    /api/v1/did/* + /api/v1/vc/verify (paste-to-verify)
   #/memory    memory      /api/v1/memory?scope=... browser
 
-(The reference's packages page manages `af install`ed bundles; package state
-here is CLI-local — see cli/packages.py — so there is no server API to render.)
+List pages render server-side aggregations (control_plane/ui_service.py) —
+the browser never fetches raw tables to re-aggregate client-side, matching
+the reference's UIService/ExecutionsUIService split (ui_service.go:78,
+executions_ui_service.go:112).
 """
 
 DASHBOARD_HTML = """<!doctype html>
@@ -70,9 +75,10 @@ const fmtT = (t) => t ? new Date(t * 1000).toLocaleTimeString() : '';
 const stat = (s) => `<span class="${esc(s)}">${esc(s)}</span>`;
 
 const PAGES = [['','dashboard'],['nodes','nodes'],['execs','executions'],
-  ['runs','workflows'],['reasoners','reasoners'],['mcp','mcp'],['did','did / vc'],['memory','memory']];
+  ['runs','workflows'],['reasoners','reasoners'],['pkgs','packages'],
+  ['creds','credentials'],['mcp','mcp'],['did','did / vc'],['memory','memory']];
 function nav() {
-  const cur = location.hash.replace(/^#\\/?/, '').split('/')[0];
+  const cur = location.hash.replace(/^#\\/?/, '').split('?')[0].split('/')[0];
   $('nav').innerHTML = PAGES.map(([p, label]) =>
     `<a href="#/${p}" class="${cur === p ? 'on' : ''}">${label}</a>`).join('');
 }
@@ -109,27 +115,39 @@ async function pgDash() {
 // ---- nodes ------------------------------------------------------------
 async function pgNodes(id) {
   if (id) {
-    const n = (await J('/api/v1/nodes/' + id)).node;
+    const n = await J('/api/ui/v1/nodes/' + id);
     const hb = n.metadata && n.metadata.stats ? n.metadata.stats : null;
+    const tm = n.target_metrics || {};
     $('page').innerHTML = `
       <div class="row"><b>${esc(n.node_id)}</b> ${stat(n.status)}
         <span class="dim">${esc(n.kind)} @ ${esc(n.base_url)}</span>
-        <span class="dim">heartbeat ${fmtT(n.last_heartbeat)}</span></div>
+        <span class="dim">heartbeat ${n.last_heartbeat_age_s}s ago</span></div>
       <div class="row dim">did: ${esc(n.did || '—')}</div>
       ${hb ? `<h3 style="font-size:0.9rem">engine stats</h3><pre>${esc(JSON.stringify(hb, null, 1))}</pre>` : ''}
       <h3 style="font-size:0.9rem">components</h3>
-      <table><tr><th>id</th><th>kind</th><th>description</th><th>did</th></tr>
-      ${[...(n.reasoners || []), ...(n.skills || [])].map(c =>
-        `<tr><td>${esc(c.id)}</td><td>${esc(c.kind)}</td><td class="dim">${esc(c.description)}</td>
-         <td class="dim">${esc((c.did || '').slice(0, 24))}…</td></tr>`).join('')}</table>`;
+      <table><tr><th>id</th><th>kind</th><th>description</th><th>calls</th>
+        <th>success</th><th>p50 / p95 ms</th></tr>
+      ${[...(n.reasoners || []), ...(n.skills || [])].map(c => {
+        const m = tm[n.node_id + '.' + c.id], d = m && m.duration_s;
+        return `<tr><td>${esc(c.id)}</td><td>${esc(c.kind)}</td>
+         <td class="dim">${esc(c.description)}</td>
+         <td>${m ? m.executions : '—'}</td>
+         <td>${m && m.success_rate != null ? (m.success_rate * 100).toFixed(0) + '%' : '—'}</td>
+         <td>${d && d.p50 != null ? (d.p50 * 1000).toFixed(0) + ' / ' + (d.p95 * 1000).toFixed(0) : '—'}</td></tr>`;
+      }).join('')}</table>`;
   } else {
-    const n = await J('/api/v1/nodes');
-    $('page').innerHTML = `<table><tr><th>node</th><th>kind</th><th>status</th>
-      <th>reasoners</th><th>skills</th><th>last heartbeat</th></tr>
+    const n = await J('/api/ui/v1/nodes');
+    $('page').innerHTML = `<div class="row"><span class="dim">
+      ${n.active}/${n.total} active</span></div>
+      <table><tr><th>node</th><th>kind</th><th>status</th>
+      <th>reasoners</th><th>skills</th><th>heartbeat age</th><th>engine</th></tr>
       ${n.nodes.map(x => `<tr class="click" data-go="#/nodes/${esc(x.node_id)}">
         <td>${esc(x.node_id)}</td><td>${esc(x.kind)}</td><td>${stat(x.status)}</td>
-        <td>${(x.reasoners || []).length}</td><td>${(x.skills || []).length}</td>
-        <td class="dim">${fmtT(x.last_heartbeat)}</td></tr>`).join('')}</table>`;
+        <td>${x.reasoners}</td><td>${x.skills}</td>
+        <td class="dim">${x.last_heartbeat_age_s}s</td>
+        <td class="dim">${x.engine ? esc(
+          (x.engine.decode_tokens ?? 0) + ' tok, ' +
+          (x.engine.active_slots ?? 0) + ' slots') : ''}</td></tr>`).join('')}</table>`;
   }
   done();
 }
@@ -137,25 +155,59 @@ async function pgNodes(id) {
 // ---- executions -------------------------------------------------------
 async function pgExecs(id) {
   if (id) {
-    const e = await J('/api/v1/executions/' + id);
-    $('page').innerHTML = `
-      <div class="row"><b>${esc(e.execution_id)}</b> ${stat(e.status)}
-        <span class="dim">${esc(e.target)}</span>
-        <a href="#/runs/${esc(e.run_id)}">run ${esc(e.run_id)}</a></div>
-      <h3 style="font-size:0.9rem">input</h3><pre>${esc(JSON.stringify(e.input, null, 1))}</pre>
-      <h3 style="font-size:0.9rem">result</h3><pre>${esc(JSON.stringify(e.result, null, 1))}</pre>
-      ${e.error ? `<h3 style="font-size:0.9rem" class="error">error</h3><pre>${esc(e.error)}</pre>` : ''}
-      ${(e.notes || []).length ? `<h3 style="font-size:0.9rem">notes</h3><pre>${esc(
-        e.notes.map(n => JSON.stringify(n)).join('\\n'))}</pre>` : ''}`;
-    done(); return;
+    const render = async () => {
+      const e = await J('/api/v1/executions/' + id);
+      $('page').innerHTML = `
+        <div class="row"><b>${esc(e.execution_id)}</b> ${stat(e.status)}
+          <span class="dim">${esc(e.target)}</span>
+          <a href="#/runs/${esc(e.run_id)}">run ${esc(e.run_id)}</a></div>
+        <h3 style="font-size:0.9rem">input</h3><pre>${esc(JSON.stringify(e.input, null, 1))}</pre>
+        <h3 style="font-size:0.9rem">result</h3><pre>${esc(JSON.stringify(e.result, null, 1))}</pre>
+        ${e.error ? `<h3 style="font-size:0.9rem" class="error">error</h3><pre>${esc(e.error)}</pre>` : ''}
+        ${(e.notes || []).length ? `<h3 style="font-size:0.9rem">notes</h3><pre>${esc(
+          e.notes.map(n => JSON.stringify(n)).join('\\n'))}</pre>` : ''}`;
+      done();
+    };
+    await render();
+    // live detail: re-render when THIS execution's events arrive
+    sse = new EventSource('/api/v1/events/executions');
+    sse.onmessage = (ev) => {
+      try { const d = JSON.parse(ev.data);
+        if (d.execution_id && d.execution_id !== id) return; } catch (_) {}
+      $('live').textContent = '· live'; render();
+    };
+    return;
   }
+  const q = new URLSearchParams(location.hash.split('?')[1] || '');
+  const page = +(q.get('page') || 1), st = q.get('status') || '', grp = q.get('group_by') || '';
   const render = async () => {
-    const d = await J('/api/v1/executions?limit=50');
-    $('page').innerHTML = `<table><tr><th>execution</th><th>target</th><th>status</th>
-      <th>run</th><th>created</th></tr>
+    const d = await J('/api/ui/v1/executions?page=' + page + '&page_size=25'
+      + (st ? '&status=' + st : '') + (grp ? '&group_by=' + grp : ''));
+    const base = '#/execs?' + (st ? 'status=' + st + '&' : '') + (grp ? 'group_by=' + grp + '&' : '');
+    $('page').innerHTML = `
+      <div class="row">status: ${['', 'running', 'completed', 'failed', 'queued'].map(s =>
+        `<a href="#/execs?${grp ? 'group_by=' + grp + '&' : ''}${s ? 'status=' + s : ''}"
+          class="${s === st ? 'on' : 'dim'}">${s || 'all'}</a>`).join(' ')}
+        group: ${['', 'target', 'status', 'run_id'].map(g =>
+        `<a href="#/execs?${st ? 'status=' + st + '&' : ''}${g ? 'group_by=' + g : ''}"
+          class="${g === grp ? 'on' : 'dim'}">${g || 'none'}</a>`).join(' ')}
+        <span class="dim">${d.total} total</span></div>
+      ${d.groups ? `<table><tr><th>${esc(grp)}</th><th>executions</th><th>completed</th>
+        <th>failed</th><th>latest</th></tr>${d.groups.map(g =>
+        `<tr><td>${esc(g.group)}</td><td>${g.executions}</td><td class="ok">${g.completed}</td>
+         <td class="${g.failed ? 'error' : 'dim'}">${g.failed}</td>
+         <td class="dim">${fmtT(g.latest)}</td></tr>`).join('')}</table><hr style="border-color:var(--line)">` : ''}
+      <table><tr><th>execution</th><th>target</th><th>status</th>
+      <th>run</th><th>duration</th><th>created</th></tr>
       ${d.executions.map(e => `<tr class="click" data-go="#/execs/${esc(e.execution_id)}">
         <td>${esc(e.execution_id)}</td><td>${esc(e.target)}</td><td>${stat(e.status)}</td>
-        <td class="dim">${esc(e.run_id)}</td><td class="dim">${fmtT(e.created_at)}</td></tr>`).join('')}</table>`;
+        <td class="dim">${esc(e.run_id)}</td>
+        <td class="dim">${e.duration_s != null ? e.duration_s.toFixed(2) + 's' : ''}</td>
+        <td class="dim">${fmtT(e.created_at)}</td></tr>`).join('')}</table>
+      <div class="row">
+        ${d.has_prev ? `<a href="${base}page=${page - 1}">‹ prev</a>` : ''}
+        <span class="dim">page ${d.page} / ${d.total_pages}</span>
+        ${d.has_next ? `<a href="${base}page=${page + 1}">next ›</a>` : ''}</div>`;
     done();
   };
   await render();
@@ -237,6 +289,53 @@ async function pgReasoners() {
   }));
   $('page').innerHTML = `<table><tr><th>reasoner</th><th>description</th><th>calls</th>
     <th>success</th><th>p50 / p95 ms</th></tr>${rows.join('')}</table>`;
+  done();
+}
+
+// ---- packages ---------------------------------------------------------
+async function pgPkgs() {
+  const d = await J('/api/v1/packages');
+  $('page').innerHTML = `
+    <table><tr><th>package</th><th>entry</th><th>origin</th><th>installed</th>
+      <th>description</th></tr>
+    ${(d.packages || []).map(p => `<tr>
+      <td>${esc(p.name)}</td><td class="dim">${esc(p.entry || '')}</td>
+      <td class="dim">${esc(p.origin ? (p.origin.url || p.origin.path || p.origin.type) : '')}</td>
+      <td class="dim">${fmtT(p.installed_at)}</td>
+      <td class="dim">${esc(p.description || '')}</td></tr>`).join('')}</table>
+    ${d.total ? '' : '<p class="dim">no packages installed (aftpu install &lt;source&gt;)</p>'}`;
+  done();
+}
+
+// ---- credentials ------------------------------------------------------
+async function pgCreds() {
+  const q = new URLSearchParams(location.hash.split('?')[1] || '');
+  const page = +(q.get('page') || 1), st = q.get('subject_type') || '';
+  const d = await J('/api/ui/v1/credentials?page=' + page + '&page_size=25'
+    + (st ? '&subject_type=' + st : ''));
+  const base = '#/creds?' + (st ? 'subject_type=' + st + '&' : '');
+  $('page').innerHTML = `
+    <div class="row">type: ${['', 'execution', 'workflow'].map(s =>
+      `<a href="#/creds?${s ? 'subject_type=' + s : ''}" class="${s === st ? 'on' : 'dim'}">${s || 'all'}</a>`).join(' ')}
+      <span class="dim">${d.total} issued</span></div>
+    <table><tr><th>credential</th><th>type</th><th>subject</th><th>issued</th><th></th></tr>
+    ${(d.credentials || []).map((c, i) => `<tr>
+      <td class="dim">${esc(String(c.vc_id).slice(0, 40))}</td>
+      <td>${esc(c.subject_type)}</td>
+      <td><a href="${c.subject_type === 'execution' ? '#/execs/' : '#/runs/'}${esc(c.subject_id)}">${esc(c.subject_id)}</a></td>
+      <td class="dim">${fmtT(c.issued_at)}</td>
+      <td><button data-show="${i}">view</button></td></tr>
+      <tr id="vc${i}" style="display:none"><td colspan="5"><pre>${esc(JSON.stringify(c.vc, null, 1))}</pre></td></tr>`).join('')}
+    </table>
+    ${d.total ? '' : '<p class="dim">no credentials issued yet (POST /api/v1/vc/executions/{id})</p>'}
+    <div class="row">
+      ${d.page > 1 ? `<a href="${base}page=${page - 1}">‹ prev</a>` : ''}
+      <span class="dim">page ${d.page} / ${d.total_pages}</span>
+      ${d.page < d.total_pages ? `<a href="${base}page=${page + 1}">next ›</a>` : ''}</div>`;
+  document.querySelectorAll('[data-show]').forEach(b => b.onclick = () => {
+    const row = $('vc' + b.getAttribute('data-show'));
+    row.style.display = row.style.display === 'none' ? '' : 'none';
+  });
   done();
 }
 
@@ -336,6 +435,8 @@ async function route() {
     else if (p === 'execs') await pgExecs(id);
     else if (p === 'runs') { await pgRuns(id); if (id) setRefresh(() => pgRuns(id), 4000); }
     else if (p === 'reasoners') { await pgReasoners(); setRefresh(pgReasoners, 6000); }
+    else if (p === 'pkgs') await pgPkgs();
+    else if (p === 'creds') await pgCreds();
     else if (p === 'mcp') { await pgMcp(); setRefresh(pgMcp, 5000); }
     else if (p === 'did') await pgDid();
     else if (p === 'memory') await pgMemory();

@@ -50,6 +50,7 @@ class ControlPlane:
         payload_dir: str | None = None,  # None → payloads stay inline
         admin_grpc_port: int | None = None,  # reference serves admin gRPC on port+100
         health_interval: float = 30.0,  # active probe cadence (health_monitor.go)
+        data_dir: str | None = None,  # package registry root (packages page)
     ):
         from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
         from agentfield_tpu.control_plane.storage_pg import create_storage
@@ -110,6 +111,10 @@ class ControlPlane:
 
         self.health_monitor = HealthMonitor(self.registry, interval=health_interval)
         self.mcp = MCPService(self.storage, db=self.db)
+        import os as _os2
+        from pathlib import Path as _Path
+
+        self.data_dir = _Path(_os2.path.expanduser(data_dir or "~/.agentfield_tpu"))
         self._notes_lock = asyncio.Lock()
         self.cleanup_interval = cleanup_interval
         self.stale_after = stale_after
@@ -544,7 +549,14 @@ def create_app(cp: ControlPlane) -> web.Application:
                 doc["result"] = await asyncio.to_thread(cp.payloads.resolve, doc["result"], True)
             except PayloadMissingError as e:
                 return _json_error(410, f"cannot attest: offloaded payload gone ({e})")
-        return web.json_response({"vc": cp.vc_service.issue_execution_vc(doc)})
+        vc = cp.vc_service.issue_execution_vc(doc)
+        # Persist for the credentials explorer (/api/ui/v1/credentials) —
+        # the reference keeps issued VCs behind its DID/VC services.
+        await cp.db.save_credential(
+            vc.get("id", f"vc:exec:{ex.execution_id}"), "execution",
+            ex.execution_id, vc,
+        )
+        return web.json_response({"vc": vc})
 
     @routes.post("/api/v1/vc/verify")
     async def verify_vc(req: web.Request):
@@ -588,7 +600,23 @@ def create_app(cp: ControlPlane) -> web.Application:
                 await asyncio.to_thread(_resolve_all)
             except PayloadMissingError as e:
                 return _json_error(410, f"cannot attest: offloaded payload gone ({e})")
-        return web.json_response(cp.vc_service.workflow_chain(docs))
+        chain = cp.vc_service.workflow_chain(docs)
+        # GET stays read-only (a dashboard poll must not mutate the DB); an
+        # explicit POST records the chain in the credentials explorer —
+        # envelope only, not the payload-resolved per-execution VCs (a large
+        # run's chain is megabytes).
+        if req.method == "POST":
+            await cp.db.save_credential(
+                chain.get("envelope", {}).get("id", f"vc:run:{run_id}"),
+                "workflow", run_id,
+                {
+                    "envelope": chain.get("envelope"),
+                    "credential_count": len(chain.get("credentials", [])),
+                },
+            )
+        return web.json_response(chain)
+
+    routes.post("/api/v1/vc/workflows/{run_id}")(workflow_vc_chain)
 
     # -- workflow DAG / runs / notes -----------------------------------
 
@@ -767,6 +795,73 @@ def create_app(cp: ControlPlane) -> web.Application:
                 "queue_depth": cp.gateway.queue_depth,
                 "backpressure_total": cp.metrics.counter_value("gateway_backpressure_total"),
             }
+        )
+
+    @routes.get("/api/ui/v1/executions")
+    async def ui_executions(req: web.Request):
+        """Paginated/filtered/grouped executions page payload (reference:
+        GetExecutionsSummary + GetGroupedExecutions,
+        internal/services/executions_ui_service.go:112,158). Totals and
+        group rollups come from SQL, never from len() of one page."""
+        from agentfield_tpu.control_plane import ui_service
+
+        q = req.query
+        try:
+            payload = await ui_service.executions_page(
+                cp.db,
+                page=q.get("page", 1),
+                page_size=q.get("page_size", 25),
+                status=q.get("status"),
+                target=q.get("target"),
+                run_id=q.get("run_id"),
+                order=q.get("order", "desc"),
+                group_by=q.get("group_by"),
+            )
+        except ValueError as e:
+            return _json_error(400, str(e))
+        return web.json_response(payload)
+
+    @routes.get("/api/ui/v1/nodes")
+    async def ui_nodes(_req):
+        """Per-node rollups (reference: GetNodesSummary, ui_service.go:78)."""
+        from agentfield_tpu.control_plane import ui_service
+
+        return web.json_response(await ui_service.node_summaries(cp))
+
+    @routes.get("/api/ui/v1/nodes/{node_id}")
+    async def ui_node_details(req: web.Request):
+        """Node detail + per-target SQL metrics in one fetch (reference:
+        GetNodeDetailsWithMCP, ui_service.go:467)."""
+        from agentfield_tpu.control_plane import ui_service
+
+        doc = await ui_service.node_details(cp, req.match_info["node_id"])
+        if doc is None:
+            return _json_error(404, "unknown node")
+        return web.json_response(doc)
+
+    @routes.get("/api/ui/v1/credentials")
+    async def ui_credentials(req: web.Request):
+        """Issued-credential explorer (reference: CredentialsPage.tsx)."""
+        from agentfield_tpu.control_plane import ui_service
+
+        q = req.query
+        return web.json_response(
+            await ui_service.credentials_page(
+                cp.db,
+                page=q.get("page", 1),
+                page_size=q.get("page_size", 25),
+                subject_type=q.get("subject_type"),
+            )
+        )
+
+    @routes.get("/api/v1/packages")
+    async def list_packages(_req):
+        """Installed-package inventory (reference: package service routes
+        behind PackagesPage.tsx)."""
+        from agentfield_tpu.control_plane import ui_service
+
+        return web.json_response(
+            await asyncio.to_thread(ui_service.packages_summary, cp.data_dir)
         )
 
     # -- MCP manager (reference: internal/mcp + ui mcp handlers,

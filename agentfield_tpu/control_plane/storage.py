@@ -90,6 +90,15 @@ CREATE TABLE IF NOT EXISTS kv_config (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS credentials (
+    vc_id TEXT PRIMARY KEY,
+    subject_type TEXT NOT NULL,
+    subject_id TEXT NOT NULL,
+    issued_at REAL NOT NULL,
+    doc TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_credentials_subject
+    ON credentials(subject_type, subject_id);
 """
 
 
@@ -219,15 +228,10 @@ class SQLiteStorage:
             ).fetchone()
         return Execution.from_dict(json.loads(row["doc"])) if row else None
 
-    def list_executions(
-        self,
-        run_id: str | None = None,
-        status: ExecutionStatus | None = None,
-        limit: int = 100,
-        offset: int = 0,
-        newest_first: bool = False,
-    ) -> list[Execution]:
-        q = "SELECT doc FROM executions"
+    @staticmethod
+    def _exec_filters(
+        run_id: str | None, status: "ExecutionStatus | None", target: str | None
+    ) -> tuple[str, list]:
         cond, args = [], []
         if run_id is not None:
             cond.append("run_id=?")
@@ -235,14 +239,141 @@ class SQLiteStorage:
         if status is not None:
             cond.append("status=?")
             args.append(status.value)
-        if cond:
-            q += " WHERE " + " AND ".join(cond)
+        if target is not None:
+            cond.append("target=?")
+            args.append(target)
+        return (" WHERE " + " AND ".join(cond)) if cond else "", args
+
+    def list_executions(
+        self,
+        run_id: str | None = None,
+        status: ExecutionStatus | None = None,
+        limit: int = 100,
+        offset: int = 0,
+        newest_first: bool = False,
+        target: str | None = None,
+    ) -> list[Execution]:
+        where, args = self._exec_filters(run_id, status, target)
         direction = "DESC" if newest_first else "ASC"
-        q += f" ORDER BY created_at {direction}, execution_id {direction} LIMIT ? OFFSET ?"
+        q = (
+            f"SELECT doc FROM executions{where} "
+            f"ORDER BY created_at {direction}, execution_id {direction} LIMIT ? OFFSET ?"
+        )
         args += [limit, offset]
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
+
+    def count_executions(
+        self,
+        run_id: str | None = None,
+        status: ExecutionStatus | None = None,
+        target: str | None = None,
+    ) -> int:
+        """Exact filtered count — the UI pagination totals must come from the
+        database, not from len() of one page (ref executions_ui_service.go)."""
+        where, args = self._exec_filters(run_id, status, target)
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT COUNT(*) AS n FROM executions{where}", args
+            ).fetchone()
+        return row["n"] or 0
+
+    _EXEC_GROUP_COLS = ("target", "status", "run_id")
+
+    def execution_group_counts(
+        self,
+        group_by: str,
+        run_id: str | None = None,
+        status: ExecutionStatus | None = None,
+        target: str | None = None,
+        limit: int = 100,
+    ) -> list[dict[str, Any]]:
+        """SQL GROUP BY rollup for the grouped executions view (ref
+        GetGroupedExecutions, executions_ui_service.go:158) — per group:
+        count, per-status counts, newest activity."""
+        if group_by not in self._EXEC_GROUP_COLS:
+            raise ValueError(f"group_by must be one of {self._EXEC_GROUP_COLS}")
+        where, args = self._exec_filters(run_id, status, target)
+        q = (
+            f"SELECT {group_by} AS g, COUNT(*) AS n, "
+            "SUM(CASE WHEN status='completed' THEN 1 ELSE 0 END) AS ok, "
+            "SUM(CASE WHEN status IN ('failed','timeout') THEN 1 ELSE 0 END) AS bad, "
+            "MAX(created_at) AS latest "
+            f"FROM executions{where} GROUP BY {group_by} "
+            "ORDER BY latest DESC LIMIT ?"
+        )
+        with self._lock:
+            rows = self._conn.execute(q, args + [limit]).fetchall()
+        return [
+            {
+                "group": r["g"],
+                "executions": r["n"],
+                "completed": r["ok"] or 0,
+                "failed": r["bad"] or 0,
+                "latest": r["latest"],
+            }
+            for r in rows
+        ]
+
+    # -- credentials (issued-VC persistence for the credentials explorer;
+    # the reference stores them behind its DID/VC services) ---------------
+
+    def save_credential(
+        self, vc_id: str, subject_type: str, subject_id: str, doc: dict[str, Any]
+    ) -> None:
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO credentials (vc_id, subject_type, subject_id, "
+                "issued_at, doc) VALUES (?, ?, ?, ?, ?) "
+                "ON CONFLICT (vc_id) DO UPDATE SET doc=excluded.doc, "
+                "issued_at=excluded.issued_at",
+                (vc_id, subject_type, subject_id, now, json.dumps(doc)),
+            )
+            self._conn.commit()
+
+    def list_credentials(
+        self,
+        subject_type: str | None = None,
+        subject_id: str | None = None,
+        limit: int = 100,
+        offset: int = 0,
+    ) -> list[dict[str, Any]]:
+        cond, args = [], []
+        if subject_type is not None:
+            cond.append("subject_type=?")
+            args.append(subject_type)
+        if subject_id is not None:
+            cond.append("subject_id=?")
+            args.append(subject_id)
+        where = (" WHERE " + " AND ".join(cond)) if cond else ""
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT vc_id, subject_type, subject_id, issued_at, doc "
+                f"FROM credentials{where} ORDER BY issued_at DESC, vc_id DESC "
+                "LIMIT ? OFFSET ?",
+                args + [limit, offset],
+            ).fetchall()
+        return [
+            {
+                "vc_id": r["vc_id"],
+                "subject_type": r["subject_type"],
+                "subject_id": r["subject_id"],
+                "issued_at": r["issued_at"],
+                "vc": json.loads(r["doc"]),
+            }
+            for r in rows
+        ]
+
+    def count_credentials(self, subject_type: str | None = None) -> int:
+        cond = " WHERE subject_type=?" if subject_type is not None else ""
+        args = [subject_type] if subject_type is not None else []
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT COUNT(*) AS n FROM credentials{cond}", args
+            ).fetchone()
+        return row["n"] or 0
 
     def target_metrics(self, target: str) -> dict[str, Any]:
         """Per-reasoner/skill performance rollup in SQL (reference: per-

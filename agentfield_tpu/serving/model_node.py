@@ -424,11 +424,19 @@ class ModelBackend:
             )
         cfg = self.tts_cfg
         full = text.encode("utf-8")
-        data = full[: cfg.max_chars]
-        while data and (data[-1] & 0xC0) == 0x80:
-            data = data[:-1]  # don't feed a dangling UTF-8 continuation run
-        if data and data[-1] >= 0xC0:
-            data = data[:-1]  # ...or its orphaned lead byte
+        data = full
+        if len(full) > cfg.max_chars:
+            data = full[: cfg.max_chars]
+            # The cut may land mid-codepoint: strip ONLY an incomplete
+            # trailing multibyte sequence (a complete final char stays).
+            i = len(data) - 1
+            while i >= 0 and (data[i] & 0xC0) == 0x80:
+                i -= 1
+            if i >= 0 and data[i] >= 0xC0:
+                lead = data[i]
+                need = 2 if lead < 0xE0 else 3 if lead < 0xF0 else 4
+                if len(data) - i < need:
+                    data = data[:i]
         truncated = len(full) - len(data)
         ids = np.zeros((1, cfg.max_chars), np.int32)
         if data:
@@ -702,6 +710,11 @@ class ModelBackend:
                 "this model node has no TTS head (audio output unsupported); "
                 "start it with tts=<config> to serve output='audio'/'speech'"
             )
+        if output == "speech" and self.tokenizer is None:
+            raise ValueError(
+                "output='speech' needs a tokenizer on this node (the "
+                "generated text is what gets synthesized)"
+            )
         if output == "audio":
             # Pure TTS (reference: agent_ai.py:750 hands text to a speech
             # API): no LM decode, the prompt itself is spoken.
@@ -769,11 +782,6 @@ class ModelBackend:
         if output == "speech":
             # Speak the GENERATED text (reference chat-audio shape,
             # agent_ai.py:864: text response + audio of that response).
-            if self.tokenizer is None:
-                raise ValueError(
-                    "output='speech' needs a tokenizer on this node (the "
-                    "generated text is what gets synthesized)"
-                )
             # An empty generation (immediate EOS) speaks as near-silence —
             # the synth pads to one frame span; not an error.
             wav_b64, tts_trunc = await asyncio.to_thread(

@@ -1,0 +1,210 @@
+"""UI aggregation service layer (VERDICT round-2 missing #4, item 9):
+server-side paginated/filtered/grouped executions, node rollups, persisted
+credentials explorer, and package inventory — plus the dashboard pages that
+render them.
+
+Reference analogue: internal/services/ui_service.go:78-732 and
+executions_ui_service.go:112-477 (page-shaped aggregation on the server so
+the SPA never re-aggregates raw lists client-side)."""
+
+import json
+import time
+
+import pytest
+
+from tests.helpers_cp import CPHarness, async_test
+
+from agentfield_tpu.control_plane.types import Execution, ExecutionStatus, TargetType
+
+
+def _seed_executions(storage, n=60, run="run_ui", target_a="n.alpha", target_b="n.beta"):
+    t0 = time.time() - n
+    for i in range(n):
+        status = (
+            ExecutionStatus.COMPLETED if i % 3 else ExecutionStatus.FAILED
+        )
+        ex = Execution(
+            execution_id=f"exec_{i:04d}",
+            target=target_a if i % 2 else target_b,
+            target_type=TargetType.REASONER,
+            status=status,
+            run_id=run if i < n // 2 else f"{run}_2",
+            created_at=t0 + i,
+            finished_at=t0 + i + 0.5,
+        )
+        storage.create_execution(ex)
+
+
+@async_test
+async def test_executions_page_pagination_and_totals():
+    async with CPHarness() as h:
+        _seed_executions(h.cp.storage)
+        async with h.http.get("/api/ui/v1/executions?page=1&page_size=10") as r:
+            d = await r.json()
+        assert d["total"] == 60 and d["total_pages"] == 6
+        assert len(d["executions"]) == 10
+        assert d["has_next"] and not d["has_prev"]
+        # newest-first default
+        ids = [e["execution_id"] for e in d["executions"]]
+        assert ids == sorted(ids, reverse=True)
+        assert d["executions"][0]["duration_s"] == 0.5
+        # last page
+        async with h.http.get("/api/ui/v1/executions?page=6&page_size=10") as r:
+            d6 = await r.json()
+        assert len(d6["executions"]) == 10 and not d6["has_next"]
+
+
+@async_test
+async def test_executions_page_filters_and_groups():
+    async with CPHarness() as h:
+        _seed_executions(h.cp.storage)
+        async with h.http.get("/api/ui/v1/executions?status=failed") as r:
+            d = await r.json()
+        assert d["total"] == 20  # every 3rd of 60
+        assert all(e["status"] == "failed" for e in d["executions"])
+        async with h.http.get("/api/ui/v1/executions?target=n.alpha") as r:
+            d = await r.json()
+        assert d["total"] == 30
+        # SQL GROUP BY rollup
+        async with h.http.get("/api/ui/v1/executions?group_by=target") as r:
+            d = await r.json()
+        groups = {g["group"]: g for g in d["groups"]}
+        assert groups["n.alpha"]["executions"] == 30
+        assert groups["n.alpha"]["completed"] + groups["n.alpha"]["failed"] == 30
+        # combined filter + group
+        async with h.http.get(
+            "/api/ui/v1/executions?status=failed&group_by=run_id"
+        ) as r:
+            d = await r.json()
+        assert sum(g["executions"] for g in d["groups"]) == 20
+        # bad inputs
+        async with h.http.get("/api/ui/v1/executions?status=nope") as r:
+            assert r.status == 400
+        async with h.http.get("/api/ui/v1/executions?group_by=doc") as r:
+            assert r.status == 400
+        # page clamping: garbage falls back to defaults, never a 500
+        async with h.http.get("/api/ui/v1/executions?page=zzz&page_size=-3") as r:
+            d = await r.json()
+        assert r.status == 200 and d["page"] == 1
+
+
+@async_test
+async def test_node_summaries_and_details():
+    async with CPHarness() as h:
+        await h.register_agent()
+        # fake a model node with heartbeat stats (what build_model_node pushes)
+        async with h.http.post(
+            "/api/v1/nodes",
+            json={
+                "node_id": "model-x",
+                "base_url": "http://127.0.0.1:1",
+                "kind": "model",
+                "reasoners": [{"id": "generate"}],
+            },
+        ) as r:
+            assert r.status in (200, 201)
+        node = h.cp.storage.get_node("model-x")
+        node.metadata["stats"] = {
+            "decode_tokens": 123, "active_slots": 2, "free_pages": 9,
+            "grammar_bank_rows_used": 4, "grammar_bank_rows": 255,
+        }
+        h.cp.storage.upsert_node(node)
+        async with h.http.get("/api/ui/v1/nodes") as r:
+            d = await r.json()
+        assert d["total"] == 2
+        model = next(n for n in d["nodes"] if n["node_id"] == "model-x")
+        assert model["engine"]["decode_tokens"] == 123
+        assert model["reasoners"] == 1
+        agent = next(n for n in d["nodes"] if n["node_id"] == "fake-agent")
+        assert "engine" not in agent and agent["last_heartbeat_age_s"] < 60
+        # details include per-target metrics once executions exist
+        ex = Execution(
+            execution_id="e1", target="fake-agent.echo",
+            target_type=TargetType.REASONER, status=ExecutionStatus.COMPLETED,
+            run_id="r1", finished_at=time.time(),
+        )
+        h.cp.storage.create_execution(ex)
+        async with h.http.get("/api/ui/v1/nodes/fake-agent") as r:
+            d = await r.json()
+        assert d["node_id"] == "fake-agent"
+        assert d["target_metrics"]["fake-agent.echo"]["executions"] == 1
+        async with h.http.get("/api/ui/v1/nodes/ghost") as r:
+            assert r.status == 404
+
+
+@async_test
+async def test_credentials_persist_and_page():
+    async with CPHarness() as h:
+        await h.register_agent()
+        # run an execution, issue its VC, expect it in the explorer
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo", json={"input": {"x": 1}}
+        ) as r:
+            doc = await r.json()
+        eid = doc["execution_id"]
+        async with h.http.post(f"/api/v1/vc/executions/{eid}") as r:
+            assert r.status == 200
+            vc = (await r.json())["vc"]
+        async with h.http.get("/api/ui/v1/credentials") as r:
+            d = await r.json()
+        assert d["total"] == 1
+        [row] = d["credentials"]
+        assert row["subject_type"] == "execution" and row["subject_id"] == eid
+        assert row["vc_id"] == f"vc:exec:{eid}"  # deterministic → re-issue upserts
+        assert row["vc"]["credentialSubject"]["execution_id"] == eid
+        assert row["vc"]["proof"] == vc["proof"]
+        # re-issuing upserts, not duplicates
+        async with h.http.post(f"/api/v1/vc/executions/{eid}") as r:
+            assert r.status == 200
+        async with h.http.get("/api/ui/v1/credentials?subject_type=execution") as r:
+            assert (await r.json())["total"] == 1
+        # workflow chain: GET is read-only (a dashboard poll must not write);
+        # explicit POST records the envelope in the explorer
+        run_id = doc["run_id"]
+        async with h.http.get(f"/api/v1/vc/workflows/{run_id}") as r:
+            assert r.status == 200
+        async with h.http.get("/api/ui/v1/credentials?subject_type=workflow") as r:
+            assert (await r.json())["total"] == 0
+        async with h.http.post(f"/api/v1/vc/workflows/{run_id}") as r:
+            assert r.status == 200
+            chain = await r.json()
+        async with h.http.get("/api/ui/v1/credentials?subject_type=workflow") as r:
+            d = await r.json()
+        assert d["total"] == 1
+        [wf] = d["credentials"]
+        assert wf["subject_id"] == run_id
+        assert wf["vc"]["credential_count"] == len(chain["credentials"])
+        assert "credentials" not in wf["vc"]  # envelope-only (size bound)
+
+
+@async_test
+async def test_packages_endpoint(tmp_path):
+    async with CPHarness(data_dir=str(tmp_path)) as h:
+        async with h.http.get("/api/v1/packages") as r:
+            assert (await r.json()) == {"packages": [], "total": 0}
+        # registry written the way cli/packages.py install() does
+        (tmp_path / "packages").mkdir()
+        (tmp_path / "packages" / "installed.json").write_text(
+            json.dumps(
+                {
+                    "demo": {
+                        "name": "demo", "path": "/x/demo", "entry": "agent.py",
+                        "description": "demo pkg",
+                        "origin": {"type": "local", "path": "/src"},
+                        "installed_at": 1.0,
+                    }
+                }
+            )
+        )
+        async with h.http.get("/api/v1/packages") as r:
+            d = await r.json()
+        assert d["total"] == 1 and d["packages"][0]["entry"] == "agent.py"
+
+
+@async_test
+async def test_dashboard_serves_new_pages():
+    async with CPHarness() as h:
+        async with h.http.get("/") as r:
+            html = await r.text()
+        for frag in ("pgPkgs", "pgCreds", "'pkgs'", "'creds'", "/api/ui/v1/executions"):
+            assert frag in html, frag

@@ -47,14 +47,27 @@ phase('PROBE-OK %s %.1fs' % (jax.default_backend(), time.time() - t0))
 """
 
 
+def _reap_later(p: "subprocess.Popen") -> None:
+    """Reap an abandoned (never-killed) child when it eventually exits, so
+    overrun attempts don't accumulate zombies."""
+    import threading
+
+    threading.Thread(target=p.wait, daemon=True).start()
+
+
 def probe_once(claim_budget: float = 420.0, run_budget: float = 900.0) -> str:
-    """One probe attempt. Returns 'ok' or a failure description."""
+    """One probe attempt. Returns 'ok' or a failure description. Child output
+    goes to a FILE, not a pipe — a chatty JAX runtime filling a 64 KB pipe
+    buffer would block the child mid-claim, a deadlock this watcher exists to
+    avoid."""
     with tempfile.NamedTemporaryFile("r", suffix=".phase", delete=False) as pf:
         phase_path = pf.name
-    p = subprocess.Popen(
-        [sys.executable, "-c", _PROBE, phase_path],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
+    err_path = phase_path + ".err"
+    with open(err_path, "w") as errf:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _PROBE, phase_path],
+            stdout=errf, stderr=errf,
+        )
     t0 = time.monotonic()
     claimed = None
     try:
@@ -66,7 +79,7 @@ def probe_once(claim_budget: float = 420.0, run_budget: float = 900.0) -> str:
             if rc is not None:
                 if "PROBE-OK" in phases:
                     return "ok"
-                err = (p.stderr.read() or "").strip()[-300:]
+                err = open(err_path).read().strip()[-300:]
                 return f"rc={rc}: {err or phases.strip() or 'no output'}"
             el = time.monotonic() - t0
             if claimed is None and el > claim_budget:
@@ -75,16 +88,19 @@ def probe_once(claim_budget: float = 420.0, run_budget: float = 900.0) -> str:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+                    p.wait()
                 return f"claim not granted in {claim_budget:.0f}s"
             if claimed is not None and el > run_budget:
                 # claimed but slow: NEVER kill; abandon (it exits on its own)
+                _reap_later(p)
                 return "claimed but matmul overran; child left unkilled"
             time.sleep(2)
     finally:
-        try:
-            os.unlink(phase_path)
-        except OSError:
-            pass
+        for path in (phase_path, err_path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def run_validation(out_dir: str) -> None:
@@ -100,24 +116,33 @@ def run_validation(out_dir: str) -> None:
     # NEVER kill this child: it holds the TPU claim. Its own in-process
     # watchdog emits the JSON line and exits at 3000s; we wait patiently and
     # if it somehow outlives even that, we abandon it UNKILLED (it releases
-    # the claim when it exits) and record the overrun.
-    p = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
-    t0 = time.monotonic()
-    while p.poll() is None and time.monotonic() - t0 < 3900:
-        time.sleep(5)
+    # the claim when it exits) and record the overrun. Output goes to files —
+    # a full pipe buffer would block the claim-holding child (see probe_once).
+    out_path = os.path.join(out_dir, "bench.stdout")
+    err_path = os.path.join(out_dir, "bench.stderr")
+    with open(out_path, "w") as outf, open(err_path, "w") as errf:
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, stdout=outf, stderr=errf,
+        )
+        t0 = time.monotonic()
+        while p.poll() is None and time.monotonic() - t0 < 3900:
+            time.sleep(5)
     if p.poll() is None:
+        _reap_later(p)
         payload = {"error": "bench outlived its own watchdog; left unkilled"}
-        out_stdout = out_stderr = ""
     else:
-        out_stdout, out_stderr = p.communicate()
-    lines = [l for l in (out_stdout or "").strip().splitlines() if l.startswith("{")]
-    if lines:
-        payload = json.loads(lines[-1])
-    elif p.poll() is not None:
-        payload = {"error": "no JSON line", "stderr": (out_stderr or "")[-1000:]}
+        lines = [
+            l for l in open(out_path).read().strip().splitlines()
+            if l.startswith("{")
+        ]
+        if lines:
+            payload = json.loads(lines[-1])
+        else:
+            payload = {
+                "error": "no JSON line",
+                "stderr": open(err_path).read()[-1000:],
+            }
     with open(os.path.join(out_dir, "bench.json"), "w") as f:
         json.dump(payload, f, indent=1)
     kernels = {

@@ -771,8 +771,7 @@ class ModelBackend:
             # decoding for a dead reader wastes TPU steps and pins pages.
             self._futures.pop(rid, None)
             self._buffers.pop(rid, None)
-            self.engine.request_cancel(rid)
-            self._wake.set()
+            self.cancel(rid)
             raise
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(result["tokens"])
@@ -833,6 +832,14 @@ class ModelBackend:
             prefused=prefused,
         )
         return rid, q
+
+    def cancel(self, rid: str) -> None:
+        """Cancel an in-flight request and wake the drive loop so the slot
+        frees now, not at the next natural step. The one cancel recipe for
+        every abandoned-caller path (generate() CancelledError, stream
+        disconnects)."""
+        self.engine.request_cancel(rid)
+        self._wake.set()
 
     def release_stream(self, rid: str) -> None:
         """Consumer gone: stop dispatching to its queue (remaining tokens take
@@ -974,10 +981,13 @@ def build_model_node(
                 if ev.finished:
                     break
         except (ConnectionResetError, asyncio.CancelledError):
-            pass
+            # Consumer gone mid-stream: CANCEL the request — decoding for a
+            # dead reader wastes TPU steps and pins pages (same policy as
+            # generate()'s CancelledError path).
+            backend.cancel(rid)
         finally:
             backend.release_stream(rid)  # disconnected consumers must not
-            # accumulate in _streams (remaining tokens take the discard path)
+            # accumulate in _streams
         return resp
 
     agent.add_route("POST", "/generate/stream", stream_handler)

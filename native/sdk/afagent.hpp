@@ -69,12 +69,9 @@ struct HttpResponse {
     std::string body;
 };
 
-// Minimal HTTP/1.1 request over a fresh socket (Content-Length framing only —
-// the control plane always sends it for JSON responses).
-inline HttpResponse http_request(const std::string& method, const std::string& url,
-                                 const std::string& body,
-                                 const std::vector<std::string>& headers = {}) {
-    Url u = parse_url(url);
+// Connect a fresh socket to `u` with the gateway-mirroring 90s timeouts.
+// Throws on resolve/connect failure; caller owns (and must close) the fd.
+inline int dial(const Url& u) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) throw std::runtime_error("socket() failed");
     sockaddr_in addr{};
@@ -100,19 +97,32 @@ inline HttpResponse http_request(const std::string& method, const std::string& u
         ::close(fd);
         throw std::runtime_error("connect failed: " + u.host + ":" + std::to_string(u.port));
     }
-    std::ostringstream req;
-    req << method << " " << u.path << " HTTP/1.1\r\nHost: " << u.host
-        << "\r\nContent-Type: application/json\r\nContent-Length: " << body.size()
-        << "\r\nConnection: close\r\n";
-    for (auto& h : headers) req << h << "\r\n";
-    req << "\r\n" << body;
-    std::string data = req.str();
+    return fd;
+}
+
+inline void send_all(int fd, const std::string& data) {
     size_t sent = 0;
     while (sent < data.size()) {
         ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
         if (n <= 0) { ::close(fd); throw std::runtime_error("send failed"); }
         sent += (size_t)n;
     }
+}
+
+// Minimal HTTP/1.1 request over a fresh socket (Content-Length framing only —
+// the control plane always sends it for JSON responses).
+inline HttpResponse http_request(const std::string& method, const std::string& url,
+                                 const std::string& body,
+                                 const std::vector<std::string>& headers = {}) {
+    Url u = parse_url(url);
+    int fd = dial(u);
+    std::ostringstream req;
+    req << method << " " << u.path << " HTTP/1.1\r\nHost: " << u.host
+        << "\r\nContent-Type: application/json\r\nContent-Length: " << body.size()
+        << "\r\nConnection: close\r\n";
+    for (auto& h : headers) req << h << "\r\n";
+    req << "\r\n" << body;
+    send_all(fd, req.str());
     std::string raw;
     char buf[4096];
     ssize_t n;
@@ -206,6 +216,18 @@ inline std::string json_scan_string(const std::string& body, const std::string& 
     return out;
 }
 
+// Scan a bare numeric value (`"key": -12` / `"key": 3.5`); json.dumps never
+// quotes numbers. Returns `fallback` when the key is absent.
+inline double json_scan_number(const std::string& body, const std::string& key,
+                               double fallback = 0.0) {
+    std::string needle = "\"" + key + "\": ";
+    size_t at = body.find(needle);
+    if (at == std::string::npos) return fallback;
+    const char* p = body.c_str() + at + needle.size();
+    if (*p != '-' && *p != '+' && !(*p >= '0' && *p <= '9')) return fallback;
+    return std::atof(p);
+}
+
 // Result of an ai() call (the reference Go SDK's ai.Client response role,
 // sdk/go/ai/client.go — here served by an in-tree TPU model node).
 struct AiResponse {
@@ -215,6 +237,21 @@ struct AiResponse {
     std::string model;   // serving model name
     std::string raw;     // full execution response JSON (tokens, logprobs, …)
 };
+
+// One token frame from the model node's SSE stream (the Python SDK's
+// ai_stream counterpart; wire shape pinned by model_node.py stream_handler).
+struct StreamEvent {
+    int token = -1;
+    int index = -1;
+    bool finished = false;
+    std::string finish_reason;
+    std::string text;  // decoded piece ("" for control frames)
+};
+
+// Per-token callback; return false to stop consuming — closing the socket
+// makes the node's stream handler cancel the request (freeing its engine
+// slot) the next time it tries to write a frame.
+using StreamCallback = std::function<bool(const StreamEvent&)>;
 
 // Handler: raw request-body JSON in, JSON value string out.
 using Handler = std::function<std::string(const std::string& body)>;
@@ -234,6 +271,39 @@ class Agent {
                             "{\"input\":" + input_json + "}");
     }
 
+    // Resolve the first active kind=model node from the registry. Returns
+    // false (with error filled) when none is registered; on success fills
+    // the node id and its base_url (the direct data-plane address).
+    bool resolve_model_node(std::string& node_id, std::string& base_url,
+                            std::string& error) {
+        auto nodes = http_request("GET", cp_ + "/api/v1/nodes", "");
+        if (nodes.status != 200) {
+            error = "list_nodes failed: " + std::to_string(nodes.status);
+            return false;
+        }
+        // Scan node blocks: each starts at "node_id"; pick the first
+        // whose block carries kind=model and status=active.
+        size_t pos = 0;
+        while (true) {
+            size_t at = nodes.body.find("\"node_id\": \"", pos);
+            if (at == std::string::npos) break;
+            size_t next = nodes.body.find("\"node_id\": \"", at + 12);
+            std::string block = nodes.body.substr(
+                at, (next == std::string::npos ? nodes.body.size() : next) - at);
+            if (block.find("\"kind\": \"model\"") != std::string::npos &&
+                block.find("\"status\": \"active\"") != std::string::npos) {
+                if (node_id.empty() || json_scan_string(block, "node_id") == node_id) {
+                    node_id = json_scan_string(block, "node_id");
+                    base_url = json_scan_string(block, "base_url");
+                    return true;
+                }
+            }
+            pos = at + 12;
+        }
+        error = "no active model node registered";
+        return false;
+    }
+
     // LLM call through the gateway to an in-tree model node — the second-
     // language SDK's ai() (reference: sdk/go/ai/client.go + Agent.ai()).
     // `model_node` pins a node id; empty resolves the first active
@@ -242,31 +312,8 @@ class Agent {
                   double temperature = 0.0, std::string model_node = "") {
         AiResponse out;
         if (model_node.empty()) {
-            auto nodes = http_request("GET", cp_ + "/api/v1/nodes", "");
-            if (nodes.status != 200) {
-                out.error = "list_nodes failed: " + std::to_string(nodes.status);
-                return out;
-            }
-            // Scan node blocks: each starts at "node_id"; pick the first
-            // whose block carries kind=model and status=active.
-            size_t pos = 0;
-            while (true) {
-                size_t at = nodes.body.find("\"node_id\": \"", pos);
-                if (at == std::string::npos) break;
-                size_t next = nodes.body.find("\"node_id\": \"", at + 12);
-                std::string block = nodes.body.substr(
-                    at, (next == std::string::npos ? nodes.body.size() : next) - at);
-                if (block.find("\"kind\": \"model\"") != std::string::npos &&
-                    block.find("\"status\": \"active\"") != std::string::npos) {
-                    model_node = json_scan_string(block, "node_id");
-                    break;
-                }
-                pos = at + 12;
-            }
-            if (model_node.empty()) {
-                out.error = "no active model node registered";
-                return out;
-            }
+            std::string base_url;
+            if (!resolve_model_node(model_node, base_url, out.error)) return out;
         }
         std::ostringstream body;
         body << "{\"prompt\":\"" << json_escape(prompt)
@@ -296,6 +343,113 @@ class Agent {
         }
         out.text = json_scan_string(resp.body, "text");
         out.model = json_scan_string(resp.body, "model");
+        out.ok = true;
+        return out;
+    }
+
+    // Streaming ai(): tokens arrive through `on_event` as the model decodes
+    // (the Python SDK's ai_stream / reference streaming passthrough,
+    // agent_ai.py:414). The data plane is the MODEL NODE's own
+    // /generate/stream SSE endpoint — tokens never proxy through the
+    // control plane; the registry only resolves the node's base_url.
+    // HTTP/1.0 on purpose: close-delimited framing keeps the dependency-free
+    // client out of the chunked-transfer business.
+    AiResponse ai_stream(const std::string& prompt, const StreamCallback& on_event,
+                         int max_new_tokens = 64, double temperature = 0.0,
+                         std::string model_node = "") {
+        AiResponse out;
+        std::string base_url;
+        if (!resolve_model_node(model_node, base_url, out.error)) return out;
+        if (base_url.empty()) {
+            out.error = "model node " + model_node + " has no base_url";
+            return out;
+        }
+        out.model = model_node;
+        std::ostringstream body;
+        body << "{\"prompt\":\"" << json_escape(prompt)
+             << "\",\"max_new_tokens\":" << max_new_tokens
+             << ",\"temperature\":" << temperature << "}";
+        std::string payload = body.str();
+
+        Url u = parse_url(base_url);
+        int fd = -1;
+        try {
+            fd = dial(u);
+            std::ostringstream req;
+            req << "POST /generate/stream HTTP/1.0\r\nHost: " << u.host
+                << "\r\nContent-Type: application/json\r\nContent-Length: "
+                << payload.size() << "\r\n\r\n" << payload;
+            send_all(fd, req.str());
+        } catch (const std::exception& e) {
+            out.error = e.what();
+            return out;
+        }
+        std::string buf;
+        bool headers_done = false;
+        int status = 0;
+        char chunk[4096];
+        bool finished = false;
+        while (!finished) {
+            ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+            if (n <= 0) break;  // node closed (or timed out)
+            buf.append(chunk, (size_t)n);
+            if (!headers_done) {
+                auto hdr_end = buf.find("\r\n\r\n");
+                if (hdr_end == std::string::npos) continue;
+                auto sp = buf.find(' ');
+                if (sp != std::string::npos) status = std::atoi(buf.c_str() + sp + 1);
+                if (status != 200) {
+                    // error body is small JSON; drain and report
+                    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0)
+                        buf.append(chunk, (size_t)n);
+                    ::close(fd);
+                    out.raw = buf.substr(hdr_end + 4);
+                    out.error = json_scan_string(out.raw, "error");
+                    if (out.error.empty())
+                        out.error = "stream returned " + std::to_string(status);
+                    return out;
+                }
+                buf.erase(0, hdr_end + 4);
+                headers_done = true;
+            }
+            // Extract complete `data: {...}\n\n` SSE frames.
+            while (true) {
+                size_t end = buf.find("\n\n");
+                if (end == std::string::npos) break;
+                std::string frame = buf.substr(0, end);
+                buf.erase(0, end + 2);
+                size_t at = frame.find("data: ");
+                if (at == std::string::npos) continue;
+                std::string doc = frame.substr(at + 6);
+                StreamEvent ev;
+                ev.token = (int)json_scan_number(doc, "token", -1);
+                ev.index = (int)json_scan_number(doc, "index", -1);
+                ev.finished = doc.find("\"finished\": true") != std::string::npos;
+                ev.finish_reason = json_scan_string(doc, "finish_reason");
+                ev.text = json_scan_string(doc, "text");
+                out.text += ev.text;
+                if (!on_event(ev)) {  // consumer stop: closing the socket
+                    ::close(fd);      // cancels the request server-side
+                    out.ok = true;
+                    return out;
+                }
+                if (ev.finished) {
+                    finished = true;
+                    // The drive loop reports engine failures as a terminal
+                    // frame with finish_reason "error: ..." — surface it
+                    // like unary ai() does, not as a truncated success.
+                    if (ev.finish_reason.rfind("error", 0) == 0)
+                        out.error = ev.finish_reason;
+                    break;
+                }
+            }
+        }
+        ::close(fd);
+        if (!out.error.empty()) return out;
+        if (!finished) {
+            out.error = "stream ended before a finished frame";
+            return out;
+        }
         out.ok = true;
         return out;
     }

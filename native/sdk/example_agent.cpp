@@ -67,6 +67,25 @@ int main(int argc, char** argv) {
         },
         "Greet via the TPU model node (C++ ai() demo)");
 
+    agent.register_reasoner(
+        "cpp_ai_stream",
+        [&agent](const std::string&) {
+            // Streaming parity: tokens arrive per-frame over the model
+            // node's SSE endpoint; count them and return the joined text.
+            int frames = 0;
+            afield::AiResponse r = agent.ai_stream(
+                "Stream from C++",
+                [&frames](const afield::StreamEvent& ev) {
+                    if (ev.token >= 0) ++frames;
+                    return true;  // consume to completion
+                },
+                8, 0.0);
+            if (!r.ok) return std::string("{\"error\":\"") + afield::json_escape(r.error) + "\"}";
+            return std::string("{\"text\":\"") + afield::json_escape(r.text) +
+                   "\",\"frames\":" + std::to_string(frames) + "}";
+        },
+        "Stream tokens from the TPU model node (C++ ai_stream demo)");
+
     agent.start();
     std::printf("[afield-cpp] %s serving on :%d against %s\n", node.c_str(), agent.port(),
                 cp.c_str());

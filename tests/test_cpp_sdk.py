@@ -57,7 +57,7 @@ async def test_cpp_agent_end_to_end():
             node = nodes["cpp-agent"]
             assert node["metadata"] == {"sdk": "cpp"}
             assert {r["id"] for r in node["reasoners"]} == {
-                "cpp_echo", "cpp_sum", "cpp_ai_greet"
+                "cpp_echo", "cpp_sum", "cpp_ai_greet", "cpp_ai_stream"
             }
             assert node["did"].startswith("did:key:z")  # full identity parity
 
@@ -130,6 +130,49 @@ async def test_cpp_ai_client_through_model_node():
                 doc = await r.json()
             assert doc["status"] == "completed", doc
             assert doc["result"]["model"] == "llama-tiny"
+            assert isinstance(doc["result"]["text"], str) and doc["result"]["text"]
+        finally:
+            proc.terminate()
+            await proc.wait()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_cpp_ai_stream_through_model_node():
+    """The C++ SDK's ai_stream() consumes the model node's SSE endpoint
+    directly (data plane, no control-plane proxy) — streaming parity with the
+    Python SDK's ai_stream (VERDICT round-2 missing #6)."""
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    binary = await asyncio.to_thread(_build)
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny",
+            ecfg=EngineConfig(max_batch=2, page_size=16, num_pages=64, max_pages_per_seq=4),
+        )
+        await backend.start()
+        await model_agent.start()
+        proc = await asyncio.create_subprocess_exec(
+            str(binary), h.base_url, "cpp-agent",
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        )
+        try:
+            for _ in range(100):
+                nodes = (await (await h.http.get("/api/v1/nodes")).json())["nodes"]
+                if any(n["node_id"] == "cpp-agent" and n["status"] == "active" for n in nodes):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("cpp agent never registered")
+            async with h.http.post(
+                "/api/v1/execute/cpp-agent.cpp_ai_stream", json={"input": {}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            # 8 requested tokens streamed as 8 frames; text is their join
+            assert doc["result"]["frames"] == 8, doc["result"]
             assert isinstance(doc["result"]["text"], str) and doc["result"]["text"]
         finally:
             proc.terminate()

@@ -175,6 +175,7 @@ def cmd_model(cfg: Config, args) -> int:
             grammar_whitespace=mn.grammar_whitespace,
             audio=mn.audio,
             tts=mn.tts,
+            quant=mn.quant,
         )
         await backend.start()
         await agent.start()

@@ -67,6 +67,7 @@ class ModelNodeConfig:
     vision: str | None = None  # vision tower config name → serve image inputs
     audio: str | None = None  # audio tower config name → serve audio inputs
     tts: str | None = None  # TTS head config name → serve audio OUTPUT
+    quant: str | None = None  # "int8" weight-only quantized serving
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 
 

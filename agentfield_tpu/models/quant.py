@@ -1,0 +1,104 @@
+"""Weight-only int8 quantization for serving.
+
+TPU decode is HBM-bandwidth-bound: every decode step streams the full weight
+matrix set from HBM into the MXU while activations stay tiny, so halving the
+bytes per weight is a direct throughput lever (the reference has no analogue
+— its models live behind external providers, agent_ai.py:95-447).
+
+Design:
+- **Per-output-channel symmetric int8.** ``w ≈ q * scale`` with
+  ``scale[j] = max_i |w[i, j]| / 127``. Because the scale is constant along
+  the *contraction* axis, dequantization commutes with the matmul:
+  ``x @ (q * s) == (x @ q) * s`` — the kernel multiplies the int8 weights
+  straight into the MXU (XLA fuses the int8→bf16 convert into the dot's
+  operand read) and applies one [d_out] rescale to the product. The full
+  bf16 weight matrix is never materialized.
+- **Transparent call sites.** :class:`QuantW` is a pytree node implementing
+  ``__rmatmul__``; JAX arrays defer unrecognized ``@`` operands, so
+  ``x @ lp["wq"]`` in models/llama.py works unchanged for fp and quantized
+  params alike — one forward implementation, no quant branches.
+- **Scan/jit/shard compatible.** Both leaves (q [L, in, out] int8,
+  scale [L, out] f32) carry the stacked-layer axis, so ``lax.scan`` over
+  ``params["layers"]`` slices them in lockstep; parallel/sharding.py maps
+  the q spec's output axis onto the scale.
+
+Embeddings and lm_head stay fp: ``jnp.take`` reads only B×S rows (not
+bandwidth-bound) and the final projection dominates logit accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Weight leaves of models.llama.init_params that carry the decode-step HBM
+# traffic; order/keys mirror the init (biases + norms stay fp — trivial bytes).
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantW:
+    """int8 weight + per-output-channel scale behaving like the fp matrix on
+    the right side of ``@``."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q  # [..., d_in, d_out] int8
+        self.scale = scale  # [..., d_out] f32
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def __rmatmul__(self, x: jax.Array) -> jax.Array:
+        # (x @ q) * s == x @ (q * s): scale is constant along the contraction
+        # axis. The convert rides the dot's operand read; no dequantized
+        # matrix is materialized.
+        y = x @ self.q.astype(x.dtype)
+        return y * self.scale.astype(y.dtype)
+
+    def dequantize(self) -> jax.Array:
+        """Materialize the fp approximation (tests/debugging only)."""
+        return self.q.astype(jnp.float32) * self.scale[..., None, :]
+
+    def __repr__(self):
+        return f"QuantW(q={self.q.shape} int8, scale={self.scale.shape})"
+
+
+def quantize_weight(w: jax.Array) -> QuantW:
+    """[..., d_in, d_out] fp → QuantW. Symmetric per-output-channel."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)  # [..., d_out]
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -127, 127).astype(jnp.int8)
+    return QuantW(q, scale)
+
+
+def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize the layer-stack weight matrices of a llama param tree
+    (models/llama.py init_params layout). Idempotent on already-quantized
+    trees; everything outside QUANT_KEYS passes through untouched."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for k in QUANT_KEYS:
+        w = layers.get(k)
+        if w is not None and not isinstance(w, QuantW):
+            layers[k] = quantize_weight(w)
+    out["layers"] = layers
+    return out
+
+
+def is_quantized(params: dict[str, Any]) -> bool:
+    return any(isinstance(params.get("layers", {}).get(k), QuantW) for k in QUANT_KEYS)

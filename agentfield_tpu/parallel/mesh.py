@@ -67,6 +67,65 @@ def use_mesh(mesh: Mesh):
     return setter(mesh)
 
 
+def make_hybrid_mesh(
+    ici_shape: dict[str, int],
+    dcn_shape: dict[str, int],
+    devices=None,
+) -> Mesh:
+    """Multi-slice mesh: `ici_shape` axes stay within one slice (TP/SP/EP —
+    bandwidth-hungry collectives ride the ICI torus), `dcn_shape` axes span
+    slices (DP/PP — pipeline ppermute and gradient psum tolerate DCN
+    latency). SURVEY §7 step 8's "multi-slice DCN placement".
+
+    On real multi-slice TPU hardware this delegates to
+    ``mesh_utils.create_hybrid_device_mesh`` (device order chosen so
+    same-slice devices are contiguous along ICI axes); on hosts whose
+    devices carry no slice topology (CPU test meshes, single slice) it
+    falls back to a canonical-order reshape with identical axis semantics,
+    so sharded programs compile the same either way."""
+    overlap = set(ici_shape) & set(dcn_shape)
+    if overlap:
+        raise ValueError(f"axes cannot be both ICI and DCN: {sorted(overlap)}")
+    unknown = (set(ici_shape) | set(dcn_shape)) - set(CANONICAL_ORDER)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; canonical axes are "
+            f"{CANONICAL_ORDER}"
+        )
+    if devices is None:
+        devices = jax.devices()
+    # CANONICAL_ORDER keeps `model` fastest-varying (physically adjacent);
+    # DCN axes order ahead of ICI axes within each group.
+    dcn_axes = [ax for ax in CANONICAL_ORDER if ax in dcn_shape]
+    ici_axes = [ax for ax in CANONICAL_ORDER if ax in ici_shape]
+    names = dcn_axes + ici_axes
+    ici_dims = [ici_shape[ax] for ax in ici_axes]
+    dcn_dims = [dcn_shape[ax] for ax in dcn_axes]
+    n = int(np.prod(ici_dims + dcn_dims))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
+    slice_ids = {getattr(d, "slice_index", None) for d in devices[:n]}
+    if None in slice_ids or len(slice_ids) < 2:
+        # No slice topology metadata (CPU/virtual devices, single slice):
+        # plain reshape preserves axis semantics for compile-level validation.
+        dev_array = np.asarray(devices[:n]).reshape(dcn_dims + ici_dims)
+        return Mesh(dev_array, axis_names=names)
+    from jax.experimental import mesh_utils
+
+    # Real multi-slice hardware: let create_hybrid_device_mesh place devices
+    # (errors here are genuine misconfigurations — a wrong dcn shape must
+    # NOT silently degrade to a reshape that routes `model` collectives over
+    # DCN). It multiplies the two shapes elementwise over ONE axis list:
+    # each axis is pure-DCN (ici part 1) or pure-ICI (dcn part 1) here, so
+    # the product recovers our dims.
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=[1] * len(dcn_dims) + ici_dims,
+        dcn_mesh_shape=dcn_dims + [1] * len(ici_dims),
+        devices=devices[:n],
+    )
+    return Mesh(dev_array, axis_names=names)
+
+
 def auto_mesh_shape(n_devices: int, tp: int | None = None) -> dict[str, int]:
     """Factor n_devices into {data, model}. If tp is not given, pick the
     largest power-of-two TP degree ≤ 8 that divides n_devices — TP wants to

@@ -55,10 +55,27 @@ def named_sharding(mesh: Mesh, spec_tree: Any) -> Any:
     )
 
 
+def _quant_aware(specs: Any, params: Any) -> Any:
+    """Expand weight specs to match int8-quantized leaves: the QuantW node
+    carries (q [L, in, out], scale [L, out]) — q takes the full spec, scale
+    keeps the (layer, output) axes (the output axis is what TP shards)."""
+    from agentfield_tpu.models.quant import QuantW
+
+    def fix(spec, p):
+        if isinstance(p, QuantW):
+            return QuantW(spec, P(spec[0], spec[-1]))
+        return spec
+
+    return jax.tree.map(
+        fix, specs, params, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def shard_params(params: Any, cfg: LlamaConfig, mesh: Mesh) -> Any:
     """Place an (unsharded) param pytree onto the mesh. One pytree-aware
     device_put so XLA batches the host-to-device transfers."""
-    return jax.device_put(params, named_sharding(mesh, param_pspecs(cfg)))
+    specs = _quant_aware(param_pspecs(cfg), params)
+    return jax.device_put(params, named_sharding(mesh, specs))
 
 
 def check_divisibility(cfg: LlamaConfig, tp: int, paged_kv: bool = False) -> None:

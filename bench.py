@@ -375,6 +375,15 @@ def _run_bench() -> None:
         return
     cfg = get_config(model)
     params = init_params(cfg, jax.random.PRNGKey(0))
+    quant = os.environ.get("AGENTFIELD_BENCH_QUANT") or None  # "int8" halves
+    # decode-step HBM weight traffic (models/quant.py)
+    if quant is not None:
+        if quant != "int8":
+            # A typo'd mode must not record a "quantized" run over fp weights.
+            raise ValueError(f"AGENTFIELD_BENCH_QUANT={quant!r} (have: 'int8')")
+        from agentfield_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
     demoted = None
     if attn == "pallas":
         if not _budget_gate("correctness gate (pallas vs ref numerics)", 180):
@@ -514,6 +523,7 @@ def _run_bench() -> None:
             "compile_gate_s": _partial.get("compile_gate_s"),
             "fallback_tiny_tok_s": _partial.get("fallback", {}).get("value"),
             "max_batch": max_batch,
+            "quant": quant,
             "device": str(jax.devices()[0]),
         }
     )

@@ -83,3 +83,29 @@ def test_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_hybrid_mesh_dcn_ici_axes():
+    """Multi-slice mesh (SURVEY §7 step 8): DCN axes (stage/data) major,
+    ICI axes (model) minor; sharded programs compile over it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agentfield_tpu.parallel.mesh import (
+        AXIS_DATA,
+        AXIS_MODEL,
+        AXIS_STAGE,
+        make_hybrid_mesh,
+    )
+
+    m = make_hybrid_mesh({AXIS_MODEL: 2}, {AXIS_STAGE: 2, AXIS_DATA: 2})
+    assert tuple(m.axis_names) == (AXIS_STAGE, AXIS_DATA, AXIS_MODEL)
+    assert dict(m.shape) == {AXIS_STAGE: 2, AXIS_DATA: 2, AXIS_MODEL: 2}
+    x = jax.device_put(
+        jnp.ones((8, 16)), NamedSharding(m, P(AXIS_DATA, AXIS_MODEL))
+    )
+    total = jax.jit(lambda a: (a @ a.T).sum(), out_shardings=NamedSharding(m, P()))(x)
+    assert float(total) == 8 * 16 * 8
+    with pytest.raises(ValueError, match="ICI and DCN"):
+        make_hybrid_mesh({AXIS_MODEL: 2}, {AXIS_MODEL: 2})

@@ -1,0 +1,138 @@
+"""Weight-only int8 quantization (models/quant.py): numerics, transparent
+matmul dispatch, scan/jit/shard compatibility, and quantized serving e2e.
+
+Decode on TPU streams the full weight set from HBM every step; int8 halves
+that traffic (the serving-throughput lever — no reference analogue, its
+models live behind external providers)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models import llama
+from agentfield_tpu.models.quant import (
+    QUANT_KEYS,
+    QuantW,
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+)
+
+CFG = get_config("llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 64, 32)) * 0.1
+    qw = quantize_weight(w)
+    assert qw.q.dtype == jnp.int8 and qw.q.shape == w.shape
+    assert qw.scale.shape == (3, 32)
+    # symmetric rounding: error per element ≤ scale/2
+    err = np.abs(np.asarray(qw.dequantize()) - np.asarray(w))
+    bound = np.asarray(qw.scale)[:, None, :] * 0.5 + 1e-9
+    assert (err <= bound).all()
+
+
+def test_rmatmul_matches_dequantized():
+    w = jax.random.normal(jax.random.PRNGKey(2), (16, 24))
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 16))
+    qw = quantize_weight(w)
+    direct = np.asarray(x @ qw)  # jnp defers @ to QuantW.__rmatmul__
+    via_deq = np.asarray(x @ qw.dequantize().astype(x.dtype))
+    np.testing.assert_allclose(direct, via_deq, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_params_idempotent(params):
+    qp = quantize_params(params)
+    assert is_quantized(qp) and not is_quantized(params)
+    for k in QUANT_KEYS:
+        assert isinstance(qp["layers"][k], QuantW)
+    assert qp["layers"]["attn_norm"] is params["layers"]["attn_norm"]
+    qp2 = quantize_params(qp)
+    assert qp2["layers"]["wq"] is qp["layers"]["wq"]  # no double-quant
+
+
+def test_dense_forward_logits_close(params):
+    """One forward implementation serves fp and quantized params: per-channel
+    int8 keeps random-init logits within a few percent."""
+    qp = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, CFG.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    lf, _ = llama.forward(params, CFG, toks, pos, collect_kv=False)
+    lq, _ = llama.forward(qp, CFG, toks, pos, collect_kv=False)
+    lf, lq = np.asarray(lf, np.float32), np.asarray(lq, np.float32)
+    rel = np.abs(lf - lq).max() / (np.abs(lf).max() + 1e-6)
+    assert rel < 0.1, rel
+    # ranking mostly preserved at the last position
+    agree = (lf[:, -1].argmax(-1) == lq[:, -1].argmax(-1)).mean()
+    assert agree >= 0.5
+
+
+def test_engine_serves_quantized(params):
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    qp = quantize_params(params)
+    eng = InferenceEngine(
+        qp, CFG,
+        EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4),
+    )
+    out = eng.run_to_completion(
+        [
+            Request(id="q0", prompt=[1, 2, 3], sampling=SamplingParams(max_new_tokens=8)),
+            Request(id="q1", prompt=[9, 8, 7, 6], sampling=SamplingParams(max_new_tokens=8)),
+        ]
+    )
+    assert all(len(v) == 8 for v in out.values())
+    # deterministic greedy decode
+    out2 = eng.run_to_completion(
+        [Request(id="q2", prompt=[1, 2, 3], sampling=SamplingParams(max_new_tokens=8))]
+    )
+    assert out2["q2"] == out["q0"]
+
+
+def test_tp_shards_quantized_params(params):
+    """TP=2 over virtual devices: QuantW leaves shard (q full spec, scale on
+    the output axis) and the sharded forward runs."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    from agentfield_tpu.parallel.mesh import AXIS_MODEL, make_mesh, use_mesh
+    from agentfield_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh({AXIS_MODEL: 2})
+    qp = shard_params(quantize_params(params), CFG, mesh)
+    toks = jnp.ones((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8), (1, 8))
+    with use_mesh(mesh):
+        logits, _ = llama.forward(qp, CFG, toks, pos, collect_kv=False)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_build_model_node_quant_knob(params):
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    async def main():
+        agent, backend = build_model_node(
+            "model-q", model="llama-tiny",
+            ecfg=EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4),
+            quant="int8",
+        )
+        assert is_quantized(backend.engine.params)
+        await backend.start()
+        try:
+            r = await backend.generate(prompt="hi", max_new_tokens=4)
+            assert len(r["tokens"]) == 4
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+    with pytest.raises(ValueError, match="quant mode"):
+        build_model_node("model-q2", model="llama-tiny", quant="fp4")

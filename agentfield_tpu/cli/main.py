@@ -176,6 +176,8 @@ def cmd_model(cfg: Config, args) -> int:
             audio=mn.audio,
             tts=mn.tts,
             quant=mn.quant,
+            spec_draft=mn.spec_draft,
+            spec_k=mn.spec_k or None,
         )
         await backend.start()
         await agent.start()

@@ -68,6 +68,9 @@ class ModelNodeConfig:
     audio: str | None = None  # audio tower config name → serve audio inputs
     tts: str | None = None  # TTS head config name → serve audio OUTPUT
     quant: str | None = None  # "int8" weight-only quantized serving
+    spec_draft: str | None = None  # draft preset/checkpoint for speculative
+    # decoding (with spec_k > 0)
+    spec_k: int = 0  # speculative proposals per decode step (0 disables)
     tp: int = 1  # tensor-parallel degree over the `model` mesh axis
 
 

@@ -62,6 +62,32 @@ class LlamaConfig:
 
 PRESETS: dict[str, LlamaConfig] = {
     # Tiny config for unit tests — MXU-aligned dims, trivially fast on CPU.
+    # hermetic speculative-decoding draft: llama-tiny's vocab, quarter the
+    # width — pairs with llama-tiny in engine tests (spec_k / draft-verify)
+    "llama-nano": LlamaConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=1,
+        head_dim=32,
+        max_seq_len=256,
+    ),
+    # draft-scale model sharing the Llama-3 vocabulary: the speculative
+    # decoding companion for the 1B/8B targets (random-init until a trained
+    # draft checkpoint is pointed at via spec_draft=<dir>)
+    "llama-3.2-draft": LlamaConfig(
+        vocab_size=128256,
+        hidden_size=512,
+        intermediate_size=2048,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=2,
+        head_dim=64,
+        tie_embeddings=True,
+        max_seq_len=8192,
+    ),
     "llama-tiny": LlamaConfig(
         vocab_size=512,
         hidden_size=128,

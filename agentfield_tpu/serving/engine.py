@@ -119,6 +119,14 @@ class EngineConfig:
     # host's device→host round trip (token events arrive one tick later;
     # greedy streams are bit-identical either way). False restores the
     # dispatch-and-wait scheduler.
+    spec_k: int = 0  # speculative decoding: draft proposals per step (0
+    # disables). Requires a draft model (InferenceEngine(draft=...)). Each
+    # eligible step a small draft model proposes spec_k greedy tokens and the
+    # target verifies them in ONE (spec_k+1)-wide forward — accepted-prefix +
+    # correction emits 1..spec_k+1 tokens per target pass (classic
+    # draft-verify; exact greedy equivalence). Eligibility is per dispatch:
+    # every active row greedy (temperature 0) and unconstrained; mixed
+    # batches fall back to normal decode for that step.
     dtype: str | None = None
 
     @property
@@ -174,6 +182,11 @@ class _Slot:
     last_token: int
     tokens: list[int] = dataclasses.field(default_factory=list)  # full history
     # (prompt + generated) — retained for session prefix caching
+    draft_len: int = 0  # speculative decoding: the length through which the
+    # DRAFT cache is synced (normal-decode fallback steps advance the target
+    # only; before the next spec step the gap replays through the draft —
+    # without this, a single sampled request joining the batch would
+    # permanently collapse the acceptance rate)
 
 
 @dataclasses.dataclass
@@ -296,6 +309,151 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
         return toks, lps, seq_lens, gstates, tokens, kp, vp
 
     return jax.jit(decode, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
+    """Jitted speculative decode step (greedy): the DRAFT model proposes
+    ``spec_k`` tokens autoregressively, the TARGET verifies them in one
+    (spec_k+1)-wide batched chunk forward over the paged cache, and the
+    longest target-agreeing prefix plus the target's own next token are
+    emitted — 1..spec_k+1 tokens per target pass, bit-identical to plain
+    greedy decode (rejected-position KV is garbage beyond the advanced
+    length and is overwritten before it ever becomes attendable).
+
+    Both models share the page TABLES and lengths; the draft keeps its own
+    page pool (same page ids — one allocator governs both). The draft runs
+    spec_k+1 steps so its cache also holds the last proposal's KV when
+    everything is accepted."""
+    k = ecfg.spec_k
+    W = k + 1  # verify width
+    ps = ecfg.page_size
+    maxp = ecfg.max_pages_per_seq
+    T = maxp * ps
+
+    def draft_step(dparams, kp, vp, tokens, seq_lens, page_tables):
+        """One greedy draft step (one_step minus sampling/grammar)."""
+        B = tokens.shape[0]
+        x = jnp.take(dparams["embed"], tokens, axis=0)[:, None, :]
+        cos, sin = llama.rope_sincos(
+            seq_lens[:, None], dcfg.head_dim, dcfg.rope_theta, dcfg.rope_scaling
+        )
+        lookup = seq_lens // ps
+        in_table = lookup < page_tables.shape[1]
+        page_idx = jnp.take_along_axis(
+            page_tables, jnp.minimum(lookup, page_tables.shape[1] - 1)[:, None], axis=1
+        )[:, 0]
+        page_idx = jnp.where(in_table, page_idx, 0)
+        slot_idx = seq_lens % ps
+
+        def body(x, xs):
+            lp, kp, vp = xs
+            h = llama.rms_norm(x, lp["attn_norm"], dcfg.rms_norm_eps)
+            q, kk, vv = llama.qkv_proj(lp, h, dcfg, cos, sin)
+            kp, vp = kv_write(
+                kp, vp, kk[:, 0], vv[:, 0], page_idx, slot_idx,
+                impl=ecfg.kv_write_impl, mesh=mesh,
+            )
+            attn = paged_attention(
+                q[:, 0], kp, vp, page_tables, seq_lens + 1,
+                impl=ecfg.attn_impl, mesh=mesh,
+            )
+            x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
+            x = x + llama.mlp_block(lp, x, dcfg)
+            return x, (kp, vp)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (dparams["layers"], kp, vp))
+        logits = llama.unembed(dparams, dcfg, x)[:, 0]
+        nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
+        return nt, new_lens, kp, vp
+
+    def verify(params, k_pages, v_pages, x_tokens, seq_lens, page_tables):
+        """Target forward over W positions per row (batched ragged chunk:
+        every row at its own start position), writing KV for all W and
+        returning [B, W, V] logits."""
+        B = x_tokens.shape[0]
+        active = seq_lens > 0
+        positions = seq_lens[:, None] + jnp.arange(W, dtype=seq_lens.dtype)  # [B, W]
+        x = jnp.take(params["embed"], x_tokens, axis=0)  # [B, W, D]
+        cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        lookup = positions // ps
+        in_table = (lookup < maxp) & active[:, None]
+        page_ids = jnp.where(
+            in_table,
+            jnp.take_along_axis(page_tables, jnp.minimum(lookup, maxp - 1), axis=1),
+            0,
+        )  # [B, W] (garbage page 0 for inactive/over-budget writes)
+        slot_ids = positions % ps
+        k_pos = jnp.arange(T, dtype=jnp.int32)[None]  # [1, T]
+        k_valid = (k_pos < (seq_lens + W)[:, None]) & active[:, None]
+
+        def body(x, xs):
+            lp, kp, vp = xs
+            h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, kk, vv = llama.qkv_proj(lp, h, cfg, cos, sin)
+            # scatter W new K/V per row: kp[page_ids[b,i], :, slot_ids[b,i]]
+            # — non-adjacent advanced indices put [B, W] first: [B, W, Kh, hd]
+            kp = kp.at[page_ids, :, slot_ids].set(kk)
+            vp = vp.at[page_ids, :, slot_ids].set(vv)
+            # gather each row's pages → [B, T, Kh, hd] context (ref path; a
+            # batched Pallas chunk kernel is the TPU follow-up)
+            ctx_k = kp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            ctx_v = vp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            attn = llama.attention_ref(
+                q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid
+            )
+            x = x + (attn.reshape(B, W, -1) @ lp["wo"]).astype(x.dtype)
+            x = x + llama.mlp_block(lp, x, cfg)
+            return x, (kp, vp)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+        return llama.unembed(params, cfg, x), kp, vp  # [B, W, V]
+
+    def spec(
+        params, k_pages, v_pages, dparams, dk_pages, dv_pages,
+        tokens, seq_lens, page_tables,
+    ):
+        B = tokens.shape[0]
+        active = seq_lens > 0
+
+        def dbody(carry, _):
+            toks, lens, kp, vp = carry
+            nt, lens, kp, vp = draft_step(dparams, kp, vp, toks, lens, page_tables)
+            return (nt, lens, kp, vp), nt
+
+        # k+1 draft steps: proposals d_1..d_k plus one extra step that writes
+        # d_k's KV into the draft cache (needed when all k are accepted).
+        (_, _, dk_pages, dv_pages), drafts = jax.lax.scan(
+            dbody, (tokens, seq_lens, dk_pages, dv_pages), None, length=k + 1
+        )
+        dmat = jnp.swapaxes(drafts[:k], 0, 1)  # [B, k] = d_1..d_k
+        x_tokens = jnp.concatenate([tokens[:, None], dmat], axis=1)  # [B, W]
+        logits, k_pages, v_pages = verify(
+            params, k_pages, v_pages, x_tokens, seq_lens, page_tables
+        )
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
+        match = dmat == g[:, :k]  # d_{i+1} vs g_i
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [B] 0..k
+        g_m = jnp.take_along_axis(g, m[:, None], axis=1)  # [B, 1] correction
+        t_idx = jnp.arange(W, dtype=jnp.int32)[None]  # [1, W]
+        dmat_pad = jnp.concatenate([dmat, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        emitted = jnp.where(t_idx < m[:, None], dmat_pad, g_m)  # [B, W]
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        lps = jnp.take_along_axis(lsm, emitted[:, :, None], axis=2)[:, :, 0]
+        counts = jnp.where(active, m + 1, 0)
+        new_seq_lens = seq_lens + counts.astype(seq_lens.dtype)
+        next_tokens = jnp.where(active, g_m[:, 0], tokens)
+        return (
+            jnp.swapaxes(emitted, 0, 1),  # [W, B] harvest shape
+            jnp.swapaxes(lps, 0, 1),
+            counts,
+            new_seq_lens,
+            next_tokens,
+            k_pages, v_pages, dk_pages, dv_pages,
+        )
+
+    return jax.jit(spec, donate_argnums=(1, 2, 4, 5))
 
 
 @functools.lru_cache(maxsize=None)
@@ -466,6 +624,9 @@ class InferenceEngine:
         ecfg: EngineConfig | None = None,
         seed: int = 0,
         mesh=None,
+        draft: tuple[Any, LlamaConfig] | None = None,  # (params, cfg) of the
+        # speculative-decoding draft model (required when ecfg.spec_k > 0;
+        # must share the target's vocabulary)
     ):
         """With `mesh`, the engine runs tensor-parallel: params shard per the
         Megatron-style PartitionSpecs (parallel/sharding.py), KV pages over
@@ -548,6 +709,41 @@ class InferenceEngine:
         self.cache = PagedKVCache.create(
             cfg, self.ecfg.num_pages, self.ecfg.page_size, cache_dtype, mesh=mesh
         )
+        # Speculative decoding: the draft model mirrors the target's page
+        # TABLE (one allocator governs both) with its own page pool sized by
+        # the draft config. Prefills replay onto the draft cache so proposals
+        # see the full context.
+        self.draft_params = self.draft_cfg = self.draft_cache = None
+        if self.ecfg.spec_k > 0:
+            if draft is None:
+                raise ValueError(
+                    f"spec_k={self.ecfg.spec_k} needs a draft model: "
+                    "InferenceEngine(draft=(params, cfg))"
+                )
+            self.draft_params, self.draft_cfg = draft
+            if self.draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self.draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size} (speculation compares token ids)"
+                )
+            if mesh is not None:
+                from agentfield_tpu.parallel.mesh import AXIS_MODEL as _AM
+                from agentfield_tpu.parallel.sharding import (
+                    check_divisibility as _chk,
+                    shard_params as _shard,
+                )
+
+                dtp = mesh.shape.get(_AM, 1)
+                if dtp > 1:
+                    # The draft runs under the same mesh: its dims (incl. KV
+                    # heads — the draft cache shards over them) must divide
+                    # too, and its params shard like the target's.
+                    _chk(self.draft_cfg, dtp, paged_kv=True)
+                    self.draft_params = _shard(self.draft_params, self.draft_cfg, mesh)
+            self.draft_cache = PagedKVCache.create(
+                self.draft_cfg, self.ecfg.num_pages, self.ecfg.page_size,
+                cache_dtype, mesh=mesh,
+            )
         self.allocator = PageAllocator(self.ecfg.num_pages)
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
         self.page_tables = np.zeros((B, maxp), np.int32)
@@ -620,6 +816,9 @@ class InferenceEngine:
             "admission_reorders": 0,
             "grammar_evictions": 0,
             "grammar_capacity_errors": 0,
+            "spec_steps": 0,  # speculative dispatches
+            "spec_emitted": 0,  # tokens emitted by them (rate = emitted /
+            # (steps * (spec_k+1)))
         }
         # Consecutive ticks the queue head has been page-starved while later
         # requests admitted (see _try_admit's fairness fence).
@@ -1031,6 +1230,10 @@ class InferenceEngine:
             jnp.asarray(lengths),
             jnp.asarray(rows),
         )
+        self._draft_replay(
+            _batch_prefill_fn, bucket,
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(rows),
+        )
         masks = None
         for j, (req, _, _) in enumerate(batch):
             m = self._first_token_mask(req)
@@ -1136,6 +1339,7 @@ class InferenceEngine:
             generated=1,
             last_token=tok,
             tokens=list(req.prompt) + [tok],
+            draft_len=len(req.prompt),  # prefill replays onto the draft cache
         )
         event = self._emit(slot_idx, slot, tok, logprob)
         if not event.finished:
@@ -1188,6 +1392,10 @@ class InferenceEngine:
                     jnp.int32(len(piece)),
                     jnp.asarray(row),
                 )
+                self._draft_replay(
+                    _prefill_fn, bucket,
+                    jnp.asarray(padded), jnp.int32(len(piece)), jnp.asarray(row),
+                )
             else:
                 fn = _suffix_prefill_fn(self.cfg, self.ecfg, bucket)
                 last_logits, self.cache.k_pages, self.cache.v_pages = fn(
@@ -1198,6 +1406,12 @@ class InferenceEngine:
                     jnp.int32(piece_start),
                     jnp.int32(len(piece)),
                     jnp.asarray(row),
+                )
+                self._draft_replay(
+                    _suffix_prefill_fn, bucket,
+                    jnp.asarray(padded), jnp.int32(piece_start),
+                    jnp.int32(len(piece)), jnp.asarray(row),
+                    with_mesh=False,
                 )
         return last_logits
 
@@ -1223,6 +1437,14 @@ class InferenceEngine:
             jnp.asarray(mask),
             jnp.int32(len(tokens)),
             jnp.asarray(row),
+        )
+        # The draft has no projector for the target's media embeddings; it
+        # prefills the placeholder token ids instead. Verification keeps
+        # correctness — a context-blind draft only lowers the acceptance
+        # rate on multimodal rows.
+        self._draft_replay(
+            _prefill_fn, bucket,
+            jnp.asarray(padded), jnp.int32(len(tokens)), jnp.asarray(row),
         )
         return last
 
@@ -1375,23 +1597,116 @@ class InferenceEngine:
             events += self._harvest_inflight()
         return events
 
+    def _spec_eligible(self, active_idx: list[int]) -> bool:
+        """Speculation requires every active row greedy and unconstrained
+        (verification compares greedy argmax; grammar masks would make draft
+        proposals unsampleable mid-schema). Checked per dispatch — mixed
+        batches take the normal decode path for that step."""
+        if self.draft_cache is None or not active_idx:
+            return False
+        idx = np.asarray(active_idx)
+        if (self.temps[idx] > 0).any() or (self.grammar_states[idx] != 0).any():
+            return False
+        return not any(self.slots[i].req.grammar is not None for i in active_idx)
+
     def _dispatch_decode(self) -> None:
         """Dispatch one decode step (no host sync) and record it in-flight."""
         active_idx = [i for i, s in enumerate(self.slots) if s is not None]
-        bucket = self._pick_decode_bucket(len(active_idx))
-        if bucket is not None:
-            toks, lps = self._decode_compact_dispatch(active_idx, bucket)
-            compact = True
+        counts = None
+        if self._spec_eligible(active_idx):
+            toks, lps, counts, compact = self._decode_spec_dispatch(active_idx)
+            self.stats["decode_steps"] += 1
+            self.stats["spec_steps"] += 1
         else:
-            toks, lps = self._decode_full_dispatch()
-            compact = False
-        self.stats["decode_steps"] += max(1, self.ecfg.decode_span)
+            bucket = self._pick_decode_bucket(len(active_idx))
+            if bucket is not None:
+                toks, lps = self._decode_compact_dispatch(active_idx, bucket)
+                compact = True
+            else:
+                toks, lps = self._decode_full_dispatch()
+                compact = False
+            self.stats["decode_steps"] += max(1, self.ecfg.decode_span)
         self._inflight = {
             "tokens": toks,
             "logprobs": lps,
+            "counts": counts,
             "slots": [(i, self.slots[i]) for i in active_idx],
             "compact": compact,
         }
+
+    def _draft_replay(self, fn_factory, bucket: int, *call_args, with_mesh=True) -> None:
+        """Replay a prefill onto the DRAFT cache (logits discarded) so
+        speculative proposals see the same context as the target. No-op
+        without a draft model."""
+        if self.draft_cache is None:
+            return
+        fn = (
+            fn_factory(self.draft_cfg, self.ecfg, bucket, self.mesh)
+            if with_mesh
+            else fn_factory(self.draft_cfg, self.ecfg, bucket)
+        )
+        _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
+            self.draft_params,
+            self.draft_cache.k_pages,
+            self.draft_cache.v_pages,
+            *call_args,
+        )
+
+    def _resync_draft(self, active_idx: list[int]) -> None:
+        """Replay any tokens the draft cache missed (normal-decode fallback
+        steps advance the target only) through a draft suffix prefill, so
+        speculation resumes with full-context proposals instead of silently
+        collapsing to ~zero acceptance."""
+        for i in active_idx:
+            slot = self.slots[i]
+            if slot.draft_len >= slot.length:
+                continue
+            missing = slot.tokens[slot.draft_len : slot.length]  # tokens IS
+            # the full prompt+generated history; positions index it directly
+            bucket = self.ecfg.prefill_bucket(len(missing))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(missing)] = np.asarray(missing, np.int32)
+            self._draft_replay(
+                _suffix_prefill_fn, bucket,
+                jnp.asarray(padded), jnp.int32(slot.draft_len),
+                jnp.int32(len(missing)), jnp.asarray(self.page_tables[i]),
+                with_mesh=False,
+            )
+            slot.draft_len = slot.length
+
+    def _decode_spec_dispatch(
+        self, active_idx: list[int]
+    ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
+        """Speculative step: draft proposes, target verifies
+        (engine._spec_decode_fn). Chains device control state exactly like
+        the normal dispatches — lengths advance on-device by each row's
+        accepted count. Low occupancy takes the compact (bucketed) control
+        state so the W-wide verify doesn't pay max_batch width."""
+        self._resync_draft(active_idx)
+        bucket = self._pick_decode_bucket(len(active_idx))
+        if bucket is not None:
+            c = self._compact_state(active_idx, bucket)
+            self._dirty = True  # full-width device state is now stale
+        else:
+            c = self._dev_state()
+        fn = _spec_decode_fn(self.cfg, self.draft_cfg, self.ecfg, self.mesh)
+        (
+            toks, lps, counts, new_seq_lens, next_toks,
+            self.cache.k_pages, self.cache.v_pages,
+            self.draft_cache.k_pages, self.draft_cache.v_pages,
+        ) = fn(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.draft_params,
+            self.draft_cache.k_pages,
+            self.draft_cache.v_pages,
+            c["tokens"],
+            c["seq_lens"],
+            c["page_tables"],
+        )
+        c["tokens"], c["seq_lens"] = next_toks, new_seq_lens
+        return toks, lps, counts, bucket is not None
 
     def _harvest_inflight(self) -> list[TokenEvent]:
         prev, self._inflight = self._inflight, None
@@ -1406,14 +1721,23 @@ class InferenceEngine:
             return []
         toks = np.asarray(inf["tokens"])  # [span, B]
         lps = np.asarray(inf["logprobs"])
+        # Speculative steps emit a VARIABLE number of tokens per row (the
+        # accepted prefix + correction); counts[row] gates the span loop.
+        counts = np.asarray(inf["counts"]) if inf.get("counts") is not None else None
         out: list[TokenEvent] = []
         for t in range(toks.shape[0]):
             for j, (i, slot) in enumerate(inf["slots"]):
                 if self.slots[i] is not slot:
                     continue  # finished/cancelled: discard its later span tokens
                 row = j if inf["compact"] else i
+                if counts is not None:
+                    if t >= counts[row]:
+                        continue
+                    self.stats["spec_emitted"] += 1
                 tok, logprob = int(toks[t, row]), float(lps[t, row])
                 slot.length += 1
+                if counts is not None:
+                    slot.draft_len = slot.length  # spec steps write BOTH caches
                 slot.generated += 1
                 slot.last_token = tok
                 slot.tokens.append(tok)
@@ -1437,7 +1761,9 @@ class InferenceEngine:
                 return b
         return None
 
-    def _decode_full_dispatch(self) -> tuple[jax.Array, jax.Array]:
+    def _dev_state(self) -> dict[str, jax.Array]:
+        """Full-width device control state, rebuilt from the host shadows
+        when dirty (shared by the normal and speculative full dispatches)."""
         if self._dirty:
             self._dev = {
                 "tokens": jnp.asarray(self.last_tokens),
@@ -1450,7 +1776,10 @@ class InferenceEngine:
                 "eos_ids": jnp.asarray(self.eos_ids),
             }
             self._dirty = False
-        d = self._dev
+        return self._dev
+
+    def _decode_full_dispatch(self) -> tuple[jax.Array, jax.Array]:
+        d = self._dev_state()
         bank = self._gbank_device()
         toks, lps, new_seq_lens, new_gstates, last_toks, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(
@@ -1473,15 +1802,11 @@ class InferenceEngine:
         d["tokens"], d["seq_lens"], d["gstates"] = last_toks, new_seq_lens, new_gstates
         return toks, lps
 
-    def _decode_compact_dispatch(
-        self, active_idx: list[int], bucket: int
-    ) -> tuple[jax.Array, jax.Array]:
-        """Low-occupancy step: gather the active slots' control rows into a
-        [bucket]-wide batch (padding rows are inert: seq_len 0 writes to the
-        garbage page). The jitted decode retraces once per bucket width.
-        While membership is stable the compact control state stays
-        device-resident (tokens/seq_lens advance on-device via the decode
-        return); admission/release invalidates it."""
+    def _compact_state(self, active_idx: list[int], bucket: int) -> dict:
+        """Bucketed device control state: the active slots' rows gathered
+        into a [bucket]-wide batch (padding rows are inert: seq_len 0 writes
+        to the garbage page). Cached while membership is stable; shared by
+        the normal and speculative compact dispatches."""
         key = (tuple(active_idx), bucket)
         c = self._compact
         if c is None or c["key"] != key:
@@ -1513,7 +1838,18 @@ class InferenceEngine:
                 "gstates": jnp.asarray(gstates),
                 "eos_ids": jnp.asarray(eos_ids),
             }
+        return c
 
+    def _decode_compact_dispatch(
+        self, active_idx: list[int], bucket: int
+    ) -> tuple[jax.Array, jax.Array]:
+        """Low-occupancy step: gather the active slots' control rows into a
+        [bucket]-wide batch (padding rows are inert: seq_len 0 writes to the
+        garbage page). The jitted decode retraces once per bucket width.
+        While membership is stable the compact control state stays
+        device-resident (tokens/seq_lens advance on-device via the decode
+        return); admission/release invalidates it."""
+        c = self._compact_state(active_idx, bucket)
         bank = self._gbank_device()
         toks, lps, new_seq_lens, new_gstates, last_toks, self.cache.k_pages, self.cache.v_pages = (
             self._decode_jit(

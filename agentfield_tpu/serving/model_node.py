@@ -148,11 +148,15 @@ class ModelBackend:
         # (AudioConfig, params) — serve <audio> prompt parts (models/audio.py)
         tts=None,  # audio OUTPUT head: config name, TTSConfig, or
         # (TTSConfig, params) — serve output="audio"/"speech" synthesis
+        draft=None,  # (params, cfg) speculative-decoding draft model
+        # (with ecfg.spec_k > 0; see InferenceEngine)
     ):
         self.grammar_whitespace = grammar_whitespace
         self.cfg = cfg
         self.model_name = model_name
-        self.engine = InferenceEngine(params, cfg, ecfg, seed=seed, mesh=mesh)
+        self.engine = InferenceEngine(
+            params, cfg, ecfg, seed=seed, mesh=mesh, draft=draft
+        )
         self.tokenizer = tokenizer
         self.vision_cfg = self.vision_params = None
         if vision is not None:
@@ -864,6 +868,9 @@ def build_model_node(
     tts=None,  # audio output head (ModelBackend tts contract)
     quant: str | None = None,  # "int8" → weight-only quantized serving
     # (models/quant.py): halves decode-step HBM weight traffic
+    spec_draft: str | None = None,  # draft model preset for speculative
+    # decoding (requires ecfg.spec_k > 0 or spec_k below)
+    spec_k: int | None = None,  # proposals per step; sets ecfg.spec_k
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -897,6 +904,29 @@ def build_model_node(
         # 256 int16 bank rows (~66 MB at a 128k vocab) cover several live
         # schemas; idle ones evict LRU under pressure.
         ecfg = EngineConfig(grammar_slots=256)
+    draft = None
+    if spec_k is not None:
+        import dataclasses as _dc
+
+        ecfg = _dc.replace(ecfg, spec_k=spec_k)
+    if ecfg.spec_k > 0:
+        if spec_draft is None:
+            raise ValueError("spec_k > 0 needs spec_draft=<model preset>")
+        import os as _os
+
+        if _os.path.isdir(spec_draft):  # trained draft from a HF checkpoint
+            from agentfield_tpu.models.hf_loader import load_hf_checkpoint
+
+            dcfg, dparams = load_hf_checkpoint(spec_draft)
+        else:  # named preset, random init (demo/tests)
+            dcfg = get_config(spec_draft)
+            dparams = init_params(dcfg, jax.random.PRNGKey(seed + 4))
+        if dcfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"spec_draft {spec_draft!r} vocab {dcfg.vocab_size} != "
+                f"target vocab {cfg.vocab_size}"
+            )
+        draft = (dparams, dcfg)
     mesh = None
     if tp > 1:
         from agentfield_tpu.parallel.mesh import AXIS_MODEL, make_mesh
@@ -905,7 +935,7 @@ def build_model_node(
     backend = ModelBackend(
         params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model,
         mesh=mesh, vision=vision, grammar_whitespace=grammar_whitespace,
-        audio=audio, tts=tts,
+        audio=audio, tts=tts, draft=draft,
     )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}

@@ -121,6 +121,29 @@ class HFTokenizer:
         return out
 
 
+def load_draft_model(source: str, target_vocab: int, seed: int = 0):
+    """Resolve a speculative-decoding draft: an HF checkpoint dir loads
+    trained weights, a preset name random-inits (demo/tests — worst-case
+    acceptance against an unrelated target). Returns the (params, cfg) pair
+    InferenceEngine(draft=...) takes; rejects vocabulary mismatches up front
+    (speculation compares token ids)."""
+    import os as _os
+
+    if _os.path.isdir(source):
+        from agentfield_tpu.models.hf_loader import load_hf_checkpoint
+
+        dcfg, dparams = load_hf_checkpoint(source)
+    else:
+        dcfg = get_config(source)
+        dparams = init_params(dcfg, jax.random.PRNGKey(seed))
+    if dcfg.vocab_size != target_vocab:
+        raise ValueError(
+            f"spec draft {source!r} vocab {dcfg.vocab_size} != "
+            f"target vocab {target_vocab}"
+        )
+    return dparams, dcfg
+
+
 def _error_event(rid: str, error: str):
     from agentfield_tpu.serving.engine import TokenEvent
 
@@ -912,21 +935,7 @@ def build_model_node(
     if ecfg.spec_k > 0:
         if spec_draft is None:
             raise ValueError("spec_k > 0 needs spec_draft=<model preset>")
-        import os as _os
-
-        if _os.path.isdir(spec_draft):  # trained draft from a HF checkpoint
-            from agentfield_tpu.models.hf_loader import load_hf_checkpoint
-
-            dcfg, dparams = load_hf_checkpoint(spec_draft)
-        else:  # named preset, random init (demo/tests)
-            dcfg = get_config(spec_draft)
-            dparams = init_params(dcfg, jax.random.PRNGKey(seed + 4))
-        if dcfg.vocab_size != cfg.vocab_size:
-            raise ValueError(
-                f"spec_draft {spec_draft!r} vocab {dcfg.vocab_size} != "
-                f"target vocab {cfg.vocab_size}"
-            )
-        draft = (dparams, dcfg)
+        draft = load_draft_model(spec_draft, cfg.vocab_size, seed=seed + 4)
     mesh = None
     if tp > 1:
         from agentfield_tpu.parallel.mesh import AXIS_MODEL, make_mesh

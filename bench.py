@@ -31,7 +31,10 @@ claim-holding children):
 Env knobs: AGENTFIELD_BENCH_CPU=1 (debug on CPU), AGENTFIELD_BENCH_MODEL,
 AGENTFIELD_BENCH_REQUESTS, AGENTFIELD_BENCH_BATCH,
 AGENTFIELD_BENCH_ATTN=auto|ref|pallas, AGENTFIELD_BENCH_WATCHDOG (s),
-AGENTFIELD_BENCH_SKIP_PROBE=1 (operator knows the chip is healthy).
+AGENTFIELD_BENCH_SKIP_PROBE=1 (operator knows the chip is healthy),
+AGENTFIELD_BENCH_QUANT=int8 (weight-only quantized serving),
+AGENTFIELD_BENCH_SPEC=<draft preset|checkpoint> + AGENTFIELD_BENCH_SPEC_K
+(speculative decoding).
 """
 
 from __future__ import annotations
@@ -313,7 +316,20 @@ def _run_bench() -> None:
     span = int(os.environ.get("AGENTFIELD_BENCH_SPAN", "16" if on_tpu else "1"))
     prompt_len, new_tokens = 128, 128
 
-    def make_engine(cfg, params, attn_impl, batch):
+    # Speculative decoding: AGENTFIELD_BENCH_SPEC=<draft preset or checkpoint
+    # dir> + AGENTFIELD_BENCH_SPEC_K (default 4). Greedy-equivalent; the win
+    # is tokens-per-target-pass (and per tunnel round-trip). NOTE: a preset
+    # name random-inits the draft — worst-case acceptance against an
+    # unrelated random target; point at a trained draft checkpoint (or the
+    # target's own checkpoint for a self-draft upper bound) for meaningful
+    # spec_tokens_per_step numbers. Loaded ONCE here — engines share it.
+    spec_draft = os.environ.get("AGENTFIELD_BENCH_SPEC")
+    spec_k = int(os.environ.get("AGENTFIELD_BENCH_SPEC_K", "4")) if spec_draft else 0
+    draft_model = None  # loaded once at model init (needs cfg.vocab_size);
+    # the closure below picks up the rebound local
+
+    def make_engine(cfg, params, attn_impl, batch, spec=False):
+        use_spec = spec_k if spec else 0
         ecfg = EngineConfig(
             max_batch=batch,
             page_size=32,
@@ -323,8 +339,10 @@ def _run_bench() -> None:
             attn_impl="pallas" if attn_impl == "pallas" else "ref",
             prefill_impl="flash" if attn_impl == "pallas" else "ref",
             decode_span=span,
+            spec_k=use_spec,
         )
-        return InferenceEngine(params, cfg, ecfg), ecfg
+        draft = draft_model if use_spec else None
+        return InferenceEngine(params, cfg, ecfg, draft=draft), ecfg
 
     def make_reqs(cfg, prefix: str, n: int, p_len: int = prompt_len, new_toks: int = None):
         key = jax.random.PRNGKey(1)
@@ -384,6 +402,11 @@ def _run_bench() -> None:
         from agentfield_tpu.models.quant import quantize_params
 
         params = quantize_params(params)
+    if spec_k:
+        from agentfield_tpu.serving.model_node import load_draft_model
+
+        _partial["stage"] = "load draft"
+        draft_model = load_draft_model(spec_draft, cfg.vocab_size, seed=3)
     demoted = None
     if attn == "pallas":
         if not _budget_gate("correctness gate (pallas vs ref numerics)", 180):
@@ -452,7 +475,7 @@ def _run_bench() -> None:
         _emit(_fallback_payload("budget exhausted before engine warmup"))
         _done.set()
         return
-    warm, ecfg = make_engine(cfg, params, attn, max_batch)
+    warm, ecfg = make_engine(cfg, params, attn, max_batch, spec=True)
     for _ in warm.run_to_completion(make_reqs(cfg, "w", 2)):
         pass
 
@@ -463,7 +486,7 @@ def _run_bench() -> None:
         return
     ttfts = []
     for i in range(3):
-        e, _ = make_engine(cfg, params, attn, max_batch)
+        e, _ = make_engine(cfg, params, attn, max_batch, spec=True)
         [req] = make_reqs(cfg, f"t{i}", 1)
         t0 = time.perf_counter()
         e.submit(req)
@@ -481,7 +504,7 @@ def _run_bench() -> None:
     if _remaining() < 240 and n_requests > 64:
         _partial["burst_shrunk_from"] = n_requests
         n_requests = 64
-    engine, _ = make_engine(cfg, params, attn, max_batch)
+    engine, _ = make_engine(cfg, params, attn, max_batch, spec=True)
     reqs = make_reqs(cfg, "r", n_requests)
     first_token_ms: dict[str, float] = {}
     t0 = time.perf_counter()
@@ -524,6 +547,13 @@ def _run_bench() -> None:
             "fallback_tiny_tok_s": _partial.get("fallback", {}).get("value"),
             "max_batch": max_batch,
             "quant": quant,
+            "spec_draft": spec_draft,
+            "spec_k": spec_k or None,
+            "spec_tokens_per_step": (
+                round(engine.stats["spec_emitted"] / engine.stats["spec_steps"], 2)
+                if engine.stats["spec_steps"]
+                else None
+            ),
             "device": str(jax.devices()[0]),
         }
     )

@@ -38,6 +38,13 @@ class LlamaConfig:
     max_seq_len: int = 8192
     tie_embeddings: bool = False
     attn_bias: bool = False  # Qwen2-style QKV projection biases
+    mlp_act: str = "silu"  # gate activation: "silu" (llama) | "gelu"
+    # (gemma's gelu_pytorch_tanh)
+    norm_offset: bool = False  # gemma RMSNorm computes x*(1+w). Convention:
+    # params store RUNTIME weights (hf_loader adds the 1.0 at load), so the
+    # forward stays one code path
+    scale_embeddings: bool = False  # gemma multiplies token embeddings by
+    # sqrt(hidden_size) after lookup (unembed uses the RAW tied table)
     # dtype name, resolved lazily so configs stay hashable / serializable
     dtype: str = "bfloat16"
 
@@ -152,6 +159,56 @@ PRESETS: dict[str, LlamaConfig] = {
         head_dim=128,
         rope_theta=10000.0,
         max_seq_len=32768,
+    ),
+    # Gemma (v1): GeGLU MLP, RMSNorm x*(1+w), sqrt(d)-scaled embeddings,
+    # MQA (2B) / MHA (7B), 256-wide heads, tied embeddings.
+    "gemma-2b": LlamaConfig(
+        vocab_size=256000,
+        hidden_size=2048,
+        intermediate_size=16384,
+        num_layers=18,
+        num_heads=8,
+        num_kv_heads=1,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        max_seq_len=8192,
+        tie_embeddings=True,
+        mlp_act="gelu",
+        norm_offset=True,
+        scale_embeddings=True,
+    ),
+    "gemma-7b": LlamaConfig(
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        max_seq_len=8192,
+        tie_embeddings=True,
+        mlp_act="gelu",
+        norm_offset=True,
+        scale_embeddings=True,
+    ),
+    # hermetic gemma-shaped test config (all three gemma behaviors on)
+    "gemma-tiny": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=1,
+        head_dim=32,
+        rms_norm_eps=1e-6,
+        max_seq_len=256,
+        tie_embeddings=True,
+        mlp_act="gelu",
+        norm_offset=True,
+        scale_embeddings=True,
     ),
     # Qwen2-7B: adds QKV projection biases (attn_bias).
     "qwen2-7b": LlamaConfig(

@@ -25,10 +25,12 @@ from agentfield_tpu.models.configs import LlamaConfig
 
 def config_from_hf(path: str | Path) -> LlamaConfig:
     doc = json.loads((Path(path) / "config.json").read_text())
-    if doc.get("model_type") not in ("llama", "mistral", "qwen2", None):
+    if doc.get("model_type") not in ("llama", "mistral", "qwen2", "gemma", None):
         raise ValueError(
-            f"unsupported model_type={doc.get('model_type')!r} (llama/mistral/qwen2)"
+            f"unsupported model_type={doc.get('model_type')!r} "
+            "(llama/mistral/qwen2/gemma)"
         )
+    gemma = doc.get("model_type") == "gemma"
     if doc.get("sliding_window") and doc.get("use_sliding_window", True):
         # (Qwen2 configs carry sliding_window but disable it via
         # use_sliding_window=false — full attention matches the reference.)
@@ -75,7 +77,26 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         attn_bias=doc.get("attention_bias", doc.get("model_type") == "qwen2"),
         rms_norm_eps=doc.get("rms_norm_eps", 1e-5),
         max_seq_len=doc.get("max_position_embeddings", 8192),
-        tie_embeddings=doc.get("tie_word_embeddings", False),
+        # HF GemmaConfig defaults tie_word_embeddings=True (often omitted)
+        tie_embeddings=doc.get("tie_word_embeddings", gemma),
+        # gemma family: GeGLU MLP, x*(1+w) norms, sqrt(d)-scaled embeddings
+        mlp_act=_mlp_act_from_hf(doc.get("hidden_act"), gemma),
+        norm_offset=gemma,
+        scale_embeddings=gemma,
+    )
+
+
+def _mlp_act_from_hf(hidden_act: str | None, gemma: bool) -> str:
+    """Exact activation mapping — a near-miss (quick_gelu, erf gelu) must
+    fail loudly, not silently compute a different function (same policy as
+    the rope_scaling check above)."""
+    if hidden_act in (None, "silu", "swish"):
+        return "gelu" if gemma else "silu"  # gemma's config default is GeGLU
+    if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh"):
+        return "gelu"  # jax.nn.gelu's default tanh approximation, exactly
+    raise ValueError(
+        f"unsupported hidden_act={hidden_act!r} (silu / gelu_pytorch_tanh); "
+        "loading would silently produce wrong logits"
     )
 
 
@@ -119,12 +140,18 @@ def load_hf_checkpoint(
             per_layer.append(t.T if transpose else t)
         return jnp.asarray(np.stack(per_layer)).astype(dt)
 
+    def stack_norm(fmt: str) -> jnp.ndarray:
+        w = stack(fmt, transpose=False)
+        # norm_offset checkpoints store w for x*(1+w); fold the 1.0 here so
+        # the runtime rms_norm stays one code path (models/llama.py).
+        return w + 1.0 if cfg.norm_offset else w
+
     p = "model.layers.{i}."
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("model.embed_tokens.weight")).astype(dt),
         "layers": {
-            "attn_norm": stack(p + "input_layernorm.weight", transpose=False),
-            "mlp_norm": stack(p + "post_attention_layernorm.weight", transpose=False),
+            "attn_norm": stack_norm(p + "input_layernorm.weight"),
+            "mlp_norm": stack_norm(p + "post_attention_layernorm.weight"),
             "wq": stack(p + "self_attn.q_proj.weight", transpose=True),
             "wk": stack(p + "self_attn.k_proj.weight", transpose=True),
             "wv": stack(p + "self_attn.v_proj.weight", transpose=True),
@@ -133,7 +160,11 @@ def load_hf_checkpoint(
             "w_up": stack(p + "mlp.up_proj.weight", transpose=True),
             "w_down": stack(p + "mlp.down_proj.weight", transpose=True),
         },
-        "final_norm": jnp.asarray(get("model.norm.weight")).astype(dt),
+        "final_norm": (
+            jnp.asarray(get("model.norm.weight")).astype(dt) + 1.0
+            if cfg.norm_offset
+            else jnp.asarray(get("model.norm.weight")).astype(dt)
+        ),
     }
     if cfg.attn_bias:
         params["layers"]["bq"] = stack(p + "self_attn.q_proj.bias", transpose=False)
@@ -150,10 +181,14 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
 
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
+    # Inverse of the load-time norm fold: norm_offset checkpoints store w
+    # for x*(1+w) while params hold the runtime weight (1+w).
+    noff = 1.0 if cfg.norm_offset else 0.0
     out: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
-        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32) - noff,
     }
+    norm_keys = {"attn_norm", "mlp_norm"}
     names = {
         "attn_norm": ("input_layernorm.weight", False),
         "mlp_norm": ("post_attention_layernorm.weight", False),
@@ -171,6 +206,8 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
         names["bv"] = ("self_attn.v_proj.bias", False)
     for ours, (theirs, transpose) in names.items():
         stacked = np.asarray(params["layers"][ours], np.float32)
+        if ours in norm_keys:
+            stacked = stacked - noff
         for i in range(cfg.num_layers):
             t = stacked[i].T if transpose else stacked[i]
             out[f"model.layers.{i}.{theirs}"] = np.ascontiguousarray(t)
@@ -180,7 +217,7 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
     (path / "config.json").write_text(
         json.dumps(
             {
-                "model_type": "llama",
+                "model_type": "gemma" if cfg.norm_offset else "llama",
                 "vocab_size": cfg.vocab_size,
                 "hidden_size": cfg.hidden_size,
                 "intermediate_size": cfg.intermediate_size,
@@ -206,6 +243,11 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
                 "max_position_embeddings": cfg.max_seq_len,
                 "tie_word_embeddings": cfg.tie_embeddings,
                 "attention_bias": cfg.attn_bias,
+                # explicit so a gelu LLAMA-architecture model survives the
+                # round trip (gemma-ness alone doesn't encode the activation)
+                "hidden_act": (
+                    "gelu_pytorch_tanh" if cfg.mlp_act == "gelu" else "silu"
+                ),
             }
         )
     )

@@ -80,9 +80,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype: str | None = None) -> P
 
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    # `w` is the RUNTIME weight: for norm_offset (gemma x*(1+w)) checkpoints
+    # the 1.0 is folded in at load (hf_loader), keeping one forward path.
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def embed_tokens(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    """Token-table lookup; gemma-family configs scale by sqrt(hidden) (the
+    tied UNEMBED uses the raw table, so the scale cannot be pre-folded)."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if cfg.scale_embeddings:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    return x
 
 
 def rope_sincos(positions: jax.Array, head_dim: int, theta: float, scaling=None):
@@ -166,7 +177,9 @@ def qkv_proj(lp: Params, x_normed: jax.Array, cfg: LlamaConfig, cos, sin):
 
 def mlp_block(lp: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    # jax.nn.gelu's default tanh approximation IS HF's gelu_pytorch_tanh
+    act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
+    gate = act((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
     return ((gate * (h @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
 
 
@@ -206,7 +219,7 @@ def forward_impl(
     ``collect_kv=False`` (don't materialize caches) and ``remat=True``
     (rematerialize the layer body in backward, trading FLOPs for HBM).
     """
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_tokens(params, cfg, tokens)
     if embeds_override is not None:
         inject, inj_mask = embeds_override
         x = jnp.where(inj_mask[..., None], inject.astype(x.dtype), x)
@@ -301,7 +314,7 @@ def forward_with_cache(
     B, S = tokens.shape
     T = cache["k"].shape[2]
     positions = offset + jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_tokens(params, cfg, tokens)
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     k_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
     k_valid = k_pos < (offset + S)

@@ -114,7 +114,7 @@ def pipeline_forward(
         raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
     stage_layers = split_layers_for_stages(params, n_stages)
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, S, D]
+    x = llama.embed_tokens(params, cfg, tokens)  # [B, S, D]
     Bm = B // num_microbatches
     x_micro = x.reshape(num_microbatches, Bm, *x.shape[1:])
     pos_m = positions[:Bm]  # positions identical across microbatches by construction

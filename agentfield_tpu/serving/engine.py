@@ -209,7 +209,7 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     ):
         B = tokens.shape[0]
         positions = seq_lens  # 0-based position of the incoming token
-        x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
+        x = llama.embed_tokens(params, cfg, tokens)[:, None, :]  # [B,1,D]
         cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         # Page lookup clamps + routes past-the-table writes to the garbage
         # page: the pipelined scheduler can dispatch ONE speculative step past
@@ -334,7 +334,7 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
     def draft_step(dparams, kp, vp, tokens, seq_lens, page_tables):
         """One greedy draft step (one_step minus sampling/grammar)."""
         B = tokens.shape[0]
-        x = jnp.take(dparams["embed"], tokens, axis=0)[:, None, :]
+        x = llama.embed_tokens(dparams, dcfg, tokens)[:, None, :]
         cos, sin = llama.rope_sincos(
             seq_lens[:, None], dcfg.head_dim, dcfg.rope_theta, dcfg.rope_scaling
         )
@@ -375,7 +375,7 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
         B = x_tokens.shape[0]
         active = seq_lens > 0
         positions = seq_lens[:, None] + jnp.arange(W, dtype=seq_lens.dtype)  # [B, W]
-        x = jnp.take(params["embed"], x_tokens, axis=0)  # [B, W, D]
+        x = llama.embed_tokens(params, cfg, x_tokens)  # [B, W, D]
         cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         lookup = positions // ps
         in_table = (lookup < maxp) & active[:, None]
@@ -557,7 +557,7 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
 
     def prefill(params, k_pages, v_pages, tokens, start, n_new, page_table_row):
         positions = (start + jnp.arange(bucket, dtype=jnp.int32))[None]  # [1, B]
-        x = jnp.take(params["embed"], tokens, axis=0)
+        x = llama.embed_tokens(params, cfg, tokens)
         cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         pos = positions[0]
         rel = jnp.arange(bucket, dtype=jnp.int32)

@@ -170,3 +170,98 @@ def test_hf_config_rope_scaling_round_trip(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(doc))
     with _pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(tmp_path)
+
+
+def test_gemma_family_matches_transformers(tmp_path):
+    """Gemma family (GeGLU MLP, x*(1+w) norms, sqrt(d)-scaled embeddings,
+    MQA, tied unembed) validated against the authoritative HF transformers
+    forward: random tiny GemmaForCausalLM → save_pretrained → our
+    hf_loader → logits must match."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, max_position_embeddings=128,
+        hidden_act="gelu_pytorch_tanh", attention_bias=False,
+        rope_theta=10000.0, tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval().to(torch.float32)
+    d = tmp_path / "gemma-ckpt"
+    model.save_pretrained(d, safe_serialization=True)
+
+    from agentfield_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.norm_offset and cfg.scale_embeddings and cfg.mlp_act == "gelu"
+    assert cfg.tie_embeddings and cfg.num_kv_heads == 2
+
+    ids = np.array([[3, 17, 255, 9, 101, 42, 7, 300]], np.int32)
+    with torch.no_grad():
+        want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    toks = jnp.asarray(ids)
+    pos = jnp.arange(ids.shape[1], dtype=jnp.int32)[None]
+    got, _ = forward(params, cfg, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_round_trip_and_serving(tmp_path):
+    """gemma-tiny preset end to end: save→load round-trips the norm fold
+    exactly, and the serving engine decodes it."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config
+    from agentfield_tpu.models.hf_loader import load_hf_checkpoint, save_hf_checkpoint
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    gcfg = get_config("gemma-tiny")
+    gparams = init_params(gcfg, jax.random.PRNGKey(2))
+    toks = _tokens(jax.random.PRNGKey(3), 1, 8)
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    base, _ = forward(gparams, gcfg, toks, pos, collect_kv=False)
+    d = tmp_path / "rt"
+    save_hf_checkpoint(d, gcfg, gparams)
+    cfg2, params2 = load_hf_checkpoint(d, dtype="float32")
+    assert cfg2.norm_offset and cfg2.mlp_act == "gelu" and cfg2.scale_embeddings
+    again, _ = forward(params2, cfg2, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(
+        np.asarray(again), np.asarray(base), rtol=2e-2, atol=2e-2
+    )  # bf16 params → f32 reload
+    # the paged engine serves the family (scaled embeds ride every path)
+    eng = InferenceEngine(
+        gparams, gcfg,
+        EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4),
+    )
+    out = eng.run_to_completion(
+        [Request(id="g", prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=6))]
+    )
+    assert len(out["g"]) == 6
+    # scale_embeddings participates: disabling it changes the logits
+    flat = _dc.replace(gcfg, scale_embeddings=False)
+    alt, _ = forward(gparams, flat, toks, pos, collect_kv=False)
+    assert not np.allclose(np.asarray(alt), np.asarray(base))
+
+
+def test_hidden_act_round_trip_and_rejection(tmp_path):
+    """mlp_act survives save/reload for a gelu LLAMA-architecture model, and
+    unsupported activations fail loudly instead of silently computing a
+    different function."""
+    import dataclasses as _dc
+    import json as _json
+
+    from agentfield_tpu.models.hf_loader import load_hf_checkpoint, save_hf_checkpoint
+
+    gelu_llama = _dc.replace(CFG, mlp_act="gelu")
+    params = init_params(gelu_llama, jax.random.PRNGKey(0))
+    d = tmp_path / "gelu-llama"
+    save_hf_checkpoint(d, gelu_llama, params)
+    cfg2, _ = load_hf_checkpoint(d, dtype="float32")
+    assert cfg2.mlp_act == "gelu" and not cfg2.norm_offset
+    # quick_gelu is a different function — must be rejected, not approximated
+    doc = _json.loads((d / "config.json").read_text())
+    doc["hidden_act"] = "quick_gelu"
+    (d / "config.json").write_text(_json.dumps(doc))
+    with pytest.raises(ValueError, match="hidden_act"):
+        load_hf_checkpoint(d)

@@ -45,6 +45,10 @@ class LlamaConfig:
     # forward stays one code path
     scale_embeddings: bool = False  # gemma multiplies token embeddings by
     # sqrt(hidden_size) after lookup (unembed uses the RAW tied table)
+    num_experts: int = 0  # >0 → Mixtral-style MoE FFN: per-layer router
+    # [d, E] + expert-stacked gate/up/down [E, ...]; top-k routing with
+    # softmax over the selected experts' logits
+    num_experts_per_tok: int = 2
     # dtype name, resolved lazily so configs stay hashable / serializable
     dtype: str = "bfloat16"
 
@@ -60,7 +64,11 @@ class LlamaConfig:
     def num_params(self) -> int:
         """Approximate parameter count (for memory planning)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
-        per_layer = d * (self.q_dim + 2 * self.kv_dim) + self.q_dim * d + 3 * d * f + 2 * d
+        if self.num_experts > 0:
+            mlp = d * self.num_experts + 3 * d * f * self.num_experts
+        else:
+            mlp = 3 * d * f
+        per_layer = d * (self.q_dim + 2 * self.kv_dim) + self.q_dim * d + mlp + 2 * d
         if self.attn_bias:
             per_layer += self.q_dim + 2 * self.kv_dim
         embed = v * d * (1 if self.tie_embeddings else 2)
@@ -209,6 +217,33 @@ PRESETS: dict[str, LlamaConfig] = {
         mlp_act="gelu",
         norm_offset=True,
         scale_embeddings=True,
+    ),
+    # Mixtral: Llama architecture with a top-2-of-8 MoE FFN per layer.
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1_000_000.0,
+        max_seq_len=32768,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
+    # hermetic MoE test config (4 experts, top-2)
+    "mixtral-tiny": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        max_seq_len=256,
+        num_experts=4,
+        num_experts_per_tok=2,
     ),
     # Qwen2-7B: adds QKV projection biases (attn_bias).
     "qwen2-7b": LlamaConfig(

@@ -25,10 +25,10 @@ from agentfield_tpu.models.configs import LlamaConfig
 
 def config_from_hf(path: str | Path) -> LlamaConfig:
     doc = json.loads((Path(path) / "config.json").read_text())
-    if doc.get("model_type") not in ("llama", "mistral", "qwen2", "gemma", None):
+    if doc.get("model_type") not in ("llama", "mistral", "qwen2", "gemma", "mixtral", None):
         raise ValueError(
             f"unsupported model_type={doc.get('model_type')!r} "
-            "(llama/mistral/qwen2/gemma)"
+            "(llama/mistral/qwen2/gemma/mixtral)"
         )
     gemma = doc.get("model_type") == "gemma"
     if doc.get("sliding_window") and doc.get("use_sliding_window", True):
@@ -83,6 +83,8 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         mlp_act=_mlp_act_from_hf(doc.get("hidden_act"), gemma),
         norm_offset=gemma,
         scale_embeddings=gemma,
+        num_experts=doc.get("num_local_experts", 0),
+        num_experts_per_tok=doc.get("num_experts_per_tok", 2),
     )
 
 
@@ -146,7 +148,31 @@ def load_hf_checkpoint(
         # the runtime rms_norm stays one code path (models/llama.py).
         return w + 1.0 if cfg.norm_offset else w
 
+    def stack_experts(fmt: str) -> jnp.ndarray:
+        """Mixtral expert weights → [L, E, in, out] (HF stores [out, in])."""
+        per_layer = []
+        for i in range(cfg.num_layers):
+            per_layer.append(
+                np.stack([get(fmt.format(i=i, e=e)).T for e in range(cfg.num_experts)])
+            )
+        return jnp.asarray(np.stack(per_layer)).astype(dt)
+
     p = "model.layers.{i}."
+    if cfg.num_experts > 0:
+        # Mixtral block_sparse_moe: gate = router, experts.N.w1/w3/w2 =
+        # gate/up/down (reference modeling_mixtral naming)
+        mlp_params = {
+            "router": stack(p + "block_sparse_moe.gate.weight", transpose=True),
+            "w_gate": stack_experts(p + "block_sparse_moe.experts.{e}.w1.weight"),
+            "w_up": stack_experts(p + "block_sparse_moe.experts.{e}.w3.weight"),
+            "w_down": stack_experts(p + "block_sparse_moe.experts.{e}.w2.weight"),
+        }
+    else:
+        mlp_params = {
+            "w_gate": stack(p + "mlp.gate_proj.weight", transpose=True),
+            "w_up": stack(p + "mlp.up_proj.weight", transpose=True),
+            "w_down": stack(p + "mlp.down_proj.weight", transpose=True),
+        }
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("model.embed_tokens.weight")).astype(dt),
         "layers": {
@@ -156,9 +182,7 @@ def load_hf_checkpoint(
             "wk": stack(p + "self_attn.k_proj.weight", transpose=True),
             "wv": stack(p + "self_attn.v_proj.weight", transpose=True),
             "wo": stack(p + "self_attn.o_proj.weight", transpose=True),
-            "w_gate": stack(p + "mlp.gate_proj.weight", transpose=True),
-            "w_up": stack(p + "mlp.up_proj.weight", transpose=True),
-            "w_down": stack(p + "mlp.down_proj.weight", transpose=True),
+            **mlp_params,
         },
         "final_norm": (
             jnp.asarray(get("model.norm.weight")).astype(dt) + 1.0
@@ -204,6 +228,27 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
         names["bq"] = ("self_attn.q_proj.bias", False)
         names["bk"] = ("self_attn.k_proj.bias", False)
         names["bv"] = ("self_attn.v_proj.bias", False)
+    if cfg.num_experts > 0:
+        for k in ("w_gate", "w_up", "w_down"):
+            names.pop(k)
+        router = np.asarray(params["layers"]["router"], np.float32)
+        expert_names = {"w_gate": "w1", "w_up": "w3", "w_down": "w2"}
+        # One device→host conversion per stack, NOT per layer (a 8x7B expert
+        # stack is ~47 GB in f32; converting it inside the layer loop would
+        # multiply that by num_layers).
+        expert_stacks = {
+            ours: np.asarray(params["layers"][ours], np.float32)
+            for ours in expert_names
+        }
+        for i in range(cfg.num_layers):
+            out[f"model.layers.{i}.block_sparse_moe.gate.weight"] = (
+                np.ascontiguousarray(router[i].T)
+            )
+            for ours, theirs in expert_names.items():
+                for e in range(cfg.num_experts):
+                    out[
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{theirs}.weight"
+                    ] = np.ascontiguousarray(expert_stacks[ours][i, e].T)
     for ours, (theirs, transpose) in names.items():
         stacked = np.asarray(params["layers"][ours], np.float32)
         if ours in norm_keys:
@@ -217,7 +262,19 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
     (path / "config.json").write_text(
         json.dumps(
             {
-                "model_type": "gemma" if cfg.norm_offset else "llama",
+                "model_type": (
+                    "gemma" if cfg.norm_offset
+                    else "mixtral" if cfg.num_experts > 0
+                    else "llama"
+                ),
+                **(
+                    {
+                        "num_local_experts": cfg.num_experts,
+                        "num_experts_per_tok": cfg.num_experts_per_tok,
+                    }
+                    if cfg.num_experts > 0
+                    else {}
+                ),
                 "vocab_size": cfg.vocab_size,
                 "hidden_size": cfg.hidden_size,
                 "intermediate_size": cfg.intermediate_size,

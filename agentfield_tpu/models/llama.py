@@ -50,6 +50,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype: str | None = None) -> P
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
     scale = 0.02
+    E = cfg.num_experts
+    if E > 0:  # Mixtral-style MoE FFN: expert axis after the layer stack
+        mlp = {
+            "router": norm(keys[9], (L, d, E), scale),
+            "w_gate": norm(keys[5], (L, E, d, f), scale),
+            "w_up": norm(keys[6], (L, E, d, f), scale),
+            "w_down": norm(keys[7], (L, E, f, d), scale),
+        }
+    else:
+        mlp = {
+            "w_gate": norm(keys[5], (L, d, f), scale),
+            "w_up": norm(keys[6], (L, d, f), scale),
+            "w_down": norm(keys[7], (L, f, d), scale),
+        }
     params: Params = {
         "embed": norm(keys[0], (v, d), scale),
         "layers": {
@@ -59,9 +73,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype: str | None = None) -> P
             "wk": norm(keys[2], (L, d, cfg.kv_dim), scale),
             "wv": norm(keys[3], (L, d, cfg.kv_dim), scale),
             "wo": norm(keys[4], (L, cfg.q_dim, d), scale),
-            "w_gate": norm(keys[5], (L, d, f), scale),
-            "w_up": norm(keys[6], (L, d, f), scale),
-            "w_down": norm(keys[7], (L, f, d), scale),
+            **mlp,
         },
         "final_norm": jnp.ones((d,), dt),
     }
@@ -179,8 +191,30 @@ def mlp_block(lp: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     # jax.nn.gelu's default tanh approximation IS HF's gelu_pytorch_tanh
     act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
+    if cfg.num_experts > 0:
+        return _moe_mlp(lp, h, cfg, act).astype(x.dtype)
     gate = act((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
     return ((gate * (h @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+
+
+def _moe_mlp(lp: Params, h: jax.Array, cfg: LlamaConfig, act) -> jax.Array:
+    """Mixtral-style top-k MoE FFN, dense-mix formulation: every expert
+    computes, a top-k-masked softmax weights the outputs. Static shapes (no
+    gather/dispatch), exact top-k semantics. On DECODE this costs the same
+    HBM as sparse dispatch — ALL expert weights stream from HBM per step
+    regardless — and decode is weight-bound, so the extra FLOPs are largely
+    free at serving batch sizes. PREFILL is compute-bound though: dense-mix
+    pays E/top_k× the MLP FLOPs and [B, E, S, f] intermediates there, so
+    long-prompt TTFT on big MoE models wants the sparse-dispatch path
+    (models/moe.py's capacity-based layout is the follow-up)."""
+    from agentfield_tpu.models.moe import topk_router_weights
+
+    logits = (h @ lp["router"]).astype(jnp.float32)  # [B, S, E]
+    weights = topk_router_weights(logits, cfg.num_experts_per_tok)
+    gate = act(jnp.einsum("bsd,edf->besf", h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = jnp.einsum("bsd,edf->besf", h, lp["w_up"])
+    y = jnp.einsum("besf,efd->besd", gate * up, lp["w_down"])
+    return jnp.einsum("bse,besd->bsd", weights.astype(y.dtype), y)
 
 
 def unembed(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:

@@ -49,16 +49,26 @@ def moe_pspecs() -> dict[str, P]:
 def moe_ffn(params: dict[str, Any], cfg: MoEConfig, x: jax.Array) -> jax.Array:
     """Reference (single-device) computation. x: [B, S, D] → [B, S, D]."""
     logits = (x @ params["router"]).astype(jnp.float32)  # [B, S, E]
-    top, idx = jax.lax.top_k(logits, cfg.top_k)
-    mask = jnp.zeros_like(logits).at[
-        jnp.arange(x.shape[0])[:, None, None],
-        jnp.arange(x.shape[1])[None, :, None],
-        idx,
-    ].set(jax.nn.softmax(top, axis=-1))
+    mask = topk_router_weights(logits, cfg.top_k)
     h = jnp.einsum("bsd,edf->besf", x, params["w_in"])
     h = jax.nn.silu(h.astype(jnp.float32)).astype(x.dtype)
     y = jnp.einsum("besf,efd->besd", h, params["w_out"])
     return jnp.einsum("besd,bse->bsd", y.astype(jnp.float32), mask).astype(x.dtype)
+
+
+def topk_router_weights(logits: jax.Array, k: int) -> jax.Array:
+    """[..., S, E] router logits → [..., S, E] routing weights: softmax over
+    the top-k experts' logits, zero elsewhere (exactly HF Mixtral's
+    softmax→top-k→renormalize). The ONE routing definition — serving
+    (llama._moe_mlp), the dense reference (moe_ffn), and the EP shard body
+    (_moe_local) all call it."""
+    top, idx = jax.lax.top_k(logits, k)
+    batch_idx = jnp.meshgrid(
+        *[jnp.arange(n) for n in logits.shape[:-1]], indexing="ij"
+    )
+    return jnp.zeros_like(logits).at[
+        tuple(b[..., None] for b in batch_idx) + (idx,)
+    ].set(jax.nn.softmax(top, axis=-1))
 
 
 def _moe_local(params, x, cfg: MoEConfig, axis: str):
@@ -68,13 +78,7 @@ def _moe_local(params, x, cfg: MoEConfig, axis: str):
     e_local = params["w_in"].shape[0]
     my_idx = jax.lax.axis_index(axis)
     logits = (x @ params["router"]).astype(jnp.float32)  # [B, S, E_total]
-    E_total = logits.shape[-1]
-    top, idx = jax.lax.top_k(logits, cfg.top_k)
-    weights = jnp.zeros_like(logits).at[
-        jnp.arange(x.shape[0])[:, None, None],
-        jnp.arange(x.shape[1])[None, :, None],
-        idx,
-    ].set(jax.nn.softmax(top, axis=-1))
+    weights = topk_router_weights(logits, cfg.top_k)
     # Slice my experts' routing weights: experts [my_idx*e_local, ...).
     my_w = jax.lax.dynamic_slice_in_dim(weights, my_idx * e_local, e_local, axis=2)
     h = jnp.einsum("bsd,edf->besf", x, params["w_in"])

@@ -92,10 +92,27 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
     trees; everything outside QUANT_KEYS passes through untouched."""
     out = dict(params)
     layers = dict(params["layers"])
+    skipped = []
     for k in QUANT_KEYS:
         w = layers.get(k)
-        if w is not None and not isinstance(w, QuantW):
-            layers[k] = quantize_weight(w)
+        if w is None or isinstance(w, QuantW):
+            continue
+        if w.ndim > 3:
+            # MoE expert stacks [L, E, in, out] go through einsum, not `@` —
+            # QuantW's __rmatmul__ dispatch doesn't reach them. Left fp (a
+            # quantized-einsum path is the MoE follow-up).
+            skipped.append(k)
+            continue
+        layers[k] = quantize_weight(w)
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"int8 quantization skipped the MoE expert stacks {skipped} "
+            "(einsum path, not `@`); the bulk of an MoE model's weights stay "
+            "fp — plan HBM accordingly",
+            stacklevel=2,
+        )
     out["layers"] = layers
     return out
 

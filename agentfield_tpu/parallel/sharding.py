@@ -23,6 +23,24 @@ def param_pspecs(cfg: LlamaConfig) -> dict[str, Any]:
     Layer leaves have a leading stacked-layer axis (never sharded — it is
     scanned over; pipeline parallelism splits it explicitly instead)."""
     m = AXIS_MODEL
+    if cfg.num_experts > 0:
+        from agentfield_tpu.parallel.mesh import AXIS_EXPERT as ex
+
+        # Mixtral MoE FFN: experts shard over `expert` (EP), the ffn dim over
+        # `model` (TP) — both axes exist (size 1 when unused) on every
+        # make_mesh mesh, so EP×TP and TP-only meshes share these specs.
+        mlp_specs: dict[str, Any] = {
+            "router": P(None, None, None),
+            "w_gate": P(None, ex, None, m),
+            "w_up": P(None, ex, None, m),
+            "w_down": P(None, ex, m, None),
+        }
+    else:
+        mlp_specs = {
+            "w_gate": P(None, None, m),
+            "w_up": P(None, None, m),
+            "w_down": P(None, m, None),
+        }
     specs: dict[str, Any] = {
         "embed": P(m, None),  # vocab-sharded; doubles as column-parallel tied lm_head
         "layers": {
@@ -32,9 +50,7 @@ def param_pspecs(cfg: LlamaConfig) -> dict[str, Any]:
             "wk": P(None, None, m),
             "wv": P(None, None, m),
             "wo": P(None, m, None),
-            "w_gate": P(None, None, m),
-            "w_up": P(None, None, m),
-            "w_down": P(None, m, None),
+            **mlp_specs,
         },
         "final_norm": P(None),
     }

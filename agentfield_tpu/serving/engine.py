@@ -691,12 +691,25 @@ class InferenceEngine:
                         "(prefill buckets are powers of two >= 16)"
                     )
             tp = mesh.shape.get(AXIS_MODEL, 1)
-            if tp > 1:
+            from agentfield_tpu.parallel.mesh import AXIS_EXPERT as _AE
+
+            ep = mesh.shape.get(_AE, 1)
+            if ep > 1 and cfg.num_experts % ep:
+                # Fail at config time with a readable error, not inside
+                # device_put (mirrors check_divisibility for TP).
+                raise ValueError(
+                    f"expert axis {ep} does not divide "
+                    f"num_experts={cfg.num_experts}"
+                )
+            if tp > 1 or (ep > 1 and cfg.num_experts > 0):
                 # Pallas impls run under shard_map over the (KV-)head axis —
                 # see ops/paged_attention.py and models/llama.py attend() — so
                 # TP composes with both the ref GSPMD path and the kernels
                 # (north-star config 5: 70B TP=8 on the paged kernel).
-                check_divisibility(cfg, tp, paged_kv=True)
+                # EP-only meshes must shard too: replicating 8 experts per
+                # device is exactly the OOM expert parallelism exists to avoid.
+                if tp > 1:
+                    check_divisibility(cfg, tp, paged_kv=True)
                 params = shard_params(params, cfg, mesh)
         elif self.ecfg.prefill_impl == "ring":
             raise ValueError("prefill_impl='ring' requires a mesh (sequence-parallel)")

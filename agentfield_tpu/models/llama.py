@@ -208,12 +208,16 @@ def _moe_mlp(lp: Params, h: jax.Array, cfg: LlamaConfig, act) -> jax.Array:
     long-prompt TTFT on big MoE models wants the sparse-dispatch path
     (models/moe.py's capacity-based layout is the follow-up)."""
     from agentfield_tpu.models.moe import topk_router_weights
+    from agentfield_tpu.models.quant import QuantW
+
+    def emm(spec, x, w):  # expert contraction, int8-aware
+        return w.expert_einsum(spec, x) if isinstance(w, QuantW) else jnp.einsum(spec, x, w)
 
     logits = (h @ lp["router"]).astype(jnp.float32)  # [B, S, E]
     weights = topk_router_weights(logits, cfg.num_experts_per_tok)
-    gate = act(jnp.einsum("bsd,edf->besf", h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    up = jnp.einsum("bsd,edf->besf", h, lp["w_up"])
-    y = jnp.einsum("besf,efd->besd", gate * up, lp["w_down"])
+    gate = act(emm("bsd,edf->besf", h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = emm("bsd,edf->besf", h, lp["w_up"])
+    y = emm("besf,efd->besd", gate * up, lp["w_down"])
     return jnp.einsum("bse,besd->bsd", weights.astype(y.dtype), y)
 
 

@@ -69,6 +69,23 @@ class QuantW:
         y = x @ self.q.astype(x.dtype)
         return y * self.scale.astype(y.dtype)
 
+    _EXPERT_SPECS = ("bsd,edf->besf", "besf,efd->besd")
+
+    def expert_einsum(self, spec: str, x: jax.Array) -> jax.Array:
+        """Quantized MoE expert contraction (``einsum(spec, x, w)`` with the
+        weight as the SECOND operand). Same post-contraction rescale trick
+        as ``@``: the per-output-channel scale commutes out of the einsum.
+        The scale broadcast is layout-specific ([..., E, S, out] outputs), so
+        only the specs models/llama._moe_mlp uses are accepted — an
+        unanticipated spec must fail loudly, not rescale the wrong axis."""
+        if spec not in self._EXPERT_SPECS:
+            raise ValueError(
+                f"expert_einsum supports {self._EXPERT_SPECS}, got {spec!r}"
+            )
+        y = jnp.einsum(spec, x, self.q.astype(x.dtype))
+        # scale [E, out] broadcasts against y [..., E, S, out]
+        return y * self.scale[..., :, None, :].astype(y.dtype)
+
     def dequantize(self) -> jax.Array:
         """Materialize the fp approximation (tests/debugging only)."""
         return self.q.astype(jnp.float32) * self.scale[..., None, :]
@@ -92,27 +109,14 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
     trees; everything outside QUANT_KEYS passes through untouched."""
     out = dict(params)
     layers = dict(params["layers"])
-    skipped = []
     for k in QUANT_KEYS:
         w = layers.get(k)
-        if w is None or isinstance(w, QuantW):
-            continue
-        if w.ndim > 3:
-            # MoE expert stacks [L, E, in, out] go through einsum, not `@` —
-            # QuantW's __rmatmul__ dispatch doesn't reach them. Left fp (a
-            # quantized-einsum path is the MoE follow-up).
-            skipped.append(k)
-            continue
-        layers[k] = quantize_weight(w)
-    if skipped:
-        import warnings
-
-        warnings.warn(
-            f"int8 quantization skipped the MoE expert stacks {skipped} "
-            "(einsum path, not `@`); the bulk of an MoE model's weights stay "
-            "fp — plan HBM accordingly",
-            stacklevel=2,
-        )
+        if w is not None and not isinstance(w, QuantW):
+            # 3D [L, in, out] dense weights AND 4D [L, E, in, out] MoE expert
+            # stacks (per-output-channel scales either way; the MoE einsum
+            # dispatches through QuantW.expert_einsum). The router stays fp —
+            # trivially small and routing precision matters most.
+            layers[k] = quantize_weight(w)
     out["layers"] = layers
     return out
 

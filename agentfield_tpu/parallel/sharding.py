@@ -79,7 +79,9 @@ def _quant_aware(specs: Any, params: Any) -> Any:
 
     def fix(spec, p):
         if isinstance(p, QuantW):
-            return QuantW(spec, P(spec[0], spec[-1]))
+            # scale = q minus the contraction (-2) axis: [L, out] for dense
+            # stacks, [L, E, out] for MoE expert stacks
+            return QuantW(spec, P(*spec[:-2], spec[-1]))
         return spec
 
     return jax.tree.map(

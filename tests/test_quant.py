@@ -136,3 +136,41 @@ def test_build_model_node_quant_knob(params):
     asyncio.run(main())
     with pytest.raises(ValueError, match="quant mode"):
         build_model_node("model-q2", model="llama-tiny", quant="fp4")
+
+
+def test_mixtral_quantized_serving():
+    """MoE expert stacks quantize too (the einsum path): logits stay close,
+    the engine serves the quantized model, and EP×TP sharding covers the
+    4-D QuantW leaves. On Mixtral decode this is the biggest HBM win — ALL
+    expert weights stream per step."""
+    from agentfield_tpu.parallel.mesh import AXIS_EXPERT, AXIS_MODEL, make_mesh
+    from agentfield_tpu.parallel.sharding import shard_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    mcfg = get_config("mixtral-tiny")
+    mparams = init_params(mcfg, jax.random.PRNGKey(5))
+    qp = quantize_params(mparams)
+    assert isinstance(qp["layers"]["w_gate"], QuantW)
+    assert qp["layers"]["w_gate"].scale.shape == (
+        mcfg.num_layers, mcfg.num_experts, mcfg.intermediate_size,
+    )
+    assert "router" not in QUANT_KEYS  # routing precision stays fp
+    toks = jnp.asarray([[9, 8, 7, 6]], jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None]
+    lf, _ = llama.forward(mparams, mcfg, toks, pos, collect_kv=False)
+    lq, _ = llama.forward(qp, mcfg, toks, pos, collect_kv=False)
+    rel = np.abs(np.asarray(lf) - np.asarray(lq)).max() / (np.abs(np.asarray(lf)).max() + 1e-6)
+    assert rel < 0.1, rel
+    eng = InferenceEngine(
+        qp, mcfg,
+        EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4),
+    )
+    out = eng.run_to_completion(
+        [Request(id="q", prompt=[1, 2, 3], sampling=SamplingParams(max_new_tokens=6))]
+    )
+    assert len(out["q"]) == 6
+    if len(jax.devices()) >= 4:
+        mesh = make_mesh({AXIS_EXPERT: 2, AXIS_MODEL: 2})
+        sp = shard_params(qp, mcfg, mesh)
+        logits, _ = llama.forward(sp, mcfg, toks, pos, collect_kv=False)
+        assert np.isfinite(np.asarray(logits, np.float32)).all()

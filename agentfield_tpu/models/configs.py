@@ -45,6 +45,10 @@ class LlamaConfig:
     # forward stays one code path
     scale_embeddings: bool = False  # gemma multiplies token embeddings by
     # sqrt(hidden_size) after lookup (unembed uses the RAW tied table)
+    sliding_window: int | None = None  # Mistral/Qwen2-style windowed
+    # attention: each query attends the most recent `sliding_window` keys
+    # only. Served on the ref attention paths; kernel impls reject configs
+    # where the window actually binds (window < max context)
     num_experts: int = 0  # >0 → Mixtral-style MoE FFN: per-layer router
     # [d, E] + expert-stacked gate/up/down [E, ...]; top-k routing with
     # softmax over the selected experts' logits
@@ -167,6 +171,7 @@ PRESETS: dict[str, LlamaConfig] = {
         head_dim=128,
         rope_theta=10000.0,
         max_seq_len=32768,
+        sliding_window=4096,  # Mistral-7B-v0.1 windowed attention
     ),
     # Gemma (v1): GeGLU MLP, RMSNorm x*(1+w), sqrt(d)-scaled embeddings,
     # MQA (2B) / MHA (7B), 256-wide heads, tied embeddings.

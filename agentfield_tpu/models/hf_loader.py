@@ -31,17 +31,11 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
             "(llama/mistral/qwen2/gemma/mixtral)"
         )
     gemma = doc.get("model_type") == "gemma"
+    sliding_window = None
     if doc.get("sliding_window") and doc.get("use_sliding_window", True):
         # (Qwen2 configs carry sliding_window but disable it via
         # use_sliding_window=false — full attention matches the reference.)
-        import warnings
-
-        warnings.warn(
-            f"checkpoint declares sliding_window={doc['sliding_window']} which this "
-            "build does not implement — attention is full-causal, so logits "
-            "diverge from the reference beyond that window length",
-            stacklevel=2,
-        )
+        sliding_window = int(doc["sliding_window"])
     rope_scaling = None
     rs = doc.get("rope_scaling")
     if rs:
@@ -85,6 +79,7 @@ def config_from_hf(path: str | Path) -> LlamaConfig:
         scale_embeddings=gemma,
         num_experts=doc.get("num_local_experts", 0),
         num_experts_per_tok=doc.get("num_experts_per_tok", 2),
+        sliding_window=sliding_window,
     )
 
 
@@ -304,6 +299,11 @@ def save_hf_checkpoint(path: str | Path, cfg: LlamaConfig, params: Any) -> None:
                 # round trip (gemma-ness alone doesn't encode the activation)
                 "hidden_act": (
                     "gelu_pytorch_tanh" if cfg.mlp_act == "gelu" else "silu"
+                ),
+                **(
+                    {"sliding_window": cfg.sliding_window}
+                    if cfg.sliding_window is not None
+                    else {}
                 ),
             }
         )

@@ -152,6 +152,8 @@ def attention_ref(
     q_pos: jax.Array,  # [B, S] absolute positions of queries
     k_pos: jax.Array,  # [B, T] absolute positions of keys
     k_valid: jax.Array,  # [B, T] bool — is this key slot populated
+    window: int | None = None,  # sliding window: keys within the most
+    # recent `window` positions of each query (HF Mistral semantics)
 ) -> jax.Array:
     """Reference GQA attention with causal+validity masking, f32 softmax.
 
@@ -166,6 +168,8 @@ def attention_ref(
         "bskrh,btkh->bkrst", qg, k, preferred_element_type=jnp.float32
     ) * (hd ** -0.5)
     mask = (k_pos[:, None, :] <= q_pos[:, :, None]) & k_valid[:, None, :]  # [B,S,T]
+    if window is not None:
+        mask = mask & (k_pos[:, None, :] > q_pos[:, :, None] - window)
     logits = jnp.where(mask[:, None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
@@ -262,6 +266,17 @@ def forward_impl(
         inject, inj_mask = embeds_override
         x = jnp.where(inj_mask[..., None], inject.astype(x.dtype), x)
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    # A window that can't bind within this sequence length is a no-op —
+    # kernels stay usable for short-context serving of windowed models.
+    win = cfg.sliding_window
+    if win is not None and win >= tokens.shape[1]:
+        win = None
+    if win is not None and attn_impl != "ref":
+        raise ValueError(
+            f"sliding_window={cfg.sliding_window} binds at S={tokens.shape[1]} "
+            f"and is served on the ref attention path only "
+            f"(attn_impl={attn_impl!r} kernels don't implement windows yet)"
+        )
 
     def attend(q, k, v):
         if attn_impl == "flash":
@@ -310,7 +325,10 @@ def forward_impl(
                     f"(got {mesh!r})"
                 )
             return ring_attention(q, k, v, mesh, causal=True, positions=positions)
-        return attention_ref(q, k, v, positions, positions, jnp.ones_like(positions, bool))
+        return attention_ref(
+            q, k, v, positions, positions, jnp.ones_like(positions, bool),
+            window=win,
+        )
 
     def body(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -356,6 +374,9 @@ def forward_with_cache(
     cos, sin = rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     k_pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, 0)
     k_valid = k_pos < (offset + S)
+    win = cfg.sliding_window
+    if win is not None and win >= T:
+        win = None  # can't bind within this cache budget
 
     def body(x, xs):
         lp, ck, cv = xs
@@ -363,7 +384,7 @@ def forward_with_cache(
         q, k, v = qkv_proj(lp, h, cfg, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k, (0, offset, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (0, offset, 0, 0))
-        attn = attention_ref(q, ck, cv, positions, k_pos, k_valid)
+        attn = attention_ref(q, ck, cv, positions, k_pos, k_valid, window=win)
         x = x + (attn.reshape(B, S, -1) @ lp["wo"]).astype(x.dtype)
         x = x + mlp_block(lp, x, cfg)
         return x, (ck, cv)

@@ -27,6 +27,8 @@ def paged_attention_ref(
     v_pages: jax.Array,  # [P, Kh, ps, hd]
     page_tables: jax.Array,  # [B, maxp] int32 page ids (0 = garbage page)
     seq_lens: jax.Array,  # [B] int32 — #valid tokens (incl. current) per sequence
+    window: int | None = None,  # sliding window (Mistral): the query (at
+    # position seq_len-1) attends keys within the most recent `window` only
 ) -> jax.Array:
     """Reference implementation via page gather. Returns [B, H, hd]."""
     B, H, hd = q.shape
@@ -42,14 +44,20 @@ def paged_attention_ref(
     qg = q.reshape(B, Kh, rep, hd)
     logits = jnp.einsum("bkrh,btkh->bkrt", qg, k, preferred_element_type=jnp.float32)
     logits = logits * (hd ** -0.5)
-    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, T]
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = k_pos < seq_lens[:, None]  # [B, T]
+    if window is not None:
+        valid = valid & (k_pos >= seq_lens[:, None] - window)
     logits = jnp.where(valid[:, None, None, :], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkrt,btkh->bkrh", probs, v, preferred_element_type=jnp.float32)
     return out.reshape(B, H, hd).astype(q.dtype)
 
 
-def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref", mesh=None):
+def paged_attention(
+    q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref", mesh=None,
+    window: int | None = None,
+):
     """Dispatch decode attention.
 
     With `mesh` (tensor parallelism), the Pallas kernel runs under shard_map
@@ -59,8 +67,15 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref
     output projection downstream is the only cross-chip traffic, exactly as
     in the ref GSPMD path. The `ref` impl needs no wrapper (XLA partitions
     the gather itself)."""
+    if window is not None and impl != "ref":
+        raise ValueError(
+            "sliding_window decode is served by the ref impl only (the "
+            "pallas paged kernel doesn't implement windows yet)"
+        )
     if impl == "ref":
-        return paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens)
+        return paged_attention_ref(
+            q, k_pages, v_pages, page_tables, seq_lens, window=window
+        )
     if impl == "pallas":
         from agentfield_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
 

@@ -43,7 +43,8 @@ def _stage_body(cfg: LlamaConfig, stage_layers, x, positions):
         cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
         attn = llama.attention_ref(
-            q, k, v, positions, positions, jnp.ones_like(positions, dtype=bool)
+            q, k, v, positions, positions, jnp.ones_like(positions, dtype=bool),
+            window=cfg.sliding_window,
         )
         x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
         x = x + llama.mlp_block(lp, x, cfg)

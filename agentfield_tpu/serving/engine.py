@@ -196,6 +196,16 @@ class _SessionEntry:
     last_used: float
 
 
+def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
+    """The sliding window, or None when it cannot bind within this engine's
+    context budget (kernels stay usable for short-context serving of
+    windowed models like Mistral)."""
+    w = cfg.sliding_window
+    if w is None or w >= ecfg.max_context:
+        return None
+    return w
+
+
 @functools.lru_cache(maxsize=None)
 def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     """Jitted decode dispatch, cached per (model, engine, mesh) config so
@@ -238,6 +248,7 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
             attn = paged_attention(
                 q[:, 0], kp, vp, page_tables, seq_lens + 1,
                 impl=ecfg.attn_impl, mesh=mesh,
+                window=_binding_window(cfg, ecfg),
             )
             x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
@@ -357,6 +368,7 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
             attn = paged_attention(
                 q[:, 0], kp, vp, page_tables, seq_lens + 1,
                 impl=ecfg.attn_impl, mesh=mesh,
+                window=_binding_window(dcfg, ecfg),
             )
             x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, dcfg)
@@ -401,7 +413,8 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
             ctx_k = kp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             ctx_v = vp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
             attn = llama.attention_ref(
-                q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid
+                q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid,
+                window=_binding_window(cfg, ecfg),
             )
             x = x + (attn.reshape(B, W, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
@@ -590,7 +603,10 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
                 # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
                 kk = kp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
                 vv = vp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-                attn = llama.attention_ref(q, kk, vv, positions, k_pos, k_valid)
+                attn = llama.attention_ref(
+                    q, kk, vv, positions, k_pos, k_valid,
+                    window=_binding_window(cfg, ecfg),
+                )
             x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
             return x, (kp, vp)
@@ -655,6 +671,21 @@ class InferenceEngine:
             self.ecfg = dataclasses.replace(
                 self.ecfg, prefill_chunk=min(512, self.ecfg.max_context)
             )
+        if _binding_window(cfg, self.ecfg) is not None:
+            kernel_knobs = [
+                k for k, v in (
+                    ("attn_impl", self.ecfg.attn_impl),
+                    ("prefill_impl", self.ecfg.prefill_impl),
+                    ("chunk_attn_impl", self.ecfg.chunk_attn_impl),
+                ) if v not in ("ref",)
+            ]
+            if kernel_knobs:
+                raise ValueError(
+                    f"sliding_window={cfg.sliding_window} binds within "
+                    f"max_context={self.ecfg.max_context} and is served on "
+                    f"the ref paths only — set {kernel_knobs} to 'ref' (the "
+                    "kernels don't implement windows yet)"
+                )
         if self.ecfg.prefill_chunk is not None and self.ecfg.prefill_chunk < 16:
             raise ValueError(
                 f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 16 (one tile) or None"
@@ -738,6 +769,18 @@ class InferenceEngine:
                 raise ValueError(
                     f"draft vocab {self.draft_cfg.vocab_size} != target "
                     f"vocab {cfg.vocab_size} (speculation compares token ids)"
+                )
+            if _binding_window(self.draft_cfg, self.ecfg) is not None and (
+                self.ecfg.attn_impl != "ref"
+            ):
+                # Same fail-fast contract as the target-model guard above:
+                # a windowed DRAFT on a kernel impl must not trace-fail
+                # mid-serving at the first speculative step.
+                raise ValueError(
+                    f"draft sliding_window={self.draft_cfg.sliding_window} "
+                    f"binds within max_context={self.ecfg.max_context} and "
+                    "is served on the ref decode path only — set "
+                    "attn_impl='ref'"
                 )
             if mesh is not None:
                 from agentfield_tpu.parallel.mesh import AXIS_MODEL as _AM

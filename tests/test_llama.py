@@ -265,3 +265,78 @@ def test_hidden_act_round_trip_and_rejection(tmp_path):
     (d / "config.json").write_text(_json.dumps(doc))
     with pytest.raises(ValueError, match="hidden_act"):
         load_hf_checkpoint(d)
+
+
+def test_sliding_window_matches_transformers(tmp_path):
+    """Mistral-style windowed attention validated against transformers'
+    reference forward: tiny random MistralForCausalLM with a window that
+    BINDS (window=4 < seq=12) → logits must match; previously this build
+    computed full-causal attention and only warned."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-5, max_position_embeddings=128,
+        sliding_window=4, rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.MistralForCausalLM(hf_cfg).eval().to(torch.float32)
+    d = tmp_path / "mistral-ckpt"
+    model.save_pretrained(d, safe_serialization=True)
+
+    from agentfield_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.sliding_window == 4
+    ids = np.array([[3, 17, 255, 9, 101, 42, 7, 300, 12, 88, 5, 401]], np.int32)
+    with torch.no_grad():
+        want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    toks = jnp.asarray(ids)
+    pos = jnp.arange(ids.shape[1], dtype=jnp.int32)[None]
+    got, _ = forward(params, cfg, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+    # the window changes the logits (it binds)
+    import dataclasses as _dc
+
+    full, _ = forward(params, _dc.replace(cfg, sliding_window=None), toks, pos, collect_kv=False)
+    assert not np.allclose(np.asarray(full), want, rtol=2e-4, atol=2e-4)
+
+
+def test_sliding_window_engine_decode():
+    """The paged engine decodes windowed models: greedy output matches a
+    windowed dense re-forward per step; kernel impls reject binding windows
+    at init with a readable error."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    wcfg = _dc.replace(CFG, sliding_window=6)
+    params = init_params(wcfg, jax.random.PRNGKey(7))
+    ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=4)
+    assert wcfg.sliding_window < ecfg.max_context  # the window binds
+    eng = InferenceEngine(params, wcfg, ecfg)
+    prompt = [5, 9, 13, 17]
+    out = eng.run_to_completion(
+        [Request(id="w", prompt=prompt, sampling=SamplingParams(max_new_tokens=10))]
+    )["w"]
+    # dense windowed greedy oracle
+    seq = list(prompt)
+    for _ in range(10):
+        toks = jnp.asarray([seq], jnp.int32)
+        pos = jnp.arange(len(seq), dtype=jnp.int32)[None]
+        logits, _ = forward(params, wcfg, toks, pos, collect_kv=False)
+        seq.append(int(np.asarray(logits)[0, -1].argmax()))
+    assert out == seq[len(prompt):]
+    # and the window binds: full-causal engine output differs... at least
+    # the oracle asserts agreement; the init guard is the second claim:
+    with pytest.raises(ValueError, match="sliding_window"):
+        InferenceEngine(params, wcfg, _dc.replace(ecfg, attn_impl="pallas"))
+    # non-binding window keeps kernels usable (window >= max_context)
+    wide = _dc.replace(CFG, sliding_window=4096)
+    InferenceEngine(
+        init_params(wide, jax.random.PRNGKey(8)), wide,
+        _dc.replace(ecfg, attn_impl="pallas", prefill_impl="flash"),
+    )

@@ -1,7 +1,14 @@
 """Run a TPU model node serving `generate` to the cluster.
 
 Usage: python examples/run_model_node.py [control_plane_url] [model]
-Env:   AGENTFIELD_MODEL_CPU=1  — serve on the CPU backend (debug/demo)
+Env:   AGENTFIELD_MODEL_CPU=1   — serve on the CPU backend (debug/demo)
+       AGENTFIELD_QUANT=int8    — weight-only int8 serving (models/quant.py)
+       AGENTFIELD_SPEC_DRAFT=<preset|ckpt> + AGENTFIELD_SPEC_K=4
+                                — speculative decoding (draft-verify)
+       AGENTFIELD_AUDIO=audio-base / AGENTFIELD_TTS=tts-base
+                                — serve audio input / output
+(Production deployments set the same knobs in the model_node config section
+— see docs/OPERATIONS.md.)
 """
 
 import asyncio
@@ -23,7 +30,18 @@ async def main() -> None:
     cp_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8800"
     model = sys.argv[2] if len(sys.argv) > 2 else "llama-tiny"
     ecfg = EngineConfig(max_batch=8, page_size=16, num_pages=256, max_pages_per_seq=16)
-    agent, backend = build_model_node("model", cp_url, model=model, ecfg=ecfg)
+    # empty string means unset (wrapper scripts export optional knobs blank)
+    spec_draft = os.environ.get("AGENTFIELD_SPEC_DRAFT") or None
+    agent, backend = build_model_node(
+        "model", cp_url, model=model, ecfg=ecfg,
+        quant=os.environ.get("AGENTFIELD_QUANT") or None,
+        spec_draft=spec_draft,
+        # parsed only when speculation is on: a stray SPEC_K without a draft
+        # must not crash (or silently half-configure) the node
+        spec_k=int(os.environ.get("AGENTFIELD_SPEC_K", "4")) if spec_draft else None,
+        audio=os.environ.get("AGENTFIELD_AUDIO") or None,
+        tts=os.environ.get("AGENTFIELD_TTS") or None,
+    )
     await backend.start()
     await agent.start()
     print(f"model node '{model}' registered at :{agent.port}", flush=True)

@@ -175,6 +175,7 @@ def cmd_model(cfg: Config, args) -> int:
             grammar_whitespace=mn.grammar_whitespace,
             audio=mn.audio,
             tts=mn.tts,
+            imagegen=mn.imagegen,
             quant=mn.quant,
             spec_draft=mn.spec_draft,
             spec_k=mn.spec_k or None,

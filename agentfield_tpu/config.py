@@ -67,6 +67,8 @@ class ModelNodeConfig:
     vision: str | None = None  # vision tower config name → serve image inputs
     audio: str | None = None  # audio tower config name → serve audio inputs
     tts: str | None = None  # TTS head config name → serve audio OUTPUT
+    imagegen: str | None = None  # image-gen head config name → serve
+    # output="image" rendering
     quant: str | None = None  # "int8" weight-only quantized serving
     spec_draft: str | None = None  # draft preset/checkpoint for speculative
     # decoding (with spec_k > 0)

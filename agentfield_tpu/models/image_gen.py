@@ -1,0 +1,127 @@
+"""Image generation head: text → image, served through the response-parts seam.
+
+The reference's image generation forwards prompts to provider image APIs
+(sdk/python/agentfield/agent_ai.py:1004-1067). Here the modality is SERVED
+in-tree, exactly the way the TTS head serves audio output (models/audio.py):
+a compact non-autoregressive text-to-canvas model whose PNG bytes ride the
+``parts`` response seam. With trained weights this is a small direct
+text-to-image decoder (pixel-regression family); with random init it proves
+the served-output path end to end — ``ai(output="image")`` returns a
+decodable PNG.
+
+TPU-first: byte-level text encoder and canvas decoder are the shared
+``lax.scan`` transformer from models/audio.py, the canvas is a learned grid
+of patch queries (unpatchify is a reshape — no deconvolutions), all matmuls
+land on the MXU in bf16, and every shape is static per config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentfield_tpu.models.audio import _encoder, _init_encoder_layers, _layer_norm
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageGenConfig:
+    vocab_size: int = 256  # byte-level prompt (self-contained, any tokenizer)
+    max_chars: int = 256  # static text budget
+    image_size: int = 64  # square output canvas
+    patch_size: int = 8
+    hidden_size: int = 384
+    num_text_layers: int = 3
+    num_canvas_layers: int = 3
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    layer_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+CONFIGS = {
+    "imagegen-base": ImageGenConfig(image_size=256, patch_size=16, hidden_size=768,
+                                    num_text_layers=6, num_canvas_layers=6, num_heads=12),
+    # hermetic test head: 32px canvas, tiny stacks
+    "imagegen-tiny": ImageGenConfig(
+        max_chars=32, image_size=32, patch_size=8, hidden_size=32,
+        num_text_layers=1, num_canvas_layers=1, num_heads=2,
+    ),
+}
+
+
+def get_imagegen_config(name: str) -> ImageGenConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown imagegen config {name!r}; have {sorted(CONFIGS)}")
+    return CONFIGS[name]
+
+
+def init_imagegen_params(cfg: ImageGenConfig, key: jax.Array) -> Params:
+    dt = jnp.dtype(cfg.dtype)
+    d = cfg.hidden_size
+    keys = jax.random.split(key, 6)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "char_embed": norm(keys[0], (cfg.vocab_size, d)),
+        "char_pos": norm(keys[1], (cfg.max_chars, d)),
+        "text_layers": _init_encoder_layers(keys[2], cfg.num_text_layers, d, d * cfg.mlp_ratio, dt),
+        # learned patch queries: the canvas grid, conditioned on pooled text
+        "canvas_queries": norm(keys[3], (cfg.num_patches, d)),
+        "canvas_layers": _init_encoder_layers(keys[4], cfg.num_canvas_layers, d, d * cfg.mlp_ratio, dt),
+        "final_ln_w": jnp.ones((d,), dt),
+        "final_ln_b": jnp.zeros((d,), dt),
+        "patch_head": norm(keys[5], (d, cfg.patch_dim)),
+    }
+
+
+def imagegen_synthesize(params: Params, cfg: ImageGenConfig, char_ids: jax.Array) -> jax.Array:
+    """[B, max_chars] int32 byte ids (0-padded) → [B, S, S, 3] float32 in
+    (0, 1). Non-autoregressive: encode the text, mean-pool into a
+    conditioning vector, add it to every learned canvas query, run the
+    canvas decoder, emit patches, unpatchify by reshape."""
+    B = char_ids.shape[0]
+    d = cfg.hidden_size
+    x = params["char_embed"][char_ids] + params["char_pos"]
+    x = _encoder(x, params["text_layers"], cfg.num_heads, cfg.layer_norm_eps)
+    # masked mean over real (nonzero) chars; all-padding prompts fall back
+    # to a plain mean so the conditioning never divides by zero
+    real = (char_ids > 0).astype(jnp.float32)[..., None]
+    denom = jnp.maximum(real.sum(axis=1), 1.0)
+    cond = (x.astype(jnp.float32) * real).sum(axis=1) / denom  # [B, d]
+    canvas = params["canvas_queries"][None] + cond[:, None, :].astype(x.dtype)
+    canvas = _encoder(canvas, params["canvas_layers"], cfg.num_heads, cfg.layer_norm_eps)
+    canvas = _layer_norm(canvas, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    patches = (canvas @ params["patch_head"]).astype(jnp.float32)  # [B, N, pdim]
+    g, p = cfg.image_size // cfg.patch_size, cfg.patch_size
+    img = patches.reshape(B, g, g, p, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    return jax.nn.sigmoid(img.reshape(B, cfg.image_size, cfg.image_size, 3))
+
+
+imagegen_synthesize_jit = jax.jit(imagegen_synthesize, static_argnames=("cfg",))
+
+
+def image_to_png(img: np.ndarray) -> bytes:
+    """[S, S, 3] float in [0, 1] → PNG bytes (PIL, host side)."""
+    from PIL import Image
+
+    arr = (np.clip(np.asarray(img, np.float32), 0.0, 1.0) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()

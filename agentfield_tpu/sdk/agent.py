@@ -389,11 +389,12 @@ class Agent:
                     f"but only {len(audio)} audio parts were passed"
                 )
             prompt = prompt + "\n<audio>" * missing
-        if output not in ("text", "audio", "speech"):
+        if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
                 f"unknown output modality {output!r}: 'text' | 'audio' "
                 "(speak the prompt, reference agent_ai.py:750 TTS) | "
-                "'speech' (generate text, then speak it — chat-audio)"
+                "'speech' (generate text, then speak it — chat-audio) | "
+                "'image' (render the prompt, reference agent_ai.py:1004)"
             )
         if output != "text" and schema is not None:
             raise ValueError("schema-constrained decoding is text-only")
@@ -536,6 +537,13 @@ class Agent:
         return await self.ai(
             prompt, images=images or None, audio=audios or None, **kw
         )
+
+    async def generate_image(self, prompt: str, **kw) -> dict[str, Any]:
+        """Text-to-image sugar (reference: generate_image, agent_ai.py:1004
+        forwards to provider image APIs; here the node's in-tree image head
+        renders). Returns a MultimodalResponse whose first part is a PNG."""
+        kw.setdefault("output", "image")
+        return await self.ai(prompt, **kw)
 
     async def ai_with_audio(
         self, prompt: str, audio: Any = None, **kw

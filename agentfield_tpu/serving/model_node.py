@@ -171,6 +171,8 @@ class ModelBackend:
         # (AudioConfig, params) — serve <audio> prompt parts (models/audio.py)
         tts=None,  # audio OUTPUT head: config name, TTSConfig, or
         # (TTSConfig, params) — serve output="audio"/"speech" synthesis
+        imagegen=None,  # image OUTPUT head: config name, ImageGenConfig, or
+        # (ImageGenConfig, params) — serve output="image" rendering
         draft=None,  # (params, cfg) speculative-decoding draft model
         # (with ecfg.spec_k > 0; see InferenceEngine)
     ):
@@ -244,6 +246,25 @@ class ModelBackend:
                 self.tts_params = init_tts_params(tts, _jax.random.PRNGKey(seed + 3))
             else:
                 self.tts_cfg, self.tts_params = tts
+        self.imagegen_cfg = self.imagegen_params = None
+        if imagegen is not None:
+            import jax as _jax
+
+            from agentfield_tpu.models.image_gen import (
+                ImageGenConfig,
+                get_imagegen_config,
+                init_imagegen_params,
+            )
+
+            if isinstance(imagegen, str):
+                imagegen = get_imagegen_config(imagegen)
+            if isinstance(imagegen, ImageGenConfig):
+                self.imagegen_cfg = imagegen
+                self.imagegen_params = init_imagegen_params(
+                    imagegen, _jax.random.PRNGKey(seed + 5)
+                )
+            else:
+                self.imagegen_cfg, self.imagegen_params = imagegen
         self.idle_sleep = idle_sleep
         # One accumulation dict: (token, logprob) records per request —
         # parallel dicts would need mirrored lifecycle at every cleanup site.
@@ -472,6 +493,30 @@ class ModelBackend:
         # trim the static budget to the speakable span of THIS text
         n = max(1, len(data)) * cfg.frames_per_char * cfg.samples_per_frame
         return base64.b64encode(float_to_wav(wav[:n], cfg.sample_rate)).decode(), truncated
+
+    def _render_png_b64(self, text: str) -> tuple[str, int]:
+        """Prompt → (PNG base64, truncated-byte count) through the
+        image-generation head; jitted synth runs on a worker thread
+        (asyncio.to_thread at the call site). Truncation is reported like
+        the TTS path's tts_truncated_chars, never silent."""
+        import base64
+
+        import numpy as np
+
+        from agentfield_tpu.models.image_gen import (
+            image_to_png,
+            imagegen_synthesize_jit,
+        )
+
+        cfg = self.imagegen_cfg
+        full = text.encode("utf-8")
+        data = full[: cfg.max_chars]
+        ids = np.zeros((1, cfg.max_chars), np.int32)
+        if data:
+            ids[0, : len(data)] = np.frombuffer(data, np.uint8)
+        img = imagegen_synthesize_jit(self.imagegen_params, cfg, ids)[0]
+        png = base64.b64encode(image_to_png(np.asarray(img))).decode()
+        return png, len(full) - len(data)
 
     def _decode_image(self, item) -> "np.ndarray":
         """One wire image → [S, S, 3] float32 in [0, 1]. Accepts raw encoded
@@ -725,18 +770,44 @@ class ModelBackend:
         audios: list | None = None,
         output: str = "text",
     ) -> dict[str, Any]:
-        if output not in ("text", "audio", "speech"):
+        if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
                 f"unknown output modality {output!r}: 'text' | 'audio' "
                 "(synthesize the prompt) | 'speech' (generate, then "
-                "synthesize the generated text)"
+                "synthesize the generated text) | 'image' (render the prompt)"
             )
-        if output != "text" and self.tts_cfg is None:
+        if output in ("audio", "speech") and self.tts_cfg is None:
             # Fail in milliseconds, not after a full LM decode.
             raise ValueError(
                 "this model node has no TTS head (audio output unsupported); "
                 "start it with tts=<config> to serve output='audio'/'speech'"
             )
+        if output == "image":
+            # Text-to-image (reference: agent_ai.py:1004 forwards the prompt
+            # to a provider image API): the prompt itself is rendered.
+            if self.imagegen_cfg is None:
+                raise ValueError(
+                    "this model node has no image-generation head; start it "
+                    "with imagegen=<config> to serve output='image'"
+                )
+            if images or audios:
+                raise ValueError(
+                    "output='image' renders the prompt — media inputs would "
+                    "be silently dropped"
+                )
+            if not prompt:
+                raise ValueError("output='image' requires a text prompt")
+            png_b64, img_trunc = await asyncio.to_thread(self._render_png_b64, prompt)
+            out = {
+                "text": prompt,
+                "parts": [{"type": "image", "mime": "image/png", "data_b64": png_b64}],
+                "model": self.model_name,
+                "finish_reason": "imagegen",
+                "tokens": [],
+            }
+            if img_trunc:
+                out["imagegen_truncated_chars"] = img_trunc
+            return out
         if output == "speech" and self.tokenizer is None:
             raise ValueError(
                 "output='speech' needs a tokenizer on this node (the "
@@ -889,6 +960,7 @@ def build_model_node(
     grammar_whitespace: bool = False,
     audio=None,  # audio input tower (ModelBackend audio contract)
     tts=None,  # audio output head (ModelBackend tts contract)
+    imagegen=None,  # image output head (ModelBackend imagegen contract)
     quant: str | None = None,  # "int8" → weight-only quantized serving
     # (models/quant.py): halves decode-step HBM weight traffic
     spec_draft: str | None = None,  # draft model preset for speculative
@@ -944,7 +1016,7 @@ def build_model_node(
     backend = ModelBackend(
         params, cfg, ecfg, tokenizer=tokenizer, seed=seed, model_name=model,
         mesh=mesh, vision=vision, grammar_whitespace=grammar_whitespace,
-        audio=audio, tts=tts, draft=draft,
+        audio=audio, tts=tts, imagegen=imagegen, draft=draft,
     )
 
     kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
@@ -990,7 +1062,7 @@ def build_model_node(
             if body.get("output") not in (None, "text"):
                 raise ValueError(
                     "the token stream is text-only; use the unary generate "
-                    "path for output='audio'/'speech'"
+                    "path for output='audio'/'speech'/'image'"
                 )
             if gen_kwargs.get("response_schema") is not None:
                 gen_kwargs["grammar_obj"] = await backend.ensure_grammar(

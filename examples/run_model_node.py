@@ -7,6 +7,8 @@ Env:   AGENTFIELD_MODEL_CPU=1   — serve on the CPU backend (debug/demo)
                                 — speculative decoding (draft-verify)
        AGENTFIELD_AUDIO=audio-base / AGENTFIELD_TTS=tts-base
                                 — serve audio input / output
+       AGENTFIELD_IMAGEGEN=imagegen-base
+                                — serve image output (ai(output="image"))
 (Production deployments set the same knobs in the model_node config section
 — see docs/OPERATIONS.md.)
 """
@@ -38,9 +40,10 @@ async def main() -> None:
         spec_draft=spec_draft,
         # parsed only when speculation is on: a stray SPEC_K without a draft
         # must not crash (or silently half-configure) the node
-        spec_k=int(os.environ.get("AGENTFIELD_SPEC_K", "4")) if spec_draft else None,
+        spec_k=int(os.environ.get("AGENTFIELD_SPEC_K") or "4") if spec_draft else None,
         audio=os.environ.get("AGENTFIELD_AUDIO") or None,
         tts=os.environ.get("AGENTFIELD_TTS") or None,
+        imagegen=os.environ.get("AGENTFIELD_IMAGEGEN") or None,
     )
     await backend.start()
     await agent.start()

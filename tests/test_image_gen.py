@@ -1,0 +1,154 @@
+"""Image generation head (models/image_gen.py): text → PNG through the
+response-parts seam — the in-tree replacement for the reference's provider
+image APIs (agent_ai.py:1004-1067), closing the last descoped modality."""
+
+import asyncio
+import base64
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.image_gen import (
+    get_imagegen_config,
+    image_to_png,
+    imagegen_synthesize_jit,
+    init_imagegen_params,
+)
+from agentfield_tpu.serving import EngineConfig
+from agentfield_tpu.serving.model_node import ByteTokenizer, ModelBackend
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4)
+ICFG = get_imagegen_config("imagegen-tiny")
+
+
+def test_synthesize_shapes_determinism_and_prompt_dependence():
+    p = init_imagegen_params(ICFG, jax.random.PRNGKey(0))
+    ids = np.zeros((2, ICFG.max_chars), np.int32)
+    for b, text in enumerate([b"a red cat", b"blueprints"]):
+        ids[b, : len(text)] = np.frombuffer(text, np.uint8)
+    i1 = np.asarray(imagegen_synthesize_jit(p, ICFG, ids))
+    i2 = np.asarray(imagegen_synthesize_jit(p, ICFG, ids))
+    assert i1.shape == (2, ICFG.image_size, ICFG.image_size, 3)
+    assert np.array_equal(i1, i2)  # deterministic
+    assert (i1 > 0).all() and (i1 < 1).all()  # sigmoid-bounded
+    assert np.abs(i1[0] - i1[1]).max() > 1e-4  # prompt-dependent
+    # all-padding prompt is finite (masked mean never divides by zero)
+    blank = np.asarray(imagegen_synthesize_jit(p, ICFG, np.zeros((1, ICFG.max_chars), np.int32)))
+    assert np.isfinite(blank).all()
+
+
+def test_png_codec_round_trip():
+    from PIL import Image
+
+    img = np.linspace(0, 1, ICFG.image_size * ICFG.image_size * 3, dtype=np.float32)
+    img = img.reshape(ICFG.image_size, ICFG.image_size, 3)
+    data = image_to_png(img)
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    back = np.asarray(Image.open(io.BytesIO(data)), np.float32) / 255.0
+    assert np.abs(back - img).max() < 1 / 255 + 1e-6
+
+
+def test_model_node_image_output():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            imagegen="imagegen-tiny",
+        )
+        await backend.start()
+        try:
+            r = await backend.generate(prompt="a tiny landscape", output="image")
+            assert r["finish_reason"] == "imagegen"
+            [part] = r["parts"]
+            assert part["mime"] == "image/png"
+            png = base64.b64decode(part["data_b64"])
+            assert png[:8] == b"\x89PNG\r\n\x1a\n"
+            from PIL import Image
+
+            im = Image.open(io.BytesIO(png))
+            assert im.size == (ICFG.image_size, ICFG.image_size)
+            # media inputs with output='image' are rejected, not dropped
+            with pytest.raises(ValueError, match="renders the prompt"):
+                await backend.generate(
+                    prompt="<image>", images=[np.zeros((8, 8, 3), np.float32)],
+                    output="image",
+                )
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_model_node_without_head_rejects():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    async def main():
+        backend = ModelBackend(params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size))
+        await backend.start()
+        try:
+            before = backend.engine.stats["decode_steps"]
+            with pytest.raises(ValueError, match="image-generation head"):
+                await backend.generate(prompt="draw", output="image")
+            assert backend.engine.stats["decode_steps"] == before  # no LM run
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+
+def test_sdk_generate_image_end_to_end():
+    from tests.helpers_cp import CPHarness, async_test
+
+    from agentfield_tpu.sdk.agent import Agent
+    from agentfield_tpu.sdk.multimodal import ImageContent, MultimodalResponse
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    @async_test
+    async def run():
+        async with CPHarness() as h:
+            magent, backend = build_model_node(
+                "model", h.base_url, model="llama-tiny", params=params,
+                ecfg=ECFG, imagegen="imagegen-tiny",
+            )
+            await backend.start()
+            await magent.start()
+            app = Agent("caller", h.base_url)
+            await app.start()
+            try:
+                r = await app.generate_image("a mountain at dusk", timeout=60)
+                assert isinstance(r, MultimodalResponse)
+                [part] = [p for p in r.parts if isinstance(p, ImageContent)]
+                assert part.data[:8] == b"\x89PNG\r\n\x1a\n"
+            finally:
+                await app.stop()
+                await magent.stop()
+                await backend.stop()
+
+    run()
+
+
+def test_image_truncation_reported():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    async def main():
+        backend = ModelBackend(
+            params, CFG, ECFG, tokenizer=ByteTokenizer(CFG.vocab_size),
+            imagegen="imagegen-tiny",
+        )
+        await backend.start()
+        try:
+            r = await backend.generate(prompt="x" * 100, output="image")
+            assert r["imagegen_truncated_chars"] == 100 - ICFG.max_chars
+            r2 = await backend.generate(prompt="short", output="image")
+            assert "imagegen_truncated_chars" not in r2
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())

@@ -313,10 +313,19 @@ class Agent:
     async def _resolve_model_node(self, model: str | None) -> dict[str, Any]:
         return (await self._model_candidates(model))[0]
 
-    async def _model_candidates(self, model: str | None) -> list[dict[str, Any]]:
+    async def _model_candidates(
+        self, model: str | None, need: set[str] | None = None
+    ) -> list[dict[str, Any]]:
         """Failover set: the named node alone, or every active model node in
         registration order (the reference's fallback chain iterates provider
-        models, agent_ai.py:345-384 — here the units of failure are nodes)."""
+        models, agent_ai.py:345-384 — here the units of failure are nodes).
+
+        With `need` (required modalities, e.g. {"audio-out"}), nodes whose
+        metadata advertises them come FIRST — in a mixed cluster a TTS/image
+        request must not land on a node without the head. Nodes that
+        advertise no modality list (older registrations) keep their place
+        after advertising ones: unknown ≠ incapable, so failover still
+        reaches them."""
         nodes = await self.client.list_nodes()
         if model is not None:
             for n in nodes:
@@ -326,6 +335,13 @@ class Agent:
         candidates = [n for n in nodes if n.get("kind") == "model" and n["status"] == "active"]
         if not candidates:
             raise RuntimeError("no active model node registered")
+        if need:
+            def rank(n: dict[str, Any]) -> int:
+                mods = (n.get("metadata") or {}).get("modalities")
+                if mods is None:
+                    return 1  # unknown: after advertisers, before refusers
+                return 0 if need.issubset(mods) else 2
+            candidates.sort(key=rank)  # stable: registration order within rank
         return candidates
 
     async def ai(
@@ -430,7 +446,16 @@ class Agent:
         # to the next active model node — the reference's fallback-model chain
         # (agent_ai.py:345-384) re-designed for in-tree serving, where the
         # unit of failure is a node, not a provider model.
-        candidates = await self._model_candidates(model)
+        need: set[str] = set()
+        if images:
+            need.add("image-in")
+        if audio:
+            need.add("audio-in")
+        if output in ("audio", "speech"):
+            need.add("audio-out")
+        elif output == "image":
+            need.add("image-out")
+        candidates = await self._model_candidates(model, need=need or None)
         node_errors: list[str] = []
         doc: dict[str, Any] = {}
         for ci, cand in enumerate(candidates):

@@ -121,6 +121,32 @@ class HFTokenizer:
         return out
 
 
+def _prompt_byte_ids(text: str, max_chars: int):
+    """UTF-8 prompt → ([1, max_chars] int32 padded byte ids, #bytes used,
+    #bytes truncated). The one truncation recipe for the byte-level output
+    heads (TTS, image gen): a cut landing mid-codepoint strips ONLY the
+    incomplete trailing multibyte sequence (a complete final char stays) so
+    the heads never condition on dangling continuation bytes."""
+    import numpy as np
+
+    full = text.encode("utf-8")
+    data = full
+    if len(full) > max_chars:
+        data = full[:max_chars]
+        i = len(data) - 1
+        while i >= 0 and (data[i] & 0xC0) == 0x80:
+            i -= 1
+        if i >= 0 and data[i] >= 0xC0:
+            lead = data[i]
+            need = 2 if lead < 0xE0 else 3 if lead < 0xF0 else 4
+            if len(data) - i < need:
+                data = data[:i]
+    ids = np.zeros((1, max_chars), np.int32)
+    if data:
+        ids[0, : len(data)] = np.frombuffer(data, np.uint8)
+    return ids, len(data), len(full) - len(data)
+
+
 def load_draft_model(source: str, target_vocab: int, seed: int = 0):
     """Resolve a speculative-decoding draft: an HF checkpoint dir loads
     trained weights, a preset name random-inits (demo/tests — worst-case
@@ -471,27 +497,10 @@ class ModelBackend:
                 "start it with tts=<config> to serve output='audio'/'speech'"
             )
         cfg = self.tts_cfg
-        full = text.encode("utf-8")
-        data = full
-        if len(full) > cfg.max_chars:
-            data = full[: cfg.max_chars]
-            # The cut may land mid-codepoint: strip ONLY an incomplete
-            # trailing multibyte sequence (a complete final char stays).
-            i = len(data) - 1
-            while i >= 0 and (data[i] & 0xC0) == 0x80:
-                i -= 1
-            if i >= 0 and data[i] >= 0xC0:
-                lead = data[i]
-                need = 2 if lead < 0xE0 else 3 if lead < 0xF0 else 4
-                if len(data) - i < need:
-                    data = data[:i]
-        truncated = len(full) - len(data)
-        ids = np.zeros((1, cfg.max_chars), np.int32)
-        if data:
-            ids[0, : len(data)] = np.frombuffer(data, np.uint8)
+        ids, n_bytes, truncated = _prompt_byte_ids(text, cfg.max_chars)
         wav = np.asarray(tts_synthesize_jit(self.tts_params, cfg, ids)[0], np.float32)
         # trim the static budget to the speakable span of THIS text
-        n = max(1, len(data)) * cfg.frames_per_char * cfg.samples_per_frame
+        n = max(1, n_bytes) * cfg.frames_per_char * cfg.samples_per_frame
         return base64.b64encode(float_to_wav(wav[:n], cfg.sample_rate)).decode(), truncated
 
     def _render_png_b64(self, text: str) -> tuple[str, int]:
@@ -509,14 +518,10 @@ class ModelBackend:
         )
 
         cfg = self.imagegen_cfg
-        full = text.encode("utf-8")
-        data = full[: cfg.max_chars]
-        ids = np.zeros((1, cfg.max_chars), np.int32)
-        if data:
-            ids[0, : len(data)] = np.frombuffer(data, np.uint8)
+        ids, _, truncated = _prompt_byte_ids(text, cfg.max_chars)
         img = imagegen_synthesize_jit(self.imagegen_params, cfg, ids)[0]
         png = base64.b64encode(image_to_png(np.asarray(img))).decode()
-        return png, len(full) - len(data)
+        return png, truncated
 
     def _decode_image(self, item) -> "np.ndarray":
         """One wire image → [S, S, 3] float32 in [0, 1]. Accepts raw encoded
@@ -1019,7 +1024,24 @@ def build_model_node(
         audio=audio, tts=tts, imagegen=imagegen, draft=draft,
     )
 
-    kwargs: dict[str, Any] = {"kind": "model", "metadata": {"model": model}}
+    # Advertise served modalities so callers can route capability-needing
+    # requests to a node that actually has the tower/head (SDK
+    # _model_candidates prefers advertising nodes; reference analogue: the
+    # provider-model fallback chain picks models by capability,
+    # agent_ai.py:345-384).
+    modalities = ["text"]
+    if backend.vision_cfg is not None:
+        modalities.append("image-in")
+    if backend.audio_cfg is not None:
+        modalities.append("audio-in")
+    if backend.tts_cfg is not None:
+        modalities.append("audio-out")
+    if backend.imagegen_cfg is not None:
+        modalities.append("image-out")
+    kwargs: dict[str, Any] = {
+        "kind": "model",
+        "metadata": {"model": model, "modalities": modalities},
+    }
     if control_plane:
         kwargs["control_plane"] = control_plane
     agent = Agent(node_id, **kwargs)

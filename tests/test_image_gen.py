@@ -152,3 +152,55 @@ def test_image_truncation_reported():
             await backend.stop()
 
     asyncio.run(main())
+
+
+def test_capability_aware_placement():
+    """Mixed cluster: ai(output='image') routes to the node advertising
+    image-out even when a text-only node registered first; plain text calls
+    keep registration order."""
+    from tests.helpers_cp import CPHarness, async_test
+
+    from agentfield_tpu.sdk.agent import Agent
+    from agentfield_tpu.sdk.multimodal import MultimodalResponse
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    @async_test
+    async def run():
+        async with CPHarness() as h:
+            plain_agent, plain = build_model_node(
+                "plain", h.base_url, model="llama-tiny", params=params, ecfg=ECFG,
+            )
+            await plain.start()
+            await plain_agent.start()
+            img_agent, imgnode = build_model_node(
+                "imgnode", h.base_url, model="llama-tiny", params=params,
+                ecfg=ECFG, imagegen="imagegen-tiny",
+            )
+            await imgnode.start()
+            await img_agent.start()
+            app = Agent("caller", h.base_url)
+            await app.start()
+            try:
+                # capability routing: first-registered 'plain' is skipped
+                r = await app.generate_image("route me", timeout=60)
+                assert isinstance(r, MultimodalResponse)
+                assert r.raw["model"] == "llama-tiny"
+                cands = await app._model_candidates(None, need={"image-out"})
+                assert cands[0]["node_id"] == "imgnode"
+                # no capability needed → no reordering beyond the server's
+                # listing; both nodes stay in the failover set
+                cands_plain = await app._model_candidates(None)
+                assert {c["node_id"] for c in cands_plain} == {"plain", "imgnode"}
+                # and the plain node sorts AFTER the advertiser when a
+                # capability is needed (refusers rank last, not dropped)
+                assert [c["node_id"] for c in cands] == ["imgnode", "plain"]
+            finally:
+                await app.stop()
+                await img_agent.stop()
+                await imgnode.stop()
+                await plain_agent.stop()
+                await plain.stop()
+
+    run()

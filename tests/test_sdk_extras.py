@@ -143,6 +143,13 @@ async def test_connection_manager_degraded_and_reconnect():
                 if agent.connection_state == "connected":
                     break
             assert agent.connection_state == "connected"
+            # on_reconnect observers run as a task OFF the heartbeat loop
+            # (deliberately — a slow callback must not stall heartbeating),
+            # so the state can flip a beat before the callback lands.
+            for _ in range(100):
+                if events:
+                    break
+                await asyncio.sleep(0.05)
             assert events == ["reconnected"]
             assert cp2.storage.get_node("flaky") is not None  # re-registered
         finally:

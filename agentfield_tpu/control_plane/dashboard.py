@@ -180,16 +180,19 @@ async function pgExecs(id) {
   }
   const q = new URLSearchParams(location.hash.split('?')[1] || '');
   const page = +(q.get('page') || 1), st = q.get('status') || '', grp = q.get('group_by') || '';
+  // Hash-derived values are percent-decoded by URLSearchParams; re-encode
+  // before embedding in URLs/href attributes (quote/angle-safe in both).
+  const stE = encodeURIComponent(st), grpE = encodeURIComponent(grp);
   const render = async () => {
     const d = await J('/api/ui/v1/executions?page=' + page + '&page_size=25'
-      + (st ? '&status=' + st : '') + (grp ? '&group_by=' + grp : ''));
-    const base = '#/execs?' + (st ? 'status=' + st + '&' : '') + (grp ? 'group_by=' + grp + '&' : '');
+      + (st ? '&status=' + stE : '') + (grp ? '&group_by=' + grpE : ''));
+    const base = '#/execs?' + (st ? 'status=' + stE + '&' : '') + (grp ? 'group_by=' + grpE + '&' : '');
     $('page').innerHTML = `
       <div class="row">status: ${['', 'running', 'completed', 'failed', 'queued'].map(s =>
-        `<a href="#/execs?${grp ? 'group_by=' + grp + '&' : ''}${s ? 'status=' + s : ''}"
+        `<a href="#/execs?${grp ? 'group_by=' + grpE + '&' : ''}${s ? 'status=' + s : ''}"
           class="${s === st ? 'on' : 'dim'}">${s || 'all'}</a>`).join(' ')}
         group: ${['', 'target', 'status', 'run_id'].map(g =>
-        `<a href="#/execs?${st ? 'status=' + st + '&' : ''}${g ? 'group_by=' + g : ''}"
+        `<a href="#/execs?${st ? 'status=' + stE + '&' : ''}${g ? 'group_by=' + g : ''}"
           class="${g === grp ? 'on' : 'dim'}">${g || 'none'}</a>`).join(' ')}
         <span class="dim">${d.total} total</span></div>
       ${d.groups ? `<table><tr><th>${esc(grp)}</th><th>executions</th><th>completed</th>
@@ -311,9 +314,10 @@ async function pgPkgs() {
 async function pgCreds() {
   const q = new URLSearchParams(location.hash.split('?')[1] || '');
   const page = +(q.get('page') || 1), st = q.get('subject_type') || '';
+  const stE = encodeURIComponent(st);
   const d = await J('/api/ui/v1/credentials?page=' + page + '&page_size=25'
-    + (st ? '&subject_type=' + st : ''));
-  const base = '#/creds?' + (st ? 'subject_type=' + st + '&' : '');
+    + (st ? '&subject_type=' + stE : ''));
+  const base = '#/creds?' + (st ? 'subject_type=' + stE + '&' : '');
   $('page').innerHTML = `
     <div class="row">type: ${['', 'execution', 'workflow'].map(s =>
       `<a href="#/creds?${s ? 'subject_type=' + s : ''}" class="${s === st ? 'on' : 'dim'}">${s || 'all'}</a>`).join(' ')}

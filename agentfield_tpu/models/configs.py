@@ -53,6 +53,14 @@ class LlamaConfig:
     # [d, E] + expert-stacked gate/up/down [E, ...]; top-k routing with
     # softmax over the selected experts' logits
     num_experts_per_tok: int = 2
+    moe_impl: str = "dense"  # MoE FFN formulation: "dense" soft-routes every
+    # expert (exact — the oracle); "sparse" runs capacity-based top-k
+    # dispatch (FLOPs ∝ top_k; over-capacity tokens lose that expert's
+    # contribution). Serving flips this on its PREFILL cfg only
+    # (EngineConfig.moe_prefill_impl) — prefill is compute-bound, decode is
+    # weight-bound so dense-mix costs the same HBM there.
+    moe_capacity_factor: float = 2.0  # sparse dispatch headroom: per-expert
+    # capacity = ceil(tokens * top_k / num_experts * factor)
     # dtype name, resolved lazily so configs stay hashable / serializable
     dtype: str = "bfloat16"
 
@@ -115,6 +123,20 @@ PRESETS: dict[str, LlamaConfig] = {
         num_heads=4,
         num_kv_heads=2,
         head_dim=32,
+        max_seq_len=256,
+        dtype="float32",
+    ),
+    # llama-3-70b's GQA shape in miniature (8 KV heads, group size 8): the
+    # TP=8 serving-validation config — 1 KV head per device, exactly the
+    # north-star config-5 carve (BASELINE.md) where KV-page layout bugs live.
+    "llama-tiny-tp8": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=16,
         max_seq_len=256,
         dtype="float32",
     ),

@@ -191,38 +191,83 @@ def qkv_proj(lp: Params, x_normed: jax.Array, cfg: LlamaConfig, cos, sin):
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
-def mlp_block(lp: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def mlp_block(
+    lp: Params, x: jax.Array, cfg: LlamaConfig, valid: jax.Array | None = None
+) -> jax.Array:
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     # jax.nn.gelu's default tanh approximation IS HF's gelu_pytorch_tanh
     act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
     if cfg.num_experts > 0:
-        return _moe_mlp(lp, h, cfg, act).astype(x.dtype)
+        return _moe_mlp(lp, h, cfg, act, valid).astype(x.dtype)
     gate = act((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
     return ((gate * (h @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
 
 
-def _moe_mlp(lp: Params, h: jax.Array, cfg: LlamaConfig, act) -> jax.Array:
-    """Mixtral-style top-k MoE FFN, dense-mix formulation: every expert
-    computes, a top-k-masked softmax weights the outputs. Static shapes (no
-    gather/dispatch), exact top-k semantics. On DECODE this costs the same
-    HBM as sparse dispatch — ALL expert weights stream from HBM per step
-    regardless — and decode is weight-bound, so the extra FLOPs are largely
-    free at serving batch sizes. PREFILL is compute-bound though: dense-mix
-    pays E/top_k× the MLP FLOPs and [B, E, S, f] intermediates there, so
-    long-prompt TTFT on big MoE models wants the sparse-dispatch path
-    (models/moe.py's capacity-based layout is the follow-up)."""
+def _moe_mlp(
+    lp: Params, h: jax.Array, cfg: LlamaConfig, act, valid: jax.Array | None = None
+) -> jax.Array:
+    """Mixtral-style top-k MoE FFN, two formulations (cfg.moe_impl):
+
+    - "dense" (default): every expert computes, a top-k-masked softmax
+      weights the outputs. Static shapes, exact top-k semantics. On DECODE
+      this costs the same HBM as sparse dispatch — ALL expert weights stream
+      from HBM per step regardless — and decode is weight-bound, so the
+      extra FLOPs are largely free at serving batch sizes.
+    - "sparse": capacity-based scatter dispatch (models/moe.py). PREFILL is
+      compute-bound and dense-mix pays E/top_k× the MLP FLOPs there, so the
+      engine flips its prefill cfg to sparse via
+      EngineConfig.moe_prefill_impl; over-capacity tokens lose that
+      expert's contribution (cfg.moe_capacity_factor sizes the headroom)."""
     from agentfield_tpu.models.moe import topk_router_weights
     from agentfield_tpu.models.quant import QuantW
 
     def emm(spec, x, w):  # expert contraction, int8-aware
         return w.expert_einsum(spec, x) if isinstance(w, QuantW) else jnp.einsum(spec, x, w)
 
+    if cfg.moe_impl == "sparse":
+        return _moe_mlp_sparse(lp, h, cfg, act, emm, valid)
+    if cfg.moe_impl != "dense":
+        raise ValueError(f"moe_impl={cfg.moe_impl!r} must be 'dense' or 'sparse'")
     logits = (h @ lp["router"]).astype(jnp.float32)  # [B, S, E]
     weights = topk_router_weights(logits, cfg.num_experts_per_tok)
     gate = act(emm("bsd,edf->besf", h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     up = emm("bsd,edf->besf", h, lp["w_up"])
     y = emm("besf,efd->besd", gate * up, lp["w_down"])
     return jnp.einsum("bse,besd->bsd", weights.astype(y.dtype), y)
+
+
+def _moe_mlp_sparse(
+    lp: Params, h: jax.Array, cfg: LlamaConfig, act, emm,
+    valid: jax.Array | None = None,  # [B, S] bool: serving prefills exclude
+    # bucket padding so it cannot consume expert capacity ahead of real
+    # tokens (dense-mix needs no mask — padding rows are discarded downstream)
+) -> jax.Array:
+    """Capacity-based sparse dispatch for the gated (gate/up/down) MoE FFN:
+    scatter the routed tokens into [E, capacity, D] buffers, run each
+    expert's FFN on its buffer only, gather + weight-sum back. FFN FLOPs
+    ∝ top_k * capacity_factor instead of num_experts."""
+    from agentfield_tpu.models.moe import (
+        combine_tokens,
+        dispatch_tokens,
+        expert_capacity,
+        sparse_plan,
+    )
+
+    b, s, d = h.shape
+    n = b * s
+    k = cfg.num_experts_per_tok
+    capacity = expert_capacity(n, cfg.num_experts, k, cfg.moe_capacity_factor)
+    xt = h.reshape(n, d)
+    logits = (xt @ lp["router"]).astype(jnp.float32)  # [N, E]
+    experts, slots, keep, weights = sparse_plan(
+        logits, k, capacity, None if valid is None else valid.reshape(n)
+    )
+    buf = dispatch_tokens(xt, experts, slots, cfg.num_experts, capacity)
+    gate = act(emm("ecd,edf->ecf", buf, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = emm("ecd,edf->ecf", buf, lp["w_up"])
+    y = emm("ecf,efd->ecd", gate * up, lp["w_down"])
+    out = combine_tokens(y, experts, slots, keep, weights, k)
+    return out.reshape(b, s, d).astype(h.dtype)
 
 
 def unembed(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
@@ -246,6 +291,10 @@ def forward_impl(
     attn_impl: str = "ref",
     mesh=None,  # required (static) for attn_impl="ring"
     embeds_override: tuple[jax.Array, jax.Array] | None = None,
+    valid_mask: jax.Array | None = None,  # [B, S] bool: which tokens are
+    # real (serving prefills mark bucket padding False so sparse-MoE
+    # dispatch cannot let padding consume expert capacity; the dense paths
+    # ignore it — padded outputs are discarded downstream either way)
 ):
     """Dense causal forward. tokens/positions: [B, S].
 
@@ -335,7 +384,7 @@ def forward_impl(
         q, k, v = qkv_proj(lp, h, cfg, cos, sin)
         attn = attend(q, k, v)
         x = x + (attn.reshape(*attn.shape[:2], -1) @ lp["wo"]).astype(x.dtype)
-        x = x + mlp_block(lp, x, cfg)
+        x = x + mlp_block(lp, x, cfg, valid_mask)
         return x, ((k, v) if collect_kv else None)
 
     if remat:

@@ -2,17 +2,31 @@
 
 Completes the parallelism inventory (SURVEY §2.4 EP row: "only if MoE models
 are added; GSPMD `expert` axis"). Expert weights carry a leading [E, ...]
-axis sharded over ``expert``; each device computes its resident experts for
-all tokens and a psum combines router-weighted outputs — a soft-routing
-formulation (dense compute, exact) whose sharding layout is identical to
-sparse-dispatch MoE; capacity-based top-k token dropping is the planned
-optimization on the same layout.
+axis sharded over ``expert``. Two formulations share that layout:
+
+- **soft routing** (``moe_ffn`` / ``impl="dense"``): every expert computes
+  for every token, a top-k-masked softmax weights the outputs. Exact (no
+  token ever dropped) but pays E/top_k× the FFN FLOPs — the exactness
+  oracle.
+- **capacity-based sparse dispatch** (``moe_ffn_sparse`` / ``impl="sparse"``):
+  GShard-style static-shape scatter dispatch. Each token's top-k expert
+  choices are scattered into a per-expert ``[E, capacity, D]`` buffer
+  (token-major priority: earlier tokens win slots), experts run their FFN on
+  only their buffer, and a gather+weighted-sum combines. FFN FLOPs are
+  ``E * capacity ≈ N * top_k * capacity_factor`` — proportional to top_k,
+  not num_experts. Tokens beyond an expert's capacity lose that expert's
+  contribution (the standard trade; ``capacity_factor`` sizes the headroom,
+  and agreement with soft routing is exact whenever nothing drops).
+
+Everything is static-shape scatter/gather — no data-dependent shapes — so
+XLA tiles the expert einsums onto the MXU unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Any
 
 import jax
@@ -71,6 +85,101 @@ def topk_router_weights(logits: jax.Array, k: int) -> jax.Array:
     ].set(jax.nn.softmax(top, axis=-1))
 
 
+def expert_capacity(
+    num_tokens: int, num_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Per-expert slot count for sparse dispatch. Static (derived from the
+    traced shape), never below top_k so a tiny batch still routes."""
+    return max(top_k, math.ceil(num_tokens * top_k / num_experts * capacity_factor))
+
+
+def sparse_plan(
+    logits: jax.Array, k: int, capacity: int, valid: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """[N, E] router logits → token-major dispatch plan.
+
+    Returns ``(experts, slots, keep, weights)``, each ``[N*k]`` (entry
+    ``m`` is token ``m // k``'s choice ``m % k``): the chosen expert id,
+    the token's slot within that expert's capacity buffer (its rank among
+    earlier entries choosing the same expert — earlier tokens win),
+    whether the slot fits under ``capacity``, and the softmax routing
+    weight (identical to :func:`topk_router_weights`' nonzeros).
+
+    ``valid`` ([N] bool) excludes tokens from dispatch entirely — they
+    occupy no capacity and combine to zero. Serving prefills pass the
+    in-range mask: bucket PADDING tokens all share one hidden state, so
+    unexcluded they would pile onto the same top-k experts and (token-major)
+    starve real tokens behind them out of capacity."""
+    n, e_total = logits.shape
+    top, idx = jax.lax.top_k(logits, k)  # [N, k]
+    weights = jax.nn.softmax(top, axis=-1)
+    experts = idx.reshape(-1)  # [M]
+    if valid is not None:
+        # Invalid entries route "nowhere": expert id E is out of range, so
+        # the one-hot row is zero (no rank consumed), the scatter drops it,
+        # and `keep` masks it out of the combine.
+        experts = jnp.where(jnp.repeat(valid, k), experts, e_total)
+    onehot = jax.nn.one_hot(experts, e_total, dtype=jnp.int32)  # [M, E]
+    ranks = jnp.cumsum(onehot, axis=0) - onehot  # rank within each expert
+    slots = jnp.take_along_axis(
+        ranks, jnp.minimum(experts, e_total - 1)[:, None], axis=1
+    )[:, 0]
+    keep = (slots < capacity) & (experts < e_total)
+    return experts, slots, keep, weights.reshape(-1)
+
+
+def dispatch_tokens(
+    xt: jax.Array, experts: jax.Array, slots: jax.Array, num_experts: int, capacity: int
+) -> jax.Array:
+    """Scatter [N, D] tokens into the [E, C, D] per-expert buffers.
+    Over-capacity entries have ``slots >= capacity`` and are dropped by the
+    scatter's out-of-bounds mode — no mask needed here."""
+    k = experts.shape[0] // xt.shape[0]
+    x_rep = jnp.repeat(xt, k, axis=0)  # [M, D]
+    buf = jnp.zeros((num_experts, capacity, xt.shape[-1]), xt.dtype)
+    return buf.at[experts, slots].set(x_rep, mode="drop")
+
+
+def combine_tokens(
+    y: jax.Array,
+    experts: jax.Array,
+    slots: jax.Array,
+    keep: jax.Array,
+    weights: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Gather [E, C, D] expert outputs back to tokens and weight-sum the k
+    choices: [N, D] (float32 accumulation)."""
+    ec = jnp.minimum(experts, y.shape[0] - 1)
+    sc = jnp.minimum(slots, y.shape[1] - 1)
+    ym = y[ec, sc].astype(jnp.float32) * (weights * keep)[:, None]
+    return ym.reshape(-1, k, y.shape[-1]).sum(axis=1)
+
+
+def moe_ffn_sparse(
+    params: dict[str, Any],
+    cfg: MoEConfig,
+    x: jax.Array,
+    capacity_factor: float = 2.0,
+    capacity: int | None = None,
+) -> jax.Array:
+    """Capacity-based sparse-dispatch MoE FFN (single device). x: [B, S, D].
+    Matches :func:`moe_ffn` exactly whenever no expert overflows capacity."""
+    b, s, d = x.shape
+    n = b * s
+    if capacity is None:
+        capacity = expert_capacity(n, cfg.num_experts, cfg.top_k, capacity_factor)
+    xt = x.reshape(n, d)
+    logits = (xt @ params["router"]).astype(jnp.float32)  # [N, E]
+    experts, slots, keep, weights = sparse_plan(logits, cfg.top_k, capacity)
+    buf = dispatch_tokens(xt, experts, slots, cfg.num_experts, capacity)
+    h = jnp.einsum("ecd,edf->ecf", buf, params["w_in"])
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    out = combine_tokens(y, experts, slots, keep, weights, cfg.top_k)
+    return out.reshape(b, s, d).astype(x.dtype)
+
+
 def _moe_local(params, x, cfg: MoEConfig, axis: str):
     """Per-device body: my expert shard computes for ALL tokens; the router
     (replicated) masks non-resident experts' weights to zero and a psum
@@ -88,14 +197,61 @@ def _moe_local(params, x, cfg: MoEConfig, axis: str):
     return jax.lax.psum(mine, axis).astype(x.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
-def moe_ffn_sharded(params: dict[str, Any], cfg: MoEConfig, x: jax.Array, mesh: Mesh) -> jax.Array:
-    """Expert-parallel MoE FFN over the `expert` mesh axis."""
+def _moe_local_sparse(params, x, cfg: MoEConfig, axis: str, capacity: int):
+    """Per-device sparse body: routing (replicated router, all tokens) runs
+    on every device; each device scatters only the entries routed to its
+    RESIDENT expert shard into a local [E_local, C, D] buffer, computes, and
+    combines — the psum sums disjoint expert contributions, so the collective
+    cost is identical to soft routing while compute drops to capacity."""
+    e_local = params["w_in"].shape[0]
+    lo = jax.lax.axis_index(axis) * e_local
+    b, s, d = x.shape
+    xt = x.reshape(-1, d)
+    logits = (xt @ params["router"]).astype(jnp.float32)
+    experts, slots, keep, weights = sparse_plan(logits, cfg.top_k, capacity)
+    # Local re-index: non-resident entries map to E_local (out of bounds →
+    # dropped by the scatter, masked in the combine).
+    mine = keep & (experts >= lo) & (experts < lo + e_local)
+    experts_loc = jnp.where(mine, experts - lo, e_local)
+    buf = dispatch_tokens(xt, experts_loc, slots, e_local, capacity)
+    h = jnp.einsum("ecd,edf->ecf", buf, params["w_in"])
+    h = jax.nn.silu(h.astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    out = combine_tokens(y, experts_loc, slots, mine, weights, cfg.top_k)
+    return jax.lax.psum(out, axis).reshape(b, s, d).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "impl", "capacity_factor"))
+def moe_ffn_sharded(
+    params: dict[str, Any],
+    cfg: MoEConfig,
+    x: jax.Array,
+    mesh: Mesh,
+    impl: str = "dense",
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """Expert-parallel MoE FFN over the `expert` mesh axis.
+
+    ``impl="dense"`` soft-routes (exact); ``impl="sparse"`` runs the
+    capacity-based dispatch (FLOPs ∝ top_k, token-major drop priority —
+    identical across devices since routing is computed from replicated
+    inputs everywhere)."""
     n = mesh.shape[AXIS_EXPERT]
     if cfg.num_experts % n:
         raise ValueError(f"{cfg.num_experts} experts not divisible by expert={n}")
+    if impl == "sparse":
+        capacity = expert_capacity(
+            x.shape[0] * x.shape[1], cfg.num_experts, cfg.top_k, capacity_factor
+        )
+        body = functools.partial(
+            _moe_local_sparse, cfg=cfg, axis=AXIS_EXPERT, capacity=capacity
+        )
+    elif impl == "dense":
+        body = functools.partial(_moe_local, cfg=cfg, axis=AXIS_EXPERT)
+    else:
+        raise ValueError(f"impl={impl!r} must be 'dense' or 'sparse'")
     fn = jax.shard_map(
-        functools.partial(_moe_local, cfg=cfg, axis=AXIS_EXPERT),
+        body,
         mesh=mesh,
         in_specs=(moe_pspecs(), P()),
         out_specs=P(),

@@ -69,7 +69,12 @@ class QuantW:
         y = x @ self.q.astype(x.dtype)
         return y * self.scale.astype(y.dtype)
 
-    _EXPERT_SPECS = ("bsd,edf->besf", "besf,efd->besd")
+    # dense-mix specs ([.., E, S, out] outputs) + sparse-dispatch buffer
+    # specs ([E, C, out] outputs) — both broadcast scale [E, out] as
+    # [E, 1, out] against the output's second-to-last axis.
+    _EXPERT_SPECS = (
+        "bsd,edf->besf", "besf,efd->besd", "ecd,edf->ecf", "ecf,efd->ecd",
+    )
 
     def expert_einsum(self, spec: str, x: jax.Array) -> jax.Array:
         """Quantized MoE expert contraction (``einsum(spec, x, w)`` with the

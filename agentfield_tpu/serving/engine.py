@@ -67,6 +67,13 @@ class EngineConfig:
     # long-context serving path: no device materializes full-context
     # attention; requires mesh= with a seq axis, prompt buckets divide by
     # the axis size since they are powers of two >= 16)
+    moe_prefill_impl: str = "dense"  # MoE FFN during PREFILL forwards:
+    # "dense" soft-routes (exact, the default) | "sparse" capacity-based
+    # top-k dispatch (FLOPs ∝ top_k not num_experts — prefill is
+    # compute-bound, so big-MoE TTFT wants this; over-capacity tokens lose
+    # that expert's contribution, cfg.moe_capacity_factor sizes headroom).
+    # Decode always soft-routes: it is weight-bound (all expert weights
+    # stream from HBM per step regardless) and dense-mix is exact.
     prefill_batch: int = 4  # admit up to this many fresh requests per tick as
     # ONE padded prefill batch (burst TTFT: N admissions cost one kernel call
     # instead of N serial prefills). 1 restores one-at-a-time admission.
@@ -194,6 +201,28 @@ class _SessionEntry:
     pages: list[int]
     tokens: list[int]  # tokens whose KV is resident (prompt + generated[:-1])
     last_used: float
+
+
+def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
+    """The cfg a PREFILL forward runs under: flipped to sparse-dispatch MoE
+    when the knob asks for it (one constructor for target and draft, so the
+    two cannot drift)."""
+    if ecfg.moe_prefill_impl == "sparse" and cfg.num_experts > 0:
+        return dataclasses.replace(cfg, moe_impl="sparse")
+    return cfg
+
+
+def _non_ref_knobs(ecfg: "EngineConfig") -> list[str]:
+    """Attention-impl knobs not set to 'ref' — the set a binding sliding
+    window is incompatible with (one list so the target- and draft-model
+    guards cannot drift)."""
+    return [
+        k for k, v in (
+            ("attn_impl", ecfg.attn_impl),
+            ("prefill_impl", ecfg.prefill_impl),
+            ("chunk_attn_impl", ecfg.chunk_attn_impl),
+        ) if v not in ("ref",)
+    ]
 
 
 def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
@@ -477,11 +506,12 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
         # tokens: [1, bucket]; positions past `length` are padding whose
         # K/V are routed to the garbage page.
         positions = jnp.arange(bucket, dtype=jnp.int32)[None]
-        logits, (ks, vs) = llama.forward_impl(
-            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh
-        )
         pos = positions[0]
         in_range = pos < length
+        logits, (ks, vs) = llama.forward_impl(
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh,
+            valid_mask=in_range[None],
+        )
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
         slot_ids = pos % ps
         # pages: [L, P, Kh, ps, hd]; advanced indices at dims 1,3 put the
@@ -508,10 +538,11 @@ def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=No
     def prefill(params, k_pages, v_pages, tokens, lengths, rows):
         # tokens [N, bucket]; lengths [N]; rows [N, max_pages_per_seq]
         positions = jnp.arange(bucket, dtype=jnp.int32)[None].repeat(N, 0)
-        logits, (ks, vs) = llama.forward_impl(
-            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh
-        )
         in_range = positions < lengths[:, None]
+        logits, (ks, vs) = llama.forward_impl(
+            params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl, mesh=mesh,
+            valid_mask=in_range,
+        )
         page_ids = jnp.where(
             in_range, jnp.take_along_axis(rows, positions // ps, axis=1), 0
         )  # [N, bucket]
@@ -539,12 +570,13 @@ def _prefill_inject_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=N
 
     def prefill(params, k_pages, v_pages, tokens, inject, inj_mask, length, page_table_row):
         positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+        pos = positions[0]
+        in_range = pos < length
         logits, (ks, vs) = llama.forward_impl(
             params, cfg, tokens, positions, attn_impl=ecfg.prefill_impl,
             mesh=mesh, embeds_override=(inject, inj_mask),
+            valid_mask=in_range[None],
         )
-        pos = positions[0]
-        in_range = pos < length
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
         slot_ids = pos % ps
         k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(ks[:, 0], 0, 1))
@@ -608,7 +640,7 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
                     window=_binding_window(cfg, ecfg),
                 )
             x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
-            x = x + llama.mlp_block(lp, x, cfg)
+            x = x + llama.mlp_block(lp, x, cfg, in_range[None])
             return x, (kp, vp)
 
         x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
@@ -672,13 +704,7 @@ class InferenceEngine:
                 self.ecfg, prefill_chunk=min(512, self.ecfg.max_context)
             )
         if _binding_window(cfg, self.ecfg) is not None:
-            kernel_knobs = [
-                k for k, v in (
-                    ("attn_impl", self.ecfg.attn_impl),
-                    ("prefill_impl", self.ecfg.prefill_impl),
-                    ("chunk_attn_impl", self.ecfg.chunk_attn_impl),
-                ) if v not in ("ref",)
-            ]
+            kernel_knobs = _non_ref_knobs(self.ecfg)
             if kernel_knobs:
                 raise ValueError(
                     f"sliding_window={cfg.sliding_window} binds within "
@@ -698,6 +724,23 @@ class InferenceEngine:
                 f"num_pages-1={self.ecfg.num_pages - 1} (page 0 is reserved); "
                 "an admitted request could otherwise never obtain its pages"
             )
+        if cfg.moe_impl != "dense":
+            raise ValueError(
+                f"engine model cfg has moe_impl={cfg.moe_impl!r}: the DECODE "
+                "path always soft-routes (weight-bound, exact) and takes no "
+                "padding mask — use EngineConfig.moe_prefill_impl='sparse' "
+                "to run sparse dispatch on prefill forwards"
+            )
+        if self.ecfg.moe_prefill_impl not in ("dense", "sparse"):
+            raise ValueError(
+                f"moe_prefill_impl={self.ecfg.moe_prefill_impl!r} must be "
+                "'dense' or 'sparse'"
+            )
+        # Prefill forwards may run the sparse-dispatch MoE (compute-bound
+        # phase); decode always soft-routes (weight-bound, exact). The
+        # prefill builders are keyed on this cfg, so the flip costs nothing
+        # when it is the identity.
+        self.prefill_cfg = _sparse_prefill_cfg(cfg, self.ecfg)
         self.mesh = mesh
         if mesh is not None:
             from agentfield_tpu.parallel.mesh import AXIS_MODEL, AXIS_SEQ
@@ -765,23 +808,31 @@ class InferenceEngine:
                     "InferenceEngine(draft=(params, cfg))"
                 )
             self.draft_params, self.draft_cfg = draft
+            if self.draft_cfg.moe_impl != "dense":
+                raise ValueError(
+                    f"draft cfg has moe_impl={self.draft_cfg.moe_impl!r}: "
+                    "draft decode soft-routes like the target's — use "
+                    "EngineConfig.moe_prefill_impl='sparse' instead"
+                )
             if self.draft_cfg.vocab_size != cfg.vocab_size:
                 raise ValueError(
                     f"draft vocab {self.draft_cfg.vocab_size} != target "
                     f"vocab {cfg.vocab_size} (speculation compares token ids)"
                 )
-            if _binding_window(self.draft_cfg, self.ecfg) is not None and (
-                self.ecfg.attn_impl != "ref"
-            ):
+            if _binding_window(self.draft_cfg, self.ecfg) is not None:
                 # Same fail-fast contract as the target-model guard above:
                 # a windowed DRAFT on a kernel impl must not trace-fail
-                # mid-serving at the first speculative step.
-                raise ValueError(
-                    f"draft sliding_window={self.draft_cfg.sliding_window} "
-                    f"binds within max_context={self.ecfg.max_context} and "
-                    "is served on the ref decode path only — set "
-                    "attn_impl='ref'"
-                )
+                # mid-serving. Draft prefill REPLAYS run forward_impl with
+                # prefill_impl/chunk_attn_impl too, so all three knobs must
+                # be 'ref', not just the decode impl.
+                draft_knobs = _non_ref_knobs(self.ecfg)
+                if draft_knobs:
+                    raise ValueError(
+                        f"draft sliding_window={self.draft_cfg.sliding_window} "
+                        f"binds within max_context={self.ecfg.max_context} and "
+                        f"is served on the ref paths only — set {draft_knobs} "
+                        "to 'ref'"
+                    )
             if mesh is not None:
                 from agentfield_tpu.parallel.mesh import AXIS_MODEL as _AM
                 from agentfield_tpu.parallel.sharding import (
@@ -800,6 +851,10 @@ class InferenceEngine:
                 self.draft_cfg, self.ecfg.num_pages, self.ecfg.page_size,
                 cache_dtype, mesh=mesh,
             )
+        self.draft_prefill_cfg = (
+            _sparse_prefill_cfg(self.draft_cfg, self.ecfg)
+            if self.draft_cfg is not None else None
+        )
         self.allocator = PageAllocator(self.ecfg.num_pages)
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
         self.page_tables = np.zeros((B, maxp), np.int32)
@@ -1277,7 +1332,7 @@ class InferenceEngine:
             rows[j] = row
             s = req.sampling
             temps[j], top_ks[j], top_ps[j] = s.temperature, s.top_k, s.top_p
-        fn = _batch_prefill_fn(self.cfg, self.ecfg, bucket, self.mesh)
+        fn = _batch_prefill_fn(self.prefill_cfg, self.ecfg, bucket, self.mesh)
         last, self.cache.k_pages, self.cache.v_pages = fn(
             self.params,
             self.cache.k_pages,
@@ -1439,7 +1494,7 @@ class InferenceEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(piece)] = np.asarray(piece, np.int32)
             if piece_start == 0 and len(pieces) == 1:
-                fn = _prefill_fn(self.cfg, self.ecfg, bucket, self.mesh)
+                fn = _prefill_fn(self.prefill_cfg, self.ecfg, bucket, self.mesh)
                 last_logits, self.cache.k_pages, self.cache.v_pages = fn(
                     self.params,
                     self.cache.k_pages,
@@ -1453,7 +1508,7 @@ class InferenceEngine:
                     jnp.asarray(padded), jnp.int32(len(piece)), jnp.asarray(row),
                 )
             else:
-                fn = _suffix_prefill_fn(self.cfg, self.ecfg, bucket)
+                fn = _suffix_prefill_fn(self.prefill_cfg, self.ecfg, bucket)
                 last_logits, self.cache.k_pages, self.cache.v_pages = fn(
                     self.params,
                     self.cache.k_pages,
@@ -1483,7 +1538,7 @@ class InferenceEngine:
             arr = np.asarray(emb, np.float32)
             inject[0, off : off + arr.shape[0]] = arr
             mask[0, off : off + arr.shape[0]] = True
-        fn = _prefill_inject_fn(self.cfg, self.ecfg, bucket, self.mesh)
+        fn = _prefill_inject_fn(self.prefill_cfg, self.ecfg, bucket, self.mesh)
         last, self.cache.k_pages, self.cache.v_pages = fn(
             self.params,
             self.cache.k_pages,
@@ -1697,9 +1752,9 @@ class InferenceEngine:
         if self.draft_cache is None:
             return
         fn = (
-            fn_factory(self.draft_cfg, self.ecfg, bucket, self.mesh)
+            fn_factory(self.draft_prefill_cfg, self.ecfg, bucket, self.mesh)
             if with_mesh
-            else fn_factory(self.draft_cfg, self.ecfg, bucket)
+            else fn_factory(self.draft_prefill_cfg, self.ecfg, bucket)
         )
         _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
             self.draft_params,

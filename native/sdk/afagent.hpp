@@ -158,15 +158,27 @@ inline std::string json_escape(const std::string& s) {
     return out;
 }
 
-// Scan helpers for the narrow, known response formats of the control plane
-// (json.dumps with default separators: `"key": value`). Not a JSON parser —
-// the SDK stays dependency-free, and tests pin the wire format.
+// Scan helpers for the narrow, known response formats of the control plane.
+// Not a JSON parser — the SDK stays dependency-free, and tests pin the wire
+// format. Matches `"key":` then skips optional whitespace, so both default
+// json.dumps separators (`"key": v`) and compact ones (`"key":v`) parse.
+inline size_t json_value_pos(const std::string& body, const std::string& key,
+                             size_t from = 0) {
+    std::string needle = "\"" + key + "\":";
+    size_t at = body.find(needle, from);
+    if (at == std::string::npos) return std::string::npos;
+    size_t p = at + needle.size();
+    while (p < body.size() && (body[p] == ' ' || body[p] == '\t' ||
+                               body[p] == '\n' || body[p] == '\r'))
+        ++p;
+    return p;
+}
+
 inline std::string json_scan_string(const std::string& body, const std::string& key,
                                     size_t from = 0, size_t* end_out = nullptr) {
-    std::string needle = "\"" + key + "\": \"";
-    size_t at = body.find(needle, from);
-    if (at == std::string::npos) return "";
-    size_t start = at + needle.size();
+    size_t p = json_value_pos(body, key, from);
+    if (p == std::string::npos || p >= body.size() || body[p] != '"') return "";
+    size_t start = p + 1;
     std::string out;
     for (size_t i = start; i < body.size(); ++i) {
         char c = body[i];
@@ -220,12 +232,35 @@ inline std::string json_scan_string(const std::string& body, const std::string& 
 // quotes numbers. Returns `fallback` when the key is absent.
 inline double json_scan_number(const std::string& body, const std::string& key,
                                double fallback = 0.0) {
-    std::string needle = "\"" + key + "\": ";
-    size_t at = body.find(needle);
-    if (at == std::string::npos) return fallback;
-    const char* p = body.c_str() + at + needle.size();
+    size_t pos = json_value_pos(body, key);
+    if (pos == std::string::npos || pos >= body.size()) return fallback;
+    const char* p = body.c_str() + pos;
     if (*p != '-' && *p != '+' && !(*p >= '0' && *p <= '9')) return fallback;
     return std::atof(p);
+}
+
+// Scan a bare boolean value. Returns `fallback` when the key is absent.
+inline bool json_scan_bool(const std::string& body, const std::string& key,
+                           bool fallback = false) {
+    size_t pos = json_value_pos(body, key);
+    if (pos == std::string::npos) return fallback;
+    if (body.compare(pos, 4, "true") == 0) return true;
+    if (body.compare(pos, 5, "false") == 0) return false;
+    return fallback;
+}
+
+// True when ANY `"key": "value"` pair occurs in `body` (separator-tolerant;
+// checks every occurrence of the key, matching the containment semantics the
+// node-block and failure-detection scans rely on). Built on json_value_pos
+// so the key-match/whitespace rules cannot drift from the scalar scanners.
+inline bool json_has_pair(const std::string& body, const std::string& key,
+                          const std::string& value) {
+    std::string quoted = "\"" + value + "\"";
+    for (size_t p = json_value_pos(body, key); p != std::string::npos;
+         p = json_value_pos(body, key, p)) {
+        if (body.compare(p, quoted.size(), quoted) == 0) return true;
+    }
+    return false;
 }
 
 // Result of an ai() call (the reference Go SDK's ai.Client response role,
@@ -283,22 +318,23 @@ class Agent {
         }
         // Scan node blocks: each starts at "node_id"; pick the first
         // whose block carries kind=model and status=active.
+        const std::string delim = "\"node_id\":";
         size_t pos = 0;
         while (true) {
-            size_t at = nodes.body.find("\"node_id\": \"", pos);
+            size_t at = nodes.body.find(delim, pos);
             if (at == std::string::npos) break;
-            size_t next = nodes.body.find("\"node_id\": \"", at + 12);
+            size_t next = nodes.body.find(delim, at + delim.size());
             std::string block = nodes.body.substr(
                 at, (next == std::string::npos ? nodes.body.size() : next) - at);
-            if (block.find("\"kind\": \"model\"") != std::string::npos &&
-                block.find("\"status\": \"active\"") != std::string::npos) {
+            if (json_has_pair(block, "kind", "model") &&
+                json_has_pair(block, "status", "active")) {
                 if (node_id.empty() || json_scan_string(block, "node_id") == node_id) {
                     node_id = json_scan_string(block, "node_id");
                     base_url = json_scan_string(block, "base_url");
                     return true;
                 }
             }
-            pos = at + 12;
+            pos = at + delim.size();
         }
         error = "no active model node registered";
         return false;
@@ -326,7 +362,7 @@ class Agent {
             bool backpressure =
                 resp.status == 503 ||
                 (resp.body.find("QueueFullError") != std::string::npos &&
-                 resp.body.find("\"status\": \"failed\"") != std::string::npos);
+                 json_has_pair(resp.body, "status", "failed"));
             if (!backpressure) break;
             std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
             if (delay_ms < 5000) delay_ms *= 2;
@@ -424,7 +460,7 @@ class Agent {
                 StreamEvent ev;
                 ev.token = (int)json_scan_number(doc, "token", -1);
                 ev.index = (int)json_scan_number(doc, "index", -1);
-                ev.finished = doc.find("\"finished\": true") != std::string::npos;
+                ev.finished = json_scan_bool(doc, "finished");
                 ev.finish_reason = json_scan_string(doc, "finish_reason");
                 ev.text = json_scan_string(doc, "text");
                 out.text += ev.text;

@@ -1,0 +1,80 @@
+"""70B TP=8 serving shape plan — north-star config 5 (BASELINE.md: Llama-3-70B
+over ICI on a v5e-8) validated WITHOUT weights: the carve's divisibility, the
+KV-page layout where per-device head slices degenerate to width 1 (GQA: 8 KV
+heads / 8 devices), and the HBM arithmetic that decides whether the plan fits
+a 16 GB v5e chip at all.
+
+The full engine decode at this carve (miniaturized to llama-tiny-tp8, the
+same 1-KV-head-per-device shape) runs in __graft_entry__.dryrun_multichip.
+"""
+
+import jax
+import numpy as np
+
+from agentfield_tpu.models import get_config
+from agentfield_tpu.parallel import make_mesh
+from agentfield_tpu.parallel.sharding import check_divisibility
+from agentfield_tpu.serving.kv_cache import PagedKVCache
+
+TP = 8
+
+
+def test_70b_tp8_divisibility():
+    cfg = get_config("llama-3-70b")
+    # GQA 8 KV heads over 8 devices: exactly one KV head per device.
+    assert cfg.num_kv_heads == TP
+    check_divisibility(cfg, TP, paged_kv=True)  # must not raise
+
+
+def test_70b_kv_page_layout_tp8():
+    """Pages [L, P, Kh, ps, hd] shard over the KV-head axis on `model`; at
+    TP=8 each device's slice is ONE head wide — the layout where off-by-one
+    head-slicing bugs live."""
+    cfg = get_config("llama-3-70b")
+    mesh = make_mesh({"model": TP}, jax.devices()[:TP])
+    cache = PagedKVCache.create(cfg, num_pages=16, page_size=16, dtype="bfloat16", mesh=mesh)
+    assert cache.k_pages.shape == (cfg.num_layers, 16, cfg.num_kv_heads, 16, cfg.head_dim)
+    assert "model" in str(cache.k_pages.sharding)
+    shard = cache.k_pages.addressable_shards[0]
+    assert shard.data.shape[2] == 1  # one KV head per device
+    assert shard.data.shape[0] == cfg.num_layers  # layers replicated
+
+
+def test_70b_param_pspecs_cover_tree():
+    """Every 70B param leaf has a spec of matching rank (the spec tree is
+    computed from the config, so no weights are needed)."""
+    import jax.numpy as jnp
+
+    from agentfield_tpu.models.llama import init_params
+    from agentfield_tpu.parallel.sharding import param_pspecs
+
+    cfg = get_config("llama-3-70b")
+    specs = param_pspecs(cfg)
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k), jax.random.PRNGKey(0))
+    jax.tree.map(lambda p, s: None if len(s) == p.ndim else (_ for _ in ()).throw(
+        AssertionError((p.shape, s))), shapes, specs)
+    # sharded dims must divide by TP on every model-sharded leaf
+    def divisible(p, s):
+        for dim, axis in zip(p.shape, s):
+            if axis == "model":
+                assert dim % TP == 0, (p.shape, s)
+    jax.tree.map(divisible, shapes, specs)
+
+
+def test_70b_hbm_budget_v5e():
+    """The plan must fit the chip: v5e has 16 GB HBM. bf16 70B does NOT fit
+    at TP=8 (17.6 GB/device weights alone) — int8 weight-only serving is the
+    fitting configuration (8.8 GB/device), leaving >5 GB for KV pages +
+    activations. This is the arithmetic behind EngineConfig defaults for
+    config 5."""
+    cfg = get_config("llama-3-70b")
+    hbm = 16 * 1024**3
+    per_device_bf16 = cfg.num_params * 2 / TP
+    per_device_int8 = cfg.num_params * 1 / TP
+    assert per_device_bf16 > hbm  # documents WHY int8 is the 70B serving mode
+    assert per_device_int8 < 0.6 * hbm
+    # KV budget: pages [L, P, Kh/8, ps, hd] bf16, K+V. With 3 GB of pages a
+    # device holds > 48k tokens of context (page_size 16).
+    kv_bytes_per_token = cfg.num_layers * 1 * cfg.head_dim * 2 * 2  # 1 local head
+    tokens_in_3gb = 3 * 1024**3 // kv_bytes_per_token
+    assert tokens_in_3gb > 48_000

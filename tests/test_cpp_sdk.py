@@ -179,3 +179,37 @@ async def test_cpp_ai_stream_through_model_node():
             await proc.wait()
             await model_agent.stop()
             await backend.stop()
+
+
+def test_cpp_json_scan_separator_robustness(tmp_path):
+    """The scan helpers must parse both default json.dumps separators
+    ('"k": v') and compact ones ('"k":v') — a benign server-side separator
+    change must not silently turn every frame into token=-1/finished=false
+    (afagent.hpp json_value_pos)."""
+    src = tmp_path / "scan_test.cpp"
+    src.write_text(
+        '#include "afagent.hpp"\n'
+        "#include <cassert>\n"
+        "int main() {\n"
+        '  std::string d = "{\\"token\\": 42, \\"finished\\": true, '
+        '\\"text\\": \\"hi\\"}";\n'
+        '  std::string c = "{\\"token\\":42,\\"finished\\":true,'
+        '\\"text\\":\\"hi\\"}";\n'
+        "  for (const auto& s : {d, c}) {\n"
+        '    assert((int)afield::json_scan_number(s, "token", -1) == 42);\n'
+        '    assert(afield::json_scan_bool(s, "finished"));\n'
+        '    assert(afield::json_scan_string(s, "text") == "hi");\n'
+        '    assert((int)afield::json_scan_number(s, "absent", -1) == -1);\n'
+        '    assert(!afield::json_scan_bool(s, "absent"));\n'
+        "  }\n"
+        '  std::string f = "{\\"finished\\": false}";\n'
+        '  assert(!afield::json_scan_bool(f, "finished"));\n'
+        "  return 0;\n"
+        "}\n"
+    )
+    out = tmp_path / "scan_test"
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17", f"-I{SDK_DIR}", "-o", str(out), str(src), "-pthread"],
+        check=True, capture_output=True, timeout=180,
+    )
+    subprocess.run([str(out)], check=True, timeout=30)

@@ -135,3 +135,172 @@ def test_mixtral_tp_sharding_specs():
     def chk(p, s):
         assert len(s) == p.ndim, (p.shape, s)
     jax.tree.map(chk, params, specs)
+
+
+def test_sparse_matches_dense_when_nothing_drops():
+    """Capacity dispatch with headroom is bit-for-bit the same math as soft
+    routing — the dense path is the exactness oracle."""
+    from agentfield_tpu.models.moe import moe_ffn_sparse
+
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, CFG.hidden_size), jnp.float32)
+    dense = moe_ffn(params, CFG, x)
+    # capacity = every entry fits even if all route to one expert
+    sparse = moe_ffn_sparse(params, CFG, x, capacity=2 * 8 * CFG.top_k)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense), rtol=1e-5, atol=1e-5)
+    # the default factor leaves generous headroom on random routing too
+    sparse2 = moe_ffn_sparse(params, CFG, x, capacity_factor=2.0)
+    np.testing.assert_allclose(np.asarray(sparse2), np.asarray(dense), rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_sharded_matches_dense():
+    from agentfield_tpu.models.moe import moe_ffn_sparse
+
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, CFG.hidden_size), jnp.float32)
+    dense = moe_ffn(params, CFG, x)
+    for n_exp in (2, 4):
+        mesh = make_mesh({"expert": n_exp})
+        sharded = moe_ffn_sharded(
+            params, CFG, x, mesh, impl="sparse", capacity_factor=float(CFG.num_experts)
+        )
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_capacity_drop_is_token_major():
+    """When an expert overflows, EARLIER tokens keep their slots; later
+    tokens lose that expert's contribution (here: all of them, since the
+    router is rigged so every token picks the same two experts)."""
+    from agentfield_tpu.models.moe import moe_ffn_sparse
+
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    # rig the router: expert 0 then expert 1 dominate for every token
+    router = np.zeros((CFG.hidden_size, CFG.num_experts), np.float32)
+    router[:, 0] = 1.0
+    router[:, 1] = 0.5
+    params = dict(params, router=jnp.asarray(router))
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(5), (1, 4, CFG.hidden_size))) + 0.1
+    out = moe_ffn_sparse(params, CFG, x, capacity=1)
+    full = moe_ffn(params, CFG, x)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(full[0, 0]), rtol=1e-5, atol=1e-5)
+    # every later token overflowed both of its chosen experts -> zero output
+    np.testing.assert_allclose(np.asarray(out[0, 1:]), 0.0, atol=1e-6)
+
+
+def test_expert_capacity_scales_with_top_k():
+    from agentfield_tpu.models.moe import expert_capacity
+
+    # FLOPs ~ E * capacity ~ N * top_k * factor: independent of num_experts
+    assert expert_capacity(1024, 8, 2, 1.0) * 8 == 1024 * 2
+    assert expert_capacity(1024, 64, 2, 1.0) * 64 == 1024 * 2
+    assert expert_capacity(1, 8, 2, 1.0) == 2  # floor at top_k
+
+
+def test_mixtral_sparse_prefill_matches_dense():
+    """cfg.moe_impl='sparse' (the engine's prefill flip) with headroom
+    reproduces the dense-mix forward numerically."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config, init_params, llama
+
+    cfg = get_config("mixtral-tiny")
+    cfg = _dc.replace(cfg, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray([[5, 6, 7, 8, 9, 10, 11, 12]], jnp.int32)
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    dense, _ = llama.forward(params, cfg, toks, pos, collect_kv=False)
+    scfg = _dc.replace(cfg, moe_impl="sparse", moe_capacity_factor=float(cfg.num_experts))
+    sparse, _ = llama.forward(params, scfg, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense), rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_engine_sparse_prefill_serves():
+    """EngineConfig.moe_prefill_impl='sparse' flips prefill only; with ample
+    capacity the generated stream matches the dense engine token-for-token
+    (decode is identical — it always soft-routes)."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    cfg = get_config("mixtral-tiny")
+    cfg = _dc.replace(cfg, moe_capacity_factor=float(cfg.num_experts))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    base_ecfg = dict(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4)
+    reqs = lambda: [
+        Request(id="m", prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=6))
+    ]
+    dense = InferenceEngine(params, cfg, EngineConfig(**base_ecfg)).run_to_completion(reqs())
+    sparse_eng = InferenceEngine(
+        params, cfg, EngineConfig(moe_prefill_impl="sparse", **base_ecfg)
+    )
+    assert sparse_eng.prefill_cfg.moe_impl == "sparse"
+    assert sparse_eng.cfg.moe_impl == "dense"  # decode path untouched
+    assert sparse_eng.run_to_completion(reqs()) == dense
+
+
+def test_mixtral_engine_sparse_prefill_int8():
+    """Sparse dispatch composes with int8 expert stacks (QuantW.expert_einsum
+    accepts the [E, C, D] buffer specs)."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.models.quant import quantize_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    cfg = get_config("mixtral-tiny")
+    cfg = _dc.replace(cfg, moe_capacity_factor=float(cfg.num_experts))
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(
+            moe_prefill_impl="sparse", max_batch=2, page_size=16, num_pages=32,
+            max_pages_per_seq=4,
+        ),
+    )
+    out = eng.run_to_completion(
+        [Request(id="q", prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=4))]
+    )
+    assert len(out["q"]) == 4
+
+
+def test_sparse_plan_valid_mask_excludes_padding():
+    """Invalid (padding) entries consume no capacity and combine to zero —
+    without this, bucket padding's identical hidden states pile onto one
+    expert and starve real tokens behind them (token-major priority)."""
+    from agentfield_tpu.models.moe import sparse_plan
+
+    # 4 tokens, all routed to expert 0; first two are "padding"
+    logits = jnp.asarray([[9.0, 0.0], [9.0, 0.0], [9.0, 0.0], [9.0, 0.0]])
+    valid = jnp.asarray([False, False, True, True])
+    experts, slots, keep, _ = sparse_plan(logits, k=1, capacity=2, valid=valid)
+    # real tokens get slots 0 and 1 (padding occupied none) and are kept
+    assert slots[2] == 0 and slots[3] == 1
+    assert bool(keep[2]) and bool(keep[3])
+    assert not bool(keep[0]) and not bool(keep[1])
+    # without the mask, padding would have taken both slots
+    _, slots_nm, keep_nm, _ = sparse_plan(logits, k=1, capacity=2)
+    assert not bool(keep_nm[2]) and not bool(keep_nm[3])
+
+
+def test_mixtral_batched_sparse_prefill_padding_immune():
+    """Batched prefill (prefill_batch=2) with sparse MoE: bucket padding must
+    not eat expert capacity, so the stream equals the dense engine's even at
+    a tight capacity factor."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    cfg = _dc.replace(get_config("mixtral-tiny"), moe_capacity_factor=1.0)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    base = dict(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4, prefill_batch=2)
+    reqs = lambda: [
+        Request(id="a", prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=4)),
+        Request(id="b", prompt=[100, 200, 300, 400], sampling=SamplingParams(max_new_tokens=4)),
+    ]
+    dense = InferenceEngine(params, cfg, EngineConfig(**base)).run_to_completion(reqs())
+    sparse = InferenceEngine(
+        params, cfg, EngineConfig(moe_prefill_impl="sparse", **base)
+    ).run_to_completion(reqs())
+    assert sparse == dense

@@ -47,8 +47,10 @@ class LlamaConfig:
     # sqrt(hidden_size) after lookup (unembed uses the RAW tied table)
     sliding_window: int | None = None  # Mistral/Qwen2-style windowed
     # attention: each query attends the most recent `sliding_window` keys
-    # only. Served on the ref attention paths; kernel impls reject configs
-    # where the window actually binds (window < max context)
+    # only. Served on the ref paths AND the pallas kernels (flash / paged
+    # decode / paged chunk implement the window with block/page skipping,
+    # so a bound window reads O(window) K/V); only ring prefill rejects
+    # binding windows
     num_experts: int = 0  # >0 → Mixtral-style MoE FFN: per-layer router
     # [d, E] + expert-stacked gate/up/down [E, ...]; top-k routing with
     # softmax over the selected experts' logits

@@ -320,11 +320,11 @@ def forward_impl(
     win = cfg.sliding_window
     if win is not None and win >= tokens.shape[1]:
         win = None
-    if win is not None and attn_impl != "ref":
+    if win is not None and attn_impl == "ring":
         raise ValueError(
             f"sliding_window={cfg.sliding_window} binds at S={tokens.shape[1]} "
-            f"and is served on the ref attention path only "
-            f"(attn_impl={attn_impl!r} kernels don't implement windows yet)"
+            "and is served on the ref/flash attention paths only "
+            "(ring attention doesn't implement windows yet)"
         )
 
     def attend(q, k, v):
@@ -340,7 +340,8 @@ def forward_impl(
             from agentfield_tpu.ops.pallas.flash_attention_kernel import flash_attention
 
             fa = functools.partial(
-                flash_attention, causal=True, interpret=jax.default_backend() == "cpu"
+                flash_attention, causal=True, window=win,
+                interpret=jax.default_backend() == "cpu",
             )
             if mesh is not None:
                 from jax.sharding import PartitionSpec as P

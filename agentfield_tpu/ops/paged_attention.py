@@ -67,11 +67,6 @@ def paged_attention(
     output projection downstream is the only cross-chip traffic, exactly as
     in the ref GSPMD path. The `ref` impl needs no wrapper (XLA partitions
     the gather itself)."""
-    if window is not None and impl != "ref":
-        raise ValueError(
-            "sliding_window decode is served by the ref impl only (the "
-            "pallas paged kernel doesn't implement windows yet)"
-        )
     if impl == "ref":
         return paged_attention_ref(
             q, k_pages, v_pages, page_tables, seq_lens, window=window
@@ -92,7 +87,9 @@ def paged_attention(
                 import functools
 
                 return shard_map(
-                    functools.partial(paged_attention_pallas, interpret=interpret),
+                    functools.partial(
+                        paged_attention_pallas, interpret=interpret, window=window
+                    ),
                     mesh=mesh,
                     in_specs=(
                         P(None, AXIS_MODEL, None),  # q [B, H, hd] on heads
@@ -105,6 +102,7 @@ def paged_attention(
                     check_rep=False,
                 )(q, k_pages, v_pages, page_tables, seq_lens)
         return paged_attention_pallas(
-            q, k_pages, v_pages, page_tables, seq_lens, interpret=interpret
+            q, k_pages, v_pages, page_tables, seq_lens, interpret=interpret,
+            window=window,
         )
     raise ValueError(f"unknown paged_attention impl {impl!r}")

@@ -37,6 +37,7 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
     num_k_blocks: int,
+    window: int | None,
 ):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -47,8 +48,12 @@ def _flash_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: blocks strictly above the diagonal contribute nothing.
+    # Causal: blocks strictly above the diagonal contribute nothing; with a
+    # sliding window, neither do blocks wholly below every query's window
+    # (max key pos in block < min query pos - window + 1).
     run = (not causal) or (ki * block_k <= qi * block_q + (block_q - 1))
+    if window is not None:
+        run = run & (ki * block_k + block_k - 1 > qi * block_q - window)
 
     @pl.when(run)
     def _compute():
@@ -60,7 +65,10 @@ def _flash_kernel(
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            keep = k_pos <= q_pos
+            if window is not None:  # HF Mistral semantics (attention_ref)
+                keep &= k_pos > q_pos - window
+            s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[...]  # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -86,7 +94,9 @@ def _flash_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "sm_scale", "block_q", "block_k", "interpret", "window"
+    ),
 )
 def flash_attention(
     q: jax.Array,  # [B, H, S, hd]
@@ -97,6 +107,9 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: int | None = None,  # sliding window over causal positions; with
+    # block-level skipping a bound window reads O(S * window) K/V blocks
+    # instead of O(S^2 / 2)
 ) -> jax.Array:
     """Returns [B, H, S, hd]. S and T must be multiples of the block sizes
     (the serving engine's prefill buckets guarantee this); callers with ragged
@@ -122,6 +135,8 @@ def flash_attention(
         sm_scale = hd**-0.5
     num_k_blocks = T // block_k
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (HF Mistral semantics)")
     grid = (B, H, S // block_q, num_k_blocks)
     kernel = functools.partial(
         _flash_kernel,
@@ -130,6 +145,7 @@ def flash_attention(
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=num_k_blocks,
+        window=window,
     )
     return pl.pallas_call(
         kernel,

@@ -37,6 +37,7 @@ def _paged_kernel(
     sm_scale: float,
     page_size: int,
     num_page_steps: int,
+    window: int | None,
 ):
     b = pl.program_id(0)
     pi = pl.program_id(2)
@@ -50,8 +51,13 @@ def _paged_kernel(
 
     # Pages wholly past the sequence end contribute nothing (their DMA may
     # fetch the garbage page; the mask below would zero it anyway, but
-    # skipping saves the FLOPs).
-    @pl.when(pi * page_size < seq_len)
+    # skipping saves the FLOPs). With a sliding window, pages wholly BEFORE
+    # the window skip too — windowed decode touches O(window/ps) pages.
+    relevant = pi * page_size < seq_len
+    if window is not None:
+        relevant &= (pi + 1) * page_size > seq_len - window
+
+    @pl.when(relevant)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [rep, hd]
         k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
@@ -59,7 +65,10 @@ def _paged_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [rep, ps]
         k_pos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos < seq_len, s, _NEG_INF)
+        keep = k_pos < seq_len
+        if window is not None:  # the query sits at position seq_len - 1
+            keep &= k_pos >= seq_len - window
+        s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -80,7 +89,7 @@ def _paged_kernel(
         o_ref[0, 0, ...] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret", "window"))
 def paged_attention_pallas(
     q: jax.Array,  # [B, H, hd]
     k_pages: jax.Array,  # [P, Kh, ps, hd]
@@ -89,6 +98,8 @@ def paged_attention_pallas(
     seq_lens: jax.Array,  # [B] int32 (valid tokens incl. current)
     sm_scale: float | None = None,
     interpret: bool = False,
+    window: int | None = None,  # sliding window (Mistral): the query at
+    # seq_len-1 attends only keys within the most recent `window`
 ) -> jax.Array:
     B, H, hd = q.shape
     P, Kh, ps, _ = k_pages.shape
@@ -102,7 +113,8 @@ def paged_attention_pallas(
     qg = q.reshape(B, Kh, rep, hd)
     grid = (B, Kh, maxp)
     kernel = functools.partial(
-        _paged_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp
+        _paged_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp,
+        window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -41,6 +41,7 @@ def _chunk_kernel(
     page_size: int,
     num_page_steps: int,
     rep: int,
+    window: int | None,
 ):
     pi = pl.program_id(1)
     start = start_ref[0]
@@ -54,8 +55,13 @@ def _chunk_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Page is relevant iff it holds any key with pos < k_len (valid) — keys
-    # past every query position mask out below anyway.
-    @pl.when(pi * page_size < k_len)
+    # past every query position mask out below anyway. With a sliding
+    # window, pages wholly before even the FIRST query's window skip.
+    relevant = pi * page_size < k_len
+    if window is not None:
+        relevant &= (pi + 1) * page_size - 1 > start - window
+
+    @pl.when(relevant)
     def _compute():
         q = q_ref[0].astype(jnp.float32).reshape(C * rep, -1) * sm_scale
         k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
@@ -64,7 +70,10 @@ def _chunk_kernel(
         )  # [C*rep, ps]
         k_pos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         q_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
-        s = jnp.where((k_pos <= q_pos) & (k_pos < k_len), s, _NEG_INF)
+        keep = (k_pos <= q_pos) & (k_pos < k_len)
+        if window is not None:  # HF Mistral semantics (attention_ref)
+            keep &= k_pos > q_pos - window
+        s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -85,7 +94,7 @@ def _chunk_kernel(
         o_ref[0, ...] = (acc_scr[...] / l).reshape(C, rep, -1).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret", "window"))
 def paged_chunk_attention_pallas(
     q: jax.Array,  # [C, H, hd] — one sequence's chunk of query tokens
     k_pages: jax.Array,  # [P, Kh, ps, hd]
@@ -95,6 +104,8 @@ def paged_chunk_attention_pallas(
     k_len: jax.Array,  # scalar int32 — valid keys (= start + n_new)
     sm_scale: float | None = None,
     interpret: bool = False,
+    window: int | None = None,  # sliding window (Mistral) on absolute
+    # positions: query at q_pos attends keys in (q_pos - window, q_pos]
 ) -> jax.Array:
     C, H, hd = q.shape
     P, Kh, ps, _ = k_pages.shape
@@ -107,7 +118,8 @@ def paged_chunk_attention_pallas(
 
     qg = q.reshape(C, Kh, rep, hd).transpose(1, 0, 2, 3)  # [Kh, C, rep, hd]
     kernel = functools.partial(
-        _chunk_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp, rep=rep
+        _chunk_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp,
+        rep=rep, window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,

@@ -213,16 +213,11 @@ def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
 
 
 def _non_ref_knobs(ecfg: "EngineConfig") -> list[str]:
-    """Attention-impl knobs not set to 'ref' — the set a binding sliding
-    window is incompatible with (one list so the target- and draft-model
-    guards cannot drift)."""
-    return [
-        k for k, v in (
-            ("attn_impl", ecfg.attn_impl),
-            ("prefill_impl", ecfg.prefill_impl),
-            ("chunk_attn_impl", ecfg.chunk_attn_impl),
-        ) if v not in ("ref",)
-    ]
+    """Attention-impl knobs a binding sliding window is incompatible with
+    (one list so the target- and draft-model guards cannot drift). The
+    pallas decode/flash/chunk kernels all implement windows; only the ring
+    (sequence-parallel) prefill does not."""
+    return ["prefill_impl"] if ecfg.prefill_impl == "ring" else []
 
 
 def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
@@ -630,6 +625,7 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
                 attn = paged_chunk_attention_pallas(
                     q[0], kp, vp, page_table_row, start, start + n_new,
                     interpret=jax.default_backend() == "cpu",
+                    window=_binding_window(cfg, ecfg),
                 )[None]
             else:
                 # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
@@ -708,9 +704,9 @@ class InferenceEngine:
             if kernel_knobs:
                 raise ValueError(
                     f"sliding_window={cfg.sliding_window} binds within "
-                    f"max_context={self.ecfg.max_context} and is served on "
-                    f"the ref paths only — set {kernel_knobs} to 'ref' (the "
-                    "kernels don't implement windows yet)"
+                    f"max_context={self.ecfg.max_context} but "
+                    f"prefill_impl='ring' doesn't implement windows — use "
+                    "'ref' or 'flash' prefill for windowed models"
                 )
         if self.ecfg.prefill_chunk is not None and self.ecfg.prefill_chunk < 16:
             raise ValueError(
@@ -821,17 +817,16 @@ class InferenceEngine:
                 )
             if _binding_window(self.draft_cfg, self.ecfg) is not None:
                 # Same fail-fast contract as the target-model guard above:
-                # a windowed DRAFT on a kernel impl must not trace-fail
-                # mid-serving. Draft prefill REPLAYS run forward_impl with
-                # prefill_impl/chunk_attn_impl too, so all three knobs must
-                # be 'ref', not just the decode impl.
+                # draft prefill REPLAYS run forward_impl with prefill_impl
+                # too, so a ring prefill must not trace-fail mid-serving at
+                # the first windowed draft replay.
                 draft_knobs = _non_ref_knobs(self.ecfg)
                 if draft_knobs:
                     raise ValueError(
                         f"draft sliding_window={self.draft_cfg.sliding_window} "
-                        f"binds within max_context={self.ecfg.max_context} and "
-                        f"is served on the ref paths only — set {draft_knobs} "
-                        "to 'ref'"
+                        f"binds within max_context={self.ecfg.max_context} but "
+                        "prefill_impl='ring' doesn't implement windows — use "
+                        "'ref' or 'flash' prefill for windowed drafts"
                     )
             if mesh is not None:
                 from agentfield_tpu.parallel.mesh import AXIS_MODEL as _AM

@@ -239,3 +239,110 @@ def test_session_second_turn_pallas_chunk_path_matches_oracle():
     assert eng.stats["prefix_cache_hits"] == 1
     oracle = generate_greedy(params, cfg, jnp.asarray([p2], jnp.int32), 4, 64)[0].tolist()
     assert out2 == oracle
+
+
+def test_flash_attention_windowed_matches_ref():
+    """Sliding-window flash: in-kernel window mask + block skipping must
+    reproduce attention_ref's windowed output (HF Mistral semantics)."""
+    B, S, H, Kh, hd, window = 2, 128, 4, 2, 64, 20
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    k = _rand(ks[1], (B, S, Kh, hd))
+    v = _rand(ks[2], (B, S, Kh, hd))
+    pos = jnp.arange(S, dtype=jnp.int32)[None].repeat(B, 0)
+    ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool), window=window)
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        causal=True, block_q=32, block_k=32, interpret=True, window=window,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    # window wider than the sequence == plain causal
+    wide = flash_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        causal=True, block_q=32, block_k=32, interpret=True, window=4 * S,
+    ).transpose(0, 2, 1, 3)
+    plain = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
+    np.testing.assert_allclose(np.asarray(wide), np.asarray(plain), rtol=2e-3, atol=2e-3)
+
+
+def test_paged_attention_windowed_matches_ref():
+    """Windowed paged decode: the query at seq_len-1 sees only the last
+    `window` keys; page skipping must not clip a window straddling pages."""
+    B, H, Kh, hd, P, ps, maxp = 4, 4, 2, 64, 32, 16, 6
+    ks = jax.random.split(jax.random.PRNGKey(10), 4)
+    q = _rand(ks[0], (B, H, hd))
+    k_pages = _rand(ks[1], (P, Kh, ps, hd))
+    v_pages = _rand(ks[2], (P, Kh, ps, hd))
+    perm = np.asarray(jax.random.permutation(ks[3], P - 1) + 1)
+    page_tables = jnp.asarray(perm[: B * maxp].reshape(B, maxp), jnp.int32)
+    # lengths chosen so windows end mid-page, at page boundary, and at full
+    seq_lens = jnp.asarray([1, ps * 2 + 3, ps * 2, maxp * ps], jnp.int32)
+    for window in (5, ps, ps + 7, 3 * ps):
+        ref = paged_attention_ref(
+            q, k_pages, v_pages, page_tables, seq_lens, window=window
+        )
+        out = paged_attention_pallas(
+            q, k_pages, v_pages, page_tables, seq_lens, interpret=True, window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3, err_msg=f"w={window}"
+        )
+
+
+def test_paged_chunk_attention_windowed_matches_oracle():
+    from agentfield_tpu.ops.pallas.paged_chunk_attention_kernel import (
+        paged_chunk_attention_pallas,
+    )
+
+    key = jax.random.PRNGKey(11)
+    P, Kh, ps, hd, maxp = 9, 2, 8, 32, 6
+    H, C, start_v, n_new, window = 4, 16, 13, 11, 9
+    ks = jax.random.split(key, 3)
+    kp = jax.random.normal(ks[0], (P, Kh, ps, hd), jnp.float32)
+    vp = jax.random.normal(ks[1], (P, Kh, ps, hd), jnp.float32)
+    q = jax.random.normal(ks[2], (C, H, hd), jnp.float32)
+    row = jnp.asarray([3, 5, 7, 8, 0, 0], jnp.int32)
+    k_len = start_v + n_new
+    out = paged_chunk_attention_pallas(
+        q, kp, vp, row, jnp.int32(start_v), jnp.int32(k_len),
+        interpret=True, window=window,
+    )
+    T = maxp * ps
+    kk = kp[row].transpose(0, 2, 1, 3).reshape(1, T, Kh, hd)
+    vv = vp[row].transpose(0, 2, 1, 3).reshape(1, T, Kh, hd)
+    q_pos = (start_v + jnp.arange(C))[None]
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None]
+    oracle = attention_ref(
+        q[None], kk, vv, q_pos, k_pos, k_pos < k_len, window=window
+    )[0]
+    err = float(jnp.max(jnp.abs(out[:n_new] - oracle[:n_new])))
+    assert err < 1e-5, f"windowed chunk kernel diverged: {err}"
+
+
+def test_windowed_engine_chunked_prefill_pallas_matches_ref_engine():
+    """Long windowed prompt through chunked prefill on the chunk kernel:
+    the full kernel-path engine equals the all-ref engine token-for-token."""
+    import dataclasses as _dc
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    cfg = _dc.replace(get_config("llama-tiny"), sliding_window=10)
+    params = init_params(cfg, jax.random.PRNGKey(12))
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(13), (40,), 0, cfg.vocab_size)
+    ).tolist()
+    base = dict(
+        max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8,
+        prefill_chunk=16,
+    )
+    ref_eng = InferenceEngine(params, cfg, EngineConfig(**base))
+    kern_eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(attn_impl="pallas", prefill_impl="flash",
+                     chunk_attn_impl="pallas", **base),
+    )
+    reqs = lambda: [
+        Request(id="w", prompt=list(prompt), sampling=SamplingParams(max_new_tokens=8))
+    ]
+    assert kern_eng.run_to_completion(reqs()) == ref_eng.run_to_completion(reqs())

@@ -348,13 +348,29 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
 
 @functools.lru_cache(maxsize=None)
 def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
-    """Jitted speculative decode step (greedy): the DRAFT model proposes
-    ``spec_k`` tokens autoregressively, the TARGET verifies them in one
-    (spec_k+1)-wide batched chunk forward over the paged cache, and the
-    longest target-agreeing prefix plus the target's own next token are
-    emitted — 1..spec_k+1 tokens per target pass, bit-identical to plain
-    greedy decode (rejected-position KV is garbage beyond the advanced
-    length and is overwritten before it ever becomes attendable).
+    """Jitted speculative decode step with PER-ROW verification modes: the
+    DRAFT model proposes ``spec_k`` tokens autoregressively, the TARGET
+    verifies them in one (spec_k+1)-wide batched chunk forward over the
+    paged cache, and each row emits its accepted prefix plus a correction
+    token — 1..spec_k+1 tokens per target pass.
+
+    Row modes (mixed freely in one dispatch):
+
+    - greedy (temperature<=0): accept while draft == target argmax;
+      correction is the target argmax — bit-identical to plain greedy.
+    - plain-temperature (top_k=0, top_p>=1): textbook rejection sampling
+      (Leviathan et al.): accept d with prob min(1, p(d)/q(d)) where p/q are
+      the TEMPERED target/draft distributions; on rejection sample the
+      normalized residual max(p-q, 0); on full acceptance sample p directly.
+      The emitted distribution is exactly the plain sampler's.
+    - truncated (top_k>0 or top_p<1): proposals are auto-rejected and the
+      correction samples the exact truncated distribution via sample_tokens
+      on the first verify position — 1 token per dispatch, same progress and
+      distribution as normal decode (truncation-aware acceptance would need
+      the filtered q/p vectors; not worth the complexity for these rows).
+
+    Grammar-constrained rows still exclude the whole dispatch (engine
+    ``_spec_eligible``): draft proposals are unsampleable mid-schema.
 
     Both models share the page TABLES and lengths; the draft keeps its own
     page pool (same page ids — one allocator governs both). The draft runs
@@ -366,8 +382,10 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
     maxp = ecfg.max_pages_per_seq
     T = maxp * ps
 
-    def draft_step(dparams, kp, vp, tokens, seq_lens, page_tables):
-        """One greedy draft step (one_step minus sampling/grammar)."""
+    def draft_step(dparams, kp, vp, tokens, seq_lens, page_tables, temps, rng):
+        """One draft step: greedy rows take the argmax, sampled rows draw
+        from the TEMPERED draft distribution (whose probabilities the
+        verifier needs for the acceptance ratio — returned as ``q``)."""
         B = tokens.shape[0]
         x = llama.embed_tokens(dparams, dcfg, tokens)[:, None, :]
         cos, sin = llama.rope_sincos(
@@ -400,9 +418,12 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
 
         x, (kp, vp) = jax.lax.scan(body, x, (dparams["layers"], kp, vp))
         logits = llama.unembed(dparams, dcfg, x)[:, 0]
-        nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        t = jnp.maximum(temps, 1e-6)[:, None]
+        q = jax.nn.softmax(logits / t, axis=-1)  # [B, V] tempered draft dist
+        sampled = jax.random.categorical(rng, logits / t, axis=-1).astype(jnp.int32)
+        nt = jnp.where(temps <= 0, jnp.argmax(logits, axis=-1).astype(jnp.int32), sampled)
         new_lens = seq_lens + (seq_lens > 0).astype(seq_lens.dtype)
-        return nt, new_lens, kp, vp
+        return nt, q, new_lens, kp, vp
 
     def verify(params, k_pages, v_pages, x_tokens, seq_lens, page_tables):
         """Target forward over W positions per row (batched ragged chunk:
@@ -449,38 +470,80 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
 
     def spec(
         params, k_pages, v_pages, dparams, dk_pages, dv_pages,
-        tokens, seq_lens, page_tables,
+        tokens, seq_lens, page_tables, temps, top_ks, top_ps, rng,
     ):
         B = tokens.shape[0]
         active = seq_lens > 0
+        step_keys = jax.random.split(rng, k + 4)  # k+1 draft steps + 3 own
+        accept_key, resid_key, corr_key = (
+            step_keys[k + 1], step_keys[k + 2], step_keys[k + 3]
+        )
 
-        def dbody(carry, _):
+        def dbody(carry, step_key):
             toks, lens, kp, vp = carry
-            nt, lens, kp, vp = draft_step(dparams, kp, vp, toks, lens, page_tables)
-            return (nt, lens, kp, vp), nt
+            nt, q, lens, kp, vp = draft_step(
+                dparams, kp, vp, toks, lens, page_tables, temps, step_key
+            )
+            return (nt, lens, kp, vp), (nt, q)
 
         # k+1 draft steps: proposals d_1..d_k plus one extra step that writes
         # d_k's KV into the draft cache (needed when all k are accepted).
-        (_, _, dk_pages, dv_pages), drafts = jax.lax.scan(
-            dbody, (tokens, seq_lens, dk_pages, dv_pages), None, length=k + 1
+        (_, _, dk_pages, dv_pages), (drafts, qstack) = jax.lax.scan(
+            dbody, (tokens, seq_lens, dk_pages, dv_pages), step_keys[:k + 1]
         )
         dmat = jnp.swapaxes(drafts[:k], 0, 1)  # [B, k] = d_1..d_k
+        qs = jnp.swapaxes(qstack[:k], 0, 1)  # [B, k, V] draft dists
         x_tokens = jnp.concatenate([tokens[:, None], dmat], axis=1)  # [B, W]
         logits, k_pages, v_pages = verify(
             params, k_pages, v_pages, x_tokens, seq_lens, page_tables
         )
         g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W]
-        match = dmat == g[:, :k]  # d_{i+1} vs g_i
+
+        greedy_row = temps <= 0
+        truncated_row = (top_ks > 0) | (top_ps < 1.0)
+        t = jnp.maximum(temps, 1e-6)[:, None, None]
+        p = jax.nn.softmax(logits / t, axis=-1)  # [B, W, V] tempered target
+        # Acceptance per mode. Greedy: exact argmax agreement. Sampled:
+        # u < p(d)/q(d) (as u*q < p — robust at q→0). Truncated: never.
+        match_greedy = dmat == g[:, :k]
+        p_d = jnp.take_along_axis(p[:, :k], dmat[..., None], axis=2)[..., 0]  # [B, k]
+        q_d = jnp.take_along_axis(qs, dmat[..., None], axis=2)[..., 0]  # [B, k]
+        u = jax.random.uniform(accept_key, (B, k))
+        match_sampled = u * q_d < p_d
+        match = jnp.where(
+            greedy_row[:, None],
+            match_greedy,
+            jnp.where(truncated_row[:, None], False, match_sampled),
+        )
         m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [B] 0..k
-        g_m = jnp.take_along_axis(g, m[:, None], axis=1)  # [B, 1] correction
+        # Correction token at position m, per mode. Residual sampling needs
+        # q at the rejection position; position k (full acceptance) has no
+        # draft — its "q" is zero so the residual IS p (plain p-sample).
+        p_m = jnp.take_along_axis(p, m[:, None, None], axis=1)[:, 0]  # [B, V]
+        qs_pad = jnp.concatenate([qs, jnp.zeros((B, 1, qs.shape[-1]), qs.dtype)], axis=1)
+        q_m = jnp.take_along_axis(qs_pad, m[:, None, None], axis=1)[:, 0]  # [B, V]
+        residual = jnp.maximum(p_m - q_m, 0.0)
+        rsum = jnp.sum(residual, axis=-1, keepdims=True)
+        # p==q numerics can zero the residual; fall back to p itself then.
+        resid_dist = jnp.where(rsum > 1e-9, residual, p_m)
+        resid_tok = jax.random.categorical(
+            resid_key, jnp.where(resid_dist > 0, jnp.log(jnp.maximum(resid_dist, 1e-30)), -jnp.inf),
+            axis=-1,
+        ).astype(jnp.int32)
+        # Greedy rows: sample_tokens == argmax (bit-exact). Truncated rows
+        # (m=0): the exact truncated sampler over the normal-decode logits.
+        l_m = jnp.take_along_axis(logits, m[:, None, None], axis=1)[:, 0]  # [B, V]
+        exact_corr = sample_tokens(l_m, corr_key, temps, top_ks, top_ps)
+        plain_sampled = (~greedy_row) & (~truncated_row)
+        c = jnp.where(plain_sampled, resid_tok, exact_corr)[:, None]  # [B, 1]
         t_idx = jnp.arange(W, dtype=jnp.int32)[None]  # [1, W]
         dmat_pad = jnp.concatenate([dmat, jnp.zeros((B, 1), jnp.int32)], axis=1)
-        emitted = jnp.where(t_idx < m[:, None], dmat_pad, g_m)  # [B, W]
+        emitted = jnp.where(t_idx < m[:, None], dmat_pad, c)  # [B, W]
         lsm = jax.nn.log_softmax(logits, axis=-1)
         lps = jnp.take_along_axis(lsm, emitted[:, :, None], axis=2)[:, :, 0]
         counts = jnp.where(active, m + 1, 0)
         new_seq_lens = seq_lens + counts.astype(seq_lens.dtype)
-        next_tokens = jnp.where(active, g_m[:, 0], tokens)
+        next_tokens = jnp.where(active, c[:, 0], tokens)
         return (
             jnp.swapaxes(emitted, 0, 1),  # [W, B] harvest shape
             jnp.swapaxes(lps, 0, 1),
@@ -1704,16 +1767,26 @@ class InferenceEngine:
         return events
 
     def _spec_eligible(self, active_idx: list[int]) -> bool:
-        """Speculation requires every active row greedy and unconstrained
-        (verification compares greedy argmax; grammar masks would make draft
-        proposals unsampleable mid-schema). Checked per dispatch — mixed
-        batches take the normal decode path for that step."""
+        """Speculation handles greedy AND sampled rows per-row in one
+        dispatch (_spec_decode_fn modes); only grammar-constrained rows
+        exclude the dispatch — grammar masks would make draft proposals
+        unsampleable mid-schema. Checked per dispatch: a batch gains/loses
+        eligibility as constrained requests come and go."""
         if self.draft_cache is None or not active_idx:
             return False
         idx = np.asarray(active_idx)
-        if (self.temps[idx] > 0).any() or (self.grammar_states[idx] != 0).any():
+        if (self.grammar_states[idx] != 0).any():
             return False
-        return not any(self.slots[i].req.grammar is not None for i in active_idx)
+        if any(self.slots[i].req.grammar is not None for i in active_idx):
+            return False
+        # At least one row must be able to ACCEPT proposals (greedy or
+        # plain-temperature); an all-truncated batch would pay k+1 draft
+        # forwards plus the wide verify to emit exactly 1 token per row —
+        # strictly worse than one plain decode forward.
+        can_accept = (self.temps[idx] <= 0) | (
+            (self.top_ks[idx] == 0) & (self.top_ps[idx] >= 1.0)
+        )
+        return bool(can_accept.any())
 
     def _dispatch_decode(self) -> None:
         """Dispatch one decode step (no host sync) and record it in-flight."""
@@ -1810,6 +1883,10 @@ class InferenceEngine:
             c["tokens"],
             c["seq_lens"],
             c["page_tables"],
+            c["temps"],
+            c["top_ks"],
+            c["top_ps"],
+            self._next_rng(),
         )
         c["tokens"], c["seq_lens"] = next_toks, new_seq_lens
         return toks, lps, counts, bucket is not None

@@ -9,6 +9,7 @@ per-request settings share one jitted step (no shape specialization).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,7 @@ class SamplingParams:
             raise ValueError("max_new_tokens must be >= 1")
 
 
+@functools.partial(jax.jit, static_argnames=("k_max",))
 def sample_tokens(
     logits: jax.Array,  # [B, V] float32
     rng: jax.Array,

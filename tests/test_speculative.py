@@ -1,9 +1,11 @@
 """Speculative decoding: draft-propose / target-verify (engine spec_k path).
 
-Greedy-equivalent by construction: the target's one (spec_k+1)-wide verify
-forward decides every emitted token, so output must match plain greedy
-decode token-for-token; the draft only changes how many target passes that
-takes. No reference analogue (its models are external providers)."""
+Per-row verification modes: greedy rows accept on target-argmax agreement
+(bit-identical to plain greedy — the target's verify forward decides every
+token), plain-temperature rows run Leviathan rejection sampling (emitted
+distribution exactly the plain sampler's), truncated rows advance one
+exactly-sampled token per dispatch. Grammar rows exclude the dispatch.
+No reference analogue (its models are external providers)."""
 
 import asyncio
 
@@ -70,7 +72,10 @@ def test_self_draft_accepts_everything(params):
     assert plain.run_to_completion(_reqs(n=2, new=16)) == out
 
 
-def test_mixed_batch_falls_back(params, dparams):
+def test_mixed_batch_speculates_per_row(params, dparams):
+    """A sampled row no longer disables speculation: greedy rows verify by
+    argmax, the temperature row by rejection sampling — in the SAME
+    dispatches. Greedy rows stay bit-exact vs the plain engine."""
     eng = InferenceEngine(
         params, CFG, EngineConfig(spec_k=3, **BASE), draft=(dparams, DCFG)
     )
@@ -83,7 +88,37 @@ def test_mixed_batch_falls_back(params, dparams):
     ]
     out = eng.run_to_completion(reqs)
     assert all(len(v) == 8 for v in out.values())
-    assert eng.stats["spec_steps"] == 0  # a sampled row disables speculation
+    assert eng.stats["spec_steps"] > 0  # mixed batches now speculate
+    plain = InferenceEngine(params, CFG, EngineConfig(**BASE))
+    plain_out = plain.run_to_completion(_reqs(n=2, new=8))
+    for rid in plain_out:  # greedy rows: exact equivalence preserved
+        assert out[rid] == plain_out[rid], rid
+
+
+def test_grammar_row_still_disables_spec(params, dparams):
+    """Grammar-constrained rows exclude the dispatch (draft proposals are
+    unsampleable mid-schema) — the one remaining batch-global fallback."""
+    from agentfield_tpu.serving.grammar import compile_json_schema
+
+    vocab = [bytes([i]) for i in range(256)]
+    vocab += [b"\x00\x01" for _ in range(CFG.vocab_size - 256)]
+    g = compile_json_schema(
+        {"type": "object", "properties": {"a": {"type": "integer"}},
+         "required": ["a"]},
+        vocab,
+    )
+    eng = InferenceEngine(
+        params, CFG,
+        EngineConfig(spec_k=3, grammar_slots=g.n_states + 1, **BASE),
+        draft=(dparams, DCFG),
+    )
+    reqs = _reqs(n=1, new=6) + [
+        Request(id="j", prompt=[3, 5], grammar=g,
+                sampling=SamplingParams(max_new_tokens=6, stop_token_ids=(0,)))
+    ]
+    out = eng.run_to_completion(reqs)
+    assert all(len(v) <= 6 for v in out.values())
+    assert eng.stats["spec_steps"] == 0
 
 
 def test_spec_with_sessions_prefix_reuse(params, dparams):
@@ -143,27 +178,118 @@ def test_model_node_spec_knobs(params):
 
 
 def test_draft_resyncs_after_fallback_steps(params):
-    """A sampled request joining the batch forces normal-decode fallback;
-    when it leaves, the draft cache must catch up (suffix replay) or
-    acceptance collapses. Self-draft makes the signal sharp: post-resync
-    steps should still accept nearly everything."""
+    """A GRAMMAR request joining the batch forces normal-decode fallback
+    (the one remaining spec-ineligible row kind); when it leaves, the draft
+    cache must catch up (suffix replay) or acceptance collapses. Self-draft
+    makes the signal sharp: post-resync steps should still accept nearly
+    everything."""
+    from agentfield_tpu.serving.grammar import compile_json_schema
+
+    vocab = [bytes([i]) for i in range(256)]
+    vocab += [b"\x00\x01" for _ in range(CFG.vocab_size - 256)]
+    g = compile_json_schema({"type": "boolean"}, vocab)
+
     def reqs():
         return [
             Request(id="greedy", prompt=[5, 6, 7],
                     sampling=SamplingParams(max_new_tokens=20)),
-            Request(id="hot", prompt=[9, 10],
-                    sampling=SamplingParams(max_new_tokens=4, temperature=0.8)),
+            Request(id="hot", prompt=[9, 10], grammar=g,
+                    sampling=SamplingParams(max_new_tokens=6,
+                                            stop_token_ids=(0,))),
         ]
 
     spec = InferenceEngine(
-        params, CFG, EngineConfig(spec_k=3, **BASE), draft=(params, CFG)
+        params, CFG, EngineConfig(spec_k=3, grammar_slots=64, **BASE),
+        draft=(params, CFG),
     )
     got = spec.run_to_completion(reqs())
-    assert len(got["greedy"]) == 20 and len(got["hot"]) == 4
+    assert len(got["greedy"]) == 20 and 1 <= len(got["hot"]) <= 6
     # fallback happened while 'hot' was active, spec resumed after
     assert spec.stats["spec_steps"] > 0
     per_step = spec.stats["spec_emitted"] / spec.stats["spec_steps"]
     assert per_step > 2.0, spec.stats  # resync keeps self-draft acceptance high
     # greedy row's output matches the plain engine run of the same pair
-    plain = InferenceEngine(params, CFG, EngineConfig(**BASE))
+    plain = InferenceEngine(params, CFG, EngineConfig(grammar_slots=64, **BASE))
     assert plain.run_to_completion(reqs())["greedy"] == got["greedy"]
+
+
+def test_mixed_batch_self_draft_accepts_sampled_rows(params, dparams):
+    """With a SELF-draft (q == p) every sampled proposal is accepted
+    (acceptance ratio min(1, p/q) = 1), so a mixed greedy+temperature batch
+    must average > 1 emitted token per speculative dispatch — the
+    multi-token win now extends to sampled traffic."""
+    eng = InferenceEngine(
+        params, CFG, EngineConfig(spec_k=3, **BASE), draft=(params, CFG)
+    )
+    reqs = _reqs(n=2, new=16) + [
+        Request(
+            id="hot", prompt=[3, 5, 9],
+            sampling=SamplingParams(max_new_tokens=16, temperature=0.8),
+        )
+    ]
+    out = eng.run_to_completion(reqs)
+    assert all(len(v) == 16 for v in out.values())
+    assert eng.stats["spec_steps"] > 0
+    emitted_per_step = sum(len(v) for v in out.values()) / eng.stats["decode_steps"]
+    assert emitted_per_step > 1.5, eng.stats
+
+
+def test_rejection_sampling_matches_plain_distribution(params, dparams):
+    """Monte carlo: with an INDEPENDENT draft, the rejection sampler's
+    emitted token distribution for a temperature row must equal the plain
+    sampler's (Leviathan-exactness). The target's lm_head is scaled 30x so
+    its distribution is PEAKED (random-init logits are near-uniform, where
+    any two finite samples are far apart in TV and the test has no power);
+    the draft stays flat, so acceptance is low and the residual-sampling
+    path — the part most likely to be wrong — carries most of the mass.
+    Per-token tolerance is ~3 sigma for n=720."""
+    n_runs, new, temp = 240, 3, 1.0
+    sharp = dict(params, lm_head=params["lm_head"] * 30.0)
+    plain_eng = InferenceEngine(sharp, CFG, EngineConfig(**BASE))
+    spec_eng = InferenceEngine(
+        sharp, CFG, EngineConfig(spec_k=2, **BASE), draft=(dparams, DCFG)
+    )
+
+    def marginal(eng):
+        # one engine, many runs: its rng stream advances across runs, so
+        # each run is an independent sample (and nothing recompiles)
+        counts = {}
+        total = 0
+        for i in range(n_runs):
+            out = eng.run_to_completion([
+                Request(id=f"d{i}", prompt=[7, 11, 13],
+                        sampling=SamplingParams(max_new_tokens=new, temperature=temp))
+            ])[f"d{i}"]
+            for t in out:
+                counts[t] = counts.get(t, 0) + 1
+                total += 1
+        return {t: c / total for t, c in counts.items()}
+
+    p_plain = marginal(plain_eng)
+    p_spec = marginal(spec_eng)
+    assert spec_eng.stats["spec_steps"] > 0
+    # every token the plain sampler visits with noticeable mass must carry
+    # statistically-equal mass under the rejection sampler
+    major = {t for t, p in p_plain.items() if p >= 0.03}
+    assert major, p_plain  # the 30x lm_head scaling must concentrate it
+    for t in major:
+        diff = abs(p_plain[t] - p_spec.get(t, 0.0))
+        assert diff < 0.06, (t, p_plain[t], p_spec.get(t, 0.0))
+    support = set(p_plain) | set(p_spec)
+    tv = 0.5 * sum(abs(p_plain.get(t, 0.0) - p_spec.get(t, 0.0)) for t in support)
+    assert tv < 0.25, f"total variation {tv:.3f} (support {len(support)})"
+
+
+def test_all_truncated_batch_skips_spec(params, dparams):
+    """top-k/top-p rows can never accept proposals; a batch made only of
+    them must take plain decode (spec would pay k+1 draft forwards + the
+    wide verify to emit 1 token per row)."""
+    eng = InferenceEngine(
+        params, CFG, EngineConfig(spec_k=3, **BASE), draft=(dparams, DCFG)
+    )
+    out = eng.run_to_completion([
+        Request(id="n", prompt=[3, 5],
+                sampling=SamplingParams(max_new_tokens=6, temperature=0.8, top_p=0.9))
+    ])
+    assert len(out["n"]) == 6
+    assert eng.stats["spec_steps"] == 0

@@ -23,6 +23,7 @@ all shapes are static per config so serving stays compile-friendly.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import io
 import struct
 import wave as _wave
@@ -57,6 +58,17 @@ class AudioConfig:
     out_dim: int = 2048  # LLM hidden size the projector maps into
     layer_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    frontend: str = "group"  # "group": frame_group mel frames → one linear
+    # token (compact, train-from-scratch). "conv": Whisper's two-Conv1d stem
+    # (k=3; stride 1 then conv_stride) — the layout pretrained Whisper
+    # encoders load into (load_whisper_encoder).
+    conv_stride: int = 2
+    mel_impl: str = "htk"  # "htk": this file's filterbank on raw frames.
+    # "whisper": slaney-normalized filters, reflect-padded centered frames,
+    # log10 + per-clip max-8 floor + (x+4)/4 — bit-matches
+    # WhisperFeatureExtractor so pretrained conv stems see their training
+    # distribution.
+    gelu_exact: bool = False  # erf gelu (HF "gelu") instead of tanh approx
 
     @property
     def max_samples(self) -> int:
@@ -64,10 +76,16 @@ class AudioConfig:
 
     @property
     def n_frames(self) -> int:
+        if self.mel_impl == "whisper":
+            # centered frames (reflect pad n_fft//2 each side), last dropped
+            return self.max_samples // self.hop
         return 1 + (self.max_samples - self.n_fft) // self.hop
 
     @property
     def n_tokens(self) -> int:
+        if self.frontend == "conv":
+            # conv1 stride 1 (same), conv2 stride conv_stride (same padding)
+            return -(-self.n_frames // self.conv_stride)
         return self.n_frames // self.frame_group
 
 
@@ -100,6 +118,14 @@ CONFIGS = {
     "audio-tiny": AudioConfig(
         n_fft=128, hop=64, n_mels=16, max_seconds=1.0, frame_group=4,
         hidden_size=32, num_layers=2, num_heads=2, out_dim=128,
+    ),
+    # openai/whisper-tiny encoder shape (load_whisper_encoder fills the
+    # exact dims from the checkpoint's config.json; this preset documents
+    # the family and serves random-init smoke tests)
+    "whisper-tiny": AudioConfig(
+        max_seconds=30.0, hidden_size=384, num_layers=4, num_heads=6,
+        frontend="conv", mel_impl="whisper", gelu_exact=True,
+        dtype="float32",
     ),
 }
 
@@ -151,12 +177,66 @@ def _mel_filterbank(cfg: AudioConfig) -> np.ndarray:
     return fb
 
 
+def _mel_filterbank_slaney(cfg: AudioConfig) -> np.ndarray:
+    """[n_fft//2+1, n_mels] slaney-scale, slaney-normalized filterbank —
+    librosa's default and therefore WhisperFeatureExtractor's (continuous
+    triangles over FFT bin frequencies, not floored bins)."""
+    n_bins = cfg.n_fft // 2 + 1
+    fftfreqs = np.linspace(0.0, cfg.sample_rate / 2.0, n_bins)
+
+    def hz_to_mel(f):
+        f = np.asarray(f, np.float64)
+        mel = f * 3.0 / 200.0  # linear below 1 kHz
+        log_reg = f >= 1000.0
+        mel = np.where(log_reg, 15.0 + np.log(np.maximum(f, 1e-9) / 1000.0) / (np.log(6.4) / 27.0), mel)
+        return mel
+
+    def mel_to_hz(m):
+        m = np.asarray(m, np.float64)
+        f = m * 200.0 / 3.0
+        log_reg = m >= 15.0
+        return np.where(log_reg, 1000.0 * np.exp((np.log(6.4) / 27.0) * (m - 15.0)), f)
+
+    mel_pts = np.linspace(
+        hz_to_mel(0.0), hz_to_mel(cfg.sample_rate / 2.0), cfg.n_mels + 2
+    )
+    hz_pts = mel_to_hz(mel_pts)  # [n_mels + 2]
+    fdiff = np.diff(hz_pts)
+    ramps = hz_pts[:, None] - fftfreqs[None, :]  # [n_mels+2, n_bins]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))  # [n_mels, n_bins]
+    enorm = 2.0 / (hz_pts[2 : cfg.n_mels + 2] - hz_pts[:cfg.n_mels])
+    fb *= enorm[:, None]
+    return fb.T.astype(np.float32)  # [n_bins, n_mels]
+
+
 def log_mel(cfg: AudioConfig, wave: jax.Array) -> jax.Array:
     """[B, max_samples] float in [-1, 1] → [B, n_frames, n_mels] log-mel.
 
     Overlapping frames are one strided gather (static index matrix), the DFT
     is ``jnp.fft.rfft`` over the last axis, and the filterbank is a matmul —
-    no Python loops inside jit."""
+    no Python loops inside jit. mel_impl="whisper" reproduces
+    WhisperFeatureExtractor: reflect-padded centered frames, periodic hann,
+    slaney filters, log10 with a per-clip max-8 floor, (x+4)/4 scaling."""
+    if cfg.mel_impl == "whisper":
+        half = cfg.n_fft // 2
+        padded = jnp.pad(wave, ((0, 0), (half, half)), mode="reflect")
+        # n_frames+1 centered frames; Whisper drops the final one
+        idx = (
+            np.arange(cfg.n_frames + 1)[:, None] * cfg.hop
+            + np.arange(cfg.n_fft)[None, :]
+        )
+        frames = padded[:, idx]  # [B, n_frames+1, n_fft]
+        n = np.arange(cfg.n_fft, dtype=np.float32)
+        window = jnp.asarray(0.5 * (1.0 - np.cos(2.0 * np.pi * n / cfg.n_fft)))
+        spec = jnp.fft.rfft(frames.astype(jnp.float32) * window, axis=-1)
+        power = (jnp.abs(spec) ** 2)[:, :-1]  # [B, n_frames, n_bins]
+        mel = power @ jnp.asarray(_mel_filterbank_slaney(cfg))
+        log_spec = jnp.log10(jnp.maximum(mel, 1e-10))
+        peak = jnp.max(log_spec, axis=(1, 2), keepdims=True)
+        log_spec = jnp.maximum(log_spec, peak - 8.0)
+        return (log_spec + 4.0) / 4.0
     idx = (
         np.arange(cfg.n_frames)[:, None] * cfg.hop + np.arange(cfg.n_fft)[None, :]
     )  # [n_frames, n_fft] static
@@ -192,20 +272,41 @@ def _init_encoder_layers(key: jax.Array, L: int, d: int, f: int, dt) -> Params:
         "ln2_w": jnp.ones((L, d), dt),
         "ln2_b": jnp.zeros((L, d), dt),
         "wqkv": norm(ks[0], (L, d, 3 * d)),
+        "bqkv": jnp.zeros((L, 3 * d), dt),  # Whisper: q/v biased, k zero
         "wo": norm(ks[1], (L, d, d)),
+        "bo": jnp.zeros((L, d), dt),
         "w1": norm(ks[2], (L, d, f)),
+        "b1": jnp.zeros((L, f), dt),
         "w2": norm(ks[3], (L, f, d)),
+        "b2": jnp.zeros((L, d), dt),
     }
 
 
-def _encoder(x: jax.Array, layers: Params, num_heads: int, eps: float) -> jax.Array:
+def _encoder(
+    x: jax.Array, layers: Params, num_heads: int, eps: float,
+    gelu_exact: bool = False,
+) -> jax.Array:
     """Bidirectional pre-LN transformer over [B, N, d]; one lax.scan."""
     B, N, d = x.shape
     hd = d // num_heads
+    act = functools.partial(jax.nn.gelu, approximate=not gelu_exact)
+    # Bias keys arrived with Whisper support; tower checkpoints saved before
+    # then upgrade in place to zero biases (identity) instead of KeyError-ing.
+    if "bqkv" not in layers:
+        L = layers["wqkv"].shape[0]
+        f = layers["w1"].shape[-1]
+        dt = layers["wqkv"].dtype
+        layers = {
+            **layers,
+            "bqkv": jnp.zeros((L, 3 * d), dt),
+            "bo": jnp.zeros((L, d), dt),
+            "b1": jnp.zeros((L, f), dt),
+            "b2": jnp.zeros((L, d), dt),
+        }
 
     def body(x, lp):
         h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], eps)
-        qkv = (h @ lp["wqkv"]).reshape(B, N, 3, num_heads, hd)
+        qkv = (h @ lp["wqkv"] + lp["bqkv"]).reshape(B, N, 3, num_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         logits = jnp.einsum(
             "bnhd,bmhd->bhnm", q, k, preferred_element_type=jnp.float32
@@ -214,9 +315,10 @@ def _encoder(x: jax.Array, layers: Params, num_heads: int, eps: float) -> jax.Ar
         attn = jnp.einsum(
             "bhnm,bmhd->bnhd", probs, v, preferred_element_type=jnp.float32
         ).astype(x.dtype)
-        x = x + attn.reshape(B, N, d) @ lp["wo"]
+        x = x + (attn.reshape(B, N, d) @ lp["wo"] + lp["bo"])
         h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], eps)
-        x = x + jax.nn.gelu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+        up = act((h @ lp["w1"] + lp["b1"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + (up @ lp["w2"] + lp["b2"])
         return x, None
 
     x, _ = jax.lax.scan(body, x, layers)
@@ -231,13 +333,24 @@ def _encoder(x: jax.Array, layers: Params, num_heads: int, eps: float) -> jax.Ar
 def init_audio_params(cfg: AudioConfig, key: jax.Array) -> Params:
     dt = jnp.dtype(cfg.dtype)
     d = cfg.hidden_size
-    keys = jax.random.split(key, 5)
+    keys = jax.random.split(key, 6)
 
     def norm(k, shape, scale=0.02):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
+    stem = (
+        {
+            # Whisper conv stem: [out, in, k] (lax OIH layout)
+            "conv1_w": norm(keys[0], (d, cfg.n_mels, 3)),
+            "conv1_b": jnp.zeros((d,), dt),
+            "conv2_w": norm(keys[5], (d, d, 3)),
+            "conv2_b": jnp.zeros((d,), dt),
+        }
+        if cfg.frontend == "conv"
+        else {"frame_embed": norm(keys[0], (cfg.frame_group * cfg.n_mels, d))}
+    )
     return {
-        "frame_embed": norm(keys[0], (cfg.frame_group * cfg.n_mels, d)),
+        **stem,
         "pos_embed": norm(keys[1], (cfg.n_tokens, d)),
         "layers": _init_encoder_layers(keys[2], cfg.num_layers, d, d * cfg.mlp_ratio, dt),
         "final_ln_w": jnp.ones((d,), dt),
@@ -257,17 +370,166 @@ def audio_encode(params: Params, cfg: AudioConfig, wave: jax.Array) -> jax.Array
     dt = jnp.dtype(cfg.dtype)
     mel = log_mel(cfg, wave)  # [B, n_frames, n_mels]
     B = mel.shape[0]
-    # group consecutive frames into one token — a reshape, no conv unrolling
-    usable = cfg.n_tokens * cfg.frame_group
-    x = mel[:, :usable].reshape(B, cfg.n_tokens, cfg.frame_group * cfg.n_mels)
-    x = x.astype(dt) @ params["frame_embed"] + params["pos_embed"]
-    x = _encoder(x, params["layers"], cfg.num_heads, cfg.layer_norm_eps)
-    x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    x = encode_hidden(params, cfg, mel.astype(dt))
     h = jax.nn.gelu((x @ params["proj_w1"]).astype(jnp.float32)).astype(x.dtype)
     return h @ params["proj_w2"]
 
 
+def encode_hidden(params: Params, cfg: AudioConfig, mel: jax.Array) -> jax.Array:
+    """[B, n_frames, n_mels] mel → [B, n_tokens, hidden] encoder states
+    (pre-projector; for a Whisper checkpoint these match the HF encoder's
+    last_hidden_state)."""
+    B = mel.shape[0]
+    if cfg.frontend == "conv":
+        act = functools.partial(jax.nn.gelu, approximate=not cfg.gelu_exact)
+        xc = jnp.transpose(mel, (0, 2, 1))  # [B, n_mels, T]
+        dn = ("NCH", "OIH", "NCH")
+        # explicit symmetric pad (torch Conv1d padding=1): lax "SAME" puts
+        # the stride-2 leftover pad on the right only, shifting every window
+        xc = act(
+            jax.lax.conv_general_dilated(
+                xc.astype(jnp.float32), params["conv1_w"].astype(jnp.float32),
+                window_strides=(1,), padding=[(1, 1)], dimension_numbers=dn,
+            )
+            + params["conv1_b"].astype(jnp.float32)[None, :, None]
+        )
+        xc = act(
+            jax.lax.conv_general_dilated(
+                xc, params["conv2_w"].astype(jnp.float32),
+                window_strides=(cfg.conv_stride,), padding=[(1, 1)],
+                dimension_numbers=dn,
+            )
+            + params["conv2_b"].astype(jnp.float32)[None, :, None]
+        )
+        x = jnp.transpose(xc, (0, 2, 1)).astype(mel.dtype)  # [B, n_tokens, d]
+        x = x + params["pos_embed"]
+    else:
+        # group consecutive frames into one token — a reshape, no conv
+        usable = cfg.n_tokens * cfg.frame_group
+        x = mel[:, :usable].reshape(B, cfg.n_tokens, cfg.frame_group * cfg.n_mels)
+        x = x @ params["frame_embed"] + params["pos_embed"]
+    x = _encoder(
+        x, params["layers"], cfg.num_heads, cfg.layer_norm_eps,
+        gelu_exact=cfg.gelu_exact,
+    )
+    return _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+
+
 audio_encode_jit = jax.jit(audio_encode, static_argnames=("cfg",))
+
+
+# ---------------------------------------------------------------------------
+# pretrained Whisper encoder loading
+# ---------------------------------------------------------------------------
+
+
+def load_whisper_encoder(
+    path: str, out_dim: int = 2048, dtype: str = "float32", key=None
+) -> tuple[AudioConfig, Params]:
+    """HF Whisper checkpoint directory → (AudioConfig, params) for this
+    tower: the ENCODER weights load exactly (conv stem, sinusoidal
+    positions, stacked attention/MLP layers, final LN — verified against
+    transformers' last_hidden_state by tests), while the LLM-space projector
+    stays random-init (the multimodal adapter has no Whisper counterpart;
+    it is the part a fusion finetune trains, as in LLaVA-style systems).
+
+    Reference capability: audio parts ride external providers
+    (sdk/python/agentfield/agent_ai.py:750-1002); here the encoder runs
+    in-tree with real pretrained weights.
+    """
+    import json
+    from pathlib import Path as _Path
+
+    from safetensors import safe_open
+
+    p = _Path(path)
+    hf = json.loads((p / "config.json").read_text())
+    d = int(hf["d_model"])
+    cfg = AudioConfig(
+        sample_rate=16000,
+        n_fft=400,
+        hop=160,
+        n_mels=int(hf["num_mel_bins"]),
+        max_seconds=float(hf.get("max_source_positions", 1500) * 2 * 160) / 16000.0,
+        hidden_size=d,
+        num_layers=int(hf["encoder_layers"]),
+        num_heads=int(hf["encoder_attention_heads"]),
+        mlp_ratio=int(hf["encoder_ffn_dim"]) // d,
+        out_dim=out_dim,
+        frontend="conv",
+        mel_impl="whisper",
+        gelu_exact=hf.get("activation_function", "gelu") == "gelu",
+        dtype=dtype,
+    )
+    tensors: dict[str, np.ndarray] = {}
+    found_any = False
+    for f in sorted(p.glob("*.safetensors")):
+        found_any = True
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                # only the encoder is used — skip decoder/proj_out shards
+                # (half of whisper-large's ~3 GB otherwise loads for nothing)
+                if name.startswith(("model.encoder.", "encoder.")):
+                    tensors[name] = sf.get_tensor(name)
+    if not found_any:
+        raise FileNotFoundError(f"no *.safetensors under {p}")
+    if not tensors:
+        raise KeyError(f"no encoder tensors in {p} (not a Whisper checkpoint?)")
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("model.encoder.", "encoder."):
+            if prefix + name in tensors:
+                return tensors[prefix + name]
+        raise KeyError(f"missing encoder tensor {name!r}")
+
+    dt = jnp.dtype(dtype)
+    L = cfg.num_layers
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        mats = [get(fmt.format(i)) for i in range(L)]
+        out = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(out, dt)
+
+    # qkv: HF stores q/k/v separately; k has NO bias (Whisper convention)
+    wq = stack("layers.{}.self_attn.q_proj.weight")
+    wk = stack("layers.{}.self_attn.k_proj.weight")
+    wv = stack("layers.{}.self_attn.v_proj.weight")
+    bq = stack("layers.{}.self_attn.q_proj.bias", transpose=False)
+    bv = stack("layers.{}.self_attn.v_proj.bias", transpose=False)
+    layers = {
+        "ln1_w": stack("layers.{}.self_attn_layer_norm.weight", transpose=False),
+        "ln1_b": stack("layers.{}.self_attn_layer_norm.bias", transpose=False),
+        "ln2_w": stack("layers.{}.final_layer_norm.weight", transpose=False),
+        "ln2_b": stack("layers.{}.final_layer_norm.bias", transpose=False),
+        "wqkv": jnp.concatenate([wq, wk, wv], axis=2),
+        "bqkv": jnp.concatenate([bq, jnp.zeros_like(bq), bv], axis=1),
+        "wo": stack("layers.{}.self_attn.out_proj.weight"),
+        "bo": stack("layers.{}.self_attn.out_proj.bias", transpose=False),
+        "w1": stack("layers.{}.fc1.weight"),
+        "b1": stack("layers.{}.fc1.bias", transpose=False),
+        "w2": stack("layers.{}.fc2.weight"),
+        "b2": stack("layers.{}.fc2.bias", transpose=False),
+    }
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+
+    def rand(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    params: Params = {
+        "conv1_w": jnp.asarray(get("conv1.weight"), dt),  # [d, n_mels, 3]
+        "conv1_b": jnp.asarray(get("conv1.bias"), dt),
+        "conv2_w": jnp.asarray(get("conv2.weight"), dt),
+        "conv2_b": jnp.asarray(get("conv2.bias"), dt),
+        "pos_embed": jnp.asarray(get("embed_positions.weight"), dt)[: cfg.n_tokens],
+        "layers": layers,
+        "final_ln_w": jnp.asarray(get("layer_norm.weight"), dt),
+        "final_ln_b": jnp.asarray(get("layer_norm.bias"), dt),
+        "proj_w1": rand(k1, (d, out_dim)),
+        "proj_w2": rand(k2, (out_dim, out_dim)),
+    }
+    return cfg, params
 
 
 # ---------------------------------------------------------------------------

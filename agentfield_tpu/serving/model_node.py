@@ -244,7 +244,19 @@ class ModelBackend:
             )
 
             if isinstance(audio, str):
-                audio = get_audio_config(audio)
+                import os as _os
+
+                from agentfield_tpu.models.audio import CONFIGS as _ACFGS
+
+                if audio in _ACFGS:  # registered names win over cwd paths
+                    audio = get_audio_config(audio)
+                elif _os.path.isdir(audio):
+                    # checkpoint directory → pretrained Whisper encoder
+                    from agentfield_tpu.models.audio import load_whisper_encoder
+
+                    audio = load_whisper_encoder(audio, out_dim=cfg.hidden_size)
+                else:
+                    audio = get_audio_config(audio)  # raises with known names
             if isinstance(audio, AudioConfig):
                 self.audio_cfg = audio
                 self.audio_params = init_audio_params(audio, _jax.random.PRNGKey(seed + 2))

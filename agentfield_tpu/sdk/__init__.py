@@ -1,3 +1,10 @@
-from agentfield_tpu.sdk.agent import Agent, AgentRouter  # noqa: F401
+from agentfield_tpu.sdk.agent import Agent, AgentRouter, AIConfig  # noqa: F401
 from agentfield_tpu.sdk.context import ExecutionContext  # noqa: F401
 from agentfield_tpu.sdk.client import ControlPlaneClient  # noqa: F401
+from agentfield_tpu.sdk.multimodal import (  # noqa: F401
+    AudioContent,
+    FileContent,
+    ImageContent,
+    TextContent,
+    UnsupportedModalityError,
+)

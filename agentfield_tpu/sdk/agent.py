@@ -17,6 +17,8 @@ heartbeats). Differences, deliberate:
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import dataclasses
 import inspect
 import json
 import os
@@ -56,12 +58,62 @@ def _schema_from_signature(fn: Callable) -> tuple[type[pydantic.BaseModel], dict
     return model, model.model_json_schema(), ctx_params
 
 
+@dataclasses.dataclass(frozen=True)
+class AIConfig:
+    """Default ai() parameters, merged hierarchically: agent-level <
+    reasoner-level < explicit call-site arguments (reference AIConfig merge,
+    agent_ai.py:189-215). None fields are "unset" and defer to the next
+    level down; unset everywhere falls back to ai()'s builtin defaults."""
+
+    model: str | None = None
+    max_new_tokens: int | None = None
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+    stop_token_ids: tuple[int, ...] | None = None
+    timeout: float | None = None
+    context_overflow: str | None = None
+    output: str | None = None
+
+    def overrides(self) -> dict[str, Any]:
+        return {
+            k: v for k, v in dataclasses.asdict(self).items() if v is not None
+        }
+
+
+def _norm_ai_defaults(v: "AIConfig | dict | None", where: str) -> "AIConfig | None":
+    if v is None or isinstance(v, AIConfig):
+        return v
+    if isinstance(v, dict):
+        known = {f.name for f in dataclasses.fields(AIConfig)}
+        bad = set(v) - known
+        if bad:
+            raise ValueError(
+                f"{where}: unknown ai_defaults keys {sorted(bad)}; "
+                f"known: {sorted(known)}"
+            )
+        return AIConfig(**v)
+    raise TypeError(f"{where}: ai_defaults must be AIConfig or dict, got {type(v).__name__}")
+
+
+# The component currently executing on this task (set around dispatch) —
+# how ai() finds the reasoner-level AIConfig without threading it through
+# every call site.
+_current_component: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "agentfield_current_component", default=None
+)
+
+
 class ComponentDef:
-    def __init__(self, id: str, kind: str, fn: Callable, description: str):
+    def __init__(
+        self, id: str, kind: str, fn: Callable, description: str,
+        ai_defaults: "AIConfig | dict | None" = None,
+    ):
         self.id = id
         self.kind = kind  # "reasoner" | "skill"
         self.fn = fn
         self.description = description
+        self.ai_defaults = _norm_ai_defaults(ai_defaults, f"{kind} {id!r}")
         self.input_model, self.input_schema, self.ctx_params = _schema_from_signature(fn)
         self._passthrough = False
 
@@ -122,18 +174,21 @@ class AgentRouter:
         self.prefix = prefix.strip("_")
         self.components: list[ComponentDef] = []
 
-    def reasoner(self, id: str | None = None, description: str = ""):
-        return self._decorator("reasoner", id, description)
+    def reasoner(self, id: str | None = None, description: str = "", ai_defaults=None):
+        return self._decorator("reasoner", id, description, ai_defaults)
 
-    def skill(self, id: str | None = None, description: str = ""):
-        return self._decorator("skill", id, description)
+    def skill(self, id: str | None = None, description: str = "", ai_defaults=None):
+        return self._decorator("skill", id, description, ai_defaults)
 
-    def _decorator(self, kind: str, id: str | None, description: str):
+    def _decorator(self, kind: str, id: str | None, description: str, ai_defaults=None):
         def deco(fn):
             cid = id or fn.__name__
             if self.prefix:
                 cid = f"{self.prefix}_{cid}"
-            self.components.append(ComponentDef(cid, kind, fn, description or (fn.__doc__ or "")))
+            self.components.append(
+                ComponentDef(cid, kind, fn, description or (fn.__doc__ or ""),
+                             ai_defaults=ai_defaults)
+            )
             return fn
 
         return deco
@@ -149,9 +204,13 @@ class Agent:
         kind: str = "agent",
         heartbeat_interval: float = 2.0,  # reference enhanced-heartbeat cadence
         metadata: dict | None = None,
+        ai_defaults: "AIConfig | dict | None" = None,  # agent-level ai()
+        # defaults; per-reasoner ai_defaults= and explicit call arguments
+        # override field-by-field (reference agent_ai.py:189-215)
     ):
         if "." in node_id:
             raise ValueError("node_id must not contain '.'")
+        self.ai_defaults = _norm_ai_defaults(ai_defaults, f"Agent {node_id!r}")
         self.node_id = node_id
         self.kind = kind
         self.host = host
@@ -169,15 +228,18 @@ class Agent:
 
     # -- decorators -----------------------------------------------------
 
-    def reasoner(self, id: str | None = None, description: str = ""):
-        return self._decorator("reasoner", id, description)
+    def reasoner(self, id: str | None = None, description: str = "", ai_defaults=None):
+        return self._decorator("reasoner", id, description, ai_defaults)
 
-    def skill(self, id: str | None = None, description: str = ""):
-        return self._decorator("skill", id, description)
+    def skill(self, id: str | None = None, description: str = "", ai_defaults=None):
+        return self._decorator("skill", id, description, ai_defaults)
 
-    def _decorator(self, kind: str, id: str | None, description: str):
+    def _decorator(self, kind: str, id: str | None, description: str, ai_defaults=None):
         def deco(fn):
-            comp = ComponentDef(id or fn.__name__, kind, fn, description or (fn.__doc__ or ""))
+            comp = ComponentDef(
+                id or fn.__name__, kind, fn, description or (fn.__doc__ or ""),
+                ai_defaults=ai_defaults,
+            )
             self._add_component(comp)
             return fn
 
@@ -274,9 +336,11 @@ class Agent:
 
     async def _run(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> Any:
         token = set_context(ctx)
+        ctoken = _current_component.set(comp.id)
         try:
             return await comp.invoke(payload, ctx)
         finally:
+            _current_component.reset(ctoken)
             reset_context(token)
 
     async def _run_tracked(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> None:
@@ -344,22 +408,44 @@ class Agent:
             candidates.sort(key=rank)  # stable: registration order within rank
         return candidates
 
+    _AI_BUILTIN = {
+        "model": None, "max_new_tokens": 128, "temperature": 0.0,
+        "top_k": 0, "top_p": 1.0, "stop_token_ids": None, "timeout": 600.0,
+        "context_overflow": "truncate_left", "output": "text",
+    }
+
+    def _resolve_ai_params(self, explicit: dict[str, Any]) -> dict[str, Any]:
+        """builtin < agent ai_defaults < executing reasoner's ai_defaults <
+        explicit (non-None) call arguments — reference agent_ai.py:189-215."""
+        merged = dict(self._AI_BUILTIN)
+        if self.ai_defaults is not None:
+            merged.update(self.ai_defaults.overrides())
+        cid = _current_component.get()
+        comp = self.components.get(cid) if cid else None
+        if comp is not None and comp.ai_defaults is not None:
+            merged.update(comp.ai_defaults.overrides())
+        merged.update({k: v for k, v in explicit.items() if v is not None})
+        if merged["stop_token_ids"] is not None:
+            merged["stop_token_ids"] = list(merged["stop_token_ids"])
+        return merged
+
     async def ai(
         self,
         prompt: str | None = None,
         tokens: list[int] | None = None,
         model: str | None = None,
-        max_new_tokens: int = 128,
-        temperature: float = 0.0,
-        top_k: int = 0,
-        top_p: float = 1.0,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
         stop_token_ids: list[int] | None = None,
-        timeout: float = 600.0,
+        timeout: float | None = None,
         schema: dict[str, Any] | None = None,
-        context_overflow: str = "truncate_left",
+        context_overflow: str | None = None,
         images: list[Any] | None = None,
         audio: list[Any] | None = None,
-        output: str = "text",
+        files: list[Any] | None = None,
+        output: str | None = None,
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -379,7 +465,37 @@ class Agent:
         is schema-valid JSON by construction — no regex salvage (the
         reference's failure mode, agent_ai.py:424-447). The prompt still
         gains a strict-JSON instruction (steers content quality; correctness
-        comes from the mask), and the result dict gains a "parsed" key."""
+        comes from the mask), and the result dict gains a "parsed" key.
+
+        Parameters left at None resolve through the config hierarchy:
+        agent-level ``Agent(ai_defaults=...)`` < the executing reasoner's
+        ``@app.reasoner(ai_defaults=...)`` < explicit arguments here.
+
+        ``files`` takes text-like attachments (paths, bytes, FileContent,
+        or {"b64"/"path", "name", "mime"} dicts): their text inlines into
+        the prompt as fenced blocks; binary files raise
+        UnsupportedModalityError naming the supported routes."""
+        p = self._resolve_ai_params({
+            "model": model, "max_new_tokens": max_new_tokens,
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+            "stop_token_ids": stop_token_ids, "timeout": timeout,
+            "context_overflow": context_overflow, "output": output,
+        })
+        model = p["model"]
+        max_new_tokens, temperature = p["max_new_tokens"], p["temperature"]
+        top_k, top_p = p["top_k"], p["top_p"]
+        stop_token_ids, timeout = p["stop_token_ids"], p["timeout"]
+        context_overflow, output = p["context_overflow"], p["output"]
+        if files:
+            if tokens is not None:
+                # _submit generates from `tokens` and ignores `prompt`; the
+                # inlined file text would silently vanish (same contract as
+                # the media-vs-tokens rejection on the model node)
+                raise ValueError("files require a text 'prompt', not 'tokens'")
+            from agentfield_tpu.sdk.multimodal import file_prompt_block
+
+            blocks = [file_prompt_block(f) for f in _normalize_files(files)]
+            prompt = "\n".join(([prompt] if prompt else []) + blocks)
         if images:
             if prompt is None:
                 raise ValueError("images require a text prompt")
@@ -948,6 +1064,50 @@ def _normalize_images(items: list[Any]) -> list[dict[str, Any]]:
             out.append(_np.asarray(item).tolist())
         else:
             raise TypeError(f"cannot use {type(item).__name__} as an image input")
+    return out
+
+
+def _normalize_files(items: list[Any]) -> list[Any]:
+    """ai(files=...) accepts FileContent, file paths, raw bytes, or
+    {"b64"/"path", "name", "mime"} dicts — everything normalizes to
+    FileContent for prompt inlining. Image/audio bytes are redirected with
+    a pointed error (they have dedicated tower routes)."""
+    import base64 as _b64
+    from pathlib import Path as _Path
+
+    from agentfield_tpu.sdk.multimodal import (
+        AudioContent,
+        FileContent,
+        ImageContent,
+        classify,
+    )
+
+    out: list[Any] = []
+    for item in items:
+        if isinstance(item, dict):
+            if "b64" in item:
+                item = FileContent(
+                    _b64.b64decode(item["b64"]),
+                    name=item.get("name", "blob"),
+                    mime=item.get("mime", "application/octet-stream"),
+                )
+            elif "path" in item:
+                item = FileContent.from_file(item["path"])
+            else:
+                raise TypeError("file dicts need 'b64' or 'path'")
+        elif isinstance(item, (str, _Path)):
+            item = FileContent.from_file(item)
+        elif isinstance(item, bytes):
+            item = classify(item)  # sniffs magic: may be image/audio bytes
+        if isinstance(item, (ImageContent, AudioContent)):
+            kind = "images=" if isinstance(item, ImageContent) else "audio="
+            raise TypeError(
+                f"this looks like {'an image' if kind == 'images=' else 'audio'} — "
+                f"pass it via {kind} (it routes to the model node's tower)"
+            )
+        if not isinstance(item, FileContent):
+            raise TypeError(f"cannot use {type(item).__name__} as a file input")
+        out.append(item)
     return out
 
 

@@ -11,7 +11,10 @@ patch embeddings fused into the prompt) and ``Agent.ai(audio=[...])`` routes
 audio parts to an audio-tower node (models/audio.py — log-mel frame
 embeddings, same ``_fuse_media`` early-fusion path). AUDIO OUTPUT is served
 by the TTS head (``ai(output="audio"|"speech")`` → WAV parts in the
-response). Generic files remain a capability error.
+response). FILE parts are served for text-like types: they inline into the
+prompt as fenced blocks (``file_prompt_block``); binary files are rejected
+with a reason naming the supported routes (reference file handling:
+agent_ai.py:449-520).
 """
 
 from __future__ import annotations
@@ -75,6 +78,12 @@ class FileContent:
     name: str
     mime: str = "application/octet-stream"
 
+    @staticmethod
+    def from_file(path: str | Path) -> "FileContent":
+        p = Path(path)
+        mime = mimetypes.guess_type(str(p))[0] or "application/octet-stream"
+        return FileContent(p.read_bytes(), name=p.name, mime=mime)
+
     def to_part(self) -> dict[str, Any]:
         return {
             "type": "file",
@@ -108,6 +117,64 @@ def classify(arg: Any) -> Content:
             return AudioContent(arg, "audio/wav")
         return FileContent(arg, name="blob")
     raise TypeError(f"cannot classify {type(arg).__name__} as content")
+
+
+_TEXTLIKE_MIMES = {
+    "application/json", "application/xml", "application/x-yaml",
+    "application/yaml", "application/toml", "application/csv",
+    "application/javascript", "application/x-python", "application/x-sh",
+    "application/sql",
+}
+
+
+def file_to_text(part: FileContent, max_bytes: int = 256_000) -> str:
+    """Extract a file part's text for prompt inlining. Text-like mime types
+    (text/*, json/xml/yaml/csv/source) and anything that cleanly decodes as
+    NUL-free UTF-8 pass; binary files raise UnsupportedModalityError naming
+    the supported routes. Oversized text truncates with a marker (the model
+    node's context trimming governs the final budget anyway)."""
+    textlike = part.mime.startswith("text/") or part.mime in _TEXTLIKE_MIMES
+    data = part.data
+    truncated = len(data) > max_bytes
+    if truncated:
+        data = data[:max_bytes]
+        # back off a cut that landed mid-codepoint: a valid UTF-8 file must
+        # not be misclassified as binary because of where we sliced it
+        while data and (data[-1] & 0xC0) == 0x80:
+            data = data[:-1]
+        if data and data[-1] >= 0xC0:
+            data = data[:-1]
+    try:
+        text = data.decode("utf-8")
+    except UnicodeDecodeError:
+        text = None
+    if text is None and textlike:
+        text = data.decode("utf-8", errors="replace")
+    if text is not None and "\x00" in text:
+        # NUL-laced "text" (UTF-16 dumps, binaries with text mimes) would
+        # feed the model mojibake with no signal — reject loudly instead
+        text = None
+    if text is None:
+        raise UnsupportedModalityError(
+            f"file {part.name!r} ({part.mime}) is binary or not UTF-8: only "
+            "UTF-8 text-like files inline into the prompt — send images via "
+            "images=, audio via audio=; other formats are not a servable "
+            "modality"
+        )
+    if truncated:
+        text += "\n... [file truncated]"
+    # a file whose CONTENT contains literal media markers must not change
+    # the prompt's marker arithmetic (SDK and node both count them); a
+    # zero-width space breaks the match without visibly altering the text
+    return text.replace("<image>", "<image\u200b>").replace("<audio>", "<audio\u200b>")
+
+
+def file_prompt_block(part: FileContent, max_bytes: int = 256_000) -> str:
+    """One file part → the fenced prompt block the model sees."""
+    return (
+        f"--- file: {part.name} ({part.mime}) ---\n"
+        f"{file_to_text(part, max_bytes)}\n--- end file ---"
+    )
 
 
 def to_text_prompt(parts: list[Content]) -> str:
@@ -147,10 +214,9 @@ def split_prompt_and_media(
             pieces.append("<audio>")
             audios.append({"b64": base64.b64encode(part.data).decode()})
         else:
-            raise UnsupportedModalityError(
-                f"{type(part).__name__} is not a servable input modality "
-                "(text, image, and audio are)"
-            )
+            # text-like files inline at their argument position; binary
+            # raises UnsupportedModalityError with the reason
+            pieces.append(file_prompt_block(part))
     return "\n".join(pieces), images, audios
 
 

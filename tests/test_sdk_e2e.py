@@ -257,3 +257,84 @@ def test_byte_tokenizer_roundtrip():
     ids = tok.encode("hello")
     assert tok.decode(ids) == "hello"
     assert all(0 <= t < 512 for t in ids)
+
+
+@async_test
+async def test_ai_config_hierarchy():
+    """Agent-level ai_defaults < reasoner-level < explicit call args —
+    the reference's AIConfig merge (agent_ai.py:189-215), checked through a
+    live gateway round trip (max_new_tokens governs emitted token counts)."""
+    from agentfield_tpu.sdk import AIConfig
+
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("cfg-agent", h.base_url, ai_defaults=AIConfig(max_new_tokens=3))
+
+        @app.reasoner()
+        async def agent_level() -> dict:
+            return {"n": len((await app.ai(prompt="a"))["tokens"])}
+
+        @app.reasoner(ai_defaults={"max_new_tokens": 5})
+        async def reasoner_level() -> dict:
+            return {"n": len((await app.ai(prompt="b"))["tokens"])}
+
+        @app.reasoner(ai_defaults={"max_new_tokens": 5})
+        async def call_site() -> dict:
+            return {"n": len((await app.ai(prompt="c", max_new_tokens=2))["tokens"])}
+
+        await app.start()
+        try:
+            for rid, want in (("agent_level", 3), ("reasoner_level", 5), ("call_site", 2)):
+                async with h.http.post(
+                    f"/api/v1/execute/cfg-agent.{rid}", json={"input": {}}
+                ) as r:
+                    doc = await r.json()
+                assert doc["status"] == "completed", doc
+                assert doc["result"]["n"] == want, (rid, doc["result"])
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_ai_file_parts_inline_and_reject():
+    """files=: text-like attachments inline into the prompt as fenced
+    blocks; binary attachments raise UnsupportedModalityError with the
+    supported routes named (reference file parts, agent_ai.py:449-520)."""
+    import pytest as _pytest
+
+    from agentfield_tpu.sdk import FileContent, UnsupportedModalityError
+
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("file-agent", h.base_url)
+        await app.start()
+        try:
+            out = await app.ai(
+                prompt="summarize:",
+                files=[FileContent(b'{"k": 1}', name="data.json", mime="application/json")],
+                max_new_tokens=3,
+            )
+            assert len(out["tokens"]) == 3
+            with _pytest.raises(UnsupportedModalityError, match="binary"):
+                await app.ai(
+                    prompt="x",
+                    files=[FileContent(b"\x00\x01\x02\xff", name="blob.bin")],
+                )
+            # image bytes are redirected to their tower route
+            png = b"\x89PNG\r\n\x1a\n" + b"0" * 16
+            with _pytest.raises(TypeError, match="images="):
+                await app.ai(prompt="x", files=[png])
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()

@@ -17,6 +17,7 @@ import json
 import os
 import signal
 import subprocess
+import re
 import sys
 import time
 from pathlib import Path
@@ -234,18 +235,81 @@ if __name__ == "__main__":
 '''
 
 
+CPP_AGENT_TEMPLATE = """// {name} — an agentfield_tpu agent (C++ SDK).
+// Build: g++ -O2 -std=c++17 -I<repo>/native/sdk -o {name} main.cpp -pthread
+#include "afagent.hpp"
+
+int main(int argc, char** argv) {{
+    afield::Agent app("{name}", argc > 1 ? argv[1] : "http://127.0.0.1:8800");
+    app.register_reasoner("respond", [&app](const std::string& body) {{
+        auto prompt = afield::json_scan_string(body, "prompt");
+        auto out = app.ai(prompt, /*max_new_tokens=*/64);
+        if (!out.ok) throw std::runtime_error(out.error);
+        return "{{\\"text\\": \\"" + afield::json_escape(out.text) + "\\"}}";
+    }}, "Example reasoner backed by the TPU model node");
+    app.start();  // bind + register + heartbeat (returns once registered)
+    for (;;) std::this_thread::sleep_for(std::chrono::seconds(60));
+    return 0;
+}}
+"""
+
+GO_AGENT_TEMPLATE = """// {name} — an agentfield_tpu agent (Go SDK, sdk/go).
+package main
+
+import (
+	context "context"
+	agent "agentfield-tpu/sdk/go/agent"
+)
+
+func main() {{
+	a, err := agent.New("{name}", "http://127.0.0.1:8800")
+	if err != nil {{ panic(err) }}
+	a.RegisterReasoner("respond", "Example reasoner", func(ctx context.Context, in map[string]any) (any, error) {{
+		prompt, _ := in["prompt"].(string)
+		out, err := a.Ai(ctx, prompt, nil)
+		if err != nil {{ return nil, err }}
+		return map[string]any{{"text": out.Text, "model": out.Model}}, nil
+	}})
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {{ panic(err) }}
+	select {{}}
+}}
+"""
+
+
 def cmd_init(cfg: Config, args) -> int:
-    """Scaffold an agent project (reference: af init, internal/cli/init.go:202)."""
+    """Scaffold an agent project (reference: af init, internal/cli/init.go:202
+    — which ships Python AND Go templates, internal/templates/go/). Language
+    via --lang: python (default) | cpp (this repo's in-CI second language) |
+    go (sources for the toolchain-gated sdk/go)."""
     target = Path(args.name)
     if target.exists():
         print(f"{target} already exists", file=sys.stderr)
         return 1
+    lang = getattr(args, "lang", "python")
     target.mkdir(parents=True)
-    (target / "main.py").write_text(AGENT_TEMPLATE.format(name=args.name))
+    if lang == "cpp":
+        (target / "main.cpp").write_text(CPP_AGENT_TEMPLATE.format(name=args.name))
+        entry, created = "main.cpp", "main.cpp"
+    elif lang == "go":
+        # module paths reject slashes-from-abs-paths/uppercase/spaces —
+        # sanitize the basename (the name itself only lands in comments)
+        mod = re.sub(r"[^a-z0-9._-]", "-", Path(args.name).name.lower()) or "agent"
+        (target / "main.go").write_text(GO_AGENT_TEMPLATE.format(name=target.name))
+        (target / "go.mod").write_text(
+            f"module {mod}\n\ngo 1.21\n\n"
+            "// replace with the repo path holding sdk/go\n"
+            "require agentfield-tpu/sdk/go v0.0.0\n"
+            "replace agentfield-tpu/sdk/go => ../sdk/go\n"
+        )
+        entry, created = "main.go", "main.go, go.mod"
+    else:
+        (target / "main.py").write_text(AGENT_TEMPLATE.format(name=args.name))
+        entry, created = "main.py", "main.py"
     (target / "agentfield.yaml").write_text(
-        f"name: {args.name}\nentry: main.py\ndescription: scaffolded by aftpu init\n"
+        f"name: {args.name}\nentry: {entry}\ndescription: scaffolded by aftpu init\n"
     )
-    print(f"created {target}/ (main.py, agentfield.yaml)")
+    print(f"created {target}/ ({created}, agentfield.yaml)")
     return 0
 
 
@@ -491,6 +555,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("init", help="scaffold an agent project")
     s.add_argument("name")
+    s.add_argument("--lang", choices=("python", "cpp", "go"), default="python",
+                   help="template language (default python)")
     s.set_defaults(fn=cmd_init)
 
     s = sub.add_parser("install", help="install an agent package (local path or git)")

@@ -89,6 +89,20 @@ function setRefresh(fn, ms) {
   if (sse) { sse.close(); sse = null; $('live').textContent = ''; }
   if (fn && ms) timer = setInterval(fn, ms);
 }
+// SSE-driven re-render pump: never two renders in flight (an older fetch
+// can't overwrite a newer one), and an event storm coalesces into at most
+// one follow-up render instead of one /dag fetch per event.
+function liveRender(render) {
+  let running = false, pending = false;
+  const pump = async () => {
+    if (running) { pending = true; return; }
+    running = true;
+    try { await render(); } catch (_) {}
+    running = false;
+    if (pending) { pending = false; setTimeout(pump, 600); }
+  };
+  return pump;
+}
 const done = () => $('ts').textContent = 'refreshed ' + new Date().toLocaleTimeString();
 
 // ---- dashboard --------------------------------------------------------
@@ -170,11 +184,12 @@ async function pgExecs(id) {
     };
     await render();
     // live detail: re-render when THIS execution's events arrive
+    const pump = liveRender(render);
     sse = new EventSource('/api/v1/events/executions');
     sse.onmessage = (ev) => {
       try { const d = JSON.parse(ev.data);
         if (d.execution_id && d.execution_id !== id) return; } catch (_) {}
-      $('live').textContent = '· live'; render();
+      $('live').textContent = '· live'; pump();
     };
     return;
   }
@@ -214,8 +229,9 @@ async function pgExecs(id) {
     done();
   };
   await render();
+  const pump = liveRender(render);
   sse = new EventSource('/api/v1/events/executions');
-  sse.onmessage = () => { $('live').textContent = '· live'; render(); };
+  sse.onmessage = () => { $('live').textContent = '· live'; pump(); };
 }
 
 // ---- workflows / DAG --------------------------------------------------
@@ -233,43 +249,75 @@ function dagSvg(dag) {
     layers.push(frontier); frontier.forEach(id => seen[id] = layers.length - 1);
     frontier = frontier.flatMap(id => children[id] || []).filter(id => !(id in seen));
   }
-  const W = 170, H = 52, GX = 30, GY = 26, pos = {};
-  layers.forEach((ids, li) => ids.forEach((id, i) =>
-    pos[id] = { x: 20 + i * (W + GX), y: 16 + li * (H + GY) }));
+  // Big runs render compact and WRAP wide layers into rows, so a 100+
+  // node fan-out stays on screen instead of stretching 10k px sideways.
+  const compact = nodes.length > 40;
+  const W = compact ? 108 : 170, H = compact ? 34 : 52;
+  const GX = compact ? 12 : 30, GY = compact ? 14 : 26;
+  const perRow = Math.max(1, Math.floor(1340 / (W + GX)));
+  const pos = {};
+  let y = 16;
+  layers.forEach(ids => {
+    ids.forEach((id, i) => {
+      pos[id] = { x: 20 + (i % perRow) * (W + GX),
+                  y: y + Math.floor(i / perRow) * (H + GY) };
+    });
+    y += Math.ceil(ids.length / perRow) * (H + GY) + (compact ? 10 : 0);
+  });
   const colors = { completed: 'var(--green)', failed: 'var(--red)', timeout: 'var(--red)',
                    running: 'var(--amber)', queued: 'var(--amber)' };
   const edges = nodes.filter(n => n.parent_execution_id && pos[n.parent_execution_id])
     .map(n => { const a = pos[n.parent_execution_id], b = pos[n.execution_id];
       return `<line x1="${a.x + W / 2}" y1="${a.y + H}" x2="${b.x + W / 2}" y2="${b.y}"
-        stroke="var(--line)" stroke-width="1.5"/>`; }).join('');
+        stroke="var(--line)" stroke-width="1"/>`; }).join('');
+  const fs1 = compact ? 9 : 11, fs2 = compact ? 8 : 10;
   const boxes = nodes.filter(n => pos[n.execution_id]).map(n => { const p = pos[n.execution_id];
+    const label = compact && n.target.length > 16 ? n.target.slice(0, 15) + '…' : n.target;
     return `<g class="click" data-go="#/execs/${esc(n.execution_id)}" cursor="pointer">
-      <rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="7" fill="var(--panel)"
-        stroke="${colors[n.status] || 'var(--line)'}" stroke-width="1.6"/>
-      <text x="${p.x + 9}" y="${p.y + 20}" fill="var(--fg)" font-size="11">${esc(n.target)}</text>
-      <text x="${p.x + 9}" y="${p.y + 38}" fill="${colors[n.status] || 'var(--dim)'}"
-        font-size="10">${esc(n.status)}</text></g>`; }).join('');
+      <rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="${compact ? 4 : 7}" fill="var(--panel)"
+        stroke="${colors[n.status] || 'var(--line)'}" stroke-width="1.4"/>
+      <text x="${p.x + 7}" y="${p.y + (compact ? 13 : 20)}" fill="var(--fg)" font-size="${fs1}">${esc(label)}</text>
+      <text x="${p.x + 7}" y="${p.y + (compact ? 26 : 38)}" fill="${colors[n.status] || 'var(--dim)'}"
+        font-size="${fs2}">${esc(n.status)}</text></g>`; }).join('');
   const w = Math.max(...Object.values(pos).map(p => p.x + W + 20), 300);
   const h = Math.max(...Object.values(pos).map(p => p.y + H + 20), 120);
   return `<svg width="${w}" height="${h}" id="dag">${edges}${boxes}</svg>`;
 }
 async function pgRuns(id) {
   if (id) {
-    const dag = await J('/api/v1/workflows/' + id + '/dag');
-    $('page').innerHTML = `<div class="row"><b>run ${esc(id)}</b>
-      ${stat(dag.overall_status)} <span class="dim">${dag.nodes.length} executions</span>
-      <button id="chainbtn">verify VC chain</button></div>
-      <div id="chain"></div>${dagSvg(dag)}`;
-    $('chainbtn').onclick = () => vcChain(id);
-    done(); return;
+    const render = async () => {
+      const dag = await J('/api/v1/workflows/' + id + '/dag');
+      $('page').innerHTML = `<div class="row"><b>run ${esc(id)}</b>
+        ${stat(dag.overall_status)} <span class="dim">${dag.nodes.length} executions</span>
+        <button id="chainbtn">verify VC chain</button></div>
+        <div id="chain"></div>${dagSvg(dag)}`;
+      $('chainbtn').onclick = () => vcChain(id);
+      done();
+    };
+    await render();
+    // live DAG: re-render as THIS run's executions progress
+    const pump = liveRender(render);
+    sse = new EventSource('/api/v1/events/executions');
+    sse.onmessage = (ev) => {
+      try { const d = JSON.parse(ev.data);
+        if (d.run_id && d.run_id !== id) return; } catch (_) {}
+      $('live').textContent = '· live'; pump();
+    };
+    return;
   }
-  const d = await J('/api/v1/runs');
-  $('page').innerHTML = `<table><tr><th>run</th><th>status</th><th>executions</th>
-    <th>started</th></tr>${d.runs.map(r =>
-    `<tr class="click" data-go="#/runs/${esc(r.run_id)}">
-     <td>${esc(r.run_id)}</td><td>${stat(r.overall_status)}</td>
-     <td>${r.executions}</td><td class="dim">${fmtT(r.started_at)}</td></tr>`).join('')}</table>`;
-  done();
+  const render = async () => {
+    const d = await J('/api/v1/runs');
+    $('page').innerHTML = `<table><tr><th>run</th><th>status</th><th>executions</th>
+      <th>started</th></tr>${d.runs.map(r =>
+      `<tr class="click" data-go="#/runs/${esc(r.run_id)}">
+       <td>${esc(r.run_id)}</td><td>${stat(r.overall_status)}</td>
+       <td>${r.executions}</td><td class="dim">${fmtT(r.started_at)}</td></tr>`).join('')}</table>`;
+    done();
+  };
+  await render();
+  const pump = liveRender(render);
+  sse = new EventSource('/api/v1/events/executions');
+  sse.onmessage = () => { $('live').textContent = '· live'; pump(); };
 }
 async function vcChain(runId) {
   try { const c = await J('/api/v1/vc/workflows/' + runId);
@@ -437,7 +485,7 @@ async function route() {
   try {
     if (p === 'nodes') { await pgNodes(id); setRefresh(() => pgNodes(id), 4000); }
     else if (p === 'execs') await pgExecs(id);
-    else if (p === 'runs') { await pgRuns(id); if (id) setRefresh(() => pgRuns(id), 4000); }
+    else if (p === 'runs') await pgRuns(id);
     else if (p === 'reasoners') { await pgReasoners(); setRefresh(pgReasoners, 6000); }
     else if (p === 'pkgs') await pgPkgs();
     else if (p === 'creds') await pgCreds();

@@ -128,6 +128,7 @@ class ComponentDef:
         comp.kind = kind
         comp.fn = handler
         comp.description = description
+        comp.ai_defaults = None
         comp.input_model = None
         comp.input_schema = input_schema
         comp.ctx_params = []
@@ -703,12 +704,12 @@ class Agent:
         prompt: str | None = None,
         tokens: list[int] | None = None,
         model: str | None = None,
-        max_new_tokens: int = 128,
-        temperature: float = 0.0,
-        top_k: int = 0,
-        top_p: float = 1.0,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
         stop_token_ids: list[int] | None = None,
-        timeout: float = 600.0,
+        timeout: float | None = None,
     ):
         """Token-streaming LLM call: SSE straight from the model node (data
         plane), with DAG visibility via workflow lifecycle events. Yields
@@ -720,6 +721,16 @@ class Agent:
         ``contextlib.aclosing(...)`` — use that for deterministic DAG events."""
         import aiohttp
 
+        # same defaults hierarchy as ai(): agent < reasoner < explicit args
+        rp = self._resolve_ai_params({
+            "model": model, "max_new_tokens": max_new_tokens,
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+            "stop_token_ids": stop_token_ids, "timeout": timeout,
+        })
+        model = rp["model"]
+        max_new_tokens, temperature = rp["max_new_tokens"], rp["temperature"]
+        top_k, top_p = rp["top_k"], rp["top_p"]
+        stop_token_ids, timeout = rp["stop_token_ids"], rp["timeout"]
         node = await self._resolve_model_node(model)
         ctx = self._outbound_ctx()
         base = {
@@ -1086,11 +1097,19 @@ def _normalize_files(items: list[Any]) -> list[Any]:
     for item in items:
         if isinstance(item, dict):
             if "b64" in item:
-                item = FileContent(
-                    _b64.b64decode(item["b64"]),
-                    name=item.get("name", "blob"),
-                    mime=item.get("mime", "application/octet-stream"),
-                )
+                data = _b64.b64decode(item["b64"])
+                name = item.get("name", "blob")
+                if "mime" in item:
+                    item = FileContent(data, name=name, mime=item["mime"])
+                else:
+                    # sniff magic like the raw-bytes path, so b64-wrapped
+                    # media gets the pointed images=/audio= redirect below
+                    sniffed = classify(data)
+                    item = (
+                        FileContent(data, name=name, mime=sniffed.mime)
+                        if isinstance(sniffed, FileContent)
+                        else sniffed
+                    )
             elif "path" in item:
                 item = FileContent.from_file(item["path"])
             else:

@@ -164,15 +164,22 @@ def file_to_text(part: FileContent, max_bytes: int = 256_000) -> str:
     if truncated:
         text += "\n... [file truncated]"
     # a file whose CONTENT contains literal media markers must not change
-    # the prompt's marker arithmetic (SDK and node both count them); a
-    # zero-width space breaks the match without visibly altering the text
-    return text.replace("<image>", "<image\u200b>").replace("<audio>", "<audio\u200b>")
+    # the prompt's marker arithmetic (SDK and node both count them)
+    return _break_markers(text)
+
+
+def _break_markers(s: str) -> str:
+    """Neutralize literal media markers (prompt arithmetic protection —
+    zero-width space breaks the match without visibly altering text)."""
+    return s.replace("<image>", "<image\u200b>").replace("<audio>", "<audio\u200b>")
 
 
 def file_prompt_block(part: FileContent, max_bytes: int = 256_000) -> str:
-    """One file part → the fenced prompt block the model sees."""
+    """One file part → the fenced prompt block the model sees. The header's
+    name/mime get the same marker neutralization as the content — a filename
+    containing a literal "<image>" must not corrupt the marker count."""
     return (
-        f"--- file: {part.name} ({part.mime}) ---\n"
+        f"--- file: {_break_markers(part.name)} ({_break_markers(part.mime)}) ---\n"
         f"{file_to_text(part, max_bytes)}\n--- end file ---"
     )
 
@@ -199,7 +206,8 @@ def split_prompt_and_media(
     agent_ai.py:449): text parts join into the prompt with an ``<image>`` /
     ``<audio>`` marker standing in for each media part at its argument
     position; media parts become the wire payloads the model node's towers
-    consume. File parts raise UnsupportedModalityError."""
+    consume. Text-like file parts inline as fenced blocks at their argument
+    position; binary files raise UnsupportedModalityError."""
     pieces: list[str] = []
     images: list[dict[str, Any]] = []
     audios: list[dict[str, Any]] = []

@@ -314,6 +314,11 @@ def _run_bench() -> None:
     # tunnel's readback latency is ~100ms, so per-token harvesting caps
     # throughput at ~10 steps/s no matter how fast the chip is.
     span = int(os.environ.get("AGENTFIELD_BENCH_SPAN", "16" if on_tpu else "1"))
+    # Burst admission width: on TPU the prefill batch dim is nearly free on
+    # the MXU; on CPU 8 measured best p50/p99 balance (engine.py knob note).
+    prefill_batch = int(
+        os.environ.get("AGENTFIELD_BENCH_PREFILL_BATCH", "16" if on_tpu else "8")
+    )
     prompt_len, new_tokens = 128, 128
 
     # Speculative decoding: AGENTFIELD_BENCH_SPEC=<draft preset or checkpoint
@@ -336,6 +341,7 @@ def _run_bench() -> None:
             num_pages=batch * 8 * 2 + 1,
             max_pages_per_seq=8,  # 256-token context budget per request
             max_pending=max(n_requests, 1024),
+            prefill_batch=prefill_batch,
             attn_impl="pallas" if attn_impl == "pallas" else "ref",
             prefill_impl="flash" if attn_impl == "pallas" else "ref",
             decode_span=span,

@@ -1,0 +1,507 @@
+// Package agent is the Go SDK for the agentfield_tpu control plane — the
+// second-language counterpart of the Python SDK (agentfield_tpu/sdk) and the
+// C++ SDK (native/sdk/afagent.hpp), playing the reference Go SDK's role
+// (reference: sdk/go/agent/agent.go:93 — register reasoners, HTTP server,
+// control-plane registration + heartbeat, gateway Call, ai client).
+//
+// Wire protocol (pinned by the control plane, control_plane/server.py):
+//
+//	outbound POST {cp}/api/v1/nodes                    registration (201)
+//	         POST {cp}/api/v1/nodes/{id}/heartbeat     2s cadence; 404 → re-register
+//	         POST {cp}/api/v1/execute/{target}         gateway execute
+//	inbound  POST /reasoners/{id}  {"input":..., "execution_id":...}
+//	         → 200 {"result":...} | 500 {"error":...}
+//	         GET  /health          → {"status":"ok","node_id":...}
+package agent
+
+import (
+	"bufio"
+	"bytes"
+	"context"
+	"encoding/json"
+	"errors"
+	"fmt"
+	"net"
+	"net/http"
+	"strings"
+	"sync"
+	"time"
+)
+
+// Handler is a reasoner/skill implementation: JSON-decoded input in, any
+// JSON-encodable result out.
+type Handler func(ctx context.Context, input map[string]any) (any, error)
+
+type component struct {
+	id          string
+	kind        string // "reasoner" | "skill"
+	description string
+	fn          Handler
+}
+
+// ExecutionContext carries the X-* identity headers the control plane
+// propagates across calls (agentfield_tpu/sdk/context.py).
+type ExecutionContext struct {
+	RunID             string
+	ExecutionID       string
+	ParentExecutionID string
+	SessionID         string
+	ActorID           string
+}
+
+type ctxKey struct{}
+
+func contextWith(ctx context.Context, ec ExecutionContext) context.Context {
+	return context.WithValue(ctx, ctxKey{}, ec)
+}
+
+// ExecutionContextFrom recovers the propagated identity inside a Handler.
+func ExecutionContextFrom(ctx context.Context) (ExecutionContext, bool) {
+	ec, ok := ctx.Value(ctxKey{}).(ExecutionContext)
+	return ec, ok
+}
+
+// Agent registers with a control plane, serves its components over HTTP, and
+// heartbeats. Zero value is not usable — construct with New.
+type Agent struct {
+	NodeID       string
+	ControlPlane string
+	Metadata     map[string]any
+
+	mu         sync.Mutex
+	components map[string]component
+	server     *http.Server
+	listener   net.Listener
+	baseURL    string
+	hbStop     chan struct{}
+	hbDone     chan struct{}
+	client     *http.Client
+}
+
+// New builds an agent bound to a control plane base URL (no trailing slash).
+func New(nodeID, controlPlane string) (*Agent, error) {
+	if nodeID == "" || strings.Contains(nodeID, ".") {
+		return nil, fmt.Errorf("node_id %q must be non-empty and contain no '.'", nodeID)
+	}
+	return &Agent{
+		NodeID:       nodeID,
+		ControlPlane: strings.TrimRight(controlPlane, "/"),
+		Metadata:     map[string]any{"sdk": "go"},
+		components:   map[string]component{},
+		client:       &http.Client{Timeout: 90 * time.Second},
+	}, nil
+}
+
+// RegisterReasoner adds a reasoner; call before Start.
+func (a *Agent) RegisterReasoner(id, description string, fn Handler) {
+	a.register(component{id: id, kind: "reasoner", description: description, fn: fn})
+}
+
+// RegisterSkill adds a skill; call before Start.
+func (a *Agent) RegisterSkill(id, description string, fn Handler) {
+	a.register(component{id: id, kind: "skill", description: description, fn: fn})
+}
+
+func (a *Agent) register(c component) {
+	a.mu.Lock()
+	defer a.mu.Unlock()
+	a.components[c.id] = c
+}
+
+// Start binds 127.0.0.1:0, registers with the control plane, and begins
+// heartbeating. Returns once the node is registered.
+func (a *Agent) Start(ctx context.Context) error {
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		return err
+	}
+	a.listener = ln
+	a.baseURL = "http://" + ln.Addr().String()
+	mux := http.NewServeMux()
+	mux.HandleFunc("/health", a.handleHealth)
+	mux.HandleFunc("/reasoners/", a.handleInvoke)
+	mux.HandleFunc("/skills/", a.handleInvoke)
+	a.server = &http.Server{Handler: mux}
+	go a.server.Serve(ln) //nolint:errcheck // closed via Shutdown
+
+	if err := a.doRegister(ctx); err != nil {
+		_ = a.server.Close()
+		return err
+	}
+	a.hbStop = make(chan struct{})
+	a.hbDone = make(chan struct{})
+	go a.heartbeatLoop()
+	return nil
+}
+
+// Stop shuts the HTTP server and heartbeat down.
+func (a *Agent) Stop(ctx context.Context) error {
+	if a.hbStop != nil {
+		close(a.hbStop)
+		<-a.hbDone
+		a.hbStop = nil
+	}
+	if a.server != nil {
+		return a.server.Shutdown(ctx)
+	}
+	return nil
+}
+
+// BaseURL is the bound address after Start (for tests).
+func (a *Agent) BaseURL() string { return a.baseURL }
+
+func (a *Agent) doRegister(ctx context.Context) error {
+	a.mu.Lock()
+	var reasoners, skills []map[string]any
+	for _, c := range a.components {
+		entry := map[string]any{"id": c.id, "description": c.description}
+		if c.kind == "skill" {
+			skills = append(skills, entry)
+		} else {
+			reasoners = append(reasoners, entry)
+		}
+	}
+	a.mu.Unlock()
+	body := map[string]any{
+		"node_id":   a.NodeID,
+		"base_url":  a.baseURL,
+		"metadata":  a.Metadata,
+		"reasoners": reasoners,
+		"skills":    skills,
+	}
+	resp, raw, err := a.postJSON(ctx, a.ControlPlane+"/api/v1/nodes", body)
+	if err != nil {
+		return err
+	}
+	if resp != http.StatusCreated {
+		return fmt.Errorf("registration failed: %d %s", resp, raw)
+	}
+	return nil
+}
+
+func (a *Agent) heartbeatLoop() {
+	defer close(a.hbDone)
+	t := time.NewTicker(2 * time.Second)
+	defer t.Stop()
+	for {
+		select {
+		case <-a.hbStop:
+			return
+		case <-t.C:
+			ctx, cancel := context.WithTimeout(context.Background(), 5*time.Second)
+			status, _, err := a.postJSON(ctx, a.ControlPlane+"/api/v1/nodes/"+a.NodeID+"/heartbeat", map[string]any{})
+			if err == nil && status == http.StatusNotFound {
+				// control plane restarted: re-register (Python SDK parity)
+				_ = a.doRegister(ctx)
+			}
+			cancel()
+		}
+	}
+}
+
+func (a *Agent) handleHealth(w http.ResponseWriter, _ *http.Request) {
+	writeJSON(w, http.StatusOK, map[string]any{"status": "ok", "node_id": a.NodeID})
+}
+
+type invokeBody struct {
+	Input       map[string]any `json:"input"`
+	ExecutionID string         `json:"execution_id"`
+	RunID       string         `json:"run_id"`
+}
+
+func (a *Agent) handleInvoke(w http.ResponseWriter, r *http.Request) {
+	if r.Method != http.MethodPost {
+		writeJSON(w, http.StatusMethodNotAllowed, map[string]any{"error": "POST only"})
+		return
+	}
+	parts := strings.Split(strings.Trim(r.URL.Path, "/"), "/")
+	if len(parts) != 2 {
+		writeJSON(w, http.StatusNotFound, map[string]any{"error": "not found"})
+		return
+	}
+	a.mu.Lock()
+	c, ok := a.components[parts[1]]
+	a.mu.Unlock()
+	if !ok {
+		writeJSON(w, http.StatusNotFound, map[string]any{"error": "unknown component " + parts[1]})
+		return
+	}
+	var body invokeBody
+	if err := json.NewDecoder(r.Body).Decode(&body); err != nil {
+		writeJSON(w, http.StatusBadRequest, map[string]any{"error": "bad JSON: " + err.Error()})
+		return
+	}
+	ec := ExecutionContext{
+		RunID:             firstNonEmpty(r.Header.Get("X-Run-ID"), body.RunID),
+		ExecutionID:       firstNonEmpty(r.Header.Get("X-Execution-ID"), body.ExecutionID),
+		ParentExecutionID: r.Header.Get("X-Parent-Execution-ID"),
+		SessionID:         r.Header.Get("X-Session-ID"),
+		ActorID:           r.Header.Get("X-Actor-ID"),
+	}
+	result, err := c.fn(contextWith(r.Context(), ec), body.Input)
+	if err != nil {
+		writeJSON(w, http.StatusInternalServerError, map[string]any{"error": err.Error()})
+		return
+	}
+	writeJSON(w, http.StatusOK, map[string]any{"result": result})
+}
+
+// Call executes a target ("node.component") through the gateway and returns
+// the terminal execution document's result (reference Call, agent.go:514).
+func (a *Agent) Call(ctx context.Context, target string, input map[string]any) (map[string]any, error) {
+	doc, err := a.Execute(ctx, target, input)
+	if err != nil {
+		return nil, err
+	}
+	if status, _ := doc["status"].(string); status != "completed" {
+		return nil, fmt.Errorf("execution %v: %v", doc["status"], doc["error"])
+	}
+	result, _ := doc["result"].(map[string]any)
+	if result == nil {
+		// non-object results wrap so callers always get a map
+		return map[string]any{"result": doc["result"]}, nil
+	}
+	return result, nil
+}
+
+// Execute posts to the gateway and returns the raw execution document.
+func (a *Agent) Execute(ctx context.Context, target string, input map[string]any) (map[string]any, error) {
+	status, raw, err := a.postJSON(ctx, a.ControlPlane+"/api/v1/execute/"+target, map[string]any{"input": input})
+	if err != nil {
+		return nil, err
+	}
+	var doc map[string]any
+	if err := json.Unmarshal(raw, &doc); err != nil {
+		return nil, fmt.Errorf("gateway returned %d with non-JSON body", status)
+	}
+	if status >= 400 {
+		return doc, fmt.Errorf("gateway %d: %v", status, doc["error"])
+	}
+	return doc, nil
+}
+
+// AiOptions tune an Ai / AiStream call.
+type AiOptions struct {
+	MaxNewTokens int     // default 64
+	Temperature  float64 // default 0 (greedy)
+	ModelNode    string  // pin a node id; empty resolves the first active model node
+}
+
+// AiResponse is the decoded result of Ai.
+type AiResponse struct {
+	Text   string
+	Model  string
+	Tokens []int
+}
+
+// Ai runs an LLM call through the gateway to an in-tree model node — the
+// reference Go SDK's ai.Client role (sdk/go/ai/client.go) served in-cluster.
+// Retries 503/queue-full backpressure with capped exponential backoff.
+func (a *Agent) Ai(ctx context.Context, prompt string, opts *AiOptions) (*AiResponse, error) {
+	o := withDefaults(opts)
+	node := o.ModelNode
+	if node == "" {
+		var err error
+		if node, _, err = a.resolveModelNode(ctx, ""); err != nil {
+			return nil, err
+		}
+	}
+	payload := map[string]any{
+		"prompt":         prompt,
+		"max_new_tokens": o.MaxNewTokens,
+		"temperature":    o.Temperature,
+	}
+	delay := 200 * time.Millisecond
+	var doc map[string]any
+	var err error
+	for attempt := 0; attempt < 6; attempt++ {
+		var status int
+		var raw []byte
+		status, raw, err = a.postJSON(ctx, a.ControlPlane+"/api/v1/execute/"+node+".generate", map[string]any{"input": payload})
+		if err != nil {
+			return nil, err
+		}
+		if err := json.Unmarshal(raw, &doc); err != nil {
+			return nil, fmt.Errorf("gateway returned %d with non-JSON body", status)
+		}
+		errStr, _ := doc["error"].(string)
+		backpressure := status == http.StatusServiceUnavailable ||
+			(strings.Contains(errStr, "QueueFullError") && doc["status"] == "failed")
+		if !backpressure {
+			break
+		}
+		select {
+		case <-ctx.Done():
+			return nil, ctx.Err()
+		case <-time.After(delay):
+		}
+		if delay < 5*time.Second {
+			delay *= 2
+		}
+	}
+	if doc["status"] != "completed" {
+		return nil, fmt.Errorf("ai failed: %v", doc["error"])
+	}
+	result, _ := doc["result"].(map[string]any)
+	out := &AiResponse{}
+	out.Text, _ = result["text"].(string)
+	out.Model, _ = result["model"].(string)
+	if toks, ok := result["tokens"].([]any); ok {
+		for _, t := range toks {
+			if f, ok := t.(float64); ok {
+				out.Tokens = append(out.Tokens, int(f))
+			}
+		}
+	}
+	return out, nil
+}
+
+// StreamEvent is one token frame from the model node's SSE stream.
+type StreamEvent struct {
+	Token        int    `json:"token"`
+	Index        int    `json:"index"`
+	Finished     bool   `json:"finished"`
+	FinishReason string `json:"finish_reason"`
+	Text         string `json:"text"`
+}
+
+// AiStream streams tokens straight from the MODEL NODE's /generate/stream
+// SSE endpoint (data plane — tokens never proxy through the control plane;
+// the registry only resolves the node's base_url). Return false from fn to
+// stop: closing the connection cancels the request server-side.
+func (a *Agent) AiStream(ctx context.Context, prompt string, opts *AiOptions, fn func(StreamEvent) bool) (string, error) {
+	o := withDefaults(opts)
+	node, baseURL, err := a.resolveModelNode(ctx, o.ModelNode)
+	if err != nil {
+		return "", err
+	}
+	_ = node
+	payload, _ := json.Marshal(map[string]any{
+		"prompt":         prompt,
+		"max_new_tokens": o.MaxNewTokens,
+		"temperature":    o.Temperature,
+	})
+	req, err := http.NewRequestWithContext(ctx, http.MethodPost, baseURL+"/generate/stream", bytes.NewReader(payload))
+	if err != nil {
+		return "", err
+	}
+	req.Header.Set("Content-Type", "application/json")
+	resp, err := a.client.Do(req)
+	if err != nil {
+		return "", err
+	}
+	defer resp.Body.Close()
+	if resp.StatusCode != http.StatusOK {
+		return "", fmt.Errorf("stream endpoint returned %d", resp.StatusCode)
+	}
+	var text strings.Builder
+	sc := bufio.NewScanner(resp.Body)
+	sc.Buffer(make([]byte, 0, 64*1024), 1<<20)
+	for sc.Scan() {
+		line := sc.Text()
+		if !strings.HasPrefix(line, "data: ") {
+			continue
+		}
+		var ev StreamEvent
+		if err := json.Unmarshal([]byte(line[len("data: "):]), &ev); err != nil {
+			continue // control frames / keepalives
+		}
+		text.WriteString(ev.Text)
+		if !fn(ev) {
+			return text.String(), nil // close cancels server-side
+		}
+		if ev.Finished {
+			return text.String(), nil
+		}
+	}
+	if err := sc.Err(); err != nil {
+		return text.String(), err
+	}
+	return text.String(), errors.New("stream ended before a finished frame")
+}
+
+// resolveModelNode finds an active kind=model node (or validates a pinned
+// one) and returns (node_id, base_url).
+func (a *Agent) resolveModelNode(ctx context.Context, pin string) (string, string, error) {
+	req, err := http.NewRequestWithContext(ctx, http.MethodGet, a.ControlPlane+"/api/v1/nodes", nil)
+	if err != nil {
+		return "", "", err
+	}
+	resp, err := a.client.Do(req)
+	if err != nil {
+		return "", "", err
+	}
+	defer resp.Body.Close()
+	var doc struct {
+		Nodes []struct {
+			NodeID  string         `json:"node_id"`
+			Kind    string         `json:"kind"`
+			Status  string         `json:"status"`
+			BaseURL string         `json:"base_url"`
+			Meta    map[string]any `json:"metadata"`
+		} `json:"nodes"`
+	}
+	if err := json.NewDecoder(resp.Body).Decode(&doc); err != nil {
+		return "", "", err
+	}
+	for _, n := range doc.Nodes {
+		if n.Kind != "model" || n.Status != "active" {
+			continue
+		}
+		if pin == "" || n.NodeID == pin {
+			return n.NodeID, n.BaseURL, nil
+		}
+	}
+	if pin != "" {
+		return "", "", fmt.Errorf("model node %q not active", pin)
+	}
+	return "", "", errors.New("no active model node registered")
+}
+
+func (a *Agent) postJSON(ctx context.Context, url string, body any) (int, []byte, error) {
+	raw, err := json.Marshal(body)
+	if err != nil {
+		return 0, nil, err
+	}
+	req, err := http.NewRequestWithContext(ctx, http.MethodPost, url, bytes.NewReader(raw))
+	if err != nil {
+		return 0, nil, err
+	}
+	req.Header.Set("Content-Type", "application/json")
+	resp, err := a.client.Do(req)
+	if err != nil {
+		return 0, nil, err
+	}
+	defer resp.Body.Close()
+	var buf bytes.Buffer
+	if _, err := buf.ReadFrom(resp.Body); err != nil {
+		return resp.StatusCode, nil, err
+	}
+	return resp.StatusCode, buf.Bytes(), nil
+}
+
+func withDefaults(o *AiOptions) AiOptions {
+	out := AiOptions{MaxNewTokens: 64}
+	if o != nil {
+		out = *o
+		if out.MaxNewTokens == 0 {
+			out.MaxNewTokens = 64
+		}
+	}
+	return out
+}
+
+func writeJSON(w http.ResponseWriter, status int, body any) {
+	w.Header().Set("Content-Type", "application/json")
+	w.WriteHeader(status)
+	_ = json.NewEncoder(w).Encode(body)
+}
+
+func firstNonEmpty(vals ...string) string {
+	for _, v := range vals {
+		if v != "" {
+			return v
+		}
+	}
+	return ""
+}

@@ -1,0 +1,219 @@
+package agent
+
+import (
+	"context"
+	"encoding/json"
+	"fmt"
+	"net/http"
+	"net/http/httptest"
+	"strings"
+	"sync/atomic"
+	"testing"
+	"time"
+)
+
+// fakeCP is an httptest stand-in for the control plane's registration,
+// heartbeat, node-list and execute surfaces (the Go twin of the Python
+// tests' CPHarness, scoped to what this SDK touches).
+type fakeCP struct {
+	srv        *httptest.Server
+	registered atomic.Int64
+	heartbeats atomic.Int64
+	modelURL   string
+}
+
+func newFakeCP(t *testing.T) *fakeCP {
+	f := &fakeCP{}
+	mux := http.NewServeMux()
+	mux.HandleFunc("/api/v1/nodes", func(w http.ResponseWriter, r *http.Request) {
+		if r.Method == http.MethodPost {
+			f.registered.Add(1)
+			w.WriteHeader(http.StatusCreated)
+			_, _ = w.Write([]byte(`{"node_id": "ok"}`))
+			return
+		}
+		_ = json.NewEncoder(w).Encode(map[string]any{"nodes": []map[string]any{
+			{"node_id": "m", "kind": "model", "status": "active", "base_url": f.modelURL},
+		}})
+	})
+	mux.HandleFunc("/api/v1/nodes/", func(w http.ResponseWriter, _ *http.Request) {
+		f.heartbeats.Add(1)
+		_, _ = w.Write([]byte(`{}`))
+	})
+	mux.HandleFunc("/api/v1/execute/", func(w http.ResponseWriter, r *http.Request) {
+		target := strings.TrimPrefix(r.URL.Path, "/api/v1/execute/")
+		var body struct {
+			Input map[string]any `json:"input"`
+		}
+		_ = json.NewDecoder(r.Body).Decode(&body)
+		switch {
+		case target == "m.generate":
+			_ = json.NewEncoder(w).Encode(map[string]any{
+				"status": "completed",
+				"result": map[string]any{"text": "hi", "model": "tiny", "tokens": []int{1, 2, 3}},
+			})
+		case target == "other.echo":
+			_ = json.NewEncoder(w).Encode(map[string]any{
+				"status": "completed",
+				"result": map[string]any{"echo": body.Input["x"]},
+			})
+		default:
+			w.WriteHeader(http.StatusNotFound)
+			_ = json.NewEncoder(w).Encode(map[string]any{"error": "unknown target " + target})
+		}
+	})
+	f.srv = httptest.NewServer(mux)
+	t.Cleanup(f.srv.Close)
+	return f
+}
+
+func TestRegisterServeInvoke(t *testing.T) {
+	cp := newFakeCP(t)
+	a, err := New("go-agent", cp.srv.URL)
+	if err != nil {
+		t.Fatal(err)
+	}
+	a.RegisterReasoner("sum", "adds", func(ctx context.Context, in map[string]any) (any, error) {
+		ec, ok := ExecutionContextFrom(ctx)
+		if !ok || ec.ExecutionID == "" {
+			return nil, fmt.Errorf("execution context missing")
+		}
+		av, _ := in["a"].(float64)
+		bv, _ := in["b"].(float64)
+		return map[string]any{"sum": av + bv}, nil
+	})
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {
+		t.Fatal(err)
+	}
+	defer a.Stop(ctx) //nolint:errcheck
+	if cp.registered.Load() != 1 {
+		t.Fatalf("registered %d times", cp.registered.Load())
+	}
+	// invoke like the gateway does
+	resp, err := http.Post(a.BaseURL()+"/reasoners/sum", "application/json",
+		strings.NewReader(`{"input": {"a": 2, "b": 3}, "execution_id": "e1"}`))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer resp.Body.Close()
+	var out struct {
+		Result map[string]any `json:"result"`
+	}
+	if err := json.NewDecoder(resp.Body).Decode(&out); err != nil {
+		t.Fatal(err)
+	}
+	if out.Result["sum"].(float64) != 5 {
+		t.Fatalf("sum = %v", out.Result["sum"])
+	}
+	// handler errors surface as 500 {"error"}
+	resp2, _ := http.Post(a.BaseURL()+"/reasoners/missing", "application/json", strings.NewReader(`{}`))
+	defer resp2.Body.Close()
+	if resp2.StatusCode != http.StatusNotFound {
+		t.Fatalf("missing component -> %d", resp2.StatusCode)
+	}
+}
+
+func TestCallAndAi(t *testing.T) {
+	cp := newFakeCP(t)
+	a, _ := New("caller", cp.srv.URL)
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {
+		t.Fatal(err)
+	}
+	defer a.Stop(ctx) //nolint:errcheck
+
+	out, err := a.Call(ctx, "other.echo", map[string]any{"x": "y"})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if out["echo"] != "y" {
+		t.Fatalf("echo = %v", out["echo"])
+	}
+	if _, err := a.Call(ctx, "nope.nope", nil); err == nil {
+		t.Fatal("unknown target must error")
+	}
+
+	ai, err := a.Ai(ctx, "hello", nil)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if ai.Text != "hi" || ai.Model != "tiny" || len(ai.Tokens) != 3 {
+		t.Fatalf("ai = %+v", ai)
+	}
+}
+
+func TestAiStream(t *testing.T) {
+	// model node stand-in: SSE frames, default json.dumps-style separators
+	node := httptest.NewServer(http.HandlerFunc(func(w http.ResponseWriter, r *http.Request) {
+		if r.URL.Path != "/generate/stream" {
+			http.NotFound(w, r)
+			return
+		}
+		w.Header().Set("Content-Type", "text/event-stream")
+		fl := w.(http.Flusher)
+		for i := 0; i < 3; i++ {
+			fin := "false"
+			if i == 2 {
+				fin = "true"
+			}
+			fmt.Fprintf(w, "data: {\"token\": %d, \"index\": %d, \"finished\": %s, \"text\": \"t%d\"}\n\n", 100+i, i, fin, i)
+			fl.Flush()
+		}
+	}))
+	defer node.Close()
+	cp := newFakeCP(t)
+	cp.modelURL = node.URL
+
+	a, _ := New("streamer", cp.srv.URL)
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {
+		t.Fatal(err)
+	}
+	defer a.Stop(ctx) //nolint:errcheck
+	var events []StreamEvent
+	text, err := a.AiStream(ctx, "go", nil, func(ev StreamEvent) bool {
+		events = append(events, ev)
+		return true
+	})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if text != "t0t1t2" || len(events) != 3 || !events[2].Finished {
+		t.Fatalf("text=%q events=%+v", text, events)
+	}
+}
+
+func TestHeartbeatReRegistersOn404(t *testing.T) {
+	var registered atomic.Int64
+	var gone atomic.Bool
+	mux := http.NewServeMux()
+	mux.HandleFunc("/api/v1/nodes", func(w http.ResponseWriter, r *http.Request) {
+		registered.Add(1)
+		w.WriteHeader(http.StatusCreated)
+	})
+	mux.HandleFunc("/api/v1/nodes/", func(w http.ResponseWriter, _ *http.Request) {
+		if gone.Load() {
+			gone.Store(false)
+			w.WriteHeader(http.StatusNotFound)
+			return
+		}
+		_, _ = w.Write([]byte(`{}`))
+	})
+	srv := httptest.NewServer(mux)
+	defer srv.Close()
+	a, _ := New("hb", srv.URL)
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {
+		t.Fatal(err)
+	}
+	defer a.Stop(ctx) //nolint:errcheck
+	gone.Store(true) // next heartbeat sees 404 → re-register
+	deadline := time.Now().Add(10 * time.Second)
+	for registered.Load() < 2 && time.Now().Before(deadline) {
+		time.Sleep(50 * time.Millisecond)
+	}
+	if registered.Load() < 2 {
+		t.Fatalf("re-registration never happened (%d)", registered.Load())
+	}
+}

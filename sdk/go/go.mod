@@ -1,0 +1,3 @@
+module agentfield-tpu/sdk/go
+
+go 1.21

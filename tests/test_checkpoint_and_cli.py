@@ -118,3 +118,34 @@ def test_cli_list_and_logs_empty(tmp_path):
     assert r.returncode == 0 and "no managed processes" in r.stdout
     r = _cli("logs", "nonexistent", home=tmp_path)
     assert r.returncode == 1
+
+
+def test_cli_init_cpp_template_compiles(tmp_path):
+    """--lang cpp scaffolds a project that actually builds against the C++
+    SDK header (reference ships Python AND Go templates,
+    internal/templates/go/; this repo's in-CI second language is C++)."""
+    import shutil as _sh
+
+    r = _cli("init", str(tmp_path / "cagent"), "--lang", "cpp", home=tmp_path)
+    assert r.returncode == 0, r.stderr
+    src = tmp_path / "cagent" / "main.cpp"
+    assert src.exists()
+    if _sh.which("g++") is None:
+        return
+    sdk = Path(_REPO_ROOT) / "native" / "sdk"
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17", f"-I{sdk}", "-o",
+         str(tmp_path / "cagent" / "bin"), str(src), "-pthread"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert build.returncode == 0, build.stderr
+
+
+def test_cli_init_go_template(tmp_path):
+    """--lang go scaffolds Go sources wired to sdk/go (toolchain-gated:
+    compiled by tests/test_go_sdk.py's environment when Go exists)."""
+    r = _cli("init", str(tmp_path / "gagent"), "--lang", "go", home=tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "gagent" / "main.go").exists()
+    assert (tmp_path / "gagent" / "go.mod").exists()
+    assert "sdk/go" in (tmp_path / "gagent" / "go.mod").read_text()

@@ -294,7 +294,7 @@ def cmd_init(cfg: Config, args) -> int:
     elif lang == "go":
         # module paths reject slashes-from-abs-paths/uppercase/spaces —
         # sanitize the basename (the name itself only lands in comments)
-        mod = re.sub(r"[^a-z0-9._-]", "-", Path(args.name).name.lower()) or "agent"
+        mod = re.sub(r"[^a-z0-9._-]", "-", Path(args.name).name.lower()).strip("-._") or "agent"
         (target / "main.go").write_text(GO_AGENT_TEMPLATE.format(name=target.name))
         (target / "go.mod").write_text(
             f"module {mod}\n\ngo 1.21\n\n"

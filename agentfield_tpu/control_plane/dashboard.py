@@ -97,7 +97,14 @@ function liveRender(render) {
   const pump = async () => {
     if (running) { pending = true; return; }
     running = true;
-    try { await render(); } catch (_) {}
+    try { await render(); }
+    catch (e) {
+      // surface + retry: a silently-stale page labeled "live" is worse
+      // than a visible error
+      $('live').textContent = '· live (error, retrying)';
+      $('ts').textContent = 'refresh failed: ' + e;
+      setTimeout(pump, 3000);
+    }
     running = false;
     if (pending) { pending = false; setTimeout(pump, 600); }
   };

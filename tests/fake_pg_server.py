@@ -107,11 +107,58 @@ class _Reader:
         return out
 
 
+def _make_tls_context():
+    """Self-signed server context (cryptography lib — already a control-plane
+    dependency for DID keys). Certs land in a tempdir; ssl wants file paths."""
+    import datetime
+    import ssl
+    import tempfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    d = tempfile.mkdtemp(prefix="fakepg-tls-")
+    cert_path, key_path = f"{d}/cert.pem", f"{d}/key.pem"
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ))
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx, cert_path
+
+
 class FakePgServer:
     """One-database fake. `password` is what SCRAM verifies against."""
 
     def __init__(self, password: str = "hunter2", vector: bool = False,
-                 conforming_strings: str = "on"):
+                 conforming_strings: str = "on", tls: bool = False):
+        self.tls = tls
+        self._ssl_ctx = self.tls_cert = None
+        if tls:
+            self._ssl_ctx, self.tls_cert = _make_tls_context()
         self.password = password
         self.conforming_strings = conforming_strings  # tests can claim "off"
         self.stall_on: tuple[str, float] | None = None  # (sql substring, seconds)
@@ -214,10 +261,21 @@ class FakePgServer:
             (length,) = struct.unpack("!I", rd.exact(4))
             body = rd.exact(length - 4)
             (proto,) = struct.unpack("!I", body[:4])
-            if proto == 80877103:  # SSLRequest → not supported
-                conn.sendall(b"N")
+            if proto == 80877103:  # SSLRequest
+                if self._ssl_ctx is None:
+                    conn.sendall(b"N")  # declined → client may fall back
+                else:
+                    conn.sendall(b"S")
+                    conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                    rd = _Reader(conn)
                 (length,) = struct.unpack("!I", rd.exact(4))
                 body = rd.exact(length - 4)
+                (proto,) = struct.unpack("!I", body[:4])
+            elif self.tls:
+                # a TLS-required fake sees a plaintext startup: refuse, so
+                # tests catch clients that skipped the handshake
+                conn.close()
+                return
             if not self._scram(conn, rd):
                 conn.close()
                 return

@@ -149,3 +149,24 @@ def test_cli_init_go_template(tmp_path):
     assert (tmp_path / "gagent" / "main.go").exists()
     assert (tmp_path / "gagent" / "go.mod").exists()
     assert "sdk/go" in (tmp_path / "gagent" / "go.mod").read_text()
+
+
+def test_cli_init_go_template_builds_when_toolchain_exists(tmp_path):
+    """Mirror of the cpp compile test: with a Go toolchain, the scaffold
+    must `go build` against sdk/go (skipped in this image — no Go)."""
+    import shutil as _sh
+
+    if _sh.which("go") is None:
+        import pytest as _pytest
+
+        _pytest.skip("no Go toolchain")
+    r = _cli("init", str(tmp_path / "gb"), "--lang", "go", home=tmp_path)
+    assert r.returncode == 0, r.stderr
+    mod = tmp_path / "gb" / "go.mod"
+    sdk = Path(_REPO_ROOT) / "sdk" / "go"
+    mod.write_text(mod.read_text().replace("../sdk/go", str(sdk)))
+    build = subprocess.run(
+        ["go", "build", "./..."], cwd=tmp_path / "gb",
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stdout + build.stderr

@@ -239,3 +239,76 @@ async def test_stalled_pg_does_not_stall_heartbeats(pg):
         assert hb_latency < 1.0, f"heartbeat stalled {hb_latency:.2f}s behind the slow query"
         assert await t_slow == 200
         pg.stall_on = None
+
+
+# ---------------------------------------------------------------------------
+# TLS (sslmode): the SSLRequest handshake against a TLS-enabled fake server
+# ---------------------------------------------------------------------------
+
+
+def test_pg_tls_require_and_verify_full():
+    """sslmode=require encrypts without cert verification; verify-full
+    verifies against the provided root cert; queries work over the wrapped
+    socket end to end."""
+    srv = FakePgServer(tls=True).start()
+    try:
+        for mode, extra in (
+            ("require", {}),
+            ("verify-full", {"sslrootcert": srv.tls_cert}),
+            ("prefer", {}),
+        ):
+            c = PgClient(
+                port=srv.port, password="hunter2", sslmode=mode, **extra
+            )
+            assert c.tls, mode
+            cols, rows, _ = c.query("SELECT 'x' AS a")
+            assert rows == [["x"]]
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_pg_tls_modes_and_fallbacks():
+    from agentfield_tpu.control_plane.pgwire import parse_dsn
+
+    # plaintext server: require fails loudly, prefer falls back
+    plain = FakePgServer().start()
+    try:
+        with pytest.raises(ConnectionError, match="declined TLS"):
+            PgClient(port=plain.port, password="hunter2", sslmode="require")
+        c = PgClient(port=plain.port, password="hunter2", sslmode="prefer")
+        assert not c.tls
+        c.close()
+    finally:
+        plain.stop()
+    # TLS-required server refuses plaintext startups (client skipped the
+    # handshake) instead of serving them
+    tls_srv = FakePgServer(tls=True).start()
+    try:
+        with pytest.raises(ConnectionError):
+            PgClient(port=tls_srv.port, password="hunter2")  # sslmode=disable
+    finally:
+        tls_srv.stop()
+    # DSN parsing: sslmode/sslrootcert pass through; junk still rejected
+    kw = parse_dsn("postgres://u:p@h:5/db?sslmode=require&sslrootcert=/ca.pem")
+    assert kw["sslmode"] == "require" and kw["sslrootcert"] == "/ca.pem"
+    with pytest.raises(ValueError, match="unsupported DSN parameters"):
+        parse_dsn("postgres://u:p@h/db?application_name=x")
+    with pytest.raises(ValueError, match="sslmode"):
+        parse_dsn("postgres://u:p@h/db?sslmode=allow")
+
+
+@async_test
+async def test_control_plane_boots_over_tls_dsn():
+    """The full control plane boots on a postgres DSN with sslmode=require —
+    the managed-Postgres deployment shape (OPERATIONS.md)."""
+    srv = FakePgServer(tls=True).start()
+    try:
+        dsn = _dsn(srv, password="hunter2") + "?sslmode=require"
+        async with CPHarness(db_path=dsn) as h:
+            await h.register_agent("tls-agent")
+            async with h.http.get("/api/v1/nodes") as r:
+                nodes = (await r.json())["nodes"]
+            assert any(n["node_id"] == "tls-agent" for n in nodes)
+    finally:
+        srv.stop()

@@ -83,27 +83,34 @@ function nav() {
     `<a href="#/${p}" class="${cur === p ? 'on' : ''}">${label}</a>`).join('');
 }
 
-let timer = null, sse = null;
+let timer = null, sse = null, viewEpoch = 0;
 function setRefresh(fn, ms) {
   if (timer) clearInterval(timer); timer = null;
   if (sse) { sse.close(); sse = null; $('live').textContent = ''; }
+  viewEpoch++;  // invalidates any pending liveRender retries of the old view
   if (fn && ms) timer = setInterval(fn, ms);
 }
 // SSE-driven re-render pump: never two renders in flight (an older fetch
 // can't overwrite a newer one), and an event storm coalesces into at most
 // one follow-up render instead of one /dag fetch per event.
 function liveRender(render) {
-  let running = false, pending = false;
+  const epoch = viewEpoch;  // retries die with the view they belong to
+  let running = false, pending = false, retryTimer = null;
   const pump = async () => {
+    if (epoch !== viewEpoch) return;  // user navigated away
     if (running) { pending = true; return; }
     running = true;
-    try { await render(); }
-    catch (e) {
-      // surface + retry: a silently-stale page labeled "live" is worse
-      // than a visible error
-      $('live').textContent = '· live (error, retrying)';
-      $('ts').textContent = 'refresh failed: ' + e;
-      setTimeout(pump, 3000);
+    try {
+      await render();
+      if (retryTimer) { clearTimeout(retryTimer); retryTimer = null; }
+    } catch (e) {
+      // surface + retry (ONE outstanding retry, not a chain per event):
+      // a silently-stale page labeled "live" is worse than a visible error
+      if (epoch === viewEpoch) {
+        $('live').textContent = '· live (error, retrying)';
+        $('ts').textContent = 'refresh failed: ' + e;
+        if (!retryTimer) retryTimer = setTimeout(() => { retryTimer = null; pump(); }, 3000);
+      }
     }
     running = false;
     if (pending) { pending = false; setTimeout(pump, 600); }

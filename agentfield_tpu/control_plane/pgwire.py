@@ -213,12 +213,19 @@ class PgClient:
         self._sock.sendall(struct.pack("!II", 8, 80877103))
         answer = self._sock.recv(1)
         if answer != b"S":
-            if sslmode == "prefer" and answer == b"N":
-                return  # plaintext fallback, as libpq's prefer does
+            # 'N', an ErrorResponse byte ('E' from pre-SSL servers/poolers),
+            # or EOF all mean "no TLS here"
             self._sock.close()
+            if sslmode == "prefer":
+                # libpq's prefer: retry a FRESH plaintext connection
+                self._sock = socket.create_connection(
+                    (host, port), timeout=connect_timeout
+                )
+                self._sock.settimeout(read_timeout)
+                return
             raise ConnectionError(
                 f"server declined TLS (got {answer!r}) but sslmode={sslmode!r} "
-                "requires it"
+                "requires encryption"
             )
         if sslmode == "verify-full":
             ctx = ssl.create_default_context(cafile=sslrootcert)

@@ -1,0 +1,164 @@
+"""Pallas TPU BATCHED paged chunk attention (speculative verify forward).
+
+Every row of a [B, W] verify window (speculative decoding: W = spec_k+1
+tokens per sequence, each row at its OWN start position) attends over its
+sequence's paged K/V. The single-sequence chunk kernel
+(paged_chunk_attention_kernel.py) covers suffix/chunked prefill; the
+speculative verify is a *batch* of small ragged chunks, which previously
+fell back to the per-layer full-context gather (engine `_spec_decode_fn`
+verify body materialized [B, T, Kh, hd] per layer per step — exactly the
+bandwidth the paged kernels exist to avoid).
+
+Same online-softmax page walk as the decode kernel, widened to W query rows
+per sequence and indexed per-batch-row through scalar-prefetched page
+tables. Pages wholly past a row's keys (or wholly before its sliding
+window) are skipped.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _batch_chunk_kernel(
+    page_tables_ref,  # [B, maxp] int32 (scalar prefetch)
+    starts_ref,  # [B] int32 — absolute position of each row's first query
+    k_lens_ref,  # [B] int32 — total valid keys per row (start + W; 0 = inactive)
+    q_ref,  # [1, 1, W, rep, hd] — the (batch, kv-head) tile
+    k_ref,  # [1, 1, ps, hd]
+    v_ref,  # [1, 1, ps, hd]
+    o_ref,  # [1, 1, W, rep, hd]
+    m_scr,  # [W * rep, 1] f32
+    l_scr,  # [W * rep, 1] f32
+    acc_scr,  # [W * rep, hd] f32
+    *,
+    sm_scale: float,
+    page_size: int,
+    num_page_steps: int,
+    rep: int,
+    window: int | None,
+):
+    b = pl.program_id(0)
+    pi = pl.program_id(2)
+    start = starts_ref[b]
+    k_len = k_lens_ref[b]
+    W = q_ref.shape[2]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    relevant = pi * page_size < k_len
+    if window is not None:
+        # pages wholly before even the FIRST query's window skip
+        relevant &= (pi + 1) * page_size - 1 > start - window
+
+    @pl.when(relevant)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(W * rep, -1) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [W*rep, ps]
+        k_pos = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
+        keep = (k_pos <= q_pos) & (k_pos < k_len)
+        if window is not None:  # HF Mistral semantics (attention_ref)
+            keep &= k_pos > q_pos - window
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(pi == num_page_steps - 1)
+    def _finalize():
+        # inactive rows (k_len 0) never accumulated: the l floor yields 0s
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / l).reshape(W, rep, -1).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret", "window"))
+def paged_batch_chunk_attention_pallas(
+    q: jax.Array,  # [B, W, H, hd] — W query tokens per sequence
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, maxp] int32
+    starts: jax.Array,  # [B] int32 — absolute position of q[:, 0]
+    k_lens: jax.Array,  # [B] int32 — valid keys per row (0 = inactive row)
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    window: int | None = None,  # sliding window on absolute positions
+) -> jax.Array:
+    """Returns [B, W, H, hd]. Inactive rows (k_lens == 0) return zeros."""
+    B, W, H, hd = q.shape
+    P, Kh, ps, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    qg = q.reshape(B, W, Kh, rep, hd).transpose(0, 2, 1, 3, 4)  # [B, Kh, W, rep, hd]
+    kernel = functools.partial(
+        _batch_chunk_kernel, sm_scale=sm_scale, page_size=ps, num_page_steps=maxp,
+        rep=rep, window=window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Kh, maxp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, W, rep, hd), lambda b, kvh, pi, pt, st, kl: (b, kvh, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, ps, hd), lambda b, kvh, pi, pt, st, kl: (pt[b, pi], kvh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, ps, hd), lambda b, kvh, pi, pt, st, kl: (pt[b, pi], kvh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, W, rep, hd), lambda b, kvh, pi, pt, st, kl: (b, kvh, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((W * rep, 1), jnp.float32),
+            pltpu.VMEM((W * rep, 1), jnp.float32),
+            pltpu.VMEM((W * rep, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Kh, W, rep, hd), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * W * H * maxp * ps * hd,
+            bytes_accessed=2 * B * maxp * ps * Kh * hd * k_pages.dtype.itemsize,
+            transcendentals=B * W * H * maxp * ps,
+        ),
+        interpret=interpret,
+    )(page_tables, starts, k_lens, qg, k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, W, H, hd)

@@ -452,6 +452,41 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
         k_pos = jnp.arange(T, dtype=jnp.int32)[None]  # [1, T]
         k_valid = (k_pos < (seq_lens + W)[:, None]) & active[:, None]
 
+        k_lens = jnp.where(active, seq_lens + W, 0)
+
+        def _batch_chunk_attn(q, kp, vp):
+            """Verify attention over the paged cache: the batched chunk
+            kernel streams each row's pages HBM→VMEM (chunk_attn_impl=
+            "pallas"); the ref path gathers [B, T] context per layer."""
+            from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
+                paged_batch_chunk_attention_pallas,
+            )
+
+            fn = functools.partial(
+                paged_batch_chunk_attention_pallas,
+                interpret=jax.default_backend() == "cpu",
+                window=_binding_window(cfg, ecfg),
+            )
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                from jax.experimental.shard_map import shard_map
+
+                from agentfield_tpu.parallel.mesh import AXIS_MODEL
+
+                if mesh.shape.get(AXIS_MODEL, 1) > 1:
+                    fn = shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(
+                            P(None, None, AXIS_MODEL, None),  # q [B,W,H,hd]
+                            P(None, AXIS_MODEL, None, None),  # pages on Kh
+                            P(None, AXIS_MODEL, None, None),
+                            P(None, None), P(None), P(None),
+                        ),
+                        out_specs=P(None, None, AXIS_MODEL, None),
+                        check_rep=False,
+                    )
+            return fn(q, kp, vp, page_tables, seq_lens, k_lens)
+
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -460,14 +495,16 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
             # — non-adjacent advanced indices put [B, W] first: [B, W, Kh, hd]
             kp = kp.at[page_ids, :, slot_ids].set(kk)
             vp = vp.at[page_ids, :, slot_ids].set(vv)
-            # gather each row's pages → [B, T, Kh, hd] context (ref path; a
-            # batched Pallas chunk kernel is the TPU follow-up)
-            ctx_k = kp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-            ctx_v = vp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-            attn = llama.attention_ref(
-                q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid,
-                window=_binding_window(cfg, ecfg),
-            )
+            if ecfg.chunk_attn_impl == "pallas":
+                attn = _batch_chunk_attn(q, kp, vp)
+            else:
+                # ref path: gather each row's pages → [B, T, Kh, hd] context
+                ctx_k = kp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+                ctx_v = vp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+                attn = llama.attention_ref(
+                    q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid,
+                    window=_binding_window(cfg, ecfg),
+                )
             x = x + (attn.reshape(B, W, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
             return x, (kp, vp)

@@ -103,6 +103,7 @@ function liveRender(render) {
     try {
       await render();
       if (retryTimer) { clearTimeout(retryTimer); retryTimer = null; }
+      if (epoch === viewEpoch) $('live').textContent = '· live';
     } catch (e) {
       // surface + retry (ONE outstanding retry, not a chain per event):
       // a silently-stale page labeled "live" is worse than a visible error

@@ -828,6 +828,22 @@ def create_app(cp: ControlPlane) -> web.Application:
 
         return web.json_response(await ui_service.node_summaries(cp))
 
+    @routes.post("/api/ui/v1/executions/status")
+    async def ui_executions_status_bulk(req: web.Request):
+        """Bulk status refresh for visible rows (reference:
+        executions_ui_service.go RefreshStatuses) — one IN query, not N
+        detail fetches."""
+        from agentfield_tpu.control_plane import ui_service
+
+        try:
+            body = await _json_dict(req, allow_empty=False)
+        except _BadBody as e:
+            return _json_error(400, str(e))
+        ids = body.get("ids")
+        if not isinstance(ids, list) or not all(isinstance(i, str) for i in ids):
+            return _json_error(400, "field 'ids' (list of execution ids) is required")
+        return web.json_response(await ui_service.executions_status_bulk(cp.db, ids))
+
     @routes.get("/api/ui/v1/nodes/{node_id}")
     async def ui_node_details(req: web.Request):
         """Node detail + per-target SQL metrics in one fetch (reference:

@@ -228,6 +228,20 @@ class SQLiteStorage:
             ).fetchone()
         return Execution.from_dict(json.loads(row["doc"])) if row else None
 
+    def get_executions_bulk(self, ids: list[str]) -> list[Execution]:
+        """One IN-clause fetch for the UI's bulk status refresh (ref
+        executions_ui_service.go RefreshStatuses) — N visible rows refresh
+        in one statement instead of N round trips."""
+        if not ids:
+            return []
+        marks = ",".join("?" for _ in ids)
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT doc FROM executions WHERE execution_id IN ({marks})",
+                tuple(ids),
+            ).fetchall()
+        return [Execution.from_dict(json.loads(r["doc"])) for r in rows]
+
     @staticmethod
     def _exec_filters(
         run_id: str | None, status: "ExecutionStatus | None", target: str | None

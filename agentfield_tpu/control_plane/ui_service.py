@@ -105,19 +105,28 @@ async def node_summaries(cp) -> dict[str, Any]:
     nodes = await cp.db.list_nodes()
     mcp = {s["alias"]: s for s in cp.mcp.status()} if cp.mcp else {}
     now = time.time()
+    ttl = getattr(getattr(cp, "registry", None), "heartbeat_ttl", 300.0)
     out = []
     for n in nodes:
         stats = n.metadata.get("stats") if isinstance(n.metadata, dict) else None
+        age = now - n.last_heartbeat
+        # Reconciled status (ref getReconciledNodeStatus, ui_service.go:115):
+        # the stored lifecycle status can lag the sweeper; the UI must not
+        # paint an active node whose heartbeats died minutes ago as healthy.
+        effective = n.status.value
+        if effective == "active" and age > ttl:
+            effective = "stale"
         summary: dict[str, Any] = {
             "node_id": n.node_id,
             "kind": n.kind,
             "status": n.status.value,
+            "effective_status": effective,
             "base_url": n.base_url,
             "did": n.did,
             "reasoners": len(n.reasoners),
             "skills": len(n.skills),
             "registered_at": n.registered_at,
-            "last_heartbeat_age_s": round(now - n.last_heartbeat, 1),
+            "last_heartbeat_age_s": round(age, 1),
         }
         if n.kind == "model" and isinstance(stats, dict):
             summary["engine"] = {
@@ -154,7 +163,43 @@ async def node_details(cp, node_id: str) -> dict[str, Any] | None:
             metrics[t] = m
     doc["target_metrics"] = metrics
     doc["last_heartbeat_age_s"] = round(time.time() - node.last_heartbeat, 1)
+    # Installed-package attribution (ref GetNodeDetailsWithPackageInfo,
+    # ui_service.go:196): if this node came from `af install`, surface the
+    # package entry so the detail page links provenance.
+    try:
+        from agentfield_tpu.cli.packages import load_registry
+
+        reg = load_registry(cp.data_dir)
+        if node_id in reg:
+            doc["package"] = dict(reg[node_id])
+    except Exception:
+        pass  # package registry is optional context, never a 500
     return doc
+
+
+async def executions_status_bulk(db, ids: list[str]) -> dict[str, Any]:
+    """Bulk status refresh (ref executions_ui_service.go RefreshStatuses):
+    the SPA refreshes its visible rows in ONE query instead of N detail
+    fetches. Unknown ids are reported, not errored — rows may have been
+    retention-pruned since render."""
+    ids = [str(i) for i in ids]
+    overflow = ids[500:]  # bound the IN clause; overflow is REPORTED, not
+    # silently dropped (absence must always mean "pruned", never "truncated")
+    ids = ids[:500]
+    found = await db.get_executions_bulk(ids)
+    found_ids = {e.execution_id for e in found}
+    return {
+        "statuses": {
+            e.execution_id: {
+                "status": e.status.value,
+                "finished_at": e.finished_at,
+                "error": e.error,
+            }
+            for e in found
+        },
+        "missing": [i for i in ids if i not in found_ids],
+        "truncated": overflow,
+    }
 
 
 async def credentials_page(

@@ -208,3 +208,44 @@ async def test_dashboard_serves_new_pages():
             html = await r.text()
         for frag in ("pgPkgs", "pgCreds", "'pkgs'", "'creds'", "/api/ui/v1/executions"):
             assert frag in html, frag
+
+
+@async_test
+async def test_bulk_status_refresh():
+    """POST /api/ui/v1/executions/status: N visible rows refresh in one IN
+    query; pruned ids report as missing (ref RefreshStatuses)."""
+    async with CPHarness() as h:
+        _seed_executions(h.cp.db.sync, n=10)
+        ids = [f"exec_{i:04d}" for i in range(6)] + ["exec_gone"]
+        async with h.http.post(
+            "/api/ui/v1/executions/status", json={"ids": ids}
+        ) as r:
+            d = await r.json()
+        assert set(d["statuses"]) == set(ids[:-1])
+        assert d["statuses"]["exec_0001"]["status"] == "completed"
+        assert d["statuses"]["exec_0000"]["status"] == "failed"
+        assert d["missing"] == ["exec_gone"]
+        async with h.http.post(
+            "/api/ui/v1/executions/status", json={"ids": "nope"}
+        ) as r:
+            assert r.status == 400
+
+
+@async_test
+async def test_node_effective_status_reconciles_stale_heartbeats():
+    """A node stored 'active' whose heartbeat died past the TTL shows
+    effective_status='stale' (ref getReconciledNodeStatus) — the sweeper
+    may lag; the UI must not paint it healthy."""
+    from agentfield_tpu.control_plane import ui_service
+
+    async with CPHarness() as h:
+        await h.register_agent("fresh-node")
+        await h.register_agent("dead-node")
+        node = await h.cp.db.get_node("dead-node")
+        node.last_heartbeat = time.time() - 10_000  # far past the 300s TTL
+        await h.cp.db.upsert_node(node)
+        d = await ui_service.node_summaries(h.cp)
+        by_id = {n["node_id"]: n for n in d["nodes"]}
+        assert by_id["fresh-node"]["effective_status"] == "active"
+        assert by_id["dead-node"]["status"] == "active"  # stored status lags
+        assert by_id["dead-node"]["effective_status"] == "stale"

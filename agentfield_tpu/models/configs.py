@@ -274,6 +274,23 @@ PRESETS: dict[str, LlamaConfig] = {
         num_experts=4,
         num_experts_per_tok=2,
     ),
+    # microsoft/Phi-3-mini-4k-instruct: llama architecture with fused
+    # qkv/gate_up projections in the checkpoint (split at load,
+    # hf_loader.py), MHA (32 q = 32 kv heads), vocab 32064, and a
+    # 2047-token sliding window (its config.json carries it)
+    "phi-3-mini": LlamaConfig(
+        vocab_size=32064,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=32,
+        head_dim=96,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=4096,
+        sliding_window=2047,
+    ),
     # Qwen2-7B: adds QKV projection biases (attn_bias).
     "qwen2-7b": LlamaConfig(
         vocab_size=152064,

@@ -25,10 +25,17 @@ from agentfield_tpu.models.configs import LlamaConfig
 
 def config_from_hf(path: str | Path) -> LlamaConfig:
     doc = json.loads((Path(path) / "config.json").read_text())
-    if doc.get("model_type") not in ("llama", "mistral", "qwen2", "gemma", "mixtral", None):
+    if doc.get("model_type") not in (
+        "llama", "mistral", "qwen2", "gemma", "mixtral", "phi3", None
+    ):
         raise ValueError(
             f"unsupported model_type={doc.get('model_type')!r} "
-            "(llama/mistral/qwen2/gemma/mixtral)"
+            "(llama/mistral/qwen2/gemma/mixtral/phi3)"
+        )
+    if float(doc.get("partial_rotary_factor", 1.0)) != 1.0:
+        raise ValueError(
+            "partial_rotary_factor != 1.0 is not implemented; loading would "
+            "silently produce wrong logits"
         )
     gemma = doc.get("model_type") == "gemma"
     sliding_window = None
@@ -153,6 +160,27 @@ def load_hf_checkpoint(
         return jnp.asarray(np.stack(per_layer)).astype(dt)
 
     p = "model.layers.{i}."
+    fused_qkv = "model.layers.0.self_attn.qkv_proj.weight" in handles
+    fused_mlp = "model.layers.0.mlp.gate_up_proj.weight" in handles
+
+    _fused_cache: dict[str, np.ndarray] = {}
+
+    def stack_split(fmt: str, splits: list[int], part: int) -> jnp.ndarray:
+        """Phi-3 fuses projections row-wise ([out, in]); split, then
+        transpose into this repo's [in, out] layout. The fused tensor is
+        read once and cached until its LAST part is taken (qkv_proj would
+        otherwise hit disk 3x per layer — ~[9216, 3072] each on the mini)."""
+        last_part = len(splits)
+        per_layer = []
+        for i in range(cfg.num_layers):
+            name = fmt.format(i=i)
+            if name not in _fused_cache:
+                _fused_cache[name] = get(name)
+            per_layer.append(np.split(_fused_cache[name], splits)[part].T)
+            if part == last_part:
+                del _fused_cache[name]  # keep peak host memory ~1 tensor
+        return jnp.asarray(np.stack(per_layer)).astype(dt)
+
     if cfg.num_experts > 0:
         # Mixtral block_sparse_moe: gate = router, experts.N.w1/w3/w2 =
         # gate/up/down (reference modeling_mixtral naming)
@@ -161,6 +189,14 @@ def load_hf_checkpoint(
             "w_gate": stack_experts(p + "block_sparse_moe.experts.{e}.w1.weight"),
             "w_up": stack_experts(p + "block_sparse_moe.experts.{e}.w3.weight"),
             "w_down": stack_experts(p + "block_sparse_moe.experts.{e}.w2.weight"),
+        }
+    elif fused_mlp:
+        # Phi-3 gate_up_proj: [2f, d] rows = gate then up (modeling_phi3)
+        f = cfg.intermediate_size
+        mlp_params = {
+            "w_gate": stack_split(p + "mlp.gate_up_proj.weight", [f], 0),
+            "w_up": stack_split(p + "mlp.gate_up_proj.weight", [f], 1),
+            "w_down": stack(p + "mlp.down_proj.weight", transpose=True),
         }
     else:
         mlp_params = {
@@ -173,9 +209,25 @@ def load_hf_checkpoint(
         "layers": {
             "attn_norm": stack_norm(p + "input_layernorm.weight"),
             "mlp_norm": stack_norm(p + "post_attention_layernorm.weight"),
-            "wq": stack(p + "self_attn.q_proj.weight", transpose=True),
-            "wk": stack(p + "self_attn.k_proj.weight", transpose=True),
-            "wv": stack(p + "self_attn.v_proj.weight", transpose=True),
+            # Phi-3 qkv_proj rows: q (q_dim) then k then v (kv_dim each)
+            "wq": (
+                stack_split(p + "self_attn.qkv_proj.weight",
+                            [cfg.q_dim, cfg.q_dim + cfg.kv_dim], 0)
+                if fused_qkv
+                else stack(p + "self_attn.q_proj.weight", transpose=True)
+            ),
+            "wk": (
+                stack_split(p + "self_attn.qkv_proj.weight",
+                            [cfg.q_dim, cfg.q_dim + cfg.kv_dim], 1)
+                if fused_qkv
+                else stack(p + "self_attn.k_proj.weight", transpose=True)
+            ),
+            "wv": (
+                stack_split(p + "self_attn.qkv_proj.weight",
+                            [cfg.q_dim, cfg.q_dim + cfg.kv_dim], 2)
+                if fused_qkv
+                else stack(p + "self_attn.v_proj.weight", transpose=True)
+            ),
             "wo": stack(p + "self_attn.o_proj.weight", transpose=True),
             **mlp_params,
         },

@@ -45,12 +45,12 @@ class LlamaConfig:
     # forward stays one code path
     scale_embeddings: bool = False  # gemma multiplies token embeddings by
     # sqrt(hidden_size) after lookup (unembed uses the RAW tied table)
-    sliding_window: int | None = None  # Mistral/Qwen2-style windowed
+    sliding_window: int | None = None  # Mistral/Qwen2/Phi-3-style windowed
     # attention: each query attends the most recent `sliding_window` keys
-    # only. Served on the ref paths AND the pallas kernels (flash / paged
-    # decode / paged chunk implement the window with block/page skipping,
-    # so a bound window reads O(window) K/V); only ring prefill rejects
-    # binding windows
+    # only. Served EVERYWHERE: ref paths, the pallas kernels (flash / paged
+    # decode / paged chunk — window applied in-kernel with block/page
+    # skipping, so a bound window reads O(window) K/V), and ring attention
+    # (whole-block skips over the traveling positions)
     num_experts: int = 0  # >0 → Mixtral-style MoE FFN: per-layer router
     # [d, E] + expert-stacked gate/up/down [E, ...]; top-k routing with
     # softmax over the selected experts' logits

@@ -320,12 +320,6 @@ def forward_impl(
     win = cfg.sliding_window
     if win is not None and win >= tokens.shape[1]:
         win = None
-    if win is not None and attn_impl == "ring":
-        raise ValueError(
-            f"sliding_window={cfg.sliding_window} binds at S={tokens.shape[1]} "
-            "and is served on the ref/flash attention paths only "
-            "(ring attention doesn't implement windows yet)"
-        )
 
     def attend(q, k, v):
         if attn_impl == "flash":
@@ -374,7 +368,9 @@ def forward_impl(
                     "attn_impl='ring' requires mesh= with a 'seq' axis "
                     f"(got {mesh!r})"
                 )
-            return ring_attention(q, k, v, mesh, causal=True, positions=positions)
+            return ring_attention(
+                q, k, v, mesh, causal=True, positions=positions, window=win
+            )
         return attention_ref(
             q, k, v, positions, positions, jnp.ones_like(positions, bool),
             window=win,

@@ -28,7 +28,7 @@ from agentfield_tpu.parallel.mesh import AXIS_SEQ, to_varying
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_pos, k_pos, causal):
+def _block_attend(q, k, v, q_pos, k_pos, causal, window=None):
     """One Q-block × K-block partial attention. q: [B, Sq, H, hd];
     k/v: [B, Sk, Kh, hd]; positions: [B, Sq]/[B, Sk] global. Returns
     (scores_max [B,H,Sq,1], exp_sum [B,H,Sq,1], acc [B,Sq,H,hd])."""
@@ -39,6 +39,8 @@ def _block_attend(q, k, v, q_pos, k_pos, causal):
     s = jnp.einsum("bskrh,btkh->bkrst", qg, k.astype(jnp.float32))  # [B,Kh,rep,Sq,Sk]
     if causal:
         mask = k_pos[:, None, :] <= q_pos[:, :, None]  # [B, Sq, Sk]
+        if window is not None:  # HF Mistral semantics (attention_ref)
+            mask &= k_pos[:, None, :] > q_pos[:, :, None] - window
         s = jnp.where(mask[:, None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # [B,Kh,rep,Sq,1]
     # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
@@ -49,7 +51,9 @@ def _block_attend(q, k, v, q_pos, k_pos, causal):
     return m_safe, l, acc.reshape(B, Sq, H, hd)
 
 
-def _ring_attention_local(q, k, v, positions, axis_name: str, causal: bool):
+def _ring_attention_local(
+    q, k, v, positions, axis_name: str, causal: bool, window: int | None = None
+):
     """Body run per-device under shard_map. All inputs are local shards
     [B, S_local, ...]; `positions` [B, S_local] are the GLOBAL positions of
     this shard's tokens — they travel the ring alongside K/V, so the causal
@@ -70,7 +74,9 @@ def _ring_attention_local(q, k, v, positions, axis_name: str, causal: bool):
 
         def attend(args):
             m, l, acc = args
-            bm, bl, bacc = _block_attend(q, cur_k, cur_v, q_pos, k_pos, causal)
+            bm, bl, bacc = _block_attend(
+                q, cur_k, cur_v, q_pos, k_pos, causal, window=window
+            )
             bm = bm.reshape(B, -1, Sq, 1)  # [B, H, Sq, 1] (Kh*rep == H)
             bl = bl.reshape(B, -1, Sq, 1)
             # Online-softmax merge with the running statistics.
@@ -84,10 +90,15 @@ def _ring_attention_local(q, k, v, positions, axis_name: str, causal: bool):
 
         if causal:
             # Blocks wholly above the diagonal (src after me on the ring)
-            # contribute nothing: skip their FLOPs, not just mask them. The
+            # contribute nothing: skip their FLOPs, not just mask them. With
+            # a sliding window, blocks wholly BEFORE every query's window
+            # skip too (positions ride the ring, so the bound is exact). The
             # ppermute below stays unconditional — the ring must stay in
             # lockstep.
-            m, l, acc = jax.lax.cond(src_idx <= my_idx, attend, lambda a: a, (m, l, acc))
+            run = src_idx <= my_idx
+            if window is not None:
+                run &= jnp.max(k_pos) > jnp.min(q_pos) - window
+            m, l, acc = jax.lax.cond(run, attend, lambda a: a, (m, l, acc))
         else:
             m, l, acc = attend((m, l, acc))
         # Rotate K/V (and their positions) to the next ring neighbor.
@@ -107,7 +118,9 @@ def _ring_attention_local(q, k, v, positions, axis_name: str, causal: bool):
     return (acc / l).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "causal", "axis_name"))
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "causal", "axis_name", "window")
+)
 def ring_attention(
     q: jax.Array,  # [B, S, H, hd]
     k: jax.Array,  # [B, S, Kh, hd]
@@ -118,6 +131,9 @@ def ring_attention(
     positions: jax.Array | None = None,  # [B, S] global positions; default
     # arange(S) — provide explicitly for offset/continuation layouts so the
     # causal mask stays position-exact (identical to attention_ref)
+    window: int | None = None,  # sliding window (Mistral semantics): ring
+    # blocks wholly before a shard's window skip their FLOPs entirely, so a
+    # bound window visits O(window / shard_len) ring steps' worth of compute
 ) -> jax.Array:
     """Full-sequence attention with S sharded over `axis_name`. S must divide
     evenly by the axis size; positions must be STRICTLY increasing along the
@@ -141,8 +157,13 @@ def ring_attention(
         positions = jnp.arange(q.shape[1], dtype=jnp.int32)[None].repeat(q.shape[0], 0)
     spec = P(None, axis_name, None, None)
     pos_spec = P(None, axis_name)
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (HF Mistral semantics)")
     fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal,
+            window=window,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec, pos_spec),
         out_specs=spec,

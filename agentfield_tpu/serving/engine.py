@@ -219,14 +219,6 @@ def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
     return cfg
 
 
-def _non_ref_knobs(ecfg: "EngineConfig") -> list[str]:
-    """Attention-impl knobs a binding sliding window is incompatible with
-    (one list so the target- and draft-model guards cannot drift). The
-    pallas decode/flash/chunk kernels all implement windows; only the ring
-    (sequence-parallel) prefill does not."""
-    return ["prefill_impl"] if ecfg.prefill_impl == "ring" else []
-
-
 def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
     """The sliding window, or None when it cannot bind within this engine's
     context budget (kernels stay usable for short-context serving of
@@ -806,15 +798,6 @@ class InferenceEngine:
             self.ecfg = dataclasses.replace(
                 self.ecfg, prefill_chunk=min(512, self.ecfg.max_context)
             )
-        if _binding_window(cfg, self.ecfg) is not None:
-            kernel_knobs = _non_ref_knobs(self.ecfg)
-            if kernel_knobs:
-                raise ValueError(
-                    f"sliding_window={cfg.sliding_window} binds within "
-                    f"max_context={self.ecfg.max_context} but "
-                    f"prefill_impl='ring' doesn't implement windows — use "
-                    "'ref' or 'flash' prefill for windowed models"
-                )
         if self.ecfg.prefill_chunk is not None and self.ecfg.prefill_chunk < 16:
             raise ValueError(
                 f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 16 (one tile) or None"
@@ -922,19 +905,6 @@ class InferenceEngine:
                     f"draft vocab {self.draft_cfg.vocab_size} != target "
                     f"vocab {cfg.vocab_size} (speculation compares token ids)"
                 )
-            if _binding_window(self.draft_cfg, self.ecfg) is not None:
-                # Same fail-fast contract as the target-model guard above:
-                # draft prefill REPLAYS run forward_impl with prefill_impl
-                # too, so a ring prefill must not trace-fail mid-serving at
-                # the first windowed draft replay.
-                draft_knobs = _non_ref_knobs(self.ecfg)
-                if draft_knobs:
-                    raise ValueError(
-                        f"draft sliding_window={self.draft_cfg.sliding_window} "
-                        f"binds within max_context={self.ecfg.max_context} but "
-                        "prefill_impl='ring' doesn't implement windows — use "
-                        "'ref' or 'flash' prefill for windowed drafts"
-                    )
             if mesh is not None:
                 from agentfield_tpu.parallel.mesh import AXIS_MODEL as _AM
                 from agentfield_tpu.parallel.sharding import (

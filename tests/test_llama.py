@@ -335,15 +335,24 @@ def test_sliding_window_engine_decode():
             [Request(id="w", prompt=prompt, sampling=SamplingParams(max_new_tokens=10))]
         )["w"]
         assert out == want, (impls, out, want)
-    # ring prefill still rejects binding windows (no windowed ring yet)
+    # ring prefill serves binding windows too (whole-block skips over the
+    # traveling positions): same stream as the ref engine
     from agentfield_tpu.parallel import make_mesh
 
     if len(jax.devices()) >= 2:
         mesh = make_mesh({"seq": 2}, jax.devices()[:2])
-        with pytest.raises(ValueError, match="ring"):
-            InferenceEngine(
-                params, wcfg, _dc.replace(ecfg, prefill_impl="ring"), mesh=mesh
-            )
+        ring_eng = InferenceEngine(
+            params, wcfg, _dc.replace(ecfg, prefill_impl="ring"), mesh=mesh
+        )
+        ring_out = ring_eng.run_to_completion(
+            [Request(id="w", prompt=prompt * 4,  # 16 tokens: divisible bucket
+                     sampling=SamplingParams(max_new_tokens=6))]
+        )["w"]
+        plain = InferenceEngine(params, wcfg, ecfg)
+        assert ring_out == plain.run_to_completion(
+            [Request(id="w", prompt=prompt * 4,
+                     sampling=SamplingParams(max_new_tokens=6))]
+        )["w"]
     # non-binding window keeps every impl usable (window >= max_context)
     wide = _dc.replace(CFG, sliding_window=4096)
     InferenceEngine(

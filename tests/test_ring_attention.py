@@ -106,3 +106,19 @@ def test_ring_with_model_axis_combined():
     ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
     out = ring_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+@pytest.mark.parametrize("window", [5, 16, 40])
+def test_ring_attention_windowed_matches_ref(window):
+    """Sliding-window ring attention: the in-block mask + whole-block window
+    skip must reproduce attention_ref's windowed output — including windows
+    narrower than, equal to, and wider than one shard (S/n = 16)."""
+    B, S, H, Kh, hd, n_seq = 2, 64, 4, 2, 32, 4
+    mesh = make_mesh({"seq": n_seq})
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    k = _rand(ks[1], (B, S, Kh, hd))
+    v = _rand(ks[2], (B, S, Kh, hd))
+    pos = jnp.arange(S, dtype=jnp.int32)[None].repeat(B, 0)
+    ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool), window=window)
+    out = ring_attention(q, k, v, mesh, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)

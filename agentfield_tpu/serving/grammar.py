@@ -51,11 +51,21 @@ class _NFA:
     ranges (inclusive) or epsilon. Fragments expose (start, accept) and are
     combined functionally."""
 
+    # Hard bound on NFA construction: schemas arrive over the wire
+    # (model_node compiles per-request), and $ref fan-out can blow a
+    # few-KB schema up exponentially — fail with SchemaError, not OOM.
+    MAX_STATES = 200_000
+
     def __init__(self):
         self.edges: list[list[tuple[int, int, int]]] = []  # state -> [(lo, hi, dst)]
         self.eps: list[list[int]] = []  # state -> [dst]
 
     def state(self) -> int:
+        if len(self.edges) >= self.MAX_STATES:
+            raise SchemaError(
+                f"schema expands past {self.MAX_STATES} NFA states "
+                "(deep $ref fan-out?) — simplify or bound the schema"
+            )
         self.edges.append([])
         self.eps.append([])
         return len(self.edges) - 1
@@ -231,23 +241,72 @@ def _make_ws(n: _NFA, max_ws: int):
 
 
 def build_schema_nfa(
-    n: _NFA, schema: dict[str, Any], depth: int = 0, ws=None
+    n: _NFA, schema: dict[str, Any], depth: int = 0, ws=None,
+    root: dict[str, Any] | None = None, active_refs: frozenset = frozenset(),
 ) -> tuple[int, int]:
     """Recursively build the NFA fragment for one schema node. Canonical
     compact JSON (properties in declaration order); `required` marks the
     mandatory subset, `ws()` (when enabled) yields optional-whitespace
-    fragments inserted at structural boundaries."""
+    fragments inserted at structural boundaries.
+
+    pydantic-emitted constructs are supported: ``$ref``/``$defs`` (resolved
+    against ``root``; RECURSIVE refs are rejected — a DFA is finite and
+    recursive JSON is not a regular language), ``anyOf``/``oneOf``
+    (alternation; oneOf's exclusivity is relaxed to acceptance — standard in
+    token-masking decoders), and single-element ``allOf`` (pydantic v1's
+    ref-wrapping)."""
     if ws is None:
         ws = lambda: None
+    if root is None:
+        root = schema
+    # depth counts STRUCTURAL nesting (arrays/objects) only; $ref/anyOf/
+    # allOf unwrapping layers carry a separate, larger budget so pydantic
+    # model chains (each level = object + $ref, often + allOf) aren't
+    # rejected at half the advertised structural depth.
     if depth > 16:
-        raise SchemaError("schema nesting deeper than 16")
+        raise SchemaError("schema nesting deeper than 16 (arrays/objects)")
+    if len(active_refs) > 64:
+        raise SchemaError("more than 64 chained $refs")
+
+    def recur(sub: dict, bump: bool = True, extra_ref: str | None = None):
+        refs = active_refs | {extra_ref} if extra_ref else active_refs
+        return build_schema_nfa(n, sub, depth + (1 if bump else 0), ws, root, refs)
+
+    if "$ref" in schema:
+        ref = schema["$ref"]
+        if ref in active_refs:
+            raise SchemaError(
+                f"recursive $ref {ref!r}: a token-mask DFA is finite and "
+                "cannot accept recursive schemas"
+            )
+        if not ref.startswith("#/"):
+            raise SchemaError(f"only intra-document $ref supported, got {ref!r}")
+        node: Any = root
+        for part in ref[2:].split("/"):
+            part = part.replace("~1", "/").replace("~0", "~")
+            if not isinstance(node, dict) or part not in node:
+                raise SchemaError(f"$ref {ref!r} does not resolve")
+            node = node[part]
+        return recur(node, bump=False, extra_ref=ref)
+    if "anyOf" in schema or "oneOf" in schema:
+        branches = schema.get("anyOf") or schema.get("oneOf")
+        if not isinstance(branches, list) or not branches:
+            raise SchemaError("anyOf/oneOf must be a non-empty list")
+        return n.alt(*[recur(b, bump=False) for b in branches])
+    if "allOf" in schema:
+        branches = schema["allOf"]
+        if isinstance(branches, list) and len(branches) == 1:
+            # pydantic v1 wraps refs as allOf=[{$ref}] (+ sibling metadata)
+            merged = {**branches[0], **{k: v for k, v in schema.items() if k != "allOf"}}
+            return recur(merged, bump=False)
+        raise SchemaError("allOf with multiple subschemas is not supported")
     if "enum" in schema:
         return n.alt(*[n.lit(json.dumps(v, separators=(",", ":"))) for v in schema["enum"]])
     if "const" in schema:
         return n.lit(json.dumps(schema["const"], separators=(",", ":")))
     t = schema.get("type")
     if isinstance(t, list):
-        return n.alt(*[build_schema_nfa(n, {**schema, "type": one}, depth, ws) for one in t])
+        return n.alt(*[recur({**schema, "type": one}, bump=False) for one in t])
     if t == "string":
         return _json_string(n, schema.get("maxLength"))
     if t == "integer":
@@ -264,7 +323,7 @@ def build_schema_nfa(
         max_items = schema.get("maxItems")
 
         def item():
-            return build_schema_nfa(n, items, depth + 1, ws)
+            return recur(items)
 
         def comma_item():
             return n.concat(n.lit(","), ws(), item())
@@ -315,7 +374,7 @@ def build_schema_nfa(
                 n.lit(json.dumps(name)),
                 n.lit(":"),
                 ws(),
-                build_schema_nfa(n, sub, depth + 1, ws),
+                recur(sub),
             ]
             return n.concat(*[p for p in parts if p is not None])
 

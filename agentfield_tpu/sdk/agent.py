@@ -447,6 +447,11 @@ class Agent:
         audio: list[Any] | None = None,
         files: list[Any] | None = None,
         output: str | None = None,
+        messages: list[dict[str, str]] | None = None,  # chat form
+        # ([{role, content}]): the MODEL NODE applies its tokenizer's chat
+        # template (reference CompleteWithMessages, sdk/go/ai/client.go:61).
+        # Exclusive with prompt/tokens; media markers inside message content
+        # still fuse.
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -487,6 +492,29 @@ class Agent:
         top_k, top_p = p["top_k"], p["top_p"]
         stop_token_ids, timeout = p["stop_token_ids"], p["timeout"]
         context_overflow, output = p["context_overflow"], p["output"]
+        if messages is not None:
+            if prompt is not None or tokens is not None:
+                raise ValueError("messages is exclusive with prompt/tokens")
+            if not messages:
+                raise ValueError("messages must be non-empty")
+            messages = [dict(m) for m in messages]  # appends stay caller-invisible
+
+        def _carrier_text() -> str | None:
+            """The text the markers/instructions live in: the prompt, or the
+            concatenated chat contents."""
+            if messages is not None:
+                return "\n".join(str(m.get("content", "")) for m in messages)
+            return prompt
+
+        def _carrier_append(text: str) -> None:
+            """Append to the prompt, or to the LAST chat message's content
+            (file blocks, missing media markers, the schema instruction)."""
+            nonlocal prompt, messages
+            if messages is not None:
+                messages[-1]["content"] = str(messages[-1].get("content", "")) + text
+            else:
+                prompt = (prompt or "") + text
+
         if files:
             if tokens is not None:
                 # _submit generates from `tokens` and ignores `prompt`; the
@@ -496,32 +524,39 @@ class Agent:
             from agentfield_tpu.sdk.multimodal import file_prompt_block
 
             blocks = [file_prompt_block(f) for f in _normalize_files(files)]
-            prompt = "\n".join(([prompt] if prompt else []) + blocks)
+            if messages is None and prompt is None:
+                prompt = "\n".join(blocks)
+            else:
+                _carrier_append("\n" + "\n".join(blocks))
         if images:
-            if prompt is None:
-                raise ValueError("images require a text prompt")
+            if _carrier_text() is None:
+                raise ValueError("images require a text prompt (or messages)")
             images = _normalize_images(images)
-            # Each image needs an <image> marker in the prompt; unmarked
+            # Each image needs an <image> marker in the prompt/chat; unmarked
             # images append at the end (reference: image parts are appended
             # in argument order, agent_ai.py:449).
-            missing = len(images) - prompt.count("<image>")
+            have = _carrier_text().count("<image>")
+            missing = len(images) - have
             if missing < 0:
                 raise ValueError(
-                    f"prompt has {prompt.count('<image>')} <image> markers "
+                    f"prompt has {have} <image> markers "
                     f"but only {len(images)} images were passed"
                 )
-            prompt = prompt + "\n<image>" * missing
+            if missing:
+                _carrier_append("\n<image>" * missing)
         if audio:
-            if prompt is None:
-                raise ValueError("audio inputs require a text prompt")
+            if _carrier_text() is None:
+                raise ValueError("audio inputs require a text prompt (or messages)")
             audio = _normalize_audio(audio)
-            missing = len(audio) - prompt.count("<audio>")
+            have = _carrier_text().count("<audio>")
+            missing = len(audio) - have
             if missing < 0:
                 raise ValueError(
-                    f"prompt has {prompt.count('<audio>')} <audio> markers "
+                    f"prompt has {have} <audio> markers "
                     f"but only {len(audio)} audio parts were passed"
                 )
-            prompt = prompt + "\n<audio>" * missing
+            if missing:
+                _carrier_append("\n<audio>" * missing)
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
                 f"unknown output modality {output!r}: 'text' | 'audio' "
@@ -532,15 +567,17 @@ class Agent:
         if output != "text" and schema is not None:
             raise ValueError("schema-constrained decoding is text-only")
         if schema is not None:
-            if prompt is None:
-                raise ValueError("schema requires a text prompt")
+            if _carrier_text() is None:
+                raise ValueError("schema requires a text prompt (or messages)")
             from agentfield_tpu.sdk.structured import schema_instruction
 
-            prompt = prompt + schema_instruction(schema)
+            # the DFA mask on the node enforces correctness; this steers
+            _carrier_append(schema_instruction(schema))
         ctx = current_context()
         payload = {
             "prompt": prompt,
             "tokens": tokens,
+            "messages": messages,
             "images": images or None,
             "audios": audio or None,
             "output": output,

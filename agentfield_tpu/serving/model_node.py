@@ -771,10 +771,37 @@ class ModelBackend:
         self._wake.set()
         return rid, truncated
 
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        """[{role, content}] → one prompt string. HF tokenizers use their
+        checkpoint's own chat template (add_generation_prompt=True — the
+        reference's CompleteWithMessages rides the provider's template,
+        sdk/go/ai/client.go:61); tokenizers without one fall back to a plain
+        role-tagged transcript. Media markers inside message content flow
+        through to the normal fusion path."""
+        for i, m in enumerate(messages):
+            bad = (
+                not isinstance(m, dict)
+                or not isinstance(m.get("content"), str)
+                or m.get("role") not in ("system", "user", "assistant")
+            )
+            if bad:
+                raise ValueError(
+                    f"messages[{i}] must be {{role: system|user|assistant, "
+                    "content: str}"
+                )
+        tok = getattr(self.tokenizer, "_tok", None)
+        if tok is not None and getattr(tok, "chat_template", None):
+            return tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        lines = [f"{m['role']}: {m['content']}" for m in messages]
+        return "\n".join(lines) + "\nassistant:"
+
     async def generate(
         self,
         prompt: str | None = None,
         tokens: list[int] | None = None,
+        messages: list[dict] | None = None,
         max_new_tokens: int = 128,
         temperature: float = 0.0,
         top_k: int = 0,
@@ -793,6 +820,10 @@ class ModelBackend:
                 "(synthesize the prompt) | 'speech' (generate, then "
                 "synthesize the generated text) | 'image' (render the prompt)"
             )
+        if messages is not None:
+            if prompt is not None or tokens is not None:
+                raise ValueError("messages is exclusive with prompt/tokens")
+            prompt = self.apply_chat_template(messages)
         if output in ("audio", "speech") and self.tts_cfg is None:
             # Fail in milliseconds, not after a full LM decode.
             raise ValueError(
@@ -1093,6 +1124,10 @@ def build_model_node(
                 )
                 if body.get(k) is not None
             }
+            if body.get("messages") is not None:
+                if gen_kwargs.get("prompt") is not None or gen_kwargs.get("tokens") is not None:
+                    raise ValueError("messages is exclusive with prompt/tokens")
+                gen_kwargs["prompt"] = backend.apply_chat_template(body["messages"])
             if body.get("output") not in (None, "text"):
                 raise ValueError(
                     "the token stream is text-only; use the unary generate "

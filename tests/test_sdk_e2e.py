@@ -338,3 +338,89 @@ async def test_ai_file_parts_inline_and_reject():
             await app.stop()
             await model_agent.stop()
             await backend.stop()
+
+
+@async_test
+async def test_ai_chat_messages():
+    """ai(messages=[...]) — the reference's CompleteWithMessages shape: the
+    model node applies a chat template (plain role-tagged fallback for the
+    byte tokenizer) and generation proceeds as usual."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("chat-agent", h.base_url)
+        await app.start()
+        try:
+            out = await app.ai(
+                messages=[
+                    {"role": "system", "content": "be brief"},
+                    {"role": "user", "content": "hi"},
+                ],
+                max_new_tokens=4,
+            )
+            assert len(out["tokens"]) == 4
+            with pytest.raises(ValueError, match="exclusive"):
+                await app.ai(prompt="x", messages=[{"role": "user", "content": "y"}])
+            # bad role rejected server-side with a clear error
+            doc = await app.client.execute(
+                "model-tiny.generate",
+                {"messages": [{"role": "tool", "content": "z"}]},
+            )
+            assert doc["status"] == "failed" and "role" in (doc["error"] or "")
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()
+
+
+@async_test
+async def test_ai_chat_composes_with_schema_files_media():
+    """Chat form composes with the rest of ai(): schema instruction and
+    file blocks append to the last message; media markers inside message
+    content fuse through the normal path."""
+    import numpy as np
+
+    from agentfield_tpu.sdk import FileContent
+
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny",
+            ecfg=EngineConfig(max_batch=4, page_size=8, num_pages=256,
+                              max_pages_per_seq=32, grammar_slots=512),
+            vision="vit-tiny",
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("compose-agent", h.base_url)
+        await app.start()
+        try:
+            msgs = lambda c: [{"role": "user", "content": c}]
+            out = await app.ai(
+                messages=msgs("pick"),
+                schema={"type": "object", "properties": {"ok": {"type": "boolean"}},
+                        "required": ["ok"]},
+                max_new_tokens=40,
+            )
+            assert isinstance(out["parsed"]["ok"], bool)
+            out2 = await app.ai(
+                messages=msgs("summarize"),
+                files=[FileContent(b"k,v\n1,2\n", name="t.csv", mime="text/csv")],
+                max_new_tokens=3,
+            )
+            assert len(out2["tokens"]) == 3
+            img = np.full((8, 8, 3), 0.5, np.float32)
+            out3 = await app.ai(
+                messages=msgs("describe <image>"), images=[img], max_new_tokens=3,
+            )
+            assert len(out3["tokens"]) == 3
+            # caller's messages list is NOT mutated by the appends
+            keep = msgs("untouched")
+            await app.ai(messages=keep, schema={"type": "boolean"}, max_new_tokens=30)
+            assert keep == [{"role": "user", "content": "untouched"}]
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()

@@ -747,6 +747,8 @@ class Agent:
         top_p: float | None = None,
         stop_token_ids: list[int] | None = None,
         timeout: float | None = None,
+        messages: list[dict[str, str]] | None = None,  # chat form (the node
+        # applies its tokenizer's chat template, as in ai())
     ):
         """Token-streaming LLM call: SSE straight from the model node (data
         plane), with DAG visibility via workflow lifecycle events. Yields
@@ -768,6 +770,11 @@ class Agent:
         max_new_tokens, temperature = rp["max_new_tokens"], rp["temperature"]
         top_k, top_p = rp["top_k"], rp["top_p"]
         stop_token_ids, timeout = rp["stop_token_ids"], rp["timeout"]
+        if messages is not None:
+            if prompt is not None or tokens is not None:
+                raise ValueError("messages is exclusive with prompt/tokens")
+            if not messages:
+                raise ValueError("messages must be non-empty")
         node = await self._resolve_model_node(model)
         ctx = self._outbound_ctx()
         base = {
@@ -776,7 +783,10 @@ class Agent:
             "run_id": ctx.run_id,
             "parent_execution_id": ctx.parent_execution_id,
             "target": f"{node['node_id']}.generate",
-            "input": {"prompt": prompt, "max_new_tokens": max_new_tokens, "stream": True},
+            "input": {
+                "prompt": prompt, "messages": messages,
+                "max_new_tokens": max_new_tokens, "stream": True,
+            },
         }
         try:
             await self.client.post_workflow_event(base)
@@ -785,6 +795,7 @@ class Agent:
         payload = {
             "prompt": prompt,
             "tokens": tokens,
+            "messages": messages,
             "max_new_tokens": max_new_tokens,
             "temperature": temperature,
             "top_k": top_k,

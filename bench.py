@@ -33,8 +33,8 @@ AGENTFIELD_BENCH_REQUESTS, AGENTFIELD_BENCH_BATCH,
 AGENTFIELD_BENCH_ATTN=auto|ref|pallas, AGENTFIELD_BENCH_WATCHDOG (s),
 AGENTFIELD_BENCH_SKIP_PROBE=1 (operator knows the chip is healthy),
 AGENTFIELD_BENCH_QUANT=int8 (weight-only quantized serving),
-AGENTFIELD_BENCH_SPEC=<draft preset|checkpoint> + AGENTFIELD_BENCH_SPEC_K
-(speculative decoding).
+AGENTFIELD_BENCH_SPEC=<draft preset|checkpoint|self> + AGENTFIELD_BENCH_SPEC_K
+(speculative decoding; 'self' = self-draft upper bound, acceptance ≈ 1).
 """
 
 from __future__ import annotations
@@ -409,10 +409,17 @@ def _run_bench() -> None:
 
         params = quantize_params(params)
     if spec_k:
-        from agentfield_tpu.serving.model_node import load_draft_model
-
         _partial["stage"] = "load draft"
-        draft_model = load_draft_model(spec_draft, cfg.vocab_size, seed=3)
+        if spec_draft == "self":
+            # Self-draft upper bound: the target verifies its own proposals
+            # (acceptance ≈ 1), measuring the pure mechanics of speculative
+            # dispatch — the CPU fallback uses this so spec_tokens_per_step
+            # is meaningful without a trained draft checkpoint.
+            draft_model = (params, cfg)
+        else:
+            from agentfield_tpu.serving.model_node import load_draft_model
+
+            draft_model = load_draft_model(spec_draft, cfg.vocab_size, seed=3)
     demoted = None
     if attn == "pallas":
         if not _budget_gate("correctness gate (pallas vs ref numerics)", 180):
@@ -528,6 +535,45 @@ def _run_bench() -> None:
     burst_p50 = burst[len(burst) // 2] if burst else None
     burst_p99 = burst[int(len(burst) * 0.99)] if burst else None
 
+    # Speculative side-stage (only when spec wasn't requested globally):
+    # a small self-draft burst measures the spec dispatch mechanics —
+    # acceptance ≈ 1, greedy-equivalent — WITHOUT touching the headline
+    # (on CPU the draft forwards cost more than they save; on TPU the win
+    # is tokens per tunnel round-trip).
+    # The headline is already measured: stash it so a watchdog firing in any
+    # later stage still ships the real number, never just the fallback.
+    _partial["tok_s"] = round(tok_s, 1)
+    _partial["burst_ttft_ms_p50"] = round(burst_p50, 1) if burst_p50 else None
+    spec_side_tok_s = spec_side_rate = None
+    # Fresh spec-dispatch compile: cheap on CPU, minutes on the tunnel —
+    # budget accordingly, and never let a side-stage failure eat the
+    # measured headline.
+    if not spec_k and _remaining() > (90 if not on_tpu else 420):
+        _partial["stage"] = "spec side-stage (self-draft)"
+        try:
+            import dataclasses as _dc
+
+            s_ecfg = _dc.replace(ecfg, spec_k=4, max_batch=8)
+            seng = InferenceEngine(params, cfg, s_ecfg, draft=(params, cfg))
+            for _ in seng.run_to_completion(make_reqs(cfg, "spw", 2, new_toks=8)):
+                pass  # warm the spec-dispatch compile out of the timing
+            sreqs = make_reqs(cfg, "sp", 8, new_toks=64)
+            st0 = time.perf_counter()
+            for r in sreqs:
+                seng.submit(r)
+            stoks = 0
+            while seng.has_work():
+                stoks += len(seng.step())
+            sel = time.perf_counter() - st0
+            if seng.stats["spec_steps"]:
+                spec_side_tok_s = round(stoks / sel, 1)
+                spec_side_rate = round(
+                    seng.stats["spec_emitted"] / seng.stats["spec_steps"], 2
+                )
+            del seng
+        except Exception as e:  # informational stage only
+            _partial["spec_side_error"] = repr(e)[:200]
+
     _emit(
         {
             "metric": f"decode_throughput_{model}_continuous_batching_{n_requests}req",
@@ -558,8 +604,9 @@ def _run_bench() -> None:
             "spec_tokens_per_step": (
                 round(engine.stats["spec_emitted"] / engine.stats["spec_steps"], 2)
                 if engine.stats["spec_steps"]
-                else None
+                else spec_side_rate  # batch-aggregate (rows x accepted+1)
             ),
+            "spec_self_draft_tok_s": spec_side_tok_s,
             "device": str(jax.devices()[0]),
         }
     )

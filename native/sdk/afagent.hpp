@@ -346,19 +346,50 @@ class Agent {
     // kind=model node. Retries 503 backpressure with capped backoff.
     AiResponse ai(const std::string& prompt, int max_new_tokens = 64,
                   double temperature = 0.0, std::string model_node = "") {
+        std::ostringstream body;
+        body << "{\"prompt\":\"" << json_escape(prompt)
+             << "\",\"max_new_tokens\":" << max_new_tokens
+             << ",\"temperature\":" << temperature << "}";
+        return ai_request(body.str(), model_node);
+    }
+
+    // Chat form (the Python SDK's ai(messages=...) / reference
+    // CompleteWithMessages, sdk/go/ai/client.go:61): the model node applies
+    // its tokenizer's chat template. messages = {role, content} pairs with
+    // role in {system, user, assistant}.
+    AiResponse ai_chat(
+        const std::vector<std::pair<std::string, std::string>>& messages,
+        int max_new_tokens = 64, double temperature = 0.0,
+        std::string model_node = "") {
+        if (messages.empty()) {  // Python-SDK parity: fail fast client-side
+            AiResponse out;
+            out.error = "messages must be non-empty";
+            return out;
+        }
+        std::ostringstream body;
+        body << "{\"messages\":[";
+        for (size_t i = 0; i < messages.size(); ++i) {
+            if (i) body << ",";
+            body << "{\"role\":\"" << json_escape(messages[i].first)
+                 << "\",\"content\":\"" << json_escape(messages[i].second)
+                 << "\"}";
+        }
+        body << "],\"max_new_tokens\":" << max_new_tokens
+             << ",\"temperature\":" << temperature << "}";
+        return ai_request(body.str(), model_node);
+    }
+
+  private:
+    AiResponse ai_request(const std::string& body_json, std::string model_node) {
         AiResponse out;
         if (model_node.empty()) {
             std::string base_url;
             if (!resolve_model_node(model_node, base_url, out.error)) return out;
         }
-        std::ostringstream body;
-        body << "{\"prompt\":\"" << json_escape(prompt)
-             << "\",\"max_new_tokens\":" << max_new_tokens
-             << ",\"temperature\":" << temperature << "}";
         HttpResponse resp;
         int delay_ms = 200;
         for (int attempt = 0; attempt < 6; ++attempt) {
-            resp = execute(model_node + ".generate", body.str());
+            resp = execute(model_node + ".generate", body_json);
             bool backpressure =
                 resp.status == 503 ||
                 (resp.body.find("QueueFullError") != std::string::npos &&
@@ -383,6 +414,7 @@ class Agent {
         return out;
     }
 
+  public:
     // Streaming ai(): tokens arrive through `on_event` as the model decodes
     // (the Python SDK's ai_stream / reference streaming passthrough,
     // agent_ai.py:414). The data plane is the MODEL NODE's own

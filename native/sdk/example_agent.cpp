@@ -68,6 +68,18 @@ int main(int argc, char** argv) {
         "Greet via the TPU model node (C++ ai() demo)");
 
     agent.register_reasoner(
+        "cpp_ai_chat",
+        [&agent](const std::string&) {
+            // Chat-form parity (reference CompleteWithMessages): the model
+            // node applies its tokenizer's chat template.
+            afield::AiResponse r = agent.ai_chat(
+                {{"system", "be brief"}, {"user", "hi from C++"}}, 5, 0.0);
+            if (!r.ok) return std::string("{\"error\":\"") + afield::json_escape(r.error) + "\"}";
+            return std::string("{\"text\":\"") + afield::json_escape(r.text) + "\"}";
+        },
+        "Chat via the TPU model node (C++ ai_chat demo)");
+
+    agent.register_reasoner(
         "cpp_ai_stream",
         [&agent](const std::string&) {
             // Streaming parity: tokens arrive per-frame over the model

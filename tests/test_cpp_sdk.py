@@ -57,7 +57,8 @@ async def test_cpp_agent_end_to_end():
             node = nodes["cpp-agent"]
             assert node["metadata"] == {"sdk": "cpp"}
             assert {r["id"] for r in node["reasoners"]} == {
-                "cpp_echo", "cpp_sum", "cpp_ai_greet", "cpp_ai_stream"
+                "cpp_echo", "cpp_sum", "cpp_ai_greet", "cpp_ai_chat",
+                "cpp_ai_stream"
             }
             assert node["did"].startswith("did:key:z")  # full identity parity
 
@@ -131,6 +132,13 @@ async def test_cpp_ai_client_through_model_node():
             assert doc["status"] == "completed", doc
             assert doc["result"]["model"] == "llama-tiny"
             assert isinstance(doc["result"]["text"], str) and doc["result"]["text"]
+            # chat form: messages → node-side chat template → generation
+            async with h.http.post(
+                "/api/v1/execute/cpp-agent.cpp_ai_chat", json={"input": {}}
+            ) as r:
+                chat_doc = await r.json()
+            assert chat_doc["status"] == "completed", chat_doc
+            assert isinstance(chat_doc["result"]["text"], str) and chat_doc["result"]["text"]
         finally:
             proc.terminate()
             await proc.wait()

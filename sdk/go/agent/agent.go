@@ -280,11 +280,17 @@ func (a *Agent) Execute(ctx context.Context, target string, input map[string]any
 	return doc, nil
 }
 
-// AiOptions tune an Ai / AiStream call.
+// AiOptions tune an Ai / AiChat / AiStream call.
 type AiOptions struct {
 	MaxNewTokens int     // default 64
 	Temperature  float64 // default 0 (greedy)
 	ModelNode    string  // pin a node id; empty resolves the first active model node
+}
+
+// Message is one chat turn (role: system | user | assistant).
+type Message struct {
+	Role    string `json:"role"`
+	Content string `json:"content"`
 }
 
 // AiResponse is the decoded result of Ai.
@@ -298,6 +304,20 @@ type AiResponse struct {
 // reference Go SDK's ai.Client role (sdk/go/ai/client.go) served in-cluster.
 // Retries 503/queue-full backpressure with capped exponential backoff.
 func (a *Agent) Ai(ctx context.Context, prompt string, opts *AiOptions) (*AiResponse, error) {
+	return a.aiRequest(ctx, map[string]any{"prompt": prompt}, opts)
+}
+
+// AiChat runs the chat form (reference CompleteWithMessages,
+// sdk/go/ai/client.go:61): the model node applies its tokenizer's chat
+// template to the messages.
+func (a *Agent) AiChat(ctx context.Context, messages []Message, opts *AiOptions) (*AiResponse, error) {
+	if len(messages) == 0 {
+		return nil, errors.New("messages must be non-empty")
+	}
+	return a.aiRequest(ctx, map[string]any{"messages": messages}, opts)
+}
+
+func (a *Agent) aiRequest(ctx context.Context, input map[string]any, opts *AiOptions) (*AiResponse, error) {
 	o := withDefaults(opts)
 	node := o.ModelNode
 	if node == "" {
@@ -307,9 +327,11 @@ func (a *Agent) Ai(ctx context.Context, prompt string, opts *AiOptions) (*AiResp
 		}
 	}
 	payload := map[string]any{
-		"prompt":         prompt,
 		"max_new_tokens": o.MaxNewTokens,
 		"temperature":    o.Temperature,
+	}
+	for k, v := range input {
+		payload[k] = v
 	}
 	delay := 200 * time.Millisecond
 	var doc map[string]any

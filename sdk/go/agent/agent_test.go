@@ -16,10 +16,11 @@ import (
 // heartbeat, node-list and execute surfaces (the Go twin of the Python
 // tests' CPHarness, scoped to what this SDK touches).
 type fakeCP struct {
-	srv        *httptest.Server
-	registered atomic.Int64
-	heartbeats atomic.Int64
-	modelURL   string
+	srv          *httptest.Server
+	registered   atomic.Int64
+	heartbeats   atomic.Int64
+	modelURL     string
+	lastGenerate atomic.Pointer[map[string]any]
 }
 
 func newFakeCP(t *testing.T) *fakeCP {
@@ -48,6 +49,7 @@ func newFakeCP(t *testing.T) *fakeCP {
 		_ = json.NewDecoder(r.Body).Decode(&body)
 		switch {
 		case target == "m.generate":
+			f.lastGenerate.Store(&body.Input)
 			_ = json.NewEncoder(w).Encode(map[string]any{
 				"status": "completed",
 				"result": map[string]any{"text": "hi", "model": "tiny", "tokens": []int{1, 2, 3}},
@@ -215,5 +217,41 @@ func TestHeartbeatReRegistersOn404(t *testing.T) {
 	}
 	if registered.Load() < 2 {
 		t.Fatalf("re-registration never happened (%d)", registered.Load())
+	}
+}
+
+func TestAiChat(t *testing.T) {
+	cp := newFakeCP(t)
+	a, _ := New("chatter", cp.srv.URL)
+	ctx := context.Background()
+	if err := a.Start(ctx); err != nil {
+		t.Fatal(err)
+	}
+	defer a.Stop(ctx) //nolint:errcheck
+	out, err := a.AiChat(ctx, []Message{{Role: "user", Content: "hi"}}, nil)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if out.Text != "hi" {
+		t.Fatalf("chat text = %q", out.Text)
+	}
+	// the wire payload must carry messages (not prompt)
+	sent := cp.lastGenerate.Load()
+	if sent == nil {
+		t.Fatal("generate payload not captured")
+	}
+	if _, hasPrompt := (*sent)["prompt"]; hasPrompt {
+		t.Fatalf("chat payload carries prompt: %v", *sent)
+	}
+	msgs, ok := (*sent)["messages"].([]any)
+	if !ok || len(msgs) != 1 {
+		t.Fatalf("messages missing from payload: %v", *sent)
+	}
+	first, _ := msgs[0].(map[string]any)
+	if first["role"] != "user" || first["content"] != "hi" {
+		t.Fatalf("bad message encoding: %v", msgs[0])
+	}
+	if _, err := a.AiChat(ctx, nil, nil); err == nil {
+		t.Fatal("empty messages must error")
 	}
 }

@@ -4,3 +4,11 @@ from agentfield_tpu.training.trainer import (  # noqa: F401
     make_train_step,
     init_train_state,
 )
+from agentfield_tpu.training.lora import (  # noqa: F401
+    LoRAConfig,
+    init_lora_params,
+    init_lora_state,
+    lora_pspecs,
+    make_lora_train_step,
+    merge_lora,
+)

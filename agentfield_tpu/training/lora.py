@@ -1,0 +1,168 @@
+"""LoRA fine-tuning: low-rank adapters over the stacked-layer param tree.
+
+The reference cannot fine-tune at all (its models live behind provider
+APIs); here adaptation is a first-class loop: train adapters on the TPU
+mesh, merge them into the base weights, and serve the merged model through
+the same engine — `fine-tune → merge → serve` with no external tooling.
+
+TPU-first design notes:
+
+- Adapters attach to the stacked layer weights ([L, in, out] → a: [L, in, r],
+  b: [L, r, out] with b zero-init, so step 0 is exactly the base model).
+  The contribution is ``(x @ a) @ b * alpha/rank`` — but rather than
+  rewriting the forward, the loss merges ``w + a @ b * scale`` per step:
+  one [L, in, out] einsum per target that XLA fuses into the existing
+  scan, keeping ONE forward implementation for base/LoRA/serving.
+- What LoRA buys here is the OPTIMIZER memory: adam moments exist only for
+  the adapter tree (rank·(in+out) per target instead of in·out — ~0.5% of
+  an 8B model at r=16), plus tiny checkpoints and instant adapter swaps.
+  The per-step merged copy of targeted weights is transient activation
+  memory under remat, not a second resident set of moments.
+- Sharding composes with TP: ``a`` replicates (rank ≪ in), ``b`` shards its
+  out-dim exactly like the base weight, so the merge einsum needs no
+  resharding and grads ride the same collectives as the base step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from agentfield_tpu.models.configs import LlamaConfig
+from agentfield_tpu.parallel.mesh import AXIS_MODEL
+from agentfield_tpu.training.trainer import TrainState, causal_lm_loss
+
+# target name → (in_dim, out_dim) resolver over the config
+_TARGET_DIMS = {
+    "wq": lambda c: (c.hidden_size, c.q_dim),
+    "wk": lambda c: (c.hidden_size, c.kv_dim),
+    "wv": lambda c: (c.hidden_size, c.kv_dim),
+    "wo": lambda c: (c.q_dim, c.hidden_size),
+    "w_gate": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_up": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_down": lambda c: (c.intermediate_size, c.hidden_size),
+}
+
+# base-weight out-dim sharding (mirror of parallel/sharding.py param_pspecs):
+# b's out axis shards where the base weight's out axis shards
+_OUT_SHARDED = {"wq", "wk", "wv", "w_gate", "w_up"}  # wo/w_down shard IN
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo")
+    dtype: str = "float32"  # adapters train in f32 regardless of base dtype
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _check_targets(cfg: LlamaConfig, lcfg: LoRAConfig) -> None:
+    unknown = set(lcfg.targets) - set(_TARGET_DIMS)
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {sorted(unknown)}; have {sorted(_TARGET_DIMS)}")
+    if cfg.num_experts > 0 and set(lcfg.targets) & {"w_gate", "w_up", "w_down"}:
+        raise ValueError(
+            "MoE expert stacks are not LoRA targets (per-expert adapters are "
+            "not implemented) — target the attention projections instead"
+        )
+    if lcfg.rank < 1:
+        raise ValueError(f"rank={lcfg.rank} must be >= 1")
+
+
+def init_lora_params(cfg: LlamaConfig, lcfg: LoRAConfig, key: jax.Array) -> Any:
+    """Adapter tree: {"layers": {"<t>_a": [L, in, r], "<t>_b": [L, r, out]}}.
+    ``b`` is zero-init (merged model == base model at step 0 — the standard
+    LoRA identity-start)."""
+    _check_targets(cfg, lcfg)
+    dt = jnp.dtype(lcfg.dtype)
+    L, r = cfg.num_layers, lcfg.rank
+    keys = jax.random.split(key, len(lcfg.targets))
+    layers: dict[str, jax.Array] = {}
+    for k, t in zip(keys, lcfg.targets):
+        d_in, d_out = _TARGET_DIMS[t](cfg)
+        layers[f"{t}_a"] = (
+            jax.random.normal(k, (L, d_in, r), jnp.float32) * (1.0 / r)
+        ).astype(dt)
+        layers[f"{t}_b"] = jnp.zeros((L, r, d_out), dt)
+    return {"layers": layers}
+
+
+def lora_pspecs(cfg: LlamaConfig, lcfg: LoRAConfig) -> Any:
+    """PartitionSpecs matching init_lora_params: ``a`` replicated (rank is
+    tiny), ``b``'s out axis sharded exactly like the base weight's sharded
+    axis — the merge einsum then composes with TP without resharding."""
+    _check_targets(cfg, lcfg)
+    layers: dict[str, P] = {}
+    for t in lcfg.targets:
+        layers[f"{t}_a"] = P(None, None, None)
+        layers[f"{t}_b"] = (
+            P(None, None, AXIS_MODEL) if t in _OUT_SHARDED else P(None, None, None)
+        )
+    return {"layers": layers}
+
+
+def merge_lora(params: Any, lora: Any, lcfg: LoRAConfig) -> Any:
+    """base + adapters → merged params (same tree shape as the base).
+    Used per-step inside the LoRA loss AND once at serve time — one merge
+    definition, so training and serving cannot drift."""
+    merged_layers = dict(params["layers"])
+    for name, a in lora["layers"].items():
+        if not name.endswith("_a"):
+            continue
+        t = name[:-2]
+        b = lora["layers"][t + "_b"]
+        base = merged_layers[t]
+        delta = jnp.einsum("lir,lro->lio", a.astype(jnp.float32), b.astype(jnp.float32))
+        merged_layers[t] = (base.astype(jnp.float32) + delta * lcfg.scale).astype(base.dtype)
+    return {**params, "layers": merged_layers}
+
+
+def make_lora_train_step(
+    cfg: LlamaConfig,
+    lcfg: LoRAConfig,
+    optimizer: optax.GradientTransformation,
+    attn_impl: str = "ref",
+    mesh=None,
+):
+    """LoRA step: gradients (and optimizer moments) exist ONLY for the
+    adapter tree; the base params are a frozen input. State is a TrainState
+    over the ADAPTERS."""
+    _check_targets(cfg, lcfg)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def lora_step(state: TrainState, base_params: Any, batch: dict[str, jax.Array]):
+        def loss_fn(lora):
+            merged = merge_lora(base_params, lora, lcfg)
+            return causal_lm_loss(merged, cfg, batch, attn_impl, mesh)
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        lora = optax.apply_updates(state.params, updates)
+        return TrainState(lora, opt_state, state.step + 1), metrics
+
+    return lora_step
+
+
+def init_lora_state(
+    cfg: LlamaConfig,
+    lcfg: LoRAConfig,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+) -> TrainState:
+    from agentfield_tpu.training.trainer import init_state_sharded
+
+    return init_state_sharded(
+        lambda k: init_lora_params(cfg, lcfg, k), key, optimizer, mesh,
+        lora_pspecs(cfg, lcfg) if mesh is not None else None,
+    )

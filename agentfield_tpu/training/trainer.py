@@ -58,6 +58,26 @@ def causal_lm_loss(
     return loss, {"loss": loss, "tokens": mask.sum()}
 
 
+def init_state_sharded(
+    init_fn,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    pspecs=None,
+) -> TrainState:
+    """Shared init idiom: initialize a param tree directly sharded on the
+    mesh (jit with out_shardings, so a 70B init never materializes
+    unsharded) and derive opt-state with matching placement. Used by the
+    full-params trainer AND the LoRA adapter state."""
+    if mesh is None:
+        params = init_fn(key)
+    else:
+        shardings = named_sharding(mesh, pspecs)
+        params = jax.jit(init_fn, out_shardings=shardings)(key)
+    opt_state = optimizer.init(params)  # moments inherit param shardings
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
 def init_train_state(
     cfg: LlamaConfig,
     key: jax.Array,
@@ -65,18 +85,10 @@ def init_train_state(
     mesh: Mesh | None = None,
     dtype: str | None = None,
 ) -> TrainState:
-    """Initialize params directly sharded on the mesh (jit with out_shardings,
-    so a 70B init never materializes unsharded) and derive opt-state with
-    matching placement."""
-    if mesh is None:
-        params = init_params(cfg, key, dtype)
-    else:
-        shardings = named_sharding(mesh, param_pspecs(cfg))
-        params = jax.jit(
-            lambda k: init_params(cfg, k, dtype), out_shardings=shardings
-        )(key)
-    opt_state = optimizer.init(params)  # moments inherit param shardings
-    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+    return init_state_sharded(
+        lambda k: init_params(cfg, k, dtype), key, optimizer, mesh,
+        param_pspecs(cfg) if mesh is not None else None,
+    )
 
 
 def make_train_step(

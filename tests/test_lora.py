@@ -4,8 +4,6 @@ The reference cannot adapt its models at all (they live behind provider
 APIs, agent_ai.py:342); here fine-tune → merge → serve is an in-cluster
 loop on the same engine."""
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np

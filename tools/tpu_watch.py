@@ -150,7 +150,12 @@ def run_validation(out_dir: str) -> None:
     # only real-TPU datapoint — it must survive /tmp and reach the judge.
     # Never let a later FAILED run clobber a captured good result.
     repo_path = os.path.join(REPO, "TPU_WATCH_RESULT.json")
-    if "error" not in payload or not os.path.exists(repo_path):
+    # degraded/fallback payloads (CPU fallback, watchdog partials) carry no
+    # top-level "error" — they must not clobber a real chip number either
+    is_chip_result = not any(
+        k in payload for k in ("error", "headline_degraded", "device_fallback")
+    )
+    if is_chip_result or not os.path.exists(repo_path):
         try:
             with open(repo_path, "w") as f:
                 json.dump(

@@ -136,6 +136,8 @@ def cmd_model(cfg: Config, args) -> int:
         argv += ["model", "--model", args.model or cfg.model_node.model]
         if args.checkpoint:
             argv += ["--checkpoint", args.checkpoint]
+        if getattr(args, "lora", None):
+            argv += ["--lora", args.lora]
         if args.name:
             argv += ["--name", args.name]
         if args.url:
@@ -171,6 +173,7 @@ def cmd_model(cfg: Config, args) -> int:
             model=args.model or mn.model,
             ecfg=ecfg,
             checkpoint=args.checkpoint or mn.checkpoint,
+            lora=getattr(args, "lora", None),
             tp=mn.tp,
             vision=mn.vision,
             grammar_whitespace=mn.grammar_whitespace,
@@ -547,6 +550,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("model", help="run a TPU model node")
     s.add_argument("--model", help="model preset (see models/configs.py)")
     s.add_argument("--checkpoint", help="HF checkpoint dir (safetensors)")
+    s.add_argument("--lora", help="LoRA adapter dir (save_adapter) merged at load")
     s.add_argument("--name", help="node id (default: model)")
     s.add_argument("--url", help="control plane URL")
     s.add_argument("--cpu", action="store_true", help="serve on the CPU backend (demo/debug)")

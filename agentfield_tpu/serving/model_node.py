@@ -1029,6 +1029,8 @@ def build_model_node(
     spec_draft: str | None = None,  # draft model preset for speculative
     # decoding (requires ecfg.spec_k > 0 or spec_k below)
     spec_k: int | None = None,  # proposals per step; sets ecfg.spec_k
+    lora: str | None = None,  # LoRA adapter dir (training.lora.save_adapter):
+    # merged into the base weights at load — fine-tune → merge → serve
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -1049,6 +1051,22 @@ def build_model_node(
         cfg = get_config(model)
     if params is None:
         params = init_params(cfg, jax.random.PRNGKey(seed))
+    if lora is not None:
+        from agentfield_tpu.training.lora import load_adapter, merge_lora
+
+        lcfg, adapter = load_adapter(lora)
+        for t in lcfg.targets:  # EVERY target, both dims: a clear error
+            # here beats an opaque XLA shape mismatch inside merge_lora
+            base_shape = params["layers"][t].shape
+            a_shape = adapter["layers"][f"{t}_a"].shape
+            b_shape = adapter["layers"][f"{t}_b"].shape
+            if (base_shape[0], base_shape[1]) != (a_shape[0], a_shape[1])                     or base_shape[2] != b_shape[2]:
+                raise ValueError(
+                    f"LoRA adapter {lora!r} was trained for a different "
+                    f"model shape: target {t} is {base_shape}, adapter "
+                    f"a={a_shape} b={b_shape}"
+                )
+        params = merge_lora(params, adapter, lcfg)  # BEFORE quantization
     if quant is not None:
         if quant != "int8":
             raise ValueError(f"unknown quant mode {quant!r} (have: 'int8')")

@@ -11,4 +11,6 @@ from agentfield_tpu.training.lora import (  # noqa: F401
     lora_pspecs,
     make_lora_train_step,
     merge_lora,
+    load_adapter,
+    save_adapter,
 )

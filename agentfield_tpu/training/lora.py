@@ -166,3 +166,59 @@ def init_lora_state(
         lambda k: init_lora_params(cfg, lcfg, k), key, optimizer, mesh,
         lora_pspecs(cfg, lcfg) if mesh is not None else None,
     )
+
+
+def save_adapter(path, lora: Any, lcfg: LoRAConfig) -> None:
+    """Persist an adapter as a standalone artifact: the orbax tree plus a
+    lora_config.json carrying the LoRAConfig AND every leaf's shape/dtype,
+    so load_adapter needs no model config to rebuild the abstract tree.
+    Adapter artifacts are tiny (rank·(in+out) per target) — cheap to ship
+    and instant to swap."""
+    import json
+    from pathlib import Path as _Path
+
+    import orbax.checkpoint as ocp
+
+    path = _Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        # force: re-saving to one adapter dir is the natural periodic-persist
+        # flow; orbax otherwise refuses to overwrite the fixed subpath
+        ckptr.save(path / "adapter", lora, force=True)
+    meta = {
+        "rank": lcfg.rank,
+        "alpha": lcfg.alpha,
+        "targets": list(lcfg.targets),
+        "dtype": lcfg.dtype,
+        "shapes": {
+            k: list(v.shape) for k, v in lora["layers"].items()
+        },
+        "dtypes": {k: str(v.dtype) for k, v in lora["layers"].items()},
+    }
+    (path / "lora_config.json").write_text(json.dumps(meta, indent=1))
+
+
+def load_adapter(path) -> tuple[LoRAConfig, Any]:
+    """Inverse of save_adapter: (LoRAConfig, adapter tree)."""
+    import json
+    from pathlib import Path as _Path
+
+    import orbax.checkpoint as ocp
+
+    path = _Path(path).absolute()
+    meta = json.loads((path / "lora_config.json").read_text())
+    lcfg = LoRAConfig(
+        rank=int(meta["rank"]),
+        alpha=float(meta["alpha"]),
+        targets=tuple(meta["targets"]),
+        dtype=meta["dtype"],
+    )
+    abstract = {
+        "layers": {
+            k: jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(meta["dtypes"][k]))
+            for k, shape in meta["shapes"].items()
+        }
+    }
+    with ocp.StandardCheckpointer() as ckptr:
+        lora = ckptr.restore(path / "adapter", abstract)
+    return lcfg, lora

@@ -153,3 +153,50 @@ def test_lora_checkpoint_round_trip(tmp_path, params):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         state.params, back.params,
     )
+
+
+def test_adapter_artifact_and_node_serving(tmp_path, params):
+    """save_adapter → build_model_node(lora=dir): the node merges the
+    adapter at load and serves the tuned behavior; a mismatched-shape
+    adapter is rejected with a clear error."""
+    import asyncio
+
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+    from agentfield_tpu.training import load_adapter, save_adapter
+
+    opt = optax.adam(1e-2)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(9), opt)
+    step = make_lora_train_step(CFG, LCFG, opt)
+    batch = _batch(9)
+    batch["targets"] = jnp.full_like(batch["targets"], 42).at[:, -1].set(-1)
+    for _ in range(40):
+        state, _ = step(state, params, batch)
+    save_adapter(tmp_path / "ad", state.params, LCFG)
+    lcfg2, back = load_adapter(tmp_path / "ad")
+    assert lcfg2 == LCFG
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params, back,
+    )
+
+    async def main():
+        agent, backend = build_model_node(
+            "tuned", model="llama-tiny", params=params,
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+            lora=str(tmp_path / "ad"),
+        )
+        await backend.start()
+        try:
+            r = await backend.generate(prompt="anything", max_new_tokens=6)
+            assert r["tokens"].count(42) >= 4, r["tokens"]  # tuned behavior
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())
+
+    with pytest.raises(ValueError, match="different model"):
+        build_model_node(
+            "bad", model="llama-nano", lora=str(tmp_path / "ad"),
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=4),
+        )

@@ -173,7 +173,7 @@ def cmd_model(cfg: Config, args) -> int:
             model=args.model or mn.model,
             ecfg=ecfg,
             checkpoint=args.checkpoint or mn.checkpoint,
-            lora=getattr(args, "lora", None),
+            lora=getattr(args, "lora", None) or mn.lora,
             tp=mn.tp,
             vision=mn.vision,
             grammar_whitespace=mn.grammar_whitespace,

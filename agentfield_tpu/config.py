@@ -49,6 +49,8 @@ class PresenceConfig:
 class ModelNodeConfig:
     model: str = "llama-3.2-1b"
     checkpoint: str | None = None  # HF checkpoint dir (safetensors)
+    lora: str | None = None  # LoRA adapter dir (training.lora.save_adapter),
+    # merged into the base weights at load
     tokenizer: str | None = None
     max_batch: int = 32
     page_size: int = 16

@@ -10,9 +10,27 @@ Set AGENTFIELD_TPU_TEST_REAL=1 to run the suite against the real chip.
 import os
 
 if os.environ.get("AGENTFIELD_TPU_TEST_REAL", "").lower() not in ("1", "true", "yes"):
+    # A full suite run issues several thousand XLA-CPU compiles in one
+    # process; the CPU backend's parallel codegen occasionally segfaults
+    # deep in backend_compile under that load (observed ~1-in-2 full runs,
+    # always inside LLVM, a different test each time). Serializing codegen
+    # removes the implicated thread pool — pure overhead on a 1-core box
+    # anyway — and the persistent compilation cache makes reruns mostly
+    # skip the compiler entirely.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_parallel_codegen_split_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_parallel_codegen_split_count=1"
+        ).strip()
+
     from agentfield_tpu._compat import force_cpu_backend
 
     force_cpu_backend(virtual_devices=8)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/agentfield_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

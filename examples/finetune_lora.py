@@ -1,0 +1,60 @@
+"""fine-tune → merge → serve, end to end (the loop the reference cannot do:
+its models live behind provider APIs, agent_ai.py:342).
+
+Trains a LoRA adapter on next-token data, saves it as a standalone
+artifact, and serves it two ways: programmatically (build_model_node) or
+via the CLI —
+
+    python examples/finetune_lora.py /tmp/my_adapter
+    aftpu model --detach --cpu --model llama-tiny --lora /tmp/my_adapter
+
+Swap `llama-tiny` + random init for a real checkpoint
+(`load_hf_checkpoint`) and your own token batches for actual use; on a
+mesh pass mesh= through init_lora_state/make_lora_train_step and the
+shardings compose with TP automatically (training/lora.py).
+"""
+
+import sys
+
+from agentfield_tpu._compat import force_cpu_backend
+
+force_cpu_backend()  # demo runs anywhere; drop for real TPU training
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from agentfield_tpu.models import get_config, init_params  # noqa: E402
+from agentfield_tpu.training import (  # noqa: E402
+    LoRAConfig,
+    init_lora_state,
+    make_lora_train_step,
+    save_adapter,
+)
+from agentfield_tpu.training.trainer import make_lm_batch  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    cfg = get_config("llama-tiny")
+    base = init_params(cfg, jax.random.PRNGKey(0))  # or load_hf_checkpoint(...)
+    lcfg = LoRAConfig(rank=8, alpha=16.0, targets=("wq", "wk", "wv", "wo"))
+    optimizer = optax.adam(1e-2)
+    state = init_lora_state(cfg, lcfg, jax.random.PRNGKey(1), optimizer)
+    step = make_lora_train_step(cfg, lcfg, optimizer)
+
+    # toy objective: your real data goes here (make_lm_batch over token ids)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, cfg.vocab_size, jnp.int32)
+    batch = make_lm_batch(tokens)
+
+    for i in range(30):
+        state, metrics = step(state, base, batch)
+        if i % 10 == 0:
+            print(f"step {i}: loss {float(metrics['loss']):.4f}")
+
+    save_adapter(out_dir, state.params, lcfg)
+    print(f"adapter saved to {out_dir} — serve it with:")
+    print(f"  aftpu model --detach --cpu --model llama-tiny --lora {out_dir}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/lora_adapter")

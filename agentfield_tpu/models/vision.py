@@ -41,7 +41,8 @@ class VisionConfig:
     pre_ln: bool = False  # CLIP applies a layernorm to the embeddings
     # before the encoder (pre_layrnorm)
     final_ln: bool = True  # CLIP's last_hidden_state has NO final LN (its
-    # post_layernorm only feeds the pooled CLS) — load_clip_vision sets False
+    # post_layernorm only feeds the pooled CLS → loader sets False); SigLIP
+    # applies post_layernorm to the whole last_hidden_state (loader sets True)
     act: str = "gelu_tanh"  # encoder MLP activation: "gelu_tanh" (HF
     # gelu_pytorch_tanh — SigLIP), "quick_gelu" (x·σ(1.702x) — OpenAI CLIP),
     # "gelu_exact" (erf)
@@ -149,8 +150,9 @@ def _act_fn(name: str):
 
 def vision_hidden(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.Array:
     """[B, H, W, 3] float in [0, 1] → [B, num_patches, hidden] encoder
-    states at the PATCH positions (pre-projector; for a CLIP checkpoint
-    these match the HF vision model's last_hidden_state[:, 1:])."""
+    states at the PATCH positions (pre-projector). For a CLIP checkpoint
+    these match HF's last_hidden_state[:, 1:] (CLS dropped); for SigLIP —
+    which has no CLS — they match the full last_hidden_state."""
     dt = jnp.dtype(cfg.dtype)
     act = _act_fn(cfg.act)
     if cfg.pixel_mean is not None:
@@ -158,6 +160,8 @@ def vision_hidden(params: Params, cfg: VisionConfig, images: jax.Array) -> jax.A
         std = jnp.asarray(cfg.pixel_std or (1.0, 1.0, 1.0), jnp.float32)
         images = (images.astype(jnp.float32) - mean) / std
     x = patchify(images.astype(dt), cfg) @ params["patch_embed"]
+    if "patch_bias" in params:  # SigLIP's conv stem carries a bias
+        x = x + params["patch_bias"]
     B = x.shape[0]
     if cfg.class_token:
         cls = jnp.broadcast_to(params["class_embed"], (B, 1, x.shape[-1])).astype(x.dtype)
@@ -221,12 +225,15 @@ vision_encode_jit = jax.jit(vision_encode, static_argnames=("cfg",))
 def load_clip_vision(
     path: str, out_dim: int = 2048, dtype: str = "float32", key=None
 ) -> tuple[VisionConfig, Params]:
-    """HF CLIP/CLIPVision checkpoint directory → (VisionConfig, params) for
-    this tower: the vision ENCODER loads exactly (conv patch embedding
-    refolded into the patchify matmul, CLS token, pre-LN, biased attention/
-    MLP with quick_gelu — verified against transformers' CLIPVisionModel
-    last_hidden_state by tests); the LLM-space projector stays random-init
-    (the fusion adapter is what a LLaVA-style finetune trains).
+    """HF CLIP or SigLIP vision checkpoint directory → (VisionConfig,
+    params) for this tower. The two flavors are auto-detected from the
+    tensors: CLIP carries a CLS token + pre-LN + quick_gelu and its
+    last_hidden_state has NO final LN; SigLIP has a biased conv stem, no
+    CLS, tanh-gelu, and post_layernorm ON last_hidden_state. Either way the
+    conv patch embedding refolds into the patchify matmul and the encoder
+    loads exactly (verified against transformers by tests); the LLM-space
+    projector stays random-init (the fusion adapter is what a LLaVA-style
+    finetune trains).
 
     Reference capability: image parts ride external providers
     (sdk/python/agentfield/agent_ai.py:449-520); here the encoder runs
@@ -241,7 +248,35 @@ def load_clip_vision(
     doc = json.loads((p / "config.json").read_text())
     vc = doc.get("vision_config", doc)  # CLIPConfig nests; CLIPVisionConfig flat
     d = int(vc["hidden_size"])
-    act_name = vc.get("hidden_act", "quick_gelu")
+    tensors: dict[str, "np.ndarray"] = {}
+    found_any = False
+    for f in sorted(p.glob("*.safetensors")):
+        found_any = True
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                if "vision_model." in name:
+                    tensors[name.split("vision_model.", 1)[1]] = sf.get_tensor(name)
+    if not found_any:
+        raise FileNotFoundError(f"no *.safetensors under {p}")
+    if not tensors:
+        raise KeyError(f"no vision_model tensors in {p} (not a CLIP/SigLIP checkpoint?)")
+    # flavor detection: positive model_type signal first, tensor-shape
+    # fallback for configs that omit it — anything else fails loudly
+    mt = vc.get("model_type") or doc.get("model_type") or ""
+    if "siglip" in mt:
+        siglip = True
+    elif "clip" in mt:
+        siglip = False
+    elif "pre_layrnorm.weight" in tensors:
+        siglip = False
+    elif "embeddings.patch_embedding.bias" in tensors:
+        siglip = True
+    else:
+        raise ValueError(
+            f"unrecognized vision checkpoint flavor (model_type={mt!r}; "
+            "expected CLIP or SigLIP)"
+        )
+    act_name = vc.get("hidden_act", "gelu_pytorch_tanh" if siglip else "quick_gelu")
     act = {
         "quick_gelu": "quick_gelu",
         "gelu": "gelu_exact",
@@ -249,9 +284,9 @@ def load_clip_vision(
     }.get(act_name)
     if act is None:
         raise ValueError(f"unsupported vision hidden_act={act_name!r}")
-    # CLIPImageProcessor defaults (preprocessor_config.json when present)
-    mean = (0.48145466, 0.4578275, 0.40821073)
-    std = (0.26862954, 0.26130258, 0.27577711)
+    # processor defaults (preprocessor_config.json when present)
+    mean = (0.5, 0.5, 0.5) if siglip else (0.48145466, 0.4578275, 0.40821073)
+    std = (0.5, 0.5, 0.5) if siglip else (0.26862954, 0.26130258, 0.27577711)
     prep = p / "preprocessor_config.json"
     if prep.exists():
         pdoc = json.loads(prep.read_text())
@@ -265,27 +300,15 @@ def load_clip_vision(
         num_heads=int(vc["num_attention_heads"]),
         mlp_ratio=int(vc["intermediate_size"]) // d,
         out_dim=out_dim,
-        layer_norm_eps=float(vc.get("layer_norm_eps", 1e-5)),
+        layer_norm_eps=float(vc.get("layer_norm_eps", 1e-6 if siglip else 1e-5)),
         dtype=dtype,
-        class_token=True,
-        pre_ln=True,
-        final_ln=False,  # last_hidden_state carries no final LN
+        class_token=not siglip,
+        pre_ln=not siglip,
+        final_ln=siglip,  # SigLIP post_layernorm IS on last_hidden_state
         act=act,
         pixel_mean=mean,
         pixel_std=std,
     )
-    tensors: dict[str, "np.ndarray"] = {}
-    found_any = False
-    for f in sorted(p.glob("*.safetensors")):
-        found_any = True
-        with safe_open(str(f), framework="numpy") as sf:
-            for name in sf.keys():
-                if "vision_model." in name:
-                    tensors[name.split("vision_model.", 1)[1]] = sf.get_tensor(name)
-    if not found_any:
-        raise FileNotFoundError(f"no *.safetensors under {p}")
-    if not tensors:
-        raise KeyError(f"no vision_model tensors in {p} (not a CLIP checkpoint?)")
 
     def get(name: str):
         if name not in tensors:
@@ -334,14 +357,19 @@ def load_clip_vision(
 
     params: Params = {
         "patch_embed": patch_w,
-        "class_embed": jnp.asarray(get("embeddings.class_embedding"), dt),
         "pos_embed": jnp.asarray(get("embeddings.position_embedding.weight"), dt),
-        "pre_ln_w": jnp.asarray(get("pre_layrnorm.weight"), dt),
-        "pre_ln_b": jnp.asarray(get("pre_layrnorm.bias"), dt),
         "layers": layers,
-        "final_ln_w": jnp.ones((d,), dt),  # unused (final_ln=False)
-        "final_ln_b": jnp.zeros((d,), dt),
         "proj_w1": rand(k1, (d, out_dim)),
         "proj_w2": rand(k2, (out_dim, out_dim)),
     }
+    if siglip:
+        params["patch_bias"] = jnp.asarray(get("embeddings.patch_embedding.bias"), dt)
+        params["final_ln_w"] = jnp.asarray(get("post_layernorm.weight"), dt)
+        params["final_ln_b"] = jnp.asarray(get("post_layernorm.bias"), dt)
+    else:
+        params["class_embed"] = jnp.asarray(get("embeddings.class_embedding"), dt)
+        params["pre_ln_w"] = jnp.asarray(get("pre_layrnorm.weight"), dt)
+        params["pre_ln_b"] = jnp.asarray(get("pre_layrnorm.bias"), dt)
+        params["final_ln_w"] = jnp.ones((d,), dt)  # unused (final_ln=False)
+        params["final_ln_b"] = jnp.zeros((d,), dt)
     return cfg, params

@@ -302,3 +302,36 @@ def test_model_node_serves_clip_checkpoint(params, tmp_path):
             await backend.stop()
 
     asyncio.run(main())
+
+
+def test_siglip_vision_matches_transformers(tmp_path):
+    """SigLIP flavor (biased conv stem, no CLS/pre-LN, post-LN ON
+    last_hidden_state, tanh-gelu) auto-detected and loaded exactly."""
+    import pytest as _pytest
+
+    torch = _pytest.importorskip("torch")
+    transformers = _pytest.importorskip("transformers")
+    import dataclasses as _dc
+
+    from agentfield_tpu.models.vision import load_clip_vision, vision_hidden
+
+    vcfg = transformers.SiglipVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=8,
+    )
+    torch.manual_seed(1)
+    model = transformers.SiglipVisionModel(vcfg).eval().to(torch.float32)
+    d = tmp_path / "siglip-ckpt"
+    model.save_pretrained(d, safe_serialization=True)
+    cfg, vparams = load_clip_vision(str(d), out_dim=128)
+    assert not cfg.class_token and not cfg.pre_ln and cfg.final_ln
+    assert cfg.act == "gelu_tanh" and cfg.pixel_mean == (0.5, 0.5, 0.5)
+    rng = np.random.default_rng(2)
+    pixels = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        want = model(torch.tensor(pixels)).last_hidden_state.numpy()
+    cfg_nonorm = _dc.replace(cfg, pixel_mean=None, pixel_std=None)
+    imgs = jnp.asarray(np.transpose(pixels, (0, 2, 3, 1)))
+    got = np.asarray(vision_hidden(vparams, cfg_nonorm, imgs))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

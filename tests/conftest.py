@@ -35,6 +35,37 @@ if os.environ.get("AGENTFIELD_TPU_TEST_REAL", "").lower() not in ("1", "true", "
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _release_engine_compile_caches():
+    """The engine's module-level lru_cache'd jit builders pin every compiled
+    executable for the life of the process; across a full suite (hundreds of
+    distinct EngineConfigs x builders x buckets) the accumulated JIT'd
+    executables eventually crash XLA-CPU's loader (observed segfaults in
+    backend_compile_and_load / cache reads at ~80% of single-process runs).
+    Dropping the caches between test MODULES releases the executables while
+    keeping within-module reuse. Library behavior is untouched — a real
+    serving process uses a handful of configs, not hundreds."""
+    yield
+    import gc
+
+    from agentfield_tpu.serving import engine as _eng
+
+    for name in (
+        "_decode_fn", "_spec_decode_fn", "_prefill_fn", "_batch_prefill_fn",
+        "_prefill_inject_fn", "_suffix_prefill_fn",
+    ):
+        fn = getattr(_eng, name, None)
+        if fn is not None and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+    gc.collect()
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
 @pytest.fixture(scope="session")
 def rng_key():
     import jax

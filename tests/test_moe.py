@@ -304,3 +304,33 @@ def test_mixtral_batched_sparse_prefill_padding_immune():
         params, cfg, EngineConfig(moe_prefill_impl="sparse", **base)
     ).run_to_completion(reqs())
     assert sparse == dense
+
+
+def test_mixtral_engine_sparse_prefill_under_tp_mesh():
+    """Sparse-dispatch prefill composes with a GSPMD TP serving mesh: the
+    scatter/gather partitions under pjit and the stream equals the dense
+    TP engine token-for-token."""
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.parallel import make_mesh
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = _dc.replace(get_config("mixtral-tiny"), moe_capacity_factor=4.0)
+    mesh = make_mesh({"model": 2}, _jax.devices()[:2])
+    base = dict(max_batch=2, page_size=16, num_pages=32, max_pages_per_seq=4)
+    reqs = lambda: [
+        Request(id="x", prompt=[9, 8, 7, 6], sampling=SamplingParams(max_new_tokens=5))
+    ]
+    dense = InferenceEngine(
+        init_params(cfg, jax.random.PRNGKey(0)), cfg, EngineConfig(**base), mesh=mesh
+    ).run_to_completion(reqs())
+    sparse = InferenceEngine(
+        init_params(cfg, jax.random.PRNGKey(0)), cfg,
+        EngineConfig(moe_prefill_impl="sparse", **base), mesh=mesh,
+    ).run_to_completion(reqs())
+    assert sparse == dense

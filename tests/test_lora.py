@@ -200,3 +200,38 @@ def test_adapter_artifact_and_node_serving(tmp_path, params):
             "bad", model="llama-nano", lora=str(tmp_path / "ad"),
             ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=4),
         )
+
+
+def test_lora_composes_with_int8_serving(tmp_path, params):
+    """lora= merges BEFORE quantization: an int8 node serves the tuned
+    behavior (quantizing first would freeze the base weights)."""
+    import asyncio
+
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+    from agentfield_tpu.training import save_adapter
+
+    opt = optax.adam(1e-2)
+    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(11), opt)
+    step = make_lora_train_step(CFG, LCFG, opt)
+    batch = _batch(11)
+    batch["targets"] = jnp.full_like(batch["targets"], 55).at[:, -1].set(-1)
+    for _ in range(40):
+        state, _ = step(state, params, batch)
+    save_adapter(tmp_path / "ad8", state.params, LCFG)
+
+    async def main():
+        agent, backend = build_model_node(
+            "tuned8", model="llama-tiny", params=params,
+            ecfg=EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+            lora=str(tmp_path / "ad8"), quant="int8",
+        )
+        await backend.start()
+        try:
+            r = await backend.generate(prompt="anything", max_new_tokens=6)
+            # int8 rounding can flip a token; the tuned mode must dominate
+            assert r["tokens"].count(55) >= 4, r["tokens"]
+        finally:
+            await backend.stop()
+
+    asyncio.run(main())

@@ -312,3 +312,40 @@ async def test_control_plane_boots_over_tls_dsn():
             assert any(n["node_id"] == "tls-agent" for n in nodes)
     finally:
         srv.stop()
+
+
+@async_test
+async def test_two_control_planes_share_one_database():
+    """The OPERATIONS multi-instance claim, exercised: two control planes on
+    ONE Postgres — an agent registered through plane A is visible and
+    EXECUTABLE through plane B (registry + gateway read the shared DB), and
+    scoped memory written via A reads back via B."""
+    srv = FakePgServer().start()
+    try:
+        dsn = _dsn(srv, password="hunter2")
+        async with CPHarness(db_path=dsn) as a, CPHarness(db_path=dsn) as b:
+            await a.register_agent("shared-agent")
+            # visible through the OTHER plane
+            async with b.http.get("/api/v1/nodes") as r:
+                nodes = (await r.json())["nodes"]
+            assert any(n["node_id"] == "shared-agent" for n in nodes)
+            # executable through the other plane (gateway B → agent of A)
+            async with b.http.post(
+                "/api/v1/execute/shared-agent.echo", json={"input": {"k": 1}}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            # the execution record lands in the shared store: plane A sees it
+            async with a.http.get(
+                f"/api/v1/executions/{doc['execution_id']}"
+            ) as r:
+                assert (await r.json())["status"] == "completed"
+            # scoped memory crosses planes (scope via query; POST to set)
+            async with a.http.post(
+                "/api/v1/memory/answer?scope=global", json={"value": 42}
+            ) as r:
+                assert r.status == 200, await r.text()
+            async with b.http.get("/api/v1/memory/answer?scope=global") as r:
+                assert (await r.json())["value"] == 42
+    finally:
+        srv.stop()

@@ -424,3 +424,57 @@ async def test_ai_chat_composes_with_schema_files_media():
             await app.stop()
             await model_agent.stop()
             await backend.stop()
+
+
+@async_test
+async def test_session_kv_reuse_across_agent_chain():
+    """North-star config 4: an agent→agent call chain under ONE session
+    shares the model node's KV prefix cache — B's ai() (same session,
+    extended token prefix) suffix-prefills instead of recomputing A's
+    context. Session identity rides the execution context end to end."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny",
+            ecfg=EngineConfig(max_batch=4, page_size=8, num_pages=256,
+                              max_pages_per_seq=16, enable_prefix_cache=True),
+        )
+        await backend.start()
+        await model_agent.start()
+        prefix = list(range(40, 60))  # tokens= sidesteps the lossy byte tokenizer
+
+        b = Agent("chain-b", h.base_url)
+
+        @b.reasoner()
+        async def extend(history: list[int]) -> dict:
+            # continue the conversation: cached sequence must be a PREFIX of
+            # the new prompt, so B extends A's actual prompt+completion
+            out = await b.ai(tokens=history + [7, 8], max_new_tokens=3)
+            return {"n": len(out["tokens"])}
+
+        a = Agent("chain-a", h.base_url)
+
+        @a.reasoner()
+        async def root() -> dict:
+            first = await a.ai(tokens=prefix, max_new_tokens=3)
+            downstream = await a.call(
+                "chain-b.extend", {"history": prefix + first["tokens"]}
+            )
+            return {"first": len(first["tokens"]), "down": downstream["n"]}
+
+        await a.start()
+        await b.start()
+        try:
+            async with h.http.post(
+                "/api/v1/execute/chain-a.root",
+                json={"input": {}},
+                headers={"X-Session-ID": "chain-sess"},  # the session contract
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert doc["result"] == {"first": 3, "down": 3}
+            assert backend.engine.stats["prefix_cache_hits"] >= 1, backend.engine.stats
+        finally:
+            await a.stop()
+            await b.stop()
+            await model_agent.stop()
+            await backend.stop()

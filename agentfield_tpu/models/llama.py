@@ -295,6 +295,8 @@ def forward_impl(
     # real (serving prefills mark bucket padding False so sparse-MoE
     # dispatch cannot let padding consume expert capacity; the dense paths
     # ignore it — padded outputs are discarded downstream either way)
+    return_hidden: bool = False,  # return final-norm hidden states [B,S,D]
+    # instead of logits (embeddings path — skips the unembed matmul)
 ):
     """Dense causal forward. tokens/positions: [B, S].
 
@@ -387,11 +389,15 @@ def forward_impl(
     if remat:
         body = jax.checkpoint(body)
     x, kv = jax.lax.scan(body, x, params["layers"])
+    if return_hidden:
+        h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        return h, kv
     return unembed(params, cfg, x), kv
 
 
 forward = jax.jit(
-    forward_impl, static_argnames=("cfg", "collect_kv", "remat", "attn_impl", "mesh")
+    forward_impl,
+    static_argnames=("cfg", "collect_kv", "remat", "attn_impl", "mesh", "return_hidden"),
 )
 
 

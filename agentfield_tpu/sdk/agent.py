@@ -736,6 +736,60 @@ class Agent:
         kw.setdefault("output", "speech")
         return await self.ai(prompt, **kw)
 
+    async def ai_embed(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        model: str | None = None,
+        pooling: str = "mean",
+        context_overflow: str = "error",
+        timeout: float = 600.0,
+    ) -> dict[str, Any]:
+        """Text → L2-normalized embedding from a model node's LM hidden
+        states. The reference cannot embed in-cluster (its memory vector API
+        expects provider-produced vectors); here
+        ``vector_set(key, (await ai_embed(text))["embedding"])`` →
+        ``vector_search`` closes the loop with no external API.
+
+        Failover applies only to TRANSPORT/node-down failures — a
+        deterministic request error (bad pooling, empty input) raises
+        immediately instead of replaying the doomed request cluster-wide.
+        Caveat: vectors from DIFFERENT models are different embedding
+        spaces; pin ``model`` (or Agent ai_defaults) when more than one
+        model node serves, and never mix models within one vector scope
+        (the result's "model" field is there to check)."""
+        model = self._resolve_ai_params({"model": model})["model"]
+        candidates = await self._model_candidates(model, need=None)
+        errors: list[str] = []
+        doc: dict[str, Any] = {}
+        for ci, cand in enumerate(candidates):
+            node_id = cand["node_id"]
+            try:
+                doc = await self.client.execute(
+                    f"{node_id}.embed",
+                    {"prompt": prompt, "tokens": tokens, "pooling": pooling,
+                     "context_overflow": context_overflow},
+                    headers=self._outbound_ctx().to_headers(),
+                    timeout=timeout,
+                )
+            except ControlPlaneError as e:
+                if ci + 1 < len(candidates):
+                    errors.append(f"{node_id}: {e}")
+                    continue
+                raise
+            if doc.get("status") == "completed":
+                return doc["result"]
+            err = str(doc.get("error") or "")
+            node_down = "agent call failed" in err or "vanished" in err                 or "agent returned 5" in err
+            if node_down and ci + 1 < len(candidates):
+                errors.append(f"{node_id}: {err}")
+                continue
+            break  # deterministic failure: do not replay cluster-wide
+        detail = f"; failed over from {errors}" if errors else ""
+        raise RuntimeError(
+            f"ai_embed {doc.get('status')}: {doc.get('error')}{detail}"
+        )
+
     async def ai_stream(
         self,
         prompt: str | None = None,

@@ -812,6 +812,88 @@ class ModelBackend:
         lines = [f"{m['role']}: {m['content']}" for m in messages]
         return "\n".join(lines) + "\nassistant:"
 
+    async def embed(
+        self,
+        prompt: str | None = None,
+        tokens: list[int] | None = None,
+        pooling: str = "mean",
+        context_overflow: str = "error",
+    ) -> dict[str, Any]:
+        """Text → L2-normalized embedding from the LM's final-norm hidden
+        states (mean or last-token pooled over the REAL tokens; inputs pad
+        to the engine's prefill buckets so compile shapes stay bounded).
+        The reference has no in-cluster embeddings at all — its memory
+        vector API expects caller-supplied vectors from provider embedding
+        APIs; here vector memory can be fed entirely in-tree
+        (vector_set(embed(text)) → vector_search). Over-long inputs honor
+        generate()'s context_overflow contract: "error" (default) rejects,
+        "truncate_left" keeps the most recent context and reports
+        truncated_tokens."""
+        import numpy as _np
+        import jax.numpy as _jnp
+
+        from agentfield_tpu.models import llama as _llama
+
+        if pooling not in ("mean", "last"):
+            raise ValueError(f"pooling={pooling!r} must be 'mean' or 'last'")
+        if context_overflow not in ("error", "truncate_left"):
+            raise ValueError(
+                f"context_overflow={context_overflow!r} must be 'error' or "
+                "'truncate_left'"
+            )
+        if tokens is None:
+            if prompt is None:
+                raise ValueError("one of 'prompt' or 'tokens' is required")
+            if self.tokenizer is None:
+                raise ValueError("no tokenizer loaded on this model node; pass 'tokens'")
+            tokens = self.tokenizer.encode(prompt)
+        if not tokens:
+            raise ValueError("cannot embed an empty sequence")
+        max_ctx = self.engine.ecfg.max_context
+        truncated = 0
+        if len(tokens) > max_ctx:
+            if context_overflow == "error":
+                raise ValueError(
+                    f"sequence of {len(tokens)} tokens exceeds "
+                    f"max_context={max_ctx}; pass context_overflow="
+                    "'truncate_left' to embed the most recent context"
+                )
+            truncated = len(tokens) - max_ctx
+            tokens = tokens[-max_ctx:]
+        n = len(tokens)
+        # bucketed shape: ONE compile per bucket, like the engine's prefills
+        bucket = self.engine.ecfg.prefill_bucket(n)
+        padded = [0] * bucket
+        padded[:n] = tokens
+
+        def _run():
+            toks = _jnp.asarray([padded], _jnp.int32)
+            pos = _jnp.arange(bucket, dtype=_jnp.int32)[None]
+            h, _ = _llama.forward(
+                self.engine.params, self.cfg, toks, pos,
+                collect_kv=False, return_hidden=True,
+            )  # [1, bucket, D]
+            real = (_jnp.arange(bucket) < n)[:, None]
+            if pooling == "mean":
+                v = _jnp.sum(
+                    _jnp.where(real, h[0].astype(_jnp.float32), 0.0), axis=0
+                ) / n
+            else:
+                v = h[0, n - 1].astype(_jnp.float32)
+            return v / _jnp.maximum(_jnp.linalg.norm(v), 1e-9)
+
+        vec = await asyncio.to_thread(lambda: _np.asarray(_run()))
+        out = {
+            "embedding": vec.tolist(),
+            "dim": int(vec.shape[0]),
+            "model": self.model_name,
+            "pooling": pooling,
+            "tokens_used": n,
+        }
+        if truncated:
+            out["truncated_tokens"] = truncated
+        return out
+
     async def generate(
         self,
         prompt: str | None = None,
@@ -1125,6 +1207,9 @@ def build_model_node(
     # hand-maintained forwarding wrapper to drift out of sync.
     agent.reasoner(id="generate", description=f"TPU-served {model} generation")(
         backend.generate
+    )
+    agent.reasoner(id="embed", description=f"TPU-served {model} embeddings")(
+        backend.embed
     )
     # Engine counters ride the 2s heartbeats → cluster-visible via
     # /api/v1/nodes metadata and the dashboard.

@@ -478,3 +478,57 @@ async def test_session_kv_reuse_across_agent_chain():
             await b.stop()
             await model_agent.stop()
             await backend.stop()
+
+
+@async_test
+async def test_ai_embed_feeds_vector_memory():
+    """In-cluster embeddings close the vector-memory loop the reference
+    leaves to provider APIs: ai_embed → memory vector_set → vector_search
+    finds the semantically-identical entry first (same text == identical
+    normalized vector)."""
+    async with CPHarness() as h:
+        model_agent, backend = build_model_node(
+            "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
+        )
+        await backend.start()
+        await model_agent.start()
+        app = Agent("embed-agent", h.base_url)
+        await app.start()
+        try:
+            e1 = await app.ai_embed("the quick brown fox")
+            assert e1["dim"] > 0 and e1["pooling"] == "mean"
+            import math
+
+            norm = math.sqrt(sum(v * v for v in e1["embedding"]))
+            assert abs(norm - 1.0) < 1e-3  # L2-normalized
+            e2 = await app.ai_embed("completely different words!")
+            assert e1["embedding"] != e2["embedding"]
+            # deterministic: same text → same vector
+            again = await app.ai_embed("the quick brown fox")
+            assert again["embedding"] == e1["embedding"]
+            # feed vector memory and search with the query embedding
+            async with h.http.post(
+                "/api/v1/memory/vectors/set?scope=global",
+                json={"key": "fox", "embedding": e1["embedding"],
+                      "metadata": {"t": "fox"}},
+            ) as r:
+                assert r.status == 200, await r.text()
+            async with h.http.post(
+                "/api/v1/memory/vectors/set?scope=global",
+                json={"key": "other", "embedding": e2["embedding"],
+                      "metadata": {"t": "other"}},
+            ) as r:
+                assert r.status == 200
+            async with h.http.post(
+                "/api/v1/memory/vectors/search?scope=global",
+                json={"embedding": e1["embedding"], "top_k": 2},
+            ) as r:
+                hits = (await r.json())["results"]
+            assert hits[0]["key"] == "fox", hits
+            # tokens= path + pooling knob + validation
+            t = await app.ai_embed(tokens=[5, 6, 7], pooling="last")
+            assert t["tokens_used"] == 3
+        finally:
+            await app.stop()
+            await model_agent.stop()
+            await backend.stop()

@@ -174,3 +174,36 @@ def test_mixtral_quantized_serving():
         sp = shard_params(qp, mcfg, mesh)
         logits, _ = llama.forward(sp, mcfg, toks, pos, collect_kv=False)
         assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_embed_on_int8_node():
+    """The embed reasoner rides the same QuantW-aware forward: an int8
+    node produces normalized embeddings."""
+    import asyncio
+
+    import math
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.models.quant import quantize_params
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import ByteTokenizer, ModelBackend
+
+    cfg = get_config("llama-tiny")
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+
+    async def main():
+        b = ModelBackend(
+            params, cfg,
+            EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8),
+            tokenizer=ByteTokenizer(cfg.vocab_size),
+        )
+        await b.start()
+        try:
+            e = await b.embed(prompt="int8 embedding check")
+            assert e["dim"] == cfg.hidden_size
+            norm = math.sqrt(sum(v * v for v in e["embedding"]))
+            assert abs(norm - 1.0) < 1e-3
+        finally:
+            await b.stop()
+
+    asyncio.run(main())

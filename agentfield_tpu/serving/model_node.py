@@ -818,6 +818,9 @@ class ModelBackend:
         tokens: list[int] | None = None,
         pooling: str = "mean",
         context_overflow: str = "error",
+        prompts: list[str] | None = None,  # BATCH form: one [B, bucket]
+        # forward for the whole list (RAG indexing throughput) — returns
+        # {"embeddings": [...], ...} instead of "embedding"
     ) -> dict[str, Any]:
         """Text → L2-normalized embedding from the LM's final-norm hidden
         states (mean or last-token pooled over the REAL tokens; inputs pad
@@ -841,57 +844,83 @@ class ModelBackend:
                 f"context_overflow={context_overflow!r} must be 'error' or "
                 "'truncate_left'"
             )
-        if tokens is None:
-            if prompt is None:
-                raise ValueError("one of 'prompt' or 'tokens' is required")
+        batch_mode = prompts is not None
+        if batch_mode:
+            if prompt is not None or tokens is not None:
+                raise ValueError("prompts is exclusive with prompt/tokens")
+            if not prompts:
+                raise ValueError("prompts must be non-empty")
             if self.tokenizer is None:
-                raise ValueError("no tokenizer loaded on this model node; pass 'tokens'")
-            tokens = self.tokenizer.encode(prompt)
-        if not tokens:
-            raise ValueError("cannot embed an empty sequence")
+                raise ValueError("no tokenizer loaded on this model node")
+            token_rows = [self.tokenizer.encode(p) for p in prompts]
+        else:
+            if tokens is None:
+                if prompt is None:
+                    raise ValueError("one of 'prompt', 'tokens', 'prompts' is required")
+                if self.tokenizer is None:
+                    raise ValueError("no tokenizer loaded on this model node; pass 'tokens'")
+                tokens = self.tokenizer.encode(prompt)
+            token_rows = [tokens]
         max_ctx = self.engine.ecfg.max_context
-        truncated = 0
-        if len(tokens) > max_ctx:
-            if context_overflow == "error":
-                raise ValueError(
-                    f"sequence of {len(tokens)} tokens exceeds "
-                    f"max_context={max_ctx}; pass context_overflow="
-                    "'truncate_left' to embed the most recent context"
-                )
-            truncated = len(tokens) - max_ctx
-            tokens = tokens[-max_ctx:]
-        n = len(tokens)
-        # bucketed shape: ONE compile per bucket, like the engine's prefills
-        bucket = self.engine.ecfg.prefill_bucket(n)
-        padded = [0] * bucket
-        padded[:n] = tokens
+        truncated_rows: list[int] = []
+        lens: list[int] = []
+        for i, row in enumerate(token_rows):
+            if not row:
+                raise ValueError(f"cannot embed an empty sequence (row {i})")
+            if len(row) > max_ctx:
+                if context_overflow == "error":
+                    raise ValueError(
+                        f"sequence of {len(row)} tokens (row {i}) exceeds "
+                        f"max_context={max_ctx}; pass context_overflow="
+                        "'truncate_left' to embed the most recent context"
+                    )
+                truncated_rows.append(len(row) - max_ctx)
+                token_rows[i] = row = row[-max_ctx:]
+            else:
+                truncated_rows.append(0)
+            lens.append(len(row))
+        # bucketed shape: ONE compile per (B, bucket), like engine prefills
+        bucket = self.engine.ecfg.prefill_bucket(max(lens))
+        B = len(token_rows)
+        padded = [[0] * bucket for _ in range(B)]
+        for i, row in enumerate(token_rows):
+            padded[i][: lens[i]] = row
 
         def _run():
-            toks = _jnp.asarray([padded], _jnp.int32)
-            pos = _jnp.arange(bucket, dtype=_jnp.int32)[None]
+            toks = _jnp.asarray(padded, _jnp.int32)
+            pos = _jnp.arange(bucket, dtype=_jnp.int32)[None].repeat(B, 0)
+            nv = _jnp.asarray(lens, _jnp.int32)
             h, _ = _llama.forward(
                 self.engine.params, self.cfg, toks, pos,
                 collect_kv=False, return_hidden=True,
-            )  # [1, bucket, D]
-            real = (_jnp.arange(bucket) < n)[:, None]
+            )  # [B, bucket, D]
+            real = (_jnp.arange(bucket)[None, :] < nv[:, None])[..., None]
             if pooling == "mean":
                 v = _jnp.sum(
-                    _jnp.where(real, h[0].astype(_jnp.float32), 0.0), axis=0
-                ) / n
+                    _jnp.where(real, h.astype(_jnp.float32), 0.0), axis=1
+                ) / nv[:, None]
             else:
-                v = h[0, n - 1].astype(_jnp.float32)
-            return v / _jnp.maximum(_jnp.linalg.norm(v), 1e-9)
+                v = _jnp.take_along_axis(
+                    h.astype(_jnp.float32), (nv - 1)[:, None, None], axis=1
+                )[:, 0]
+            return v / _jnp.maximum(
+                _jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-9
+            )
 
-        vec = await asyncio.to_thread(lambda: _np.asarray(_run()))
-        out = {
-            "embedding": vec.tolist(),
-            "dim": int(vec.shape[0]),
-            "model": self.model_name,
-            "pooling": pooling,
-            "tokens_used": n,
-        }
-        if truncated:
-            out["truncated_tokens"] = truncated
+        vecs = await asyncio.to_thread(lambda: _np.asarray(_run()))
+        base = {"dim": int(vecs.shape[1]), "model": self.model_name, "pooling": pooling}
+        if batch_mode:
+            out = {
+                **base,
+                "embeddings": vecs.tolist(),
+                "tokens_used": lens,
+            }
+            if any(truncated_rows):
+                out["truncated_tokens"] = truncated_rows
+            return out
+        out = {**base, "embedding": vecs[0].tolist(), "tokens_used": lens[0]}
+        if truncated_rows[0]:
+            out["truncated_tokens"] = truncated_rows[0]
         return out
 
     async def generate(

@@ -2,7 +2,51 @@
 
 from __future__ import annotations
 
+import asyncio
 import os
+
+
+class _TimeoutCM:
+    """Python 3.10 stand-in for asyncio.timeout(): cancel the enclosing task
+    at the deadline and surface builtin TimeoutError at block exit (the 3.11
+    semantics — TimeoutError and asyncio.TimeoutError are aliases there)."""
+
+    def __init__(self, delay: float | None):
+        self._delay = delay
+        self._handle = None
+        self._timed_out = False
+
+    async def __aenter__(self):
+        self._task = asyncio.current_task()
+        if self._delay is not None:
+            self._handle = asyncio.get_running_loop().call_later(
+                self._delay, self._on_timeout
+            )
+        return self
+
+    def _on_timeout(self):
+        self._timed_out = True
+        self._task.cancel()
+
+    async def __aexit__(self, exc_type, exc, tb):
+        if self._handle is not None:
+            self._handle.cancel()
+        if self._timed_out and exc_type in (
+            asyncio.CancelledError,
+            asyncio.TimeoutError,
+        ):
+            raise TimeoutError from exc
+        return False
+
+
+def aio_timeout(delay: float | None):
+    """``async with aio_timeout(t):`` — asyncio.timeout() on Python >= 3.11,
+    a task-cancelling backport on 3.10. Always raises the BUILTIN
+    TimeoutError on expiry, so ``except TimeoutError`` works on both."""
+    native = getattr(asyncio, "timeout", None)
+    if native is not None:
+        return native(delay)
+    return _TimeoutCM(delay)
 
 
 def force_cpu_backend(virtual_devices: int | None = None) -> None:

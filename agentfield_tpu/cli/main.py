@@ -299,11 +299,17 @@ def cmd_init(cfg: Config, args) -> int:
         # sanitize the basename (the name itself only lands in comments)
         mod = re.sub(r"[^a-z0-9._-]", "-", Path(args.name).name.lower()).strip("-._") or "agent"
         (target / "main.go").write_text(GO_AGENT_TEMPLATE.format(name=target.name))
+        # Point the replace directive at the REAL sdk/go when this install
+        # has one: a relative ../sdk/go only builds if the project happens to
+        # sit next to the repo checkout — everywhere else `go build` dies on
+        # a missing module. The absolute path works from any directory.
+        sdk_go = Path(__file__).resolve().parents[2] / "sdk" / "go"
+        replace_path = str(sdk_go) if (sdk_go / "go.mod").exists() else "../sdk/go"
         (target / "go.mod").write_text(
             f"module {mod}\n\ngo 1.21\n\n"
-            "// replace with the repo path holding sdk/go\n"
+            "// replace points at the repo checkout holding sdk/go\n"
             "require agentfield-tpu/sdk/go v0.0.0\n"
-            "replace agentfield-tpu/sdk/go => ../sdk/go\n"
+            f"replace agentfield-tpu/sdk/go => {replace_path}\n"
         )
         entry, created = "main.go", "main.go, go.mod"
     else:

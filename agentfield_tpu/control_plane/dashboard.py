@@ -302,10 +302,14 @@ async function pgRuns(id) {
   if (id) {
     const render = async () => {
       const dag = await J('/api/v1/workflows/' + id + '/dag');
+      // live re-renders must not wipe an open "verify VC chain" result:
+      // carry the #chain contents across the innerHTML replacement
+      const prevChain = $('chain') ? $('chain').innerHTML : '';
       $('page').innerHTML = `<div class="row"><b>run ${esc(id)}</b>
         ${stat(dag.overall_status)} <span class="dim">${dag.nodes.length} executions</span>
         <button id="chainbtn">verify VC chain</button></div>
         <div id="chain"></div>${dagSvg(dag)}`;
+      $('chain').innerHTML = prevChain;
       $('chainbtn').onclick = () => vcChain(id);
       done();
     };

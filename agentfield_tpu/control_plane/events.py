@@ -10,6 +10,8 @@ bounded asyncio.Queue; publish never awaits.
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 import collections
 from typing import Any, AsyncIterator
 
@@ -60,7 +62,7 @@ class EventBus:
         execute.go:568)."""
         q = self.subscribe(topic)
         try:
-            async with asyncio.timeout(timeout):
+            async with aio_timeout(timeout):
                 while True:
                     _, ev = await q.get()
                     if predicate(ev):

@@ -5,6 +5,13 @@ Covers the reference's gateway metric surface
 step duration histogram, backpressure counter) plus serving-engine gauges
 (tok/s, TTFT) — rendered in Prometheus text exposition format at /metrics
 (reference serves the same endpoint, server.go:607).
+
+Gauges and counters take an optional ``labels`` dict (rendered as
+``name{k="v"}``); label values are escaped per the exposition format. The
+label path exists for per-node engine gauges — a model node's heartbeat
+stats (prefix-cache hit/miss/eviction/shared-page counters among them) are
+re-exported here by the registry via :func:`export_engine_stats`, so one
+control-plane /metrics scrape covers the whole fleet's cache behavior.
 """
 
 from __future__ import annotations
@@ -13,22 +20,33 @@ import collections
 import threading
 
 
+def _label_str(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k, v in sorted(labels.items()):
+        v = str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
 class Metrics:
     def __init__(self, prefix: str = "agentfield"):
         self.prefix = prefix
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = collections.defaultdict(float)
-        self._gauges: dict[str, float] = {}
+        # keyed (name, label_str) so one metric name can carry many label sets
+        self._counters: dict[tuple[str, str], float] = collections.defaultdict(float)
+        self._gauges: dict[tuple[str, str], float] = {}
         self._hist: dict[str, list[float]] = {}
         self._hist_buckets: dict[str, tuple[float, ...]] = {}
 
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def inc(self, name: str, value: float = 1.0, labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            self._counters[name] += value
+            self._counters[(name, _label_str(labels))] += value
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[(name, _label_str(labels))] = value
 
     def observe(self, name: str, value: float, buckets: tuple[float, ...] = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)) -> None:
         with self._lock:
@@ -42,20 +60,37 @@ class Metrics:
             h[-2] += value
             h[-1] += 1
 
-    def counter_value(self, name: str) -> float:
+    def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
         with self._lock:
-            return self._counters.get(name, 0.0)
+            return self._counters.get((name, _label_str(labels)), 0.0)
+
+    def gauge_value(self, name: str, labels: dict[str, str] | None = None) -> float | None:
+        with self._lock:
+            return self._gauges.get((name, _label_str(labels)))
+
+    def remove_gauges(self, labels: dict[str, str]) -> int:
+        """Drop every gauge carrying exactly this label set (e.g. a
+        deregistered node's engine gauges — dead series must not accumulate
+        in /metrics forever). Returns the number of series removed."""
+        ls = _label_str(labels)
+        with self._lock:
+            keys = [k for k in self._gauges if k[1] == ls]
+            for k in keys:
+                del self._gauges[k]
+        return len(keys)
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format (one TYPE line per metric name,
+        then every label set's sample)."""
         out = []
         with self._lock:
-            for name, v in sorted(self._counters.items()):
-                out.append(f"# TYPE {self.prefix}_{name} counter")
-                out.append(f"{self.prefix}_{name} {v}")
-            for name, v in sorted(self._gauges.items()):
-                out.append(f"# TYPE {self.prefix}_{name} gauge")
-                out.append(f"{self.prefix}_{name} {v}")
+            for kind, series in (("counter", self._counters), ("gauge", self._gauges)):
+                last_name = None
+                for (name, ls), v in sorted(series.items()):
+                    if name != last_name:
+                        out.append(f"# TYPE {self.prefix}_{name} {kind}")
+                        last_name = name
+                    out.append(f"{self.prefix}_{name}{ls} {v}")
             for name, h in sorted(self._hist.items()):
                 buckets = self._hist_buckets[name]
                 out.append(f"# TYPE {self.prefix}_{name} histogram")
@@ -67,3 +102,32 @@ class Metrics:
                 out.append(f"{self.prefix}_{name}_sum {h[-2]}")
                 out.append(f"{self.prefix}_{name}_count {h[-1]}")
         return "\n".join(out) + "\n"
+
+
+_METRIC_NAME_RE = None  # compiled lazily
+
+
+def export_engine_stats(metrics: Metrics, node_id: str, stats: dict) -> int:
+    """Re-export a node's heartbeat stats as per-node gauges
+    (``agentfield_engine_<stat>{node="<id>"}``). The whole numeric dict is
+    exported — engine counters monotonically increase on the node, so gauges
+    that mirror the latest heartbeat are the honest representation here
+    (the node owns the counter; the control plane just re-publishes it).
+    Keys that are not valid Prometheus metric-name fragments are dropped:
+    heartbeat stats are client-supplied, and one bad key (space, newline)
+    interpolated into a metric name would corrupt the whole /metrics
+    exposition for every scraper. Returns the number of gauges written."""
+    global _METRIC_NAME_RE
+    if _METRIC_NAME_RE is None:
+        import re
+
+        _METRIC_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+    n = 0
+    for k, v in stats.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if not isinstance(k, str) or not _METRIC_NAME_RE.match(k):
+            continue
+        metrics.set_gauge(f"engine_{k}", float(v), labels={"node": node_id})
+        n += 1
+    return n

@@ -181,8 +181,10 @@ class PgClient:
         read_timeout: float = 60.0,  # a hung server must not wedge the
         # control plane's event loop forever (storage calls are synchronous)
         sslmode: str = "disable",  # libpq semantics: disable | prefer |
-        # require (encrypt, no cert verification) | verify-full (verify
-        # cert chain + hostname against sslrootcert / system CAs)
+        # require (encrypt; verifies the cert chain — NOT the hostname —
+        # when sslrootcert is provided, like libpq's verify-ca) |
+        # verify-full (verify cert chain + hostname against sslrootcert /
+        # system CAs)
         sslrootcert: str | None = None,
     ):
         self.parameters: dict[str, str] = {}
@@ -229,7 +231,18 @@ class PgClient:
             )
         if sslmode == "verify-full":
             ctx = ssl.create_default_context(cafile=sslrootcert)
-        else:  # require / prefer: encrypt without verification (libpq parity)
+        elif sslmode == "require" and sslrootcert is not None:
+            # libpq semantics: require + an explicit root cert verifies the
+            # chain against it (like verify-ca) — silently skipping
+            # verification when the caller handed us a CA would downgrade
+            # their stated intent. Hostname checking stays off (that is
+            # what distinguishes verify-full). NOT applied to prefer: its
+            # failed-TLS fallback is plaintext, so verification there would
+            # turn a cert rotation into a silent encryption downgrade.
+            ctx = ssl.create_default_context(cafile=sslrootcert)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        else:  # require without a CA / prefer: encrypt without verification
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE

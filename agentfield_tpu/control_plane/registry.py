@@ -143,6 +143,12 @@ class NodeRegistry:
         stats = (data or {}).get("stats")
         if isinstance(stats, dict):
             node.metadata["stats"] = stats
+            # Re-export the node's engine counters (prefix-cache hit/miss/
+            # eviction/shared-page among them) as per-node /metrics gauges so
+            # one Prometheus scrape of the control plane covers the fleet.
+            from agentfield_tpu.control_plane.metrics import export_engine_stats
+
+            export_engine_stats(self.metrics, node_id, stats)
         old_status = node.status
         if requested is not None:
             try:
@@ -185,6 +191,8 @@ class NodeRegistry:
         if ok:
             self._last_persist.pop(node_id, None)
             self._fences.pop(node_id, None)
+            # a dead node's engine gauges must not linger in /metrics
+            self.metrics.remove_gauges({"node": node_id})
             self.bus.publish(NODE_TOPIC, {"type": "deregistered", "node_id": node_id, "ts": now()})
         return ok
 

@@ -9,6 +9,8 @@ search, SSE event streams, Prometheus /metrics, /health.
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 import json
 from typing import Any
 
@@ -52,7 +54,17 @@ class ControlPlane:
         health_interval: float = 30.0,  # active probe cadence (health_monitor.go)
         data_dir: str | None = None,  # package registry root (packages page)
     ):
-        from agentfield_tpu.control_plane.identity import DIDService, Keystore, VCService
+        try:
+            from agentfield_tpu.control_plane.identity import (
+                DIDService,
+                Keystore,
+                VCService,
+            )
+        except ModuleNotFoundError:
+            # No 'cryptography' in this environment: run WITHOUT the DID/VC
+            # audit layer (identity endpoints answer 501) instead of refusing
+            # to start — orchestration does not depend on attestation.
+            DIDService = Keystore = VCService = None
         from agentfield_tpu.control_plane.storage_pg import create_storage
 
         # db_path doubles as a storage URL: a postgres:// DSN selects the
@@ -64,14 +76,25 @@ class ControlPlane:
         # Awaitable mirror: handlers await this so a slow Postgres can never
         # stall the event loop (SQLite passes through on-loop).
         self.db = AsyncStorage(self.storage)
-        if keystore_path:
-            seed = Keystore(keystore_path, keystore_passphrase).load_or_create_seed()
-        else:
+        if DIDService is None:
+            if keystore_path:
+                raise ModuleNotFoundError(
+                    "keystore_path requires the 'cryptography' package "
+                    "(AES-GCM keystore sealing): pip install cryptography"
+                )
             import os as _os
 
             seed = _os.urandom(32)
-        self.did_service = DIDService(seed)
-        self.vc_service = VCService(self.did_service)
+            self.did_service = self.vc_service = None
+        else:
+            if keystore_path:
+                seed = Keystore(keystore_path, keystore_passphrase).load_or_create_seed()
+            else:
+                import os as _os
+
+                seed = _os.urandom(32)
+            self.did_service = DIDService(seed)
+            self.vc_service = VCService(self.did_service)
         from agentfield_tpu.control_plane.payloads import PayloadStore
 
         self.payloads = (
@@ -513,12 +536,27 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     # -- DID / VC audit layer ------------------------------------------
 
+    def _no_identity():
+        """501 when the DID/VC layer is disabled (no 'cryptography' package
+        in this environment); orchestration endpoints stay fully available."""
+        if cp.did_service is None:
+            return _json_error(
+                501,
+                "DID/VC identity layer unavailable: this control plane runs "
+                "without the 'cryptography' package",
+            )
+        return None
+
     @routes.get("/api/v1/did/org")
     async def org_did(_req):
+        if (err := _no_identity()) is not None:
+            return err
         return web.json_response({"did": cp.did_service.org_did})
 
     @routes.get("/api/v1/did/{node_id}")
     async def node_did(req: web.Request):
+        if (err := _no_identity()) is not None:
+            return err
         node = await cp.db.get_node(req.match_info["node_id"])
         if node is None:
             return _json_error(404, "unknown node")
@@ -535,6 +573,8 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.post("/api/v1/vc/executions/{execution_id}")
     async def issue_vc(req: web.Request):
+        if (err := _no_identity()) is not None:
+            return err
         ex = await cp.db.get_execution(req.match_info["execution_id"])
         if ex is None:
             return _json_error(404, "unknown execution")
@@ -567,6 +607,8 @@ def create_app(cp: ControlPlane) -> web.Application:
         vc = body.get("vc")
         if not isinstance(vc, dict):
             return _json_error(400, "field 'vc' (object) is required")
+        if (err := _no_identity()) is not None:
+            return err
         ok, reason = cp.vc_service.verify(vc)
         return web.json_response({"valid": ok, "reason": reason})
 
@@ -576,6 +618,8 @@ def create_app(cp: ControlPlane) -> web.Application:
         # attest a truncated run.
         # One SQL statement = one snapshot: offset pagination could skip or
         # duplicate rows while the run mutates, and a signed chain must not.
+        if (err := _no_identity()) is not None:
+            return err
         run_id = req.match_info["run_id"]
         limit = 1_000_000
         exs = await cp.db.list_executions(run_id=run_id, limit=limit)
@@ -720,7 +764,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             while True:
                 try:
-                    async with asyncio.timeout(15):
+                    async with aio_timeout(15):
                         _, ev = await q.get()
                     await resp.write(f"data: {json.dumps(ev)}\n\n".encode())
                 except TimeoutError:
@@ -761,7 +805,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             while not ws.closed:
                 try:
-                    async with asyncio.timeout(30):
+                    async with aio_timeout(30):
                         _, ev = await q.get()
                 except TimeoutError:
                     continue

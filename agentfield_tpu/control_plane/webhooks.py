@@ -10,6 +10,8 @@ queue IS the table (webhook_dispatcher.go:150,212,439,470).
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 import hashlib
 import hmac
 import json
@@ -100,7 +102,7 @@ class WebhookDispatcher:
                 processed = await self.process_due()
                 if processed == 0:
                     try:
-                        async with asyncio.timeout(self.poll_interval):
+                        async with aio_timeout(self.poll_interval):
                             await self._wake.wait()
                     except TimeoutError:
                         pass

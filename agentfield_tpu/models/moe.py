@@ -250,7 +250,9 @@ def moe_ffn_sharded(
         body = functools.partial(_moe_local, cfg=cfg, axis=AXIS_EXPERT)
     else:
         raise ValueError(f"impl={impl!r} must be 'dense' or 'sparse'")
-    fn = jax.shard_map(
+    from agentfield_tpu.parallel.mesh import shard_map as shard_map_compat
+
+    fn = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(moe_pspecs(), P()),

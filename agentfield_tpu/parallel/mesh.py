@@ -53,18 +53,40 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
 
 def to_varying(x, axis_name: str):
     """Mark a shard_map value as device-varying over `axis_name` (jax 0.9's
-    vma type system needs loop carries pre-marked). pvary→pcast rename compat."""
+    vma type system needs loop carries pre-marked). pvary→pcast rename
+    compat; jax < 0.6 has neither and needs no marking — identity."""
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis_name)
+    return x
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kwargs):
+    """shard_map across jax versions: top-level ``jax.shard_map`` (>= 0.6)
+    or ``jax.experimental.shard_map.shard_map`` (older). The old
+    replication checker predates the vma marking to_varying relies on and
+    false-positives on lax.cond carries, so it defaults off there."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+
+        kwargs.setdefault("check_rep", False)
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
 
 
 def use_mesh(mesh: Mesh):
     """Context manager making `mesh` the ambient mesh (jax>=0.9 renamed
-    use_mesh → set_mesh; accept either)."""
-    setter = getattr(jax.sharding, "set_mesh", None) or jax.sharding.use_mesh
-    return setter(mesh)
+    use_mesh → set_mesh; accept either; on jax 0.4/0.5 the Mesh object is
+    itself the ambient-mesh context manager)."""
+    setter = getattr(jax.sharding, "set_mesh", None) or getattr(
+        jax.sharding, "use_mesh", None
+    )
+    if setter is not None:
+        return setter(mesh)
+    return mesh
 
 
 def make_hybrid_mesh(

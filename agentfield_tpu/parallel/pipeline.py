@@ -22,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
 from agentfield_tpu.parallel.mesh import AXIS_STAGE, to_varying
+from agentfield_tpu.parallel.mesh import shard_map as shard_map_compat
 
 
 def split_layers_for_stages(params, num_stages: int):
@@ -120,7 +121,7 @@ def pipeline_forward(
     x_micro = x.reshape(num_microbatches, Bm, *x.shape[1:])
     pos_m = positions[:Bm]  # positions identical across microbatches by construction
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(_pipeline_local, cfg=cfg, axis=AXIS_STAGE),
         mesh=mesh,
         in_specs=(

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from agentfield_tpu.parallel.mesh import AXIS_SEQ, to_varying
+from agentfield_tpu.parallel.mesh import shard_map as shard_map_compat
 
 _NEG_INF = -1e30
 
@@ -159,7 +160,7 @@ def ring_attention(
     pos_spec = P(None, axis_name)
     if window is not None and not causal:
         raise ValueError("window requires causal=True (HF Mistral semantics)")
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal,
             window=window,

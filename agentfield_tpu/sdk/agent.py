@@ -430,6 +430,26 @@ class Agent:
             merged["stop_token_ids"] = list(merged["stop_token_ids"])
         return merged
 
+    @staticmethod
+    def _doc_node_down(doc: dict[str, Any]) -> bool:
+        """Structured node-down detection, shared by every model-failover
+        path (ai(), ai_embed): the transport layer records a synthesized
+        ``status: node_down``, and a FAILED execution whose error names a
+        gateway-level delivery failure (unreachable / vanished mid-call /
+        5xx) means the node, not the request, is the problem — fail over.
+        Deterministic request errors (bad pooling, empty input, schema
+        violations) never match: replaying those cluster-wide is useless."""
+        if doc.get("status") == "node_down":
+            return True
+        if doc.get("status") != "failed":
+            return False
+        err = str(doc.get("error") or "")
+        return (
+            "agent call failed" in err
+            or "vanished" in err
+            or "agent returned 5" in err
+        )
+
     async def ai(
         self,
         prompt: str | None = None,
@@ -661,17 +681,8 @@ class Agent:
                     await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
                     continue
                 break
-            err = str(doc.get("error") or "")
-            node_down = doc.get("status") == "node_down" or (
-                doc.get("status") == "failed"
-                and (
-                    "agent call failed" in err
-                    or "vanished" in err
-                    or "agent returned 5" in err
-                )
-            )
-            if node_down and ci + 1 < len(candidates):
-                node_errors.append(f"{node_id}: {err}")
+            if self._doc_node_down(doc) and ci + 1 < len(candidates):
+                node_errors.append(f"{node_id}: {doc.get('error')}")
                 continue
             break
         if doc.get("status") != "completed":
@@ -779,10 +790,8 @@ class Agent:
                 raise
             if doc.get("status") == "completed":
                 return doc["result"]
-            err = str(doc.get("error") or "")
-            node_down = "agent call failed" in err or "vanished" in err                 or "agent returned 5" in err
-            if node_down and ci + 1 < len(candidates):
-                errors.append(f"{node_id}: {err}")
+            if self._doc_node_down(doc) and ci + 1 < len(candidates):
+                errors.append(f"{node_id}: {doc.get('error')}")
                 continue
             break  # deterministic failure: do not replay cluster-wide
         detail = f"; failed over from {errors}" if errors else ""

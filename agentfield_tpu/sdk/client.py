@@ -9,6 +9,8 @@ public sync façade wraps it with asyncio.run where needed.
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 from typing import Any
 from urllib.parse import quote, urlencode
 
@@ -170,7 +172,7 @@ class ControlPlaneClient:
 
     async def _wait_sse(self, execution_id: str, timeout: float) -> dict[str, Any]:
         s = await self._s()
-        async with asyncio.timeout(timeout):
+        async with aio_timeout(timeout):
             async with s.get(
                 self.base_url + "/api/v1/events/executions",
                 timeout=aiohttp.ClientTimeout(total=timeout),

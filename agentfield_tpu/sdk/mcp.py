@@ -12,6 +12,8 @@ reference's bridge exists because its stack was threaded FastAPI).
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 import json
 from collections import deque
 from pathlib import Path
@@ -83,7 +85,7 @@ class MCPStdioClient:
         if self._proc and self._proc.returncode is None:
             self._proc.terminate()
             try:
-                async with asyncio.timeout(5):
+                async with aio_timeout(5):
                     await self._proc.wait()
             except TimeoutError:
                 self._proc.kill()
@@ -154,7 +156,7 @@ class MCPStdioClient:
             await self._send(
                 {"jsonrpc": "2.0", "id": rid, "method": method, "params": params or {}}
             )
-            async with asyncio.timeout(timeout):
+            async with aio_timeout(timeout):
                 return await fut
         finally:
             self._pending.pop(rid, None)  # timed-out futures must not accumulate

@@ -1,4 +1,8 @@
-from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache  # noqa: F401
+from agentfield_tpu.serving.kv_cache import (  # noqa: F401
+    PageAllocator,
+    PagedKVCache,
+    PrefixPagePool,
+)
 from agentfield_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
     GrammarCapacityError,

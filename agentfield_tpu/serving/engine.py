@@ -42,7 +42,12 @@ from agentfield_tpu.models import llama
 from agentfield_tpu.ops.paged_attention import paged_attention
 from agentfield_tpu.ops.pallas.kv_write_kernel import kv_write
 from agentfield_tpu.serving.grammar import Grammar
-from agentfield_tpu.serving.kv_cache import PageAllocator, PagedKVCache, build_page_table
+from agentfield_tpu.serving.kv_cache import (
+    PagedKVCache,
+    PrefixPagePool,
+    build_page_table,
+    page_chain_hashes,
+)
 from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
 
 _MASKED = -1e30  # logit value for grammar-disallowed tokens
@@ -95,6 +100,13 @@ class EngineConfig:
     # later requests admit, the window collapses to 1 (strict FIFO) until
     # the head gets its pages — freed pages then flow to the head first.
     enable_prefix_cache: bool = True  # retain session KV across turns
+    shared_prefix_cache: bool = True  # CROSS-REQUEST prefix reuse: prompt
+    # pages are content-addressed (chained block hashes over full pages,
+    # vLLM/SGLang-style) in a refcounted index, so any request — not just a
+    # session's next turn — skips prefill for its longest cached full-page
+    # prefix. Agent-fleet traffic shares system prompts/tool schemas, so the
+    # burst-TTFT win dominates (ISSUE 1). Requires enable_prefix_cache;
+    # False restores session-affinity-only reuse.
     prefill_chunk: int | None = None  # chunk long prefills to this many tokens:
     # bounds compiled bucket shapes and keeps decode latency fair under long
     # prompts (chunks run through the cached-page attention path). None
@@ -593,6 +605,18 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
 
 
 @functools.lru_cache(maxsize=None)
+def _copy_page_fn():
+    """Jitted device-side page copy (copy-on-write): duplicate one page's
+    K/V across all layers into a fresh page. jit re-specializes per pool
+    shape, so the target and draft caches share this builder."""
+
+    def cp(kp, vp, src, dst):
+        return kp.at[:, dst].set(kp[:, src]), vp.at[:, dst].set(vp[:, src])
+
+    return jax.jit(cp, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
 def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
     ps = ecfg.page_size
 
@@ -927,7 +951,49 @@ class InferenceEngine:
             _sparse_prefill_cfg(self.draft_cfg, self.ecfg)
             if self.draft_cfg is not None else None
         )
-        self.allocator = PageAllocator(self.ecfg.num_pages)
+        # Counters (exported via the control plane's /metrics, mirroring the
+        # reference's gateway gauges, internal/services/execution_metrics.go:14-44).
+        # Created BEFORE the page pool: the pool increments its
+        # prefix_pages_* counters directly into this dict.
+        self.stats = {
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "decode_steps": 0,
+            "requests_finished": 0,
+            "backpressure_total": 0,
+            "prefix_cache_hits": 0,
+            "prefix_tokens_reused": 0,
+            "sessions_evicted": 0,
+            "requests_cancelled": 0,
+            "prefill_batches": 0,
+            "admission_reorders": 0,
+            "grammar_evictions": 0,
+            "grammar_capacity_errors": 0,
+            "spec_steps": 0,  # speculative dispatches
+            "spec_emitted": 0,  # tokens emitted by them (rate = emitted /
+            # (steps * (spec_k+1)))
+            # Cross-request shared-prefix cache (kv_cache.PrefixPagePool):
+            "prefix_index_hits": 0,  # admissions that reused indexed pages
+            "prefix_index_misses": 0,  # matchable fresh admissions that found none
+            "prefix_cow_copies": 0,  # shared pages privatized (copied) before a write
+            "prefix_pages_unpublished": 0,  # sole-holder indexed pages whose
+            # mapping was dropped so the owner could write them in place
+            "prefix_batch_deferrals": 0,  # batch mates deferred to reuse a
+            # tick-mate's about-to-be-published prefix instead of re-prefilling
+        }
+        # Cross-request sharing rides on the session prefix-cache switch: one
+        # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
+        self._shared_prefix = bool(
+            self.ecfg.enable_prefix_cache and self.ecfg.shared_prefix_cache
+        )
+        self.allocator = PrefixPagePool(
+            self.ecfg.num_pages, self.ecfg.page_size, stats=self.stats
+        )
+        # Per-pending-request prompt chain hashes, computed once: the
+        # admission probe runs every tick over the whole window, and
+        # re-hashing long prompts each tick would tax the decode loop.
+        # Entries drop at admission/cancel.
+        self._req_hashes: dict[str, list[bytes]] = {}
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
         self.page_tables = np.zeros((B, maxp), np.int32)
         self.seq_lens = np.zeros((B,), np.int32)
@@ -983,26 +1049,6 @@ class InferenceEngine:
         self._compact: dict[str, Any] | None = None
         # One-deep decode pipeline: the dispatched-but-unread step (async_decode).
         self._inflight: dict[str, Any] | None = None
-        # Counters (exported via the control plane's /metrics, mirroring the
-        # reference's gateway gauges, internal/services/execution_metrics.go:14-44)
-        self.stats = {
-            "prefill_tokens": 0,
-            "decode_tokens": 0,
-            "decode_steps": 0,
-            "requests_finished": 0,
-            "backpressure_total": 0,
-            "prefix_cache_hits": 0,
-            "prefix_tokens_reused": 0,
-            "sessions_evicted": 0,
-            "requests_cancelled": 0,
-            "prefill_batches": 0,
-            "admission_reorders": 0,
-            "grammar_evictions": 0,
-            "grammar_capacity_errors": 0,
-            "spec_steps": 0,  # speculative dispatches
-            "spec_emitted": 0,  # tokens emitted by them (rate = emitted /
-            # (steps * (spec_k+1)))
-        }
         # Consecutive ticks the queue head has been page-starved while later
         # requests admitted (see _try_admit's fairness fence).
         self._head_starved_ticks = 0
@@ -1303,25 +1349,92 @@ class InferenceEngine:
         self.allocator.free(self._sessions.pop(req.session_id).pages)
         return None
 
+    def _prompt_hashes(self, req: Request) -> list[bytes]:
+        """Memoized page-chain hashes of the request's matchable prompt
+        prefix (prompt minus its last token): computed once per pending
+        request, not once per admission tick."""
+        hs = self._req_hashes.get(req.id)
+        if hs is None:
+            hs = page_chain_hashes(
+                req.prompt[: len(req.prompt) - 1], self.ecfg.page_size
+            )
+            self._req_hashes[req.id] = hs
+        return hs
+
+    def _cached_prefix_len(self, req: Request) -> int:
+        """Host-side probe (no references taken, nothing mutated): how many
+        prompt tokens a session hit or a shared-prefix index hit would skip
+        for this request. Drives cache-aware admission ordering."""
+        if req.mm_embeds or not self.ecfg.enable_prefix_cache or len(req.prompt) < 2:
+            return 0
+        with self._session_lock:
+            if req.session_id and req.session_id in self._sessions:
+                sess = self._sessions[req.session_id]
+                cl = len(sess.tokens)
+                if 0 < cl < len(req.prompt) and req.prompt[:cl] == sess.tokens:
+                    return cl
+                if 0 < len(req.prompt) <= cl and sess.tokens[: len(req.prompt)] == req.prompt:
+                    return len(req.prompt) - 1
+                return 0  # mismatched history: _admit_single drops the entry
+            if self._shared_prefix:
+                return self.allocator.peek(
+                    req.prompt[: len(req.prompt) - 1], hashes=self._prompt_hashes(req)
+                )
+        return 0
+
     def _try_admit(self) -> list[TokenEvent]:
         """Admit pending requests. Up to ``prefill_batch`` fresh prompts
         coalesce into ONE padded prefill call (burst TTFT is bounded by
-        ceil(burst/N) kernel calls, not the burst size); session-hit and
-        chunked prompts take the single-request path, one per tick.
+        ceil(burst/N) kernel calls, not the burst size); session-hit,
+        shared-prefix-hit and chunked prompts take the single-request path,
+        one per tick.
+
+        Cache-aware ordering: before the FIFO scan, the window candidate with
+        the LONGEST cached prefix (session or shared-prefix index) admits
+        first — its suffix prefill pads to a far smaller bucket than the cold
+        prompts' full-length buckets, so hits never queue behind cold
+        prefills. Fresh candidates that share their leading page with a
+        batch-mate admitted THIS tick are deferred one tick
+        (``prefix_batch_deferrals``): next tick they hit the published prefix
+        instead of redundantly re-prefilling it.
 
         Fairness: a page-starved request does not block the queue — admission
         scans up to ``admit_window`` entries past it (bounded reorder). The
         head is always tried first, so freed pages reach it before anyone
         behind it; if later requests keep admitting around a starved head for
         ``head_starve_fifo_ticks`` consecutive ticks, the window collapses to
-        strict FIFO until the head admits."""
+        strict FIFO until the head admits. Cache-hit hoisting ages the same
+        fence whenever it bypasses the head."""
         if not self.pending:
             return []
         N = max(1, self.ecfg.prefill_batch)
         window = max(1, self.ecfg.admit_window)
         if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
             window = 1  # anti-starvation fence: freed pages go to the head
+        if any(s is None for s in self.slots):
+            with self._pending_lock:
+                cands = [self.pending[i] for i in range(min(window, len(self.pending)))]
+            best = None  # (cached_len, window index, req)
+            for i, req in enumerate(cands):
+                cl = self._cached_prefix_len(req)
+                if cl > 0 and (best is None or cl > best[0]):
+                    best = (cl, i, req)
+            if best is not None:
+                _, i, req = best
+                free_slot = next(j for j, s in enumerate(self.slots) if s is None)
+                single = self._admit_single(req, free_slot)
+                if single:
+                    if i > 0:
+                        self.stats["admission_reorders"] += 1
+                        # bypassing the head ages the anti-starvation fence
+                        self._head_starved_ticks += 1
+                    else:
+                        self._head_starved_ticks = 0
+                    return single
+                # starved even with its cached pages: fall through to the
+                # FIFO scan, which skips it like any starved single
         batch: list[tuple[Request, int, list[int]]] = []  # (req, slot, pages)
+        batch_chains: set[bytes] = set()  # leading-page chain hashes in `batch`
         claimed: set[int] = set()
         head = self.pending[0]
         head_starved = False
@@ -1347,7 +1460,17 @@ class InferenceEngine:
                 and self.ecfg.enable_prefix_cache
                 and req.session_id in self._sessions
             )
-            if chunked or has_sess or req.mm_embeds:
+            index_hit = False
+            if not (chunked or has_sess or req.mm_embeds) and self._shared_prefix:
+                with self._session_lock:
+                    index_hit = (
+                        self.allocator.peek(
+                            req.prompt[: len(req.prompt) - 1],
+                            hashes=self._prompt_hashes(req),
+                        )
+                        > 0
+                    )
+            if chunked or has_sess or req.mm_embeds or index_hit:
                 if batch:
                     break  # flush the fresh batch first; single path next tick
                 single = self._admit_single(req, free_slot)
@@ -1366,6 +1489,16 @@ class InferenceEngine:
                 head_starved = head_starved or req is head
                 idx += 1
                 continue
+            h1 = None
+            if self._shared_prefix and len(req.prompt) > self.ecfg.page_size:
+                h1 = self._prompt_hashes(req)[0]
+                if h1 in batch_chains:
+                    # a batch-mate admitted THIS tick is about to prefill (and
+                    # publish) this same leading page: defer one tick so this
+                    # request reuses it instead of re-prefilling the prefix
+                    self.stats["prefix_batch_deferrals"] += 1
+                    idx += 1
+                    continue
             with self._session_lock:
                 pages = self._alloc_with_eviction(self._pages_needed(req))
             if pages is None:
@@ -1374,8 +1507,12 @@ class InferenceEngine:
                 head_starved = head_starved or req is head
                 idx += 1
                 continue
+            if h1 is not None:
+                batch_chains.add(h1)
+                self.stats["prefix_index_misses"] += 1
             with self._pending_lock:
                 self.pending.remove(req)
+            self._req_hashes.pop(req.id, None)
             claimed.add(free_slot)
             batch.append((req, free_slot, pages))
         if head_starved and batch:
@@ -1455,8 +1592,11 @@ class InferenceEngine:
         ]
 
     def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
-        """Single-request admission: session prefix-cache reuse (suffix-only
-        prefill) and chunked long prompts flow through here."""
+        """Single-request admission: session prefix-cache reuse, cross-request
+        shared-prefix reuse (both suffix-only prefill) and chunked long
+        prompts flow through here."""
+        ps = self.ecfg.page_size
+        index_hit = False
         with self._session_lock:
             hit = self._session_hit(req)
             total_pages = self._pages_needed(req)
@@ -1473,19 +1613,97 @@ class InferenceEngine:
                     return []  # page-starved; decode will free pages
                 pages = sess.pages + extra
                 suffix = req.prompt[start:]
+                # Copy-on-write: this request will WRITE every page from
+                # start//ps onward (suffix re-prefill from `start`, then
+                # decode past the prompt). Indexed pages are immutable and
+                # pages other holders reference must not be touched, so any
+                # shared page in the write range is privatized first. This
+                # bites on the full-prompt retry path (start=len(prompt)-1):
+                # the session's published pages BEYOND the retried prompt
+                # would otherwise be silently corrupted under the index's
+                # feet. Sole-holder indexed pages just drop their (about to
+                # be stale) index mapping and are written in place; pages
+                # other requests hold get a fresh copy.
+                widx0 = start // ps
+                pages = list(pages)
+                cow_idx = []
+                # Write range ends at the request's own page budget: a retry
+                # shorter than the session history never touches the
+                # history's tail pages, so those keep their index entries
+                # (and other holders) untouched.
+                for k in range(widx0, min(len(pages), total_pages)):
+                    if not self.allocator.is_shared(pages[k]):
+                        continue
+                    if self.allocator.refcount(pages[k]) <= 1:
+                        self.allocator.forget(pages[k])
+                        self.stats["prefix_pages_unpublished"] += 1
+                    else:
+                        cow_idx.append(k)
+                if cow_idx:
+                    fresh = self._alloc_with_eviction(len(cow_idx))
+                    if fresh is None:
+                        if extra:
+                            self.allocator.free(extra)
+                        self._sessions[req.session_id] = sess
+                        return []  # page-starved; retry later
+                    for k, new_page in zip(cow_idx, fresh):
+                        if k == widx0 and start % ps:
+                            # the only page whose prior slots (< start) this
+                            # request still READS; later pages are fully
+                            # rewritten before any read touches them
+                            self._copy_page(pages[k], new_page)
+                        self.allocator.free([pages[k]])  # drop this holder's ref
+                        pages[k] = new_page
+                    self.stats["prefix_cow_copies"] += len(cow_idx)
+                if len(pages) > total_pages:
+                    # A retry shorter than the session history: drop the tail
+                    # beyond this request's own page budget. The slot's table
+                    # must not reference pages it may never legally write —
+                    # a pipelined decode span's stale post-finish write would
+                    # otherwise land on them (indexed tail pages stay cached
+                    # and matchable; the rest return to the free list). Past
+                    # the shortened table, such writes hit garbage page 0,
+                    # the designed sink.
+                    self.allocator.free(pages[total_pages:])
+                    pages = pages[:total_pages]
             else:
-                pages = self._alloc_with_eviction(total_pages)
-                if pages is None:
-                    return []
+                matched: list[int] = []
                 start = 0
-                suffix = req.prompt
+                if self._shared_prefix and not req.mm_embeds and len(req.prompt) > 1:
+                    # Cross-request reuse: longest content-addressed full-page
+                    # prefix of the prompt (minus the last token — its logits
+                    # must be computed to sample). Matched pages are incref'd.
+                    matched, start = self.allocator.lookup(
+                        req.prompt[: len(req.prompt) - 1],
+                        hashes=self._prompt_hashes(req),
+                    )
+                if matched:
+                    extra_needed = total_pages - len(matched)
+                    extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
+                    if extra is None:
+                        self.allocator.free(matched)  # drop refs; retry later
+                        return []
+                    pages = matched + extra
+                    suffix = req.prompt[start:]
+                    index_hit = True
+                else:
+                    pages = self._alloc_with_eviction(total_pages)
+                    if pages is None:
+                        return []
+                    suffix = req.prompt
+                    if self._shared_prefix and len(req.prompt) > ps:
+                        self.stats["prefix_index_misses"] += 1
         with self._pending_lock:
             self.pending.remove(req)  # by identity: the fairness window may
             # admit from behind a page-starved head, not just pending[0]
+        self._req_hashes.pop(req.id, None)
 
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
         if hit is not None:
             self.stats["prefix_cache_hits"] += 1
+            self.stats["prefix_tokens_reused"] += start
+        elif index_hit:
+            self.stats["prefix_index_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
         if req.mm_embeds:
             # Whole-prompt injection prefill (chunking doesn't apply: the
@@ -1517,6 +1735,31 @@ class InferenceEngine:
         first_logprob = float(jax.nn.log_softmax(last_logits)[tok])
         return self._install(req, slot_idx, pages, row, tok, first_logprob)
 
+    def _copy_page(self, src: int, dst: int) -> None:
+        """Copy-on-write: duplicate page `src` into `dst` (all layers), on the
+        target cache and — when speculation is on — the draft cache, so the
+        draft's view of a privatized page stays in sync."""
+        fn = _copy_page_fn()
+        self.cache.k_pages, self.cache.v_pages = fn(
+            self.cache.k_pages, self.cache.v_pages, jnp.int32(src), jnp.int32(dst)
+        )
+        if self.draft_cache is not None:
+            self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
+                self.draft_cache.k_pages, self.draft_cache.v_pages,
+                jnp.int32(src), jnp.int32(dst),
+            )
+
+    def prefix_cache_stats(self) -> dict[str, int]:
+        """Gauges for the shared-prefix page pool (counters live in
+        ``self.stats``); exported via heartbeats, /stats and /metrics."""
+        with self._session_lock:
+            a = self.allocator
+            return {
+                "prefix_cached_pages": a.cached_pages,
+                "prefix_shared_pages": a.shared_pages,
+                "cached_sessions": len(self._sessions),
+            }
+
     def _install(
         self,
         req: Request,
@@ -1526,6 +1769,14 @@ class InferenceEngine:
         tok: int,
         logprob: float,
     ) -> TokenEvent:
+        if self._shared_prefix and not req.mm_embeds:
+            # The prompt's KV is final once prefill completes: content-address
+            # its full pages NOW so the rest of a burst (and any later
+            # request) reuses them while this one is still decoding. Decode
+            # writes land strictly past the prompt, so published pages are
+            # never rewritten by their owner.
+            with self._session_lock:
+                self.allocator.publish(req.prompt, pages)
         slot = _Slot(
             req=req,
             pages=pages,
@@ -1666,6 +1917,13 @@ class InferenceEngine:
     def _release(self, slot_idx: int, slot: _Slot) -> None:
         sid = slot.req.session_id
         with self._session_lock:
+            if self._shared_prefix and not slot.req.mm_embeds and len(slot.tokens) > 1:
+                # Publish the GENERATED full pages too (the prompt's were
+                # published at install; re-walking them is a cheap no-op):
+                # agent→agent chains resubmit prompt+response as the next
+                # prompt, so completed outputs are tomorrow's shared prefixes.
+                # The last token's KV was never written — publish tokens[:-1].
+                self.allocator.publish(slot.tokens[:-1], slot.pages)
             if (
                 sid
                 and self.ecfg.enable_prefix_cache
@@ -1724,6 +1982,8 @@ class InferenceEngine:
             with self._session_lock:
                 for r in dropped:
                     self._grammar_release(r.grammar)
+            for r in dropped:
+                self._req_hashes.pop(r.id, None)
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
                 # Incomplete output: release WITHOUT session retention.

@@ -18,7 +18,10 @@ there, which keeps the decode step shape-static with no host branching.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -110,3 +113,255 @@ def build_page_table(pages: list[int], max_pages: int) -> np.ndarray:
     row = np.zeros((max_pages,), np.int32)
     row[: len(pages)] = pages
     return row
+
+
+def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
+    """Chained block hash over one full page of token ids (vLLM/SGLang-style):
+    a page's identity is (everything before it, its own tokens), so two
+    requests share a page iff their prompts agree on the ENTIRE prefix
+    through that page. blake2b-128 makes accidental collisions negligible;
+    lookups still verify token content, so a collision degrades to a miss,
+    never to wrong KV."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def page_chain_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
+    """Chained hash per full page of `tokens`. Callers that probe the index
+    repeatedly (the scheduler, every admission tick) compute this once per
+    request and pass it to peek()/lookup() instead of re-hashing the prompt
+    each tick."""
+    out: list[bytes] = []
+    h = b""
+    for off in range(0, (len(tokens) // page_size) * page_size, page_size):
+        h = chain_hash(h, tokens[off : off + page_size])
+        out.append(h)
+    return out
+
+
+@dataclasses.dataclass
+class PageRecord:
+    """One content-addressed page: the chain hash that names it and the page
+    of token ids backing that hash (kept for collision verification)."""
+
+    page: int
+    chain: bytes
+    tokens: tuple[int, ...]
+    last_used: float  # logical LRU clock, maintained by the pool
+
+
+class PrefixPagePool:
+    """Refcounted, content-addressed page pool: the cross-request generalization
+    of :class:`PageAllocator`.
+
+    Three page states:
+
+    - **free**: on the free list, content is garbage.
+    - **live**: refcount >= 1 — owned by one or more slots/sessions. Live pages
+      may ALSO be in the content index (a published prompt page of a running
+      request), in which case new requests incref them via :meth:`lookup`.
+    - **cached**: refcount == 0 but still in the content index — the page's KV
+      is valid and reusable. Cached pages sit on an LRU; allocation evicts
+      them only when the free list is empty (cached prefixes are a best-effort
+      optimization; live requests always win).
+
+    Single ownership rule: every ``alloc``/``lookup`` reference must be
+    balanced by one :meth:`free` (release). Over-release raises — the
+    refcounted analogue of the old allocator's double-free check.
+
+    Not thread-safe; callers serialize (the engine holds its session lock).
+    """
+
+    def __init__(self, num_pages: int, page_size: int, stats: dict | None = None):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._refs = [0] * num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
+        self._by_hash: dict[bytes, PageRecord] = {}
+        self._by_page: dict[int, PageRecord] = {}
+        # refcount-0 cached pages in eviction order (oldest first); OrderedDict
+        # gives O(1) touch/evict instead of an O(cached) min() per allocation.
+        self._lru: collections.OrderedDict[int, None] = collections.OrderedDict()
+        self._clock = 0.0
+        # Shared counter surface (the engine passes its stats dict so pool
+        # events ride heartbeats/metrics without a mirror-copy step).
+        self.stats = stats if stats is not None else {}
+        for k in ("prefix_pages_published", "prefix_pages_evicted", "prefix_pages_reused"):
+            self.stats.setdefault(k, 0)
+
+    # -- gauges ---------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        """Allocatable pages right now: the free list plus refcount-0 cached
+        pages (evictable on demand). This is the backpressure signal."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages resident in the content index (live shared + refcount-0)."""
+        return len(self._by_page)
+
+    @property
+    def shared_pages(self) -> int:
+        """Indexed pages currently referenced by 2+ holders — the live
+        sharing factor the whole feature exists for."""
+        return sum(1 for p in self._by_page if self._refs[p] > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
+
+    def is_shared(self, page: int) -> bool:
+        """True when writing this page could be observed by someone else:
+        it is content-addressed (future lookups may match it) or another
+        holder references it. Writers must copy-on-write first."""
+        return page in self._by_page or self._refs[page] > 1
+
+    # -- allocation -----------------------------------------------------
+
+    def _tick(self) -> float:
+        self._clock += 1.0
+        return self._clock
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Allocate n pages (each with refcount 1) or None — all-or-nothing,
+        so a half-admitted request never strands pages. Evicts LRU cached
+        pages (refcount 0) when the free list runs dry."""
+        if n > self.free_pages:
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._lru.popitem(last=False)  # oldest cached page
+                rec = self._by_page.pop(p)
+                del self._by_hash[rec.chain]
+                self.stats["prefix_pages_evicted"] += 1
+            self._refs[p] = 1
+            out.append(p)
+        return out
+
+    def incref(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == 0 or p >= self.num_pages:
+                raise ValueError(f"invalid page id {p}")
+            if self._refs[p] == 0:
+                # a cached page gaining a holder leaves the eviction LRU
+                if p not in self._by_page:
+                    raise ValueError(f"incref of unowned, uncached page {p}")
+                self._lru.pop(p, None)
+            self._refs[p] += 1
+
+    def free(self, pages: list[int]) -> None:
+        """Release one reference per page. Pages hitting refcount 0 return to
+        the free list, unless content-addressed — those stay cached (KV still
+        valid) until allocation pressure evicts them LRU."""
+        for p in pages:
+            if p == 0 or p >= self.num_pages:
+                raise ValueError(f"invalid page id {p}")
+            if self._refs[p] <= 0:
+                raise ValueError(f"over-free of page {p} (refcount already 0)")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                if p in self._by_page:
+                    self._lru[p] = None  # newest cached entry
+                else:
+                    self._free.append(p)
+
+    # -- content index --------------------------------------------------
+
+    def peek(self, tokens: Sequence[int], hashes: list[bytes] | None = None) -> int:
+        """Length (in tokens) of the longest indexed full-page prefix of
+        `tokens`, without taking references. Admission uses this to order
+        and group candidates before committing. Pass precomputed
+        `hashes` (page_chain_hashes) to skip re-hashing."""
+        ps = self.page_size
+        if hashes is None:
+            hashes = page_chain_hashes(tokens, ps)
+        n = 0
+        for i, h in enumerate(hashes):
+            rec = self._by_hash.get(h)
+            if rec is None or rec.tokens != tuple(tokens[i * ps : (i + 1) * ps]):
+                break
+            n += ps
+        return n
+
+    def lookup(
+        self, tokens: Sequence[int], hashes: list[bytes] | None = None
+    ) -> tuple[list[int], int]:
+        """Longest indexed full-page chain prefix of `tokens`. Returns
+        (pages, matched_token_count); the caller owns one reference on each
+        returned page (balance with free())."""
+        ps = self.page_size
+        if hashes is None:
+            hashes = page_chain_hashes(tokens, ps)
+        pages: list[int] = []
+        t = self._tick()
+        for i, h in enumerate(hashes):
+            page_toks = tuple(tokens[i * ps : (i + 1) * ps])
+            rec = self._by_hash.get(h)
+            if rec is None or rec.tokens != page_toks:
+                break
+            rec.last_used = t
+            if self._refs[rec.page] == 0:
+                self._lru.pop(rec.page, None)
+            self._refs[rec.page] += 1
+            pages.append(rec.page)
+        self.stats["prefix_pages_reused"] += len(pages)
+        return pages, len(pages) * ps
+
+    def publish(self, tokens: Sequence[int], pages: list[int]) -> int:
+        """Register the full pages of `tokens` (KV resident in position-
+        ordered `pages`) under their chain hashes. Pages whose chain is
+        already indexed are skipped — a concurrent duplicate prefill keeps
+        the incumbent and the duplicate page simply frees when its holder
+        releases it. Returns the number of newly indexed pages.
+
+        Publish only pages whose content is FINAL (the engine publishes a
+        prompt after its prefill completes, and generated pages at release):
+        an indexed page must never be rewritten — writers copy-on-write.
+        """
+        ps = self.page_size
+        h = b""
+        n_new = 0
+        t = self._tick()
+        for i in range(min(len(tokens) // ps, len(pages))):
+            page_toks = tuple(tokens[i * ps : (i + 1) * ps])
+            h = chain_hash(h, page_toks)
+            rec = self._by_hash.get(h)
+            if rec is not None:
+                if rec.tokens == page_toks:
+                    rec.last_used = t
+                    if self._refs[rec.page] == 0:
+                        self._lru.move_to_end(rec.page)
+                continue  # same chain cached, or a hash collision: keep incumbent
+            p = pages[i]
+            if p in self._by_page:
+                continue  # page already names another chain (defensive)
+            self._by_page[p] = self._by_hash[h] = PageRecord(
+                page=p, chain=h, tokens=page_toks, last_used=t
+            )
+            if self._refs[p] == 0:
+                self._lru[p] = None
+            n_new += 1
+            self.stats["prefix_pages_published"] += 1
+        return n_new
+
+    def forget(self, page: int) -> None:
+        """Drop a page from the content index (its KV is about to be
+        invalidated). Live references are unaffected; a refcount-0 page
+        moves from cached to free."""
+        rec = self._by_page.pop(page, None)
+        if rec is None:
+            return
+        del self._by_hash[rec.chain]
+        if page in self._lru:
+            del self._lru[page]
+        if self._refs[page] == 0:
+            self._free.append(page)

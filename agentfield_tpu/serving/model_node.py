@@ -14,6 +14,8 @@ loop); completions resolve asyncio futures on the loop.
 from __future__ import annotations
 
 import asyncio
+
+from agentfield_tpu._compat import aio_timeout
 import collections
 import time
 from typing import Any
@@ -382,7 +384,7 @@ class ModelBackend:
                     self.engine.gc_sessions()  # bound idle KV retention
                 self._wake.clear()
                 try:
-                    async with asyncio.timeout(self.idle_sleep * 50):
+                    async with aio_timeout(self.idle_sleep * 50):
                         await self._wake.wait()
                 except TimeoutError:
                     continue
@@ -1245,6 +1247,7 @@ def build_model_node(
     agent.heartbeat_stats = lambda: {
         **backend.engine.stats,
         **backend.engine.grammar_bank_stats(),
+        **backend.engine.prefix_cache_stats(),
         "active_slots": backend.engine.num_active,
         "free_pages": backend.engine.allocator.free_pages,
     }
@@ -1335,10 +1338,10 @@ def build_model_node(
             {
                 "model": backend.model_name,
                 **eng.stats,
+                **eng.prefix_cache_stats(),
                 "active_slots": eng.num_active,
                 "pending": len(eng.pending),
                 "free_pages": eng.allocator.free_pages,
-                "cached_sessions": len(eng._sessions),
             }
         )
 

@@ -35,6 +35,13 @@ AGENTFIELD_BENCH_SKIP_PROBE=1 (operator knows the chip is healthy),
 AGENTFIELD_BENCH_QUANT=int8 (weight-only quantized serving),
 AGENTFIELD_BENCH_SPEC=<draft preset|checkpoint|self> + AGENTFIELD_BENCH_SPEC_K
 (speculative decoding; 'self' = self-draft upper bound, acceptance ≈ 1).
+
+Scenarios (AGENTFIELD_BENCH_SCENARIO):
+  shared_prefix_burst — 32 requests sharing a 512-token system prompt
+    (AGENTFIELD_BENCH_PREFIX overrides), run twice on the same backend:
+    cross-request shared-prefix KV cache ON vs all prefix reuse OFF.
+    Reports prefix_hit_rate and burst TTFT p50/p99 for both, headline value
+    = cache-ON burst TTFT p50 (ms).
 """
 
 from __future__ import annotations
@@ -420,6 +427,19 @@ def _run_bench() -> None:
             from agentfield_tpu.serving.model_node import load_draft_model
 
             draft_model = load_draft_model(spec_draft, cfg.vocab_size, seed=3)
+    # --- Scenario dispatch: a named scenario replaces the headline run
+    # (same probe/compile-gate discipline, its own one-line JSON).
+    scenario = os.environ.get("AGENTFIELD_BENCH_SCENARIO")
+    if scenario == "shared_prefix_burst":
+        _shared_prefix_burst(model, cfg, params, attn, span, n_requests)
+        _done.set()
+        return
+    if scenario:
+        raise ValueError(
+            f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
+            "(have: shared_prefix_burst)"
+        )
+
     demoted = None
     if attn == "pallas":
         if not _budget_gate("correctness gate (pallas vs ref numerics)", 180):
@@ -611,6 +631,129 @@ def _run_bench() -> None:
         }
     )
     _done.set()
+
+
+def _shared_prefix_burst(
+    model: str, cfg, params, attn: str, span: int, n_requests_env: int
+) -> None:
+    """Agent-fleet burst: N requests sharing one long system prompt, admitted
+    at t0. Run twice on the same backend — cross-request shared-prefix cache
+    ON (the tentpole path: one request prefills the prefix, the rest
+    suffix-prefill only their own tail) vs ALL prefix reuse OFF (every
+    request re-prefills the full prompt). Emits prefix_hit_rate and both
+    bursts' TTFT p50/p99; headline value is the cache-ON burst TTFT p50."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    n = 32 if os.environ.get("AGENTFIELD_BENCH_REQUESTS") is None else n_requests_env
+    prefix_len = int(os.environ.get("AGENTFIELD_BENCH_PREFIX", "512"))
+    tail_len, new_tokens = 32, 32
+    page_size = 32
+    pages_per_seq = -(-(prefix_len + tail_len + new_tokens) // page_size) + 1
+    ecfg = EngineConfig(
+        max_batch=min(n, 64),
+        page_size=page_size,
+        num_pages=n * pages_per_seq + 32,  # no-sharing worst case fits too
+        max_pages_per_seq=pages_per_seq,
+        max_pending=max(n, 1024),
+        prefill_batch=int(os.environ.get("AGENTFIELD_BENCH_PREFILL_BATCH", "8")),
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=span,
+    )
+    key = jax.random.PRNGKey(11)
+    shared = jax.random.randint(key, (prefix_len,), 0, cfg.vocab_size, jnp.int32).tolist()
+    tails = jax.random.randint(
+        jax.random.PRNGKey(12), (n, tail_len), 0, cfg.vocab_size, jnp.int32
+    )
+
+    def burst_reqs(prefix: str):
+        return [
+            Request(
+                id=f"{prefix}{i}",
+                prompt=shared + tails[i].tolist(),
+                sampling=SamplingParams(max_new_tokens=new_tokens),
+            )
+            for i in range(n)
+        ]
+
+    def run_burst(enable_cache: bool, tag: str):
+        _partial["stage"] = f"shared_prefix_burst ({tag})"
+        e = InferenceEngine(
+            params, cfg, _dc.replace(ecfg, enable_prefix_cache=enable_cache)
+        )
+        # warm the compile paths (full-prompt bucket, suffix buckets, decode)
+        warm = [
+            Request(
+                id=f"w{tag}{i}",
+                prompt=shared + tails[i].tolist(),
+                sampling=SamplingParams(max_new_tokens=4),
+            )
+            for i in range(2)
+        ]
+        for _ in e.run_to_completion(warm):
+            pass
+        e2 = InferenceEngine(
+            params, cfg, _dc.replace(ecfg, enable_prefix_cache=enable_cache)
+        )
+        reqs = burst_reqs(tag)
+        first_ms: dict[str, float] = {}
+        t0 = time.perf_counter()
+        for r in reqs:
+            e2.submit(r)
+        toks = 0
+        while e2.has_work():
+            for ev in e2.step():
+                toks += 1
+                if ev.index == 0:
+                    first_ms[ev.request_id] = (time.perf_counter() - t0) * 1e3
+        el = time.perf_counter() - t0
+        ttfts = sorted(first_ms.values())
+        return {
+            "ttft_p50": ttfts[len(ttfts) // 2],
+            "ttft_p99": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))],
+            "tok_s": toks / el,
+            "elapsed_s": el,
+            "stats": dict(e2.stats),
+        }
+
+    if not _budget_gate("shared_prefix_burst", 120):
+        _emit(_fallback_payload("budget exhausted before shared_prefix_burst"))
+        return
+    cold = run_burst(False, "n")  # no reuse: every request re-prefills fully
+    warm = run_burst(True, "s")  # shared-prefix cache on
+    s = warm["stats"]
+    hits = s["prefix_index_hits"] + s["prefix_cache_hits"]
+    lookups = hits + s["prefix_index_misses"]
+    hit_rate = hits / lookups if lookups else 0.0
+    _emit(
+        {
+            "metric": f"shared_prefix_burst_{model}_{n}req_{prefix_len}tok_prefix",
+            "value": round(warm["ttft_p50"], 1),
+            "unit": "ms_burst_ttft_p50",
+            "prefix_hit_rate": round(hit_rate, 3),
+            "burst_ttft_ms_p50": round(warm["ttft_p50"], 1),
+            "burst_ttft_ms_p99": round(warm["ttft_p99"], 1),
+            "nocache_ttft_ms_p50": round(cold["ttft_p50"], 1),
+            "nocache_ttft_ms_p99": round(cold["ttft_p99"], 1),
+            "ttft_speedup_p50": round(cold["ttft_p50"] / max(warm["ttft_p50"], 1e-9), 2),
+            "tok_s": round(warm["tok_s"], 1),
+            "nocache_tok_s": round(cold["tok_s"], 1),
+            "prefix_tokens_reused": s["prefix_tokens_reused"],
+            "prefix_pages_published": s["prefix_pages_published"],
+            "prefix_pages_evicted": s["prefix_pages_evicted"],
+            "prefix_batch_deferrals": s["prefix_batch_deferrals"],
+            "attn_impl": attn,
+            "decode_span": span,
+            "n_requests": n,
+            "prefix_len": prefix_len,
+            "device": str(jax.devices()[0]),
+        }
+    )
 
 
 if __name__ == "__main__":

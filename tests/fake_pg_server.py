@@ -108,16 +108,42 @@ class _Reader:
 
 
 def _make_tls_context():
-    """Self-signed server context (cryptography lib — already a control-plane
-    dependency for DID keys). Certs land in a tempdir; ssl wants file paths."""
+    """Self-signed server context. Prefers the cryptography lib (a DID/VC
+    dependency when installed); environments without it fall back to the
+    openssl CLI. Certs land in a tempdir; ssl wants file paths."""
     import datetime
     import ssl
     import tempfile
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ModuleNotFoundError:
+        import shutil
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            import pytest
+
+            pytest.skip("TLS fake-PG needs either 'cryptography' or openssl")
+        d = tempfile.mkdtemp(prefix="fakepg-tls-")
+        cert_path, key_path = f"{d}/cert.pem", f"{d}/key.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "ec",
+                "-pkeyopt", "ec_paramgen_curve:prime256v1",
+                "-keyout", key_path, "-out", cert_path,
+                "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_path, key_path)
+        return ctx, cert_path
 
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])

@@ -148,7 +148,16 @@ def test_cli_init_go_template(tmp_path):
     assert r.returncode == 0, r.stderr
     assert (tmp_path / "gagent" / "main.go").exists()
     assert (tmp_path / "gagent" / "go.mod").exists()
-    assert "sdk/go" in (tmp_path / "gagent" / "go.mod").read_text()
+    mod_text = (tmp_path / "gagent" / "go.mod").read_text()
+    assert "sdk/go" in mod_text
+    # the replace directive must point at the repo's real sdk/go (absolute):
+    # a relative ../sdk/go breaks `go build` for projects scaffolded outside
+    # the repo checkout — tmp_path certainly is outside it
+    replace_line = next(l for l in mod_text.splitlines() if l.startswith("replace"))
+    target = Path(replace_line.split("=>", 1)[1].strip())
+    if (Path(_REPO_ROOT) / "sdk" / "go" / "go.mod").exists():
+        assert target.is_absolute(), replace_line
+        assert (target / "go.mod").exists(), replace_line
 
 
 def test_cli_init_go_template_builds_when_toolchain_exists(tmp_path):

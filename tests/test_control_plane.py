@@ -219,6 +219,31 @@ async def test_async_backpressure_503():
 
 
 @async_test
+async def test_heartbeat_stats_exported_to_metrics():
+    """A node's heartbeat stats (model-node engine counters: prefix-cache
+    hits/misses/evictions/shared pages) re-export as per-node gauges on the
+    control plane's Prometheus /metrics."""
+    async with CPHarness() as h:
+        await h.register_agent()
+        stats = {
+            "prefix_index_hits": 3,
+            "prefix_index_misses": 1,
+            "prefix_pages_evicted": 4,
+            "prefix_shared_pages": 2,
+            "decode_tokens": 99,
+        }
+        async with h.http.post(
+            "/api/v1/nodes/fake-agent/heartbeat", json={"stats": stats}
+        ) as r:
+            assert r.status == 200
+        async with h.http.get("/metrics") as r:
+            text = await r.text()
+        for k, v in stats.items():
+            assert f'agentfield_engine_{k}{{node="fake-agent"}} {float(v)}' in text, k
+        assert "# TYPE agentfield_engine_prefix_index_hits gauge" in text
+
+
+@async_test
 async def test_sync_wait_timeout_marks_timeout():
     async with CPHarness(sync_wait_timeout=0.3) as h:
         await h.register_agent()

@@ -3,7 +3,12 @@ execution credentials and workflow chains over the live API."""
 
 import pytest
 
-from agentfield_tpu.control_plane.identity import (
+pytest.importorskip(
+    "cryptography",
+    reason="DID/VC identity layer needs the 'cryptography' package",
+)
+
+from agentfield_tpu.control_plane.identity import (  # noqa: E402
     DIDService,
     Keystore,
     VCService,

@@ -195,6 +195,276 @@ def test_exact_prompt_match_reuses_cache(params):
     assert out2 == _run(fresh, "b", prompt)
 
 
+# ---------------------------------------------------------------------------
+# cross-request shared-prefix cache (refcounted, content-addressed pages)
+# ---------------------------------------------------------------------------
+
+
+def _no_cache(ecfg):
+    return dataclasses_replace(ecfg, enable_prefix_cache=False)
+
+
+def test_prefix_pool_refcount_publish_lookup_evict():
+    """PrefixPagePool unit invariants: content addressing, refcounts,
+    LRU eviction only at refcount 0, over-free detection."""
+    from agentfield_tpu.serving.kv_cache import PrefixPagePool
+
+    pool = PrefixPagePool(10, page_size=4)
+    pages = pool.alloc(2)
+    assert pages is not None and all(pool.refcount(p) == 1 for p in pages)
+    toks = list(range(8))
+    assert pool.publish(toks, pages) == 2
+    assert pool.cached_pages == 2 and pool.is_shared(pages[0])
+    got, n = pool.lookup(toks)
+    assert got == pages and n == 8
+    assert pool.refcount(pages[0]) == 2 and pool.shared_pages == 2
+    # a 7-token lookup matches only the first FULL page
+    got1, n1 = pool.lookup(toks[:7])
+    assert got1 == pages[:1] and n1 == 4
+    # divergent content at page 2 breaks the chain after page 1
+    got2, n2 = pool.lookup(toks[:4] + [99, 98, 97, 96])
+    assert got2 == pages[:1] and n2 == 4
+    pool.free(got + got1 + got2 + pages)
+    assert pool.free_pages == 9  # refcount-0 cached pages stay allocatable
+    # allocation pressure evicts cached pages (refcount 0) LRU
+    big = pool.alloc(9)
+    assert big is not None and pool.cached_pages == 0
+    assert pool.stats["prefix_pages_evicted"] == 2
+    pool.free(big)
+    with pytest.raises(ValueError):
+        pool.free([big[0]])  # over-free
+    with pytest.raises(ValueError):
+        pool.free([0])  # reserved page
+
+
+def test_cross_request_prefix_reuse_is_logit_exact(params):
+    """A second, sessionless request sharing a multi-page prefix reuses the
+    first request's pages (suffix-only prefill) and emits exactly the tokens
+    a cache-free engine would."""
+    shared = _prompt(50, 24)  # 3 full pages at page_size 8
+    tail_b = _prompt(52, 5)
+    engine = InferenceEngine(params, CFG, ECFG)
+    _run(engine, "a", shared + _prompt(51, 4))
+    pre = engine.stats["prefill_tokens"]
+    out_b = _run(engine, "b", shared + tail_b)
+    assert engine.stats["prefix_index_hits"] == 1
+    assert engine.stats["prefix_tokens_reused"] >= 24
+    # only the unshared suffix prefilled: (24+5) prompt - 24 matched
+    assert engine.stats["prefill_tokens"] == pre + 5
+    fresh = InferenceEngine(params, CFG, _no_cache(ECFG))
+    assert out_b == _run(fresh, "b", shared + tail_b), "shared-prefix reuse changed outputs"
+
+
+def test_shared_prefix_burst_hit_rate_and_deferral(params):
+    """An 8-request burst sharing a 2-page prefix: the first admission
+    publishes, batch-mates defer one tick instead of re-prefilling, and the
+    rest hit — hit rate >= 7/8, all outputs oracle-exact."""
+    ecfg = EngineConfig(
+        max_batch=8, page_size=8, num_pages=128, max_pages_per_seq=8, prefill_batch=4
+    )
+    shared = _prompt(60, 16)
+    tails = [_prompt(70 + i, 3) for i in range(8)]
+    mk = lambda pfx: [  # noqa: E731
+        Request(
+            id=f"{pfx}{i}",
+            prompt=shared + tails[i],
+            sampling=SamplingParams(max_new_tokens=3),
+        )
+        for i in range(8)
+    ]
+    engine = InferenceEngine(params, CFG, ecfg)
+    res = engine.run_to_completion(mk("r"))
+    hits, misses = engine.stats["prefix_index_hits"], engine.stats["prefix_index_misses"]
+    assert hits + misses == 8 and hits >= 7
+    assert engine.stats["prefix_batch_deferrals"] >= 1
+    fresh = InferenceEngine(params, CFG, _no_cache(ecfg))
+    expected = fresh.run_to_completion(mk("r"))
+    assert res == expected, "burst outputs diverged from the cache-free engine"
+
+
+def test_cow_on_shared_page_full_prompt_retry(params):
+    """A client retry of an exact prompt re-prefills the final prompt token
+    INTO a page that is now content-addressed: the engine must unshare the
+    page (here: sole holder, so the stale index mapping is dropped and the
+    page written in place) instead of writing a shared page, and stay exact."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    prompt = _prompt(80, 8)  # exactly one full page at page_size 8
+    _run(engine, "a", prompt, session="retry")
+    out2 = _run(engine, "b", prompt, session="retry")
+    assert engine.stats["prefix_pages_unpublished"] >= 1
+    assert engine.stats["prefix_cache_hits"] == 1
+    fresh = InferenceEngine(params, CFG, _no_cache(ECFG))
+    assert out2 == _run(fresh, "b", prompt)
+
+
+def test_session_rewrite_does_not_corrupt_indexed_pages(params):
+    """Regression: a session retry that rewinds INTO published history must
+    not leave those pages in the index while decode overwrites them — a
+    later request matching the OLD chain would silently attend over
+    corrupted KV. (The rewriter samples at temperature>0 so the rewritten
+    content genuinely differs from the indexed chain.)"""
+    engine = InferenceEngine(params, CFG, ECFG)
+    prompt = _prompt(95, 8)
+    out1 = _run(engine, "a", prompt, max_new=10, session="s")  # cached = 17 tokens
+    cached = prompt + out1[:-1]
+    assert len(cached) == 17  # two FULL published pages + a partial third
+    held_before = ECFG.num_pages - 1 - engine.allocator.free_pages
+    # rewind: same session, prompt = first 9 tokens of the cached history,
+    # sampled — its decode writes DIFFERENT tokens over positions 9..15
+    engine.run_to_completion(
+        [
+            Request(
+                id="rw",
+                prompt=cached[:9],
+                sampling=SamplingParams(max_new_tokens=6, temperature=1.0),
+                session_id="s",
+            )
+        ]
+    )
+    assert engine.stats["prefix_pages_unpublished"] >= 1
+    # the rewind's budget is 2 pages; the history's third page was released
+    # (no page leak from the shortened retry)
+    held_after = ECFG.num_pages - 1 - engine.allocator.free_pages
+    assert held_after <= held_before
+    # a third request extending the ORIGINAL chain must still be exact:
+    # the overwritten page may no longer be served from the index
+    probe = cached + _prompt(96, 2)
+    out3 = _run(engine, "c", probe, max_new=4)
+    fresh = InferenceEngine(params, CFG, _no_cache(ECFG))
+    assert out3 == _run(fresh, "c", probe, max_new=4), (
+        "stale index entry served overwritten KV"
+    )
+
+
+def test_cow_copies_page_held_by_concurrent_reader(params):
+    """When another LIVE request holds a reference to the page a session
+    rewrite wants to overwrite, the engine must copy (not just unpublish):
+    the reader keeps attending over the original page."""
+    ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8)
+    engine = InferenceEngine(params, CFG, ecfg)
+    prompt = _prompt(97, 8)
+    _run(engine, "a", prompt, max_new=4, session="s")  # page 0 published
+    # reader B: sessionless, matches page 0 via the index, stays ACTIVE
+    engine.submit(
+        Request(
+            id="b",
+            prompt=prompt + _prompt(98, 3),
+            sampling=SamplingParams(max_new_tokens=12),
+        )
+    )
+    results: dict = {}
+    while engine.stats["prefix_index_hits"] < 1:
+        for ev in engine.step():  # admit B (index hit increfs page 0)
+            results.setdefault(ev.request_id, []).append(ev.token)
+    # retry the session's exact prompt: page 0 now has refs > 1 → real COW
+    engine.submit(
+        Request(
+            id="c",
+            prompt=prompt,
+            sampling=SamplingParams(max_new_tokens=4),
+            session_id="s",
+        )
+    )
+    while engine.has_work():
+        for ev in engine.step():
+            results.setdefault(ev.request_id, []).append(ev.token)
+    assert engine.stats["prefix_cow_copies"] >= 1
+    fresh = InferenceEngine(params, CFG, _no_cache(ecfg))
+    fb = fresh.run_to_completion(
+        [
+            Request(
+                id="b",
+                prompt=prompt + _prompt(98, 3),
+                sampling=SamplingParams(max_new_tokens=12),
+            ),
+            Request(id="c", prompt=prompt, sampling=SamplingParams(max_new_tokens=4)),
+        ]
+    )
+    assert results["b"] == fb["b"], "reader's KV was corrupted by the rewrite"
+    assert results["c"] == fb["c"]
+
+
+def test_session_pages_reusable_cross_request_after_session_drop(params):
+    """Dropping a session decrefs its pages; the published full pages stay
+    content-addressed so OTHER requests still hit them."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    prompt = _prompt(81, 16)  # 2 full pages
+    _run(engine, "a", prompt + _prompt(82, 2), session="s")
+    assert engine.free_session("s")
+    out = _run(engine, "b", prompt + _prompt(83, 3))
+    assert engine.stats["prefix_index_hits"] == 1
+    fresh = InferenceEngine(params, CFG, _no_cache(ECFG))
+    assert out == _run(fresh, "b", prompt + _prompt(83, 3))
+
+
+def test_shared_prefix_disabled_knob(params):
+    """shared_prefix_cache=False keeps session reuse but turns off the
+    cross-request index entirely."""
+    ecfg = dataclasses_replace(ECFG, shared_prefix_cache=False)
+    engine = InferenceEngine(params, CFG, ecfg)
+    shared = _prompt(85, 16)
+    _run(engine, "a", shared + _prompt(86, 3))
+    _run(engine, "b", shared + _prompt(87, 3))
+    assert engine.stats["prefix_index_hits"] == 0
+    assert engine.allocator.cached_pages == 0
+    # session reuse still works
+    t1 = shared + _prompt(88, 2)
+    out1 = _run(engine, "c", t1, session="sess")
+    t2 = t1 + out1 + _prompt(89, 2)
+    _run(engine, "d", t2, session="sess")
+    assert engine.stats["prefix_cache_hits"] == 1
+
+
+def test_cache_aware_admission_prefers_longest_cached_prefix(params):
+    """With a cold and a cache-hit request pending in the same tick, the hit
+    admits first (suffix prefill, small bucket) even from behind the head."""
+    ecfg = EngineConfig(
+        max_batch=4, page_size=8, num_pages=128, max_pages_per_seq=8, prefill_batch=4
+    )
+    engine = InferenceEngine(params, CFG, ecfg)
+    shared = _prompt(90, 24)
+    _run(engine, "seed", shared + _prompt(91, 3))
+    engine.submit(
+        Request(id="cold", prompt=_prompt(92, 20), sampling=SamplingParams(max_new_tokens=3))
+    )
+    engine.submit(
+        Request(id="hot", prompt=shared + _prompt(93, 4), sampling=SamplingParams(max_new_tokens=3))
+    )
+    first = engine.step()
+    assert [e.request_id for e in first] == ["hot"], "cache hit should admit first"
+    assert engine.stats["admission_reorders"] >= 1
+    results = {e.request_id: [e.token] for e in first}
+    while engine.has_work():
+        for ev in engine.step():
+            results.setdefault(ev.request_id, []).append(ev.token)
+    assert len(results["cold"]) == 3 and len(results["hot"]) == 3
+
+
+def test_engine_stats_exported_to_prometheus():
+    """Prefix-cache counters ride heartbeat stats into per-node /metrics
+    gauges (control_plane.metrics.export_engine_stats)."""
+    from agentfield_tpu.control_plane.metrics import Metrics, export_engine_stats
+
+    m = Metrics()
+    n = export_engine_stats(
+        m,
+        "model-1",
+        {
+            "prefix_index_hits": 5,
+            "prefix_index_misses": 1,
+            "prefix_pages_evicted": 2,
+            "prefix_shared_pages": 7,
+            "model": "llama-tiny",  # non-numeric: skipped
+        },
+    )
+    assert n == 4
+    text = m.render()
+    assert '# TYPE agentfield_engine_prefix_index_hits gauge' in text
+    assert 'agentfield_engine_prefix_index_hits{node="model-1"} 5.0' in text
+    assert 'agentfield_engine_prefix_shared_pages{node="model-1"} 7.0' in text
+    assert "model-1" not in text.replace('{node="model-1"}', "")  # label-escaped only
+
+
 def test_session_hit_probe_does_not_mutate_entry(params):
     """_session_hit must not mutate the cached entry: a page-starved admission
     restores the session, which must keep its full cached history."""

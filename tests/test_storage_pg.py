@@ -268,6 +268,33 @@ def test_pg_tls_require_and_verify_full():
         srv.stop()
 
 
+def test_pg_tls_require_with_rootcert_verifies():
+    """sslmode=require with an explicit sslrootcert must VERIFY the chain
+    against it (libpq verify-ca semantics) — the right CA connects, a wrong
+    CA is rejected instead of silently skipping verification."""
+    import ssl
+
+    srv = FakePgServer(tls=True).start()
+    other = FakePgServer(tls=True).start()  # its cert is the "wrong" CA
+    try:
+        c = PgClient(
+            port=srv.port, password="hunter2", sslmode="require",
+            sslrootcert=srv.tls_cert,
+        )
+        assert c.tls
+        _, rows, _ = c.query("SELECT 'ok' AS a")
+        assert rows == [["ok"]]
+        c.close()
+        with pytest.raises((ssl.SSLError, ConnectionError)):
+            PgClient(
+                port=srv.port, password="hunter2", sslmode="require",
+                sslrootcert=other.tls_cert,
+            )
+    finally:
+        srv.stop()
+        other.stop()
+
+
 def test_pg_tls_modes_and_fallbacks():
     from agentfield_tpu.control_plane.pgwire import parse_dsn
 

@@ -134,6 +134,9 @@ async def test_node_summaries_and_details():
 
 @async_test
 async def test_credentials_persist_and_page():
+    pytest.importorskip(
+        "cryptography", reason="VC issuance needs the DID/VC identity layer"
+    )
     async with CPHarness() as h:
         await h.register_agent()
         # run an execution, issue its VC, expect it in the explorer

@@ -13,6 +13,17 @@ Same online-softmax page walk as the decode kernel, widened to W query rows
 per sequence and indexed per-batch-row through scalar-prefetched page
 tables. Pages wholly past a row's keys (or wholly before its sliding
 window) are skipped.
+
+The mixed token-budget scheduler (docs/MIXED_SCHEDULING.md) drives this
+kernel at W=1: every packed token — a decode token or one token of a
+prefill chunk — is its own n_tokens=1 ragged row with its own page table,
+start and key count. W=1 rows are the cheap corner of the row loop: the
+q/o block collapses to (1, 1, 1, rep, hd), the scratch accumulator to
+(rep, hd), and the per-page `relevant` predicate skips every page past the
+row's keys, so a decode row touches exactly ceil((start+1)/ps) pages — the
+same page traffic as the dedicated decode kernel, with no W-wide padding
+compute. ``paged_batch_chunk_attention_ref`` below is the XLA reference
+for parity tests and CPU/debug fallback.
 """
 
 from __future__ import annotations
@@ -25,6 +36,52 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def paged_batch_chunk_attention_ref(
+    q: jax.Array,  # [B, W, H, hd] — W query tokens per sequence
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, maxp] int32
+    starts: jax.Array,  # [B] int32 — absolute position of q[:, 0]
+    k_lens: jax.Array,  # [B] int32 — valid keys per row (0 = inactive row)
+    sm_scale: float | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """XLA reference for the batched ragged chunk kernel: gathers each row's
+    pages into [B, T] context and runs masked-softmax attention. Semantics
+    match the kernel exactly — per-row causal masking against absolute
+    positions, sliding window, and zeros for inactive (k_lens == 0) rows —
+    so it serves both as the parity oracle in tests and as the engine's
+    chunk-attention path on backends without the kernel."""
+    B, W, H, hd = q.shape
+    P, Kh, ps, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    T = maxp * ps
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+    # [B, maxp, Kh, ps, hd] → [B, T, Kh, hd]
+    k = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, Kh, hd)
+    v = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, Kh, hd)
+    qg = q.reshape(B, W, Kh, rep, hd)
+    logits = jnp.einsum(
+        "bwkrh,btkh->bkrwt", qg, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None, None]  # [1, 1, T]
+    q_pos = starts[:, None] + jnp.arange(W, dtype=jnp.int32)[None]  # [B, W]
+    keep = (k_pos <= q_pos[..., None]) & (k_pos < k_lens[:, None, None])
+    if window is not None:  # HF Mistral semantics (attention_ref)
+        keep = keep & (k_pos > q_pos[..., None] - window)
+    logits = jnp.where(keep[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkrwt,btkh->bwkrh", probs, v, preferred_element_type=jnp.float32
+    ).reshape(B, W, H, hd)
+    # inactive rows return zeros like the kernel's un-accumulated finalize
+    return jnp.where((k_lens > 0)[:, None, None, None], out, 0.0).astype(q.dtype)
 
 
 def _batch_chunk_kernel(

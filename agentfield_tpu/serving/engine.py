@@ -46,6 +46,7 @@ from agentfield_tpu.serving.kv_cache import (
     PagedKVCache,
     PrefixPagePool,
     build_page_table,
+    pack_ragged_rows,
     page_chain_hashes,
 )
 from agentfield_tpu.serving.sampler import SamplingParams, sample_tokens
@@ -145,6 +146,26 @@ class EngineConfig:
     # host's device→host round trip (token events arrive one tick later;
     # greedy streams are bit-identical either way). False restores the
     # dispatch-and-wait scheduler.
+    mixed_step: bool | str = False  # token-budget MIXED scheduling
+    # (docs/MIXED_SCHEDULING.md): when prompts arrive while decodes are in
+    # flight, one jitted ragged forward per tick packs ONE decode token per
+    # active slot plus up to (mixed_step_budget - n_active) prefill-chunk
+    # tokens from admitting requests — chunked prefill piggybacks on decode
+    # (Sarathi-style), so prompt bursts stop freezing in-flight decodes and
+    # long prompts stop delaying admission. Worst-case inter-token latency is
+    # bounded by the budget, not the longest prompt. "auto" enables it when
+    # speculative decoding is off (spec decode owns its ticks); False
+    # preserves the classic prefill-XOR-decode tick bit-for-bit. Mixed ticks
+    # pause (classic ticks resume) while any grammar-constrained request is
+    # active — the decode-step grammar mask is a classic-tick feature.
+    mixed_step_budget: int = 512  # tokens per mixed tick (decode rows +
+    # prefill-chunk rows, padded to this static shape — ONE compile per
+    # budget instead of a prefill-bucket x decode-bucket matrix). Must be
+    # >= max_batch + 16 so a full decode batch still leaves chunk room.
+    compile_cache_dir: str | None = None  # persistent JAX compilation cache
+    # (jax_compilation_cache_dir): warm restarts skip the multi-second
+    # compile gate. None falls back to $AGENTFIELD_COMPILE_CACHE; empty/unset
+    # leaves the cache off. Logged (entries found = warm) at engine startup.
     spec_k: int = 0  # speculative decoding: draft proposals per step (0
     # disables). Requires a draft model (InferenceEngine(draft=...)). Each
     # eligible step a small draft model proposes spec_k greedy tokens and the
@@ -164,6 +185,15 @@ class EngineConfig:
         while b < n:
             b *= 2
         return min(b, self.max_context)
+
+    def mixed_bucket(self, n: int) -> int:
+        """Padded width of a mixed tick carrying n real tokens: powers of two
+        from 16, capped at the budget (a lightly loaded tick — few decodes, a
+        short chunk tail — pays a small forward, not the full budget)."""
+        b = 16
+        while b < min(n, self.mixed_step_budget):
+            b *= 2
+        return min(b, self.mixed_step_budget)
 
 
 @dataclasses.dataclass
@@ -213,6 +243,8 @@ class _Slot:
     # only; before the next spec step the gap replays through the draft —
     # without this, a single sampled request joining the batch would
     # permanently collapse the acceptance rate)
+    last_emit_t: float = 0.0  # wall time of this slot's last emitted token
+    # (perf_counter): feeds the engine's inter-token-latency window
 
 
 @dataclasses.dataclass
@@ -220,6 +252,25 @@ class _SessionEntry:
     pages: list[int]
     tokens: list[int]  # tokens whose KV is resident (prompt + generated[:-1])
     last_used: float
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """An admitting request whose prompt prefills CHUNK BY CHUNK across mixed
+    ticks (docs/MIXED_SCHEDULING.md). The job owns its pages (acquired with
+    the same session/shared-prefix machinery as classic admission — the
+    cached-prefix hoist decides ``start``) and reserves one decode slot by
+    count (``_slots_available``); it installs into a concrete slot only when
+    the final prompt token's logits come back."""
+
+    req: Request
+    pages: list[int]
+    row: Any  # np.ndarray page-table row [max_pages_per_seq]
+    start: int  # cached-prefix length: prefill begins here
+    pos: int  # next absolute position to prefill (== start at creation)
+    lead_hash: bytes | None = None  # chain hash of the prompt's first full
+    # page: pending requests sharing it defer until this job publishes at
+    # install, instead of redundantly re-prefilling the same prefix
 
 
 def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
@@ -357,6 +408,41 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     return jax.jit(decode, donate_argnums=(1, 2))
 
 
+def _ragged_chunk_attn_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
+    """Batched ragged chunk attention dispatch shared by the speculative
+    verify forward and the mixed token-budget tick: the pallas kernel
+    (interpret-mode on CPU backends), under shard_map over the KV-head axis
+    when the mesh is tensor-parallel. Returns a callable
+    ``(q [B,W,H,hd], k_pages, v_pages, page_tables, starts, k_lens)``."""
+    from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
+        paged_batch_chunk_attention_pallas,
+    )
+
+    fn = functools.partial(
+        paged_batch_chunk_attention_pallas,
+        interpret=jax.default_backend() == "cpu",
+        window=_binding_window(cfg, ecfg),
+    )
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from agentfield_tpu.parallel.mesh import AXIS_MODEL
+        from agentfield_tpu.parallel.mesh import shard_map  # version compat
+
+        if mesh.shape.get(AXIS_MODEL, 1) > 1:
+            fn = shard_map(
+                fn, mesh=mesh,
+                in_specs=(
+                    P(None, None, AXIS_MODEL, None),  # q [B,W,H,hd]
+                    P(None, AXIS_MODEL, None, None),  # pages on Kh
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, None), P(None), P(None),
+                ),
+                out_specs=P(None, None, AXIS_MODEL, None),
+            )
+    return fn
+
+
 @functools.lru_cache(maxsize=None)
 def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     """Jitted speculative decode step with PER-ROW verification modes: the
@@ -462,34 +548,9 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
             """Verify attention over the paged cache: the batched chunk
             kernel streams each row's pages HBM→VMEM (chunk_attn_impl=
             "pallas"); the ref path gathers [B, T] context per layer."""
-            from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
-                paged_batch_chunk_attention_pallas,
+            return _ragged_chunk_attn_fn(cfg, ecfg, mesh)(
+                q, kp, vp, page_tables, seq_lens, k_lens
             )
-
-            fn = functools.partial(
-                paged_batch_chunk_attention_pallas,
-                interpret=jax.default_backend() == "cpu",
-                window=_binding_window(cfg, ecfg),
-            )
-            if mesh is not None:
-                from jax.sharding import PartitionSpec as P
-                from jax.experimental.shard_map import shard_map
-
-                from agentfield_tpu.parallel.mesh import AXIS_MODEL
-
-                if mesh.shape.get(AXIS_MODEL, 1) > 1:
-                    fn = shard_map(
-                        fn, mesh=mesh,
-                        in_specs=(
-                            P(None, None, AXIS_MODEL, None),  # q [B,W,H,hd]
-                            P(None, AXIS_MODEL, None, None),  # pages on Kh
-                            P(None, AXIS_MODEL, None, None),
-                            P(None, None), P(None), P(None),
-                        ),
-                        out_specs=P(None, None, AXIS_MODEL, None),
-                        check_rep=False,
-                    )
-            return fn(q, kp, vp, page_tables, seq_lens, k_lens)
 
         def body(x, xs):
             lp, kp, vp = xs
@@ -770,6 +831,122 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+@functools.lru_cache(maxsize=None)
+def _mixed_step_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
+    """Jitted MIXED token-budget tick (docs/MIXED_SCHEDULING.md): ONE ragged
+    forward over ``mixed_step_budget`` packed tokens, each its own
+    n_tokens=1 row — decode tokens (one per active slot, at its sequence's
+    next position) and prefill-chunk tokens (consecutive positions of an
+    admitting prompt) ride the same batched ragged chunk attention
+    (paged_batch_chunk_kernel; decode rows walk exactly their pages). KV
+    scatters into the paged pool through the same multi-row kv_write the
+    decode step uses; per-token ``k_lens`` (position+1, or 0 for padding)
+    gives causal masking within a chunk for free since a chunk's KV lands
+    before its attention each layer. Every position's logits are sampled
+    with per-token params (the host reads only the rows it needs: decode
+    rows, and a chunk's last token when it completes the prompt). One
+    compile per ``bucket`` (EngineConfig.mixed_bucket widths up to the
+    budget) — the whole prefill-bucket x decode-bucket matrix collapses to
+    this one ladder."""
+    ps = ecfg.page_size
+    maxp = ecfg.max_pages_per_seq
+    N = bucket
+
+    def chunk_attn(q, kp, vp, page_tables, starts, k_lens):
+        # q: [N, 1, H, hd] — n_tokens=1 rows through the ragged chunk path
+        if ecfg.chunk_attn_impl != "pallas":
+            from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
+                paged_batch_chunk_attention_ref,
+            )
+
+            return paged_batch_chunk_attention_ref(
+                q, kp, vp, page_tables, starts, k_lens,
+                window=_binding_window(cfg, ecfg),
+            )
+        return _ragged_chunk_attn_fn(cfg, ecfg, mesh)(
+            q, kp, vp, page_tables, starts, k_lens
+        )
+
+    def mixed(
+        params, k_pages, v_pages, tokens, positions, page_tables, k_lens,
+        rng, temps, top_ks, top_ps,
+    ):
+        # tokens/positions/k_lens: [N]; page_tables: [N, maxp] — one page
+        # table ROW per token (decode rows repeat their slot's row; chunk
+        # rows repeat their job's row). k_lens == 0 marks padding.
+        active = k_lens > 0
+        x = llama.embed_tokens(params, cfg, tokens)[:, None, :]  # [N,1,D]
+        cos, sin = llama.rope_sincos(
+            positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        lookup = positions // ps
+        in_table = (lookup < maxp) & active
+        page_idx = jnp.where(
+            in_table,
+            jnp.take_along_axis(
+                page_tables, jnp.minimum(lookup, maxp - 1)[:, None], axis=1
+            )[:, 0],
+            0,
+        )  # [N] (garbage page 0 for padding/over-budget writes)
+        slot_idx = positions % ps
+
+        def body(x, xs):
+            lp, kp, vp = xs
+            h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
+            # Multi-row ragged scatter: token i's K/V land at
+            # (page_idx[i], slot_idx[i]). A prefill chunk writes MULTIPLE
+            # slots of the same page in this one call — the pallas kv_write
+            # kernel's per-row copy-then-patch assumes decode's one-write-
+            # per-page invariant and would keep only the last row's slot, so
+            # mixed ticks always use the exact XLA scatter (distinct
+            # (page, slot) pairs; kv_write_impl governs the decode step only).
+            kp, vp = kv_write(
+                kp, vp, k[:, 0], v[:, 0], page_idx, slot_idx,
+                impl="ref", mesh=mesh,
+            )
+            attn = chunk_attn(q, kp, vp, page_tables, positions, k_lens)
+            x = x + (attn.reshape(N, 1, -1) @ lp["wo"]).astype(x.dtype)
+            x = x + llama.mlp_block(lp, x, cfg)
+            return x, (kp, vp)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], k_pages, v_pages))
+        logits = llama.unembed(params, cfg, x)[:, 0]  # [N, V]
+        toks = sample_tokens(logits, rng, temps, top_ks, top_ps)
+        lps = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), toks[:, None], axis=-1
+        )[:, 0]
+        return toks, lps, kp, vp
+
+    return jax.jit(mixed, donate_argnums=(1, 2))
+
+
+def _setup_compile_cache(ecfg: EngineConfig) -> None:
+    """Wire the persistent JAX compilation cache (warm restarts skip the
+    multi-second compile gate). Resolution: EngineConfig.compile_cache_dir,
+    else $AGENTFIELD_COMPILE_CACHE, else leave jax's current setting alone
+    (tests point it at their own directory). Logs the entry count found —
+    a nonzero count at startup means the restart is warm."""
+    import os
+
+    path = ecfg.compile_cache_dir or os.environ.get("AGENTFIELD_COMPILE_CACHE")
+    if not path:
+        return
+    try:
+        entries = len(os.listdir(path)) if os.path.isdir(path) else 0
+    except OSError:
+        entries = 0
+    jax.config.update("jax_compilation_cache_dir", path)
+    from agentfield_tpu.logging import get_logger
+
+    get_logger("engine").info(
+        "jax compilation cache enabled",
+        dir=path,
+        entries_found=entries,
+        warm=entries > 0,
+    )
+
+
 class QueueFullError(Exception):
     """Admission queue at capacity — surfaced as backpressure (the reference
     returns HTTP 503 from the async gateway, execute.go:333-346)."""
@@ -828,6 +1005,32 @@ class InferenceEngine:
             )
         if self.ecfg.decode_span < 1:
             raise ValueError(f"decode_span={self.ecfg.decode_span} must be >= 1")
+        if self.ecfg.mixed_step not in (True, False, "auto"):
+            raise ValueError(
+                f"mixed_step={self.ecfg.mixed_step!r} must be True, False, "
+                "or 'auto'"
+            )
+        if self.ecfg.mixed_step == "auto":
+            # Speculative decode owns its ticks (draft+verify is already a
+            # multi-token dispatch); auto turns mixing on everywhere else.
+            self.ecfg = dataclasses.replace(
+                self.ecfg, mixed_step=self.ecfg.spec_k == 0
+            )
+        if self.ecfg.mixed_step and self.ecfg.spec_k > 0:
+            raise ValueError(
+                "mixed_step=True is incompatible with spec_k > 0 "
+                "(speculative decoding owns the tick); use mixed_step='auto' "
+                "to fall back automatically"
+            )
+        if self.ecfg.mixed_step and (
+            self.ecfg.mixed_step_budget < self.ecfg.max_batch + 16
+        ):
+            raise ValueError(
+                f"mixed_step_budget={self.ecfg.mixed_step_budget} must be >= "
+                f"max_batch+16={self.ecfg.max_batch + 16}: a full decode "
+                "batch must still leave prefill-chunk room in the tick"
+            )
+        _setup_compile_cache(self.ecfg)
         if self.ecfg.max_pages_per_seq > self.ecfg.num_pages - 1:
             raise ValueError(
                 f"max_pages_per_seq={self.ecfg.max_pages_per_seq} cannot exceed "
@@ -972,6 +1175,10 @@ class InferenceEngine:
             "spec_steps": 0,  # speculative dispatches
             "spec_emitted": 0,  # tokens emitted by them (rate = emitted /
             # (steps * (spec_k+1)))
+            # Mixed token-budget scheduling (docs/MIXED_SCHEDULING.md):
+            "mixed_ticks": 0,  # ticks that ran the packed ragged forward
+            "mixed_tokens": 0,  # real tokens those ticks carried (decode +
+            # prefill-chunk; utilization = mixed_tokens / (ticks * budget))
             # Cross-request shared-prefix cache (kv_cache.PrefixPagePool):
             "prefix_index_hits": 0,  # admissions that reused indexed pages
             "prefix_index_misses": 0,  # matchable fresh admissions that found none
@@ -1052,6 +1259,17 @@ class InferenceEngine:
         # Consecutive ticks the queue head has been page-starved while later
         # requests admitted (see _try_admit's fairness fence).
         self._head_starved_ticks = 0
+        # Mixed scheduling: admitting requests mid-chunked-prefill. Each job
+        # reserves one decode slot BY COUNT (_slots_available) and installs
+        # into a concrete slot when its prompt completes.
+        self._prefill_jobs: list[_PrefillJob] = []
+        # Scheduler-latency telemetry (scheduler_stats): rolling windows of
+        # inter-token arrival gaps (seconds) and per-dispatch token counts.
+        # The lock serializes worker-thread appends against event-loop reads
+        # (heartbeats, /stats) — iterating a deque mid-append raises.
+        self._telemetry_lock = threading.Lock()
+        self._itl_window: collections.deque[float] = collections.deque(maxlen=4096)
+        self._tick_tokens: collections.deque[int] = collections.deque(maxlen=1024)
 
     # ------------------------------------------------------------------
     # host-side scheduling
@@ -1308,7 +1526,19 @@ class InferenceEngine:
         return sum(s is not None for s in self.slots)
 
     def has_work(self) -> bool:
-        return bool(self.pending) or self.num_active > 0 or self._inflight is not None
+        return (
+            bool(self.pending)
+            or self.num_active > 0
+            or self._inflight is not None
+            or bool(self._prefill_jobs)
+        )
+
+    def _slots_available(self) -> int:
+        """Free decode slots not reserved by in-flight prefill jobs: a job
+        must always find a slot when its prompt completes, so admission (and
+        new jobs) only claim what the jobs have not."""
+        free = sum(s is None for s in self.slots)
+        return free - len(self._prefill_jobs)
 
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
@@ -1407,32 +1637,36 @@ class InferenceEngine:
         fence whenever it bypasses the head."""
         if not self.pending:
             return []
-        N = max(1, self.ecfg.prefill_batch)
+        avail = self._slots_available()  # free slots minus prefill-job
+        # reservations (mixed scheduling): a completing job must always find
+        # a slot, so classic admission never claims the reserved count
+        if avail <= 0:
+            return []
+        N = min(max(1, self.ecfg.prefill_batch), avail)
         window = max(1, self.ecfg.admit_window)
         if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
             window = 1  # anti-starvation fence: freed pages go to the head
-        if any(s is None for s in self.slots):
-            with self._pending_lock:
-                cands = [self.pending[i] for i in range(min(window, len(self.pending)))]
-            best = None  # (cached_len, window index, req)
-            for i, req in enumerate(cands):
-                cl = self._cached_prefix_len(req)
-                if cl > 0 and (best is None or cl > best[0]):
-                    best = (cl, i, req)
-            if best is not None:
-                _, i, req = best
-                free_slot = next(j for j, s in enumerate(self.slots) if s is None)
-                single = self._admit_single(req, free_slot)
-                if single:
-                    if i > 0:
-                        self.stats["admission_reorders"] += 1
-                        # bypassing the head ages the anti-starvation fence
-                        self._head_starved_ticks += 1
-                    else:
-                        self._head_starved_ticks = 0
-                    return single
-                # starved even with its cached pages: fall through to the
-                # FIFO scan, which skips it like any starved single
+        with self._pending_lock:
+            cands = [self.pending[i] for i in range(min(window, len(self.pending)))]
+        best = None  # (cached_len, window index, req)
+        for i, req in enumerate(cands):
+            cl = self._cached_prefix_len(req)
+            if cl > 0 and (best is None or cl > best[0]):
+                best = (cl, i, req)
+        if best is not None:
+            _, i, req = best
+            free_slot = next(j for j, s in enumerate(self.slots) if s is None)
+            single = self._admit_single(req, free_slot)
+            if single:
+                if i > 0:
+                    self.stats["admission_reorders"] += 1
+                    # bypassing the head ages the anti-starvation fence
+                    self._head_starved_ticks += 1
+                else:
+                    self._head_starved_ticks = 0
+                return single
+            # starved even with its cached pages: fall through to the
+            # FIFO scan, which skips it like any starved single
         batch: list[tuple[Request, int, list[int]]] = []  # (req, slot, pages)
         batch_chains: set[bytes] = set()  # leading-page chain hashes in `batch`
         claimed: set[int] = set()
@@ -1586,18 +1820,28 @@ class InferenceEngine:
         toks_np, lps_np = np.asarray(toks), np.asarray(lps)
         self.stats["prefill_tokens"] += int(lengths.sum())
         self.stats["prefill_batches"] += 1
+        with self._telemetry_lock:
+            self._tick_tokens.append(int(lengths.sum()))
         return [
             self._install(req, slot_idx, pages, row_tables[j], int(toks_np[j]), float(lps_np[j]))
             for j, (req, slot_idx, pages) in enumerate(batch)
         ]
 
-    def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
-        """Single-request admission: session prefix-cache reuse, cross-request
-        shared-prefix reuse (both suffix-only prefill) and chunked long
-        prompts flow through here."""
+    def _acquire_pages_locked(
+        self, req: Request
+    ) -> tuple[list[int], int, str] | None:
+        """Page acquisition for ONE request (caller holds the session lock):
+        session prefix hit (with copy-on-write privatization), cross-request
+        shared-prefix lookup, or fresh allocation. Returns ``(pages, start,
+        kind)`` with ``kind`` in {"session", "index", "fresh"} and ``start``
+        the cached-prefix length prefill skips, or None on page starvation
+        (all acquisition state restored; the caller retries a later tick).
+        Shared by classic single-request admission and mixed-scheduling
+        prefill-job creation (docs/MIXED_SCHEDULING.md), so the two paths
+        cannot drift on cache/COW semantics."""
         ps = self.ecfg.page_size
         index_hit = False
-        with self._session_lock:
+        with self._session_lock:  # RLock: callers may already hold it
             hit = self._session_hit(req)
             total_pages = self._pages_needed(req)
 
@@ -1610,9 +1854,8 @@ class InferenceEngine:
                 extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
                 if extra is None:
                     self._sessions[req.session_id] = sess  # restore; retry later
-                    return []  # page-starved; decode will free pages
+                    return None  # page-starved; decode will free pages
                 pages = sess.pages + extra
-                suffix = req.prompt[start:]
                 # Copy-on-write: this request will WRITE every page from
                 # start//ps onward (suffix re-prefill from `start`, then
                 # decode past the prompt). Indexed pages are immutable and
@@ -1645,7 +1888,7 @@ class InferenceEngine:
                         if extra:
                             self.allocator.free(extra)
                         self._sessions[req.session_id] = sess
-                        return []  # page-starved; retry later
+                        return None  # page-starved; retry later
                     for k, new_page in zip(cow_idx, fresh):
                         if k == widx0 and start % ps:
                             # the only page whose prior slots (< start) this
@@ -1682,36 +1925,52 @@ class InferenceEngine:
                     extra = self._alloc_with_eviction(extra_needed) if extra_needed > 0 else []
                     if extra is None:
                         self.allocator.free(matched)  # drop refs; retry later
-                        return []
+                        return None
                     pages = matched + extra
-                    suffix = req.prompt[start:]
                     index_hit = True
                 else:
                     pages = self._alloc_with_eviction(total_pages)
                     if pages is None:
-                        return []
-                    suffix = req.prompt
+                        return None
                     if self._shared_prefix and len(req.prompt) > ps:
                         self.stats["prefix_index_misses"] += 1
-        with self._pending_lock:
-            self.pending.remove(req)  # by identity: the fairness window may
-            # admit from behind a page-starved head, not just pending[0]
-        self._req_hashes.pop(req.id, None)
+        kind = "session" if hit is not None else ("index" if index_hit else "fresh")
+        return pages, start, kind
 
-        row = build_page_table(pages, self.ecfg.max_pages_per_seq)
-        if hit is not None:
+    def _dequeue_acquired(self, req: Request, kind: str, start: int) -> None:
+        """Post-acquisition bookkeeping shared by the classic single path and
+        mixed job creation: the request leaves the pending queue (by
+        identity — the fairness window may admit from behind a page-starved
+        head) and its cache hit, if any, is counted."""
+        with self._pending_lock:
+            self.pending.remove(req)
+        self._req_hashes.pop(req.id, None)
+        if kind == "session":
             self.stats["prefix_cache_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
-        elif index_hit:
+        elif kind == "index":
             self.stats["prefix_index_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
+
+    def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
+        """Single-request admission: session prefix-cache reuse, cross-request
+        shared-prefix reuse (both suffix-only prefill) and chunked long
+        prompts flow through here."""
+        acq = self._acquire_pages_locked(req)
+        if acq is None:
+            return []  # page-starved; decode will free pages
+        pages, start, kind = acq
+        self._dequeue_acquired(req, kind, start)
+        row = build_page_table(pages, self.ecfg.max_pages_per_seq)
         if req.mm_embeds:
             # Whole-prompt injection prefill (chunking doesn't apply: the
             # inject buffer is positioned against the full prompt).
             last_logits = self._prefill_mm(req.prompt, row, req.mm_embeds)
         else:
-            last_logits = self._prefill(suffix, start, row)
-        self.stats["prefill_tokens"] += len(suffix)
+            last_logits = self._prefill(req.prompt[start:], start, row)
+        self.stats["prefill_tokens"] += len(req.prompt) - start
+        with self._telemetry_lock:
+            self._tick_tokens.append(len(req.prompt) - start)
         return [self._sample_first_and_install(req, free_slot, pages, row, last_logits)]
 
     def _sample_first_and_install(
@@ -1748,6 +2007,25 @@ class InferenceEngine:
                 self.draft_cache.k_pages, self.draft_cache.v_pages,
                 jnp.int32(src), jnp.int32(dst),
             )
+
+    def scheduler_stats(self) -> dict[str, float]:
+        """Scheduler-latency gauges (docs/MIXED_SCHEDULING.md): inter-token
+        arrival percentiles over a rolling window (the stall the mixed tick
+        bounds) and tokens carried per device dispatch. Exported on /stats,
+        heartbeats, and re-exported by the control plane as per-node
+        Prometheus gauges (metrics.export_engine_stats)."""
+        with self._telemetry_lock:
+            w = sorted(self._itl_window)
+            tt = list(self._tick_tokens)
+
+        def pct(p: float) -> float:
+            return w[min(len(w) - 1, int(len(w) * p))] * 1e3 if w else 0.0
+
+        return {
+            "itl_ms_p50": round(pct(0.50), 3),
+            "itl_ms_p99": round(pct(0.99), 3),
+            "tokens_per_tick": round(sum(tt) / len(tt), 2) if tt else 0.0,
+        }
 
     def prefix_cache_stats(self) -> dict[str, int]:
         """Gauges for the shared-prefix page pool (counters live in
@@ -1896,6 +2174,14 @@ class InferenceEngine:
     def _emit(
         self, slot_idx: int, slot: _Slot, tok: int, logprob: float | None = None
     ) -> TokenEvent:
+        # Inter-token latency: the gap between consecutive token ARRIVALS of
+        # one request, as a stream consumer would see them (span harvests
+        # deliver their tokens together — those near-zero gaps are real).
+        now = time.perf_counter()
+        if slot.last_emit_t > 0.0:
+            with self._telemetry_lock:
+                self._itl_window.append(now - slot.last_emit_t)
+        slot.last_emit_t = now
         s = slot.req.sampling
         reason = None
         if tok in s.stop_token_ids:
@@ -1984,6 +2270,13 @@ class InferenceEngine:
                     self._grammar_release(r.grammar)
             for r in dropped:
                 self._req_hashes.pop(r.id, None)
+        for job in [j for j in self._prefill_jobs if j.req.id in cancels]:
+            # Mid-prefill cancel (mixed scheduling): the job's pages hold a
+            # partial prompt — release them without publishing anything.
+            with self._session_lock:
+                self.allocator.free(job.pages)
+            self._prefill_jobs.remove(job)
+            self.stats["requests_cancelled"] += 1
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
                 # Incomplete output: release WITHOUT session retention.
@@ -2002,8 +2295,229 @@ class InferenceEngine:
                 self._compact = None
                 self.stats["requests_cancelled"] += 1
 
+    def _mixed_eligible(self, req: Request) -> bool:
+        """Mixed prefill jobs carry plain token prompts only: grammar
+        first-token masks and multimodal inject buffers are classic-tick
+        features (such requests still admit through the classic path)."""
+        return req.grammar is None and not req.mm_embeds
+
+    def _mixed_tick_ready(self) -> bool:
+        """Should this tick run the packed mixed dispatch? Yes while prefill
+        jobs are mid-prompt, or when prompts wait behind active decodes —
+        the head-of-line contention mixing exists to remove. Everything else
+        (idle-engine bursts → batched flash prefill, constrained traffic →
+        the grammar-masked decode step, empty queue → plain decode) falls
+        through to the classic paths unchanged."""
+        if not self.ecfg.mixed_step:
+            return False
+        for s in self.slots:
+            if s is not None and s.req.grammar is not None:
+                return False  # grammar mask is a classic-tick feature
+        if self._prefill_jobs:
+            return True
+        if not self.pending or self.num_active == 0:
+            return False
+        if self._slots_available() <= 0:
+            return False
+        with self._pending_lock:
+            head = self.pending[0] if self.pending else None
+        return head is not None and self._mixed_eligible(head)
+
+    def _start_mixed_jobs(self, room: int) -> None:
+        """Admit pending requests into chunked prefill jobs while the tick
+        has token room (``_acquire_pages_locked``'s cached-prefix probe
+        decides each job's chunk start, so session and shared-prefix hits
+        skip straight to their suffix).
+
+        Fairness mirrors ``_try_admit``: a page-starved (or mixed-ineligible)
+        head does not block the queue — the scan looks up to ``admit_window``
+        entries past it, bypasses age the same ``_head_starved_ticks`` fence,
+        and the fence collapses the window to strict FIFO so freed pages
+        reach the head first. Candidates whose leading page chain matches an
+        IN-FLIGHT job defer until that job publishes at install
+        (``prefix_batch_deferrals``) instead of re-prefilling the prefix."""
+        window = max(1, self.ecfg.admit_window)
+        if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
+            window = 1  # anti-starvation fence: freed pages go to the head
+            # (and a mixed-ineligible head drains the jobs — no new ones can
+            # start past it — until a classic tick can admit it)
+        job_leads = {j.lead_hash for j in self._prefill_jobs if j.lead_hash}
+        head = self.pending[0] if self.pending else None
+        head_blocked = False  # page-starved OR mixed-ineligible head
+        admitted_past_head = False
+        idx = 0
+        while room > 0 and self._slots_available() > 0 and idx < window:
+            with self._pending_lock:
+                if idx >= len(self.pending):
+                    break
+                req = self.pending[idx]
+            if not self._mixed_eligible(req):
+                # grammar/mm admit via classic ticks; scan past them like a
+                # starved entry. A blocked HEAD ages the fence below, so
+                # sustained mixed traffic cannot starve it: once the fence
+                # trips, no new jobs start and the job queue drains, letting
+                # a classic tick admit it.
+                head_blocked = head_blocked or req is head
+                idx += 1
+                continue
+            lead = None
+            if self._shared_prefix and len(req.prompt) > self.ecfg.page_size:
+                lead = self._prompt_hashes(req)[0]
+                if lead in job_leads:
+                    # an in-flight job is about to publish this same leading
+                    # page: defer until it installs, then hit the index
+                    self.stats["prefix_batch_deferrals"] += 1
+                    idx += 1
+                    continue
+            acq = self._acquire_pages_locked(req)
+            if acq is None:
+                head_blocked = head_blocked or req is head
+                idx += 1
+                continue  # page-starved: scan past it (decode frees pages)
+            pages, start, kind = acq
+            if kind != "fresh":
+                lead = None  # reused pages are already published/indexed
+            self._dequeue_acquired(req, kind, start)
+            row = build_page_table(pages, self.ecfg.max_pages_per_seq)
+            self._prefill_jobs.append(
+                _PrefillJob(
+                    req=req, pages=pages, row=row, start=start, pos=start,
+                    lead_hash=lead,
+                )
+            )
+            if lead is not None:
+                job_leads.add(lead)
+            if idx > 0:
+                # idx > 0 means entries were SKIPPED (starved/ineligible/
+                # deferred) before this one — a genuine bypass. Plain FIFO
+                # multi-admission keeps idx at 0 as pending shrinks and
+                # counts nothing, matching the classic scheduler's stat.
+                admitted_past_head = True
+                self.stats["admission_reorders"] += 1
+            room -= len(req.prompt) - start
+        if head_blocked and admitted_past_head:
+            self._head_starved_ticks += 1
+        elif head is not None and (not self.pending or self.pending[0] is not head):
+            self._head_starved_ticks = 0  # the head itself admitted
+
+    def _mixed_tick(self) -> list[TokenEvent] | None:
+        """One token-budget tick (docs/MIXED_SCHEDULING.md): decode every
+        active slot by one token AND advance admitting prompts by up to
+        ``budget - n_active`` prefill-chunk tokens, in ONE jitted ragged
+        forward. Decode inter-token latency is bounded by the budget
+        instead of the longest waiting prompt, and admission no longer
+        waits for a decode span to drain.
+
+        Returns None when NO prefill token could ride the tick (every
+        candidate page-starved/deferred and no job in flight): the caller
+        falls through to the classic paths — a one-token-per-slot mixed
+        forward would forfeit decode_span amortization for zero scheduling
+        benefit."""
+        budget = self.ecfg.mixed_step_budget
+        active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+        n_active = len(active)
+        committed = sum(len(j.req.prompt) - j.pos for j in self._prefill_jobs)
+        self._start_mixed_jobs(budget - n_active - committed)
+        committed = sum(len(j.req.prompt) - j.pos for j in self._prefill_jobs)
+        # Pad to the smallest bucket holding this tick's real tokens — a
+        # light tick (few decodes, a chunk tail) pays a small forward.
+        bucket = self.ecfg.mixed_bucket(n_active + committed)
+        room = bucket - n_active
+        chunks: list[tuple[_PrefillJob, int]] = []
+        for job in self._prefill_jobs:  # FIFO: head jobs drain first
+            if room <= 0:
+                break
+            n = min(len(job.req.prompt) - job.pos, room)
+            if n > 0:
+                chunks.append((job, n))
+                room -= n
+        if not chunks:
+            return None  # nothing to mix: classic tick (span decode) instead
+        rows = [
+            (self.page_tables[i], int(self.seq_lens[i]), [int(self.last_tokens[i])])
+            for i, _ in active
+        ] + [
+            (job.row, job.pos, job.req.prompt[job.pos : job.pos + n])
+            for job, n in chunks
+        ]
+        tokens, positions, tables, k_lens = pack_ragged_rows(
+            rows, self.ecfg.max_pages_per_seq, bucket
+        )
+        temps = np.zeros((bucket,), np.float32)
+        top_ks = np.zeros((bucket,), np.int32)
+        top_ps = np.ones((bucket,), np.float32)
+        for j, (i, _) in enumerate(active):
+            temps[j] = self.temps[i]
+            top_ks[j] = self.top_ks[i]
+            top_ps[j] = self.top_ps[i]
+        base = n_active
+        for job, n in chunks:
+            if job.pos + n == len(job.req.prompt):
+                # the chunk reaches the prompt's last token: its logits
+                # sample the request's FIRST generated token this tick
+                s = job.req.sampling
+                temps[base + n - 1] = s.temperature
+                top_ks[base + n - 1] = s.top_k
+                top_ps[base + n - 1] = s.top_p
+            base += n
+        fn = _mixed_step_fn(self.cfg, self.ecfg, bucket, self.mesh)
+        toks, lps, self.cache.k_pages, self.cache.v_pages = fn(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(k_lens),
+            self._next_rng(),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        toks_np, lps_np = np.asarray(toks), np.asarray(lps)
+        events: list[TokenEvent] = []
+        for j, (i, slot) in enumerate(active):
+            tok, logprob = int(toks_np[j]), float(lps_np[j])
+            slot.length += 1
+            slot.generated += 1
+            slot.last_token = tok
+            slot.tokens.append(tok)
+            self.seq_lens[i] = slot.length
+            self.last_tokens[i] = tok
+            self.stats["decode_tokens"] += 1
+            events.append(self._emit(i, slot, tok, logprob))
+        base = n_active
+        for job, n in chunks:
+            job.pos += n
+            self.stats["prefill_tokens"] += n
+            if job.pos == len(job.req.prompt):
+                tok = int(toks_np[base + n - 1])
+                logprob = float(lps_np[base + n - 1])
+                self._prefill_jobs.remove(job)
+                free_slot = next(i for i, s in enumerate(self.slots) if s is None)
+                events.append(
+                    self._install(job.req, free_slot, job.pages, job.row, tok, logprob)
+                )
+            base += n
+        if n_active:
+            self.stats["decode_steps"] += 1
+        carried = n_active + sum(n for _, n in chunks)
+        self.stats["mixed_ticks"] += 1
+        self.stats["mixed_tokens"] += carried
+        with self._telemetry_lock:
+            self._tick_tokens.append(carried)
+        # Host shadows advanced outside the device-chained decode state:
+        # the next classic dispatch must rebuild from them.
+        self._dirty = True
+        self._compact = None
+        return events
+
     def step(self) -> list[TokenEvent]:
-        """One scheduler tick: admit (prefill) if possible, else decode.
+        """One scheduler tick: admit (prefill) if possible, else decode —
+        unless ``mixed_step`` is on and prompts are contending with active
+        decodes, in which case ONE packed ragged forward carries a decode
+        token per active slot plus prefill-chunk tokens for the admitting
+        head (``_mixed_tick``, docs/MIXED_SCHEDULING.md).
 
         With ``async_decode`` the decode path is a one-deep pipeline: dispatch
         step N, then read step N-1's tokens while the device runs N. Any
@@ -2019,7 +2533,19 @@ class InferenceEngine:
             # a post-cancel rebuild starts from harvested (current) state.
             events += self._harvest_inflight()
         self._drain_cancels()
-        if self.pending and any(s is None for s in self.slots):
+        if self._mixed_tick_ready():
+            # Mixed ticks are synchronous (the packed descriptors change
+            # every tick): drain the decode pipeline so host shadows are
+            # current before they are packed into the ragged dispatch. (The
+            # classic path below drains it too whenever admission is
+            # possible, so this costs nothing extra under contention.)
+            events += self._harvest_inflight()
+            mixed = self._mixed_tick()
+            if mixed is not None:
+                return events + mixed
+            # no prefill token could ride the tick (page-starved/deferred
+            # candidates, no jobs): classic admission retry + span decode
+        if self.pending and self._slots_available() > 0:
             # Admission needs current state: drain the pipeline first. Only
             # do this when a slot is actually free — under full occupancy the
             # drain would serialize the pipeline every tick for an admission
@@ -2219,6 +2745,8 @@ class InferenceEngine:
                     )
                 self.stats["decode_tokens"] += 1
                 out.append(self._emit(i, slot, tok, logprob))
+        with self._telemetry_lock:
+            self._tick_tokens.append(len(out))
         return out
 
     def _pick_decode_bucket(self, n_active: int) -> int | None:

@@ -115,6 +115,42 @@ def build_page_table(pages: list[int], max_pages: int) -> np.ndarray:
     return row
 
 
+def pack_ragged_rows(
+    rows: Sequence[tuple[np.ndarray, int, Sequence[int]]],
+    max_pages: int,
+    budget: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Flatten ragged ``(page_table_row, start_pos, tokens)`` descriptors into
+    the fixed-width per-token arrays the mixed token-budget forward consumes
+    (docs/MIXED_SCHEDULING.md): every token becomes its own n_tokens=1 ragged
+    row against its sequence's page table. Decode rows are 1-token
+    descriptors; prefill chunks contribute one entry per chunk token.
+
+    Returns ``(tokens [budget], positions [budget], tables [budget, max_pages],
+    k_lens [budget])`` — padding entries carry k_len 0 (inactive: attention
+    returns zeros, KV writes route to garbage page 0). The multi-row scatter
+    install into the paged pool follows from these arrays: token i writes at
+    ``(tables[i][positions[i] // page_size], positions[i] % page_size)``.
+    """
+    tokens = np.zeros((budget,), np.int32)
+    positions = np.zeros((budget,), np.int32)
+    tables = np.zeros((budget, max_pages), np.int32)
+    k_lens = np.zeros((budget,), np.int32)
+    idx = 0
+    for row, start, toks in rows:
+        n = len(toks)
+        if idx + n > budget:
+            raise ValueError(
+                f"ragged rows hold {idx + n}+ tokens > budget {budget}"
+            )
+        tokens[idx : idx + n] = np.asarray(toks, np.int32)
+        positions[idx : idx + n] = start + np.arange(n, dtype=np.int32)
+        tables[idx : idx + n] = row
+        k_lens[idx : idx + n] = positions[idx : idx + n] + 1
+        idx += n
+    return tokens, positions, tables, k_lens
+
+
 def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
     """Chained block hash over one full page of token ids (vLLM/SGLang-style):
     a page's identity is (everything before it, its own tokens), so two

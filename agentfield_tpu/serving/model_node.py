@@ -1248,6 +1248,7 @@ def build_model_node(
         **backend.engine.stats,
         **backend.engine.grammar_bank_stats(),
         **backend.engine.prefix_cache_stats(),
+        **backend.engine.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
         "active_slots": backend.engine.num_active,
         "free_pages": backend.engine.allocator.free_pages,
     }
@@ -1339,6 +1340,7 @@ def build_model_node(
                 "model": backend.model_name,
                 **eng.stats,
                 **eng.prefix_cache_stats(),
+                **eng.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
                 "active_slots": eng.num_active,
                 "pending": len(eng.pending),
                 "free_pages": eng.allocator.free_pages,

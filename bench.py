@@ -42,6 +42,11 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     cross-request shared-prefix KV cache ON vs all prefix reuse OFF.
     Reports prefix_hit_rate and burst TTFT p50/p99 for both, headline value
     = cache-ON burst TTFT p50 (ms).
+  mixed_interference — 8 long decodes in flight while 16 prompts burst in,
+    run twice on the same backend: token-budget mixed scheduling ON vs OFF
+    (docs/MIXED_SCHEDULING.md). Reports the in-flight decodes' inter-token
+    latency p50/p99 and the burst's TTFT p50/p99 for both modes, plus
+    decode throughput; headline value = mixed-ON decode ITL p99 (ms).
 """
 
 from __future__ import annotations
@@ -434,10 +439,14 @@ def _run_bench() -> None:
         _shared_prefix_burst(model, cfg, params, attn, span, n_requests)
         _done.set()
         return
+    if scenario == "mixed_interference":
+        _mixed_interference(model, cfg, params, attn)
+        _done.set()
+        return
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
-            "(have: shared_prefix_burst)"
+            "(have: shared_prefix_burst, mixed_interference)"
         )
 
     demoted = None
@@ -751,6 +760,237 @@ def _shared_prefix_burst(
             "decode_span": span,
             "n_requests": n,
             "prefix_len": prefix_len,
+            "device": str(jax.devices()[0]),
+        }
+    )
+
+
+def _ratio(num, den):
+    """off/on speedup, None-tolerant (degenerate runs report null fields)."""
+    if num is None or den is None:
+        return None
+    return round(num / max(den, 1e-9), 2)
+
+
+def _mixed_interference(model: str, cfg, params, attn: str) -> None:
+    """Mixed agent traffic under contention: 8 long decodes in flight when a
+    16-prompt burst arrives. Run twice on the same backend — token-budget
+    mixed scheduling ON (prefill chunks piggyback on decode ticks,
+    docs/MIXED_SCHEDULING.md) vs OFF (classic prefill-XOR-decode: the burst
+    freezes every in-flight decode for its prefills). Reports the decodes'
+    inter-token latency p50/p99 measured from burst arrival, the burst's
+    TTFT, and decode throughput; headline value is the mixed-ON ITL p99."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    n_decode, n_burst = 8, 16
+    decode_prompt, decode_new = 32, int(os.environ.get("AGENTFIELD_BENCH_DECODE_NEW", "128"))
+    burst_prompt = int(os.environ.get("AGENTFIELD_BENCH_BURST_PROMPT", "256"))
+    burst_new = 16
+    budget = int(os.environ.get("AGENTFIELD_BENCH_MIXED_BUDGET", "256"))
+    page_size = 32
+    pages_per_seq = -(-max(decode_prompt + decode_new, burst_prompt + burst_new) // page_size) + 1
+    base_ecfg = EngineConfig(
+        max_batch=n_decode + n_burst,
+        page_size=page_size,
+        num_pages=(n_decode + n_burst) * pages_per_seq + 32,
+        max_pages_per_seq=pages_per_seq,
+        max_pending=max(n_burst + n_decode, 64),
+        prefill_batch=8,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: the honest ITL measurement
+        mixed_step_budget=budget,
+    )
+
+    def reqs(prefix, n, p_len, new_toks, seed):
+        toks = jax.random.randint(
+            jax.random.PRNGKey(seed), (n, p_len), 0, cfg.vocab_size, jnp.int32
+        )
+        return [
+            Request(
+                id=f"{prefix}{i}",
+                prompt=toks[i].tolist(),
+                sampling=SamplingParams(max_new_tokens=new_toks),
+            )
+            for i in range(n)
+        ]
+
+    def run(mixed: bool, tag: str):
+        _partial["stage"] = f"mixed_interference ({tag})"
+        ecfg = _dc.replace(base_ecfg, mixed_step=mixed)
+        warm = InferenceEngine(params, cfg, ecfg)
+        # Warm every compile this mode will touch: the decode-prompt bucket,
+        # the decode step, and — via a full prefill_batch burst submitted
+        # MID-DECODE — the classic batched prefill at the burst bucket or
+        # (mixed) the packed ragged forward. Compile time must not be
+        # misread as scheduling interference.
+        warm.submit(reqs("wa", 1, decode_prompt, 8, 21)[0])
+        for _ in range(3):
+            warm.step()
+        for r in reqs("wb", max(2, ecfg.prefill_batch), burst_prompt, 4, 22):
+            warm.submit(r)
+        while warm.has_work():
+            warm.step()
+        if mixed:
+            # Pre-compile EVERY mixed-bucket width: tick totals descend
+            # arbitrarily as the burst drains (e.g. 24 decodes + a small
+            # chunk tail → the 32 bucket), and an uncached bucket compile
+            # landing inside the measurement window would be misread as
+            # scheduling interference. A scratch page pool (same shape as
+            # the engine's, so the jit cache keys match) absorbs the
+            # donated-buffer warm calls.
+            from agentfield_tpu.serving.engine import _mixed_step_fn
+            from agentfield_tpu.serving.kv_cache import PagedKVCache
+
+            eng = warm
+            scratch = PagedKVCache.create(
+                cfg, ecfg.num_pages, ecfg.page_size,
+                str(eng.cache.k_pages.dtype),
+            )
+            kp, vp = scratch.k_pages, scratch.v_pages
+            w_ = 16
+            widths = []
+            while w_ < ecfg.mixed_step_budget:
+                widths.append(w_)
+                w_ *= 2
+            widths.append(ecfg.mixed_step_budget)
+            for w_ in widths:
+                fn = _mixed_step_fn(eng.cfg, eng.ecfg, w_, None)
+                _, _, kp, vp = fn(
+                    eng.params, kp, vp,
+                    jnp.zeros((w_,), jnp.int32),
+                    jnp.zeros((w_,), jnp.int32),
+                    jnp.zeros((w_, ecfg.max_pages_per_seq), jnp.int32),
+                    jnp.zeros((w_,), jnp.int32),  # k_lens 0: all padding
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((w_,), jnp.float32),
+                    jnp.zeros((w_,), jnp.int32),
+                    jnp.ones((w_,), jnp.float32),
+                )
+            del scratch, kp, vp
+        del warm
+
+        e = InferenceEngine(params, cfg, ecfg)
+        decodes = reqs("d", n_decode, decode_prompt, decode_new, 23)
+        burst = reqs("b", n_burst, burst_prompt, burst_new, 24)
+        decode_ids = {r.id for r in decodes}
+        burst_ids = {r.id for r in burst}
+        for r in decodes:
+            e.submit(r)
+        seen: dict[str, int] = {}
+        while len(seen) < n_decode or min(seen.values()) < 2:
+            for ev in e.step():
+                seen[ev.request_id] = seen.get(ev.request_id, 0) + 1
+        t_burst = time.perf_counter()
+        for r in burst:
+            e.submit(r)
+        arrivals: list[tuple[str, float, int]] = []  # (rid, t, index)
+        first_ms: dict[str, float] = {}
+        while e.has_work():
+            for ev in e.step():
+                now = time.perf_counter()
+                arrivals.append((ev.request_id, now, ev.index))
+                if ev.request_id in burst_ids and ev.index == 0:
+                    first_ms[ev.request_id] = (now - t_burst) * 1e3
+        t_end = time.perf_counter()
+        # Interference window: burst submission → every burst request
+        # admitted (last first token). This is where the classic scheduler
+        # freezes decodes behind prefills; the mixed tick exists to bound
+        # exactly these gaps. ITL samples = gaps between consecutive tokens
+        # of each in-flight decode that OVERLAP the window (a classic-mode
+        # freeze is one gap spanning the whole window — it must count).
+        t_admitted = max(t_burst + v / 1e3 for v in first_ms.values())
+        last_arrival: dict[str, float] = {}
+        itl: list[float] = []
+        for rid, t, _idx in arrivals:
+            if rid not in decode_ids:
+                continue
+            prev = last_arrival.get(rid)
+            if prev is not None and t >= t_burst and prev <= t_admitted:
+                itl.append((t - prev) * 1e3)
+            last_arrival[rid] = t
+        itl.sort()
+        ttfts = sorted(first_ms.values())
+
+        # Headline decode throughput: a burst-free full-batch decode phase
+        # on the same engine — with nothing pending, a mixed_step engine
+        # runs the IDENTICAL classic decode path, so this is the "mixed
+        # costs nothing when not mixing" check (acceptance: within 5%).
+        steady = reqs("s", n_decode + n_burst, decode_prompt, 64, 25)
+        for r in steady:
+            e.submit(r)
+        admitted = 0
+        t_full = t_first_done = None
+        steady_tokens = 0
+        while e.has_work():
+            for ev in e.step():
+                now = time.perf_counter()
+                if ev.index == 0:
+                    admitted += 1
+                    if admitted == len(steady):
+                        t_full = now
+                elif t_full is not None and t_first_done is None:
+                    # constant-occupancy window: every slot live, none done —
+                    # the same full-batch decode rate in both modes
+                    steady_tokens += 1
+                    if ev.finished:
+                        t_first_done = now
+        steady_s = max((t_first_done or time.perf_counter()) - t_full, 1e-9)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(len(xs) * p))] if xs else None
+
+        def _r(x, nd=2):
+            # empty sample sets (e.g. AGENTFIELD_BENCH_DECODE_NEW small
+            # enough that decodes drain pre-burst) report null, not a crash
+            return round(x, nd) if x is not None else None
+
+        return {
+            "itl_ms_p50": _r(pct(itl, 0.50)),
+            "itl_ms_p99": _r(pct(itl, 0.99)),
+            "itl_samples": len(itl),
+            "burst_ttft_ms_p50": _r(pct(ttfts, 0.50), 1),
+            "burst_ttft_ms_p99": _r(pct(ttfts, 0.99), 1),
+            "decode_tok_s": round(steady_tokens / steady_s, 1),
+            "tok_s": round(len(arrivals) / (t_end - t_burst), 1),
+            "interference_s": round(t_admitted - t_burst, 2),
+            "mixed_ticks": e.stats["mixed_ticks"],
+            "tokens_per_tick": e.scheduler_stats()["tokens_per_tick"],
+        }
+
+    if not _budget_gate("mixed_interference", 150):
+        _emit(_fallback_payload("budget exhausted before mixed_interference"))
+        return
+    off = run(False, "off")
+    on = run(True, "on")
+    _emit(
+        {
+            "metric": (
+                f"mixed_interference_{model}_{n_decode}decode_{n_burst}burst_"
+                f"{budget}budget"
+            ),
+            "value": on["itl_ms_p99"],
+            "unit": "ms_decode_itl_p99",
+            "mixed": {k: v for k, v in on.items()},
+            "classic": {k: v for k, v in off.items()},
+            "itl_p99_speedup": _ratio(off["itl_ms_p99"], on["itl_ms_p99"]),
+            "itl_p50_speedup": _ratio(off["itl_ms_p50"], on["itl_ms_p50"]),
+            "ttft_p50_speedup": _ratio(
+                off["burst_ttft_ms_p50"], on["burst_ttft_ms_p50"]
+            ),
+            "decode_tok_s_ratio": round(
+                on["decode_tok_s"] / max(off["decode_tok_s"], 1e-9), 3
+            ),
+            "tok_s_ratio": round(on["tok_s"] / max(off["tok_s"], 1e-9), 3),
+            "attn_impl": attn,
+            "n_decode": n_decode,
+            "n_burst": n_burst,
+            "mixed_step_budget": budget,
             "device": str(jax.devices()[0]),
         }
     )

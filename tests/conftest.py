@@ -35,6 +35,14 @@ if os.environ.get("AGENTFIELD_TPU_TEST_REAL", "").lower() not in ("1", "true", "
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: anything that compiles more than one
+    # engine budget bucket (or is otherwise compile-heavy) carries `slow`
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy tests excluded from tier-1 (-m 'not slow')"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _release_engine_compile_caches():
     """The engine's module-level lru_cache'd jit builders pin every compiled
@@ -52,7 +60,7 @@ def _release_engine_compile_caches():
 
     for name in (
         "_decode_fn", "_spec_decode_fn", "_prefill_fn", "_batch_prefill_fn",
-        "_prefill_inject_fn", "_suffix_prefill_fn",
+        "_prefill_inject_fn", "_suffix_prefill_fn", "_mixed_step_fn",
     ):
         fn = getattr(_eng, name, None)
         if fn is not None and hasattr(fn, "cache_clear"):

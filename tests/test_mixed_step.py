@@ -1,0 +1,389 @@
+"""Mixed token-budget scheduling (docs/MIXED_SCHEDULING.md): the packed
+ragged tick must be TOKEN-EXACT against the classic prefill-XOR-decode
+scheduler under greedy sampling — same prompts, same submission order, same
+outputs — while actually interleaving prefill chunks with decode steps.
+Plus: n_tokens=1-row parity of the batched chunk kernel against its ref
+fallback, scheduler-stats export, the compile-cache knob, and the
+EngineConfig docs lint (tier-1)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+CFG = get_config("llama-tiny")
+# ONE budget for every tier-1 engine test in this module: each distinct
+# budget compiles its own mixed-bucket ladder (the multi-budget test below
+# is marked slow).
+ECFG = EngineConfig(
+    max_batch=4, page_size=8, num_pages=128, max_pages_per_seq=8,
+    mixed_step=True, mixed_step_budget=20,
+)
+SEQ_ECFG = dataclasses.replace(ECFG, mixed_step=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompt(seed, n):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _req(rid, prompt, max_new=8, session=None):
+    return Request(
+        id=rid, prompt=prompt,
+        sampling=SamplingParams(max_new_tokens=max_new),
+        session_id=session,
+    )
+
+
+def _drive(ecfg, params, script, mesh=None):
+    """Run a submission script [(at_step, request)] and collect per-request
+    tokens. Both schedulers see the identical submission order/timing."""
+    eng = InferenceEngine(params, CFG, ecfg, mesh=mesh)
+    out: dict[str, list[int]] = {}
+    step = 0
+    pending = sorted(script, key=lambda x: x[0])
+    while pending or eng.has_work():
+        while pending and pending[0][0] <= step:
+            eng.submit(pending.pop(0)[1])
+        for ev in eng.step():
+            out.setdefault(ev.request_id, []).append(ev.token)
+        step += 1
+    return eng, out
+
+
+def test_mixed_matches_sequential_greedy(params):
+    """Prompts bursting into in-flight decodes — including one LONGER than
+    the budget (chunked across several mixed ticks) — produce exactly the
+    classic scheduler's greedy tokens."""
+    script = [
+        (0, _req("a0", _prompt(1, 5), max_new=14)),
+        (0, _req("a1", _prompt(2, 9), max_new=14)),
+        # mid-decode burst; 30 > budget 20 → chunked prefill
+        (4, _req("b0", _prompt(3, 30), max_new=6)),
+        (4, _req("b1", _prompt(4, 12), max_new=6)),
+        (4, _req("b2", _prompt(5, 23), max_new=6)),
+    ]
+    seq_eng, seq = _drive(SEQ_ECFG, params, script)
+    mix_eng, mix = _drive(ECFG, params, script)
+    assert seq_eng.stats["mixed_ticks"] == 0
+    assert mix_eng.stats["mixed_ticks"] > 0  # the packed tick actually ran
+    assert mix_eng.stats["mixed_tokens"] > 0
+    assert set(seq) == set(mix)
+    for rid in seq:
+        assert mix[rid] == seq[rid], f"{rid} diverged from the classic scheduler"
+    # all pages returned in both modes (jobs release through install/finish)
+    assert mix_eng.allocator.free_pages == seq_eng.allocator.free_pages
+
+
+def test_mixed_prefix_hit_admission_mid_decode(params):
+    """A shared-prefix cache hit admitting MID-DECODE starts its chunks at
+    the cached-prefix boundary (the hoist decides the chunk start) and stays
+    token-exact vs the classic scheduler."""
+    shared = _prompt(99, 24)  # 3 full pages at page_size=8
+    script = [
+        (0, _req("seed", shared + _prompt(6, 4), max_new=2)),
+        (6, _req("long", _prompt(7, 6), max_new=16)),
+        (9, _req("hit", shared + _prompt(8, 5), max_new=6)),
+    ]
+    seq_eng, seq = _drive(SEQ_ECFG, params, script)
+    mix_eng, mix = _drive(ECFG, params, script)
+    for rid in seq:
+        assert mix[rid] == seq[rid], f"{rid} diverged"
+    assert mix_eng.stats["prefix_index_hits"] == seq_eng.stats["prefix_index_hits"] == 1
+    assert mix_eng.stats["prefix_tokens_reused"] == seq_eng.stats["prefix_tokens_reused"]
+    assert mix_eng.stats["mixed_ticks"] > 0
+
+
+def test_budget_smaller_than_one_prompt(params):
+    """A prompt several times the budget admits as a job that survives many
+    ticks; its pages are held across ticks and install exactly once."""
+    script = [
+        (0, _req("d", _prompt(9, 4), max_new=20)),
+        (2, _req("big", _prompt(10, 60), max_new=4)),
+    ]
+    seq_eng, seq = _drive(SEQ_ECFG, params, script)
+    mix_eng, mix = _drive(ECFG, params, script)
+    for rid in seq:
+        assert mix[rid] == seq[rid], f"{rid} diverged"
+    # 60-token prompt through a 20-token budget shared with a decode row:
+    # at least 4 mixed ticks carried chunks
+    assert mix_eng.stats["mixed_ticks"] >= 4
+    assert mix_eng.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_mixed_cancel_mid_prefill_releases_pages(params):
+    """Cancelling a request whose prompt is mid-chunked-prefill frees the
+    job's pages without installing a slot."""
+    eng = InferenceEngine(params, CFG, ECFG)
+    eng.submit(_req("d", _prompt(11, 4), max_new=30))
+    for _ in range(3):
+        eng.step()
+    eng.submit(_req("big", _prompt(12, 60), max_new=4))
+    eng.step()  # first mixed tick: job created, chunk 1 prefilled
+    assert eng._prefill_jobs, "job should be mid-prompt"
+    eng.request_cancel("big")
+    eng.request_cancel("d")
+    while eng.has_work():
+        eng.step()
+    assert not eng._prefill_jobs
+    assert eng.stats["requests_cancelled"] == 2
+    assert eng.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_mixed_off_is_default_and_inert(params):
+    """mixed_step defaults to False and the classic scheduler never runs a
+    mixed tick; 'auto' resolves by spec_k; invalid values and undersized
+    budgets are rejected."""
+    assert EngineConfig().mixed_step is False
+    eng = InferenceEngine(params, CFG, SEQ_ECFG)
+    eng.run_to_completion([_req("r", _prompt(13, 5), max_new=4)])
+    assert eng.stats["mixed_ticks"] == 0
+    auto = InferenceEngine(
+        params, CFG, dataclasses.replace(ECFG, mixed_step="auto")
+    )
+    assert auto.ecfg.mixed_step is True  # no draft → auto = on
+    with pytest.raises(ValueError, match="mixed_step"):
+        InferenceEngine(
+            params, CFG, dataclasses.replace(ECFG, mixed_step="always")
+        )
+    with pytest.raises(ValueError, match="mixed_step_budget"):
+        InferenceEngine(
+            params, CFG, dataclasses.replace(ECFG, mixed_step_budget=10)
+        )
+
+
+def test_kernel_w1_rows_parity():
+    """n_tokens=1 rows (the mixed tick's shape) through the batched chunk
+    kernel match the ref fallback — decode-style rows at ragged starts,
+    inactive padding rows, and a mixed-width comparison at W=3."""
+    from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
+        paged_batch_chunk_attention_pallas,
+        paged_batch_chunk_attention_ref,
+    )
+
+    key = jax.random.PRNGKey(33)
+    B, H, Kh, hd, P, ps, maxp = 12, 4, 2, 32, 33, 8, 6
+    ks = jax.random.split(key, 4)
+    kp = jax.random.normal(ks[0], (P, Kh, ps, hd), jnp.float32)
+    vp = jax.random.normal(ks[1], (P, Kh, ps, hd), jnp.float32)
+    perm = np.asarray(jax.random.permutation(ks[3], P - 1) + 1)
+    tables = jnp.asarray(
+        np.stack([perm[i % 3 : i % 3 + maxp] for i in range(B)]), jnp.int32
+    )
+    # ragged decode-token positions incl. page boundaries; rows 10-11 padding
+    starts = jnp.asarray([0, 1, 7, 8, 9, 15, 16, 23, 30, 40, 0, 0], jnp.int32)
+    k_lens = jnp.where(jnp.arange(B) < 10, starts + 1, 0).astype(jnp.int32)
+    for W in (1, 3):
+        q = jax.random.normal(ks[2], (B, W, H, hd), jnp.float32)
+        kl = jnp.where(k_lens > 0, k_lens + (W - 1), 0)
+        for window in (None, 6):
+            out = paged_batch_chunk_attention_pallas(
+                q, kp, vp, tables, starts, kl, interpret=True, window=window
+            )
+            ref = paged_batch_chunk_attention_ref(
+                q, kp, vp, tables, starts, kl, window=window
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+                err_msg=f"W={W} window={window}",
+            )
+            assert np.allclose(np.asarray(ref)[10:], 0.0)  # inactive rows
+
+
+def test_scheduler_stats_exported(params):
+    """itl_ms_p50/p99 and tokens_per_tick ride /stats + heartbeats and
+    re-export as per-node Prometheus gauges next to the prefix gauges."""
+    from agentfield_tpu.control_plane.metrics import Metrics, export_engine_stats
+
+    eng = InferenceEngine(params, CFG, ECFG)
+    eng.run_to_completion(
+        [_req(f"r{i}", _prompt(20 + i, 5), max_new=6) for i in range(2)]
+    )
+    sched = eng.scheduler_stats()
+    assert set(sched) == {"itl_ms_p50", "itl_ms_p99", "tokens_per_tick"}
+    assert sched["itl_ms_p50"] > 0
+    assert sched["itl_ms_p99"] >= sched["itl_ms_p50"]
+    assert sched["tokens_per_tick"] > 0
+    m = Metrics()
+    export_engine_stats(m, "node-1", {**eng.stats, **sched})
+    rendered = m.render()
+    assert 'agentfield_engine_itl_ms_p99{node="node-1"}' in rendered
+    assert 'agentfield_engine_tokens_per_tick{node="node-1"}' in rendered
+    assert 'agentfield_engine_mixed_ticks{node="node-1"}' in rendered
+
+
+def test_compile_cache_knob(params, tmp_path):
+    """compile_cache_dir points jax's persistent compilation cache at the
+    given directory (warm restarts skip the compile gate)."""
+    prev = jax.config.jax_compilation_cache_dir
+    cache = tmp_path / "jitcache"
+    try:
+        ecfg = dataclasses.replace(SEQ_ECFG, compile_cache_dir=str(cache))
+        InferenceEngine(params, CFG, ecfg)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    # unset knob (and no env var) leaves the current setting alone
+    assert jax.config.jax_compilation_cache_dir == prev
+    InferenceEngine(params, CFG, SEQ_ECFG)
+    assert jax.config.jax_compilation_cache_dir == prev
+
+
+def test_engine_knobs_documented():
+    """tier-1 lint: every EngineConfig field appears in docs/*.md (the
+    reference table in docs/ARCHITECTURE.md)."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from check_engine_knobs import check
+    finally:
+        sys.path.pop(0)
+    assert check() == [], "undocumented EngineConfig fields"
+
+
+def test_mixed_starved_head_does_not_block_window(params):
+    """Fairness parity with the classic scheduler: a page-starved head must
+    not block admission — the mixed job scan looks past it (bounded by
+    admit_window) and the head admits once decode frees its pages."""
+    ecfg = dataclasses.replace(ECFG, num_pages=11)  # 10 usable pages
+    eng = InferenceEngine(params, CFG, ecfg)
+    first_seen: list[str] = []
+    out: dict[str, list[int]] = {}
+
+    def collect(events):
+        for ev in events:
+            out.setdefault(ev.request_id, []).append(ev.token)
+            if len(out[ev.request_id]) == 1:
+                first_seen.append(ev.request_id)
+
+    eng.submit(_req("d", _prompt(40, 5), max_new=20))  # 4 pages
+    for _ in range(3):
+        collect(eng.step())
+    eng.submit(_req("big", _prompt(41, 50), max_new=4))  # 7 pages > 6 free
+    eng.submit(_req("small", _prompt(42, 6), max_new=4))  # 2 pages: fits
+    while eng.has_work():
+        collect(eng.step())
+    assert len(out["big"]) == 4 and len(out["small"]) == 4 and len(out["d"]) == 20
+    # small admitted around the starved head, which admitted later
+    assert first_seen.index("small") < first_seen.index("big")
+    assert eng.stats["admission_reorders"] >= 1
+    assert eng.allocator.free_pages == ecfg.num_pages - 1
+
+
+def test_mixed_ineligible_head_not_starved_by_job_stream(params):
+    """A multimodal (mixed-ineligible) request at the queue head must admit
+    within the head_starve_fifo_ticks bound even under a sustained stream of
+    eligible prompts that keeps prefill jobs alive — the fence stops new
+    jobs, the job queue drains, and a classic tick admits the head."""
+    ecfg = dataclasses.replace(ECFG, head_starve_fifo_ticks=3)
+    eng = InferenceEngine(params, CFG, ecfg)
+    eng.submit(_req("d", _prompt(60, 4), max_new=60))
+    for _ in range(2):
+        eng.step()
+    mm = Request(
+        id="mm", prompt=[0, 0] + _prompt(61, 4),
+        sampling=SamplingParams(max_new_tokens=2),
+        mm_embeds=[(0, np.zeros((2, CFG.hidden_size), np.float32))],
+    )
+    eng.submit(mm)
+    first_tick: dict[str, int] = {}
+    feed = 0
+    for tick in range(120):
+        if feed < 30:  # eligible prompts keep arriving behind the mm head
+            try:
+                eng.submit(_req(f"e{feed}", _prompt(70 + feed, 24), max_new=2))
+                feed += 1
+            except Exception:
+                pass
+        for ev in eng.step():
+            first_tick.setdefault(ev.request_id, tick)
+        if "mm" in first_tick:
+            break
+    assert "mm" in first_tick, "mm head starved by the eligible job stream"
+    assert first_tick["mm"] <= 40, first_tick
+    while eng.has_work():
+        eng.step()
+    assert eng.allocator.free_pages == ecfg.num_pages - 1
+
+
+def test_mixed_defers_same_leading_page(params):
+    """Two same-prefix prompts admitting mid-decode: the second defers while
+    the first's job is in flight, then reuses the published prefix instead
+    of re-prefilling it (classic-path deferral parity)."""
+    shared = _prompt(50, 16)  # 2 full pages at page_size=8
+    script = [
+        (0, _req("d", _prompt(51, 5), max_new=16)),
+        (3, _req("p0", shared + _prompt(52, 10), max_new=4)),
+        (3, _req("p1", shared + _prompt(53, 7), max_new=4)),
+    ]
+    seq_eng, seq = _drive(SEQ_ECFG, params, script)
+    mix_eng, mix = _drive(ECFG, params, script)
+    for rid in seq:
+        assert mix[rid] == seq[rid], f"{rid} diverged"
+    assert mix_eng.stats["prefix_batch_deferrals"] >= 1
+    assert mix_eng.stats["prefix_index_hits"] >= 1  # deferred mate hit the
+    # prefix the first job published at install
+    assert mix_eng.stats["mixed_ticks"] > 0
+
+
+def test_mixed_with_pallas_kv_write_config(params):
+    """kv_write_impl='pallas' (the TPU decode-write kernel, one write per
+    page per call) must not corrupt mixed prefill chunks, which write
+    MULTIPLE slots of one page per call: the mixed forward pins its scatter
+    to the exact XLA path regardless of the knob. Token parity vs the all-ref
+    classic scheduler is the proof — a clobbered chunk would corrupt the KV
+    the very next attention reads."""
+    kv_ecfg = dataclasses.replace(ECFG, kv_write_impl="pallas")
+    script = [
+        (0, _req("d", _prompt(90, 5), max_new=12)),
+        (3, _req("p", _prompt(91, 30), max_new=5)),
+    ]
+    _, seq = _drive(SEQ_ECFG, params, script)
+    eng, mix = _drive(kv_ecfg, params, script)
+    assert eng.stats["mixed_ticks"] > 0
+    for rid in seq:
+        assert mix[rid] == seq[rid], f"{rid} diverged under kv_write_impl=pallas"
+
+
+def test_mixed_tensor_parallel_matches_single_device(params):
+    """Mixed ticks under a TP=2 mesh (GSPMD ref paths; pages sharded on the
+    KV-head axis): identical greedy tokens to the single-device engine."""
+    from agentfield_tpu.parallel import make_mesh
+
+    script = [
+        (0, _req("a", _prompt(80, 5), max_new=10)),
+        (3, _req("b", _prompt(81, 26), max_new=4)),
+    ]
+    plain_eng, plain = _drive(ECFG, params, script)
+    tp_eng, tp = _drive(ECFG, params, script, mesh=make_mesh({"model": 2}))
+    assert plain_eng.stats["mixed_ticks"] > 0 and tp_eng.stats["mixed_ticks"] > 0
+    assert tp == plain
+
+
+@pytest.mark.slow  # compiles a SECOND budget-bucket ladder (64) on top of 20
+def test_second_budget_bucket(params):
+    """A different mixed_step_budget compiles its own bucket ladder and
+    still matches the classic scheduler."""
+    big = dataclasses.replace(ECFG, mixed_step_budget=64)
+    script = [
+        (0, _req("a", _prompt(30, 5), max_new=10)),
+        (3, _req("b", _prompt(31, 40), max_new=4)),
+    ]
+    _, seq = _drive(SEQ_ECFG, params, script)
+    eng, mix = _drive(big, params, script)
+    for rid in seq:
+        assert mix[rid] == seq[rid]
+    assert eng.stats["mixed_ticks"] > 0

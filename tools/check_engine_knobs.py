@@ -1,0 +1,47 @@
+"""Lint: every ``EngineConfig`` field must be documented under ``docs/``.
+
+The serving engine's knob surface grows PR by PR; an undocumented knob is
+invisible to operators (and to the EngineConfig reference table in
+docs/ARCHITECTURE.md, which this lint keeps honest). Runs in tier-1 via
+``tests/test_mixed_step.py::test_engine_knobs_documented`` and standalone:
+
+    python tools/check_engine_knobs.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import sys
+
+
+def check(repo_root: pathlib.Path | None = None) -> list[str]:
+    """Returns the undocumented EngineConfig field names (empty = pass)."""
+    root_for_import = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    if str(root_for_import) not in sys.path:  # standalone `python tools/...`
+        sys.path.insert(0, str(root_for_import))
+    from agentfield_tpu.serving.engine import EngineConfig
+
+    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    docs = "\n".join(
+        p.read_text(encoding="utf-8") for p in sorted((root / "docs").glob("*.md"))
+    )
+    return [f.name for f in dataclasses.fields(EngineConfig) if f.name not in docs]
+
+
+def main() -> int:
+    missing = check()
+    if missing:
+        print(
+            "EngineConfig fields missing from docs/*.md "
+            f"(document them — docs/ARCHITECTURE.md has the reference "
+            f"table): {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_engine_knobs: all EngineConfig fields documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

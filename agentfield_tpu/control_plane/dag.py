@@ -19,6 +19,7 @@ from agentfield_tpu.control_plane.types import Execution, ExecutionStatus
 # Aggregation precedence (highest wins), mirroring the reference aggregator.
 _PRECEDENCE = [
     ExecutionStatus.FAILED,
+    ExecutionStatus.DEAD_LETTER,
     ExecutionStatus.TIMEOUT,
     ExecutionStatus.RUNNING,
     ExecutionStatus.QUEUED,

@@ -46,7 +46,7 @@ DASHBOARD_HTML = """<!doctype html>
   th, td { text-align:left; padding:0.32rem 0.55rem; border-bottom:1px solid #21262d;
            font-size:0.84rem; vertical-align:top; }
   tr.click { cursor:pointer; } tr.click:hover td { background:#1c2128; }
-  .completed,.active,.ok { color:var(--green); } .failed,.timeout,.error { color:var(--red); }
+  .completed,.active,.ok { color:var(--green); } .failed,.timeout,.dead_letter,.error { color:var(--red); }
   .running,.queued,.starting { color:var(--amber); } .inactive,.stopping { color:var(--dim); }
   small, .dim { color:var(--dim); }
   pre { background:var(--panel); border:1px solid var(--line); border-radius:6px;
@@ -127,7 +127,7 @@ async function pgDash() {
   $('page').innerHTML = `
     <div class="cards">${[['nodes', s.nodes.active + '/' + s.nodes.total],
       ['models', s.nodes.models], ['completed', ex.completed],
-      ['failed', ex.failed + ex.timeout], ['running', ex.running + ex.queued],
+      ['failed', ex.failed + ex.timeout + (ex.dead_letter || 0)], ['running', ex.running + ex.queued],
       ['queue', s.queue_depth]]
       .map(([k, v]) => `<div class="card"><div class="num">${v}</div>${k}</div>`).join('')}</div>
     <h2 style="font-size:1rem">nodes</h2><table>${n.nodes.map(x =>
@@ -218,7 +218,7 @@ async function pgExecs(id) {
       + (st ? '&status=' + stE : '') + (grp ? '&group_by=' + grpE : ''));
     const base = '#/execs?' + (st ? 'status=' + stE + '&' : '') + (grp ? 'group_by=' + grpE + '&' : '');
     $('page').innerHTML = `
-      <div class="row">status: ${['', 'running', 'completed', 'failed', 'queued'].map(s =>
+      <div class="row">status: ${['', 'running', 'completed', 'failed', 'dead_letter', 'queued'].map(s =>
         `<a href="#/execs?${grp ? 'group_by=' + grpE + '&' : ''}${s ? 'status=' + s : ''}"
           class="${s === st ? 'on' : 'dim'}">${s || 'all'}</a>`).join(' ')}
         group: ${['', 'target', 'status', 'run_id'].map(g =>
@@ -279,7 +279,7 @@ function dagSvg(dag) {
     });
     y += Math.ceil(ids.length / perRow) * (H + GY) + (compact ? 10 : 0);
   });
-  const colors = { completed: 'var(--green)', failed: 'var(--red)', timeout: 'var(--red)',
+  const colors = { completed: 'var(--green)', failed: 'var(--red)', timeout: 'var(--red)', dead_letter: 'var(--red)',
                    running: 'var(--amber)', queued: 'var(--amber)' };
   const edges = nodes.filter(n => n.parent_execution_id && pos[n.parent_execution_id])
     .map(n => { const a = pos[n.parent_execution_id], b = pos[n.execution_id];

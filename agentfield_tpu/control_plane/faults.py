@@ -1,0 +1,171 @@
+"""Deterministic fault injection for failure-domain testing.
+
+Production code asks a single question at each NAMED INJECTION POINT —
+``faults.fire("gateway.agent_call.fail")`` — and gets back ``None`` (no
+fault, the overwhelmingly common case: one dict lookup on a module-level
+``None``) or a :class:`Fault` describing what to break. The schedule is
+fully deterministic: each point owns its own ``random.Random`` stream seeded
+from ``(seed, point)``, so the N-th decision at a point is a pure function
+of the injector seed and N — independent of event-loop interleaving, of
+other points' call counts, and of wall clock. Same seed → same failure
+schedule, which is what lets the chaos tests run in tier-1 without flaking.
+
+Injection points in-tree:
+
+========================== =====================================================
+``registry.heartbeat.drop``    the heartbeat is "lost in transit": the lease is
+                               not refreshed (the node will look silent)
+``gateway.agent_call.fail``    the agent HTTP call raises a transport error
+                               before any bytes reach the agent
+``gateway.agent_call.delay``   the agent HTTP call is delayed by ``delay_s``
+                               before proceeding (slow network / GC pause)
+``node.kill``                  harness-level: the fault_storm bench and chaos
+                               tests consult this schedule to kill a node
+                               mid-burst (the injector never kills anything
+                               itself — it only answers "now?")
+``engine.page_pressure``       a page allocation is denied as if the pool were
+                               exhausted (KV pressure without a real workload)
+========================== =====================================================
+
+Activation: explicitly via :func:`install` (tests, bench), or process-wide
+via the env knob ``AGENTFIELD_FAULTS`` — a JSON spec, e.g.::
+
+    AGENTFIELD_FAULTS='{"gateway.agent_call.fail": {"prob": 0.2, "times": 3}}'
+    AGENTFIELD_FAULTS_SEED=7
+
+With the knob unset and nothing installed, every injection point costs a
+``None`` check and nothing else — the hot paths are untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import random
+from typing import Any
+
+KNOWN_POINTS = (
+    "registry.heartbeat.drop",
+    "gateway.agent_call.fail",
+    "gateway.agent_call.delay",
+    "node.kill",
+    "engine.page_pressure",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One fired fault: the point it fired at and the action parameters."""
+
+    point: str
+    delay_s: float = 0.0  # for *.delay points: how long to stall
+    error: str = "injected fault"  # message for synthesized failures
+
+
+@dataclasses.dataclass
+class _PointState:
+    prob: float = 1.0  # probability each consultation fires
+    times: int | None = None  # stop firing after this many (None = forever)
+    after: int = 0  # skip the first `after` consultations (arm late)
+    delay_s: float = 0.0
+    fired: int = 0
+    calls: int = 0
+    rng: random.Random = dataclasses.field(default_factory=random.Random)
+
+
+class FaultInjector:
+    """Seeded, per-point-deterministic fault schedule.
+
+    ``spec`` maps point name → options::
+
+        {"gateway.agent_call.fail": {"prob": 0.5, "times": 2, "after": 1},
+         "gateway.agent_call.delay": {"prob": 1.0, "delay_s": 0.05}}
+
+    Unknown point names are rejected loudly — a typo'd point would otherwise
+    silently never fire and the chaos test would pass vacuously.
+    """
+
+    def __init__(self, seed: int = 0, spec: dict[str, dict[str, Any]] | None = None):
+        self.seed = seed
+        self._points: dict[str, _PointState] = {}
+        for point, opts in (spec or {}).items():
+            if point not in KNOWN_POINTS:
+                raise ValueError(
+                    f"unknown fault point {point!r}; known: {KNOWN_POINTS}"
+                )
+            if not isinstance(opts, dict):
+                raise ValueError(f"fault spec for {point!r} must be an object")
+            st = _PointState(
+                prob=float(opts.get("prob", 1.0)),
+                times=(int(opts["times"]) if opts.get("times") is not None else None),
+                after=int(opts.get("after", 0)),
+                delay_s=float(opts.get("delay_s", 0.0)),
+            )
+            # Per-point stream: the N-th decision at a point depends only on
+            # (seed, point, N) — concurrent tasks consulting OTHER points
+            # cannot perturb this one's schedule.
+            digest = hashlib.blake2b(
+                f"{seed}:{point}".encode(), digest_size=8
+            ).digest()
+            st.rng = random.Random(int.from_bytes(digest, "big"))
+            self._points[point] = st
+
+    def fire(self, point: str) -> Fault | None:
+        """Consult the schedule at `point`. Returns a Fault when it fires."""
+        st = self._points.get(point)
+        if st is None:
+            return None
+        st.calls += 1
+        if st.calls <= st.after:
+            return None
+        if st.times is not None and st.fired >= st.times:
+            return None
+        # Draw even when prob==1.0 so `times`/`after` edits don't shift the
+        # stream consumed by later decisions at this point.
+        if st.rng.random() >= st.prob:
+            return None
+        st.fired += 1
+        return Fault(
+            point=point,
+            delay_s=st.delay_s,
+            error=f"injected fault at {point} (#{st.fired}, seed={self.seed})",
+        )
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        """Per-point consultation/fire counts (chaos-test assertions)."""
+        return {
+            p: {"calls": st.calls, "fired": st.fired}
+            for p, st in self._points.items()
+        }
+
+
+_active: FaultInjector | None = None
+_env_checked = False
+
+
+def install(injector: FaultInjector | None) -> None:
+    """Install (or clear, with None) the process-wide injector."""
+    global _active, _env_checked
+    _active = injector
+    _env_checked = True  # explicit install wins over the env knob
+
+
+def active() -> FaultInjector | None:
+    """The process-wide injector, resolving $AGENTFIELD_FAULTS once."""
+    global _active, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        raw = os.environ.get("AGENTFIELD_FAULTS")
+        if raw:
+            spec = json.loads(raw)
+            seed = int(os.environ.get("AGENTFIELD_FAULTS_SEED", "0"))
+            _active = FaultInjector(seed=seed, spec=spec)
+    return _active
+
+
+def fire(point: str) -> Fault | None:
+    """Module-level convenience: consult the active injector (None-cheap)."""
+    inj = active()
+    return inj.fire(point) if inj is not None else None

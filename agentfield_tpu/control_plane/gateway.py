@@ -18,11 +18,14 @@ Agent wire contract (network boundary):
 from __future__ import annotations
 
 import asyncio
+import dataclasses
+import random
 import time
 from typing import Any
 
 import aiohttp
 
+from agentfield_tpu.control_plane import faults
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
 from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
@@ -58,6 +61,66 @@ class GatewayError(Exception):
         self.message = message
 
 
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Gateway-side retry of NODE-level failures (transport errors, agent
+    5xx, node down) — the classification mirrors the SDK's
+    ``_doc_node_down`` (sdk/agent.py) so the two layers agree on what is
+    worth replaying. Deterministic request failures (agent 4xx, schema
+    violations) are never retried: replaying those cluster-wide is useless.
+
+    ``max_attempts`` bounds total agent-call attempts per dispatch (across
+    failover targets); backoff between attempts is exponential with FULL
+    jitter — sleep ~ U(0, min(max_backoff, base_backoff * 2^(attempt-1))) —
+    so a burst of failures against a recovering node does not re-arrive as
+    a thundering herd.
+    """
+
+    max_attempts: int = 3
+    base_backoff: float = 0.2
+    max_backoff: float = 5.0
+
+    _FIELDS = ("max_attempts", "base_backoff", "max_backoff")
+
+    @staticmethod
+    def validate(d: dict[str, Any]) -> dict[str, Any]:
+        """Validate a per-execution override dict (request body / persisted
+        row) — unknown keys and non-positive numbers are 400s at ingestion,
+        not surprises mid-retry."""
+        if not isinstance(d, dict):
+            raise GatewayError(400, "retry_policy must be an object")
+        unknown = set(d) - set(RetryPolicy._FIELDS)
+        if unknown:
+            raise GatewayError(
+                400,
+                f"unknown retry_policy keys {sorted(unknown)}; "
+                f"allowed: {list(RetryPolicy._FIELDS)}",
+            )
+        out: dict[str, Any] = {}
+        for k, v in d.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+                raise GatewayError(400, f"retry_policy.{k} must be a positive number")
+            if k == "max_attempts":
+                if v != int(v) or v < 1:
+                    # int() truncation would turn 0.9 into a zero budget
+                    raise GatewayError(400, "retry_policy.max_attempts must be an integer >= 1")
+                out[k] = int(v)
+            else:
+                out[k] = float(v)
+        return out
+
+    def merged(self, override: dict[str, Any] | None) -> "RetryPolicy":
+        if not override:
+            return self
+        return dataclasses.replace(
+            self, **{k: v for k, v in override.items() if k in self._FIELDS}
+        )
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        cap = min(self.max_backoff, self.base_backoff * (2 ** max(attempt - 1, 0)))
+        return rng.uniform(0.0, cap)
+
+
 class ExecutionGateway:
     def __init__(
         self,
@@ -71,6 +134,8 @@ class ExecutionGateway:
         webhook_notify=None,  # async callable(execution) -> None
         payloads=None,  # PayloadStore | None — large payloads offload to files
         db: AsyncStorage | None = None,  # shared async facade (built if absent)
+        retry_policy: RetryPolicy | None = None,  # default node-failure retry
+        # (per-execution "retry_policy" in the request body overrides it)
     ):
         self.payloads = payloads
         self.storage = storage
@@ -93,6 +158,17 @@ class ExecutionGateway:
         self._queue: asyncio.Queue[Execution] = asyncio.Queue(maxsize=queue_capacity)
         self._workers: list[asyncio.Task] = []
         self._session: aiohttp.ClientSession | None = None
+        self.retry_policy = retry_policy or RetryPolicy()
+        self._retry_rng = random.Random()  # backoff jitter (tests may reseed)
+        # Execution ids with a live _dispatch retry loop on this event loop.
+        # The orphan requeue (node marked INACTIVE) must skip these: their
+        # own retry loop already owns recovery, and a second enqueue would
+        # double-dispatch the work.
+        self._dispatching: set[str] = set()
+        # Strong refs for fire-and-forget terminal transitions (loop tasks
+        # are weakly held): a cancelled sync handler must still get its
+        # execution to a terminal state.
+        self._bg_completions: set[asyncio.Task] = set()
 
     @property
     def queue_depth(self) -> int:
@@ -110,6 +186,8 @@ class ExecutionGateway:
         for w in self._workers:
             w.cancel()
         await asyncio.gather(*self._workers, return_exceptions=True)
+        if self._bg_completions:  # let cancellation-path completions settle
+            await asyncio.gather(*list(self._bg_completions), return_exceptions=True)
         if self._session:
             await self._session.close()
 
@@ -122,21 +200,40 @@ class ExecutionGateway:
         headers: dict[str, str],
         webhook_url: str | None,
         status: ExecutionStatus,
+        retry_policy: dict[str, Any] | None = None,
     ) -> tuple[Execution, AgentNode]:
         """Parse target, resolve node+component, persist the execution record
         (reference: prepareExecution, execute.go:641)."""
+        if retry_policy is not None:
+            retry_policy = RetryPolicy.validate(retry_policy)
         if "." not in target:
             raise GatewayError(400, f"target {target!r} must be '<node>.<component>'")
         node_id, comp_name = target.split(".", 1)
         node = await self.db.get_node(node_id)
         if node is None:
             raise GatewayError(404, f"unknown node {node_id!r}")
-        if node.status not in (NodeStatus.ACTIVE, NodeStatus.STARTING):
-            raise GatewayError(503, f"node {node_id!r} is {node.status.value}")
         found = node.component(comp_name)
         if found is None:
             raise GatewayError(404, f"node {node_id!r} has no component {comp_name!r}")
         _, ttype = found
+        if node.status not in (NodeStatus.ACTIVE, NodeStatus.STARTING):
+            # The named node is down — but if any other ACTIVE node serves
+            # this component, accept the work and let _dispatch fail over to
+            # it (a dead target must not 503 callers while capacity exists).
+            # With no capable node anywhere, 503 as before.
+            alt = None
+            for cand in await self.db.list_nodes():
+                if (
+                    cand.node_id != node_id
+                    and cand.status == NodeStatus.ACTIVE
+                    and self._capable_substitute(cand, comp_name, node)
+                ):
+                    alt = cand
+                    break
+            if alt is None:
+                raise GatewayError(503, f"node {node_id!r} is {node.status.value}")
+            self.metrics.inc("gateway_failovers_total")
+            node = alt
 
         # Normalize header casing (clients may send lowercase).
         headers = {k.title(): v for k, v in headers.items()}
@@ -154,6 +251,7 @@ class ExecutionGateway:
             input=payload,
             webhook_url=webhook_url,
             started_at=now(),
+            retry_policy=retry_policy,
         )
         try:
             await self.db.create_execution(ex)
@@ -179,9 +277,22 @@ class ExecutionGateway:
         ]
         return f"{node.base_url.rstrip('/')}/{kind}/{comp}"
 
-    async def _call_agent(self, node: AgentNode, ex: Execution) -> None:
-        """POST to the agent; 200 completes inline, 202 defers to the status
-        callback (reference: callAgent, execute.go:783-828)."""
+    async def _call_agent_once(
+        self, node: AgentNode, ex: Execution
+    ) -> tuple[str, Any]:
+        """ONE POST to the agent. Returns an (outcome, data) pair instead of
+        completing inline so the retry driver can classify:
+
+        - ``("completed", result)`` — agent answered 200
+        - ``("deferred", None)``    — agent answered 202; status callback owns
+          completion (node death after this is the orphan-requeue's job)
+        - ``("fatal", error)``      — deterministic request failure (agent
+          4xx): retrying elsewhere cannot help
+        - ``("node_error", error)`` — transport failure / agent 5xx /
+          malformed reply: the NODE is suspect; retry/failover applies. The
+          error strings keep the exact shapes the SDK's ``_doc_node_down``
+          classifies ("agent call failed ...", "agent returned 5xx ...").
+        """
         assert self._session is not None
         headers = {
             "X-Run-ID": ex.run_id,
@@ -195,6 +306,12 @@ class ExecutionGateway:
         if self.payloads is not None:
             # agents get real bytes; file IO runs off the event loop
             agent_input = await asyncio.to_thread(self.payloads.resolve, agent_input)
+        f = faults.fire("gateway.agent_call.delay")
+        if f is not None and f.delay_s > 0:
+            await asyncio.sleep(f.delay_s)
+        f = faults.fire("gateway.agent_call.fail")
+        if f is not None:
+            return "node_error", f"agent call failed: {f.error}"
         t0 = time.perf_counter()
         try:
             async with self._session.post(
@@ -206,23 +323,160 @@ class ExecutionGateway:
                     body = await resp.json()
                     if not isinstance(body, dict):
                         raise ValueError(f"agent 200 body must be an object, got {type(body).__name__}")
-                    await self.complete(ex.execution_id, result=body.get("result"))
-                elif resp.status == 202:
-                    pass  # agent will POST the status callback
-                else:
-                    text = (await resp.text())[:500]
-                    await self.complete(
-                        ex.execution_id,
-                        error=f"agent returned {resp.status}: {text}",
-                    )
+                    return "completed", body.get("result")
+                if resp.status == 202:
+                    return "deferred", None  # agent will POST the status callback
+                text = (await resp.text())[:500]
+                err = f"agent returned {resp.status}: {text}"
+                return ("node_error" if resp.status >= 500 else "fatal"), err
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            # Any failure talking to / parsing from the agent must terminate the
-            # execution — an exception here would otherwise strand it RUNNING.
-            await self.complete(ex.execution_id, error=f"agent call failed: {e!r}")
+            # Transport/parse failure: the node (or the path to it) is the
+            # problem — retryable by classification.
+            return "node_error", f"agent call failed: {e!r}"
         finally:
             self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)
+
+    @staticmethod
+    def _capable_substitute(cand: AgentNode, comp: str, own: AgentNode | None) -> bool:
+        """Is `cand` a legitimate failover target for `own`'s component
+        `comp`? Same component name; and when the original node declares a
+        served model (model nodes advertise metadata.model), the substitute
+        must serve the SAME model — a.generate failing over to a node
+        running a different checkpoint would silently answer with the wrong
+        model."""
+        if cand.component(comp) is None:
+            return False
+        own_model = (own.metadata or {}).get("model") if own is not None else None
+        if own_model is not None and cand.metadata.get("model") != own_model:
+            return False
+        return True
+
+    async def _pick_node(
+        self, ex: Execution, tried: set[str]
+    ) -> AgentNode | None:
+        """Failover target selection: the execution's own node first, then
+        any other ACTIVE node exposing a component with the same name (and
+        serving the same model, for model nodes — _capable_substitute).
+        Nodes in `tried` are deprioritized but NOT forbidden — when every
+        capable node has failed once, retrying the original beats giving up
+        before the retry budget says so."""
+        own_id, comp = ex.target.split(".", 1)
+        candidates: list[AgentNode] = []
+        own = await self.db.get_node(own_id)
+        # STARTING is dispatchable for the NAMED node (matching _prepare's
+        # admission — the old worker called a starting node too); failover
+        # substitutes must be fully ACTIVE.
+        if own is not None and own.status in (NodeStatus.ACTIVE, NodeStatus.STARTING):
+            candidates.append(own)
+        for node in await self.db.list_nodes():
+            if node.node_id == own_id or node.status != NodeStatus.ACTIVE:
+                continue
+            if self._capable_substitute(node, comp, own):
+                candidates.append(node)
+        for node in candidates:
+            if node.node_id not in tried:
+                return node
+        return candidates[0] if candidates else None
+
+    async def _dispatch(self, ex: Execution, node: AgentNode | None = None) -> None:
+        """Retry/failover driver around ``_call_agent_once`` (the recovery
+        the reference leaves to each SDK client — here the orchestration
+        layer owns it). Node-level failures retry with full-jitter backoff,
+        failing over to the next capable active node; budget exhaustion (or
+        no capable node at all) parks the execution in DEAD_LETTER for
+        operator triage/requeue instead of FAILED."""
+        policy = self.retry_policy.merged(ex.retry_policy)
+        tried: set[str] = set()
+        self._dispatching.add(ex.execution_id)
+
+        async def persist_attempts() -> None:
+            # complete() re-reads the row, so attempt bookkeeping must land
+            # in storage BEFORE the terminal transition (and for deferred
+            # work, so the orphan requeue sees which node holds it).
+            cur = await self.db.get_execution(ex.execution_id)
+            if cur is not None and not cur.status.terminal:
+                cur.attempts = ex.attempts
+                cur.nodes_tried = ex.nodes_tried
+                await self.db.update_execution(cur)
+
+        try:
+            last_err = "no capable active node"
+            while ex.attempts < policy.max_attempts:
+                if node is None:
+                    node = await self._pick_node(ex, tried)
+                if node is None:
+                    break  # nothing active can serve this component
+                ex.attempts += 1
+                # Append EVERY dispatch (duplicates allowed): nodes_tried is
+                # dispatch order, so its last element is always the node the
+                # work was last handed to — the orphan requeue's "holder".
+                ex.nodes_tried.append(node.node_id)
+                outcome, data = await self._call_agent_once(node, ex)
+                if outcome == "completed":
+                    await persist_attempts()
+                    await self.complete(ex.execution_id, result=data)
+                    return
+                if outcome == "deferred":
+                    await persist_attempts()
+                    return
+                if outcome == "fatal":
+                    await persist_attempts()
+                    await self.complete(ex.execution_id, error=data)
+                    return
+                # node_error — retryable
+                last_err = data
+                tried.add(node.node_id)
+                self.metrics.inc("gateway_retries_total")
+                log.warning(
+                    "agent call failed; will retry",
+                    execution_id=ex.execution_id,
+                    node_id=node.node_id,
+                    attempt=ex.attempts,
+                    error=data,
+                )
+                # A late status callback may have completed the execution
+                # while the failed call was in flight — never re-dispatch
+                # finished work.
+                cur = await self.db.get_execution(ex.execution_id)
+                if cur is None or cur.status.terminal:
+                    return
+                if ex.attempts >= policy.max_attempts:
+                    break
+                nxt = await self._pick_node(ex, tried)
+                if nxt is not None and nxt.node_id != node.node_id:
+                    self.metrics.inc("gateway_failovers_total")
+                node = nxt
+                if node is None:
+                    break
+                await asyncio.sleep(policy.backoff(ex.attempts, self._retry_rng))
+            await persist_attempts()
+            await self.complete(
+                ex.execution_id,
+                error=f"retry budget exhausted after {ex.attempts} attempt(s) "
+                f"over nodes {ex.nodes_tried}: {last_err}",
+                dead_letter=True,
+            )
+        except asyncio.CancelledError:
+            # The caller vanished mid-retry (HTTP disconnect / client
+            # timeout cancels the handler task, possibly inside a backoff
+            # sleep). The execution must still reach a terminal state —
+            # its node is ACTIVE, so no requeue hook will ever touch it.
+            # Fire-and-forget on the loop (awaiting here would be
+            # re-cancelled); complete() is idempotent if anything else
+            # finishes it first, and a late agent result is still recorded.
+            t = asyncio.ensure_future(
+                self.complete(
+                    ex.execution_id,
+                    error="dispatch cancelled: caller disconnected mid-retry",
+                )
+            )
+            self._bg_completions.add(t)
+            t.add_done_callback(self._bg_completions.discard)
+            raise
+        finally:
+            self._dispatching.discard(ex.execution_id)
 
     # ------------------------------------------------------------------
 
@@ -233,11 +487,16 @@ class ExecutionGateway:
         headers: dict[str, str],
         webhook_url: str | None = None,
         timeout: float | None = None,
+        retry_policy: dict[str, Any] | None = None,
     ) -> Execution:
-        """Sync path: call agent, then wait on the event bus until the
-        execution reaches a terminal state (execute.go:195-278)."""
-        ex, node = await self._prepare(target, payload, headers, webhook_url, ExecutionStatus.RUNNING)
-        await self._call_agent(node, ex)
+        """Sync path: call agent (with retry/failover), then wait on the
+        event bus until the execution reaches a terminal state
+        (execute.go:195-278)."""
+        ex, node = await self._prepare(
+            target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
+            retry_policy=retry_policy,
+        )
+        await self._dispatch(ex, node)
         current = await self.db.get_execution(ex.execution_id)
         if current is not None and current.status.terminal:
             return current
@@ -257,10 +516,14 @@ class ExecutionGateway:
         payload: Any,
         headers: dict[str, str],
         webhook_url: str | None = None,
+        retry_policy: dict[str, Any] | None = None,
     ) -> Execution:
         """Async path: enqueue and 202 immediately; queue-full → 503
         backpressure (execute.go:327-367)."""
-        ex, _node = await self._prepare(target, payload, headers, webhook_url, ExecutionStatus.QUEUED)
+        ex, _node = await self._prepare(
+            target, payload, headers, webhook_url, ExecutionStatus.QUEUED,
+            retry_policy=retry_policy,
+        )
         try:
             self._queue.put_nowait(ex)
         except asyncio.QueueFull:
@@ -285,15 +548,14 @@ class ExecutionGateway:
                 if fresh is None or fresh.status.terminal:
                     continue
                 ex = fresh
-                node_id = ex.target.split(".", 1)[0]
-                node = await self.db.get_node(node_id)
-                if node is None:
-                    await self.complete(ex.execution_id, error=f"node {node_id} vanished")
-                    continue
                 ex.status = ExecutionStatus.RUNNING
                 await self.db.update_execution(ex)
                 self._publish(ex)
-                await self._call_agent(node, ex)
+                # _dispatch resolves the node itself (the target's node when
+                # ACTIVE, else failover candidates): a node that vanished or
+                # went INACTIVE while the work sat queued is just the first
+                # failover, not an instant failure.
+                await self._dispatch(ex)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # a worker must never die (cf. sweep loop)
@@ -311,6 +573,7 @@ class ExecutionGateway:
         result: Any = None,
         error: str | None = None,
         timeout: bool = False,
+        dead_letter: bool = False,
     ) -> Execution | None:
         """Terminal-state transition: persist once, publish once, fire webhook
         (reference: completeExecution/failExecution, execute.go:831-919;
@@ -318,7 +581,7 @@ class ExecutionGateway:
         storage provider yields the loop mid-transition, so loop ordering
         alone no longer guarantees exactly-once)."""
         async with self._complete_lock:
-            return await self._complete_locked(execution_id, result, error, timeout)
+            return await self._complete_locked(execution_id, result, error, timeout, dead_letter)
 
     async def _complete_locked(
         self,
@@ -326,13 +589,42 @@ class ExecutionGateway:
         result: Any = None,
         error: str | None = None,
         timeout: bool = False,
+        dead_letter: bool = False,
     ) -> Execution | None:
         ex = await self.db.get_execution(execution_id)
         if ex is None:
             return None
         if ex.status.terminal:
-            return ex  # idempotent: late callbacks don't double-complete
-        if timeout:
+            # Idempotent: late callbacks don't double-complete. One refinement
+            # (sync-wait-timeout race): a RESULT arriving after the timeout
+            # already went terminal is still recorded — the work WAS done and
+            # an operator (or dead-letter requeue) should see it — but the
+            # status, events and webhooks are not replayed: subscribers got
+            # exactly one terminal event.
+            if (
+                ex.status in (ExecutionStatus.TIMEOUT, ExecutionStatus.DEAD_LETTER)
+                and error is None
+                and not timeout
+                and not dead_letter
+                and ex.result is None
+                and result is not None
+            ):
+                if self.payloads is not None:
+                    ex.result = await asyncio.to_thread(self.payloads.offload, result)
+                else:
+                    ex.result = result
+                await self.db.update_execution(ex)
+                self.metrics.inc("gateway_late_results_total")
+                log.info(
+                    "late result recorded on terminal execution",
+                    execution_id=ex.execution_id,
+                    status=ex.status.value,
+                )
+            return ex
+        if dead_letter:
+            ex.status = ExecutionStatus.DEAD_LETTER
+            ex.error = error
+        elif timeout:
             ex.status = ExecutionStatus.TIMEOUT
             ex.error = error
         elif error is not None:
@@ -384,6 +676,118 @@ class ExecutionGateway:
                 self._publish(ex)
             return ex
         raise GatewayError(400, f"unknown status {status!r}")
+
+    async def requeue_node_executions(self, node_id: str, reason: str = "node down") -> int:
+        """Orphan requeue: a node just went INACTIVE/away — its in-flight
+        (RUNNING) executions must not ride out ``sync_wait_timeout``. Each
+        one re-enters the async queue, where a worker re-dispatches it with
+        failover; sync callers are still parked on the event bus and wake
+        when the requeued execution completes elsewhere. Executions with a
+        LIVE dispatch loop on this event loop are skipped (their own retry
+        loop owns recovery); an execution whose retry budget is already
+        spent dead-letters here rather than looping. Wired to the registry's
+        node-down hook (sweep + health monitor). NOTE: requeue is
+        at-least-once — the dead node may have partially executed the work;
+        targets must tolerate replay (same contract as SDK-side failover)."""
+        n = 0
+        for ex in await self.db.list_executions(
+            status=ExecutionStatus.RUNNING, limit=10_000
+        ):
+            # The node HOLDING the work is the last one dispatched to
+            # (persist_attempts records it at the 202) — after a failover
+            # that differs from the target prefix: work deferred on node b
+            # must requeue when B dies, and must NOT double-dispatch when
+            # the originally-named (but no longer involved) node dies.
+            holder = (
+                ex.nodes_tried[-1] if ex.nodes_tried else ex.target.split(".", 1)[0]
+            )
+            if holder != node_id:
+                continue
+            if ex.execution_id in self._dispatching:
+                continue
+            # Serialize against completions and re-read: the snapshot above
+            # is stale by the time we get here, and flipping a
+            # just-COMPLETED row back to QUEUED would erase its result.
+            async with self._complete_lock:
+                cur = await self.db.get_execution(ex.execution_id)
+                if (
+                    cur is None
+                    or cur.status != ExecutionStatus.RUNNING
+                    or cur.execution_id in self._dispatching
+                ):
+                    continue
+                policy = self.retry_policy.merged(cur.retry_policy)
+                exhausted = cur.attempts >= policy.max_attempts
+                if not exhausted:
+                    cur.status = ExecutionStatus.QUEUED
+                    await self.db.update_execution(cur)
+            if exhausted:
+                await self.complete(
+                    cur.execution_id,
+                    error=f"node {node_id} went down ({reason}); retry budget "
+                    f"exhausted after {cur.attempts} attempt(s) over nodes "
+                    f"{cur.nodes_tried}",
+                    dead_letter=True,
+                )
+                continue
+            try:
+                self._queue.put_nowait(cur)
+            except asyncio.QueueFull:
+                await self.complete(
+                    cur.execution_id,
+                    error=f"node {node_id} went down ({reason}) and the "
+                    "requeue found the async queue at capacity",
+                    dead_letter=True,
+                )
+                continue
+            self._publish(cur)
+            self.metrics.inc("gateway_orphans_requeued_total")
+            n += 1
+        if n:
+            self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
+            log.warning("requeued orphaned executions", node_id=node_id, count=n, reason=reason)
+        return n
+
+    async def list_dead_letter(self, limit: int = 100, offset: int = 0) -> list[Execution]:
+        return await self.db.list_executions(
+            status=ExecutionStatus.DEAD_LETTER, limit=limit, offset=offset,
+            newest_first=True,
+        )
+
+    async def requeue_dead_letter(self, execution_id: str) -> Execution:
+        """Operator requeue of a dead-lettered execution: fresh retry budget,
+        back through the async queue (404 unknown id, 409 not dead-lettered)."""
+        ex = await self.db.get_execution(execution_id)
+        if ex is None:
+            raise GatewayError(404, f"unknown execution {execution_id!r}")
+        if ex.status != ExecutionStatus.DEAD_LETTER:
+            raise GatewayError(
+                409, f"execution is {ex.status.value}, not dead_letter"
+            )
+        ex.status = ExecutionStatus.QUEUED
+        ex.error = None
+        ex.finished_at = None
+        ex.attempts = 0  # operator-granted fresh budget
+        ex.nodes_tried = []  # stale holder/audit trail must not leak into
+        # the new incarnation's requeue matching or error reports
+        ex.result = None  # ditto a late-recorded result from the dead
+        # incarnation — and the late-result guard must be open for the new one
+        # Persist BEFORE enqueueing: the worker re-reads the row and drops
+        # anything still terminal, so enqueue-first could silently lose the
+        # requeue to that race.
+        await self.db.update_execution(ex)
+        try:
+            self._queue.put_nowait(ex)
+        except asyncio.QueueFull:
+            ex.status = ExecutionStatus.DEAD_LETTER
+            ex.error = "requeue failed: async execution queue is full"
+            ex.finished_at = now()
+            await self.db.update_execution(ex)
+            raise GatewayError(503, "async execution queue is full") from None
+        self._publish(ex)
+        self.metrics.inc("gateway_dead_letter_requeued_total")
+        self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
+        return ex
 
     def _publish(self, ex: Execution) -> None:
         self.bus.publish(

@@ -28,15 +28,34 @@ class HealthMonitor:
         interval: float = 30.0,
         probe_timeout: float = 5.0,
         failure_threshold: int = 3,
+        probe_backoff_cap: float = 600.0,
     ):
         self.registry = registry
         self.interval = interval
         self.probe_timeout = probe_timeout
         self.failure_threshold = failure_threshold
-        self._failures: dict[str, int] = {}
+        self.probe_backoff_cap = probe_backoff_cap
         self.last_probe: dict[str, dict[str, Any]] = {}  # node_id -> probe doc
+        # Per-node probe backoff (capped exponential, like the webhook
+        # dispatcher's retry schedule): once a node's failure streak reaches
+        # the deactivation threshold, further probes of it space out at 2x,
+        # 4x, ... the base interval (capped) instead of hammering it every
+        # tick forever. Pre-threshold failures keep the normal cadence —
+        # backing off there would only delay legitimate deactivation. The
+        # streak survives the deactivate→fence→heartbeat-revive flap cycle
+        # and resets only on a probe success.
+        self._streak: dict[str, int] = {}  # node_id -> consecutive failures
+        self._next_probe: dict[str, float] = {}  # node_id -> earliest next probe
+        # node_id -> registered_at of the incarnation the streak belongs to:
+        # a deregister/re-register inside one probe interval must not hand
+        # the fresh node the dead incarnation's streak and backoff.
+        self._incarnation: dict[str, float] = {}
         self._task: asyncio.Task | None = None
         self._session: aiohttp.ClientSession | None = None
+
+    def probe_backoff(self, streak: int) -> float:
+        """Delay before the next probe after `streak` consecutive failures."""
+        return min(self.interval * (2 ** max(streak - 1, 0)), self.probe_backoff_cap)
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
@@ -61,15 +80,32 @@ class HealthMonitor:
             except Exception:
                 self.registry.metrics.inc("health_probe_errors_total")
 
-    async def probe_all(self) -> dict[str, bool]:
+    async def probe_all(self, at: float | None = None) -> dict[str, bool]:
         all_nodes = await self.registry.db.list_nodes()
         # Prune state for deregistered ids — churn must not grow these maps,
         # and a re-registered id must not inherit a dead incarnation's probe.
         known = {n.node_id for n in all_nodes}
-        for stale in set(self.last_probe) - known:
-            self.last_probe.pop(stale, None)
-            self._failures.pop(stale, None)
-        nodes = [n for n in all_nodes if n.status == NodeStatus.ACTIVE]
+        for stale in set(self.last_probe) | set(self._streak) | set(self._next_probe):
+            if stale not in known:
+                self.last_probe.pop(stale, None)
+                self._streak.pop(stale, None)
+                self._next_probe.pop(stale, None)
+                self._incarnation.pop(stale, None)
+        for node in all_nodes:
+            # Same id, NEW registration (registered_at moved): the streak
+            # and backoff belong to the dead incarnation — reset them even
+            # when the restart happened between two probe ticks.
+            if self._incarnation.get(node.node_id) != node.registered_at:
+                self._incarnation[node.node_id] = node.registered_at
+                self._streak.pop(node.node_id, None)
+                self._next_probe.pop(node.node_id, None)
+        t = at if at is not None else time.time()
+        nodes = [
+            n
+            for n in all_nodes
+            if n.status == NodeStatus.ACTIVE
+            and self._next_probe.get(n.node_id, 0.0) <= t  # backed-off: skip
+        ]
         results = await asyncio.gather(*(self.probe_one(n) for n in nodes))
         return {n.node_id: ok for n, ok in zip(nodes, results)}
 
@@ -87,18 +123,29 @@ class HealthMonitor:
         self.last_probe[node.node_id] = doc
 
         if doc["healthy"]:
-            self._failures.pop(node.node_id, None)
+            self._streak.pop(node.node_id, None)
+            self._next_probe.pop(node.node_id, None)
             return True
-        n = self._failures.get(node.node_id, 0) + 1
-        self._failures[node.node_id] = n
-        if n >= self.failure_threshold:
+        streak = self._streak.get(node.node_id, 0) + 1
+        self._streak[node.node_id] = streak
+        over = streak - self.failure_threshold + 1  # cycles past the threshold
+        if streak >= self.failure_threshold:
+            self._next_probe[node.node_id] = doc["ts"] + self.probe_backoff(over)
+        # Deactivate at the threshold — and, because the streak survives the
+        # flap cycle, on the FIRST failure after a heartbeat revive: a node
+        # that already proved unreachable must not get `threshold` fresh
+        # strikes of routed traffic every time its own heartbeats revive it.
+        if streak >= self.failure_threshold:
             # Same transition machinery heartbeats use — events fire and the
             # gateway stops routing. The fence keeps the agent's own 2s
             # heartbeats from instantly re-activating an unreachable node
-            # (flap guard); after the fence expires a heartbeat revives it
-            # and probing resumes.
+            # (flap guard); it GROWS with the streak, tracking the probe
+            # backoff, so a flapping node spends the backoff window
+            # INACTIVE (unrouted) rather than revived-but-unprobed. After
+            # the fence expires a heartbeat revives it and probing resumes.
             try:
-                self.registry.fence(node.node_id, duration=self.interval * 2)
+                fence_for = max(self.interval * 2, self.probe_backoff(max(over, 1)))
+                self.registry.fence(node.node_id, duration=fence_for)
                 await self.registry.heartbeat(node.node_id, {"status": "inactive"})
             except Exception:
                 pass
@@ -110,5 +157,4 @@ class HealthMonitor:
                 node_id=node.node_id,
                 error=doc.get("error"),
             )
-            self._failures.pop(node.node_id, None)
         return False

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
+from agentfield_tpu.control_plane import faults
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
 from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
@@ -67,6 +68,12 @@ class NodeRegistry:
         # an INACTIVE node (prevents probe-deactivate / heartbeat-reactivate
         # flapping for nodes whose advertised URL is unreachable).
         self._fences: dict[str, float] = {}  # node_id -> fence expiry
+        # Node-down hooks: fired (async, fire-and-forget) whenever a node
+        # leaves ACTIVE for INACTIVE or is deregistered — the gateway hangs
+        # its orphan requeue here so a dead node's in-flight executions
+        # re-dispatch immediately instead of riding out sync_wait_timeout.
+        self._node_down_cbs: list[Any] = []
+        self._cb_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> None:
         self._sweeper = asyncio.create_task(self._sweep_loop())
@@ -75,6 +82,8 @@ class NodeRegistry:
         if self._sweeper:
             self._sweeper.cancel()
             await asyncio.gather(self._sweeper, return_exceptions=True)
+        if self._cb_tasks:  # let in-flight node-down hooks settle
+            await asyncio.gather(*list(self._cb_tasks), return_exceptions=True)
 
     # ------------------------------------------------------------------
 
@@ -131,10 +140,35 @@ class NodeRegistry:
         self.bus.publish(NODE_TOPIC, {"type": "registered", "node_id": node_id, "ts": now()})
         return node
 
+    def on_node_down(self, cb) -> None:
+        """Register an async callback(node_id, reason) fired when a node
+        transitions ACTIVE→INACTIVE (sweep, health probe, explicit status)
+        or is deregistered/evicted."""
+        self._node_down_cbs.append(cb)
+
+    def _fire_node_down(self, node_id: str, reason: str) -> None:
+        for cb in self._node_down_cbs:
+
+            async def run(cb=cb):
+                try:
+                    await cb(node_id, reason)
+                except Exception:  # a broken hook must not break the sweep
+                    self.metrics.inc("node_down_hook_errors_total")
+
+            task = asyncio.create_task(run())
+            self._cb_tasks.add(task)
+            task.add_done_callback(self._cb_tasks.discard)
+
     async def heartbeat(self, node_id: str, data: dict[str, Any] | None = None) -> AgentNode:
         node = await self.db.get_node(node_id)
         if node is None:
             raise RegistryError(404, f"unknown node {node_id!r}; re-register")
+        if faults.fire("registry.heartbeat.drop") is not None:
+            # Chaos: the heartbeat is "lost in transit" — the lease is not
+            # refreshed, so a sustained drop schedule makes the node look
+            # silent to the sweep without touching the node process.
+            self.metrics.inc("heartbeats_dropped_injected_total")
+            return node
         node.last_heartbeat = now()
         requested = (data or {}).get("status")
         # Enhanced heartbeats may carry live node stats (e.g. a model node's
@@ -194,6 +228,7 @@ class NodeRegistry:
             # a dead node's engine gauges must not linger in /metrics
             self.metrics.remove_gauges({"node": node_id})
             self.bus.publish(NODE_TOPIC, {"type": "deregistered", "node_id": node_id, "ts": now()})
+            self._fire_node_down(node_id, "deregistered")
         return ok
 
     def _publish_status(self, node_id: str, old: NodeStatus, new: NodeStatus) -> None:
@@ -208,6 +243,12 @@ class NodeRegistry:
                 "ts": now(),
             },
         )
+        if new == NodeStatus.INACTIVE and old != NodeStatus.INACTIVE:
+            # ONE choke point for "this node is gone": lease-expiry sweep,
+            # health-probe deactivation and explicit status heartbeats all
+            # pass through here. STOPPING is deliberately excluded — a
+            # draining node finishes its in-flight work itself.
+            self._fire_node_down(node_id, f"status {old.value} -> inactive")
 
     # ------------------------------------------------------------------
 

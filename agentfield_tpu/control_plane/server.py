@@ -133,6 +133,11 @@ class ControlPlane:
         from agentfield_tpu.control_plane.mcp_service import MCPService
 
         self.health_monitor = HealthMonitor(self.registry, interval=health_interval)
+        # Failure-domain hook: the instant a node is marked INACTIVE (lease
+        # sweep, health probe) or deregistered, its in-flight executions
+        # requeue with failover instead of riding out sync_wait_timeout
+        # (docs/FAULT_TOLERANCE.md).
+        self.registry.on_node_down(self.gateway.requeue_node_executions)
         self.mcp = MCPService(self.storage, db=self.db)
         import os as _os2
         from pathlib import Path as _Path
@@ -427,6 +432,7 @@ def create_app(cp: ControlPlane) -> web.Application:
                 _headers(req),
                 webhook_url=body.get("webhook_url"),
                 timeout=timeout,
+                retry_policy=body.get("retry_policy"),
             )
         except _BadBody as e:
             return _json_error(400, str(e))
@@ -450,6 +456,7 @@ def create_app(cp: ControlPlane) -> web.Application:
                 body.get("input"),
                 _headers(req),
                 webhook_url=body.get("webhook_url"),
+                retry_policy=body.get("retry_policy"),
             )
         except GatewayError as e:
             return _json_error(e.status, e.message)
@@ -533,6 +540,46 @@ def create_app(cp: ControlPlane) -> web.Application:
 
             await asyncio.to_thread(_resolve_list)
         return web.json_response({"executions": docs})
+
+    # -- dead letter (failed-over-to-exhaustion executions) -------------
+
+    @routes.get("/api/v1/dead-letter")
+    async def dead_letter_list(req: web.Request):
+        """Operator triage queue: executions whose node-failure retry budget
+        was exhausted (docs/FAULT_TOLERANCE.md dead-letter triage runbook)."""
+        try:
+            limit = min(max(int(req.query.get("limit", "100")), 1), 1000)
+            offset = max(int(req.query.get("offset", "0")), 0)
+        except ValueError:
+            return _json_error(400, "limit/offset must be integers")
+        exs = await cp.gateway.list_dead_letter(limit=limit, offset=offset)
+        return web.json_response(
+            {
+                "executions": [
+                    {
+                        "execution_id": e.execution_id,
+                        "target": e.target,
+                        "run_id": e.run_id,
+                        "error": e.error,
+                        "attempts": e.attempts,
+                        "nodes_tried": e.nodes_tried,
+                        "created_at": e.created_at,
+                        "finished_at": e.finished_at,
+                    }
+                    for e in exs
+                ]
+            }
+        )
+
+    @routes.post("/api/v1/dead-letter/{execution_id}/requeue")
+    async def dead_letter_requeue(req: web.Request):
+        try:
+            ex = await cp.gateway.requeue_dead_letter(req.match_info["execution_id"])
+        except GatewayError as e:
+            return _json_error(e.status, e.message)
+        return web.json_response(
+            {"execution_id": ex.execution_id, "status": ex.status.value}, status=202
+        )
 
     # -- DID / VC audit layer ------------------------------------------
 

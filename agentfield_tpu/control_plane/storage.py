@@ -312,7 +312,7 @@ class SQLiteStorage:
         q = (
             f"SELECT {group_by} AS g, COUNT(*) AS n, "
             "SUM(CASE WHEN status='completed' THEN 1 ELSE 0 END) AS ok, "
-            "SUM(CASE WHEN status IN ('failed','timeout') THEN 1 ELSE 0 END) AS bad, "
+            "SUM(CASE WHEN status IN ('failed','timeout','dead_letter') THEN 1 ELSE 0 END) AS bad, "
             "MAX(created_at) AS latest "
             f"FROM executions{where} GROUP BY {group_by} "
             "ORDER BY latest DESC LIMIT ?"
@@ -397,7 +397,7 @@ class SQLiteStorage:
                 """
                 SELECT COUNT(*) AS n,
                        SUM(CASE WHEN status = 'completed' THEN 1 ELSE 0 END) AS ok,
-                       SUM(CASE WHEN status IN ('failed', 'timeout') THEN 1 ELSE 0 END) AS bad,
+                       SUM(CASE WHEN status IN ('failed', 'timeout', 'dead_letter') THEN 1 ELSE 0 END) AS bad,
                        MIN(created_at) AS first_seen,
                        MAX(created_at) AS last_seen
                 FROM executions WHERE target = ?

@@ -51,10 +51,19 @@ class ExecutionStatus(str, enum.Enum):
     COMPLETED = "completed"
     FAILED = "failed"
     TIMEOUT = "timeout"
+    # Retry budget exhausted on a *node-level* failure (transport error /
+    # node down) — the work itself may be fine; operators triage and requeue
+    # via POST /api/v1/dead-letter/{id}/requeue (docs/FAULT_TOLERANCE.md).
+    DEAD_LETTER = "dead_letter"
 
     @property
     def terminal(self) -> bool:
-        return self in (ExecutionStatus.COMPLETED, ExecutionStatus.FAILED, ExecutionStatus.TIMEOUT)
+        return self in (
+            ExecutionStatus.COMPLETED,
+            ExecutionStatus.FAILED,
+            ExecutionStatus.TIMEOUT,
+            ExecutionStatus.DEAD_LETTER,
+        )
 
 
 class TargetType(str, enum.Enum):
@@ -138,6 +147,12 @@ class Execution:
     started_at: float | None = None
     finished_at: float | None = None
     notes: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    # Failure-recovery bookkeeping (gateway retry/failover — the fields
+    # default so pre-existing persisted docs round-trip unchanged):
+    attempts: int = 0  # agent-call attempts consumed across all nodes
+    nodes_tried: list[str] = dataclasses.field(default_factory=list)
+    retry_policy: dict[str, Any] | None = None  # per-execution override of
+    # the gateway RetryPolicy (keys: max_attempts, base_backoff, max_backoff)
 
     def to_dict(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)

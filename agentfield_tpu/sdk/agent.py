@@ -441,6 +441,10 @@ class Agent:
         violations) never match: replaying those cluster-wide is useless."""
         if doc.get("status") == "node_down":
             return True
+        # dead_letter = the GATEWAY already retried node-level failures to
+        # budget exhaustion on our behalf — by definition a node problem.
+        if doc.get("status") == "dead_letter":
+            return True
         if doc.get("status") != "failed":
             return False
         err = str(doc.get("error") or "")
@@ -673,7 +677,9 @@ class Agent:
                     continue
                 err = str(doc.get("error") or "")
                 if (
-                    doc["status"] == "failed"
+                    # dead_letter: the gateway's own retries saw the same
+                    # backpressure — still worth client-side patience
+                    doc["status"] in ("failed", "dead_letter")
                     and ("QueueFullError" in err or "queue at capacity" in err)
                     and attempts < 5
                 ):

@@ -24,6 +24,16 @@ class ControlPlaneError(Exception):
         self.message = message
 
 
+# Terminal execution statuses, mirroring ExecutionStatus.terminal on the
+# control plane (dead_letter: gateway retry budget exhausted on node-level
+# failures — docs/FAULT_TOLERANCE.md).
+TERMINAL_STATUSES = ("completed", "failed", "timeout", "dead_letter")
+# Terminal AND immutable — safe to cache client-side forever. dead_letter
+# rows can be requeued by an operator and timeout rows can still gain a
+# late-arriving result, so neither may be frozen in the result cache.
+CACHEABLE_STATUSES = ("completed", "failed")
+
+
 class ControlPlaneClient:
     def __init__(self, base_url: str, timeout: float = 600.0):
         self.base_url = base_url.rstrip("/")
@@ -119,8 +129,8 @@ class ControlPlaneClient:
         if cached is not None:
             return copy.deepcopy(cached)  # caller mutations must not poison the cache
         doc = await self._req("GET", f"/api/v1/executions/{execution_id}")
-        if doc.get("status") in ("completed", "failed", "timeout"):
-            self._result_cache.put(execution_id, copy.deepcopy(doc))  # terminal → immutable
+        if doc.get("status") in CACHEABLE_STATUSES:
+            self._result_cache.put(execution_id, copy.deepcopy(doc))  # immutable
         return doc
 
     async def batch_status(self, execution_ids: list[str]) -> dict[str, Any]:
@@ -183,7 +193,7 @@ class ControlPlaneClient:
                 # by a workflow event moments from now).
                 try:
                     doc = await self.get_execution(execution_id)
-                    if doc["status"] in ("completed", "failed", "timeout"):
+                    if doc["status"] in TERMINAL_STATUSES:
                         return doc
                 except ControlPlaneError as e:
                     if e.status != 404:
@@ -206,7 +216,7 @@ class ControlPlaneClient:
         while True:
             try:
                 doc = await self.get_execution(execution_id)
-                if doc["status"] in ("completed", "failed", "timeout"):
+                if doc["status"] in TERMINAL_STATUSES:
                     return doc
             except ControlPlaneError as e:
                 if e.status != 404:  # not-yet-created: keep polling

@@ -48,7 +48,12 @@ class MemoryEventClient:
         delay = self.reconnect_delay
         while True:
             try:
-                async with aiohttp.ClientSession() as s:
+                # Explicit timeout: the WS read itself must stay unbounded
+                # (total=None — events are sparse; heartbeat=20 owns liveness)
+                # but connect/DNS must never hang the reconnect loop.
+                async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=None, connect=10, sock_connect=10)
+                ) as s:
                     async with s.ws_connect(
                         f"{self.base_url}/api/v1/memory/events/ws", heartbeat=20
                     ) as ws:

@@ -216,6 +216,12 @@ class Request:
     # session prefix caching: cache identity keys on token ids, which cannot
     # distinguish two images behind identical placeholders.
     mm_embeds: list[tuple[int, Any]] | None = None
+    # Wall-clock budget in SECONDS from submit. When it expires the request
+    # is cancelled through the request_cancel path and a final TokenEvent
+    # with finish_reason="deadline_exceeded" is emitted (tokens generated so
+    # far were already streamed). None = no deadline; enforcement costs one
+    # empty-dict check per step when unused (docs/FAULT_TOLERANCE.md).
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -224,7 +230,8 @@ class TokenEvent:
     token: int
     index: int  # 0-based index among generated tokens
     finished: bool
-    finish_reason: str | None = None  # "stop" | "length"
+    finish_reason: str | None = None  # "stop" | "length" |
+    # "deadline_exceeded" (Request.deadline_s expired; token is -1)
     logprob: float | None = None  # log P(token) under the UNMODIFIED (pre-
     # temperature/top-k/top-p) distribution — raw-logit log-softmax
 
@@ -921,6 +928,22 @@ def _mixed_step_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None)
     return jax.jit(mixed, donate_argnums=(1, 2))
 
 
+def _engine_fault(point: str):
+    """Consult the control-plane fault injector WITHOUT importing the (HTTP-
+    heavy) control_plane package into every engine process: if the faults
+    module was never imported and the env knob is unset, no injector can
+    exist and this is two dict lookups."""
+    import os
+    import sys
+
+    m = sys.modules.get("agentfield_tpu.control_plane.faults")
+    if m is None:
+        if not os.environ.get("AGENTFIELD_FAULTS"):
+            return None
+        from agentfield_tpu.control_plane import faults as m
+    return m.fire(point)
+
+
 def _setup_compile_cache(ecfg: EngineConfig) -> None:
     """Wire the persistent JAX compilation cache (warm restarts skip the
     multi-second compile gate). Resolution: EngineConfig.compile_cache_dir,
@@ -1187,6 +1210,14 @@ class InferenceEngine:
             # mapping was dropped so the owner could write them in place
             "prefix_batch_deferrals": 0,  # batch mates deferred to reuse a
             # tick-mate's about-to-be-published prefix instead of re-prefilling
+            # Failure-domain hardening (docs/FAULT_TOLERANCE.md):
+            "deadline_exceeded": 0,  # requests cancelled by Request.deadline_s
+            "cancels_unknown": 0,  # request_cancel of an id the engine does
+            # not hold (already finished / never submitted): client and
+            # engine disagree about in-flight work — worth an operator's eye
+            "page_pressure_injected": 0,  # fault-injected allocation denials
+            "drains_total": 0,  # graceful drains started (model node SIGTERM)
+            "drain_cancelled": 0,  # requests deadline-outed by a drain
         }
         # Cross-request sharing rides on the session prefix-cache switch: one
         # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
@@ -1236,6 +1267,14 @@ class InferenceEngine:
         # the worker thread — mutating slots from other threads mid-step
         # would race the decode batch.
         self._cancels: set[str] = set()
+        # Request deadlines: id -> monotonic expiry (written at submit under
+        # _pending_lock, scanned at the top of step()). Expired ids cancel
+        # through the normal _cancels path and emit a terminal
+        # finish_reason="deadline_exceeded" event.
+        self._deadline_at: dict[str, float] = {}
+        # Drain sweep flag (deadline_all_now): applied on the scheduler
+        # thread at the next step so live-request enumeration cannot race.
+        self._drain_sweep = False
         # step() runs on a worker thread (ModelBackend) while submit()/
         # free_session() run on the event loop: session+allocator mutations
         # need mutual exclusion.
@@ -1315,6 +1354,12 @@ class InferenceEngine:
                     f"are supported with a grammar (got "
                     f"{len(req.sampling.stop_token_ids)})"
                 )
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            # BEFORE _grammar_acquire below: a rejected request must never
+            # pin bank rows.
+            raise ValueError(
+                f"request {req.id}: deadline_s={req.deadline_s} must be > 0"
+            )
         needed = self._pages_needed(req)
         if needed > self.ecfg.max_pages_per_seq:
             raise RequestTooLongError(
@@ -1335,6 +1380,8 @@ class InferenceEngine:
                         f"pending queue at capacity {self.ecfg.max_pending}"
                     )
                 self.pending.append(req)
+                if req.deadline_s is not None:
+                    self._deadline_at[req.id] = time.monotonic() + req.deadline_s
         except QueueFullError:
             with self._session_lock:
                 self._grammar_release(req.grammar)
@@ -1547,6 +1594,11 @@ class InferenceEngine:
     def _alloc_with_eviction(self, n: int) -> list[int] | None:
         """Allocate n pages, evicting LRU idle sessions if needed (cached
         prefixes are a best-effort optimization; live requests win)."""
+        if _engine_fault("engine.page_pressure") is not None:
+            # Chaos: behave exactly like a pool with no free pages — the
+            # admission fairness/starvation machinery is what's under test.
+            self.stats["page_pressure_injected"] += 1
+            return None
         pages = self.allocator.alloc(n)
         while pages is None and self._sessions:
             lru_sid = min(self._sessions, key=lambda s: self._sessions[s].last_used)
@@ -2234,6 +2286,8 @@ class InferenceEngine:
             else:
                 self.allocator.free(slot.pages)
         self.stats["requests_finished"] += 1
+        with self._pending_lock:
+            self._deadline_at.pop(slot.req.id, None)
         if self.slots[slot_idx] is slot:
             self.slots[slot_idx] = None
         self.page_tables[slot_idx] = 0
@@ -2254,10 +2308,68 @@ class InferenceEngine:
         that no longer exists must not keep decoding."""
         self._cancels.add(request_id)
 
-    def _drain_cancels(self) -> None:
+    def live_request_ids(self) -> list[str]:
+        """Ids the engine currently holds (pending + mid-prefill + active).
+        Advisory from other threads (defensive copies): the authoritative
+        enumeration for the drain sweep happens on the scheduler thread
+        inside step() (_expire_deadlines)."""
+        with self._pending_lock:
+            ids = [r.id for r in self.pending]
+        ids += [j.req.id for j in list(self._prefill_jobs)]
+        ids += [s.req.id for s in list(self.slots) if s is not None]
+        return ids
+
+    def deadline_all_now(self) -> int:
+        """Graceful-drain helper: arm a sweep that gives every live request
+        an already-expired deadline, so step() terminates each one with a
+        finish_reason="deadline_exceeded" TokenEvent. Unlike request_cancel
+        (which frees silently), every consumer gets a terminal event — a
+        draining node must answer its callers, not strand them. The sweep
+        itself runs ON the scheduler thread at the top of the next step()
+        (_prefill_jobs/slots are worker-thread state; enumerating them here
+        could race a concurrent step and miss a live request). Returns an
+        advisory count for drain telemetry."""
+        self._drain_sweep = True
+        return len(self.live_request_ids())
+
+    def _expire_deadlines(self) -> list[str]:
+        """Scan Request.deadline_s expiries (empty-dict no-op when unused):
+        expired ids route through the normal cancel path; the caller emits
+        their terminal deadline_exceeded events. A pending drain sweep
+        (deadline_all_now) is applied here first — ON the scheduler thread,
+        where pending/jobs/slots can be enumerated without racing a step."""
+        if self._drain_sweep:
+            self._drain_sweep = False
+            t0 = time.monotonic()
+            with self._pending_lock:
+                ids = [r.id for r in self.pending]
+            ids += [j.req.id for j in self._prefill_jobs]
+            ids += [s.req.id for s in self.slots if s is not None]
+            with self._pending_lock:
+                for rid in ids:
+                    self._deadline_at[rid] = t0
+        if not self._deadline_at:
+            return []
+        t = time.monotonic()
+        with self._pending_lock:
+            expired = [rid for rid, exp in self._deadline_at.items() if exp <= t]
+            for rid in expired:
+                del self._deadline_at[rid]
+        if expired:
+            self._cancels.update(expired)
+        return expired
+
+    def _drain_cancels(self, expected: set[str] | None = None) -> None:
+        """Apply queued cancels. `expected` ids (deadline expiries routed
+        through this path) are exempt from the cancels_unknown accounting —
+        they were live moments ago by construction."""
         if not self._cancels:
             return
         cancels, self._cancels = self._cancels, set()
+        with self._pending_lock:
+            for rid in cancels:
+                self._deadline_at.pop(rid, None)
+        matched: set[str] = set()
         with self._pending_lock:
             n_before = len(self.pending)
             dropped = [r for r in self.pending if r.id in cancels]
@@ -2270,6 +2382,7 @@ class InferenceEngine:
                     self._grammar_release(r.grammar)
             for r in dropped:
                 self._req_hashes.pop(r.id, None)
+                matched.add(r.id)
         for job in [j for j in self._prefill_jobs if j.req.id in cancels]:
             # Mid-prefill cancel (mixed scheduling): the job's pages hold a
             # partial prompt — release them without publishing anything.
@@ -2277,8 +2390,10 @@ class InferenceEngine:
                 self.allocator.free(job.pages)
             self._prefill_jobs.remove(job)
             self.stats["requests_cancelled"] += 1
+            matched.add(job.req.id)
         for i, slot in enumerate(self.slots):
             if slot is not None and slot.req.id in cancels:
+                matched.add(slot.req.id)
                 # Incomplete output: release WITHOUT session retention.
                 with self._session_lock:
                     self.allocator.free(slot.pages)
@@ -2294,6 +2409,12 @@ class InferenceEngine:
                 self._dirty = True
                 self._compact = None
                 self.stats["requests_cancelled"] += 1
+        # Cancels that matched nothing: the client thinks a request is in
+        # flight that the engine does not hold (finished already, or never
+        # submitted). Silent disagreement hides bugs — count it.
+        unknown = cancels - matched - (expected or set())
+        if unknown:
+            self.stats["cancels_unknown"] += len(unknown)
 
     def _mixed_eligible(self, req: Request) -> bool:
         """Mixed prefill jobs carry plain token prompts only: grammar
@@ -2528,11 +2649,22 @@ class InferenceEngine:
         (dispatch order on the device stream makes its stale KV write land
         before any re-use of the freed pages)."""
         events: list[TokenEvent] = []
+        expired = self._expire_deadlines()  # no-op when no deadlines are set
         if self._cancels and self._inflight is not None:
             # Cancels mutate slots/host shadows: drain the pipeline first so
             # a post-cancel rebuild starts from harvested (current) state.
             events += self._harvest_inflight()
-        self._drain_cancels()
+        self._drain_cancels(expected=set(expired))
+        for rid in expired:
+            # Terminal event for the consumer (tokens generated so far were
+            # already streamed; -1 marks "no token carried").
+            self.stats["deadline_exceeded"] += 1
+            events.append(
+                TokenEvent(
+                    request_id=rid, token=-1, index=-1, finished=True,
+                    finish_reason="deadline_exceeded",
+                )
+            )
         if self._mixed_tick_ready():
             # Mixed ticks are synchronous (the packed descriptors change
             # every tick): drain the decode pipeline so host shadows are
@@ -2877,5 +3009,6 @@ class InferenceEngine:
         results: dict[str, list[int]] = {r.id: [] for r in requests}
         while self.has_work():
             for ev in self.step():
-                results[ev.request_id].append(ev.token)
+                if ev.token >= 0:  # deadline/error terminals carry no token
+                    results[ev.request_id].append(ev.token)
         return results

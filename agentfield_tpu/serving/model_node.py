@@ -31,6 +31,13 @@ from agentfield_tpu.serving.engine import (
     Request,
     RequestTooLongError,
 )
+
+
+class NodeDrainingError(QueueFullError):
+    """The node is draining (rolling restart): admission is closed. A
+    QueueFullError subclass so every transport surface already maps it to
+    retryable backpressure (HTTP 503 / gRPC RESOURCE_EXHAUSTED) and SDK
+    failover routes the caller to another node."""
 from agentfield_tpu.serving.sampler import SamplingParams
 from agentfield_tpu.sdk.agent import Agent
 
@@ -321,6 +328,10 @@ class ModelBackend:
             else:
                 self.imagegen_cfg, self.imagegen_params = imagegen
         self.idle_sleep = idle_sleep
+        # Graceful drain (SIGTERM / rolling restart): once set, _submit
+        # refuses new work with NodeDrainingError while in-flight requests
+        # run to completion (or deadline out at the drain grace cutoff).
+        self._draining = False
         # One accumulation dict: (token, logprob) records per request —
         # parallel dicts would need mirrored lifecycle at every cleanup site.
         self._buffers: dict[str, list[tuple[int, float | None]]] = {}
@@ -417,7 +428,11 @@ class ModelBackend:
                 if ev.request_id not in self._futures:
                     continue  # cancelled/unknown rid: never recreate buffers
                     # (a setdefault here would leak entries forever)
-                if not (ev.finished and ev.finish_reason == "stop"):
+                if ev.token < 0:
+                    # Terminal marker without a token (deadline_exceeded):
+                    # resolve with whatever was generated, buffer nothing.
+                    self._buffers.setdefault(ev.request_id, [])
+                elif not (ev.finished and ev.finish_reason == "stop"):
                     # Stop tokens terminate, they are not content: buffering
                     # one would append EOS text to result["text"] (breaking
                     # e.g. strict parses of constrained scalar outputs).
@@ -698,6 +713,8 @@ class ModelBackend:
         images: list | None = None,
         audios: list | None = None,
         prefused: tuple | None = None,  # (tokens, mm_embeds) from ensure_media()
+        deadline_s: float | None = None,  # per-request wall-clock budget
+        # (engine-enforced; finish_reason="deadline_exceeded" on expiry)
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -706,6 +723,10 @@ class ModelBackend:
         "truncate_left" keeps the most recent tokens that fit (the TPU-native
         analogue of the reference's token-aware oldest-first trimming,
         agent_ai.py:262-325)."""
+        if self._draining:
+            raise NodeDrainingError(
+                "node is draining (rolling restart): not admitting new work"
+            )
         mm_embeds = None
         if images or audios:
             if tokens is not None:
@@ -780,6 +801,7 @@ class ModelBackend:
                     session_id=session_id,
                     grammar=grammar,
                     mm_embeds=mm_embeds,
+                    deadline_s=deadline_s,
                 )
             )
         except Exception:
@@ -941,6 +963,7 @@ class ModelBackend:
         images: list | None = None,
         audios: list | None = None,
         output: str = "text",
+        deadline_s: float | None = None,
     ) -> dict[str, Any]:
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
@@ -1037,6 +1060,7 @@ class ModelBackend:
             images=images,
             audios=audios,
             prefused=prefused,
+            deadline_s=deadline_s,
         )
         try:
             result = await fut
@@ -1083,6 +1107,7 @@ class ModelBackend:
         images: list | None = None,
         audios: list | None = None,
         prefused: tuple | None = None,
+        deadline_s: float | None = None,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
@@ -1104,8 +1129,39 @@ class ModelBackend:
             images=images,
             audios=audios,
             prefused=prefused,
+            deadline_s=deadline_s,
         )
         return rid, q
+
+    async def drain(self, grace_s: float = 30.0) -> dict[str, Any]:
+        """Graceful drain (rolling restart): stop admitting, let in-flight
+        requests finish; whatever is still running at the grace cutoff is
+        deadline-outed (each consumer gets a terminal
+        finish_reason="deadline_exceeded" event — never a silent hang).
+        Idempotent; returns a summary for the operator log."""
+        t0 = time.monotonic()
+        first = not self._draining
+        self._draining = True
+        if first:
+            self.engine.stats["drains_total"] += 1
+        while self.engine.has_work() and time.monotonic() - t0 < grace_s:
+            self._wake.set()  # keep the drive loop stepping
+            await asyncio.sleep(0.02)
+        cancelled = 0
+        if self.engine.has_work():
+            cancelled = self.engine.deadline_all_now()
+            self.engine.stats["drain_cancelled"] += cancelled
+            self._wake.set()
+            # deadline-out is one step away; bound the wait anyway
+            t1 = time.monotonic()
+            while self.engine.has_work() and time.monotonic() - t1 < 10.0:
+                self._wake.set()
+                await asyncio.sleep(0.02)
+        return {
+            "drained": not self.engine.has_work(),
+            "deadline_outed": cancelled,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
 
     def cancel(self, rid: str) -> None:
         """Cancel an in-flight request and wake the drive loop so the slot
@@ -1251,6 +1307,7 @@ def build_model_node(
         **backend.engine.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
         "active_slots": backend.engine.num_active,
         "free_pages": backend.engine.allocator.free_pages,
+        "draining": int(backend._draining),
     }
 
     async def stream_handler(req):
@@ -1272,6 +1329,7 @@ def build_model_node(
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
                     "response_schema", "context_overflow", "images", "audios",
+                    "deadline_s",
                 )
                 if body.get(k) is not None
             }
@@ -1393,6 +1451,61 @@ def build_model_node(
 
     agent.add_route("POST", "/profile/{action}", profile_handler)
     return agent, backend
+
+
+async def drain_and_stop(agent: Agent, backend: ModelBackend, grace_s: float = 30.0) -> dict:
+    """The full rolling-restart sequence (docs/OPERATIONS.md runbook):
+    stop admitting → finish/deadline-out in-flight work → deregister from
+    the control plane (placement stops immediately; the registry fires its
+    node-down hook, which finds nothing in flight because the drain already
+    answered every caller) → unbind. Returns the drain summary."""
+    summary = await backend.drain(grace_s)
+    try:
+        await agent.client.deregister_node(agent.node_id)
+    except Exception:
+        pass  # control plane unreachable: the lease sweep will evict us
+    await agent.stop()
+    await backend.stop()
+    return summary
+
+
+def install_sigterm_drain(
+    agent: Agent, backend: ModelBackend, grace_s: float = 30.0
+) -> asyncio.Event:
+    """Wire SIGTERM (and SIGINT) to the graceful drain. Returns an Event set
+    when the drain+shutdown completes — serve loops await it instead of
+    sleeping forever. Call from the running event loop."""
+    import signal
+
+    done = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    started = False
+    holder: set = set()  # strong ref: loop tasks are weakly held and a
+    # GC'd drain task would strand the process mid-shutdown
+
+    def _on_signal():
+        nonlocal started
+        if started:
+            return  # second signal during drain: ignore (drain is bounded)
+        started = True
+
+        async def run():
+            try:
+                summary = await drain_and_stop(agent, backend, grace_s)
+                print(f"[agentfield] {agent.node_id} drained: {summary}", flush=True)
+            finally:
+                done.set()
+
+        t = loop.create_task(run())
+        holder.add(t)
+        t.add_done_callback(holder.discard)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _on_signal)
+        except (NotImplementedError, RuntimeError):
+            pass  # platform without loop signal support (tests call drain directly)
+    return done
 
 
 # Optional scalar fields of GenerateRequest, shared by the server-side

@@ -47,6 +47,14 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     (docs/MIXED_SCHEDULING.md). Reports the in-flight decodes' inter-token
     latency p50/p99 and the burst's TTFT p50/p99 for both modes, plus
     decode throughput; headline value = mixed-ON decode ITL p99 (ms).
+  fault_storm — control-plane failure-domain bench (no model, no chip;
+    docs/FAULT_TOLERANCE.md): a real in-process control plane + two agent
+    nodes serving the same component; a seeded FaultInjector schedule kills
+    node A mid-burst and revives it near the end. The same burst runs twice
+    (no-fault vs fault); reports success rate, recovery time (kill -> first
+    failed-over completion), latency p50/p99 for both runs, and asserts ZERO
+    hung executions (every one terminal). Headline value = fault-run
+    success rate (1.0 = every execution completed despite the kill).
 """
 
 from __future__ import annotations
@@ -296,6 +304,14 @@ def _run_bench() -> None:
 
         force_cpu_backend()
 
+    # fault_storm is a pure control-plane scenario (no model, no chip): it
+    # dispatches BEFORE the device probe so a wedged TPU tunnel can never
+    # block a failure-domain bench.
+    if os.environ.get("AGENTFIELD_BENCH_SCENARIO") == "fault_storm":
+        _fault_storm()
+        _done.set()
+        return
+
     # --- Stage 1: probe (claim discipline). Budget: enough for one slow
     # claim + retry, but bounded so the compile gate always gets its share.
     if os.environ.get("AGENTFIELD_BENCH_SKIP_PROBE") != "1":
@@ -446,7 +462,7 @@ def _run_bench() -> None:
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
-            "(have: shared_prefix_burst, mixed_interference)"
+            "(have: shared_prefix_burst, mixed_interference, fault_storm)"
         )
 
     demoted = None
@@ -992,6 +1008,189 @@ def _mixed_interference(model: str, cfg, params, attn: str) -> None:
             "n_burst": n_burst,
             "mixed_step_budget": budget,
             "device": str(jax.devices()[0]),
+        }
+    )
+
+
+def _fault_storm() -> None:
+    """Failure-domain storm (docs/FAULT_TOLERANCE.md): burst N sync
+    executions at a 2-node control plane while a seeded schedule kills the
+    TARGET node mid-burst and revives it near the end. Runs the identical
+    burst twice — no-fault baseline, then storm — on fresh control planes.
+
+    Deterministic by construction: the kill/revive points come from request
+    indices (kill after N/3 issued, revive after 2N/3), and every retry path
+    is driven by the gateway's own policy. Reports success rate, recovery
+    time (kill → first post-kill completion), p50/p99 for both runs; the
+    acceptance bar is ZERO hung executions — every execution terminal."""
+    import asyncio
+
+    _partial["stage"] = "fault_storm"
+    n = int(os.environ.get("AGENTFIELD_BENCH_REQUESTS") or 64)
+    grace = float(os.environ.get("AGENTFIELD_BENCH_TIMEOUT") or 30.0)
+
+    import aiohttp
+    from aiohttp import web
+
+    from agentfield_tpu.control_plane.server import ControlPlane, create_app
+
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    class _Node:
+        """Minimal agent node: POST /reasoners/task echoes; killable."""
+
+        def __init__(self):
+            self.port = _free_port()
+            self.base_url = f"http://127.0.0.1:{self.port}"
+            self.runner = None
+            self.calls = 0
+
+        async def _task(self, req):
+            body = await req.json()
+            self.calls += 1
+            return web.json_response({"result": {"echo": body.get("input")}})
+
+        async def _health(self, _req):
+            return web.json_response({"status": "ok"})
+
+        async def start(self):
+            app = web.Application()
+            app.router.add_post("/reasoners/{rid}", self._task)
+            app.router.add_get("/health", self._health)
+            self.runner = web.AppRunner(app)
+            await self.runner.setup()
+            await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
+
+        async def kill(self):
+            if self.runner is not None:
+                await self.runner.cleanup()
+                self.runner = None
+
+    async def one_run(storm: bool) -> dict:
+        cp = ControlPlane(db_path=":memory:", sync_wait_timeout=grace)
+        app = create_app(cp)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        base = f"http://127.0.0.1:{port}"
+        a, b = _Node(), _Node()
+        await a.start()
+        await b.start()
+        kill_at, revive_at = n // 3, (2 * n) // 3
+        killed_t = recovery_t = None
+        lat: list[float] = []
+        statuses: dict[str, int] = {}
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=grace + 30)
+            ) as s:
+                for node, nid in ((a, "a"), (b, "b")):
+                    async with s.post(
+                        f"{base}/api/v1/nodes",
+                        json={
+                            "node_id": nid,
+                            "base_url": node.base_url,
+                            "reasoners": [{"id": "task"}],
+                        },
+                    ) as r:
+                        assert r.status == 201, await r.text()
+
+                sem = asyncio.Semaphore(16)
+                t0 = time.perf_counter()
+
+                async def call(i: int):
+                    nonlocal killed_t, recovery_t
+                    async with sem:
+                        # Kill/revive INSIDE the semaphore: request i's slot
+                        # acquisition means ~i requests genuinely preceded it,
+                        # so the outage really lands mid-burst (before the
+                        # sem, gather's first scheduling sweep would run all
+                        # of these immediately with zero requests completed).
+                        if storm and i == kill_at:
+                            await a.kill()  # connections start refusing NOW
+                            killed_t = time.perf_counter()
+                            # the health probe would flag it within its
+                            # interval; deliver the same verdict
+                            # deterministically
+                            await cp.registry.heartbeat("a", {"status": "inactive"})
+                        if storm and i == revive_at:
+                            await a.start()
+                            await cp.registry.heartbeat("a", {"status": "active"})
+                        tc = time.perf_counter()
+                        async with s.post(
+                            f"{base}/api/v1/execute/a.task",
+                            json={
+                                "input": i,
+                                "retry_policy": {
+                                    "max_attempts": 4,
+                                    "base_backoff": 0.05,
+                                    "max_backoff": 0.5,
+                                },
+                            },
+                        ) as r:
+                            doc = await r.json()
+                    el = (time.perf_counter() - tc) * 1e3
+                    lat.append(el)
+                    st = doc.get("status", f"http_{r.status}")
+                    statuses[st] = statuses.get(st, 0) + 1
+                    if (
+                        storm
+                        and killed_t is not None
+                        and recovery_t is None
+                        and st == "completed"
+                        and time.perf_counter() > killed_t
+                    ):
+                        recovery_t = time.perf_counter() - killed_t
+                # issue sequentially-indexed tasks so the kill lands mid-burst
+                await asyncio.gather(*(call(i) for i in range(n)))
+                elapsed = time.perf_counter() - t0
+                # zero-hung check: nothing may be left non-terminal
+                hung = 0
+                for st in ("queued", "running"):
+                    async with s.get(
+                        f"{base}/api/v1/executions?status={st}&limit=1000"
+                    ) as r:
+                        hung += len((await r.json())["executions"])
+        finally:
+            await a.kill()
+            await b.kill()
+            await runner.cleanup()
+        lat.sort()
+        done = statuses.get("completed", 0)
+        return {
+            "success_rate": round(done / n, 4),
+            "statuses": statuses,
+            "latency_ms_p50": round(lat[len(lat) // 2], 1),
+            "latency_ms_p99": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)], 1),
+            "elapsed_s": round(elapsed, 2),
+            "hung_executions": hung,
+            "recovery_s": round(recovery_t, 3) if recovery_t is not None else None,
+            "calls_node_a": a.calls,
+            "calls_node_b": b.calls,
+        }
+
+    baseline = asyncio.run(one_run(storm=False))
+    _partial["fault_storm_baseline"] = baseline
+    storm = asyncio.run(one_run(storm=True))
+    _emit(
+        {
+            "metric": f"fault_storm_{n}req_kill_revive",
+            "value": storm["success_rate"],
+            "unit": "success_rate_under_node_kill",
+            "storm": storm,
+            "baseline": baseline,
+            "p99_degradation": round(
+                storm["latency_ms_p99"] / max(baseline["latency_ms_p99"], 1e-9), 2
+            ),
+            "zero_hung": storm["hung_executions"] == 0
+            and baseline["hung_executions"] == 0,
+            "requests": n,
         }
     )
 

@@ -25,7 +25,7 @@ if os.environ.get("AGENTFIELD_MODEL_CPU") == "1":
     force_cpu_backend()
 
 from agentfield_tpu.serving import EngineConfig
-from agentfield_tpu.serving.model_node import build_model_node
+from agentfield_tpu.serving.model_node import build_model_node, install_sigterm_drain
 
 
 async def main() -> None:
@@ -48,11 +48,13 @@ async def main() -> None:
     await backend.start()
     await agent.start()
     print(f"model node '{model}' registered at :{agent.port}", flush=True)
-    try:
-        await asyncio.Event().wait()
-    finally:
-        await agent.stop()
-        await backend.stop()
+    # SIGTERM → graceful drain: stop admitting, finish (or deadline-out)
+    # in-flight decodes, deregister, exit — rolling restarts don't kill
+    # live requests (docs/OPERATIONS.md runbook).
+    drained = install_sigterm_drain(
+        agent, backend, grace_s=float(os.environ.get("AGENTFIELD_DRAIN_GRACE", "30")),
+    )
+    await drained.wait()
 
 
 if __name__ == "__main__":

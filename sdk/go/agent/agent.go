@@ -348,7 +348,8 @@ func (a *Agent) aiRequest(ctx context.Context, input map[string]any, opts *AiOpt
 		}
 		errStr, _ := doc["error"].(string)
 		backpressure := status == http.StatusServiceUnavailable ||
-			(strings.Contains(errStr, "QueueFullError") && doc["status"] == "failed")
+			(strings.Contains(errStr, "QueueFullError") &&
+				(doc["status"] == "failed" || doc["status"] == "dead_letter"))
 		if !backpressure {
 			break
 		}

@@ -43,23 +43,44 @@ class FakeAgent:
       - boom      → 500
       - slow      → sleeps `slow_s`, then 200
       - silent202 → 202 and never calls back
+      - flaky     → 500 while `flaky_remaining` > 0 (decrementing), then echo
+
+    `behavior_map` remaps an advertised reasoner id to another behavior, so
+    two nodes can expose the SAME component name with different conduct
+    (failover tests: node A's "task" is silent202, node B's completes).
     """
 
-    def __init__(self, control_plane_url: str, slow_s: float = 1.0):
+    def __init__(
+        self,
+        control_plane_url: str,
+        slow_s: float = 1.0,
+        behavior_map: dict[str, str] | None = None,
+        extra_reasoners: tuple[str, ...] = (),
+    ):
         self.cp_url = control_plane_url
         self.slow_s = slow_s
+        self.behavior_map = behavior_map or {}
+        self.extra_reasoners = extra_reasoners
+        self.flaky_remaining = 0  # consecutive 500s "flaky" still owes
         self.port = free_port()
         self.base_url = f"http://127.0.0.1:{self.port}"
         self.calls: list[dict] = []
         self.runner: web.AppRunner | None = None
 
     def reasoner_specs(self):
-        return [{"id": r} for r in ("echo", "deferred", "boom", "slow", "silent202")]
+        ids = ("echo", "deferred", "boom", "slow", "silent202", "flaky")
+        return [{"id": r} for r in ids + tuple(self.extra_reasoners)]
 
     async def _handle(self, req: web.Request):
         rid = req.match_info["rid"]
         body = await req.json()
         self.calls.append({"rid": rid, "body": body, "headers": dict(req.headers)})
+        rid = self.behavior_map.get(rid, rid)
+        if rid == "flaky":
+            if self.flaky_remaining > 0:
+                self.flaky_remaining -= 1
+                return web.Response(status=500, text="flaky")
+            rid = "echo"
         if rid == "echo":
             return web.json_response({"result": {"echo": body.get("input")}})
         if rid == "boom":
@@ -84,9 +105,13 @@ class FakeAgent:
             return web.Response(status=202)
         return web.Response(status=404)
 
+    async def _health(self, _req: web.Request):
+        return web.json_response({"status": "ok"})
+
     async def start(self):
         app = web.Application()
         app.router.add_post("/reasoners/{rid}", self._handle)
+        app.router.add_get("/health", self._health)
         self.runner = web.AppRunner(app)
         await self.runner.setup()
         await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
@@ -122,12 +147,16 @@ class CPHarness:
         await self._runner.cleanup()
 
     async def register_agent(self, node_id: str = "fake-agent"):
+        return await self.register_fake(self.agent, node_id)
+
+    async def register_fake(self, agent: FakeAgent, node_id: str):
+        """Register any FakeAgent instance (multi-node failover topologies)."""
         async with self.http.post(
             "/api/v1/nodes",
             json={
                 "node_id": node_id,
-                "base_url": self.agent.base_url,
-                "reasoners": self.agent.reasoner_specs(),
+                "base_url": agent.base_url,
+                "reasoners": agent.reasoner_specs(),
             },
         ) as r:
             assert r.status == 201, await r.text()

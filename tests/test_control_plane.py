@@ -183,7 +183,10 @@ async def test_error_paths():
         await h.register_agent()
         async with h.http.post("/api/v1/execute/fake-agent.boom", json={}) as r:
             doc = await r.json()
-        assert doc["status"] == "failed" and "500" in doc["error"]
+        # agent 5xx is a node-level failure: retried to budget exhaustion,
+        # then parked in DEAD_LETTER (not FAILED) for operator triage
+        assert doc["status"] == "dead_letter" and "500" in doc["error"]
+        assert doc["attempts"] == 3
         async with h.http.post("/api/v1/execute/no-dot", json={}) as r:
             assert r.status == 400
         async with h.http.post("/api/v1/execute/ghost.echo", json={}) as r:
@@ -199,7 +202,8 @@ async def test_agent_timeout_fails_execution():
         await h.register_agent()
         async with h.http.post("/api/v1/execute/fake-agent.slow", json={}) as r:
             doc = await r.json()
-        assert doc["status"] == "failed"
+        # transport timeout = node-level failure: retried, then dead-lettered
+        assert doc["status"] == "dead_letter"
         assert "agent call failed" in doc["error"]
 
 
@@ -430,7 +434,7 @@ async def test_reasoner_listing_and_metrics():
             async with h.http.post("/api/v1/execute/fake-agent.echo", json={"input": 1}) as r:
                 assert (await r.json())["status"] == "completed"
         async with h.http.post("/api/v1/execute/fake-agent.boom", json={}) as r:
-            assert (await r.json())["status"] == "failed"
+            assert (await r.json())["status"] == "dead_letter"
         async with h.http.get("/api/v1/reasoners/fake-agent.echo/metrics") as r:
             m = await r.json()
         assert m["executions"] == 3 and m["success_rate"] == 1.0

@@ -1,0 +1,194 @@
+"""Engine deadlines, cancel accounting, graceful drain, and injected page
+pressure (ISSUE 3 failure-domain hardening, serving side).
+
+Reuses the llama-tiny ECFG of test_serving_engine so no new engine-config
+compilations enter tier-1.
+"""
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+from agentfield_tpu.serving.model_node import ModelBackend, NodeDrainingError
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faults.install(None)
+
+
+def _prompt(key, n):
+    return jax.random.randint(key, (n,), 0, CFG.vocab_size, jnp.int32).tolist()
+
+
+def _req(rid, prompt, max_new=8, **kw):
+    return Request(id=rid, prompt=prompt, sampling=SamplingParams(max_new_tokens=max_new), **kw)
+
+
+def test_deadline_expires_active_request(params):
+    """A decoding request whose deadline lapses finishes with a terminal
+    deadline_exceeded event; its pages free; an undeadlined peer completes
+    untouched."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    engine.submit(_req("dl", _prompt(jax.random.PRNGKey(0), 5), max_new=48, deadline_s=0.001))
+    engine.submit(_req("ok", _prompt(jax.random.PRNGKey(1), 5), max_new=4))
+    time.sleep(0.01)  # the deadline is already expired at the first step
+    events = []
+    t0 = time.monotonic()
+    while engine.has_work() and time.monotonic() - t0 < 60:
+        events += engine.step()
+    by_req = {}
+    for ev in events:
+        if ev.finished:
+            by_req[ev.request_id] = ev
+    assert by_req["dl"].finish_reason == "deadline_exceeded"
+    assert by_req["dl"].token == -1
+    assert by_req["ok"].finish_reason == "length"
+    assert engine.stats["deadline_exceeded"] == 1
+    assert engine.allocator.free_pages == ECFG.num_pages - 1  # pages returned
+    assert not engine._deadline_at  # no leaked deadline entries
+
+
+def test_deadline_validation(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    with pytest.raises(ValueError, match="deadline_s"):
+        engine.submit(_req("bad", [1, 2, 3], deadline_s=0.0))
+
+
+def test_rejected_deadline_does_not_pin_grammar_rows(params):
+    """deadline_s validation runs BEFORE _grammar_acquire: a rejected
+    request must never leave a reference pinning grammar-bank rows."""
+    import dataclasses
+
+    from agentfield_tpu.serving.grammar import compile_json_schema
+
+    vocab = [bytes([i]) for i in range(min(256, CFG.vocab_size))]
+    vocab += [b"\x00"] * (CFG.vocab_size - len(vocab))
+    g = compile_json_schema({"type": "boolean"}, vocab)
+    engine = InferenceEngine(
+        params, CFG, dataclasses.replace(ECFG, grammar_slots=32)
+    )
+    bad = Request(
+        id="bad",
+        prompt=[1, 2, 3],
+        sampling=SamplingParams(max_new_tokens=4, stop_token_ids=(0,)),
+        grammar=g,
+        deadline_s=-1.0,
+    )
+    with pytest.raises(ValueError, match="deadline_s"):
+        engine.submit(bad)
+    assert engine.grammar_bank_stats()["grammar_bank_grammars_in_use"] == 0
+
+
+def test_no_deadline_no_overhead_token_exact(params):
+    """With no deadlines set the scheduler output is bit-identical to the
+    plain path (the expiry scan is an empty-dict no-op)."""
+    prompts = [_prompt(jax.random.PRNGKey(i), 6) for i in range(2)]
+    a = InferenceEngine(params, CFG, ECFG)
+    ra = a.run_to_completion([_req(f"r{i}", p, 6) for i, p in enumerate(prompts)])
+    b = InferenceEngine(params, CFG, ECFG)
+    rb = b.run_to_completion([_req(f"r{i}", p, 6) for i, p in enumerate(prompts)])
+    assert ra == rb
+    assert a._deadline_at == {} and a.stats["deadline_exceeded"] == 0
+
+
+def test_cancels_unknown_counted(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    # Unknown id: never submitted.
+    engine.request_cancel("ghost")
+    engine.step()
+    assert engine.stats["cancels_unknown"] == 1
+    # Already-finished id: the client cancels after completion.
+    engine.run_to_completion([_req("done", _prompt(jax.random.PRNGKey(2), 5), 2)])
+    engine.request_cancel("done")
+    engine.step()
+    assert engine.stats["cancels_unknown"] == 2
+    # A REAL cancel of a pending request is not "unknown".
+    engine.submit(_req("pend", _prompt(jax.random.PRNGKey(3), 5), 4))
+    engine.request_cancel("pend")
+    engine.step()
+    assert engine.stats["cancels_unknown"] == 2
+    assert engine.stats["requests_cancelled"] >= 1
+
+
+def test_deadline_all_now_terminates_everything(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    for i in range(3):
+        engine.submit(_req(f"r{i}", _prompt(jax.random.PRNGKey(i), 5), max_new=48))
+    engine.step()  # admit at least the first batch
+    n = engine.deadline_all_now()
+    assert n == 3
+    events = []
+    t0 = time.monotonic()
+    while engine.has_work() and time.monotonic() - t0 < 60:
+        events += engine.step()
+    reasons = {e.request_id: e.finish_reason for e in events if e.finished}
+    assert reasons == {f"r{i}": "deadline_exceeded" for i in range(3)}
+    assert engine.allocator.free_pages == ECFG.num_pages - 1
+
+
+def test_injected_page_pressure_denies_then_recovers(params):
+    """The seeded page-pressure fault makes the first admissions behave like
+    an exhausted pool; when the schedule runs out, everything admits and
+    completes (the starvation machinery holds, nothing wedges)."""
+    faults.install(
+        faults.FaultInjector(seed=2, spec={"engine.page_pressure": {"prob": 1.0, "times": 2}})
+    )
+    engine = InferenceEngine(params, CFG, ECFG)
+    res = engine.run_to_completion(
+        [_req(f"r{i}", _prompt(jax.random.PRNGKey(i), 5), 4) for i in range(3)]
+    )
+    assert all(len(v) == 4 for v in res.values())
+    assert engine.stats["page_pressure_injected"] == 2
+
+
+def test_model_backend_drain(params):
+    """ModelBackend.drain: in-flight work deadline-outs at the grace cutoff
+    (the caller gets a terminal answer, not a hang) and new admissions are
+    refused with the retryable NodeDrainingError."""
+
+    async def main():
+        backend = ModelBackend(params, CFG, ECFG, model_name="t")
+        await backend.start()
+        try:
+            task = asyncio.create_task(
+                backend.generate(tokens=[1, 2, 3, 4], max_new_tokens=48)
+            )
+            # wait until the request is actually in flight
+            for _ in range(200):
+                if backend.engine.has_work():
+                    break
+                await asyncio.sleep(0.01)
+            assert backend.engine.has_work()
+            summary = await backend.drain(grace_s=0.05)
+            assert summary["drained"], summary
+            assert summary["deadline_outed"] == 1
+            result = await asyncio.wait_for(task, timeout=30)
+            assert result["finish_reason"] == "deadline_exceeded"
+            assert isinstance(result["tokens"], list)  # partial output kept
+            with pytest.raises(NodeDrainingError):
+                await backend.generate(tokens=[1], max_new_tokens=1)
+            # drain is idempotent; counters exported for the heartbeat pipe
+            summary2 = await backend.drain(grace_s=0.01)
+            assert summary2["drained"] and summary2["deadline_outed"] == 0
+            assert backend.engine.stats["drains_total"] == 1
+            assert backend.engine.stats["drain_cancelled"] == 1
+        finally:
+            await backend.stop()
+
+    asyncio.run(asyncio.wait_for(main(), timeout=120))

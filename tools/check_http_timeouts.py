@@ -1,0 +1,84 @@
+"""Lint: every HTTP client construction must carry an explicit timeout.
+
+An ``aiohttp.ClientSession`` (or httpx client) built without a ``timeout=``
+has NO total timeout — any await on it can hang forever on a half-dead peer,
+which is exactly the failure mode the gateway retry/deadline layer exists to
+bound (docs/FAULT_TOLERANCE.md). This lint walks the SHIPPED code
+(``agentfield_tpu/``, ``tools/``, ``examples/``, ``bench.py``; tests spin
+ephemeral localhost servers and are exempt) and flags every
+session/client construction whose
+argument list does not pass ``timeout=``. A deliberately unbounded stream
+still passes ``timeout=ClientTimeout(total=None, connect=...)`` — the point
+is that "no bound" must be an explicit decision at the call site, never a
+default. Runs in tier-1 via
+``tests/test_fault_tolerance.py::test_http_timeouts_lint`` and standalone:
+
+    python tools/check_http_timeouts.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+_CTOR_RE = re.compile(r"\b(?:ClientSession|httpx\.Client|httpx\.AsyncClient)\s*\(")
+
+_SCAN_DIRS = ("agentfield_tpu", "tools", "examples")
+_SCAN_FILES = ("bench.py",)
+
+
+def _call_args(text: str, open_paren: int) -> str:
+    """The argument text of the call whose '(' is at `open_paren`
+    (balanced-paren scan; good enough for linting real source)."""
+    depth = 0
+    for i in range(open_paren, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_paren + 1 : i]
+    return text[open_paren + 1 :]  # unbalanced (truncated file): best effort
+
+
+def check(repo_root: pathlib.Path | None = None) -> list[str]:
+    """Returns "path:line: ..." violation strings (empty = pass)."""
+    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    files: list[pathlib.Path] = [root / f for f in _SCAN_FILES]
+    for d in _SCAN_DIRS:
+        files += sorted((root / d).rglob("*.py"))
+    bad: list[str] = []
+    for path in files:
+        if not path.is_file() or "__pycache__" in path.parts:
+            continue
+        text = path.read_text(encoding="utf-8")
+        for m in _CTOR_RE.finditer(text):
+            args = _call_args(text, m.end() - 1)
+            if re.search(r"\btimeout\s*=", args):
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            bad.append(
+                f"{path.relative_to(root)}:{line}: {m.group(0).strip()}...) "
+                "without an explicit timeout="
+            )
+    return bad
+
+
+def main() -> int:
+    bad = check()
+    if bad:
+        print(
+            "HTTP clients built without an explicit timeout (pass timeout=..., "
+            "or timeout=ClientTimeout(total=None, connect=...) for a "
+            "deliberately unbounded stream):\n  " + "\n  ".join(bad),
+            file=sys.stderr,
+        )
+        return 1
+    print("check_http_timeouts: all HTTP client call sites pass an explicit timeout")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

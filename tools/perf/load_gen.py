@@ -110,7 +110,7 @@ async def _poll(session, url: str, eid: str, timeout: float) -> str:
     while time.monotonic() < deadline:
         async with session.get(f"{url}/api/v1/executions/{eid}") as resp:
             doc = await resp.json()
-        if doc.get("status") in ("completed", "failed", "timeout"):
+        if doc.get("status") in ("completed", "failed", "timeout", "dead_letter"):
             return doc["status"]
         await asyncio.sleep(interval)
         interval = min(interval * 1.5, 0.5)
@@ -119,7 +119,9 @@ async def _poll(session, url: str, eid: str, timeout: float) -> str:
 
 async def scrape_metrics(url: str) -> dict:
     try:
-        async with aiohttp.ClientSession() as s:
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=15)
+        ) as s:
             async with s.get(f"{url}/metrics") as resp:
                 text = await resp.text()
         out = {}

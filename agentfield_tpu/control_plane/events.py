@@ -17,13 +17,19 @@ from typing import Any, AsyncIterator
 
 
 class EventBus:
-    def __init__(self, maxsize: int = 256, history: int = 0):
+    def __init__(self, maxsize: int = 256, history: int = 0, metrics=None):
         self._subs: dict[str, set[asyncio.Queue]] = collections.defaultdict(set)
         self._maxsize = maxsize
         self._history: collections.deque | None = (
             collections.deque(maxlen=history) if history else None
         )
         self.dropped = 0
+        # Drops are counted PER TOPIC (a slow SSE consumer on "executions"
+        # and a slow one on "memory" are different operational problems),
+        # and exported as ``events_dropped_total{topic=...}`` when a metrics
+        # registry is attached — a silent swallow was invisible to operators.
+        self.dropped_by_topic: collections.Counter[str] = collections.Counter()
+        self._metrics = metrics
 
     def publish(self, topic: str, event: Any) -> None:
         """Non-blocking publish; slow subscribers drop events (the reference
@@ -35,6 +41,9 @@ class EventBus:
                 q.put_nowait((topic, event))
             except asyncio.QueueFull:
                 self.dropped += 1
+                self.dropped_by_topic[topic] += 1
+                if self._metrics is not None:
+                    self._metrics.inc("events_dropped_total", labels={"topic": topic})
 
     def subscribe(self, topic: str = "*") -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=self._maxsize)

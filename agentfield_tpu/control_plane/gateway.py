@@ -28,7 +28,11 @@ import aiohttp
 from agentfield_tpu.control_plane import faults
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
-from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
+from agentfield_tpu.control_plane.storage import (
+    AsyncStorage,
+    SQLiteStorage,
+    is_duplicate_key,
+)
 from agentfield_tpu.control_plane.types import (
     AgentNode,
     Execution,
@@ -136,9 +140,13 @@ class ExecutionGateway:
         db: AsyncStorage | None = None,  # shared async facade (built if absent)
         retry_policy: RetryPolicy | None = None,  # default node-failure retry
         # (per-execution "retry_policy" in the request body overrides it)
+        node_cache=None,  # registry.NodeSnapshotCache | None — dispatch fast
+        # path: node resolution in _prepare/_pick_node served from the
+        # registry's in-memory snapshot instead of a SQLite scan per request
     ):
         self.payloads = payloads
         self.storage = storage
+        self._node_cache = node_cache
         # Awaitable storage: Postgres calls hop to a worker thread so a slow
         # database can't stall the event loop (SQLite stays on-loop).
         self.db = db if db is not None else AsyncStorage(storage)
@@ -193,6 +201,16 @@ class ExecutionGateway:
 
     # ------------------------------------------------------------------
 
+    async def _node_get(self, node_id: str) -> AgentNode | None:
+        if self._node_cache is not None:
+            return await self._node_cache.get(node_id)
+        return await self.db.get_node(node_id)
+
+    async def _node_list(self) -> list[AgentNode]:
+        if self._node_cache is not None:
+            return await self._node_cache.list()
+        return await self.db.list_nodes()
+
     async def _prepare(
         self,
         target: str,
@@ -209,7 +227,7 @@ class ExecutionGateway:
         if "." not in target:
             raise GatewayError(400, f"target {target!r} must be '<node>.<component>'")
         node_id, comp_name = target.split(".", 1)
-        node = await self.db.get_node(node_id)
+        node = await self._node_get(node_id)
         if node is None:
             raise GatewayError(404, f"unknown node {node_id!r}")
         found = node.component(comp_name)
@@ -222,7 +240,7 @@ class ExecutionGateway:
             # it (a dead target must not 503 callers while capacity exists).
             # With no capable node anywhere, 503 as before.
             alt = None
-            for cand in await self.db.list_nodes():
+            for cand in await self._node_list():
                 if (
                     cand.node_id != node_id
                     and cand.status == NodeStatus.ACTIVE
@@ -239,6 +257,7 @@ class ExecutionGateway:
         headers = {k.title(): v for k, v in headers.items()}
         if self.payloads is not None:
             payload = await asyncio.to_thread(self.payloads.offload, payload)
+        caller_supplied_id = bool(headers.get("X-Execution-Id"))
         ex = Execution(
             execution_id=headers.get("X-Execution-Id") or new_id("exec"),
             target=target,
@@ -254,15 +273,11 @@ class ExecutionGateway:
             retry_policy=retry_policy,
         )
         try:
-            await self.db.create_execution(ex)
+            # Freshly-minted ids skip the journal's duplicate table probe
+            # (only caller-supplied ids can collide with existing rows).
+            await self.db.create_execution(ex, check_duplicate=caller_supplied_id)
         except Exception as e:
-            # SQLite spells it "UNIQUE constraint failed"; Postgres raises
-            # SQLSTATE 23505 ("duplicate key value violates unique constraint")
-            if (
-                "UNIQUE" in str(e)
-                or "PRIMARY KEY" in str(e)
-                or getattr(e, "sqlstate", "") == "23505"
-            ):
+            if is_duplicate_key(e):
                 raise GatewayError(
                     409, f"execution id {ex.execution_id!r} already exists"
                 ) from None
@@ -364,13 +379,13 @@ class ExecutionGateway:
         before the retry budget says so."""
         own_id, comp = ex.target.split(".", 1)
         candidates: list[AgentNode] = []
-        own = await self.db.get_node(own_id)
+        own = await self._node_get(own_id)
         # STARTING is dispatchable for the NAMED node (matching _prepare's
         # admission — the old worker called a starting node too); failover
         # substitutes must be fully ACTIVE.
         if own is not None and own.status in (NodeStatus.ACTIVE, NodeStatus.STARTING):
             candidates.append(own)
-        for node in await self.db.list_nodes():
+        for node in await self._node_list():
             if node.node_id == own_id or node.status != NodeStatus.ACTIVE:
                 continue
             if self._capable_substitute(node, comp, own):
@@ -380,21 +395,29 @@ class ExecutionGateway:
                 return node
         return candidates[0] if candidates else None
 
-    async def _dispatch(self, ex: Execution, node: AgentNode | None = None) -> None:
+    async def _dispatch(
+        self, ex: Execution, node: AgentNode | None = None
+    ) -> Execution | None:
         """Retry/failover driver around ``_call_agent_once`` (the recovery
         the reference leaves to each SDK client — here the orchestration
         layer owns it). Node-level failures retry with full-jitter backoff,
         failing over to the next capable active node; budget exhaustion (or
         no capable node at all) parks the execution in DEAD_LETTER for
-        operator triage/requeue instead of FAILED."""
+        operator triage/requeue instead of FAILED.
+
+        Returns the TERMINAL execution when dispatch itself finished the
+        work (completed / fatal / budget exhausted) so callers need no
+        re-read, or None when completion was deferred to a status callback.
+        Attempt bookkeeping on terminal paths rides the ``complete()``
+        transition itself (one storage write) instead of a separate
+        read-check-write round trip; only deferred work persists it
+        standalone — the orphan requeue must see which node holds the 202.
+        """
         policy = self.retry_policy.merged(ex.retry_policy)
         tried: set[str] = set()
         self._dispatching.add(ex.execution_id)
 
         async def persist_attempts() -> None:
-            # complete() re-reads the row, so attempt bookkeeping must land
-            # in storage BEFORE the terminal transition (and for deferred
-            # work, so the orphan requeue sees which node holds it).
             cur = await self.db.get_execution(ex.execution_id)
             if cur is not None and not cur.status.terminal:
                 cur.attempts = ex.attempts
@@ -415,16 +438,22 @@ class ExecutionGateway:
                 ex.nodes_tried.append(node.node_id)
                 outcome, data = await self._call_agent_once(node, ex)
                 if outcome == "completed":
-                    await persist_attempts()
-                    await self.complete(ex.execution_id, result=data)
-                    return
+                    return await self.complete(
+                        ex.execution_id,
+                        result=data,
+                        attempts=ex.attempts,
+                        nodes_tried=ex.nodes_tried,
+                    )
                 if outcome == "deferred":
                     await persist_attempts()
-                    return
+                    return None
                 if outcome == "fatal":
-                    await persist_attempts()
-                    await self.complete(ex.execution_id, error=data)
-                    return
+                    return await self.complete(
+                        ex.execution_id,
+                        error=data,
+                        attempts=ex.attempts,
+                        nodes_tried=ex.nodes_tried,
+                    )
                 # node_error — retryable
                 last_err = data
                 tried.add(node.node_id)
@@ -441,7 +470,7 @@ class ExecutionGateway:
                 # finished work.
                 cur = await self.db.get_execution(ex.execution_id)
                 if cur is None or cur.status.terminal:
-                    return
+                    return cur
                 if ex.attempts >= policy.max_attempts:
                     break
                 nxt = await self._pick_node(ex, tried)
@@ -451,12 +480,13 @@ class ExecutionGateway:
                 if node is None:
                     break
                 await asyncio.sleep(policy.backoff(ex.attempts, self._retry_rng))
-            await persist_attempts()
-            await self.complete(
+            return await self.complete(
                 ex.execution_id,
                 error=f"retry budget exhausted after {ex.attempts} attempt(s) "
                 f"over nodes {ex.nodes_tried}: {last_err}",
                 dead_letter=True,
+                attempts=ex.attempts,
+                nodes_tried=ex.nodes_tried,
             )
         except asyncio.CancelledError:
             # The caller vanished mid-retry (HTTP disconnect / client
@@ -470,6 +500,8 @@ class ExecutionGateway:
                 self.complete(
                     ex.execution_id,
                     error="dispatch cancelled: caller disconnected mid-retry",
+                    attempts=ex.attempts,
+                    nodes_tried=ex.nodes_tried,
                 )
             )
             self._bg_completions.add(t)
@@ -496,7 +528,10 @@ class ExecutionGateway:
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
             retry_policy=retry_policy,
         )
-        await self._dispatch(ex, node)
+        done = await self._dispatch(ex, node)
+        if done is not None and done.status.terminal:
+            return done  # dispatch finished the work: no re-read needed
+        # Deferred (202) path: a status callback may have landed already.
         current = await self.db.get_execution(ex.execution_id)
         if current is not None and current.status.terminal:
             return current
@@ -574,14 +609,28 @@ class ExecutionGateway:
         error: str | None = None,
         timeout: bool = False,
         dead_letter: bool = False,
+        attempts: int | None = None,
+        nodes_tried: list[str] | None = None,
     ) -> Execution | None:
         """Terminal-state transition: persist once, publish once, fire webhook
         (reference: completeExecution/failExecution, execute.go:831-919;
         completions serialized by _complete_lock — the thread-offloaded
         storage provider yields the loop mid-transition, so loop ordering
-        alone no longer guarantees exactly-once)."""
+        alone no longer guarantees exactly-once). ``attempts``/``nodes_tried``
+        let the dispatch loop fold its retry bookkeeping into the terminal
+        write instead of a separate read-check-write round trip."""
         async with self._complete_lock:
-            return await self._complete_locked(execution_id, result, error, timeout, dead_letter)
+            ex, barrier = await self._complete_locked(
+                execution_id, result, error, timeout, dead_letter,
+                attempts=attempts, nodes_tried=nodes_tried,
+            )
+        if barrier is not None:
+            # Group-commit durability barrier, awaited OUTSIDE the completion
+            # lock: every completion that lands within one flush tick shares
+            # a single commit, and the caller's acknowledgment still goes
+            # out only after that commit (docs/OPERATIONS.md).
+            await barrier
+        return ex
 
     async def _complete_locked(
         self,
@@ -590,10 +639,15 @@ class ExecutionGateway:
         error: str | None = None,
         timeout: bool = False,
         dead_letter: bool = False,
-    ) -> Execution | None:
+        attempts: int | None = None,
+        nodes_tried: list[str] | None = None,
+    ) -> tuple[Execution | None, Any]:
+        """Returns (execution, durability_barrier). The barrier is None on
+        the eager-commit path; with the group-commit journal it is an
+        awaitable the caller must await AFTER releasing _complete_lock."""
         ex = await self.db.get_execution(execution_id)
         if ex is None:
-            return None
+            return None, None
         if ex.status.terminal:
             # Idempotent: late callbacks don't double-complete. One refinement
             # (sync-wait-timeout race): a RESULT arriving after the timeout
@@ -620,7 +674,14 @@ class ExecutionGateway:
                     execution_id=ex.execution_id,
                     status=ex.status.value,
                 )
-            return ex
+            return ex, None
+        # Retry bookkeeping folded into the terminal write (the dispatch
+        # loop's attempts are authoritative — they only ever run ahead of
+        # what a standalone persist would have recorded).
+        if attempts is not None:
+            ex.attempts = attempts
+        if nodes_tried is not None:
+            ex.nodes_tried = list(nodes_tried)
         if dead_letter:
             ex.status = ExecutionStatus.DEAD_LETTER
             ex.error = error
@@ -638,7 +699,20 @@ class ExecutionGateway:
             else:
                 ex.result = result
         ex.finished_at = now()
-        await self.db.update_execution(ex)
+        journal = getattr(self.storage, "journal", None)
+        barrier = None
+        if journal is not None:
+            # Group commit: the terminal row is overlay-visible to every
+            # reader the instant it is enqueued (the race window the lock
+            # protects closes HERE); the commit itself is shared with every
+            # other completion landing this flush tick. Events/webhooks
+            # below fire inside the (at most) one-tick pre-durability
+            # window — an at-least-once delivery wrinkle bounded by the
+            # flush interval (docs/OPERATIONS.md).
+            journal.enqueue_terminal(ex)
+            barrier = journal.flush_barrier()
+        else:
+            await self.db.update_execution(ex)
         self.metrics.inc(f"gateway_executions_{ex.status.value}_total")
         log.info(
             "execution terminal",
@@ -658,7 +732,7 @@ class ExecutionGateway:
 
                 notify_ex = _dc.replace(ex, result=raw_result)
             await self.webhook_notify(notify_ex)
-        return ex
+        return ex, barrier
 
     async def handle_status_update(
         self, execution_id: str, status: str, result: Any = None, error: str | None = None

@@ -12,6 +12,7 @@ the reference defaults (TTL 5m, sweep 30s, evict 30m — server.go:131-137).
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Any
 
 from agentfield_tpu.control_plane import faults
@@ -39,6 +40,103 @@ class RegistryError(Exception):
         self.message = message
 
 
+class NodeSnapshotCache:
+    """Generation-stamped in-memory snapshot of the node table.
+
+    Every gateway dispatch used to re-scan ``agent_nodes`` and JSON-decode
+    every row (`list_nodes()` in ``_prepare``/``_pick_node``); this cache
+    serves those hot-path reads from memory. Registry write paths —
+    register, heartbeat persist, status change (including the node-down
+    hook's INACTIVE transitions), deregister/evict, sweep — bump the
+    generation, so the next read rebuilds once from storage and then hits
+    until the next change. A TTL additionally bounds staleness against
+    writers this process cannot observe (a second control-plane instance on
+    a shared Postgres, tests poking storage directly).
+
+    Returned ``AgentNode`` objects are SHARED snapshot entries: callers must
+    treat them as read-only (the gateway only reads; registry mutations go
+    through fresh ``db.get_node`` fetches).
+
+    Knobs: ``AGENTFIELD_REGISTRY_CACHE=0`` disables (every read falls
+    through to storage); ``AGENTFIELD_REGISTRY_CACHE_TTL_S`` (default 2.0)
+    bounds snapshot age. Hit/miss counters ride the existing metrics →
+    Prometheus pipeline (``registry_cache_hits_total`` / ``_misses_total``).
+    """
+
+    def __init__(
+        self,
+        db: AsyncStorage,
+        metrics: Metrics | None = None,
+        enabled: bool | None = None,
+        ttl_s: float | None = None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("AGENTFIELD_REGISTRY_CACHE", "1").lower() not in (
+                "0",
+                "false",
+                "no",
+            )
+        if ttl_s is None:
+            try:
+                ttl_s = float(os.environ.get("AGENTFIELD_REGISTRY_CACHE_TTL_S", "2.0"))
+            except ValueError:
+                ttl_s = 2.0
+        self.enabled = enabled
+        self.ttl_s = ttl_s
+        self._db = db
+        self._metrics = metrics
+        self._gen = 0  # bumped by invalidate()
+        self._snap_gen = -1  # generation the current snapshot was built at
+        self._snap_at = 0.0
+        self._by_id: dict[str, AgentNode] = {}
+        self._rebuild_lock = asyncio.Lock()
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    def invalidate(self) -> None:
+        self._gen += 1
+
+    def _count(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def _fresh(self) -> bool:
+        return self._snap_gen == self._gen and now() - self._snap_at <= self.ttl_s
+
+    async def _snapshot(self) -> dict[str, AgentNode]:
+        if self._fresh():
+            self._count("registry_cache_hits_total")
+            return self._by_id
+        async with self._rebuild_lock:
+            if self._fresh():  # a concurrent rebuild landed while we waited
+                self._count("registry_cache_hits_total")
+                return self._by_id
+            # Stamp the generation BEFORE the read: an invalidation racing
+            # the list_nodes() fetch must force another rebuild, never be
+            # masked by this one.
+            gen = self._gen
+            nodes = await self._db.list_nodes()
+            self._by_id = {n.node_id: n for n in nodes}
+            self._snap_gen = gen
+            self._snap_at = now()
+            self._count("registry_cache_misses_total")
+            return self._by_id
+
+    async def get(self, node_id: str) -> AgentNode | None:
+        if not self.enabled:
+            self._count("registry_cache_misses_total")
+            return await self._db.get_node(node_id)
+        return (await self._snapshot()).get(node_id)
+
+    async def list(self) -> list[AgentNode]:
+        if not self.enabled:
+            self._count("registry_cache_misses_total")
+            return await self._db.list_nodes()
+        return list((await self._snapshot()).values())
+
+
 class NodeRegistry:
     def __init__(
         self,
@@ -50,11 +148,19 @@ class NodeRegistry:
         evict_after: float = 1800.0,
         did_service=None,
         db=None,  # shared AsyncStorage facade (built if absent)
+        cache_enabled: bool | None = None,  # None → $AGENTFIELD_REGISTRY_CACHE
+        cache_ttl_s: float | None = None,  # None → $AGENTFIELD_REGISTRY_CACHE_TTL_S
     ):
         self.storage = storage
         self.db = db if db is not None else AsyncStorage(storage)
         self.bus = bus
         self.metrics = metrics
+        # Dispatch fast path: the gateway resolves nodes from this snapshot
+        # instead of re-scanning SQLite per request; every registry write
+        # below invalidates it.
+        self.cache = NodeSnapshotCache(
+            self.db, metrics, enabled=cache_enabled, ttl_s=cache_ttl_s
+        )
         self.did_service = did_service
         self.heartbeat_ttl = heartbeat_ttl
         self.sweep_interval = sweep_interval
@@ -135,6 +241,7 @@ class NodeRegistry:
             for comp in node.reasoners + node.skills:
                 comp.did = self.did_service.component_did(node_id, comp.id)
         await self.db.upsert_node(node)
+        self.cache.invalidate()
         self._last_persist[node_id] = now()
         self.metrics.inc("nodes_registered_total")
         self.bus.publish(NODE_TOPIC, {"type": "registered", "node_id": node_id, "ts": now()})
@@ -205,6 +312,7 @@ class NodeRegistry:
         # staleness (TTL is 300s >> 10s).
         if node.status != old_status or now() - self._last_persist.get(node_id, 0) > 10.0:
             await self.db.upsert_node(node)
+            self.cache.invalidate()
             self._last_persist[node_id] = now()
         return node
 
@@ -223,6 +331,7 @@ class NodeRegistry:
     async def deregister(self, node_id: str) -> bool:
         ok = await self.db.delete_node(node_id)
         if ok:
+            self.cache.invalidate()
             self._last_persist.pop(node_id, None)
             self._fences.pop(node_id, None)
             # a dead node's engine gauges must not linger in /metrics
@@ -266,6 +375,7 @@ class NodeRegistry:
                 self._publish_status(node.node_id, node.status, NodeStatus.INACTIVE)
                 node.status = NodeStatus.INACTIVE
                 await self.db.upsert_node(node)
+                self.cache.invalidate()
                 marked += 1
             elif node.status == NodeStatus.ACTIVE:
                 active += 1

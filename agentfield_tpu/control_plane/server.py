@@ -53,6 +53,12 @@ class ControlPlane:
         admin_grpc_port: int | None = None,  # reference serves admin gRPC on port+100
         health_interval: float = 30.0,  # active probe cadence (health_monitor.go)
         data_dir: str | None = None,  # package registry root (packages page)
+        db_group_commit_ms: float | None = None,  # write-behind execution
+        # journal flush tick; None → $AGENTFIELD_DB_GROUP_COMMIT_MS, 0 = off
+        # (docs/OPERATIONS.md "Durability vs throughput")
+        registry_cache: bool | None = None,  # dispatch-path node snapshot
+        # cache; None → $AGENTFIELD_REGISTRY_CACHE (default on)
+        registry_cache_ttl: float | None = None,  # None → $AGENTFIELD_REGISTRY_CACHE_TTL_S
     ):
         try:
             from agentfield_tpu.control_plane.identity import (
@@ -70,7 +76,7 @@ class ControlPlane:
         # db_path doubles as a storage URL: a postgres:// DSN selects the
         # shared-database provider (multi-instance deployments), anything
         # else is a SQLite path (reference: StorageFactory.CreateStorage).
-        self.storage = create_storage(db_path)
+        self.storage = create_storage(db_path, group_commit_ms=db_group_commit_ms)
         from agentfield_tpu.control_plane.storage import AsyncStorage
 
         # Awaitable mirror: handlers await this so a slow Postgres can never
@@ -102,8 +108,10 @@ class ControlPlane:
         )
         self.admin_grpc_port = admin_grpc_port
         self._admin_grpc = None
-        self.bus = EventBus()
         self.metrics = Metrics()
+        # Metrics attach to the bus so per-topic drops surface as
+        # events_dropped_total{topic=...} instead of a silent swallow.
+        self.bus = EventBus(metrics=self.metrics)
         self.webhooks = WebhookDispatcher(self.storage, self.metrics, db=self.db)
         self.webhook_secret = webhook_secret
         self.registry = NodeRegistry(
@@ -115,6 +123,8 @@ class ControlPlane:
             evict_after=evict_after,
             did_service=self.did_service,
             db=self.db,
+            cache_enabled=registry_cache,
+            cache_ttl_s=registry_cache_ttl,
         )
         self.gateway = ExecutionGateway(
             self.storage,
@@ -127,6 +137,9 @@ class ControlPlane:
             webhook_notify=self._notify_webhook,
             payloads=self.payloads,
             db=self.db,
+            # Dispatch fast path: _prepare/_pick_node resolve nodes from the
+            # registry's in-memory snapshot, not a SQLite scan per request.
+            node_cache=self.registry.cache,
         )
 
         from agentfield_tpu.control_plane.health import HealthMonitor
@@ -198,6 +211,14 @@ class ControlPlane:
         await self.webhooks.stop()
         await self.registry.stop()
         await self.gateway.stop()
+        # Group-commit drain hook: flush journaled execution rows while the
+        # connection is still open — a graceful shutdown (stop(), SIGTERM in
+        # examples/run_control_plane.py) must lose nothing. close() drains
+        # again defensively for callers that skip stop().
+        try:
+            self.storage.drain_executions()
+        except Exception:
+            pass  # close() retries; a failed flush must not block shutdown
         self.storage.close()
 
     async def cleanup_once(self) -> dict[str, int]:
@@ -285,6 +306,13 @@ def create_app(cp: ControlPlane) -> web.Application:
 
     @routes.get("/metrics")
     async def metrics(_req):
+        # Re-publish the storage journal's coalesced-write/flush counters at
+        # scrape time (the journal lives below the metrics registry; its
+        # stats() is an in-memory dict read — cheap and loop-safe).
+        jstats = cp.storage.journal_stats()
+        if jstats:
+            for k, v in jstats.items():
+                cp.metrics.set_gauge(f"db_{k}", float(v))
         return web.Response(text=cp.metrics.render(), content_type="text/plain")
 
     # -- nodes ----------------------------------------------------------

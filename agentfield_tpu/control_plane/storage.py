@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import json
+import os
 import sqlite3
 import threading
 import time
@@ -49,7 +50,8 @@ CREATE TABLE IF NOT EXISTS executions (
     doc TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_exec_run ON executions(run_id);
-CREATE INDEX IF NOT EXISTS idx_exec_status ON executions(status);
+DROP INDEX IF EXISTS idx_exec_status;
+CREATE INDEX IF NOT EXISTS idx_exec_status_created ON executions(status, created_at);
 CREATE INDEX IF NOT EXISTS idx_exec_created ON executions(created_at);
 CREATE TABLE IF NOT EXISTS memory (
     scope TEXT NOT NULL,
@@ -141,6 +143,374 @@ class AsyncStorage:
         return call
 
 
+def is_duplicate_key(e: Exception) -> bool:
+    """Provider-portable duplicate-PK detection: SQLite spells it "UNIQUE
+    constraint failed" (or "PRIMARY KEY" on some paths), Postgres raises
+    SQLSTATE 23505 ("duplicate key value violates unique constraint"). The
+    journal's flush replay and the gateway's 409 mapping both route through
+    here so the provider matrix lives in one place."""
+    return (
+        "UNIQUE" in str(e)
+        or "PRIMARY KEY" in str(e)
+        or "duplicate key" in str(e)
+        or getattr(e, "sqlstate", "") == "23505"
+    )
+
+
+class ExecutionJournal:
+    """Opt-in write-behind group commit for execution rows.
+
+    Every execution state transition today is its own transaction — under
+    WAL that is a journal write + commit per transition, ~5-7 of them per
+    dispatched request, and it is the control plane's dominant cost once the
+    agent call itself is cheap. With the journal enabled
+    (``AGENTFIELD_DB_GROUP_COMMIT_MS`` > 0, or the ``group_commit_ms``
+    constructor knob), NON-TERMINAL ``create_execution``/``update_execution``
+    rows are buffered here and flushed as ONE batched transaction per flush
+    tick, while reads stay exact:
+
+    - **Read-your-writes overlay** — ``get_execution`` consults the pending
+      buffer first; scan-shaped reads (``list_executions``,
+      ``count_executions``, rollups, cleanup) flush first, so dead-letter
+      listing and the orphan requeue always see pending rows.
+    - **Flush-through for terminal states** — COMPLETED / FAILED / TIMEOUT /
+      DEAD_LETTER writes flush the whole pending batch synchronously in the
+      caller's transaction: a terminal state acknowledged to a client is
+      durable before the acknowledgment, and it carries every buffered
+      non-terminal row with it (that is the "group" in group commit).
+    - **Crash window** — only non-terminal rows newer than the last flush
+      can be lost on a crash; those are exactly the rows the restart
+      cleanup already terminates (docs/OPERATIONS.md, durability section).
+      ``drain()`` is wired into server shutdown/SIGTERM so a graceful stop
+      loses nothing.
+
+    Thread-safety (two-buffer design): ``_mu`` guards the buffers with
+    SHORT holds only; the commit itself runs under ``_flush_lock`` against
+    an immutable ``_flushing`` batch, so overlay reads and new writes never
+    stall behind a commit in progress. Rows stay reader-visible in
+    ``_flushing`` until their transaction lands — there is no instant where
+    a buffered row is in neither the overlay nor the table. Postgres rides
+    the same journal but its wire client auto-commits per statement — there
+    the win is batching writes off the request path, not one fsync.
+    """
+
+    def __init__(self, storage: "SQLiteStorage", flush_interval_s: float):
+        self._s = storage
+        self._interval = max(flush_interval_s, 0.0005)
+        self._mu = threading.RLock()  # buffers + stats (short holds only)
+        self._flush_lock = threading.Lock()  # serializes whole flushes
+        # execution_id -> ("create" | "update", doc snapshot). Insertion
+        # order is flush order; create+update coalesce to one create.
+        self._pending: dict[str, tuple[str, dict]] = {}
+        # The batch currently being committed (immutable while in flight;
+        # still consulted by readers; retried if the transaction fails).
+        self._flushing: dict[str, tuple[str, dict]] = {}
+        self._wake = threading.Event()
+        # Set ONLY by flush_barrier(): lets a registering durability waiter
+        # cut the coalescing window short immediately (plain writes keep
+        # setting _wake, which must NOT break the window — that is the
+        # window's whole point).
+        self._barrier_wake = threading.Event()
+        self._closed = False
+        # Durability waiters: (loop, future) pairs resolved after the flush
+        # that commits the rows they enqueued (flush_barrier()).
+        self._waiters: list[tuple[Any, Any]] = []
+        self._stats = {
+            "journal_writes_total": 0,        # buffered (non-terminal) writes
+            "journal_coalesced_total": 0,     # writes absorbed into a pending row
+            "journal_flushes_total": 0,       # batched transactions issued
+            "journal_flushed_rows_total": 0,  # rows carried by those batches
+            "journal_flush_through_total": 0, # terminal (grouped/sync) writes
+            "journal_flush_errors_total": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="exec-journal", daemon=True
+        )
+        self._thread.start()
+
+    # -- write side -----------------------------------------------------
+
+    def _dup(self) -> sqlite3.IntegrityError:
+        # Message shape matters: the gateway's 409 mapping checks for
+        # "UNIQUE" (it must keep working for both SQLite and Postgres).
+        return sqlite3.IntegrityError(
+            "UNIQUE constraint failed: executions.execution_id"
+        )
+
+    def create(self, ex: Execution, check_duplicate: bool = True) -> None:
+        eid = ex.execution_id
+        with self._mu:
+            if eid in self._pending or eid in self._flushing:
+                raise self._dup()
+        if check_duplicate:
+            # Table check OUTSIDE _mu (point SELECT, no commit): the buffer
+            # stays lock-cheap. Callers that minted the id themselves
+            # (uuid4) skip this — the eager path's INSERT constraint only
+            # ever fires for caller-supplied ids, and this SELECT would be
+            # the journal hot path's one remaining per-request table read.
+            with self._s._lock:
+                row = self._s._conn.execute(
+                    "SELECT 1 FROM executions WHERE execution_id=?", (eid,)
+                ).fetchone()
+            if row is not None:
+                raise self._dup()
+        with self._mu:
+            if eid in self._pending or eid in self._flushing:
+                raise self._dup()
+            self._pending[eid] = ("create", ex.to_dict())
+            self._stats["journal_writes_total"] += 1
+        self._wake.set()
+
+    def _op_for(self, eid: str) -> str:
+        """A row whose CREATE is still in PENDING stays an INSERT when a
+        newer doc replaces it (one statement per row). A create sitting in
+        ``_flushing`` is deliberately NOT consulted: its commit is in flight
+        and may succeed — the newer doc is recorded as an update, and the
+        flush merge re-promotes it to a create only if that commit actually
+        failed (promoting here would double-INSERT after a success)."""
+        prev = self._pending.get(eid)
+        return "create" if prev is not None and prev[0] == "create" else "update"
+
+    def update(self, ex: Execution) -> None:
+        with self._mu:
+            if ex.execution_id in self._pending:
+                self._stats["journal_coalesced_total"] += 1
+            self._pending[ex.execution_id] = (self._op_for(ex.execution_id), ex.to_dict())
+            self._stats["journal_writes_total"] += 1
+        self._wake.set()
+
+    def write_through(self, ex: Execution) -> None:
+        """Terminal transition, synchronous form: join the pending batch,
+        then flush it NOW. Non-asyncio callers (tests, offloaded Postgres
+        worker threads) use this; the gateway's completion path uses
+        ``enqueue_terminal`` + ``flush_barrier`` instead so concurrent
+        completions share one commit."""
+        self.enqueue_terminal(ex)
+        self.flush()
+
+    def enqueue_terminal(self, ex: Execution) -> None:
+        """Terminal transition, grouped form: the row becomes visible to
+        every reader AT ONCE (read-your-writes overlay) but durability is
+        deferred to the next flush tick — callers MUST await
+        ``flush_barrier()`` (or call ``flush()``) before acknowledging the
+        terminal state to a client. Splitting the two lets the gateway
+        enqueue under its completion lock and wait outside it, so N
+        concurrent completions ride ONE commit instead of N."""
+        with self._mu:
+            self._pending[ex.execution_id] = (
+                self._op_for(ex.execution_id),
+                ex.to_dict(),
+            )
+            self._stats["journal_flush_through_total"] += 1
+        self._wake.set()
+
+    def flush_barrier(self) -> "asyncio.Future[None]":
+        """An awaitable resolved by the next flush that commits everything
+        currently buffered (set with the flush's error if it fails).
+        Resolves immediately when nothing is buffered — the rows this
+        caller cares about are already durable."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future[None] = loop.create_future()
+        closed = False
+        with self._mu:
+            if self._closed:
+                closed = True  # flush OUTSIDE _mu: flush() takes _flush_lock
+                # first, and holding _mu here would invert that order against
+                # a concurrent flush (deadlock)
+            elif not self._pending and not self._flushing:
+                fut.set_result(None)
+                return fut
+            else:
+                self._waiters.append((loop, fut))
+        if closed:
+            self.flush()  # no flusher thread anymore: commit inline
+            fut.set_result(None)
+            return fut
+        self._barrier_wake.set()
+        self._wake.set()
+        return fut
+
+    # -- read side ------------------------------------------------------
+
+    def get(self, execution_id: str) -> Execution | None:
+        with self._mu:
+            hit = self._pending.get(execution_id) or self._flushing.get(execution_id)
+            return Execution.from_dict(hit[1]) if hit is not None else None
+
+    @property
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._pending) + len(self._flushing)
+
+    def stats(self) -> dict[str, int]:
+        with self._mu:
+            return {
+                **self._stats,
+                "journal_pending": len(self._pending) + len(self._flushing),
+            }
+
+    # -- flush / lifecycle ----------------------------------------------
+
+    def flush(self) -> int:
+        """Commit every buffered row in one batched transaction. Returns the
+        number of rows flushed. Raises (rows retained for retry, transaction
+        rolled back) on a storage error so write-through callers see the
+        failure. Readers keep seeing the in-flight batch via the overlay for
+        the whole commit — no visibility gap."""
+        with self._flush_lock:
+            with self._mu:
+                # Absorb pending into the (possibly retried) batch. A newer
+                # doc wins per row; a row whose INSERT never landed (failed
+                # previous flush) stays a create.
+                for eid, (op, doc) in self._pending.items():
+                    if self._flushing.get(eid, (None,))[0] == "create":
+                        op = "create"
+                    self._flushing[eid] = (op, doc)
+                self._pending.clear()
+                batch = list(self._flushing.items())
+                waiters, self._waiters = self._waiters, []
+            if not batch:
+                self._complete_waiters(waiters, None)
+                return 0
+            try:
+                with self._s._lock:
+                    conn = self._s._conn
+                    try:
+                        for eid, (op, doc) in batch:
+                            blob = json.dumps(doc)
+                            if op == "create":
+                                try:
+                                    conn.execute(
+                                        "INSERT INTO executions(execution_id,run_id,"
+                                        "parent_execution_id,target,status,created_at,"
+                                        "finished_at,doc) VALUES(?,?,?,?,?,?,?,?)",
+                                        (
+                                            eid,
+                                            doc["run_id"],
+                                            doc.get("parent_execution_id"),
+                                            doc["target"],
+                                            doc["status"],
+                                            doc["created_at"],
+                                            doc.get("finished_at"),
+                                            blob,
+                                        ),
+                                    )
+                                    continue
+                                except Exception as e:
+                                    if not is_duplicate_key(e):
+                                        raise
+                                    # The row already landed: on Postgres each
+                                    # statement auto-commits, so a batch that
+                                    # failed MID-flush left its earlier
+                                    # INSERTs applied — the retry must
+                                    # degrade them to UPDATEs, not wedge on
+                                    # duplicate keys forever.
+                            conn.execute(
+                                "UPDATE executions SET status=?, finished_at=?, "
+                                "doc=? WHERE execution_id=?",
+                                (doc["status"], doc.get("finished_at"), blob, eid),
+                            )
+                        conn.commit()
+                    except Exception:
+                        getattr(conn, "rollback", lambda: None)()
+                        raise
+            except Exception as e:
+                with self._mu:
+                    self._stats["journal_flush_errors_total"] += 1
+                # Waiters must not hang on a failed flush: hand them the
+                # error (the rows stay in _flushing for the next attempt).
+                self._complete_waiters(waiters, e)
+                raise
+            with self._mu:
+                self._flushing = {}
+                self._stats["journal_flushes_total"] += 1
+                self._stats["journal_flushed_rows_total"] += len(batch)
+            self._complete_waiters(waiters, None)
+            return len(batch)
+
+    @staticmethod
+    def _complete_waiters(waiters: list, err: Exception | None) -> None:
+        """Resolve (or fail) durability waiters, ONE loop wakeup per event
+        loop (a flush can be releasing dozens of completions at once)."""
+        by_loop: dict[Any, list] = {}
+        for loop, fut in waiters:
+            by_loop.setdefault(loop, []).append(fut)
+
+        def _done(futs, err=err):
+            for fut in futs:
+                if fut.done():
+                    continue
+                if err is None:
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(err)
+
+        for loop, futs in by_loop.items():
+            try:
+                loop.call_soon_threadsafe(_done, futs)
+            except RuntimeError:
+                pass  # the loop is gone (shutdown); nobody is listening
+
+    def drop_pending(self) -> int:
+        """CRASH SIMULATION (tests only): discard the buffers as a process
+        kill before the flush tick would — terminal rows already flushed are
+        durable; buffered rows are the loss."""
+        with self._mu:
+            n = len(self._pending) + len(self._flushing)
+            self._pending.clear()
+            self._flushing.clear()
+            return n
+
+    def drain(self) -> int:
+        """Flush everything and stop the background flusher (idempotent).
+        Wired into storage.close(), server shutdown, and SIGTERM."""
+        with self._mu:
+            self._closed = True
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        return self.flush()
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._wake.wait()
+            if self._closed:
+                return
+            # Durability waiters are blocking completions: flush NOW — the
+            # natural group is whatever accumulated while the previous
+            # commit was in flight (classic group-commit leader). Pure
+            # write-behind batches (no waiters) sleep the coalescing window,
+            # which breaks early the moment a waiter registers (a long tick
+            # must delay background batching, never a completion) or
+            # drain() closes the journal.
+            with self._mu:
+                have_waiters = bool(self._waiters)
+            if not have_waiters:
+                self._barrier_wake.clear()
+                deadline = time.monotonic() + self._interval
+                while not self._closed and time.monotonic() < deadline:
+                    with self._mu:
+                        if self._waiters:
+                            break
+                    # Event-driven early exit: a waiter registering mid-
+                    # window sets _barrier_wake and the next iteration's
+                    # check breaks out — no fixed polling latency. The
+                    # chunk cap keeps drain() responsive on long ticks.
+                    self._barrier_wake.wait(min(0.05, self._interval))
+            self._wake.clear()
+            if self._closed:
+                return
+            try:
+                self.flush()
+            except Exception:
+                # Counted in flush(); the rows stay buffered. Re-arm the
+                # wake so the retry happens on the next tick EVEN WITH NO
+                # new writes — buffered rows must not outlive the
+                # documented one-tick crash window just because traffic
+                # went idle. The sleep paces a persistent error.
+                time.sleep(max(self._interval, 0.05))
+                self._wake.set()
+
+
 class SQLiteStorage:
     """StorageProvider over a single SQLite file (":memory:" for tests)."""
 
@@ -148,7 +518,7 @@ class SQLiteStorage:
     # thread (True for networked providers; local SQLite stays on-loop).
     offload_to_thread = False
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", group_commit_ms: float | None = None):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._lock = threading.RLock()
@@ -158,8 +528,45 @@ class SQLiteStorage:
             self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
+        self._journal = self._make_journal(group_commit_ms)
+
+    def _make_journal(self, group_commit_ms: float | None) -> ExecutionJournal | None:
+        """Group-commit journal, opt-in: the constructor knob wins; absent
+        that, ``AGENTFIELD_DB_GROUP_COMMIT_MS``; 0/unset = OFF, bit-for-bit
+        the eager-commit behavior."""
+        if group_commit_ms is None:
+            try:
+                group_commit_ms = float(
+                    os.environ.get("AGENTFIELD_DB_GROUP_COMMIT_MS", "0") or 0.0
+                )
+            except ValueError:
+                group_commit_ms = 0.0
+        if group_commit_ms > 0:
+            return ExecutionJournal(self, group_commit_ms / 1000.0)
+        return None
+
+    @property
+    def journal(self) -> ExecutionJournal | None:
+        return self._journal
+
+    def journal_stats(self) -> dict[str, int] | None:
+        """Coalesced-write/flush counters (None when group commit is off)."""
+        return self._journal.stats() if self._journal is not None else None
+
+    def flush_executions(self) -> int:
+        """Force-flush any journaled execution rows (no-op when off)."""
+        return self._journal.flush() if self._journal is not None else 0
+
+    def drain_executions(self) -> int:
+        """Shutdown hook: flush pending rows and stop the journal flusher."""
+        return self._journal.drain() if self._journal is not None else 0
 
     def close(self) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.drain()
+            except Exception:
+                pass  # a failed final flush must not block close
         with self._lock:
             self._conn.close()
 
@@ -195,7 +602,16 @@ class SQLiteStorage:
 
     # -- executions -----------------------------------------------------
 
-    def create_execution(self, ex: Execution) -> None:
+    def create_execution(self, ex: Execution, check_duplicate: bool = True) -> None:
+        """``check_duplicate=False`` tells the group-commit journal the id
+        was freshly minted (uuid) so its read-your-writes duplicate probe
+        can skip the table lookup; the eager path's INSERT constraint is
+        authoritative either way."""
+        if self._journal is not None:
+            self._journal.create(ex, check_duplicate=check_duplicate)
+            if ex.status.terminal:  # born-terminal rows are durable at once
+                self._journal.flush()
+            return
         with self._lock:
             self._conn.execute(
                 "INSERT INTO executions(execution_id,run_id,parent_execution_id,target,"
@@ -214,6 +630,15 @@ class SQLiteStorage:
             self._conn.commit()
 
     def update_execution(self, ex: Execution) -> None:
+        if self._journal is not None:
+            if ex.status.terminal:
+                # Terminal states are NEVER coalesced: flush-through makes
+                # the whole pending batch (this row included) durable before
+                # the caller's acknowledgment goes out.
+                self._journal.write_through(ex)
+            else:
+                self._journal.update(ex)
+            return
         with self._lock:
             self._conn.execute(
                 "UPDATE executions SET status=?, finished_at=?, doc=? WHERE execution_id=?",
@@ -222,6 +647,11 @@ class SQLiteStorage:
             self._conn.commit()
 
     def get_execution(self, execution_id: str) -> Execution | None:
+        if self._journal is not None:
+            # Read-your-writes: a buffered row wins over the (stale) table.
+            hit = self._journal.get(execution_id)
+            if hit is not None:
+                return hit
         with self._lock:
             row = self._conn.execute(
                 "SELECT doc FROM executions WHERE execution_id=?", (execution_id,)
@@ -234,6 +664,7 @@ class SQLiteStorage:
         in one statement instead of N round trips."""
         if not ids:
             return []
+        self.flush_executions()  # scan-shaped read: pending rows must show
         marks = ",".join("?" for _ in ids)
         with self._lock:
             rows = self._conn.execute(
@@ -267,6 +698,7 @@ class SQLiteStorage:
         newest_first: bool = False,
         target: str | None = None,
     ) -> list[Execution]:
+        self.flush_executions()  # listings (dead-letter, requeue) see pending rows
         where, args = self._exec_filters(run_id, status, target)
         direction = "DESC" if newest_first else "ASC"
         q = (
@@ -286,6 +718,7 @@ class SQLiteStorage:
     ) -> int:
         """Exact filtered count — the UI pagination totals must come from the
         database, not from len() of one page (ref executions_ui_service.go)."""
+        self.flush_executions()
         where, args = self._exec_filters(run_id, status, target)
         with self._lock:
             row = self._conn.execute(
@@ -308,6 +741,7 @@ class SQLiteStorage:
         count, per-status counts, newest activity."""
         if group_by not in self._EXEC_GROUP_COLS:
             raise ValueError(f"group_by must be one of {self._EXEC_GROUP_COLS}")
+        self.flush_executions()
         where, args = self._exec_filters(run_id, status, target)
         q = (
             f"SELECT {group_by} AS g, COUNT(*) AS n, "
@@ -392,6 +826,7 @@ class SQLiteStorage:
     def target_metrics(self, target: str) -> dict[str, Any]:
         """Per-reasoner/skill performance rollup in SQL (reference: per-
         reasoner metrics, storage.go:116-118 + handlers/reasoners.go)."""
+        self.flush_executions()
         with self._lock:
             row = self._conn.execute(
                 """
@@ -441,6 +876,7 @@ class SQLiteStorage:
 
     def execution_counts(self) -> dict[str, int]:
         """Exact per-status counts via SQL aggregation (dashboard hot path)."""
+        self.flush_executions()
         with self._lock:
             rows = self._conn.execute(
                 "SELECT status, COUNT(*) AS n FROM executions GROUP BY status"
@@ -454,6 +890,7 @@ class SQLiteStorage:
         """Aggregate run rollups in SQL (GROUP BY run_id) — exact regardless of
         table size, no doc deserialization (reference: QueryRunSummaries,
         internal/storage/execution_records.go)."""
+        self.flush_executions()
         with self._lock:
             rows = self._conn.execute(
                 """
@@ -508,6 +945,7 @@ class SQLiteStorage:
         return out
 
     def delete_executions_before(self, cutoff: float) -> int:
+        self.flush_executions()
         with self._lock:
             cur = self._conn.execute(
                 "DELETE FROM executions WHERE created_at < ? AND status IN (?,?,?)",

@@ -55,6 +55,16 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     failed-over completion), latency p50/p99 for both runs, and asserts ZERO
     hung executions (every one terminal). Headline value = fault-run
     success rate (1.0 = every execution completed despite the kill).
+  gateway_qps — control-plane dispatch fast-path bench (no model, no chip;
+    docs/PERFORMANCE.md): an in-process control plane on FILE-backed SQLite
+    + a stub agent node; the identical sync burst runs twice via
+    tools/perf/load_gen.run_load — fast path OFF (registry snapshot cache
+    + group-commit journal disabled) then ON (AGENTFIELD_REGISTRY_CACHE +
+    AGENTFIELD_DB_GROUP_COMMIT_MS semantics, docs/OPERATIONS.md). Reports
+    sync req/s, latency p50/p99, registry-cache hit/miss and journal
+    coalesced-write/flush counters for both runs. Headline value =
+    fast-path-ON req/s; AGENTFIELD_BENCH_REQUESTS / _CONCURRENCY size the
+    burst (default 768 requests at concurrency 32).
 """
 
 from __future__ import annotations
@@ -304,11 +314,15 @@ def _run_bench() -> None:
 
         force_cpu_backend()
 
-    # fault_storm is a pure control-plane scenario (no model, no chip): it
-    # dispatches BEFORE the device probe so a wedged TPU tunnel can never
-    # block a failure-domain bench.
+    # fault_storm / gateway_qps are pure control-plane scenarios (no model,
+    # no chip): they dispatch BEFORE the device probe so a wedged TPU tunnel
+    # can never block a control-plane bench.
     if os.environ.get("AGENTFIELD_BENCH_SCENARIO") == "fault_storm":
         _fault_storm()
+        _done.set()
+        return
+    if os.environ.get("AGENTFIELD_BENCH_SCENARIO") == "gateway_qps":
+        _gateway_qps()
         _done.set()
         return
 
@@ -462,7 +476,8 @@ def _run_bench() -> None:
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
-            "(have: shared_prefix_burst, mixed_interference, fault_storm)"
+            "(have: shared_prefix_burst, mixed_interference, fault_storm, "
+            "gateway_qps)"
         )
 
     demoted = None
@@ -552,7 +567,7 @@ def _run_bench() -> None:
             pass
         ttfts.append((time.perf_counter() - t0) * 1e3)
         del e
-    ttft_ms = sorted(ttfts)[len(ttfts) // 2]
+    ttft_ms = _pctile(ttfts, 50)
     _partial["ttft_ms_p50"] = round(ttft_ms, 1)
 
     # Throughput + burst TTFT: submit all n_requests at t0; record each
@@ -577,8 +592,8 @@ def _run_bench() -> None:
     elapsed = time.perf_counter() - t0
     tok_s = total_tokens / elapsed
     burst = sorted(first_token_ms.values())
-    burst_p50 = burst[len(burst) // 2] if burst else None
-    burst_p99 = burst[int(len(burst) * 0.99)] if burst else None
+    burst_p50 = _pctile(burst, 50) if burst else None
+    burst_p99 = _pctile(burst, 99) if burst else None
 
     # Speculative side-stage (only when spec wasn't requested globally):
     # a small self-draft burst measures the spec dispatch mechanics —
@@ -739,8 +754,8 @@ def _shared_prefix_burst(
         el = time.perf_counter() - t0
         ttfts = sorted(first_ms.values())
         return {
-            "ttft_p50": ttfts[len(ttfts) // 2],
-            "ttft_p99": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))],
+            "ttft_p50": _pctile(ttfts, 50),
+            "ttft_p99": _pctile(ttfts, 99),
             "tok_s": toks / el,
             "elapsed_s": el,
             "stats": dict(e2.stats),
@@ -959,7 +974,7 @@ def _mixed_interference(model: str, cfg, params, attn: str) -> None:
         steady_s = max((t_first_done or time.perf_counter()) - t_full, 1e-9)
 
         def pct(xs, p):
-            return xs[min(len(xs) - 1, int(len(xs) * p))] if xs else None
+            return _pctile(xs, p * 100) if xs else None
 
         def _r(x, nd=2):
             # empty sample sets (e.g. AGENTFIELD_BENCH_DECODE_NEW small
@@ -1012,6 +1027,64 @@ def _mixed_interference(model: str, cfg, params, attn: str) -> None:
     )
 
 
+def _pctile(values, p: float) -> float:
+    """Nearest-rank percentile, shared with the operator-facing load tool —
+    ONE implementation of the math across every scenario's report (the old
+    inline ``sorted[int(len*p)]`` indexing was biased up to one rank high)."""
+    from tools.perf.load_gen import percentile
+
+    return percentile(list(values), p)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _EchoNode:
+    """Minimal in-process agent node shared by the control-plane scenarios
+    (fault_storm, gateway_qps): POST /reasoners/{rid} echoes; killable
+    mid-burst (kill() == stop())."""
+
+    def __init__(self):
+        self.port = _free_port()
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        self.runner = None
+        self.calls = 0
+
+    async def _task(self, req):
+        from aiohttp import web
+
+        body = await req.json()
+        self.calls += 1
+        return web.json_response({"result": {"echo": body.get("input")}})
+
+    async def _health(self, _req):
+        from aiohttp import web
+
+        return web.json_response({"status": "ok"})
+
+    async def start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/reasoners/{rid}", self._task)
+        app.router.add_get("/health", self._health)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
+
+    async def kill(self):
+        if self.runner is not None:
+            await self.runner.cleanup()
+            self.runner = None
+
+    stop = kill
+
+
 def _fault_storm() -> None:
     """Failure-domain storm (docs/FAULT_TOLERANCE.md): burst N sync
     executions at a 2-node control plane while a seeded schedule kills the
@@ -1034,43 +1107,6 @@ def _fault_storm() -> None:
 
     from agentfield_tpu.control_plane.server import ControlPlane, create_app
 
-    def _free_port() -> int:
-        import socket
-
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    class _Node:
-        """Minimal agent node: POST /reasoners/task echoes; killable."""
-
-        def __init__(self):
-            self.port = _free_port()
-            self.base_url = f"http://127.0.0.1:{self.port}"
-            self.runner = None
-            self.calls = 0
-
-        async def _task(self, req):
-            body = await req.json()
-            self.calls += 1
-            return web.json_response({"result": {"echo": body.get("input")}})
-
-        async def _health(self, _req):
-            return web.json_response({"status": "ok"})
-
-        async def start(self):
-            app = web.Application()
-            app.router.add_post("/reasoners/{rid}", self._task)
-            app.router.add_get("/health", self._health)
-            self.runner = web.AppRunner(app)
-            await self.runner.setup()
-            await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
-
-        async def kill(self):
-            if self.runner is not None:
-                await self.runner.cleanup()
-                self.runner = None
-
     async def one_run(storm: bool) -> dict:
         cp = ControlPlane(db_path=":memory:", sync_wait_timeout=grace)
         app = create_app(cp)
@@ -1079,7 +1115,7 @@ def _fault_storm() -> None:
         port = _free_port()
         await web.TCPSite(runner, "127.0.0.1", port).start()
         base = f"http://127.0.0.1:{port}"
-        a, b = _Node(), _Node()
+        a, b = _EchoNode(), _EchoNode()
         await a.start()
         await b.start()
         kill_at, revive_at = n // 3, (2 * n) // 3
@@ -1166,8 +1202,8 @@ def _fault_storm() -> None:
         return {
             "success_rate": round(done / n, 4),
             "statuses": statuses,
-            "latency_ms_p50": round(lat[len(lat) // 2], 1),
-            "latency_ms_p99": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)], 1),
+            "latency_ms_p50": round(_pctile(lat, 50), 1),
+            "latency_ms_p99": round(_pctile(lat, 99), 1),
             "elapsed_s": round(elapsed, 2),
             "hung_executions": hung,
             "recovery_s": round(recovery_t, 3) if recovery_t is not None else None,
@@ -1191,6 +1227,153 @@ def _fault_storm() -> None:
             "zero_hung": storm["hung_executions"] == 0
             and baseline["hung_executions"] == 0,
             "requests": n,
+        }
+    )
+
+
+def _gateway_qps() -> None:
+    """Control-plane dispatch fast-path bench (docs/PERFORMANCE.md): the
+    identical sync burst against an in-process control plane, on fresh
+    FILE-backed databases — fast path OFF (eager per-transition commits,
+    node reads from SQLite) vs ON (registry snapshot cache + group-commit
+    execution journal). The driver calls ``ExecutionGateway.execute_sync``
+    directly through tools/perf/load_gen.run_load (same nearest-rank
+    percentile math as the operator-facing tool). Two workload variants:
+
+    - HEADLINE (``agent_hop=False``): the agent call is stubbed at the
+      gateway's ``_call_agent_once`` seam (identically for both modes) —
+      this isolates the DISPATCH path (registry + gateway + storage), the
+      layer this fast path optimizes, from localhost-HTTP throughput.
+    - ``with_agent_hop``: the same burst with a real aiohttp stub agent
+      node — end-to-end sync numbers where the wire hop (which no control-
+      plane change can remove) dilutes the dispatch speedup.
+
+    Headline value = fast-path-ON dispatch req/s; the report carries both
+    runs of both variants, the speedups, and the registry-cache/journal
+    counters that explain them."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    _partial["stage"] = "gateway_qps"
+    # Per-execution INFO lines would dominate a multi-hundred-req/s burst;
+    # this bench measures dispatch, not console logging (both runs equally).
+    os.environ.setdefault("AGENTFIELD_LOG_LEVEL", "warning")
+    n = int(os.environ.get("AGENTFIELD_BENCH_REQUESTS") or 768)
+    conc = int(os.environ.get("AGENTFIELD_BENCH_CONCURRENCY") or 32)
+    # a realistic fleet: extra registered nodes make the node table a table,
+    # not a single row (the OFF path re-reads it per dispatch)
+    fleet = int(os.environ.get("AGENTFIELD_BENCH_FLEET") or 16)
+
+    from agentfield_tpu.control_plane.server import ControlPlane
+    from tools.perf.load_gen import run_load
+
+    async def one_run(fast: bool, agent_hop: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix="gateway_qps_")
+        cp = ControlPlane(
+            db_path=os.path.join(tmp, "cp.db"),
+            # Explicit 0.0 / False force the knobs OFF regardless of env;
+            # the ON run uses a 2ms flush tick and the cache defaults.
+            db_group_commit_ms=2.0 if fast else 0.0,
+            registry_cache=fast,
+        )
+        await cp.start()
+        stub = _EchoNode() if agent_hop else None
+        if stub is not None:
+            await stub.start()
+        if not agent_hop:
+            # Stub the agent call at the gateway's own seam (both modes
+            # identically): the burst then measures pure dispatch.
+            async def _stub_call(node, ex):
+                await asyncio.sleep(0)  # keep one real scheduling point
+                return "completed", {"echo": ex.input}
+
+            cp.gateway._call_agent_once = _stub_call
+        try:
+            base_url = stub.base_url if stub else "http://127.0.0.1:9"
+            await cp.registry.register(
+                {
+                    "node_id": "stub",
+                    "base_url": base_url,
+                    "reasoners": [{"id": "task"}],
+                }
+            )
+            for i in range(fleet):
+                await cp.registry.register(
+                    {
+                        "node_id": f"peer{i}",
+                        "base_url": base_url,
+                        "reasoners": [{"id": f"other{i}"}],
+                    }
+                )
+
+            async def gw_call(i: int) -> str:
+                ex = await cp.gateway.execute_sync("stub.task", i, {})
+                return ex.status.value
+
+            # Warmup outside the measured window (sessions, code paths hot).
+            await run_load("", "stub.task", 32, conc, "sync", execute=gw_call)
+            report = await run_load("", "stub.task", n, conc, "sync", execute=gw_call)
+            report["registry_cache"] = {
+                "hits": cp.metrics.counter_value("registry_cache_hits_total"),
+                "misses": cp.metrics.counter_value("registry_cache_misses_total"),
+            }
+            report["journal"] = cp.storage.journal_stats()
+            if stub is not None:
+                report["agent_calls"] = stub.calls
+        finally:
+            if stub is not None:
+                await stub.stop()
+            await cp.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+        return report
+
+    # Interleaved best-of-2 per mode: this bench runs on shared CPU where a
+    # noisy neighbor can halve one round; the best round per mode is the
+    # honest estimate of each configuration's capability (and every round
+    # is reported).
+    def ab(agent_hop: bool) -> tuple[dict, dict, dict]:
+        off_rounds, on_rounds = [], []
+        for _ in range(2):
+            off_rounds.append(asyncio.run(one_run(fast=False, agent_hop=agent_hop)))
+            _partial["gateway_qps_off"] = off_rounds[-1]
+            on_rounds.append(asyncio.run(one_run(fast=True, agent_hop=agent_hop)))
+        off = max(off_rounds, key=lambda r: r["rps"])
+        on = max(on_rounds, key=lambda r: r["rps"])
+        rounds = {
+            "off_rps": [r["rps"] for r in off_rounds],
+            "on_rps": [r["rps"] for r in on_rounds],
+            "note": "interleaved best-of-2 per mode (shared-CPU noise)",
+        }
+        return on, off, rounds
+
+    on, off, rounds = ab(agent_hop=False)  # headline: pure dispatch path
+    _partial["gateway_qps_dispatch"] = {"on": on["rps"], "off": off["rps"]}
+    hop_on, hop_off, hop_rounds = ab(agent_hop=True)
+    speedup = round(on["rps"] / max(off["rps"], 1e-9), 2)
+    _emit(
+        {
+            "metric": f"gateway_qps_{n}req_c{conc}_sync_dispatch",
+            "value": on["rps"],
+            "unit": "req/s_fast_path_on",
+            "speedup_rps": speedup,
+            "p99_ratio_on_vs_off": round(
+                on["latency_ms"]["p99"] / max(off["latency_ms"]["p99"], 1e-9), 2
+            ),
+            "on": on,
+            "off": off,
+            "rounds": rounds,
+            "with_agent_hop": {
+                "speedup_rps": round(
+                    hop_on["rps"] / max(hop_off["rps"], 1e-9), 2
+                ),
+                "on": hop_on,
+                "off": hop_off,
+                "rounds": hop_rounds,
+            },
+            "requests": n,
+            "concurrency": conc,
+            "fleet_nodes": fleet + 1,
         }
     )
 

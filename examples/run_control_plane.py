@@ -1,9 +1,15 @@
 """Run a standalone control plane.
 
 Usage: python examples/run_control_plane.py [port] [db_path]
+
+SIGTERM/SIGINT shut down gracefully: the server stops, and the storage
+group-commit journal (AGENTFIELD_DB_GROUP_COMMIT_MS, docs/OPERATIONS.md)
+drains — buffered execution rows are flushed before the process exits, so
+a rolling restart loses nothing.
 """
 
 import asyncio
+import signal
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -14,9 +20,19 @@ from agentfield_tpu.control_plane.server import ControlPlane, run_server
 async def main() -> None:
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8800
     db = sys.argv[2] if len(sys.argv) > 2 else ":memory:"
-    await run_server(ControlPlane(db_path=db), port=port)
+    cp = ControlPlane(db_path=db)
+    runner = await run_server(cp, port=port)
     print(f"control plane listening on :{port} (db={db})", flush=True)
-    await asyncio.Event().wait()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down: draining journal and stopping server", flush=True)
+    # runner.cleanup() fires the app's on_cleanup → cp.stop(), which drains
+    # the execution journal before the storage connection closes.
+    await runner.cleanup()
 
 
 if __name__ == "__main__":

@@ -488,3 +488,143 @@ def test_storage_locks(tmp_path):
     assert st.release_lock("l1", "me")
     assert st.acquire_lock("l1", "you", ttl=100)
     st.close()
+
+
+# ---------------------------------------------------------------------------
+# Registry node snapshot cache (dispatch fast path, ISSUE 4)
+
+
+@async_test
+async def test_registry_cache_hits_and_write_invalidation():
+    """The gateway's dispatch path serves node reads from the registry's
+    generation-stamped snapshot: repeat dispatches hit; every registry write
+    (register / status heartbeat / deregister) invalidates, so routing
+    decisions never act on a stale node."""
+    async with CPHarness() as h:
+        cache = h.cp.registry.cache
+        m = h.cp.metrics
+        assert cache.enabled
+        await h.register_agent("a")
+        g0 = cache.generation
+        async with h.http.post("/api/v1/execute/a.echo", json={}) as r:
+            assert (await r.json())["status"] == "completed"
+        misses0 = m.counter_value("registry_cache_misses_total")
+        assert misses0 >= 1  # first dispatch built the snapshot
+        async with h.http.post("/api/v1/execute/a.echo", json={}) as r:
+            assert (await r.json())["status"] == "completed"
+        assert m.counter_value("registry_cache_hits_total") >= 1
+        assert m.counter_value("registry_cache_misses_total") == misses0
+
+        # register bumps the generation; a node only b serves is routable
+        b = FakeAgent(h.base_url, behavior_map={"only_b": "echo"}, extra_reasoners=("only_b",))
+        await b.start()
+        try:
+            await h.register_fake(b, "b")
+            assert cache.generation > g0
+            async with h.http.post("/api/v1/execute/b.only_b", json={"input": 1}) as r:
+                assert (await r.json())["status"] == "completed"
+            # status change through a heartbeat invalidates: the INACTIVE
+            # node (no capable substitute) must 503 immediately, not after
+            # a TTL expires
+            await h.cp.registry.heartbeat("b", {"status": "inactive"})
+            async with h.http.post("/api/v1/execute/b.only_b", json={}) as r:
+                assert r.status == 503
+            # deregister invalidates: unknown node is a 404 immediately
+            await h.cp.registry.deregister("b")
+            async with h.http.post("/api/v1/execute/b.only_b", json={}) as r:
+                assert r.status == 404
+        finally:
+            await b.stop()
+
+
+@async_test
+async def test_registry_cache_ttl_bounds_unseen_writers():
+    """Writers that bypass the registry (a second control-plane instance on
+    shared Postgres; tests poking storage) cannot invalidate the snapshot —
+    the TTL bounds how long their writes stay invisible."""
+    async with CPHarness() as h:
+        cache = h.cp.registry.cache
+        await h.register_agent("a")
+        # warm the snapshot
+        async with h.http.post("/api/v1/execute/a.echo", json={}) as r:
+            assert (await r.json())["status"] == "completed"
+        # out-of-band deactivation, bypassing every registry hook
+        node = h.cp.storage.get_node("a")
+        node.status = NodeStatus.INACTIVE
+        h.cp.storage.upsert_node(node)
+        # within the TTL the snapshot still routes to it (documented bound)
+        assert (await cache.get("a")).status is NodeStatus.ACTIVE
+        cache.ttl_s = 0.0  # expire instantly → next read rebuilds
+        assert (await cache.get("a")).status is NodeStatus.INACTIVE
+
+
+@async_test
+async def test_registry_cache_disabled_reads_through():
+    from agentfield_tpu.control_plane.registry import NodeSnapshotCache
+    from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
+    from agentfield_tpu.control_plane.types import AgentNode
+
+    st = SQLiteStorage()
+    cache = NodeSnapshotCache(AsyncStorage(st), None, enabled=False, ttl_s=60.0)
+    assert await cache.get("n") is None
+    st.upsert_node(AgentNode(node_id="n", base_url="http://x", status=NodeStatus.ACTIVE))
+    # disabled = no snapshot to go stale: the new node is visible at once
+    assert (await cache.get("n")).node_id == "n"
+    assert [n.node_id for n in await cache.list()] == ["n"]
+    st.close()
+
+
+# ---------------------------------------------------------------------------
+# Event bus drop accounting (ISSUE 4 satellite)
+
+
+@async_test
+async def test_event_bus_counts_drops_per_topic():
+    from agentfield_tpu.control_plane.events import EventBus
+    from agentfield_tpu.control_plane.metrics import Metrics
+
+    m = Metrics()
+    bus = EventBus(maxsize=2, metrics=m)
+    q = bus.subscribe("executions")
+    bus.subscribe("memory")  # empty queue on another topic: never drops
+    for i in range(5):
+        bus.publish("executions", {"i": i})
+    bus.publish("memory", {"i": 0})
+    assert bus.dropped == 3
+    assert bus.dropped_by_topic["executions"] == 3
+    assert "memory" not in bus.dropped_by_topic
+    assert m.counter_value("events_dropped_total", labels={"topic": "executions"}) == 3
+    assert 'events_dropped_total{topic="executions"} 3' in m.render()
+    assert not q.empty()
+
+
+# ---------------------------------------------------------------------------
+# Perf tooling satellites (ISSUE 4)
+
+
+def test_load_gen_percentile_nearest_rank():
+    """The old int(len*p/100) indexing over-indexed by up to one rank —
+    every reported latency was biased upward."""
+    from tools.perf.load_gen import percentile
+
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0]
+    assert percentile(vals, 50) == 5.0  # old impl returned 6.0
+    assert percentile(vals, 90) == 9.0
+    assert percentile(vals, 99) == 10.0
+    assert percentile(vals, 100) == 10.0
+    assert percentile(vals, 1) == 1.0
+    assert percentile([7.5], 99) == 7.5
+    assert percentile([], 50) == 0.0
+    # order-independent (sorts internally)
+    assert percentile([3.0, 1.0, 2.0], 50) == 2.0
+
+
+def test_control_plane_knobs_documented():
+    """Docs lint (tier-1): every AGENTFIELD_* env knob read by the control
+    plane — group-commit journal, registry cache, fault injection — must be
+    documented under docs/ (operators learn knobs from OPERATIONS.md)."""
+    from tools.check_engine_knobs import check_control_plane_knobs
+
+    assert check_control_plane_knobs() == [], (
+        "undocumented control-plane env knobs; add them to docs/OPERATIONS.md"
+    )

@@ -541,6 +541,84 @@ async def test_health_probe_backoff_per_node():
 
 
 # ---------------------------------------------------------------------------
+# Group-commit journal crash durability (ISSUE 4 acceptance: terminal states
+# are never coalesced — zero COMPLETED/FAILED/TIMEOUT/DEAD_LETTER rows lost
+# across a mid-burst kill with group commit enabled)
+
+
+@async_test
+async def test_group_commit_kill_mid_burst_zero_lost_terminals():
+    """Burst sync executions with the group-commit journal on (huge flush
+    tick: NOTHING is durable except what flush-through carries); a seeded
+    FaultInjector picks the kill point mid-burst; the 'kill' discards the
+    journal's buffered rows exactly as a SIGKILL before the flush tick
+    would. Every terminal state a client was acknowledged must be on disk
+    in a FRESH connection; buffered non-terminal rows are the (documented)
+    loss, and whatever non-terminal rows survived recover through the
+    restart cleanup path (terminate → events/webhooks fire)."""
+    import tempfile
+
+    from agentfield_tpu.control_plane.storage import SQLiteStorage
+
+    db_path = tempfile.mkdtemp(prefix="gc_crash_") + "/cp.db"
+    inj = faults.FaultInjector(
+        seed=3, spec={"node.kill": {"prob": 1.0, "times": 1, "after": 5}}
+    )
+    async with CPHarness(
+        db_path=db_path, db_group_commit_ms=60_000.0, stale_after=0.0
+    ) as h:
+        await h.register_agent("a")
+        journal = h.cp.storage.journal
+        assert journal is not None
+        terminal_seen: dict[str, str] = {}
+        lost_ids: list[str] = []
+        killed = False
+        for i in range(12):
+            async with h.http.post(
+                "/api/v1/execute/a.echo", json={"input": i}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            terminal_seen[doc["execution_id"]] = doc["status"]
+            if not killed and inj.fire("node.kill") is not None:
+                killed = True
+                # Async work lands in the buffer (202-accepted, QUEUED/
+                # RUNNING — never flushed through)...
+                for _ in range(2):
+                    async with h.http.post(
+                        "/api/v1/execute/async/a.silent202", json={}
+                    ) as r2:
+                        assert r2.status == 202
+                        lost_ids.append((await r2.json())["execution_id"])
+                await asyncio.sleep(0.05)  # let the worker persist RUNNING
+                # ...then the process "dies" before any flush tick:
+                assert journal.drop_pending() > 0
+        assert killed, "fault schedule never fired"
+
+        # Post-crash view: a separate connection on the same file.
+        fresh = SQLiteStorage(db_path)
+        try:
+            for eid, status in terminal_seen.items():
+                row = fresh.get_execution(eid)
+                assert row is not None, f"terminal execution {eid} lost"
+                assert row.status.value == status, (eid, row.status)
+            # the buffered-only rows died with the process (documented
+            # crash window: non-terminal, newer than the last flush)
+            for eid in lost_ids:
+                row = fresh.get_execution(eid)
+                assert row is None or not row.status.terminal
+        finally:
+            fresh.close()
+
+        # Restart recovery: cleanup terminates any surviving non-terminal
+        # row (stale_after=0) through gateway.complete — clients polling
+        # them observe a terminal state, never a silent hang.
+        await h.cp.cleanup_once()
+        for status in (ExecutionStatus.QUEUED, ExecutionStatus.RUNNING):
+            assert await h.cp.db.list_executions(status=status, limit=100) == []
+
+
+# ---------------------------------------------------------------------------
 # Lint: unbounded HTTP clients
 
 

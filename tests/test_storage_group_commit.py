@@ -1,0 +1,266 @@
+"""Group-commit execution journal (storage.ExecutionJournal, ISSUE 4):
+read-your-writes overlay, create+update coalescing, flush ordering, terminal
+flush-through durability, drain-on-close, crash simulation, and the
+off-by-default bit-for-bit contract. Cross-connection visibility is asserted
+against a SECOND SQLite connection on the same file — what a restarted
+process (or an operator's sqlite3 shell) would actually see."""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+
+import pytest
+
+from agentfield_tpu.control_plane.storage import AsyncStorage, SQLiteStorage
+from agentfield_tpu.control_plane.types import (
+    Execution,
+    ExecutionStatus,
+    TargetType,
+)
+
+BIG_TICK_MS = 60_000.0  # no background flush within any test's lifetime
+
+
+def mk(i: int = 0, status: ExecutionStatus = ExecutionStatus.RUNNING, **kw) -> Execution:
+    return Execution(
+        execution_id=f"exec_{i}",
+        target="node.comp",
+        target_type=TargetType.REASONER,
+        status=status,
+        run_id=f"run_{i}",
+        **kw,
+    )
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    return str(tmp_path / "cp.db")
+
+
+def fresh_view(db_path: str) -> SQLiteStorage:
+    """A separate connection = the post-crash / external view of the file."""
+    return SQLiteStorage(db_path)
+
+
+def test_journal_off_by_default_and_env_knob(db_path, monkeypatch):
+    st = SQLiteStorage(db_path)
+    assert st.journal is None and st.journal_stats() is None
+    # off → eager commits: a second connection sees the row immediately
+    st.create_execution(mk(0))
+    other = fresh_view(db_path)
+    assert other.get_execution("exec_0") is not None
+    other.close()
+    st.close()
+    monkeypatch.setenv("AGENTFIELD_DB_GROUP_COMMIT_MS", "5")
+    st2 = SQLiteStorage(str(db_path) + "2")
+    assert st2.journal is not None
+    st2.close()
+    monkeypatch.setenv("AGENTFIELD_DB_GROUP_COMMIT_MS", "0")
+    st3 = SQLiteStorage(str(db_path) + "3")
+    assert st3.journal is None
+    st3.close()
+
+
+def test_overlay_read_your_writes(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    ex = mk(1, status=ExecutionStatus.QUEUED)
+    st.create_execution(ex)
+    # the writer sees its row instantly...
+    got = st.get_execution("exec_1")
+    assert got is not None and got.status is ExecutionStatus.QUEUED
+    # ...but the row is write-behind: not on disk yet
+    other = fresh_view(db_path)
+    assert other.get_execution("exec_1") is None
+    # scan-shaped reads flush first, so listings see pending rows — and the
+    # flush makes them durable as a side effect
+    listed = st.list_executions(status=ExecutionStatus.QUEUED)
+    assert [e.execution_id for e in listed] == ["exec_1"]
+    assert other.get_execution("exec_1") is not None
+    other.close()
+    st.close()
+
+
+def test_overlay_rows_are_isolated_snapshots(db_path):
+    """Mutating an Execution AFTER a journaled write (the gateway appends to
+    nodes_tried in place during retries) must not rewrite the buffered doc,
+    and mutating an overlay-read row must not either."""
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    ex = mk(2)
+    ex.nodes_tried = ["a"]
+    st.create_execution(ex)
+    ex.nodes_tried.append("b")  # post-write mutation of the live object
+    snap = st.get_execution("exec_2")
+    assert snap.nodes_tried == ["a"]
+    snap.nodes_tried.append("c")  # mutation through an overlay read
+    assert st.get_execution("exec_2").nodes_tried == ["a"]
+    st.close()
+
+
+def test_update_coalesces_into_pending_create(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    ex = mk(3, status=ExecutionStatus.QUEUED)
+    st.create_execution(ex)
+    ex.status = ExecutionStatus.RUNNING
+    st.update_execution(ex)  # non-terminal: buffered, merged into the create
+    stats = st.journal_stats()
+    assert stats["journal_coalesced_total"] >= 1
+    assert stats["journal_pending"] == 1  # one row, not two
+    assert st.get_execution("exec_3").status is ExecutionStatus.RUNNING
+    assert st.flush_executions() == 1  # ONE insert carries the final doc
+    other = fresh_view(db_path)
+    assert other.get_execution("exec_3").status is ExecutionStatus.RUNNING
+    other.close()
+    st.close()
+
+
+def test_terminal_flush_through_is_durable_and_grouped(db_path):
+    """A terminal update flushes synchronously and carries every buffered
+    non-terminal row with it — the 'group' in group commit."""
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    bystander = mk(4, status=ExecutionStatus.QUEUED)
+    st.create_execution(bystander)
+    ex = mk(5)
+    st.create_execution(ex)
+    ex.status = ExecutionStatus.COMPLETED
+    ex.result = {"ok": True}
+    st.update_execution(ex)  # terminal → flush-through
+    assert st.journal_stats()["journal_pending"] == 0
+    other = fresh_view(db_path)
+    assert other.get_execution("exec_5").status is ExecutionStatus.COMPLETED
+    # the unrelated QUEUED row rode the same transaction
+    assert other.get_execution("exec_4") is not None
+    other.close()
+    st.close()
+
+
+def test_flush_ordering_last_write_wins(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    ex = mk(6, status=ExecutionStatus.QUEUED)
+    st.create_execution(ex)
+    for status in (ExecutionStatus.RUNNING, ExecutionStatus.QUEUED, ExecutionStatus.RUNNING):
+        ex.status = status
+        ex.attempts += 1
+        st.update_execution(ex)
+    ex.status = ExecutionStatus.FAILED
+    ex.error = "boom"
+    st.update_execution(ex)
+    other = fresh_view(db_path)
+    row = other.get_execution("exec_6")
+    assert row.status is ExecutionStatus.FAILED
+    assert row.error == "boom" and row.attempts == 3
+    other.close()
+    st.close()
+
+
+def test_duplicate_create_raises_unique(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    st.create_execution(mk(7))
+    # duplicate against the pending buffer
+    with pytest.raises(sqlite3.IntegrityError, match="UNIQUE"):
+        st.create_execution(mk(7))
+    st.flush_executions()
+    # duplicate against the flushed table
+    with pytest.raises(sqlite3.IntegrityError, match="UNIQUE"):
+        st.create_execution(mk(7))
+    st.close()
+
+
+def test_listings_and_bulk_see_pending_rows(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    st.create_execution(mk(8, status=ExecutionStatus.QUEUED))
+    st.create_execution(mk(9, status=ExecutionStatus.RUNNING))
+    assert st.count_executions() == 2
+    bulk = st.get_executions_bulk(["exec_8", "exec_9"])
+    assert {e.execution_id for e in bulk} == {"exec_8", "exec_9"}
+    assert st.execution_counts()["queued"] == 1
+    st.close()
+
+
+def test_drop_pending_simulates_crash(db_path):
+    """The crash window is exactly the buffered non-terminal rows: drop them
+    (as a SIGKILL before the flush tick would) and the file never saw them."""
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    for i in (10, 11, 12):
+        st.create_execution(mk(i, status=ExecutionStatus.QUEUED))
+    assert st.journal.drop_pending() == 3
+    assert st.flush_executions() == 0
+    other = fresh_view(db_path)
+    assert other.count_executions() == 0
+    other.close()
+    st.close()
+
+
+def test_close_drains_pending(db_path):
+    st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+    st.create_execution(mk(13, status=ExecutionStatus.QUEUED))
+    st.close()  # graceful shutdown: drain, not drop
+    other = fresh_view(db_path)
+    assert other.get_execution("exec_13") is not None
+    other.close()
+
+
+def test_flush_barrier_groups_concurrent_terminals(db_path):
+    """The asyncio barrier path the gateway uses: N terminal enqueues + N
+    barriers resolve with FEWER commits than completions."""
+
+    async def run():
+        st = SQLiteStorage(db_path, group_commit_ms=1.0)
+        j = st.journal
+        exs = [mk(20 + i) for i in range(8)]
+        for ex in exs:
+            st.create_execution(ex)
+        barriers = []
+        for ex in exs:
+            ex.status = ExecutionStatus.COMPLETED
+            j.enqueue_terminal(ex)
+            barriers.append(j.flush_barrier())
+        await asyncio.gather(*barriers)
+        stats = st.journal_stats()
+        assert stats["journal_pending"] == 0
+        assert stats["journal_flush_through_total"] == 8
+        assert stats["journal_flushes_total"] <= 8  # grouped, never per-row
+        other = fresh_view(db_path)
+        for ex in exs:
+            assert other.get_execution(ex.execution_id).status is ExecutionStatus.COMPLETED
+        other.close()
+        st.close()
+
+    asyncio.run(asyncio.wait_for(run(), timeout=30))
+
+
+def test_async_facade_passes_journal_methods(db_path):
+    """AsyncStorage mirrors the journal helpers (flush_executions,
+    journal_stats) like any other provider method."""
+
+    async def run():
+        st = SQLiteStorage(db_path, group_commit_ms=BIG_TICK_MS)
+        db = AsyncStorage(st)
+        await db.create_execution(mk(30, status=ExecutionStatus.QUEUED))
+        assert (await db.journal_stats())["journal_pending"] == 1
+        assert await db.flush_executions() == 1
+        st.close()
+
+    asyncio.run(asyncio.wait_for(run(), timeout=30))
+
+
+def test_composite_status_created_index(db_path):
+    """The dead-letter listing / cleanup sweep index: (status, created_at)
+    replaces the status-only index."""
+    st = SQLiteStorage(db_path)
+    names = {
+        r["name"]
+        for r in st._conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='index'"
+        ).fetchall()
+    }
+    assert "idx_exec_status_created" in names
+    assert "idx_exec_status" not in names
+    # and the planner actually uses it for the dead-letter shape
+    plan = st._conn.execute(
+        "EXPLAIN QUERY PLAN SELECT doc FROM executions WHERE status=? "
+        "ORDER BY created_at DESC LIMIT 10",
+        (ExecutionStatus.DEAD_LETTER.value,),
+    ).fetchall()
+    assert any("idx_exec_status_created" in str(tuple(r)) for r in plan)
+    st.close()

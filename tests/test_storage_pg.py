@@ -123,6 +123,36 @@ def test_factory_seam(pg):
     s.close()
 
 
+def test_postgres_group_commit_journal(pg):
+    """The Postgres offload path rides the same ExecutionJournal: overlay
+    read-your-writes, flush-first listings, terminal flush-through (there
+    each statement auto-commits — the journal's win on PG is batching off
+    the request path, docs/OPERATIONS.md)."""
+    s = create_storage(_dsn(pg), group_commit_ms=60_000.0)
+    assert isinstance(s, PostgresStorage) and s.journal is not None
+
+    def server_rows() -> int:  # the fake server's backing SQLite = "on disk"
+        return pg._db.execute("SELECT COUNT(*) FROM executions").fetchone()[0]
+
+    ex = Execution(execution_id="ej1", run_id="r1", target="n1.echo",
+                   target_type=TargetType.REASONER, status=ExecutionStatus.QUEUED)
+    s.create_execution(ex)
+    # buffered: the overlay serves it; the server-side table does not
+    assert s.journal.get("ej1") is not None
+    assert s.get_execution("ej1").status is ExecutionStatus.QUEUED
+    assert server_rows() == 0
+    # listings flush first
+    assert [e.execution_id for e in s.list_executions(status=ExecutionStatus.QUEUED)] == ["ej1"]
+    assert server_rows() == 1
+    # terminal flush-through lands server-side before returning
+    ex.status = ExecutionStatus.COMPLETED
+    ex.finished_at = time.time()
+    s.update_execution(ex)
+    assert s.journal_stats()["journal_pending"] == 0
+    assert s.get_execution("ej1").status is ExecutionStatus.COMPLETED
+    s.close()
+
+
 @async_test
 async def test_control_plane_boots_on_postgres_dsn(pg):
     """Full stack on the shared-database provider: register + execute

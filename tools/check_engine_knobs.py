@@ -1,9 +1,17 @@
-"""Lint: every ``EngineConfig`` field must be documented under ``docs/``.
+"""Lint: every ``EngineConfig`` field AND every control-plane env knob must
+be documented under ``docs/``.
 
 The serving engine's knob surface grows PR by PR; an undocumented knob is
 invisible to operators (and to the EngineConfig reference table in
-docs/ARCHITECTURE.md, which this lint keeps honest). Runs in tier-1 via
-``tests/test_mixed_step.py::test_engine_knobs_documented`` and standalone:
+docs/ARCHITECTURE.md, which this lint keeps honest). The control-plane side
+works the other way around: any ``AGENTFIELD_*`` environment variable READ
+by ``agentfield_tpu/control_plane/*.py`` (group-commit journal, registry
+snapshot cache, fault injection, ...) is auto-discovered from the source
+and must appear in docs/*.md — operators learn knobs from OPERATIONS.md,
+not from grepping the tree. Runs in tier-1 via
+``tests/test_mixed_step.py::test_engine_knobs_documented`` (engine) and
+``tests/test_control_plane.py::test_control_plane_knobs_documented``
+(control plane), and standalone:
 
     python tools/check_engine_knobs.py
 """
@@ -12,24 +20,53 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
+import re
 import sys
+
+
+def _repo_root(repo_root: pathlib.Path | None) -> pathlib.Path:
+    return repo_root or pathlib.Path(__file__).resolve().parent.parent
+
+
+def _docs_text(root: pathlib.Path) -> str:
+    return "\n".join(
+        p.read_text(encoding="utf-8") for p in sorted((root / "docs").glob("*.md"))
+    )
 
 
 def check(repo_root: pathlib.Path | None = None) -> list[str]:
     """Returns the undocumented EngineConfig field names (empty = pass)."""
-    root_for_import = repo_root or pathlib.Path(__file__).resolve().parent.parent
-    if str(root_for_import) not in sys.path:  # standalone `python tools/...`
-        sys.path.insert(0, str(root_for_import))
+    root = _repo_root(repo_root)
+    if str(root) not in sys.path:  # standalone `python tools/...`
+        sys.path.insert(0, str(root))
     from agentfield_tpu.serving.engine import EngineConfig
 
-    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
-    docs = "\n".join(
-        p.read_text(encoding="utf-8") for p in sorted((root / "docs").glob("*.md"))
-    )
+    docs = _docs_text(root)
     return [f.name for f in dataclasses.fields(EngineConfig) if f.name not in docs]
 
 
+# env vars the control plane reads but operators never set directly (test
+# scaffolding would go here); currently everything discovered is operator-
+# facing, so the allowlist is empty on purpose.
+_KNOB_ALLOWLIST: frozenset[str] = frozenset()
+
+_ENV_KNOB_RE = re.compile(r"AGENTFIELD_[A-Z0-9_]+")
+
+
+def check_control_plane_knobs(repo_root: pathlib.Path | None = None) -> list[str]:
+    """Returns control-plane env knobs not mentioned in docs/*.md (empty =
+    pass). Knobs are discovered by scanning the control-plane sources for
+    ``AGENTFIELD_*`` names, so a new knob fails the lint until documented."""
+    root = _repo_root(repo_root)
+    knobs: set[str] = set()
+    for p in sorted((root / "agentfield_tpu" / "control_plane").glob("*.py")):
+        knobs.update(_ENV_KNOB_RE.findall(p.read_text(encoding="utf-8")))
+    docs = _docs_text(root)
+    return sorted(k for k in knobs - _KNOB_ALLOWLIST if k not in docs)
+
+
 def main() -> int:
+    rc = 0
     missing = check()
     if missing:
         print(
@@ -38,9 +75,20 @@ def main() -> int:
             f"table): {', '.join(missing)}",
             file=sys.stderr,
         )
-        return 1
-    print("check_engine_knobs: all EngineConfig fields documented")
-    return 0
+        rc = 1
+    else:
+        print("check_engine_knobs: all EngineConfig fields documented")
+    missing_cp = check_control_plane_knobs()
+    if missing_cp:
+        print(
+            "control-plane env knobs missing from docs/*.md (document them "
+            f"in docs/OPERATIONS.md): {', '.join(missing_cp)}",
+            file=sys.stderr,
+        )
+        rc = 1
+    else:
+        print("check_engine_knobs: all control-plane env knobs documented")
+    return rc
 
 
 if __name__ == "__main__":

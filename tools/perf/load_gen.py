@@ -7,7 +7,8 @@ p50/p95/p99, status histograms, Prometheus pre/post scrape). Usage:
 
     python tools/perf/load_gen.py --url http://127.0.0.1:8800 \\
         --target mynode.myreasoner --requests 200 --concurrency 16 \\
-        [--mode sync|async] [--payload '{"x":1}'] [--scrape-metrics]
+        [--mode sync|async] [--payload '{"x":1}'] [--scrape-metrics] \\
+        [--qps 500]   # open-loop fixed-rate arrivals (no coordinated omission)
 
 Scenarios (pair with tools/perf/stress_agent.py):
     --scenario nested --depth 2 --width 3     # width^depth call tree per req
@@ -21,7 +22,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
+import math
 import sys
 import time
 from pathlib import Path
@@ -32,11 +35,16 @@ import aiohttp
 
 
 def percentile(values: list[float], p: float) -> float:
+    """Nearest-rank percentile: the smallest value with at least p% of the
+    sample at or below it (rank = ceil(p/100 * N), 1-based). The old
+    ``int(len * p / 100)`` indexing over-indexed by up to one rank — e.g.
+    p50 of 10 samples read index 5 (the 6th value) instead of index 4 —
+    biasing every reported latency upward."""
     if not values:
         return 0.0
     values = sorted(values)
-    idx = min(int(len(values) * p / 100), len(values) - 1)
-    return values[idx]
+    rank = math.ceil(len(values) * p / 100.0)  # 1-based nearest rank
+    return values[min(max(rank, 1), len(values)) - 1]
 
 
 async def run_load(
@@ -47,42 +55,80 @@ async def run_load(
     mode: str = "sync",
     payload=None,
     timeout: float = 120.0,
+    qps: float | None = None,
+    execute=None,
 ) -> dict:
+    """Closed-loop by default (`concurrency` in-flight callers, each issuing
+    the next request only after its previous one finished). With ``qps``
+    set, arrivals are OPEN-LOOP at a fixed rate instead: request i is due at
+    ``t0 + i/qps`` regardless of how earlier requests are faring, and its
+    latency is measured from that *intended* start time. A slow server
+    therefore accumulates queueing delay into the reported percentiles
+    instead of silently throttling the offered load — the closed-loop
+    numbers understate tail latency under saturation (coordinated
+    omission).
+
+    ``execute`` (async callable ``(i) -> status_str``) replaces the HTTP
+    request with an in-process call — the gateway_qps bench drives
+    ``ExecutionGateway.execute_sync`` directly through the same loop,
+    percentile math, and report shape as the HTTP tool."""
     latencies: list[float] = []
     statuses: dict[str, int] = {}
     http_errors: dict[str, int] = {}
     sem = asyncio.Semaphore(concurrency)
 
-    async with aiohttp.ClientSession(
-        timeout=aiohttp.ClientTimeout(total=timeout)
-    ) as session:
-
-        async def one(i: int) -> None:
-            async with sem:
-                t0 = time.perf_counter()
-                try:
-                    if mode == "sync":
-                        async with session.post(
-                            f"{url}/api/v1/execute/{target}", json={"input": payload}
-                        ) as resp:
-                            doc = await resp.json()
-                            status = doc.get("status", f"http_{resp.status}")
-                    else:
-                        async with session.post(
-                            f"{url}/api/v1/execute/async/{target}", json={"input": payload}
-                        ) as resp:
-                            if resp.status == 503:
-                                status = "backpressure_503"
-                            else:
-                                eid = (await resp.json())["execution_id"]
-                                status = await _poll(session, url, eid, timeout)
-                    statuses[status] = statuses.get(status, 0) + 1
-                    latencies.append(time.perf_counter() - t0)
-                except Exception as e:
-                    http_errors[type(e).__name__] = http_errors.get(type(e).__name__, 0) + 1
-
+    # No HTTP session when an in-process execute hook drives the calls —
+    # an unused connector would just pollute the measured window.
+    session_ctx = (
+        aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=timeout))
+        if execute is None
+        else contextlib.nullcontext()
+    )
+    async with session_ctx as session:
         t_start = time.perf_counter()
-        await asyncio.gather(*(one(i) for i in range(requests)))
+
+        async def issue(i: int) -> None:
+            t0 = time.perf_counter()
+            if qps:
+                # Latency is charged from the scheduled arrival, not from
+                # whenever the event loop got around to sending: missed
+                # schedule IS queueing delay the client experienced.
+                t0 = t_start + i / qps
+            try:
+                if execute is not None:
+                    status = await execute(i)
+                elif mode == "sync":
+                    async with session.post(
+                        f"{url}/api/v1/execute/{target}", json={"input": payload}
+                    ) as resp:
+                        doc = await resp.json()
+                        status = doc.get("status", f"http_{resp.status}")
+                else:
+                    async with session.post(
+                        f"{url}/api/v1/execute/async/{target}", json={"input": payload}
+                    ) as resp:
+                        if resp.status == 503:
+                            status = "backpressure_503"
+                        else:
+                            eid = (await resp.json())["execution_id"]
+                            status = await _poll(session, url, eid, timeout)
+                statuses[status] = statuses.get(status, 0) + 1
+                latencies.append(time.perf_counter() - t0)
+            except Exception as e:
+                http_errors[type(e).__name__] = http_errors.get(type(e).__name__, 0) + 1
+
+        async def one_closed(i: int) -> None:
+            async with sem:
+                await issue(i)
+
+        async def one_open(i: int) -> None:
+            delay = t_start + i / qps - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await issue(i)
+
+        runner = one_open if qps else one_closed
+        await asyncio.gather(*(runner(i) for i in range(requests)))
         elapsed = time.perf_counter() - t_start
 
     ok = statuses.get("completed", 0)
@@ -90,7 +136,8 @@ async def run_load(
         "target": target,
         "mode": mode,
         "requests": requests,
-        "concurrency": concurrency,
+        "concurrency": concurrency if not qps else None,
+        "qps_offered": qps,
         "elapsed_s": round(elapsed, 3),
         "rps": round(len(latencies) / elapsed, 2) if elapsed else 0,
         "success_rate": round(ok / requests, 4),
@@ -166,6 +213,7 @@ async def run_scenario(args_ns) -> dict:
             args_ns.mode,
             _scenario_payload(args_ns, size),
             timeout=args_ns.timeout,
+            qps=getattr(args_ns, "qps", None),
         )
         if args_ns.scenario == "nested":
             r["scenario"] = {
@@ -189,6 +237,14 @@ async def main() -> None:
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--mode", choices=("sync", "async"), default="sync")
+    ap.add_argument(
+        "--qps",
+        type=float,
+        default=None,
+        help="open-loop fixed-rate arrivals (requests/s); latency is charged "
+        "from each request's scheduled start, so reported percentiles are "
+        "free of coordinated omission (default: closed-loop --concurrency)",
+    )
     ap.add_argument("--payload", default=None, help="JSON input payload")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--scenario", choices=("plain", "nested"), default="plain")

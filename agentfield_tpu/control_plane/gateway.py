@@ -597,8 +597,15 @@ class ExecutionGateway:
                 self.metrics.inc("worker_errors_total")
                 try:
                     await self.complete(ex.execution_id, error=f"internal dispatch error: {e!r}")
-                except Exception:
-                    pass
+                except Exception as e2:
+                    # Still swallowed (the worker loop must survive), but a
+                    # double fault is worth an operator-visible trace.
+                    log.warning(
+                        "failed to record internal dispatch error",
+                        execution_id=ex.execution_id,
+                        dispatch_error=repr(e),
+                        complete_error=repr(e2),
+                    )
 
     # ------------------------------------------------------------------
 
@@ -632,7 +639,7 @@ class ExecutionGateway:
             await barrier
         return ex
 
-    async def _complete_locked(
+    async def _complete_locked(  # guarded by: _complete_lock
         self,
         execution_id: str,
         result: Any = None,

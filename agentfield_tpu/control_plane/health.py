@@ -19,6 +19,9 @@ import aiohttp
 
 from agentfield_tpu.control_plane.registry import NodeRegistry
 from agentfield_tpu.control_plane.types import NodeStatus
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("health")
 
 
 class HealthMonitor:
@@ -147,12 +150,16 @@ class HealthMonitor:
                 fence_for = max(self.interval * 2, self.probe_backoff(max(over, 1)))
                 self.registry.fence(node.node_id, duration=fence_for)
                 await self.registry.heartbeat(node.node_id, {"status": "inactive"})
-            except Exception:
-                pass
+            except Exception as e:
+                # The node may have deregistered mid-deactivation — the
+                # warning below still fires; record why the fence didn't.
+                log.debug(
+                    "deactivation fence/heartbeat failed",
+                    node_id=node.node_id,
+                    error=repr(e),
+                )
             self.registry.metrics.inc("health_deactivations_total")
-            from agentfield_tpu.logging import get_logger
-
-            get_logger("health").warning(
+            log.warning(
                 "node deactivated by health probe",
                 node_id=node.node_id,
                 error=doc.get("error"),

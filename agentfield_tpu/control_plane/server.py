@@ -22,6 +22,9 @@ from agentfield_tpu.control_plane.metrics import Metrics
 from agentfield_tpu.control_plane.registry import NODE_TOPIC, NodeRegistry, RegistryError
 from agentfield_tpu.control_plane.types import ExecutionStatus, now
 from agentfield_tpu.control_plane.webhooks import WebhookDispatcher
+from agentfield_tpu.logging import get_logger
+
+_log = get_logger("server")
 
 MEMORY_TOPIC = "memory"
 VALID_SCOPES = ("global", "session", "actor", "workflow")
@@ -214,12 +217,16 @@ class ControlPlane:
         # Group-commit drain hook: flush journaled execution rows while the
         # connection is still open — a graceful shutdown (stop(), SIGTERM in
         # examples/run_control_plane.py) must lose nothing. close() drains
-        # again defensively for callers that skip stop().
+        # again defensively for callers that skip stop(). Both hop to a
+        # worker thread: drain() joins the flusher (seconds, worst case) and
+        # must not freeze in-flight responses on the way down.
         try:
-            self.storage.drain_executions()
-        except Exception:
-            pass  # close() retries; a failed flush must not block shutdown
-        self.storage.close()
+            await asyncio.to_thread(self.storage.drain_executions)
+        except Exception as e:
+            # close() retries the drain; a failed flush must not block
+            # shutdown, but it must not vanish either.
+            _log.warning("journal drain failed during stop", error=repr(e))
+        await asyncio.to_thread(self.storage.close)
 
     async def cleanup_once(self) -> dict[str, int]:
         """Stale marking + retention GC (reference: ExecutionCleanupService,
@@ -309,6 +316,7 @@ def create_app(cp: ControlPlane) -> web.Application:
         # Re-publish the storage journal's coalesced-write/flush counters at
         # scrape time (the journal lives below the metrics registry; its
         # stats() is an in-memory dict read — cheap and loop-safe).
+        # afcheck: ignore[async-blocking] journal_stats() reads an in-memory dict under a short mutex; no DB I/O
         jstats = cp.storage.journal_stats()
         if jstats:
             for k, v in jstats.items():
@@ -343,7 +351,13 @@ def create_app(cp: ControlPlane) -> web.Application:
                         doc = await r.json()
                         if isinstance(doc, dict) and doc.get("node_id") == node_id:
                             return cand
-            except Exception:
+            except Exception as e:
+                # Unreachable candidates are the expected case during
+                # registration races — trace them, keep probing.
+                _log.debug(
+                    "callback candidate probe failed",
+                    candidate=cand, node_id=node_id, error=repr(e),
+                )
                 continue
         return fallback
 

@@ -31,6 +31,9 @@ from agentfield_tpu.control_plane.types import (
     Execution,
     ExecutionStatus,
 )
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("control_plane.storage")
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS agent_nodes (
@@ -201,10 +204,10 @@ class ExecutionJournal:
         self._flush_lock = threading.Lock()  # serializes whole flushes
         # execution_id -> ("create" | "update", doc snapshot). Insertion
         # order is flush order; create+update coalesce to one create.
-        self._pending: dict[str, tuple[str, dict]] = {}
+        self._pending: dict[str, tuple[str, dict]] = {}  # guarded by: _mu
         # The batch currently being committed (immutable while in flight;
         # still consulted by readers; retried if the transaction fails).
-        self._flushing: dict[str, tuple[str, dict]] = {}
+        self._flushing: dict[str, tuple[str, dict]] = {}  # guarded by: _mu
         self._wake = threading.Event()
         # Set ONLY by flush_barrier(): lets a registering durability waiter
         # cut the coalescing window short immediately (plain writes keep
@@ -214,8 +217,8 @@ class ExecutionJournal:
         self._closed = False
         # Durability waiters: (loop, future) pairs resolved after the flush
         # that commits the rows they enqueued (flush_barrier()).
-        self._waiters: list[tuple[Any, Any]] = []
-        self._stats = {
+        self._waiters: list[tuple[Any, Any]] = []  # guarded by: _mu
+        self._stats = {  # guarded by: _mu
             "journal_writes_total": 0,        # buffered (non-terminal) writes
             "journal_coalesced_total": 0,     # writes absorbed into a pending row
             "journal_flushes_total": 0,       # batched transactions issued
@@ -261,7 +264,7 @@ class ExecutionJournal:
             self._stats["journal_writes_total"] += 1
         self._wake.set()
 
-    def _op_for(self, eid: str) -> str:
+    def _op_for(self, eid: str) -> str:  # guarded by: _mu
         """A row whose CREATE is still in PENDING stays an INSERT when a
         newer doc replaces it (one statement per row). A create sitting in
         ``_flushing`` is deliberately NOT consulted: its commit is in flight
@@ -507,6 +510,7 @@ class ExecutionJournal:
                 # new writes — buffered rows must not outlive the
                 # documented one-tick crash window just because traffic
                 # went idle. The sleep paces a persistent error.
+                # afcheck: ignore[async-blocking] runs on the dedicated exec-journal flusher thread, never on the event loop
                 time.sleep(max(self._interval, 0.05))
                 self._wake.set()
 
@@ -565,8 +569,9 @@ class SQLiteStorage:
         if self._journal is not None:
             try:
                 self._journal.drain()
-            except Exception:
-                pass  # a failed final flush must not block close
+            except Exception as e:
+                # a failed final flush must not block close
+                log.warning("journal drain failed during close", error=repr(e))
         with self._lock:
             self._conn.close()
 

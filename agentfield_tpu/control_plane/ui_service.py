@@ -172,8 +172,9 @@ async def node_details(cp, node_id: str) -> dict[str, Any] | None:
         reg = load_registry(cp.data_dir)
         if node_id in reg:
             doc["package"] = dict(reg[node_id])
+    # afcheck: ignore[except-swallow] package registry is optional context, never a 500
     except Exception:
-        pass  # package registry is optional context, never a 500
+        pass
     return doc
 
 

@@ -27,6 +27,7 @@ from typing import Any, Callable
 import pydantic
 from aiohttp import web
 
+from agentfield_tpu.logging import get_logger
 from agentfield_tpu.sdk.client import ControlPlaneClient, ControlPlaneError
 from agentfield_tpu.sdk.context import (
     ExecutionContext,
@@ -34,6 +35,8 @@ from agentfield_tpu.sdk.context import (
     reset_context,
     set_context,
 )
+
+log = get_logger("sdk.agent")
 
 DEFAULT_CONTROL_PLANE = os.environ.get("AGENTFIELD_URL", "http://127.0.0.1:8800")
 
@@ -357,8 +360,13 @@ class Agent:
     async def _safe_status(self, execution_id: str, status: str, **kw) -> None:
         try:
             await self.client.post_status(execution_id, status, **kw)
-        except Exception:
-            pass  # control plane unreachable; execution will be marked stale
+        except Exception as e:
+            # Control plane unreachable; the execution will be marked stale
+            # by its cleanup — leave the operator a trace of the lost ack.
+            log.debug(
+                "status callback failed",
+                execution_id=execution_id, status=status, error=repr(e),
+            )
 
     # -- outbound: call() and ai() -------------------------------------
 
@@ -859,8 +867,9 @@ class Agent:
         }
         try:
             await self.client.post_workflow_event(base)
-        except Exception:
-            pass  # tracking is best-effort; the stream itself must not fail
+        except Exception as e:
+            # tracking is best-effort; the stream itself must not fail
+            log.debug("workflow start event failed", error=repr(e))
         payload = {
             "prompt": prompt,
             "tokens": tokens,
@@ -913,8 +922,9 @@ class Agent:
             }
             try:
                 await self.client.post_workflow_event(done)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort tracking, same contract as the start event
+                log.debug("workflow done event failed", error=repr(e))
 
     async def handle_serverless(self, event: dict[str, Any]) -> dict[str, Any]:
         """Process one invocation without a long-lived HTTP server (reference:
@@ -941,8 +951,12 @@ class Agent:
             return
         try:
             await self.client.add_note(ctx.execution_id, note, actor or self.node_id)
-        except Exception:
-            pass  # notes are advisory; never fail the reasoner over one
+        except Exception as e:
+            # notes are advisory; never fail the reasoner over one
+            log.debug(
+                "note delivery failed",
+                execution_id=ctx.execution_id, error=repr(e),
+            )
 
     # -- memory façade --------------------------------------------------
 
@@ -1026,6 +1040,7 @@ class Agent:
             await asyncio.gather(*self._pending, return_exceptions=True)
         try:
             await self.client.heartbeat(self.node_id, status="stopping")
+        # afcheck: ignore[except-swallow] shutdown courtesy beat; the plane may already be gone and the lease sweep covers us
         except Exception:
             pass
         if self._runner:
@@ -1058,8 +1073,13 @@ class Agent:
                     r = cb()
                     if inspect.isawaitable(r):
                         await r
-                except Exception:
-                    pass  # observer errors must not break heartbeating
+                except Exception as e:
+                    # observer errors must not break heartbeating
+                    log.debug(
+                        "reconnect observer failed",
+                        observer=getattr(cb, "__name__", repr(cb)),
+                        error=repr(e),
+                    )
 
         task = asyncio.create_task(run())
         self._pending.add(task)
@@ -1076,8 +1096,8 @@ class Agent:
             try:
                 if callable(self.heartbeat_stats):
                     stats = self.heartbeat_stats()
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("heartbeat stats provider failed", error=repr(e))
             try:
                 await self.client.heartbeat(self.node_id, stats=stats)
             except ControlPlaneError as e:
@@ -1085,8 +1105,12 @@ class Agent:
                 if e.status == 404:  # control plane restarted: re-register
                     try:
                         await self.client.register_node(self._node_spec())
-                    except Exception:
-                        pass
+                    except Exception as re_err:
+                        # next heartbeat retries; keep the failure visible
+                        log.debug(
+                            "re-registration after 404 failed",
+                            node_id=self.node_id, error=repr(re_err),
+                        )
                     else:
                         # The node is live on the fresh plane NOW — that is
                         # the recovery, not the next heartbeat. A 404 proves

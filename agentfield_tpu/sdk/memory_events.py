@@ -13,6 +13,10 @@ from typing import Any, Awaitable, Callable
 
 import aiohttp
 
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("sdk.memory_events")
+
 Handler = Callable[[dict[str, Any]], Awaitable[None] | None]
 
 
@@ -65,8 +69,9 @@ class MemoryEventClient:
                             await self._dispatch(msg.json())
             except asyncio.CancelledError:
                 raise
-            except Exception:
-                pass  # fall through to reconnect
+            except Exception as e:
+                # fall through to reconnect with backoff
+                log.debug("memory event stream dropped", error=repr(e))
             self.connected = False
             await asyncio.sleep(delay)
             delay = min(delay * 2, self.max_delay)
@@ -83,5 +88,9 @@ class MemoryEventClient:
                 out = fn(ev)
                 if asyncio.iscoroutine(out):
                     await out
-            except Exception:
-                pass  # one bad handler must not break the stream
+            except Exception as e:
+                # one bad handler must not break the stream
+                log.debug(
+                    "memory event handler failed",
+                    pattern=pattern, key=key, error=repr(e),
+                )

@@ -1224,7 +1224,9 @@ class InferenceEngine:
         self._shared_prefix = bool(
             self.ecfg.enable_prefix_cache and self.ecfg.shared_prefix_cache
         )
-        self.allocator = PrefixPagePool(
+        # The pool itself is lock-free (kv_cache.py declares its innards
+        # `guarded by: external(...)`): THIS lock is the serializer.
+        self.allocator = PrefixPagePool(  # guarded by: _session_lock
             self.ecfg.num_pages, self.ecfg.page_size, stats=self.stats
         )
         # Per-pending-request prompt chain hashes, computed once: the
@@ -1262,7 +1264,7 @@ class InferenceEngine:
         self._gbank_clock = 0.0  # LRU tiebreaker for eviction
         self.slots: list[_Slot | None] = [None] * B
         self.pending: collections.deque[Request] = collections.deque()
-        self._sessions: dict[str, _SessionEntry] = {}
+        self._sessions: dict[str, _SessionEntry] = {}  # guarded by: _session_lock
         # Cancellation requests (thread-safe set): drained inside step() on
         # the worker thread — mutating slots from other threads mid-step
         # would race the decode batch.
@@ -1307,8 +1309,8 @@ class InferenceEngine:
         # The lock serializes worker-thread appends against event-loop reads
         # (heartbeats, /stats) — iterating a deque mid-append raises.
         self._telemetry_lock = threading.Lock()
-        self._itl_window: collections.deque[float] = collections.deque(maxlen=4096)
-        self._tick_tokens: collections.deque[int] = collections.deque(maxlen=1024)
+        self._itl_window: collections.deque[float] = collections.deque(maxlen=4096)  # guarded by: _telemetry_lock
+        self._tick_tokens: collections.deque[int] = collections.deque(maxlen=1024)  # guarded by: _telemetry_lock
 
     # ------------------------------------------------------------------
     # host-side scheduling
@@ -1591,7 +1593,7 @@ class InferenceEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _alloc_with_eviction(self, n: int) -> list[int] | None:
+    def _alloc_with_eviction(self, n: int) -> list[int] | None:  # guarded by: _session_lock
         """Allocate n pages, evicting LRU idle sessions if needed (cached
         prefixes are a best-effort optimization; live requests win)."""
         if _engine_fault("engine.page_pressure") is not None:
@@ -1607,7 +1609,7 @@ class InferenceEngine:
             pages = self.allocator.alloc(n)
         return pages
 
-    def _session_hit(self, req: Request) -> tuple[_SessionEntry, int] | None:
+    def _session_hit(self, req: Request) -> tuple[_SessionEntry, int] | None:  # guarded by: _session_lock
         """Returns (entry, reusable-token count) on a prefix-cache hit, without
         mutating the entry — admission may still fail on page starvation and
         must be able to restore the session untouched."""
@@ -1741,14 +1743,16 @@ class InferenceEngine:
                 self.ecfg.prefill_chunk is not None
                 and len(req.prompt) > self.ecfg.prefill_chunk
             )
-            has_sess = (
-                req.session_id is not None
-                and self.ecfg.enable_prefix_cache
-                and req.session_id in self._sessions
-            )
-            index_hit = False
-            if not (chunked or has_sess or req.mm_embeds) and self._shared_prefix:
-                with self._session_lock:
+            with self._session_lock:
+                # one hold covers both probes: the has_sess membership test
+                # races gc_sessions/free_session on other threads otherwise
+                has_sess = (
+                    req.session_id is not None
+                    and self.ecfg.enable_prefix_cache
+                    and req.session_id in self._sessions
+                )
+                index_hit = False
+                if not (chunked or has_sess or req.mm_embeds) and self._shared_prefix:
                     index_hit = (
                         self.allocator.peek(
                             req.prompt[: len(req.prompt) - 1],

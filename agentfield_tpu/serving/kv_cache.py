@@ -216,13 +216,19 @@ class PrefixPagePool:
             raise ValueError(f"page_size={page_size} must be >= 1")
         self.num_pages = num_pages
         self.page_size = page_size
-        self._refs = [0] * num_pages
-        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
-        self._by_hash: dict[bytes, PageRecord] = {}
-        self._by_page: dict[int, PageRecord] = {}
+        # The pool's bookkeeping is serialized by its OWNER, not in-class
+        # (the engine holds _session_lock around every call — see the
+        # "guarded by: _session_lock" annotations on the engine's allocator
+        # and pool attributes). afcheck's guarded-by pass enforces the
+        # corollary it CAN check: nothing outside this class touches these.
+        self._refs = [0] * num_pages  # guarded by: external(engine _session_lock)
+        # free list; pop() yields 1,2,...
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # guarded by: external(engine _session_lock)
+        self._by_hash: dict[bytes, PageRecord] = {}  # guarded by: external(engine _session_lock)
+        self._by_page: dict[int, PageRecord] = {}  # guarded by: external(engine _session_lock)
         # refcount-0 cached pages in eviction order (oldest first); OrderedDict
         # gives O(1) touch/evict instead of an O(cached) min() per allocation.
-        self._lru: collections.OrderedDict[int, None] = collections.OrderedDict()
+        self._lru: collections.OrderedDict[int, None] = collections.OrderedDict()  # guarded by: external(engine _session_lock)
         self._clock = 0.0
         # Shared counter surface (the engine passes its stats dict so pool
         # events ride heartbeats/metrics without a mirror-copy step).

@@ -16,6 +16,7 @@ import aiohttp
 from aiohttp import web
 
 from agentfield_tpu.control_plane.server import ControlPlane, create_app
+from tools.analysis.lock_witness import LockWitness
 
 
 def async_test(fn):
@@ -132,6 +133,19 @@ class CPHarness:
         self.agent = FakeAgent(self.base_url)
         self._runner: web.AppRunner | None = None
         self.http: aiohttp.ClientSession | None = None
+        # Lock-order witness (tools/analysis/lock_witness.py): every harness
+        # test records storage/journal lock acquisition order and fails on a
+        # cycle — the runtime complement of afcheck's static guarded-by pass.
+        self.lock_witness = LockWitness()
+        storage = self.cp.storage
+        if hasattr(storage, "_lock"):
+            self.lock_witness.instrument(storage, "_lock", "storage._lock")
+        journal = getattr(storage, "journal", None)
+        if journal is not None:
+            self.lock_witness.instrument(journal, "_mu", "journal._mu")
+            self.lock_witness.instrument(
+                journal, "_flush_lock", "journal._flush_lock"
+            )
 
     async def __aenter__(self):
         self._runner = web.AppRunner(create_app(self.cp))
@@ -145,6 +159,8 @@ class CPHarness:
         await self.http.close()
         await self.agent.stop()
         await self._runner.cleanup()
+        if exc == (None, None, None):  # never mask the test's own failure
+            self.lock_witness.assert_no_cycles()
 
     async def register_agent(self, node_id: str = "fake-agent"):
         return await self.register_fake(self.agent, node_id)

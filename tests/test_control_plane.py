@@ -622,9 +622,11 @@ def test_load_gen_percentile_nearest_rank():
 def test_control_plane_knobs_documented():
     """Docs lint (tier-1): every AGENTFIELD_* env knob read by the control
     plane — group-commit journal, registry cache, fault injection — must be
-    documented under docs/ (operators learn knobs from OPERATIONS.md)."""
-    from tools.check_engine_knobs import check_control_plane_knobs
+    documented under docs/ (operators learn knobs from OPERATIONS.md). Runs
+    as afcheck's `knob-docs` pass (tools/analysis, docs/STATIC_ANALYSIS.md)."""
+    from tools.analysis import run_analysis
 
-    assert check_control_plane_knobs() == [], (
-        "undocumented control-plane env knobs; add them to docs/OPERATIONS.md"
+    findings, _ = run_analysis(
+        pass_ids=["knob-docs"], paths=["agentfield_tpu/control_plane"]
     )
+    assert findings == [], "\n".join(f.format() for f in findings)

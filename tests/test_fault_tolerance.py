@@ -623,6 +623,9 @@ async def test_group_commit_kill_mid_burst_zero_lost_terminals():
 
 
 def test_http_timeouts_lint():
-    from tools.check_http_timeouts import check
+    """Every aiohttp/httpx client construction in shipped code carries an
+    explicit timeout=. Runs as afcheck's `http-timeout` pass."""
+    from tools.analysis import run_analysis
 
-    assert check() == [], "HTTP client call sites without an explicit timeout"
+    findings, _ = run_analysis(pass_ids=["http-timeout"])
+    assert findings == [], "\n".join(f.format() for f in findings)

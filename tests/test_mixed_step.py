@@ -242,16 +242,14 @@ def test_compile_cache_knob(params, tmp_path):
 
 def test_engine_knobs_documented():
     """tier-1 lint: every EngineConfig field appears in docs/*.md (the
-    reference table in docs/ARCHITECTURE.md)."""
-    import pathlib
-    import sys
+    reference table in docs/ARCHITECTURE.md). Runs as afcheck's `knob-docs`
+    pass (tools/analysis, docs/STATIC_ANALYSIS.md)."""
+    from tools.analysis import run_analysis
 
-    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
-    try:
-        from check_engine_knobs import check
-    finally:
-        sys.path.pop(0)
-    assert check() == [], "undocumented EngineConfig fields"
+    findings, _ = run_analysis(
+        pass_ids=["knob-docs"], paths=["agentfield_tpu/serving/engine.py"]
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
 
 
 def test_mixed_starved_head_does_not_block_window(params):

@@ -1,0 +1,87 @@
+"""afcheck: the repo's unified AST-based static analysis suite.
+
+Entry points:
+
+- ``python -m tools.analysis`` — full run, exit 1 on any finding (tier-1
+  runs this via tests/test_static_analysis.py);
+- ``run_analysis(...)`` — the same thing as a function, for tests and
+  embedding;
+- ``tools.analysis.lock_witness`` — the runtime companion: lock-acquisition
+  order recording + cycle detection, wired into tests/helpers_cp.py.
+
+See docs/STATIC_ANALYSIS.md for the pass catalogue, the ``# guarded by:``
+annotation convention, and the pragma/allowlist syntax.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Iterable
+
+from tools.analysis.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    discover,
+    load_allowlist,
+    run_passes,
+)
+from tools.analysis.passes import ALL_PASSES, PASS_IDS
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+ALLOWLIST_PATH = pathlib.Path(__file__).resolve().parent / "allowlist.toml"
+
+
+def run_analysis(
+    root: pathlib.Path | None = None,
+    paths: Iterable[str] | None = None,
+    pass_ids: Iterable[str] | None = None,
+    changed_only: bool = False,
+    allowlist_path: pathlib.Path | None = None,
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Run the suite; returns (findings, info). ``root=None`` means this
+    repo with its checked-in allowlist; tests point ``root`` at fixture
+    trees (with no allowlist unless given)."""
+    if root is None:
+        root = REPO_ROOT
+        if allowlist_path is None:
+            allowlist_path = ALLOWLIST_PATH
+    elif allowlist_path is None:
+        cand = root / "tools" / "analysis" / "allowlist.toml"
+        if cand.is_file():
+            allowlist_path = cand
+    allowlist = load_allowlist(allowlist_path) if allowlist_path else {}
+    files = discover(root, paths=paths, changed_only=changed_only)
+    ctx = Context(root, files, allowlist)
+    wanted = set(pass_ids) if pass_ids is not None else None
+    passes: list[Pass] = []
+    for cls in ALL_PASSES:
+        if wanted is not None and cls.id not in wanted:
+            continue
+        p = cls()
+        if changed_only and not any(p.relevant(f.rel) for f in files):
+            continue
+        passes.append(p)
+    findings = run_passes(ctx, passes)
+    info = {
+        "files_scanned": len(files),
+        "passes": [p.id for p in passes],
+    }
+    return findings, info
+
+
+__all__ = [
+    "ALL_PASSES",
+    "ALLOWLIST_PATH",
+    "Context",
+    "Finding",
+    "PASS_IDS",
+    "Pass",
+    "REPO_ROOT",
+    "SourceFile",
+    "discover",
+    "load_allowlist",
+    "run_analysis",
+    "run_passes",
+]

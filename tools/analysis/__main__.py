@@ -1,0 +1,80 @@
+"""CLI runner: ``python -m tools.analysis [--json] [--changed] [paths...]``.
+
+Exit status 0 = clean, 1 = findings (or unparseable files). ``--changed``
+limits the walk to the git working-tree delta for fast local iteration —
+project-shaped passes (knob-docs) still run when any file they depend on
+changed. ``--json`` emits machine-readable output for CI annotation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.analysis import PASS_IDS, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="afcheck: unified static analysis suite "
+        "(docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="only walk files changed vs HEAD (plus untracked)",
+    )
+    ap.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=PASS_IDS,
+        help="run only this pass (repeatable)",
+    )
+    ap.add_argument(
+        "--root",
+        type=pathlib.Path,
+        default=None,
+        help="repo root to analyze (default: this checkout, with its "
+        "checked-in allowlist)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", help="limit the walk to these files/directories"
+    )
+    args = ap.parse_args(argv)
+
+    findings, info = run_analysis(
+        root=args.root,
+        paths=args.paths or None,
+        pass_ids=args.passes,
+        changed_only=args.changed,
+    )
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": not findings,
+                    "findings": [f.to_dict() for f in findings],
+                    **info,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        print(
+            f"afcheck: {len(findings)} finding(s) across "
+            f"{info['files_scanned']} file(s), passes: "
+            f"{', '.join(info['passes']) or 'none'}",
+            file=sys.stderr if findings else sys.stdout,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

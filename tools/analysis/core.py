@@ -1,0 +1,330 @@
+"""afcheck core: one AST walk, pluggable passes, shared suppression.
+
+The invariants that keep this codebase correct under concurrency ("terminal
+writes only under the completion lock", "never block the event loop on
+SQLite", "no host branching inside jitted fns") used to live in reviewers'
+heads and two ad-hoc regex lints. This framework turns them into
+machine-checked passes sharing one file-discovery layer, one pragma syntax,
+and one allowlist, so adding an invariant is ~a hundred lines of visitor
+instead of a new standalone script (docs/STATIC_ANALYSIS.md).
+
+Suppression, narrowest first:
+
+- inline pragma ``# afcheck: ignore[<pass-id>]`` on the finding's line (or
+  on a standalone comment line directly above it) — for single deliberate
+  violations, with the reason in the same comment;
+- per-pass ``skip`` globs in ``tools/analysis/allowlist.toml`` — for whole
+  files a pass cannot reason about (generated code, vendored code);
+- ``[global] skip`` — files no pass should read at all.
+
+Runner: ``python -m tools.analysis`` (exit 1 on any finding); see
+``__main__.py`` for ``--json`` and ``--changed``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import io
+import pathlib
+import re
+import subprocess
+import tokenize
+from typing import Any, Iterable
+
+PRAGMA_RE = re.compile(r"#\s*afcheck:\s*ignore\[([^\]]+)\]")
+
+# Mirrors the shipped-code surface the old standalone lints walked: tests
+# spin ephemeral localhost fixtures and deliberately violate production
+# conventions, so they are not scanned.
+DEFAULT_SCAN_DIRS = ("agentfield_tpu", "tools", "examples")
+DEFAULT_SCAN_FILES = ("bench.py",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: where, which invariant, and how to fix it."""
+
+    pass_id: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        tail = f" — {self.hint}" if self.hint else ""
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}{tail}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_UNPARSED = object()
+
+
+class SourceFile:
+    """One scanned file: text, lazily parsed AST, and its pragma index."""
+
+    def __init__(self, root: pathlib.Path, path: pathlib.Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self._tree: Any = _UNPARSED
+        # line -> comment text, from real COMMENT tokens (a "# guarded by:"
+        # example inside a docstring must not register as an annotation)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenizeError, IndentationError, SyntaxError):
+            pass  # unparseable file: surfaced by the runner's parse finding
+        # line -> set of suppressed pass ids ("*" = all passes)
+        self.pragmas: dict[int, set[str]] = {}
+        for i, c in self.comments.items():
+            m = PRAGMA_RE.search(c)
+            if m:
+                self.pragmas[i] = {s.strip() for s in m.group(1).split(",") if s.strip()}
+
+    @property
+    def tree(self) -> ast.AST | None:
+        """Parsed module, or None when the file does not parse (a syntax
+        error is surfaced as its own finding by the runner)."""
+        if self._tree is _UNPARSED:
+            try:
+                self._tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError:
+                self._tree = None
+        return self._tree
+
+    def suppressed(self, line: int, pass_id: str) -> bool:
+        """Pragma on the finding's own line, or on a standalone comment line
+        directly above it (for statements too long to carry the pragma)."""
+        ids = self.pragmas.get(line)
+        if ids is not None and (pass_id in ids or "*" in ids):
+            return True
+        ids = self.pragmas.get(line - 1)
+        if ids is not None and (pass_id in ids or "*" in ids):
+            above = self.lines[line - 2].lstrip() if 0 <= line - 2 < len(self.lines) else ""
+            if above.startswith("#"):
+                return True
+        return False
+
+
+def _strip_toml_comment(line: str) -> str:
+    out = []
+    in_str = False
+    for ch in line:
+        if ch == '"':
+            in_str = not in_str
+        if ch == "#" and not in_str:
+            break
+        out.append(ch)
+    return "".join(out).strip()
+
+
+def load_allowlist(path: pathlib.Path) -> dict[str, dict[str, Any]]:
+    """Parse the subset of TOML the allowlist uses: ``[section]`` tables,
+    string values, and (possibly multiline) arrays of strings. stdlib
+    ``tomllib`` is 3.11+ and this repo pins 3.10, so the ~30-line subset
+    parser beats a vendored dependency."""
+    cfg: dict[str, dict[str, Any]] = {}
+    if not path.is_file():
+        return cfg
+    section: dict[str, Any] | None = None
+    buf = ""
+    key = ""
+    for raw in path.read_text(encoding="utf-8").splitlines():
+        line = _strip_toml_comment(raw)
+        if buf:  # continuing a multiline array
+            buf += " " + line
+            if buf.count("[") == buf.count("]"):
+                section[key] = re.findall(r'"([^"]*)"', buf)
+                buf = ""
+            continue
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = cfg.setdefault(line[1:-1].strip(), {})
+            continue
+        if "=" not in line or section is None:
+            raise ValueError(f"{path}: cannot parse allowlist line {raw!r}")
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("["):
+            if val.count("[") == val.count("]"):
+                section[key] = re.findall(r'"([^"]*)"', val)
+            else:
+                buf = val
+        elif val.startswith('"') and val.endswith('"'):
+            section[key] = val[1:-1]
+        else:
+            raise ValueError(f"{path}: cannot parse allowlist value {raw!r}")
+    return cfg
+
+
+class Context:
+    """Everything a pass sees: the file set, the allowlist, the repo root."""
+
+    def __init__(
+        self,
+        root: pathlib.Path,
+        files: list[SourceFile],
+        allowlist: dict[str, dict[str, Any]] | None = None,
+    ):
+        self.root = root
+        self.files = files
+        self.by_rel = {f.rel: f for f in files}
+        self.allowlist = allowlist or {}
+
+    def cfg(self, pass_id: str) -> dict[str, Any]:
+        return self.allowlist.get(pass_id, {})
+
+    def skipped(self, pass_id: str, rel: str) -> bool:
+        pats = list(self.allowlist.get("global", {}).get("skip", []))
+        pats += list(self.cfg(pass_id).get("skip", []))
+        return any(fnmatch.fnmatch(rel, p) for p in pats)
+
+
+class Pass:
+    """One invariant. Subclasses either override ``check_file`` (per-file
+    AST walk) or ``run`` (project-shaped checks like the docs lints)."""
+
+    id: str = ""
+    description: str = ""
+
+    def relevant(self, rel: str) -> bool:
+        """Path filter; also decides whether --changed re-runs this pass."""
+        return True
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for f in ctx.files:
+            if not self.relevant(f.rel) or ctx.skipped(self.id, f.rel):
+                continue
+            if f.tree is None:
+                continue
+            out.extend(self.check_file(ctx, f))
+        return out
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        return []
+
+
+# -- shared AST helpers ---------------------------------------------------
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> "X", else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def attr_chain(node: ast.AST) -> list[str]:
+    """Dotted-name chain of an expression: ``a.b.c`` -> ["a","b","c"];
+    returns [] when the root is not a plain Name (calls, subscripts)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def iter_functions(tree: ast.AST) -> Iterable[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- discovery ------------------------------------------------------------
+
+
+def _changed_rel_paths(root: pathlib.Path) -> set[str] | None:
+    """Working-tree changes vs HEAD plus untracked files, or None when git
+    is unavailable (fall back to the full walk rather than checking nothing)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = set(diff.stdout.split())
+    if untracked.returncode == 0:
+        out |= set(untracked.stdout.split())
+    return out
+
+
+def discover(
+    root: pathlib.Path,
+    paths: Iterable[str] | None = None,
+    changed_only: bool = False,
+) -> list[SourceFile]:
+    """The shipped-code file set: DEFAULT_SCAN_DIRS + DEFAULT_SCAN_FILES,
+    optionally narrowed to explicit ``paths`` or (``--changed``) to the git
+    working-tree delta."""
+    candidates: list[pathlib.Path] = []
+    if paths:
+        for p in paths:
+            fp = root / p
+            if fp.is_dir():
+                candidates += sorted(fp.rglob("*.py"))
+            elif fp.is_file():
+                candidates.append(fp)
+    else:
+        for d in DEFAULT_SCAN_DIRS:
+            if (root / d).is_dir():
+                candidates += sorted((root / d).rglob("*.py"))
+        for fname in DEFAULT_SCAN_FILES:
+            if (root / fname).is_file():
+                candidates.append(root / fname)
+    changed = _changed_rel_paths(root) if changed_only else None
+    files: list[SourceFile] = []
+    for p in candidates:
+        if "__pycache__" in p.parts or p.suffix != ".py":
+            continue
+        rel = p.relative_to(root).as_posix()
+        if changed is not None and rel not in changed:
+            continue
+        files.append(SourceFile(root, p))
+    return files
+
+
+def run_passes(
+    ctx: Context, passes: Iterable[Pass]
+) -> list[Finding]:
+    """Run passes over the context, apply pragma suppression, report parse
+    failures once, and return findings sorted by location."""
+    findings: list[Finding] = []
+    for f in ctx.files:
+        if ctx.skipped("parse", f.rel):
+            continue
+        if f.tree is None:
+            findings.append(
+                Finding("parse", f.rel, 1, "file does not parse; all passes skipped it")
+            )
+    for p in passes:
+        for fd in p.run(ctx):
+            sf = ctx.by_rel.get(fd.path)
+            if sf is not None and sf.suppressed(fd.line, fd.pass_id):
+                continue
+            findings.append(fd)
+    findings.sort(key=lambda fd: (fd.path, fd.line, fd.pass_id))
+    return findings

@@ -1,0 +1,179 @@
+"""Runtime companion to the guarded-by pass: lock-ORDER witnessing.
+
+Static analysis proves each guarded attribute sits under its lock; it
+cannot prove two locks are always taken in the same order — the ABBA
+deadlock is invisible file-by-file. This witness wraps real locks, records
+every "acquired B while holding A" edge per thread, and fails on a cycle in
+that graph: a cycle means two code paths disagree about lock order, i.e. a
+deadlock is one unlucky preemption away even if the test run never hung.
+
+Usage (wired into tests/helpers_cp.py — every CPHarness test witnesses the
+storage/journal locks for free):
+
+    w = LockWitness()
+    w.instrument(journal, "_mu", "journal._mu")
+    w.instrument(journal, "_flush_lock", "journal._flush_lock")
+    ... run the workload ...
+    w.assert_no_cycles()   # raises LockOrderError listing the cycle
+
+Wrapped locks keep the Lock/RLock interface (acquire/release, context
+manager, ``locked``); re-entrant re-acquisition records no self-edge.
+Recording is itself guarded by one internal mutex — acquisition-order
+edges are small and deduplicated, so overhead stays negligible for tests
+(this is a test-time tool, not a production wrapper).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LockOrderError(AssertionError):
+    """Two code paths acquire the witnessed locks in conflicting order."""
+
+
+class _WitnessedLock:
+    """Duck-typed Lock/RLock proxy reporting acquisitions to the witness."""
+
+    def __init__(self, witness: "LockWitness", name: str, inner):
+        self._witness = witness
+        self.name = name
+        self.inner = inner
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self.inner.acquire(*args, **kwargs)
+        if got:
+            self._witness._on_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        self._witness._on_release(self.name)
+        self.inner.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self.inner, "locked", None)
+        if fn is not None:
+            return fn()
+        # RLock grows .locked() only in 3.12; "held by this thread" is the
+        # closest answer the 3.10 interface offers.
+        return bool(self.inner._is_owned())
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self.name} over {self.inner!r}>"
+
+
+class LockWitness:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # lock name -> names acquired WHILE it was held, with one witnessed
+        # stack (site) kept per edge for the error message.
+        self._edges: dict[str, dict[str, tuple[str, ...]]] = {}
+        self._held = threading.local()  # per-thread acquisition stack
+
+    # -- instrumentation -------------------------------------------------
+
+    def wrap(self, lock, name: str) -> _WitnessedLock:
+        return _WitnessedLock(self, name, lock)
+
+    def instrument(self, obj, attr: str, name: str | None = None) -> None:
+        """Replace ``obj.attr`` (a Lock/RLock) with a witnessed proxy.
+        Duck-typed no-op "locks" without acquire/release (the Postgres
+        provider's _NullLock) serialize nothing and are left alone."""
+        inner = getattr(obj, attr)
+        if isinstance(inner, _WitnessedLock):
+            return  # already witnessed (idempotent across fixtures)
+        if not (hasattr(inner, "acquire") and hasattr(inner, "release")):
+            return
+        setattr(obj, attr, self.wrap(inner, name or f"{type(obj).__name__}.{attr}"))
+
+    # -- recording -------------------------------------------------------
+
+    def _stack(self) -> list[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _on_acquire(self, name: str) -> None:
+        stack = self._stack()
+        if name not in stack:  # re-entrant RLock holds record no edges
+            with self._mu:
+                for outer in stack:
+                    self._edges.setdefault(outer, {}).setdefault(
+                        name, tuple(stack)
+                    )
+        stack.append(name)
+
+    def _on_release(self, name: str) -> None:
+        stack = self._stack()
+        # remove the most recent hold of `name` (locks are not always
+        # released LIFO; acquire/release pairs may interleave)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- analysis --------------------------------------------------------
+
+    def edges(self) -> dict[str, set[str]]:
+        with self._mu:
+            return {a: set(bs) for a, bs in self._edges.items()}
+
+    def find_cycle(self) -> list[str] | None:
+        """A lock-name cycle in the acquired-while-holding graph, or None."""
+        edges = self.edges()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in edges}
+        parent: dict[str, str] = {}
+
+        def dfs(n: str) -> list[str] | None:
+            color[n] = GRAY
+            for m in edges.get(n, ()):
+                if color.get(m, WHITE) == GRAY:
+                    # unwind the gray path m -> ... -> n, close with m
+                    cyc = [n]
+                    cur = n
+                    while cur != m:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    cyc.reverse()
+                    cyc.append(m)
+                    return cyc
+                if color.get(m, WHITE) == WHITE and m in edges:
+                    parent[m] = n
+                    found = dfs(m)
+                    if found:
+                        return found
+                elif color.get(m, WHITE) == WHITE:
+                    color[m] = BLACK  # leaf: no outgoing edges
+            color[n] = BLACK
+            return None
+
+        for n in list(edges):
+            if color[n] == WHITE:
+                found = dfs(n)
+                if found:
+                    return found
+        return None
+
+    def assert_no_cycles(self) -> None:
+        cyc = self.find_cycle()
+        if cyc is not None:
+            with self._mu:
+                detail = "; ".join(
+                    f"{a}->{b} (held: {list(self._edges[a][b])})"
+                    for a, b in zip(cyc, cyc[1:])
+                    if b in self._edges.get(a, {})
+                )
+            raise LockOrderError(
+                "lock acquisition order cycle (deadlock potential): "
+                + " -> ".join(cyc)
+                + (f" [{detail}]" if detail else "")
+            )

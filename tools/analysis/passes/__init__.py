@@ -1,0 +1,23 @@
+"""Pass registry for afcheck. Adding a pass = one module here + one entry
+in ALL_PASSES (docs/STATIC_ANALYSIS.md, "adding a pass")."""
+
+from __future__ import annotations
+
+from tools.analysis.core import Pass
+from tools.analysis.passes.async_blocking import AsyncBlockingPass
+from tools.analysis.passes.except_swallow import ExceptSwallowPass
+from tools.analysis.passes.guarded_by import GuardedByPass
+from tools.analysis.passes.http_timeout import HttpTimeoutPass
+from tools.analysis.passes.knob_docs import KnobDocsPass
+from tools.analysis.passes.tracer_safety import TracerSafetyPass
+
+ALL_PASSES: tuple[type[Pass], ...] = (
+    GuardedByPass,
+    AsyncBlockingPass,
+    ExceptSwallowPass,
+    TracerSafetyPass,
+    KnobDocsPass,
+    HttpTimeoutPass,
+)
+
+PASS_IDS: tuple[str, ...] = tuple(p.id for p in ALL_PASSES)

@@ -1,0 +1,106 @@
+"""async-blocking: the control plane's event loop must never block.
+
+The control plane is one asyncio process: a blocking call in an ``async
+def`` stalls heartbeats, SSE streams, and every in-flight dispatch at once.
+The conventions this pass encodes (docs/ARCHITECTURE.md, AsyncStorage
+docstring):
+
+- storage goes through the awaitable facade (``await self.db.<m>()``) so
+  the PROVIDER decides whether to hop threads — never a direct synchronous
+  ``self.storage.<m>()`` / ``...sync.<m>()`` call from async code;
+- file I/O and other blocking work hops via ``asyncio.to_thread`` (the
+  gateway's payload offload is the house style);
+- ``time.sleep`` has no place anywhere in ``control_plane/`` — async code
+  wants ``asyncio.sleep``, and the few legitimate off-loop threads (the
+  journal flusher) carry a pragma saying so.
+
+Sync ``def``s nested inside an ``async def`` are not descended into: they
+are exactly the helpers handed to ``asyncio.to_thread``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "async-blocking"
+
+_BLOCKING_MODULES = ("requests", "sqlite3", "urllib")
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, f: SourceFile, findings: list[Finding]):
+        self.f = f
+        self.findings = findings
+        self.async_depth = 0
+
+    def _flag(self, node: ast.AST, what: str, hint: str) -> None:
+        self.findings.append(Finding(_ID, self.f.rel, node.lineno, what, hint=hint))
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.async_depth += 1
+        self.generic_visit(node)
+        self.async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self.async_depth:
+            return  # sync helper inside async def: the to_thread candidate
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = attr_chain(node.func)
+        if chain == ["time", "sleep"]:
+            if self.async_depth:
+                self._flag(
+                    node,
+                    "time.sleep in an async def blocks the event loop",
+                    "use `await asyncio.sleep(...)`",
+                )
+            else:
+                self._flag(
+                    node,
+                    "time.sleep in control_plane/ — this package is hosted "
+                    "on the event loop",
+                    "if this provably runs on a dedicated thread, pragma it "
+                    "with the thread's name as the reason",
+                )
+        elif self.async_depth:
+            if len(chain) >= 2 and chain[-2] in ("storage", "sync"):
+                self._flag(
+                    node,
+                    f"synchronous storage call `{'.'.join(chain)}(...)` on "
+                    "the event loop",
+                    "await the AsyncStorage facade (`await self.db."
+                    f"{chain[-1]}(...)`) or wrap in asyncio.to_thread",
+                )
+            elif chain and chain[0] in _BLOCKING_MODULES:
+                self._flag(
+                    node,
+                    f"blocking `{'.'.join(chain)}(...)` in an async def",
+                    "use aiohttp / the async facade, or asyncio.to_thread",
+                )
+            elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                self._flag(
+                    node,
+                    "file I/O via open() in an async def",
+                    "wrap the read/write in asyncio.to_thread (see the "
+                    "gateway's payload offload)",
+                )
+        self.generic_visit(node)
+
+
+class AsyncBlockingPass(Pass):
+    id = _ID
+    description = (
+        "no blocking calls (time.sleep, sync storage/sqlite, requests, "
+        "file I/O) on the control plane's event loop"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        return "control_plane" in rel.split("/")
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        _Walker(f, findings).visit(f.tree)
+        return findings

@@ -1,0 +1,67 @@
+"""except-swallow: no silently-dropped broad exceptions.
+
+A ``except Exception:`` whose body is nothing but ``pass``/``continue``
+erases the only evidence a failure ever happened — the class of bug that
+turned PR 3's "node unreachable" into a silent hang before the dead-letter
+path existed. A broad handler must do at least one observable thing: log,
+re-raise, count a metric/stat, or carry a pragma stating why silence is the
+correct behavior (``# afcheck: ignore[except-swallow] <reason>``).
+
+Only *silent* handlers are flagged (body is pure ``pass``/``continue``/
+``break``/docstring): a handler that substitutes a fallback value is making
+a decision, not swallowing — reviewers stay the judge of those.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile
+
+_ID = "except-swallow"
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+class ExceptSwallowPass(Pass):
+    id = _ID
+    description = (
+        "broad `except Exception:` handlers must log, re-raise, count a "
+        "metric, or carry a pragma with the reason"
+    )
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _is_silent(node):
+                findings.append(
+                    Finding(
+                        _ID, f.rel, node.lineno,
+                        "broad exception handler swallows silently",
+                        hint="log at debug with context, count a metric, or "
+                        "pragma with a one-line reason why silence is correct",
+                    )
+                )
+        return findings

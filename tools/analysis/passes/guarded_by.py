@@ -1,0 +1,320 @@
+"""guarded-by: lock discipline for annotated attributes and methods.
+
+The annotation lives where the invariant lives — at the assignment site:
+
+    self._pending: dict[str, Row] = {}  # guarded by: _mu
+
+From then on, every ``self._pending`` read or write in that class must sit
+lexically inside ``with self._mu:`` (or ``async with``). Two more forms:
+
+- on a ``def`` line, ``# guarded by: <lock>`` means the method body ASSUMES
+  the lock is held, and every ``self.<method>()`` call site in the class is
+  checked to hold it (the gateway's ``_complete_locked`` pattern);
+- ``# guarded by: external(<who serializes>)`` declares an attribute whose
+  mutual exclusion lives OUTSIDE the class (kv_cache's PrefixPagePool is
+  serialized by the engine's ``_session_lock``). No with-discipline can be
+  checked, so the pass enforces encapsulation instead: nothing outside the
+  class may touch the attribute (``pool._refs`` from the engine would be a
+  finding).
+
+Conventions the checker understands:
+
+- ``__init__`` is exempt (construction precedes sharing);
+- methods whose name ends in ``_locked`` are exempt, as the suffix is this
+  repo's documented "caller holds the lock" marker (engine.py);
+- nested functions/lambdas are not descended into (their execution point —
+  and thus the lock state — is unknown);
+- the annotation inventory itself can be pinned: ``require`` entries in
+  allowlist.toml (``path::Class.attr=lock``, ``path::Class.method()=lock``,
+  or ``=external``) fail the suite when an annotation is deleted, so the
+  machine-checked invariants cannot silently erode.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, self_attr
+
+GUARD_RE = re.compile(r"#\s*guarded by:\s*(external\([^)]*\)|[A-Za-z_]\w*)")
+
+_ID = "guarded-by"
+
+
+def collect_annotations(
+    f: SourceFile,
+) -> tuple[dict[str, dict[str, str]], dict[str, dict[str, str]], list[int]]:
+    """Scan one file for guard annotations.
+
+    Returns ``(attr_guards, method_guards, orphan_lines)`` where
+    attr_guards is {class: {attr: lock-or-"external"}}, method_guards is
+    {class: {method: lock}}, and orphan_lines are annotated lines carrying
+    no recognizable assignment/def (a typo'd annotation must not silently
+    check nothing).
+    """
+    guard_lines: dict[int, str] = {}
+    for i, comment in f.comments.items():
+        m = GUARD_RE.search(comment)
+        if m:
+            spec = m.group(1)
+            guard_lines[i] = "external" if spec.startswith("external(") else spec
+
+    attr_guards: dict[str, dict[str, str]] = {}
+    method_guards: dict[str, dict[str, str]] = {}
+    claimed: set[int] = set()
+    if f.tree is None:
+        return attr_guards, method_guards, sorted(guard_lines)
+
+    for cls in ast.walk(f.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.lineno in guard_lines:
+                method_guards.setdefault(cls.name, {})[fn.name] = guard_lines[fn.lineno]
+                claimed.add(fn.lineno)
+            for node in ast.walk(fn):
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                if node.lineno not in guard_lines:
+                    continue
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        attr_guards.setdefault(cls.name, {})[attr] = guard_lines[
+                            node.lineno
+                        ]
+                        claimed.add(node.lineno)
+    orphans = sorted(set(guard_lines) - claimed)
+    return attr_guards, method_guards, orphans
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one method body tracking which ``self.<lock>`` with-blocks
+    enclose each node; flag guarded attribute/method uses outside them."""
+
+    def __init__(
+        self,
+        pass_id: str,
+        f: SourceFile,
+        cls: str,
+        attr_guards: dict[str, str],
+        method_guards: dict[str, str],
+        assume_held: set[str],
+        findings: list[Finding],
+    ):
+        self.pass_id = pass_id
+        self.f = f
+        self.cls = cls
+        self.attr_guards = attr_guards
+        self.method_guards = method_guards
+        self.held = set(assume_held)
+        self.findings = findings
+
+    def _check(self, node: ast.AST, name: str, lock: str, kind: str) -> None:
+        if lock == "external":
+            return  # encapsulation is checked globally, not per-with
+        if lock not in self.held:
+            self.findings.append(
+                Finding(
+                    self.pass_id,
+                    self.f.rel,
+                    node.lineno,
+                    f"{self.cls}.{name} is guarded by self.{lock} but this "
+                    f"{kind} is outside `with self.{lock}:`",
+                    hint=f"wrap in `with self.{lock}:`, rename the method "
+                    "*_locked if callers hold it, or pragma with a reason",
+                )
+            )
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node)
+
+    def _visit_with(self, node: ast.With | ast.AsyncWith) -> None:
+        taken: list[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)  # the lock expr itself is a use
+            lock = self_attr(item.context_expr)
+            if lock is not None and lock not in self.held:
+                self.held.add(lock)
+                taken.append(lock)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.difference_update(taken)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self_attr(node)
+        if attr is not None and attr in self.attr_guards:
+            self._check(node, attr, self.attr_guards[attr], "access")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        meth = self_attr(node.func)
+        if meth is not None and meth in self.method_guards:
+            self._check(node, f"{meth}()", self.method_guards[meth], "call")
+        self.generic_visit(node)
+
+    # Nested defs run at an unknown time with unknown lock state: do not
+    # descend (a deliberate soundness hole, documented above).
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+class GuardedByPass(Pass):
+    id = _ID
+    description = (
+        "attributes/methods annotated `# guarded by: <lock>` are only used "
+        "under `with self.<lock>:` in their class"
+    )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        # file -> (attr_guards, method_guards); kept for the require check
+        collected: dict[str, tuple[dict, dict]] = {}
+        external_attrs: dict[str, set[str]] = {}  # attr -> owning classes
+        for f in ctx.files:
+            if ctx.skipped(self.id, f.rel) or f.tree is None:
+                continue
+            attr_guards, method_guards, orphans = collect_annotations(f)
+            collected[f.rel] = (attr_guards, method_guards)
+            for line in orphans:
+                findings.append(
+                    Finding(
+                        self.id, f.rel, line,
+                        "`# guarded by:` annotation matches no assignment or "
+                        "def on this line",
+                        hint="put it on the `self.X = ...` or `def` line it guards",
+                    )
+                )
+            for cls_name, guards in attr_guards.items():
+                for attr, lock in guards.items():
+                    if lock == "external":
+                        external_attrs.setdefault(attr, set()).add(cls_name)
+            self._check_file(f, attr_guards, method_guards, findings)
+        if external_attrs:
+            self._check_encapsulation(ctx, external_attrs, findings)
+        self._check_required(ctx, collected, findings)
+        return findings
+
+    def _check_file(
+        self,
+        f: SourceFile,
+        attr_guards: dict[str, dict[str, str]],
+        method_guards: dict[str, dict[str, str]],
+        findings: list[Finding],
+    ) -> None:
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            a_guards = attr_guards.get(cls.name, {})
+            m_guards = method_guards.get(cls.name, {})
+            if not a_guards and not m_guards:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__" or fn.name.endswith("_locked"):
+                    # construction precedes sharing; *_locked is the repo's
+                    # "caller holds the lock" convention — but a def-level
+                    # guard still states WHICH lock its body may assume.
+                    assume = set(a_guards.values()) | set(m_guards.values())
+                else:
+                    assume = {m_guards[fn.name]} if fn.name in m_guards else set()
+                walker = _LockWalker(
+                    self.id, f, cls.name, a_guards, m_guards, assume, findings
+                )
+                for stmt in fn.body:
+                    walker.visit(stmt)
+
+    def _check_encapsulation(
+        self,
+        ctx: Context,
+        external_attrs: dict[str, set[str]],
+        findings: list[Finding],
+    ) -> None:
+        """Externally-serialized attributes may only be touched as ``self.X``
+        (i.e. from inside some class body — by construction the declaring
+        one, since the names are private): any ``other.X`` access is code
+        reaching around the serializing owner."""
+        for f in ctx.files:
+            if ctx.skipped(self.id, f.rel) or f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                if node.attr not in external_attrs:
+                    continue
+                if isinstance(node.value, ast.Name) and node.value.id == "self":
+                    continue
+                owners = ", ".join(sorted(external_attrs[node.attr]))
+                findings.append(
+                    Finding(
+                        self.id, f.rel, node.lineno,
+                        f".{node.attr} is declared `guarded by: external(...)` "
+                        f"on {owners} — it must not be touched from outside "
+                        "the class",
+                        hint="go through the owning class's methods (they run "
+                        "under the external serializer)",
+                    )
+                )
+
+    def _check_required(
+        self,
+        ctx: Context,
+        collected: dict[str, tuple[dict, dict]],
+        findings: list[Finding],
+    ) -> None:
+        """allowlist.toml pins the annotation inventory: deleting a
+        `# guarded by:` comment from an entry listed here is itself a
+        finding, so the checked-invariant set can only grow deliberately."""
+        for entry in ctx.cfg(self.id).get("require", []):
+            m = re.fullmatch(r"(.+?)::(\w+)\.(\w+)(\(\))?=(\w+)", entry)
+            if m is not None and m.group(1) not in ctx.by_rel:
+                # --changed / explicit-path runs scan a subset: a pinned
+                # file outside the walk is unchanged, not missing its
+                # annotation (the full tier-1 run still checks every pin).
+                continue
+            if m is None:
+                findings.append(
+                    Finding(
+                        self.id, "tools/analysis/allowlist.toml", 1,
+                        f"unparseable require entry {entry!r}",
+                        hint="format: path::Class.attr=lock, Class.method()=lock,"
+                        " or =external",
+                    )
+                )
+                continue
+            rel, cls, name, is_method, lock = m.groups()
+            attr_guards, method_guards = collected.get(rel, ({}, {}))
+            table = method_guards if is_method else attr_guards
+            got = table.get(cls, {}).get(name)
+            if got != lock:
+                findings.append(
+                    Finding(
+                        self.id, rel, 1,
+                        f"required annotation missing: {cls}.{name}"
+                        f"{is_method or ''} must carry `# guarded by: "
+                        f"{lock}{'(...)' if lock == 'external' else ''}` "
+                        f"(found: {got or 'none'})",
+                        hint="restore the annotation at the assignment/def "
+                        "site, or consciously drop the allowlist require entry",
+                    )
+                )

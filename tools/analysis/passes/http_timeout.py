@@ -1,0 +1,63 @@
+"""http-timeout: every HTTP client construction carries an explicit timeout.
+
+Migration of the standalone ``tools/check_http_timeouts.py`` regex lint
+into an AST pass. An ``aiohttp.ClientSession`` (or httpx client) built
+without ``timeout=`` has NO total timeout — any await on it can hang
+forever on a half-dead peer, which is exactly the failure mode the gateway
+retry/deadline layer exists to bound (docs/FAULT_TOLERANCE.md). A
+deliberately unbounded stream still passes
+``timeout=ClientTimeout(total=None, connect=...)``: "no bound" must be an
+explicit decision at the call site, never a default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "http-timeout"
+
+_CTOR_NAMES = {"ClientSession"}
+_CTOR_CHAINS = (
+    ["aiohttp", "ClientSession"],
+    ["httpx", "Client"],
+    ["httpx", "AsyncClient"],
+)
+
+
+def _is_client_ctor(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name) and func.id in _CTOR_NAMES:
+        return True
+    chain = attr_chain(func)
+    return chain in [list(c) for c in _CTOR_CHAINS] or (
+        len(chain) >= 1 and chain[-1] in _CTOR_NAMES
+    )
+
+
+class HttpTimeoutPass(Pass):
+    id = _ID
+    description = (
+        "aiohttp/httpx client constructions pass an explicit timeout= "
+        "(unbounded must be spelled ClientTimeout(total=None, ...))"
+    )
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call) and _is_client_ctor(node.func)):
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs may carry it; reviewers own that site
+            findings.append(
+                Finding(
+                    self.id, f.rel, node.lineno,
+                    "HTTP client built without an explicit timeout=",
+                    hint="pass timeout=..., or timeout=ClientTimeout("
+                    "total=None, connect=...) for a deliberately unbounded "
+                    "stream",
+                )
+            )
+        return findings

@@ -1,0 +1,95 @@
+"""knob-docs: every operator knob is documented under docs/.
+
+Migration of the standalone ``tools/check_engine_knobs.py`` into the
+framework (same two checks, now with file:line findings and pragma/
+allowlist support, and no import of the jax-heavy engine — the EngineConfig
+field list is read from the AST):
+
+- every ``EngineConfig`` dataclass field must appear in docs/*.md (the
+  reference table in docs/ARCHITECTURE.md);
+- every ``AGENTFIELD_*`` environment variable mentioned by
+  ``control_plane/*.py`` sources must appear in docs/*.md — operators learn
+  knobs from OPERATIONS.md, not from grepping the tree.
+
+Allowlist: ``knob_allow`` entries for env vars the control plane reads but
+operators never set (test scaffolding); empty on purpose today.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Context, Finding, Pass
+
+_ID = "knob-docs"
+
+_ENGINE_REL = "agentfield_tpu/serving/engine.py"
+_ENV_KNOB_RE = re.compile(r"AGENTFIELD_[A-Z0-9_]+")
+
+
+def _docs_text(ctx: Context) -> str:
+    docs = sorted((ctx.root / "docs").glob("*.md"))
+    return "\n".join(p.read_text(encoding="utf-8") for p in docs)
+
+
+class KnobDocsPass(Pass):
+    id = _ID
+    description = (
+        "EngineConfig fields and control-plane AGENTFIELD_* env knobs are "
+        "documented in docs/*.md"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        return rel == _ENGINE_REL or "control_plane" in rel.split("/")
+
+    def run(self, ctx: Context) -> list[Finding]:
+        if not any(
+            self.relevant(f.rel) and not ctx.skipped(self.id, f.rel)
+            for f in ctx.files
+        ):
+            return []
+        docs = _docs_text(ctx)
+        findings: list[Finding] = []
+        engine = ctx.by_rel.get(_ENGINE_REL)
+        if engine is not None and engine.tree is not None:
+            for cls in ast.walk(engine.tree):
+                if not (isinstance(cls, ast.ClassDef) and cls.name == "EngineConfig"):
+                    continue
+                for stmt in cls.body:
+                    if not (
+                        isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                    ):
+                        continue
+                    if stmt.target.id not in docs:
+                        findings.append(
+                            Finding(
+                                self.id, engine.rel, stmt.lineno,
+                                f"EngineConfig field {stmt.target.id!r} is not "
+                                "documented in docs/*.md",
+                                hint="add it to the EngineConfig reference "
+                                "table in docs/ARCHITECTURE.md",
+                            )
+                        )
+        allow = set(ctx.cfg(self.id).get("knob_allow", []))
+        seen: set[str] = set()
+        for f in ctx.files:
+            if "control_plane" not in f.rel.split("/") or ctx.skipped(self.id, f.rel):
+                continue
+            for i, line in enumerate(f.lines, 1):
+                for knob in _ENV_KNOB_RE.findall(line):
+                    if knob in seen or knob in allow or knob in docs:
+                        seen.add(knob)
+                        continue
+                    seen.add(knob)
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, i,
+                            f"control-plane env knob {knob} is not documented "
+                            "in docs/*.md",
+                            hint="document it in docs/OPERATIONS.md (or list "
+                            "it under knob_allow if operators never set it)",
+                        )
+                    )
+        return findings

@@ -1,0 +1,303 @@
+"""tracer-safety: no host-side escapes inside jitted functions.
+
+Functions handed to ``jax.jit`` (the engine's decode/prefill/mixed step
+fns, the model forwards) run ONCE as a trace; anything that forces a
+concrete value — ``.item()``, ``float()``/``int()`` on a traced array, host
+``np.*`` math on traced args, a Python ``if`` on a traced value — either
+fails under tracing or, worse, silently bakes one tick's value into the
+compiled program forever. The accelerator guide's first rule, as a pass.
+
+Detection is deliberately name-based and local:
+
+- a function is *jitted* when it is (a) the first argument of a
+  ``jax.jit(...)``/``jit(...)`` call naming it, or (b) decorated with
+  ``jax.jit`` / ``functools.partial(jax.jit, ...)``;
+- its *traced* names are its parameters minus ``static_argnames``/
+  ``static_argnums`` entries parsed from the jit call when literal; nested
+  defs handed to jax/lax combinators (scan carries, cond branches) add
+  their own parameters, while trace-time helper defs shadow instead;
+- shape-shaped accesses (``x.shape``/``ndim``/``dtype``/``size``,
+  ``len(x)``) and ``x is (not) None`` tests are static and never flagged.
+
+Closure captures (cfg objects, meshes) are not parameters, so they are
+never traced names — which is what keeps this pass quiet on the idiomatic
+"config drives Python control flow, arrays stay in lax" style.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "tracer-safety"
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _jit_target_and_statics(call: ast.Call) -> tuple[str | None, set[str], set[int]]:
+    """For a ``jax.jit(f, ...)``-shaped call, return (target function name,
+    static argnames, static argnums); (None, ...) when it is not one."""
+    chain = attr_chain(call.func)
+    if chain not in (["jax", "jit"], ["jit"]):
+        return None, set(), set()
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _str_elements(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _int_elements(kw.value)
+    target = None
+    if call.args and isinstance(call.args[0], ast.Name):
+        target = call.args[0].id
+    return target, names, nums
+
+
+def _str_elements(node: ast.expr) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _int_elements(node: ast.expr) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+    return set()
+
+
+def _params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def find_jitted(tree: ast.AST) -> dict[str, set[str]]:
+    """Map function name -> static argnames for every jit target in the
+    module (call-form and decorator-form)."""
+    out: dict[str, set[str]] = {}
+    defs: dict[str, list[ast.FunctionDef | ast.AsyncFunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                statics: set[str] | None = None
+                if attr_chain(dec) in (["jax", "jit"], ["jit"]):
+                    statics = set()
+                elif isinstance(dec, ast.Call):
+                    chain = attr_chain(dec.func)
+                    if chain in (["jax", "jit"], ["jit"]):
+                        _, names, nums = _jit_target_and_statics(dec)
+                        statics = names | {
+                            p for i, p in enumerate(_params(node)) if i in nums
+                        }
+                    elif chain[-1:] == ["partial"] and dec.args:
+                        inner = attr_chain(dec.args[0])
+                        if inner in (["jax", "jit"], ["jit"]):
+                            names: set[str] = set()
+                            nums: set[int] = set()
+                            for kw in dec.keywords:
+                                if kw.arg == "static_argnames":
+                                    names |= _str_elements(kw.value)
+                                elif kw.arg == "static_argnums":
+                                    nums |= _int_elements(kw.value)
+                            statics = names | {
+                                p for i, p in enumerate(_params(node)) if i in nums
+                            }
+                if statics is not None:
+                    out[node.name] = out.get(node.name, set()) | statics
+        elif isinstance(node, ast.Call):
+            target, names, nums = _jit_target_and_statics(node)
+            if target is not None:
+                out[target] = out.get(target, set()) | names
+                if nums:
+                    for d in defs.get(target, []):
+                        out[target] |= {
+                            p for i, p in enumerate(_params(d)) if i in nums
+                        }
+    return out
+
+
+def _traced_names_in(expr: ast.expr, traced: set[str]) -> list[str]:
+    """Traced parameter names used *as values* in `expr`: mentions reached
+    only through static contexts (``.shape``, ``len()``, ``is None``) do
+    not count."""
+    hits: list[str] = []
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return
+            chain = attr_chain(node.func)
+            if chain[-1:] == ["astype"]:  # dtype cast is a traced op, fine
+                pass
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            if all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return
+        if isinstance(node, ast.Name) and node.id in traced:
+            hits.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return hits
+
+
+_COMBINATORS = {
+    "scan", "cond", "while_loop", "fori_loop", "switch", "map", "vmap",
+    "pmap", "checkpoint", "remat", "custom_vjp", "custom_jvp", "associative_scan",
+}
+
+
+def _callback_names(fn: ast.AST) -> set[str]:
+    """Names of functions handed to jax/lax combinators inside `fn` — their
+    parameters are traced (scan carries, cond branches). A nested def that
+    is merely *called* at trace time (a block-size picker) is not one."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain:
+            continue
+        if chain[0] in ("jax", "lax") or chain[-1] in _COMBINATORS:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(
+        self,
+        f: SourceFile,
+        traced: set[str],
+        callbacks: set[str],
+        findings: list[Finding],
+    ):
+        self.f = f
+        self.traced = traced
+        self.callbacks = callbacks
+        self.findings = findings
+
+    def _flag(self, node: ast.AST, what: str, hint: str) -> None:
+        self.findings.append(Finding(_ID, self.f.rel, node.lineno, what, hint=hint))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        params = set(_params(node))
+        if node.name in self.callbacks:
+            inner_traced = self.traced | params  # scan/cond body: args traced
+        else:
+            inner_traced = self.traced - params  # trace-time helper: shadowed
+        inner = _Walker(self.f, inner_traced, self.callbacks, self.findings)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = attr_chain(node.func)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            self._flag(
+                node,
+                ".item() inside a jitted function",
+                "it fails under tracing (and device-syncs elsewhere); keep "
+                "the value on device or move the readout outside jit",
+            )
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and node.args
+        ):
+            used = _traced_names_in(node.args[0], self.traced)
+            if used:
+                self._flag(
+                    node,
+                    f"{node.func.id}() concretizes traced value "
+                    f"{', '.join(sorted(set(used)))}",
+                    "use jnp casts (astype) or restructure so the value "
+                    "stays traced",
+                )
+        elif chain[:1] in (["np"], ["numpy"]):
+            used = [u for a in node.args for u in _traced_names_in(a, self.traced)]
+            used += [
+                u for kw in node.keywords for u in _traced_names_in(kw.value, self.traced)
+            ]
+            if used:
+                self._flag(
+                    node,
+                    f"host numpy call `{'.'.join(chain)}` on traced value "
+                    f"{', '.join(sorted(set(used)))}",
+                    "use the jnp equivalent — np.* inside jit silently "
+                    "concretizes the trace",
+                )
+        self.generic_visit(node)
+
+    def _check_branch(self, node: ast.If | ast.IfExp | ast.While) -> None:
+        used = _traced_names_in(node.test, self.traced)
+        if used:
+            kind = {ast.If: "if", ast.IfExp: "conditional expression",
+                    ast.While: "while"}[type(node)]
+            self._flag(
+                node,
+                f"Python {kind} branches on traced value "
+                f"{', '.join(sorted(set(used)))}",
+                "use jnp.where / lax.cond / lax.select — a Python branch "
+                "bakes one trace-time path into the compiled fn",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node)
+        self.generic_visit(node)
+
+
+class TracerSafetyPass(Pass):
+    id = _ID
+    description = (
+        "no .item()/float()/np.*/Python-if on traced values inside "
+        "functions passed to jax.jit"
+    )
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        jitted = find_jitted(f.tree)
+        if not jitted:
+            return findings
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in jitted:
+                continue
+            statics = jitted[node.name]
+            traced = {p for p in _params(node) if p not in statics and p != "self"}
+            walker = _Walker(f, traced, _callback_names(node), findings)
+            for stmt in node.body:
+                walker.visit(stmt)
+        return findings

@@ -25,6 +25,12 @@ Injection points in-tree:
                                itself — it only answers "now?")
 ``engine.page_pressure``       a page allocation is denied as if the pool were
                                exhausted (KV pressure without a real workload)
+``engine.preempt_storm``       the engine scheduler force-preempts an active
+                               slot (parking its KV in the prefix index and
+                               re-queueing the request) regardless of priority
+                               or starvation — deterministic preempt/resume
+                               churn for overload chaos tests; consulted once
+                               per tick where a preemption is possible
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -52,6 +58,7 @@ KNOWN_POINTS = (
     "gateway.agent_call.delay",
     "node.kill",
     "engine.page_pressure",
+    "engine.preempt_storm",
 )
 
 

@@ -18,7 +18,9 @@ Agent wire contract (network boundary):
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
+import math
 import random
 import time
 from typing import Any
@@ -59,10 +61,15 @@ CONTEXT_HEADERS = (
 
 
 class GatewayError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, retry_after: float | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # Overload hint (429 transient backpressure): seconds the caller
+        # should wait before retrying, derived from queue depth and the
+        # recent worker drain rate — the server renders it as a Retry-After
+        # header and the SDK backoff honors it (docs/FAULT_TOLERANCE.md).
+        self.retry_after = retry_after
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +184,13 @@ class ExecutionGateway:
         # are weakly held): a cancelled sync handler must still get its
         # execution to a terminal state.
         self._bg_completions: set[asyncio.Task] = set()
+        # Overload signal (docs/FAULT_TOLERANCE.md overload control):
+        # monotonic timestamps of recent async-worker queue drains. A full
+        # queue WITH recent drains is transient overload (429 + Retry-After
+        # estimated from depth/rate); a full queue with NO drain in the
+        # window means nothing is moving — no-capacity 503, same as today.
+        self._drained: collections.deque[float] = collections.deque(maxlen=1024)
+        self._drain_window_s = 30.0
 
     @property
     def queue_depth(self) -> int:
@@ -219,11 +233,25 @@ class ExecutionGateway:
         webhook_url: str | None,
         status: ExecutionStatus,
         retry_policy: dict[str, Any] | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
     ) -> tuple[Execution, AgentNode]:
         """Parse target, resolve node+component, persist the execution record
         (reference: prepareExecution, execute.go:641)."""
         if retry_policy is not None:
             retry_policy = RetryPolicy.validate(retry_policy)
+        if isinstance(priority, bool) or not isinstance(priority, int):
+            raise GatewayError(400, f"priority must be an integer, got {priority!r}")
+        if deadline_s is not None and (
+            isinstance(deadline_s, bool)
+            or not isinstance(deadline_s, (int, float))
+            or not math.isfinite(deadline_s)  # NaN is comparison-inert: it
+            # would pass every downstream deadline check (silently meaning
+            # "no deadline") and serialize as invalid JSON; Infinity at
+            # least degrades, but both are lies — reject them.
+            or deadline_s <= 0
+        ):
+            raise GatewayError(400, "deadline_s must be a positive finite number")
         if "." not in target:
             raise GatewayError(400, f"target {target!r} must be '<node>.<component>'")
         node_id, comp_name = target.split(".", 1)
@@ -271,6 +299,8 @@ class ExecutionGateway:
             webhook_url=webhook_url,
             started_at=now(),
             retry_policy=retry_policy,
+            priority=priority,
+            deadline_s=float(deadline_s) if deadline_s is not None else None,
         )
         try:
             # Freshly-minted ids skip the journal's duplicate table probe
@@ -321,6 +351,27 @@ class ExecutionGateway:
         if self.payloads is not None:
             # agents get real bytes; file IO runs off the event loop
             agent_input = await asyncio.to_thread(self.payloads.resolve, agent_input)
+        if (
+            node.kind == "model"
+            and ex.target.split(".", 1)[1] == "generate"
+            and isinstance(agent_input, dict)
+            and (ex.priority or ex.deadline_s is not None)
+        ):
+            # Overload control rides THROUGH dispatch to the engine: the
+            # execute body's priority/deadline_s become generate() kwargs on
+            # the model node. The deadline forwarded is the REMAINING budget
+            # — queue/retry time already spent counts against it, so a
+            # request that waited out most of its budget at the gateway
+            # cannot monopolize a slot for the full original window. Clamped
+            # above zero: an expired-in-flight deadline becomes an instant
+            # engine-side deadline_exceeded rather than a 400. Explicit
+            # caller-set keys in the input win (setdefault).
+            agent_input = dict(agent_input)
+            if ex.priority:
+                agent_input.setdefault("priority", ex.priority)
+            if ex.deadline_s is not None:
+                remaining = ex.created_at + ex.deadline_s - now()
+                agent_input.setdefault("deadline_s", max(remaining, 0.001))
         f = faults.fire("gateway.agent_call.delay")
         if f is not None and f.delay_s > 0:
             await asyncio.sleep(f.delay_s)
@@ -427,6 +478,11 @@ class ExecutionGateway:
         try:
             last_err = "no capable active node"
             while ex.attempts < policy.max_attempts:
+                if self._deadline_passed(ex):
+                    # Retry backoff ate the rest of the budget: shedding here
+                    # beats handing a node work whose caller-facing deadline
+                    # is already unmeetable (docs/FAULT_TOLERANCE.md).
+                    return await self._shed_expired(ex)
                 if node is None:
                     node = await self._pick_node(ex, tried)
                 if node is None:
@@ -512,6 +568,26 @@ class ExecutionGateway:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _deadline_passed(ex: Execution) -> bool:
+        return ex.deadline_s is not None and now() > ex.created_at + ex.deadline_s
+
+    async def _shed_expired(self, ex: Execution) -> Execution | None:
+        """Deadline-aware shedding (docs/FAULT_TOLERANCE.md overload
+        control): the execution's wall-clock budget expired before any node
+        could take it — terminal TIMEOUT, never dispatched. The counter is
+        the gateway-side overload signal (its engine-side twin is
+        ``shed_pending_deadline_total``)."""
+        self.metrics.inc("gateway_shed_total")
+        return await self.complete(
+            ex.execution_id,
+            error=f"deadline_s={ex.deadline_s} expired before dispatch; "
+            "shed (overload control)",
+            timeout=True,
+            attempts=ex.attempts,
+            nodes_tried=ex.nodes_tried,
+        )
+
     async def execute_sync(
         self,
         target: str,
@@ -520,13 +596,15 @@ class ExecutionGateway:
         webhook_url: str | None = None,
         timeout: float | None = None,
         retry_policy: dict[str, Any] | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
     ) -> Execution:
         """Sync path: call agent (with retry/failover), then wait on the
         event bus until the execution reaches a terminal state
         (execute.go:195-278)."""
         ex, node = await self._prepare(
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
-            retry_policy=retry_policy,
+            retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
         )
         done = await self._dispatch(ex, node)
         if done is not None and done.status.terminal:
@@ -552,12 +630,18 @@ class ExecutionGateway:
         headers: dict[str, str],
         webhook_url: str | None = None,
         retry_policy: dict[str, Any] | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
     ) -> Execution:
-        """Async path: enqueue and 202 immediately; queue-full → 503
-        backpressure (execute.go:327-367)."""
+        """Async path: enqueue and 202 immediately. Queue-full backpressure
+        is SPLIT by what the drain telemetry says (execute.go:327-367 only
+        knew the blind 503): workers visibly draining → transient overload,
+        429 with a Retry-After derived from depth/rate — the caller should
+        come back; no drain inside the window → nothing is moving, the old
+        no-capacity 503."""
         ex, _node = await self._prepare(
             target, payload, headers, webhook_url, ExecutionStatus.QUEUED,
-            retry_policy=retry_policy,
+            retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
         )
         try:
             self._queue.put_nowait(ex)
@@ -567,9 +651,41 @@ class ExecutionGateway:
             ex.finished_at = now()
             await self.db.update_execution(ex)
             self.metrics.inc("gateway_backpressure_total")
+            ra = self.overload_retry_after()
+            if ra is not None:
+                raise GatewayError(
+                    429,
+                    "async execution queue is full (transient overload: "
+                    f"retry in ~{ra:.0f}s)",
+                    retry_after=ra,
+                ) from None
             raise GatewayError(503, "async execution queue is full") from None
         self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
         return ex
+
+    def overload_retry_after(self) -> float | None:
+        """Estimated seconds until the async queue frees a slot: queue depth
+        over the drain rate observed in the last ``_drain_window_s`` seconds.
+        None when no drain landed in the window — the queue is full AND
+        stalled, which is no-capacity (503 territory), not transient
+        overload. Clamped to [1, 120] so one slow execution cannot tell
+        callers to go away for an hour."""
+        t = time.monotonic()
+        cutoff = t - self._drain_window_s
+        recent = [d for d in self._drained if d >= cutoff]
+        if not recent:
+            return None
+        if len(recent) >= 2:
+            # Inter-drain rate over the observed span. Dividing by the time
+            # since the OLDEST drain instead would spike the rate right
+            # after a drain lands (1 sample / tiny elapsed), telling callers
+            # to retry in ~1s against a queue that actually frees a slot
+            # once a minute.
+            rate = (len(recent) - 1) / max(recent[-1] - recent[0], 0.05)
+        else:
+            # One drain in the whole window: that IS the observed rate.
+            rate = 1.0 / self._drain_window_s
+        return min(max((self._queue.qsize() + 1) / max(rate, 1e-6), 1.0), 120.0)
 
     async def _worker_loop(self, idx: int) -> None:
         while True:
@@ -577,12 +693,22 @@ class ExecutionGateway:
             try:
                 self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())
                 self.metrics.inc("worker_dispatch_total")
+                # Either outcome below (shed, skip, or dispatch) freed a
+                # queue slot: that drain timestamp is what turns the next
+                # queue-full answer into 429+Retry-After instead of 503.
+                self._drained.append(time.monotonic())
                 # Re-read: the row may have gone terminal while queued (client
                 # status callback, cleanup) — never resurrect it.
                 fresh = await self.db.get_execution(ex.execution_id)
                 if fresh is None or fresh.status.terminal:
                     continue
                 ex = fresh
+                if self._deadline_passed(ex):
+                    # Deadline-aware shedding: the budget expired while the
+                    # work sat queued — dispatching it now would burn a
+                    # worker and a node slot on an answer nobody can use.
+                    await self._shed_expired(ex)
+                    continue
                 ex.status = ExecutionStatus.RUNNING
                 await self.db.update_execution(ex)
                 self._publish(ex)
@@ -853,6 +979,17 @@ class ExecutionGateway:
         # the new incarnation's requeue matching or error reports
         ex.result = None  # ditto a late-recorded result from the dead
         # incarnation — and the late-result guard must be open for the new one
+        if ex.deadline_s is not None:
+            # Fresh deadline window too: deadline_s counts from created_at,
+            # and the original window has usually lapsed by the time an
+            # operator triages the dead letter — without a re-base, the
+            # worker's pre-dispatch deadline check would shed the requeue
+            # as timeout on arrival. Re-basing created_at (rather than
+            # adding the lapsed time onto deadline_s) keeps the grant
+            # idempotent across REPEATED requeues: every incarnation gets
+            # exactly the original window from its requeue instant, never
+            # a compounded one.
+            ex.created_at = now()
         # Persist BEFORE enqueueing: the worker re-reads the row and drops
         # anything still terminal, so enqueue-first could silently lose the
         # requeue to that race.

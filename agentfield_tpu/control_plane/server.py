@@ -259,8 +259,15 @@ class ControlPlane:
                 self.metrics.inc("cleanup_errors_total")
 
 
-def _json_error(status: int, message: str) -> web.Response:
-    return web.json_response({"error": message}, status=status)
+def _json_error(
+    status: int, message: str, retry_after: float | None = None
+) -> web.Response:
+    headers = None
+    if retry_after is not None:
+        # HTTP delta-seconds (integral, at least 1): overloaded-queue 429s
+        # tell callers when to come back (docs/FAULT_TOLERANCE.md).
+        headers = {"Retry-After": str(max(int(retry_after + 0.5), 1))}
+    return web.json_response({"error": message}, status=status, headers=headers)
 
 
 class _BadBody(Exception):
@@ -475,11 +482,13 @@ def create_app(cp: ControlPlane) -> web.Application:
                 webhook_url=body.get("webhook_url"),
                 timeout=timeout,
                 retry_policy=body.get("retry_policy"),
+                priority=0 if body.get("priority") is None else body["priority"],
+                deadline_s=body.get("deadline_s"),
             )
         except _BadBody as e:
             return _json_error(400, str(e))
         except GatewayError as e:
-            return _json_error(e.status, e.message)
+            return _json_error(e.status, e.message, retry_after=e.retry_after)
         doc = ex.to_dict()
         if cp.payloads is not None:
             doc["input"] = await asyncio.to_thread(cp.payloads.resolve, doc["input"])
@@ -499,9 +508,11 @@ def create_app(cp: ControlPlane) -> web.Application:
                 _headers(req),
                 webhook_url=body.get("webhook_url"),
                 retry_policy=body.get("retry_policy"),
+                priority=0 if body.get("priority") is None else body["priority"],
+                deadline_s=body.get("deadline_s"),
             )
         except GatewayError as e:
-            return _json_error(e.status, e.message)
+            return _json_error(e.status, e.message, retry_after=e.retry_after)
         return web.json_response(
             {"execution_id": ex.execution_id, "run_id": ex.run_id, "status": ex.status.value},
             status=202,

@@ -410,8 +410,9 @@ class ExecutionJournal:
                                     # duplicate keys forever.
                             conn.execute(
                                 "UPDATE executions SET status=?, finished_at=?, "
-                                "doc=? WHERE execution_id=?",
-                                (doc["status"], doc.get("finished_at"), blob, eid),
+                                "created_at=?, doc=? WHERE execution_id=?",
+                                (doc["status"], doc.get("finished_at"),
+                                 doc.get("created_at"), blob, eid),
                             )
                         conn.commit()
                     except Exception:
@@ -645,9 +646,15 @@ class SQLiteStorage:
                 self._journal.update(ex)
             return
         with self._lock:
+            # created_at rides along so the COLUMN never diverges from the
+            # doc: it is immutable everywhere except the dead-letter requeue
+            # re-base (gateway.requeue_dead_letter), and listing order,
+            # duration stats, and retention GC all read the column.
             self._conn.execute(
-                "UPDATE executions SET status=?, finished_at=?, doc=? WHERE execution_id=?",
-                (ex.status.value, ex.finished_at, json.dumps(ex.to_dict()), ex.execution_id),
+                "UPDATE executions SET status=?, finished_at=?, created_at=?, doc=? "
+                "WHERE execution_id=?",
+                (ex.status.value, ex.finished_at, ex.created_at,
+                 json.dumps(ex.to_dict()), ex.execution_id),
             )
             self._conn.commit()
 

@@ -153,6 +153,14 @@ class Execution:
     nodes_tried: list[str] = dataclasses.field(default_factory=list)
     retry_policy: dict[str, Any] | None = None  # per-execution override of
     # the gateway RetryPolicy (keys: max_attempts, base_backoff, max_backoff)
+    # Overload control (docs/FAULT_TOLERANCE.md): higher priority dispatches
+    # first on the model node's admission window; deadline_s is a wall-clock
+    # budget in seconds from created_at — queued async work whose deadline
+    # already passed is SHED before dispatch instead of occupying a worker,
+    # and the remaining budget rides to the model node so the engine can
+    # deadline-out the request mid-queue or mid-decode.
+    priority: int = 0
+    deadline_s: float | None = None
 
     def to_dict(self) -> dict[str, Any]:
         # Hand-rolled: dataclasses.asdict() deep-copies every nested value
@@ -182,6 +190,8 @@ class Execution:
             "attempts": self.attempts,
             "nodes_tried": list(self.nodes_tried),
             "retry_policy": dict(self.retry_policy) if self.retry_policy else self.retry_policy,
+            "priority": self.priority,
+            "deadline_s": self.deadline_s,
         }
 
     @staticmethod

@@ -24,6 +24,8 @@ import json
 import os
 from typing import Any, Callable
 
+import random
+
 import pydantic
 from aiohttp import web
 
@@ -37,6 +39,32 @@ from agentfield_tpu.sdk.context import (
 )
 
 log = get_logger("sdk.agent")
+
+# Backpressure backoff bounds (docs/FAULT_TOLERANCE.md overload control):
+# a server Retry-After hint wins over the local exponential schedule, but a
+# confused server must not park clients for an hour. The cap is DELIBERATELY
+# tighter than the gateway's own 120s hint ceiling: past 30s of advertised
+# wait, ai()'s failover loop is better served trying another candidate (or
+# surfacing the overload) than parking on one node's estimate.
+_RETRY_AFTER_CAP_S = 30.0
+_BACKOFF_CAP_S = 5.0
+
+
+def _backpressure_delay(attempts: int, retry_after: float | None = None) -> float:
+    """Seconds to wait before retrying a 429/503 (or QueueFullError-failed)
+    call. The server's Retry-After hint is authoritative when present —
+    jittered UPWARD only (retrying before the server's own estimate just
+    buys another 429, and multiplicative spread breaks up the herd that got
+    the same hint), then capped: the cap is the true maximum sleep, jitter
+    included. Without a hint: capped exponential with half-jitter, so
+    patience still grows with consecutive rejections."""
+    if retry_after is not None and retry_after > 0:
+        # "Retry-After: 0" (RFC-legal from proxies) is NOT an invitation to
+        # hot-loop an overloaded server — a non-positive hint falls through
+        # to the exponential schedule below, which always sleeps.
+        return min(retry_after * random.uniform(1.0, 1.25), _RETRY_AFTER_CAP_S)
+    base = min(0.2 * (2**attempts), _BACKOFF_CAP_S)
+    return random.uniform(base / 2, base)
 
 DEFAULT_CONTROL_PLANE = os.environ.get("AGENTFIELD_URL", "http://127.0.0.1:8800")
 
@@ -484,6 +512,13 @@ class Agent:
         # template (reference CompleteWithMessages, sdk/go/ai/client.go:61).
         # Exclusive with prompt/tokens; media markers inside message content
         # still fuse.
+        priority: int = 0,  # overload control (docs/FAULT_TOLERANCE.md):
+        # rides the execute body through the gateway to the model node's
+        # admission window — higher admits first under load, and a starved
+        # higher-priority request may preempt a lower-priority slot.
+        deadline_s: float | None = None,  # wall-clock budget from submit;
+        # the gateway sheds the call (TIMEOUT) if it expires pre-dispatch
+        # and forwards the REMAINING budget to the engine.
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -657,6 +692,8 @@ class Agent:
                         payload,
                         headers=self._outbound_ctx().to_headers(),
                         timeout=timeout,
+                        priority=priority,
+                        deadline_s=deadline_s,
                     )
                 except ControlPlaneError as e:
                     has_next = ci + 1 < len(candidates)
@@ -673,15 +710,18 @@ class Agent:
                             doc = {"status": "node_down", "error": str(e)}
                             break
                         raise
-                    if e.status != 503 or attempts >= 5:
-                        if e.status == 503 and has_next:
+                    # 429 = transient overload with a Retry-After estimate;
+                    # 503 = no capacity. Both are backpressure, not a dead
+                    # node: retry here with patience.
+                    if e.status not in (429, 503) or attempts >= 5:
+                        if e.status in (429, 503) and has_next:
                             # persistent backpressure on this node: another
                             # candidate may have capacity
                             doc = {"status": "node_down", "error": str(e)}
                             break
                         raise
                     attempts += 1
-                    await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                    await asyncio.sleep(_backpressure_delay(attempts, e.retry_after))
                     continue
                 err = str(doc.get("error") or "")
                 if (
@@ -692,7 +732,7 @@ class Agent:
                     and attempts < 5
                 ):
                     attempts += 1
-                    await asyncio.sleep(min(0.2 * (2**attempts), 5.0))
+                    await asyncio.sleep(_backpressure_delay(attempts))
                     continue
                 break
             if self._doc_node_down(doc) and ci + 1 < len(candidates):

@@ -18,10 +18,14 @@ import aiohttp
 
 
 class ControlPlaneError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, retry_after: float | None = None):
         super().__init__(f"[{status}] {message}")
         self.status = status
         self.message = message
+        # Server overload hint (429/503 Retry-After header, delta-seconds):
+        # the SDK backpressure loop waits at least this long (capped +
+        # jittered) instead of its own blind exponential schedule.
+        self.retry_after = retry_after
 
 
 # Terminal execution statuses, mirroring ExecutionStatus.terminal on the
@@ -60,7 +64,14 @@ class ControlPlaneClient:
                     msg = (await resp.json()).get("error", "")
                 except Exception:
                     msg = (await resp.text())[:300]
-                raise ControlPlaneError(resp.status, msg)
+                retry_after = None
+                try:
+                    ra = resp.headers.get("Retry-After")
+                    if ra is not None:
+                        retry_after = float(ra)  # delta-seconds form only
+                except (TypeError, ValueError):
+                    retry_after = None  # HTTP-date form: ignore, use backoff
+                raise ControlPlaneError(resp.status, msg, retry_after=retry_after)
             if resp.content_type == "application/json":
                 return await resp.json()
             return await resp.text()
@@ -95,6 +106,8 @@ class ControlPlaneClient:
         headers: dict[str, str] | None = None,
         timeout: float | None = None,
         webhook_url: str | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         kw: dict[str, Any] = {}
@@ -104,6 +117,10 @@ class ControlPlaneClient:
             kw["timeout"] = aiohttp.ClientTimeout(total=timeout + 30)
         if webhook_url:
             body["webhook_url"] = webhook_url
+        if priority:
+            body["priority"] = priority
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
         return await self._req(
             "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}, **kw
         )
@@ -114,10 +131,16 @@ class ControlPlaneClient:
         payload: Any = None,
         headers: dict[str, str] | None = None,
         webhook_url: str | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         if webhook_url:
             body["webhook_url"] = webhook_url
+        if priority:
+            body["priority"] = priority
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
         return await self._req(
             "POST", f"/api/v1/execute/async/{target}", json=body, headers=headers or {}
         )

@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import math
 import threading
 import time
 from typing import Any
@@ -166,6 +167,18 @@ class EngineConfig:
     # (jax_compilation_cache_dir): warm restarts skip the multi-second
     # compile gate. None falls back to $AGENTFIELD_COMPILE_CACHE; empty/unset
     # leaves the cache off. Logged (entries found = warm) at engine startup.
+    preempt_fence_ticks: int = 64  # overload control (docs/FAULT_TOLERANCE.md):
+    # when a pending request of HIGHER priority than some active slot has
+    # been page/slot-starved for this many consecutive ticks, the scheduler
+    # preempts the lowest-priority active slot — its KV pages are parked in
+    # the shared-prefix index (refcount-0 cached, nothing recomputed unless
+    # evicted) and the request re-queues with its generated-so-far suffix
+    # appended to the prompt, so resume re-admits through the normal
+    # shared-prefix path and continues token-exactly under greedy. 0
+    # disables priority preemption (the engine.preempt_storm fault point
+    # still forces preemptions for chaos testing). Requires
+    # shared_prefix_cache for cheap resume; with the cache off a preempted
+    # request re-prefills its full context on resume (still correct).
     spec_k: int = 0  # speculative decoding: draft proposals per step (0
     # disables). Requires a draft model (InferenceEngine(draft=...)). Each
     # eligible step a small draft model proposes spec_k greedy tokens and the
@@ -219,9 +232,31 @@ class Request:
     # Wall-clock budget in SECONDS from submit. When it expires the request
     # is cancelled through the request_cancel path and a final TokenEvent
     # with finish_reason="deadline_exceeded" is emitted (tokens generated so
-    # far were already streamed). None = no deadline; enforcement costs one
-    # empty-dict check per step when unused (docs/FAULT_TOLERANCE.md).
+    # far were already streamed). Enforced for PENDING work too: a request
+    # that expires before it ever admits is shed from the queue with the
+    # same terminal event (stats["shed_pending_deadline_total"]). None = no
+    # deadline; enforcement costs one empty-dict check per step when unused
+    # (docs/FAULT_TOLERANCE.md).
     deadline_s: float | None = None
+    # Admission priority (overload control, docs/FAULT_TOLERANCE.md):
+    # HIGHER values admit first — the pending queue is kept priority-tier-
+    # ordered at submit (FIFO within a tier, so all-default traffic is
+    # bit-identical to the pre-priority scheduler). A higher-priority
+    # request page/slot-starved past EngineConfig.preempt_fence_ticks
+    # preempts the lowest-priority active slot. Under sustained high-tier
+    # load lower tiers wait indefinitely — strict priority is the point;
+    # give droppable traffic a deadline_s so the pending sweep sheds it.
+    # The head_starve_fifo_ticks anti-starvation fence still collapses the
+    # admit window to strict FIFO when admissions keep bypassing the (top-
+    # tier, oldest) queue head.
+    priority: int = 0
+    # Tokens generated by a PREVIOUS incarnation of this request (set by the
+    # engine when it preempts a slot and re-queues the request with its
+    # generated-so-far suffix folded into the prompt). TokenEvent.index
+    # continues from here so stream consumers see one uninterrupted
+    # sequence; sampling.max_new_tokens was already decremented by the same
+    # amount. 0 for every caller-submitted request.
+    resumed_from: int = 0
 
 
 @dataclasses.dataclass
@@ -1218,6 +1253,19 @@ class InferenceEngine:
             "page_pressure_injected": 0,  # fault-injected allocation denials
             "drains_total": 0,  # graceful drains started (model node SIGTERM)
             "drain_cancelled": 0,  # requests deadline-outed by a drain
+            # Overload control (docs/FAULT_TOLERANCE.md overload section):
+            "preemptions_total": 0,  # active slots preempted for a starved
+            # higher-priority request (KV parked in the prefix index, the
+            # request re-queued with its generated suffix — no terminal event)
+            "resume_prefix_hits_total": 0,  # preempted-request resumes that
+            # re-admitted through a cached prefix instead of recomputing —
+            # this staying ~= preemptions_total is the proof the preempt/
+            # resume cycle rides the cache, not a re-prefill
+            "shed_pending_deadline_total": 0,  # PENDING requests shed because
+            # their deadline expired before they ever admitted (subset of
+            # deadline_exceeded; queue-time overload signal)
+            "preempt_storm_injected": 0,  # forced preemptions from the
+            # engine.preempt_storm fault point (chaos testing)
         }
         # Cross-request sharing rides on the session prefix-cache switch: one
         # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
@@ -1272,8 +1320,9 @@ class InferenceEngine:
         # Request deadlines: id -> monotonic expiry (written at submit under
         # _pending_lock, scanned at the top of step()). Expired ids cancel
         # through the normal _cancels path and emit a terminal
-        # finish_reason="deadline_exceeded" event.
-        self._deadline_at: dict[str, float] = {}
+        # finish_reason="deadline_exceeded" event — including ids still in
+        # the PENDING queue, which shed without ever occupying a slot.
+        self._deadline_at: dict[str, float] = {}  # guarded by: _pending_lock
         # Drain sweep flag (deadline_all_now): applied on the scheduler
         # thread at the next step so live-request enumeration cannot race.
         self._drain_sweep = False
@@ -1300,6 +1349,17 @@ class InferenceEngine:
         # Consecutive ticks the queue head has been page-starved while later
         # requests admitted (see _try_admit's fairness fence).
         self._head_starved_ticks = 0
+        # Consecutive ticks the best pending candidate has out-prioritized
+        # the lowest-priority active slot while page/slot-starved; reaching
+        # preempt_fence_ticks fires a preemption (_maybe_preempt). Scheduler-
+        # thread state: only step() reads or writes it.
+        self._preempt_starved_ticks = 0
+        # Request id the preemption probe saw at the queue head last tick:
+        # a head STILL pending one tick later was tried — and refused — by
+        # admission in between, so it is starved regardless of what the
+        # capacity arithmetic in _cand_starved can model (COW copies,
+        # session re-allocs). Scheduler-thread state, like the counter.
+        self._preempt_last_head: str | None = None
         # Mixed scheduling: admitting requests mid-chunked-prefill. Each job
         # reserves one decode slot BY COUNT (_slots_available) and installs
         # into a concrete slot when its prompt completes.
@@ -1356,11 +1416,23 @@ class InferenceEngine:
                     f"are supported with a grammar (got "
                     f"{len(req.sampling.stop_token_ids)})"
                 )
-        if req.deadline_s is not None and req.deadline_s <= 0:
+        if req.deadline_s is not None and (
+            not math.isfinite(req.deadline_s) or req.deadline_s <= 0
+        ):
             # BEFORE _grammar_acquire below: a rejected request must never
-            # pin bank rows.
+            # pin bank rows. NaN is comparison-inert — it would slide past
+            # every deadline sweep as a silent "no deadline".
             raise ValueError(
-                f"request {req.id}: deadline_s={req.deadline_s} must be > 0"
+                f"request {req.id}: deadline_s={req.deadline_s} must be a "
+                "positive finite number"
+            )
+        if type(req.priority) is not int:  # bool included: True < 2 would
+            # "work" but a flag is never a tier — and a non-int raising
+            # inside _enqueue_locked AFTER _grammar_acquire would leak the
+            # acquired bank row, so reject here with the other validations.
+            raise ValueError(
+                f"request {req.id}: priority must be an int "
+                f"(got {type(req.priority).__name__})"
             )
         needed = self._pages_needed(req)
         if needed > self.ecfg.max_pages_per_seq:
@@ -1381,13 +1453,33 @@ class InferenceEngine:
                     raise QueueFullError(
                         f"pending queue at capacity {self.ecfg.max_pending}"
                     )
-                self.pending.append(req)
+                self._enqueue_locked(req)
                 if req.deadline_s is not None:
                     self._deadline_at[req.id] = time.monotonic() + req.deadline_s
         except QueueFullError:
             with self._session_lock:
                 self._grammar_release(req.grammar)
             raise
+
+    def _enqueue_locked(self, req: Request, senior: bool = False) -> None:  # guarded by: _pending_lock
+        """Insert into the PRIORITY-TIER-ORDERED pending queue (guarded by
+        _pending_lock at every call site): the queue is kept non-increasing
+        in priority, FIFO within a tier, so the head is always the oldest
+        top-priority request — admission, the anti-starvation fence, and the
+        preemption probe all see priority traffic without scanning past the
+        window. Flat-priority traffic short-circuits to a plain append
+        (bit-identical to the pre-priority queue). ``senior=True`` inserts
+        at the FRONT of the request's tier instead of the back — a preempted
+        victim keeps its seniority over later arrivals of its own tier."""
+        p = req.priority
+        if not senior and (not self.pending or self.pending[-1].priority >= p):
+            self.pending.append(req)
+            return
+        for i, r in enumerate(self.pending):
+            if (r.priority < p) if not senior else (r.priority <= p):
+                self.pending.insert(i, req)
+                return
+        self.pending.append(req)
 
     def _pages_needed(self, req: Request) -> int:
         total = len(req.prompt) + req.sampling.max_new_tokens
@@ -1682,13 +1774,19 @@ class InferenceEngine:
         (``prefix_batch_deferrals``): next tick they hit the published prefix
         instead of redundantly re-prefilling it.
 
+        Priority (overload control): the pending queue is kept priority-
+        tier-ordered at enqueue (``_enqueue_locked``), so this scan tries
+        higher tiers first without any reordering of its own — all-default
+        traffic behaves bit-identically to the pre-priority scheduler.
+
         Fairness: a page-starved request does not block the queue — admission
         scans up to ``admit_window`` entries past it (bounded reorder). The
-        head is always tried first, so freed pages reach it before anyone
-        behind it; if later requests keep admitting around a starved head for
-        ``head_starve_fifo_ticks`` consecutive ticks, the window collapses to
-        strict FIFO until the head admits. Cache-hit hoisting ages the same
-        fence whenever it bypasses the head."""
+        head — the oldest top-tier request — is always tried first, so freed
+        pages reach it before anyone behind it; if later requests keep
+        admitting around a starved head for ``head_starve_fifo_ticks``
+        consecutive ticks, the window collapses to strict FIFO until the
+        head admits. Cache-hit hoisting (within the top priority tier
+        present) ages the same fence whenever it bypasses the head."""
         if not self.pending:
             return []
         avail = self._slots_available()  # free slots minus prefill-job
@@ -1701,12 +1799,29 @@ class InferenceEngine:
         if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
             window = 1  # anti-starvation fence: freed pages go to the head
         with self._pending_lock:
-            cands = [self.pending[i] for i in range(min(window, len(self.pending)))]
-        best = None  # (cached_len, window index, req)
-        for i, req in enumerate(cands):
-            cl = self._cached_prefix_len(req)
+            # Snapshot window + batch room: admissions never consume window
+            # positions (only SKIPS do — matching the old in-place scan,
+            # where removals shifted the deque under a fixed skip bound), so
+            # a burst tick still admits up to N while reorder depth stays
+            # bounded by `window`.
+            cands = [
+                self.pending[i] for i in range(min(window + N, len(self.pending)))
+            ]
+        # The pending queue is priority-tier-ordered at enqueue
+        # (_enqueue_locked), so a plain positional scan IS the priority
+        # scan: the head is the oldest top-tier request, and every fairness
+        # and fence rule below behaves exactly as in the flat-priority
+        # scheduler. Cache-hit hoisting stays within the top tier present —
+        # a cached lower-tier prompt must not jump a higher tier.
+        head = cands[0]
+        top_priority = head.priority
+        best = None  # (cached_len, window index, req) — top priority tier only
+        for i in range(min(window, len(cands))):
+            if cands[i].priority != top_priority:
+                break  # tiers are contiguous: nothing below is top-tier
+            cl = self._cached_prefix_len(cands[i])
             if cl > 0 and (best is None or cl > best[0]):
-                best = (cl, i, req)
+                best = (cl, i, cands[i])
         if best is not None:
             _, i, req = best
             free_slot = next(j for j, s in enumerate(self.slots) if s is None)
@@ -1720,21 +1835,18 @@ class InferenceEngine:
                     self._head_starved_ticks = 0
                 return single
             # starved even with its cached pages: fall through to the
-            # FIFO scan, which skips it like any starved single
+            # priority scan, which skips it like any starved single
         batch: list[tuple[Request, int, list[int]]] = []  # (req, slot, pages)
         batch_chains: set[bytes] = set()  # leading-page chain hashes in `batch`
         claimed: set[int] = set()
-        head = self.pending[0]
         head_starved = False
         skipped_starved = False
-        idx = 0
-        while len(batch) < N and idx < window:
-            with self._pending_lock:
-                if idx >= len(self.pending):
-                    break
-                req = self.pending[idx]
+        skips = 0
+        for req in cands:
+            if len(batch) >= N or skips >= window:
+                break
             free_slot = next(
-                (i for i, s in enumerate(self.slots) if s is None and i not in claimed),
+                (j for j, s in enumerate(self.slots) if s is None and j not in claimed),
                 None,
             )
             if free_slot is None:
@@ -1777,7 +1889,7 @@ class InferenceEngine:
                 # page-starved single: scan past it
                 skipped_starved = True
                 head_starved = head_starved or req is head
-                idx += 1
+                skips += 1
                 continue
             h1 = None
             if self._shared_prefix and len(req.prompt) > self.ecfg.page_size:
@@ -1787,7 +1899,7 @@ class InferenceEngine:
                     # publish) this same leading page: defer one tick so this
                     # request reuses it instead of re-prefilling the prefix
                     self.stats["prefix_batch_deferrals"] += 1
-                    idx += 1
+                    skips += 1
                     continue
             with self._session_lock:
                 pages = self._alloc_with_eviction(self._pages_needed(req))
@@ -1795,7 +1907,7 @@ class InferenceEngine:
                 # page-starved: scan past it (decode will free pages)
                 skipped_starved = True
                 head_starved = head_starved or req is head
-                idx += 1
+                skips += 1
                 continue
             if h1 is not None:
                 batch_chains.add(h1)
@@ -2007,6 +2119,11 @@ class InferenceEngine:
         elif kind == "index":
             self.stats["prefix_index_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
+        if req.resumed_from > 0 and kind != "fresh" and start > 0:
+            # A preempted request re-admitting over cached pages: the
+            # preempt/resume cycle rode the prefix index instead of paying a
+            # full re-prefill (docs/FAULT_TOLERANCE.md overload control).
+            self.stats["resume_prefix_hits_total"] += 1
 
     def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
         """Single-request admission: session prefix-cache reuse, cross-request
@@ -2247,7 +2364,9 @@ class InferenceEngine:
         ev = TokenEvent(
             request_id=slot.req.id,
             token=tok,
-            index=slot.generated - 1,
+            # resumed_from: a preempted-and-resumed request keeps one
+            # uninterrupted index sequence across incarnations.
+            index=slot.req.resumed_from + slot.generated - 1,
             finished=reason is not None,
             finish_reason=reason,
             logprob=logprob,
@@ -2352,13 +2471,25 @@ class InferenceEngine:
             with self._pending_lock:
                 for rid in ids:
                     self._deadline_at[rid] = t0
-        if not self._deadline_at:
-            return []
         t = time.monotonic()
         with self._pending_lock:
+            if not self._deadline_at:
+                return []
             expired = [rid for rid, exp in self._deadline_at.items() if exp <= t]
             for rid in expired:
                 del self._deadline_at[rid]
+            if expired:
+                # Classify queue-time sheds: an expiry whose id is still
+                # PENDING never got a slot — that is the overload signal
+                # (deadline-aware shedding), distinct from an active request
+                # running out of budget mid-decode. Preempted-and-resumed
+                # requests (resumed_from > 0) DID admit and decode before
+                # landing back in the queue, so they count as mid-decode
+                # budget exhaustion, not queue-time overload.
+                pending_ids = {r.id for r in self.pending if r.resumed_from == 0}
+                shed = sum(1 for rid in expired if rid in pending_ids)
+                if shed:
+                    self.stats["shed_pending_deadline_total"] += shed
         if expired:
             self._cancels.update(expired)
         return expired
@@ -2420,6 +2551,197 @@ class InferenceEngine:
         if unknown:
             self.stats["cancels_unknown"] += len(unknown)
 
+    def _victim_slot(self) -> tuple[int, _Slot] | None:
+        """The slot a preemption would evict: lowest priority first, then the
+        one holding the most pages (frees the most capacity), then the
+        highest slot index (determinism). Grammar-constrained and multimodal
+        slots are never preempted — a mid-schema DFA state cannot resume
+        through a prompt re-submit, and mm prompts are excluded from the
+        prefix cache, so their resume could never ride it."""
+        best: tuple[tuple[int, int, int], int, _Slot] | None = None
+        for i, s in enumerate(self.slots):
+            if s is None or s.req.grammar is not None or s.req.mm_embeds:
+                continue
+            key = (s.req.priority, -len(s.pages), -i)
+            if best is None or key < best[0]:
+                best = (key, i, s)
+        return (best[1], best[2]) if best is not None else None
+
+    def _cand_starved(self, cand: Request) -> bool:
+        """Would the candidate fail to admit THIS tick? True when no slot is
+        free, or when not enough pages are allocatable beyond its cached
+        prefix. ``free_pages`` counts refcount-0 cached pages as allocatable,
+        but admission increfs the candidate's OWN LRU-resident prefix pages
+        out of that pool before allocating the remainder — subtract the
+        overlap, or the probe reports "not starved" in exactly the band
+        where ``_acquire_pages_locked`` actually fails (a parked/published
+        prefix) and the starvation fence never ages. Session-hit prefixes
+        need no correction: sessions hold live references, so their pages
+        are never in ``free_pages``."""
+        if self._slots_available() <= 0:
+            return True
+        with self._session_lock:
+            cached_pages = self._cached_prefix_len(cand) // self.ecfg.page_size
+            evictable_overlap = 0
+            if (
+                cached_pages
+                and self._shared_prefix
+                and not (cand.session_id and cand.session_id in self._sessions)
+            ):
+                evictable_overlap = self.allocator.evictable_prefix_pages(
+                    cand.prompt[: len(cand.prompt) - 1],
+                    hashes=self._prompt_hashes(cand),
+                )
+            return (
+                self._pages_needed(cand) - cached_pages
+                > self.allocator.free_pages - evictable_overlap
+            )
+
+    def _maybe_preempt(self) -> list[TokenEvent]:
+        """Preempt-and-resume (docs/FAULT_TOLERANCE.md overload control):
+        when the best pending candidate in the admit window out-prioritizes
+        the lowest-priority active slot AND has been page/slot-starved for
+        ``preempt_fence_ticks`` consecutive ticks, park that slot's KV in
+        the shared-prefix index (refcount-0 cached — nothing is recomputed
+        unless evicted) and re-queue its request at the queue head with the
+        generated-so-far suffix folded into the prompt. No terminal event is
+        emitted; resume re-admits through the normal shared-prefix path and
+        continues token-exactly under greedy. The ``engine.preempt_storm``
+        fault point forces a preemption regardless of priority or starvation
+        (deterministic chaos testing). Returns any events harvested when a
+        firing preemption drained the decode pipeline."""
+        if not self.pending:
+            self._preempt_starved_ticks = 0
+            self._preempt_last_head = None
+            return []
+        victim = self._victim_slot()
+        if victim is None:
+            self._preempt_starved_ticks = 0
+            self._preempt_last_head = None
+            return []
+        vi, vslot = victim
+        storm = _engine_fault("engine.preempt_storm") is not None
+        if storm:
+            self.stats["preempt_storm_injected"] += 1
+        else:
+            if self.ecfg.preempt_fence_ticks <= 0:
+                return []  # priority preemption disabled
+            # The pending queue is priority-tier-ordered (_enqueue_locked),
+            # so the head IS the highest-priority waiter — no window scan.
+            with self._pending_lock:
+                cand = self.pending[0] if self.pending else None
+            if cand is None or cand.priority <= vslot.req.priority:
+                self._preempt_starved_ticks = 0
+                self._preempt_last_head = None
+                return []
+            # Is the candidate actually starved this tick? Two signals, OR'd:
+            # the capacity arithmetic (no admissible slot, or not enough
+            # allocatable pages beyond the cached prefix — catches starvation
+            # a tick earlier than waiting for admission to fail), and the
+            # head being STUCK since the previous probe — admission ran in
+            # between and refused it, which covers allocation modes the
+            # arithmetic cannot model (COW copies, session re-allocs).
+            head_stuck = cand.id == self._preempt_last_head
+            if self.ecfg.mixed_step and not self._mixed_eligible(cand):
+                # A grammar/mm head admits only on classic ticks; while
+                # mixed ticks service admission, its stuckness is
+                # mode-INELIGIBILITY, not capacity starvation — preempting
+                # would free pages that lower-priority mixed candidates
+                # absorb, not the head. Require the capacity probe instead
+                # (true page/slot starvation still ages the fence below).
+                head_stuck = False
+            self._preempt_last_head = cand.id
+            if not head_stuck:
+                # The fence is per-head: a NEW head (the old one admitted,
+                # shed, or was cancelled) starts its own starvation count —
+                # inherited ticks would let it preempt after far fewer than
+                # preempt_fence_ticks starved ticks of its own.
+                self._preempt_starved_ticks = 0
+                if not self._cand_starved(cand):
+                    return []
+            self._preempt_starved_ticks += 1
+            if self._preempt_starved_ticks < self.ecfg.preempt_fence_ticks:
+                return []
+        # Preemption mutates slots and host shadows, and the in-flight
+        # pipelined step may still emit for the victim: drain it first so
+        # bookkeeping reflects harvested state.
+        events = self._harvest_inflight()
+        if self.slots[vi] is not vslot:
+            # The harvest finished the original victim (or freed its slot).
+            if not storm:
+                # the capacity the preemption wanted just appeared on its own
+                self._preempt_starved_ticks = 0
+                return events
+            # A consumed storm injection must still break something if
+            # anything preemptable remains — otherwise seeded chaos schedules
+            # silently under-fire and preempt_storm_injected diverges from
+            # preemptions_total.
+            victim = self._victim_slot()
+            if victim is None:
+                return events  # engine drained itself: nothing to preempt
+            vi, vslot = victim
+        if not storm:
+            # The drain may have finished a DIFFERENT slot, freeing the
+            # capacity the candidate needs. Abort only when admission is now
+            # GUARANTEED: a free slot plus enough pages even if every page
+            # must be allocated fresh (COW copies and session re-allocs can
+            # demand up to the full budget — an optimistic probe here would
+            # reset the fence each cycle and the preemption would never
+            # commit).
+            with self._session_lock:
+                free = self.allocator.free_pages
+            if self._slots_available() > 0 and free >= self._pages_needed(cand):
+                self._preempt_starved_ticks = 0
+                return events
+        self._preempt_slot(vi, vslot)
+        self._preempt_starved_ticks = 0
+        return events
+
+    def _preempt_slot(self, slot_idx: int, slot: _Slot) -> None:
+        """Evict one active slot WITHOUT a terminal event: park its KV in
+        the prefix index and re-queue the request, its generated-so-far
+        suffix appended to the prompt (PR 1's refcounted content-addressed
+        cache is what makes this cheap — resume is a prefix hit, not a
+        re-prefill). The last sampled token's KV was never written, so the
+        parked prefix is tokens[:-1] and the resume prompt is the full
+        tokens list: its suffix re-prefill recomputes exactly the pending
+        last-token logits the next decode step would have used — token-exact
+        under greedy."""
+        req = slot.req
+        with self._session_lock:
+            if self._shared_prefix:
+                self.allocator.park(slot.tokens[:-1], slot.pages)
+            else:
+                # No content index to park into: drop the pages; resume
+                # re-prefills the full context (correct, just not cheap).
+                self.allocator.free(slot.pages)
+        resumed = dataclasses.replace(
+            req,
+            prompt=list(slot.tokens),
+            sampling=dataclasses.replace(
+                req.sampling,
+                max_new_tokens=req.sampling.max_new_tokens - slot.generated,
+            ),
+            resumed_from=req.resumed_from + slot.generated,
+        )
+        with self._pending_lock:
+            # Front of its priority tier: the victim keeps its seniority —
+            # the moment capacity frees (and no higher tier is waiting), it
+            # resumes.
+            self._enqueue_locked(resumed, senior=True)
+        self._req_hashes.pop(req.id, None)  # prompt changed: re-hash on probe
+        self.slots[slot_idx] = None
+        self.page_tables[slot_idx] = 0
+        self.seq_lens[slot_idx] = 0
+        self.temps[slot_idx] = 0.0
+        self.top_ks[slot_idx] = 0
+        self.top_ps[slot_idx] = 1.0
+        self.grammar_states[slot_idx] = 0
+        self.eos_ids[slot_idx] = -1
+        self._dirty = True
+        self._compact = None  # membership changed
+        self.stats["preemptions_total"] += 1
+
     def _mixed_eligible(self, req: Request) -> bool:
         """Mixed prefill jobs carry plain token prompts only: grammar
         first-token masks and multimodal inject buffers are classic-tick
@@ -2454,12 +2776,13 @@ class InferenceEngine:
         decides each job's chunk start, so session and shared-prefix hits
         skip straight to their suffix).
 
-        Fairness mirrors ``_try_admit``: a page-starved (or mixed-ineligible)
-        head does not block the queue — the scan looks up to ``admit_window``
-        entries past it, bypasses age the same ``_head_starved_ticks`` fence,
-        and the fence collapses the window to strict FIFO so freed pages
-        reach the head first. Candidates whose leading page chain matches an
-        IN-FLIGHT job defer until that job publishes at install
+        Fairness mirrors ``_try_admit``: the queue is priority-tier-ordered
+        at enqueue, and a page-starved (or mixed-ineligible) head does not
+        block it — the scan looks up to ``admit_window`` entries past the
+        head, bypasses age the same ``_head_starved_ticks`` fence, and the
+        fence collapses the window to strict FIFO so freed pages reach the
+        head first. Candidates whose leading page chain matches an IN-FLIGHT
+        job defer until that job publishes at install
         (``prefix_batch_deferrals``) instead of re-prefilling the prefix."""
         window = max(1, self.ecfg.admit_window)
         if self._head_starved_ticks >= self.ecfg.head_starve_fifo_ticks:
@@ -2467,15 +2790,23 @@ class InferenceEngine:
             # (and a mixed-ineligible head drains the jobs — no new ones can
             # start past it — until a classic tick can admit it)
         job_leads = {j.lead_hash for j in self._prefill_jobs if j.lead_hash}
-        head = self.pending[0] if self.pending else None
+        with self._pending_lock:
+            cands = [
+                self.pending[i]
+                for i in range(min(window + self.ecfg.max_batch, len(self.pending)))
+            ]
+        # The pending queue is priority-tier-ordered at enqueue
+        # (_enqueue_locked): the positional scan already tries higher
+        # tiers first, and the fairness/fence rules below behave exactly
+        # as in the flat-priority scheduler.
+        head = cands[0] if cands else None
+        head_pending = head is not None
         head_blocked = False  # page-starved OR mixed-ineligible head
         admitted_past_head = False
-        idx = 0
-        while room > 0 and self._slots_available() > 0 and idx < window:
-            with self._pending_lock:
-                if idx >= len(self.pending):
-                    break
-                req = self.pending[idx]
+        skips = 0
+        for req in cands:
+            if room <= 0 or self._slots_available() <= 0 or skips >= window:
+                break
             if not self._mixed_eligible(req):
                 # grammar/mm admit via classic ticks; scan past them like a
                 # starved entry. A blocked HEAD ages the fence below, so
@@ -2483,7 +2814,7 @@ class InferenceEngine:
                 # trips, no new jobs start and the job queue drains, letting
                 # a classic tick admit it.
                 head_blocked = head_blocked or req is head
-                idx += 1
+                skips += 1
                 continue
             lead = None
             if self._shared_prefix and len(req.prompt) > self.ecfg.page_size:
@@ -2492,12 +2823,12 @@ class InferenceEngine:
                     # an in-flight job is about to publish this same leading
                     # page: defer until it installs, then hit the index
                     self.stats["prefix_batch_deferrals"] += 1
-                    idx += 1
+                    skips += 1
                     continue
             acq = self._acquire_pages_locked(req)
             if acq is None:
                 head_blocked = head_blocked or req is head
-                idx += 1
+                skips += 1
                 continue  # page-starved: scan past it (decode frees pages)
             pages, start, kind = acq
             if kind != "fresh":
@@ -2512,17 +2843,20 @@ class InferenceEngine:
             )
             if lead is not None:
                 job_leads.add(lead)
-            if idx > 0:
-                # idx > 0 means entries were SKIPPED (starved/ineligible/
-                # deferred) before this one — a genuine bypass. Plain FIFO
-                # multi-admission keeps idx at 0 as pending shrinks and
+            if req is head:
+                head_pending = False
+            elif skips > 0:
+                # Entries were SKIPPED (starved/ineligible/deferred) before
+                # this one — a genuine bypass (the head precedes everything
+                # in the snapshot, so a still-pending head implies a skip).
+                # Plain FIFO multi-admission admits the head first and
                 # counts nothing, matching the classic scheduler's stat.
                 admitted_past_head = True
                 self.stats["admission_reorders"] += 1
             room -= len(req.prompt) - start
-        if head_blocked and admitted_past_head:
+        if admitted_past_head and head_blocked:
             self._head_starved_ticks += 1
-        elif head is not None and (not self.pending or self.pending[0] is not head):
+        elif head is not None and not head_pending:
             self._head_starved_ticks = 0  # the head itself admitted
 
     def _mixed_tick(self) -> list[TokenEvent] | None:
@@ -2659,7 +2993,14 @@ class InferenceEngine:
             # a post-cancel rebuild starts from harvested (current) state.
             events += self._harvest_inflight()
         self._drain_cancels(expected=set(expired))
+        # Exactly-one-terminal-event: a request whose deadline expired the
+        # same tick its in-flight step finished naturally just got its REAL
+        # terminal from the pre-cancel harvest above — do not stack a
+        # deadline_exceeded terminal on top of it.
+        finished_now = {e.request_id for e in events if e.finished}
         for rid in expired:
+            if rid in finished_now:
+                continue
             # Terminal event for the consumer (tokens generated so far were
             # already streamed; -1 marks "no token carried").
             self.stats["deadline_exceeded"] += 1
@@ -2669,6 +3010,11 @@ class InferenceEngine:
                     finish_reason="deadline_exceeded",
                 )
             )
+        # Overload control: a starved higher-priority pending request may
+        # preempt the lowest-priority active slot (parking its KV in the
+        # prefix index for a near-free resume). Cheap no-op when the queue
+        # is empty or priorities are flat.
+        events += self._maybe_preempt()
         if self._mixed_tick_ready():
             # Mixed ticks are synchronous (the packed descriptors change
             # every tick): drain the decode pipeline so host shadows are

@@ -21,7 +21,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import hashlib
-from typing import Sequence
+from typing import Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -318,21 +318,44 @@ class PrefixPagePool:
 
     # -- content index --------------------------------------------------
 
+    def _prefix_chain(
+        self, tokens: Sequence[int], hashes: list[bytes] | None = None
+    ) -> Iterator[PageRecord]:
+        """Walk the longest indexed full-page prefix chain of `tokens`,
+        yielding each matching PageRecord. The ONE definition of "what
+        counts as a prefix hit" — peek/lookup/evictable_prefix_pages all
+        iterate this walk so a probe can never desynchronize from actual
+        lookup behavior (the tuple compare guards hash collisions)."""
+        ps = self.page_size
+        if hashes is None:
+            hashes = page_chain_hashes(tokens, ps)
+        for i, h in enumerate(hashes):
+            rec = self._by_hash.get(h)
+            if rec is None or rec.tokens != tuple(tokens[i * ps : (i + 1) * ps]):
+                return
+            yield rec
+
     def peek(self, tokens: Sequence[int], hashes: list[bytes] | None = None) -> int:
         """Length (in tokens) of the longest indexed full-page prefix of
         `tokens`, without taking references. Admission uses this to order
         and group candidates before committing. Pass precomputed
         `hashes` (page_chain_hashes) to skip re-hashing."""
-        ps = self.page_size
-        if hashes is None:
-            hashes = page_chain_hashes(tokens, ps)
-        n = 0
-        for i, h in enumerate(hashes):
-            rec = self._by_hash.get(h)
-            if rec is None or rec.tokens != tuple(tokens[i * ps : (i + 1) * ps]):
-                break
-            n += ps
-        return n
+        return sum(1 for _ in self._prefix_chain(tokens, hashes)) * self.page_size
+
+    def evictable_prefix_pages(
+        self, tokens: Sequence[int], hashes: list[bytes] | None = None
+    ) -> int:
+        """Of the longest indexed full-page prefix of `tokens`, how many
+        pages are refcount-0 (LRU-resident)? Those pages count in
+        :attr:`free_pages`, but an admission :meth:`lookup` increfs them OUT
+        of the evictable pool — capacity probes that subtract the cached
+        prefix from a request's page need must also subtract this overlap
+        from ``free_pages``, or they double-count the same pages."""
+        return sum(
+            1
+            for rec in self._prefix_chain(tokens, hashes)
+            if self._refs[rec.page] == 0
+        )
 
     def lookup(
         self, tokens: Sequence[int], hashes: list[bytes] | None = None
@@ -340,23 +363,16 @@ class PrefixPagePool:
         """Longest indexed full-page chain prefix of `tokens`. Returns
         (pages, matched_token_count); the caller owns one reference on each
         returned page (balance with free())."""
-        ps = self.page_size
-        if hashes is None:
-            hashes = page_chain_hashes(tokens, ps)
         pages: list[int] = []
         t = self._tick()
-        for i, h in enumerate(hashes):
-            page_toks = tuple(tokens[i * ps : (i + 1) * ps])
-            rec = self._by_hash.get(h)
-            if rec is None or rec.tokens != page_toks:
-                break
+        for rec in self._prefix_chain(tokens, hashes):
             rec.last_used = t
             if self._refs[rec.page] == 0:
                 self._lru.pop(rec.page, None)
             self._refs[rec.page] += 1
             pages.append(rec.page)
         self.stats["prefix_pages_reused"] += len(pages)
-        return pages, len(pages) * ps
+        return pages, len(pages) * self.page_size
 
     def publish(self, tokens: Sequence[int], pages: list[int]) -> int:
         """Register the full pages of `tokens` (KV resident in position-
@@ -394,6 +410,20 @@ class PrefixPagePool:
             n_new += 1
             self.stats["prefix_pages_published"] += 1
         return n_new
+
+    def park(self, tokens: Sequence[int], pages: list[int]) -> int:
+        """Preemption primitive (docs/FAULT_TOLERANCE.md overload control):
+        publish the full pages of `tokens` into the content index, then
+        release the caller's reference on EVERY page. Indexed pages land on
+        the refcount-0 LRU — their KV stays valid and a later lookup (the
+        preempted request's resume, or any shared-prefix sibling) reuses
+        them without recompute — while partial tail pages (whose content is
+        not a full addressable page) return straight to the free list, so
+        the preemptor can allocate immediately. Returns the number of pages
+        left CACHED (resume's best-case prefix, in pages)."""
+        self.publish(tokens, pages)
+        self.free(pages)
+        return sum(1 for p in pages if p in self._by_page)
 
     def forget(self, page: int) -> None:
         """Drop a page from the content index (its KV is about to be

@@ -714,7 +714,12 @@ class ModelBackend:
         audios: list | None = None,
         prefused: tuple | None = None,  # (tokens, mm_embeds) from ensure_media()
         deadline_s: float | None = None,  # per-request wall-clock budget
-        # (engine-enforced; finish_reason="deadline_exceeded" on expiry)
+        # (engine-enforced; finish_reason="deadline_exceeded" on expiry —
+        # pending work past its deadline is shed without ever admitting)
+        priority: int = 0,  # admission priority (overload control): higher
+        # admits first within the engine's fairness window; a starved
+        # higher-priority request may preempt a lower-priority slot
+        # (docs/FAULT_TOLERANCE.md)
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -783,6 +788,8 @@ class ModelBackend:
                         "has no eos_token_id)"
                     )
                 stop_token_ids = [eos]
+        if isinstance(priority, bool) or not isinstance(priority, int):
+            raise ValueError(f"priority must be an integer, got {priority!r}")
         self._next += 1
         rid = f"gen_{self._next}"
         register(rid)
@@ -802,6 +809,7 @@ class ModelBackend:
                     grammar=grammar,
                     mm_embeds=mm_embeds,
                     deadline_s=deadline_s,
+                    priority=priority,
                 )
             )
         except Exception:
@@ -964,6 +972,7 @@ class ModelBackend:
         audios: list | None = None,
         output: str = "text",
         deadline_s: float | None = None,
+        priority: int = 0,
     ) -> dict[str, Any]:
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
@@ -1061,6 +1070,7 @@ class ModelBackend:
             audios=audios,
             prefused=prefused,
             deadline_s=deadline_s,
+            priority=priority,
         )
         try:
             result = await fut
@@ -1108,6 +1118,7 @@ class ModelBackend:
         audios: list | None = None,
         prefused: tuple | None = None,
         deadline_s: float | None = None,
+        priority: int = 0,
     ) -> tuple[str, asyncio.Queue]:
         """Streaming variant: returns (request_id, queue of TokenEvents).
         Raises QueueFullError / RequestTooLongError like generate()."""
@@ -1130,6 +1141,7 @@ class ModelBackend:
             audios=audios,
             prefused=prefused,
             deadline_s=deadline_s,
+            priority=priority,
         )
         return rid, q
 
@@ -1329,7 +1341,7 @@ def build_model_node(
                     "prompt", "tokens", "stop_token_ids", "session_id",
                     "max_new_tokens", "temperature", "top_k", "top_p",
                     "response_schema", "context_overflow", "images", "audios",
-                    "deadline_s",
+                    "deadline_s", "priority",
                 )
                 if body.get(k) is not None
             }

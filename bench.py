@@ -47,6 +47,18 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     (docs/MIXED_SCHEDULING.md). Reports the in-flight decodes' inter-token
     latency p50/p99 and the burst's TTFT p50/p99 for both modes, plus
     decode throughput; headline value = mixed-ON decode ITL p99 (ms).
+  overload_storm — overload-survival bench (docs/FAULT_TOLERANCE.md
+    overload control): a two-tier priority burst at 2x the engine's page
+    capacity. Low-priority deadline-carrying traffic floods the engine
+    first; a high-priority burst lands mid-decode and admits through
+    priority ordering and preempt-and-resume (victims park their KV in the
+    shared-prefix index and resume token-exactly) while the pending sweep
+    sheds low-priority work past its deadline. Reports shed rate,
+    high-priority TTFT p50/p99, preemption/resume-prefix-hit counts, and
+    asserts every request terminal (completed or shed — ZERO hung).
+    Headline value = high-priority success rate (acceptance: 1.0).
+    AGENTFIELD_BENCH_LOW/_HIGH size the tiers,
+    AGENTFIELD_BENCH_LOW_DEADLINE (s) tunes the shed pressure.
   fault_storm — control-plane failure-domain bench (no model, no chip;
     docs/FAULT_TOLERANCE.md): a real in-process control plane + two agent
     nodes serving the same component; a seeded FaultInjector schedule kills
@@ -473,11 +485,15 @@ def _run_bench() -> None:
         _mixed_interference(model, cfg, params, attn)
         _done.set()
         return
+    if scenario == "overload_storm":
+        _overload_storm(model, cfg, params, attn)
+        _done.set()
+        return
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
-            "(have: shared_prefix_burst, mixed_interference, fault_storm, "
-            "gateway_qps)"
+            "(have: shared_prefix_burst, mixed_interference, overload_storm, "
+            "fault_storm, gateway_qps)"
         )
 
     demoted = None
@@ -791,6 +807,162 @@ def _shared_prefix_burst(
             "decode_span": span,
             "n_requests": n,
             "prefix_len": prefix_len,
+            "device": str(jax.devices()[0]),
+        }
+    )
+
+
+def _overload_storm(model: str, cfg, params, attn: str) -> None:
+    """Overload-survival storm (docs/FAULT_TOLERANCE.md overload control):
+    two-tier priority burst at 2x page capacity. Low-priority traffic (with
+    deadlines) floods the engine first; once decodes are in flight a
+    high-priority burst lands and must get through via priority-ordered
+    admission and preempt-and-resume, while the pending-deadline sweep sheds
+    low-priority work that can no longer meet its deadline. Acceptance:
+    every submission terminal (completed or shed — zero hung), high-priority
+    success rate 1.0, preemptions > 0 with resumes riding the prefix cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    n_low = int(os.environ.get("AGENTFIELD_BENCH_LOW") or 24)
+    n_high = int(os.environ.get("AGENTFIELD_BENCH_HIGH") or 8)
+    low_deadline = float(os.environ.get("AGENTFIELD_BENCH_LOW_DEADLINE") or 3.0)
+    prompt_len, new_tokens = 64, 64
+    page_size = 32
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    demand = (n_low + n_high) * pages_per_seq
+    ecfg = EngineConfig(
+        max_batch=8,
+        page_size=page_size,
+        num_pages=demand // 2 + 1,  # 2x overcommit: the burst CANNOT all fit
+        max_pages_per_seq=pages_per_seq,
+        max_pending=max(n_low + n_high, 64),
+        prefill_batch=8,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: honest TTFT
+        preempt_fence_ticks=4,
+    )
+
+    def reqs(prefix, n, seed, priority=0, deadline=None):
+        # Staggered deadlines (0.5x..1.5x the knob) keep the shed rate a
+        # smooth partial quantity instead of an all-or-nothing cliff when
+        # the whole tier finishes at nearly the same instant.
+        toks = jax.random.randint(
+            jax.random.PRNGKey(seed), (n, prompt_len), 0, cfg.vocab_size, jnp.int32
+        )
+        return [
+            Request(
+                id=f"{prefix}{i}",
+                prompt=toks[i].tolist(),
+                sampling=SamplingParams(max_new_tokens=new_tokens),
+                priority=priority,
+                deadline_s=(
+                    None if deadline is None
+                    else deadline * (0.5 + i / max(n - 1, 1))
+                ),
+            )
+            for i in range(n)
+        ]
+
+    if not _budget_gate("overload_storm", 120):
+        _emit(_fallback_payload("budget exhausted before overload_storm"))
+        return
+    # Warm EVERY compile path the storm touches out of the timing (and out
+    # of the low tier's deadline budget): the full-width batched prefill,
+    # the single-request prefill at the storm's prompt bucket, the longer
+    # bucket a preempted victim resumes at (prompt + generated-so-far), and
+    # the decode step. A compile landing mid-storm would be misread as
+    # queueing delay and eat the deadlines.
+    warm = InferenceEngine(params, cfg, ecfg)
+    for _ in warm.run_to_completion(reqs("w", 8, 31)):
+        pass
+    for _ in warm.run_to_completion(reqs("w2", 1, 31)):
+        pass
+    long_prompt = jax.random.randint(
+        jax.random.PRNGKey(30), (prompt_len + new_tokens - 1,), 0,
+        cfg.vocab_size, jnp.int32,
+    ).tolist()
+    for _ in warm.run_to_completion(
+        [
+            Request(
+                id="w3", prompt=long_prompt,
+                sampling=SamplingParams(max_new_tokens=1),
+            )
+        ]
+    ):
+        pass
+    del warm
+
+    engine = InferenceEngine(params, cfg, ecfg)
+    lows = reqs("lo", n_low, 32, priority=0, deadline=low_deadline)
+    highs = reqs("hi", n_high, 33, priority=1, deadline=None)
+    first_ms: dict[str, float] = {}
+    finish: dict[str, str] = {}
+    submit_t: dict[str, float] = {}
+    t0 = time.perf_counter()
+
+    def pump():
+        for ev in engine.step():
+            now = time.perf_counter()
+            if ev.token >= 0 and ev.request_id not in first_ms:
+                first_ms[ev.request_id] = (now - submit_t[ev.request_id]) * 1e3
+            if ev.finished:
+                finish[ev.request_id] = ev.finish_reason
+
+    for r in lows:
+        submit_t[r.id] = time.perf_counter()
+        engine.submit(r)
+    # let the low tier actually occupy the slots before the storm lands
+    fill = min(ecfg.max_batch, n_low)
+    while engine.has_work() and sum(s is not None for s in engine.slots) < fill:
+        pump()
+    for r in highs:
+        submit_t[r.id] = time.perf_counter()
+        engine.submit(r)
+    while engine.has_work():
+        if time.perf_counter() - t0 > 300:
+            break  # wedge guard; reported as hung below
+        pump()
+    elapsed = time.perf_counter() - t0
+
+    hung = [r.id for r in lows + highs if r.id not in finish]
+    high_done = sum(finish.get(r.id) == "length" for r in highs)
+    low_done = sum(finish.get(r.id) == "length" for r in lows)
+    shed_low = sum(finish.get(r.id) == "deadline_exceeded" for r in lows)
+    high_ttfts = sorted(first_ms[r.id] for r in highs if r.id in first_ms)
+    s = engine.stats
+    _emit(
+        {
+            "metric": f"overload_storm_{model}_{n_low}lo_{n_high}hi_2x_pages",
+            "value": round(high_done / n_high, 4),
+            "unit": "high_priority_success_rate",
+            "zero_hung": not hung,
+            "hung": hung,
+            "low_completed": low_done,
+            "low_shed": shed_low,
+            "low_shed_rate": round(shed_low / n_low, 4),
+            "shed_pending_deadline_total": s["shed_pending_deadline_total"],
+            "deadline_exceeded_total": s["deadline_exceeded"],
+            "preemptions_total": s["preemptions_total"],
+            "resume_prefix_hits_total": s["resume_prefix_hits_total"],
+            "admission_reorders": s["admission_reorders"],
+            "high_ttft_ms_p50": (
+                round(_pctile(high_ttfts, 50), 1) if high_ttfts else None
+            ),
+            "high_ttft_ms_p99": (
+                round(_pctile(high_ttfts, 99), 1) if high_ttfts else None
+            ),
+            "elapsed_s": round(elapsed, 2),
+            "low_deadline_s": low_deadline,
+            "n_low": n_low,
+            "n_high": n_high,
+            "num_pages": ecfg.num_pages,
+            "pages_demanded": demand,
+            "preempt_fence_ticks": ecfg.preempt_fence_ticks,
+            "attn_impl": attn,
             "device": str(jax.devices()[0]),
         }
     )

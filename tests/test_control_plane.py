@@ -208,18 +208,38 @@ async def test_agent_timeout_fails_execution():
 
 
 @async_test
-async def test_async_backpressure_503():
+async def test_async_backpressure_transient_429():
+    """Queue full while workers are visibly draining = transient overload:
+    429 with a Retry-After hint (delta-seconds, >= 1) instead of the blind
+    503 (docs/FAULT_TOLERANCE.md overload control)."""
     async with CPHarness(async_workers=1, queue_capacity=1) as h:
         h.agent.slow_s = 1.0
         await h.register_agent()
-        codes = []
-        for _ in range(4):
+        codes, retry_after = [], None
+        for _ in range(6):
             async with h.http.post("/api/v1/execute/async/fake-agent.slow", json={}) as r:
                 codes.append(r.status)
-        assert 503 in codes, codes
+                if r.status == 429 and retry_after is None:
+                    retry_after = r.headers.get("Retry-After")
+        assert 429 in codes, codes
+        assert retry_after is not None and float(retry_after) >= 1
         async with h.http.get("/metrics") as r:
             text = await r.text()
         assert "agentfield_gateway_backpressure_total" in text
+
+
+@async_test
+async def test_async_backpressure_stalled_503():
+    """Queue full with NO drain in the window (zero workers: nothing is
+    moving) stays the no-capacity 503 — Retry-After would be a lie."""
+    async with CPHarness(async_workers=0, queue_capacity=1) as h:
+        await h.register_agent()
+        codes = []
+        for _ in range(3):
+            async with h.http.post("/api/v1/execute/async/fake-agent.echo", json={}) as r:
+                codes.append(r.status)
+                assert r.headers.get("Retry-After") is None
+        assert 503 in codes and 429 not in codes, codes
 
 
 @async_test

@@ -1,0 +1,519 @@
+"""Overload survival (ISSUE 6): priority admission, deadline-aware shedding
+of pending work, and preempt-and-resume on the shared-prefix cache.
+
+Engine side: priority-ordered admission within the fairness window,
+preempt-and-resume token-exactness under greedy (classic and mixed_step
+ticks), the engine.preempt_storm chaos point, pending-deadline shedding
+(terminal event exactly once), and the pending-path bookkeeping cleanup.
+Gateway side: priority/deadline_s propagation through dispatch to the model
+node, pre-dispatch deadline shedding, and the SDK backpressure delay.
+
+Reuses the llama-tiny ECFG of test_serving_engine where possible so few new
+engine-config compilations enter tier-1.
+"""
+
+import asyncio
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+from tests.helpers_cp import CPHarness, FakeAgent, async_test
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=4, page_size=8, num_pages=64, max_pages_per_seq=8)
+# Tight pool for preemption scenarios: 6 usable pages (one is the garbage
+# page). A 12-prompt/24-new victim needs 5, so a 12-prompt/8-new rival
+# (3 pages) is genuinely page-starved while the victim runs.
+TIGHT = EngineConfig(
+    max_batch=4, page_size=8, num_pages=7, max_pages_per_seq=6,
+    preempt_fence_ticks=2,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faults.install(None)
+
+
+def _prompt(seed, n):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _req(rid, prompt, max_new=8, priority=0, **kw):
+    return Request(
+        id=rid, prompt=prompt,
+        sampling=SamplingParams(max_new_tokens=max_new),
+        priority=priority, **kw,
+    )
+
+
+def _drain(engine, timeout=120):
+    """Step until idle; returns (tokens per id, terminal events per id)."""
+    tokens: dict[str, list[int]] = {}
+    finals: dict[str, list] = {}
+    t0 = time.monotonic()
+    while engine.has_work():
+        assert time.monotonic() - t0 < timeout, "engine wedged"
+        for ev in engine.step():
+            if ev.token >= 0:
+                tokens.setdefault(ev.request_id, []).append(ev.token)
+            if ev.finished:
+                finals.setdefault(ev.request_id, []).append(ev)
+    return tokens, finals
+
+
+# ---------------------------------------------------------------------------
+# Priority-ordered admission
+
+
+def test_priority_admits_first(params):
+    """The pending queue is priority-tier-ordered at submit (FIFO within a
+    tier): 4 high-priority requests submitted BEHIND 4 defaults move to the
+    queue head and take the entire first admission batch."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    for i in range(4):
+        engine.submit(_req(f"lo{i}", _prompt(i, 5), max_new=4))
+    for i in range(4):
+        engine.submit(_req(f"hi{i}", _prompt(10 + i, 5), max_new=4, priority=1))
+    assert [r.id for r in engine.pending] == (
+        [f"hi{i}" for i in range(4)] + [f"lo{i}" for i in range(4)]
+    )
+    first = engine.step()  # first tick admits one full batch
+    assert {ev.request_id for ev in first} == {f"hi{i}" for i in range(4)}
+    tokens, finals = _drain(engine)
+    for ev in first:
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    assert all(len(tokens[r]) == 4 for r in tokens), {
+        k: len(v) for k, v in tokens.items()
+    }
+    assert set(tokens) == {f"lo{i}" for i in range(4)} | {f"hi{i}" for i in range(4)}
+
+
+def test_submit_rejects_non_int_priority(params):
+    """Direct engine callers get the same priority validation the gateway
+    applies: bools and non-ints are rejected at submit, BEFORE any bank
+    rows are acquired (a TypeError deep in the enqueue would leak them)."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    for bad in (True, "high", 1.5):
+        with pytest.raises(ValueError, match="priority"):
+            engine.submit(_req("bad", _prompt(0, 5), priority=bad))
+    assert not engine.pending
+
+
+def test_flat_priority_is_plain_fifo(params):
+    """All-default traffic is the pre-priority scheduler: FIFO admission,
+    no reorders counted, and outputs identical run-to-run."""
+    def run():
+        engine = InferenceEngine(params, CFG, ECFG)
+        reqs = [_req(f"r{i}", _prompt(i, 5), max_new=4) for i in range(6)]
+        out = engine.run_to_completion(reqs)
+        return engine, out
+
+    a_eng, a = run()
+    b_eng, b = run()
+    assert a == b
+    assert a_eng.stats["admission_reorders"] == 0
+    # the first batch went to the first four submitted
+    assert a_eng.stats["preemptions_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Preempt-and-resume
+
+
+def _preempt_scenario(params, ecfg):
+    """Victim admits alone, then a higher-priority rival arrives into a
+    page-starved pool. Returns (engine, tokens, finals)."""
+    engine = InferenceEngine(params, CFG, ecfg)
+    engine.submit(_req("victim", _prompt(0, 12), max_new=24))
+    pre = engine.step()  # victim admits (emits its first token)
+    engine.submit(_req("rival", _prompt(1, 12), max_new=8, priority=1))
+    tokens, finals = _drain(engine)
+    for ev in pre:
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    return engine, tokens, finals
+
+
+@pytest.mark.parametrize("mixed", [False, True], ids=["classic", "mixed"])
+def test_preempt_resume_token_exact(params, mixed):
+    """A page-starved higher-priority request preempts the victim past the
+    fence; the victim's KV parks in the prefix index, it resumes through a
+    prefix hit, and its final token stream is EXACTLY the unpreempted run's
+    — no terminal event at preemption, one at completion."""
+    ecfg = TIGHT if not mixed else dataclasses.replace(
+        TIGHT, mixed_step=True, mixed_step_budget=20
+    )
+    ref = InferenceEngine(params, CFG, ecfg)
+    want_victim = ref.run_to_completion(
+        [_req("victim", _prompt(0, 12), max_new=24)]
+    )["victim"]
+    ref2 = InferenceEngine(params, CFG, ecfg)
+    want_rival = ref2.run_to_completion(
+        [_req("rival", _prompt(1, 12), max_new=8, priority=1)]
+    )["rival"]
+
+    engine, tokens, finals = _preempt_scenario(params, ecfg)
+    assert engine.stats["preemptions_total"] >= 1
+    assert engine.stats["resume_prefix_hits_total"] >= 1, (
+        "resume must ride the prefix cache, not re-prefill"
+    )
+    assert tokens["victim"] == want_victim  # token-exact across preemption
+    assert tokens["rival"] == want_rival
+    # exactly ONE terminal event each; none emitted at preemption time
+    assert [e.finish_reason for e in finals["victim"]] == ["length"]
+    assert [e.finish_reason for e in finals["rival"]] == ["length"]
+    # the stream index is continuous across incarnations
+    assert finals["victim"][0].index == 23
+    # everything released: only refcount-0 cached pages may remain
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+    assert not engine._deadline_at and not engine.pending
+
+
+def test_preempt_disabled_by_zero_fence(params):
+    """preempt_fence_ticks=0 turns priority preemption off: the rival waits
+    for the victim instead of evicting it."""
+    ecfg = dataclasses.replace(TIGHT, preempt_fence_ticks=0)
+    engine, tokens, finals = _preempt_scenario(params, ecfg)
+    assert engine.stats["preemptions_total"] == 0
+    assert len(tokens["victim"]) == 24 and len(tokens["rival"]) == 8
+
+
+def test_preempt_fires_when_candidate_prefix_is_cached(params):
+    """Starvation-probe regression: a rival whose prompt prefix sits
+    refcount-0 in the LRU must still age the preemption fence. free_pages
+    counts those same pages as allocatable, so a probe that subtracts the
+    cached prefix from the rival's need WITHOUT subtracting the LRU overlap
+    from free_pages reports "not starved" every tick and never preempts —
+    exactly the parked/shared-prefix regime the mechanism serves."""
+    ecfg = dataclasses.replace(TIGHT, num_pages=9)  # 8 usable pages
+    warm_prompt = _prompt(5, 16)  # 2 full pages, indexed at completion
+    ref = InferenceEngine(params, CFG, ecfg)
+    want_victim = ref.run_to_completion(
+        [_req("victim", _prompt(0, 12), max_new=24)]
+    )["victim"]
+
+    engine = InferenceEngine(params, CFG, ecfg)
+    engine.run_to_completion([_req("warm", warm_prompt, max_new=8)])
+    engine.submit(_req("victim", _prompt(0, 12), max_new=24))
+    pre = engine.step()  # victim's 5 pages come off the free list;
+    # warm's prefix stays cached refcount-0, so the rival (needs 5, 2 of
+    # them cached) sees pages_needed - cached = 3 <= free_pages = 3 under
+    # the buggy probe, yet a real admission can deliver only 1 page once
+    # its own prefix increfs out of the evictable pool.
+    engine.submit(_req("rival", warm_prompt + _prompt(6, 1), max_new=16, priority=1))
+    tokens, finals = _drain(engine)
+    for ev in pre:
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    assert engine.stats["preemptions_total"] >= 1, (
+        "LRU-cached rival prefix suppressed the starvation fence"
+    )
+    assert engine.stats["resume_prefix_hits_total"] >= 1
+    assert tokens["victim"] == want_victim  # still token-exact across resume
+    assert len(tokens["rival"]) == 16
+    assert [e.finish_reason for e in finals["victim"]] == ["length"]
+    assert [e.finish_reason for e in finals["rival"]] == ["length"]
+
+
+def test_preempt_fence_is_per_head(params):
+    """The starvation fence counts ticks for the CURRENT queue head: a new
+    high-priority arrival does not inherit ticks aged by a previous
+    (cancelled or shed) head, so it cannot preempt earlier than
+    preempt_fence_ticks starved ticks of its own."""
+    ecfg = dataclasses.replace(TIGHT, preempt_fence_ticks=3)
+    engine = InferenceEngine(params, CFG, ecfg)
+    engine.submit(_req("victim", _prompt(0, 12), max_new=24))
+    early = list(engine.step())  # victim admits
+    engine.submit(_req("rivalA", _prompt(1, 12), max_new=8, priority=1))
+    early += engine.step()
+    early += engine.step()  # rivalA ages the fence 2 of its 3 ticks...
+    assert engine.stats["preemptions_total"] == 0
+    engine.request_cancel("rivalA")
+    early += engine.step()  # ...then leaves; the fence must not carry over
+    engine.submit(_req("rivalB", _prompt(2, 12), max_new=8, priority=1))
+    early += engine.step()  # rivalB's FIRST starved tick
+    assert engine.stats["preemptions_total"] == 0, (
+        "a fresh head inherited the previous head's starvation ticks"
+    )
+    tokens, finals = _drain(engine)  # with its own full fence it preempts
+    for ev in reversed(early):
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    assert engine.stats["preemptions_total"] >= 1
+    assert len(tokens["rivalB"]) == 8 and len(tokens["victim"]) == 24
+
+
+def test_preempt_storm_chaos_token_exact(params):
+    """Seeded engine.preempt_storm forces preemptions regardless of priority
+    or starvation; the run still produces exactly the storm-free outputs —
+    every request terminal once, nothing hung, pages all returned."""
+    reqs = lambda: [  # noqa: E731 — same six requests for both runs
+        _req(f"r{i}", _prompt(i, 12), max_new=8) for i in range(6)
+    ]
+    clean_eng = InferenceEngine(params, CFG, ECFG)
+    want = clean_eng.run_to_completion(reqs())
+
+    faults.install(
+        faults.FaultInjector(
+            seed=7, spec={"engine.preempt_storm": {"prob": 1.0, "times": 2}}
+        )
+    )
+    engine = InferenceEngine(params, CFG, ECFG)
+    for r in reqs():
+        engine.submit(r)
+    tokens, finals = _drain(engine)
+    assert engine.stats["preempt_storm_injected"] == 2
+    assert engine.stats["preemptions_total"] == 2
+    assert tokens == want
+    assert all(
+        [e.finish_reason for e in finals[f"r{i}"]] == ["length"] for i in range(6)
+    )
+    assert engine.allocator.free_pages == ECFG.num_pages - 1
+    assert not engine._deadline_at and not engine._req_hashes
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware shedding of pending work
+
+
+def test_pending_deadline_shed_exactly_once(params):
+    """A request whose deadline expires while still PENDING sheds from the
+    queue with exactly one terminal deadline_exceeded event — it never
+    occupied a slot, and the queue-time shed counter classifies it."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    for i in range(4):  # fill every slot with long decodes
+        engine.submit(_req(f"busy{i}", _prompt(i, 5), max_new=48))
+    pre = engine.step()  # admit the batch (emits each first token)
+    engine.submit(_req("shed", _prompt(9, 5), max_new=4, deadline_s=0.01))
+    time.sleep(0.03)  # expire while the slots are still busy
+    tokens, finals = _drain(engine)
+    for ev in pre:
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    assert "shed" not in tokens  # never produced a token
+    assert [e.finish_reason for e in finals["shed"]] == ["deadline_exceeded"]
+    assert finals["shed"][0].token == -1
+    assert engine.stats["shed_pending_deadline_total"] == 1
+    assert engine.stats["deadline_exceeded"] == 1
+    assert all(len(tokens[f"busy{i}"]) == 48 for i in range(4))
+    assert "shed" not in engine._deadline_at and "shed" not in engine._req_hashes
+
+
+def test_pending_cancel_drops_bookkeeping(params):
+    """A cancelled never-admitted request leaks nothing: its _req_hashes
+    probe memo and _deadline_at entry both drop on the pending cancel path
+    (ISSUE 6 satellite: the pending-queue deadline leak)."""
+    engine = InferenceEngine(params, CFG, TIGHT)
+    engine.submit(_req("big", _prompt(0, 12), max_new=24))
+    early = list(engine.step())  # big admits, pool nearly full
+    engine.submit(_req("starved", _prompt(1, 12), max_new=8, deadline_s=30.0))
+    for _ in range(3):  # admission scans probe the starved request's hashes
+        early += engine.step()
+    assert "starved" in engine._req_hashes  # the probe memo exists...
+    assert "starved" in engine._deadline_at
+    engine.request_cancel("starved")
+    early += engine.step()
+    assert "starved" not in engine._req_hashes  # ...and cancel drops it
+    assert "starved" not in engine._deadline_at
+    assert engine.stats["requests_cancelled"] >= 1
+    tokens, finals = _drain(engine)
+    for ev in reversed(early):
+        if ev.token >= 0:
+            tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+    assert "starved" not in finals  # request_cancel frees silently
+    assert len(tokens["big"]) == 24
+
+
+# ---------------------------------------------------------------------------
+# Gateway: propagation, pre-dispatch shed, SDK backoff
+
+
+@async_test
+async def test_priority_deadline_ride_dispatch_to_model_node():
+    """execute body priority/deadline_s reach the model node's generate
+    input; the forwarded deadline is the REMAINING budget, not the
+    original."""
+    async with CPHarness() as h:
+        agent = FakeAgent(
+            h.base_url, behavior_map={"generate": "echo"},
+            extra_reasoners=("generate",),
+        )
+        await agent.start()
+        try:
+            async with h.http.post(
+                "/api/v1/nodes",
+                json={
+                    "node_id": "mnode",
+                    "base_url": agent.base_url,
+                    "kind": "model",
+                    "reasoners": [{"id": "generate"}],
+                },
+            ) as r:
+                assert r.status == 201, await r.text()
+            async with h.http.post(
+                "/api/v1/execute/mnode.generate",
+                json={
+                    "input": {"tokens": [1, 2, 3]},
+                    "priority": 2,
+                    "deadline_s": 30.0,
+                },
+            ) as r:
+                assert r.status == 200, await r.text()
+                doc = await r.json()
+            assert doc["status"] == "completed"
+            assert doc["priority"] == 2 and doc["deadline_s"] == 30.0
+            sent = agent.calls[-1]["body"]["input"]
+            assert sent["priority"] == 2
+            assert 0 < sent["deadline_s"] <= 30.0
+            # explicit caller keys win over execute-level propagation
+            async with h.http.post(
+                "/api/v1/execute/mnode.generate",
+                json={
+                    "input": {"tokens": [1], "priority": 7},
+                    "priority": 2,
+                },
+            ) as r:
+                assert r.status == 200
+            assert agent.calls[-1]["body"]["input"]["priority"] == 7
+        finally:
+            await agent.stop()
+
+
+@async_test
+async def test_execute_priority_deadline_validation():
+    async with CPHarness() as h:
+        await h.register_agent()
+        for body in (
+            {"input": 1, "priority": "high"},
+            {"input": 1, "priority": True},
+            {"input": 1, "deadline_s": -2},
+            {"input": 1, "deadline_s": "soon"},
+            # NaN is comparison-inert (silently "no deadline") and breaks
+            # strict JSON consumers of the stored doc; Infinity likewise lies
+            {"input": 1, "deadline_s": float("nan")},
+            {"input": 1, "deadline_s": float("inf")},
+        ):
+            async with h.http.post(
+                "/api/v1/execute/fake-agent.echo", json=body
+            ) as r:
+                assert r.status == 400, (body, await r.text())
+
+
+@async_test
+async def test_async_deadline_shed_before_dispatch():
+    """Queued async work whose deadline passes before a worker picks it up
+    is shed terminally (TIMEOUT) without burning an agent call, and the
+    gateway-side shed counter exports."""
+    async with CPHarness(async_workers=1) as h:
+        h.agent.slow_s = 0.5
+        await h.register_agent()
+        async with h.http.post(
+            "/api/v1/execute/async/fake-agent.slow", json={"input": "hog"}
+        ) as r:
+            assert r.status == 202
+        async with h.http.post(
+            "/api/v1/execute/async/fake-agent.echo",
+            json={"input": "doomed", "deadline_s": 0.05},
+        ) as r:
+            assert r.status == 202
+            eid = (await r.json())["execution_id"]
+        doc = None
+        for _ in range(100):
+            async with h.http.get(f"/api/v1/executions/{eid}") as r:
+                doc = await r.json()
+            if doc["status"] not in ("queued", "running"):
+                break
+            await asyncio.sleep(0.05)
+        assert doc["status"] == "timeout", doc
+        assert "shed" in (doc["error"] or "")
+        assert not [c for c in h.agent.calls if c["body"].get("input") == "doomed"]
+        async with h.http.get("/metrics") as r:
+            text = await r.text()
+        assert "agentfield_gateway_shed_total" in text
+
+
+@async_test
+async def test_dead_letter_requeue_rebases_deadline():
+    """Operator requeue grants a fresh deadline window, not just a fresh
+    retry budget: deadline_s counts from created_at, so a requeue minutes
+    after the original window lapsed must NOT be shed on arrival by the
+    worker's pre-dispatch deadline check."""
+    async with CPHarness(async_workers=1) as h:
+        await h.register_agent("a")
+        await h.agent.stop()  # node down: every attempt is a transport error
+        async with h.http.post(
+            "/api/v1/execute/a.echo",
+            json={
+                "input": 7,
+                "deadline_s": 0.5,
+                "retry_policy": {
+                    "max_attempts": 2, "base_backoff": 0.01, "max_backoff": 0.02,
+                },
+            },
+        ) as r:
+            doc = await r.json()
+        assert doc["status"] == "dead_letter", doc
+        eid = doc["execution_id"]
+        await asyncio.sleep(0.6)  # the original deadline window lapses
+        await h.agent.start()
+        async with h.http.post(f"/api/v1/dead-letter/{eid}/requeue") as r2:
+            assert r2.status == 202, await r2.text()
+        cur = None
+        for _ in range(200):
+            async with h.http.get(f"/api/v1/executions/{eid}") as r3:
+                cur = await r3.json()
+            if cur["status"] not in ("queued", "running"):
+                break
+            await asyncio.sleep(0.02)
+        assert cur["status"] == "completed", cur  # not shed as timeout
+        assert cur["result"] == {"echo": 7}
+        # the grant re-bases created_at, NOT deadline_s: repeated requeues
+        # always hand out exactly the original window, never a compounded one
+        assert cur["deadline_s"] == 0.5
+        # and the SQL created_at COLUMN follows the doc (listing order,
+        # duration stats, and retention GC all read the column)
+        row = h.cp.storage._conn.execute(
+            "SELECT created_at FROM executions WHERE execution_id=?", (eid,)
+        ).fetchone()
+        assert row["created_at"] == cur["created_at"]
+
+
+def test_sdk_backpressure_delay_caps_and_jitter():
+    """The SDK honors a server Retry-After hint (jittered UPWARD only, so a
+    herd that got the same hint spreads out) and caps both the hint and its
+    own exponential schedule."""
+    from agentfield_tpu.sdk.agent import (
+        _BACKOFF_CAP_S,
+        _RETRY_AFTER_CAP_S,
+        _backpressure_delay,
+    )
+
+    for _ in range(50):
+        d = _backpressure_delay(1, retry_after=3.0)
+        assert 3.0 <= d <= 3.0 * 1.25
+        # the cap is the true max sleep, jitter included
+        assert _backpressure_delay(1, retry_after=9999.0) == _RETRY_AFTER_CAP_S
+        d = _backpressure_delay(12)  # no hint: capped exponential
+        assert _BACKOFF_CAP_S / 2 <= d <= _BACKOFF_CAP_S
+        assert _backpressure_delay(0) <= _BACKOFF_CAP_S
+        # "Retry-After: 0" (RFC-legal) must not become a zero-sleep hot
+        # loop: a non-positive hint falls through to the exponential
+        assert _backpressure_delay(1, retry_after=0.0) >= 0.2
+        assert _backpressure_delay(1, retry_after=-1.0) >= 0.2

@@ -237,6 +237,30 @@ def test_prefix_pool_refcount_publish_lookup_evict():
         pool.free([0])  # reserved page
 
 
+def test_prefix_pool_evictable_prefix_pages():
+    """evictable_prefix_pages counts the LRU-resident (refcount-0) pages of
+    a prompt's cached prefix — the overlap a capacity probe must subtract
+    from free_pages, because an admission lookup() increfs exactly those
+    pages out of the evictable pool."""
+    from agentfield_tpu.serving.kv_cache import PrefixPagePool
+
+    pool = PrefixPagePool(10, page_size=4)
+    pages = pool.alloc(2)
+    toks = list(range(8))
+    pool.publish(toks, pages)
+    # the holder still references both pages: nothing is LRU-resident
+    assert pool.evictable_prefix_pages(toks) == 0
+    pool.free(pages)  # refcount-0 cached: both land on the LRU
+    assert pool.evictable_prefix_pages(toks) == 2
+    assert pool.evictable_prefix_pages(toks[:7]) == 1  # full pages only
+    assert pool.evictable_prefix_pages([42, 43, 44, 45]) == 0  # no match
+    # a new holder increfs page 1 back out of the LRU
+    got, _ = pool.lookup(toks[:4])
+    assert pool.evictable_prefix_pages(toks) == 1
+    pool.free(got)
+    assert pool.evictable_prefix_pages(toks) == 2
+
+
 def test_cross_request_prefix_reuse_is_logit_exact(params):
     """A second, sessionless request sharing a multi-page prefix reuses the
     first request's pages (suffix-only prefill) and emits exactly the tokens

@@ -1,0 +1,959 @@
+"""Streaming data plane: persistent multiplexed gateway↔node channels.
+
+PR 4's dispatch fast path left the per-request agent hop as the dominant
+cost (docs/PERFORMANCE.md: the ``with_agent_hop`` variant was "a wash — the
+hop dominates"). This module replaces the per-execution HTTP POST with ONE
+long-lived WebSocket per (gateway, node) pair carrying framed messages, and
+streams tokens end-to-end — engine ``TokenEvent`` → model node → channel
+frame → gateway stream registry → client SSE — so a sync caller's first
+byte arrives at time-to-first-token instead of full-completion latency
+(ROADMAP item 5; "Software-Defined Agentic Serving" treats the transport as
+a first-class serving concern, PAPERS.md).
+
+Frame protocol (JSON text frames; ``seq`` is per-execution, assigned by the
+node, monotonically increasing over token+terminal frames so a reattach can
+resume exactly where the last connection died):
+
+==================  ======  =====================================================
+kind                dir     meaning
+==================  ======  =====================================================
+``submit``          gw→nd   start an execution: target component, input, headers,
+                            stream flag
+``accepted``        nd→gw   submit received; the node owns the execution now
+                            (the channel's 202-equivalent)
+``token``           nd→gw   one streamed token event (``seq``, ``data``)
+``terminal``        nd→gw   exactly-one final frame: status completed|failed,
+                            result/error (``seq``)
+``cancel``          gw→nd   stop the execution (deadline/timeout/abandoned
+                            caller); propagates to the engine's cancel path
+``reattach``        gw→nd   after a channel drop: re-bind ``exec_id`` on a new
+                            connection; the node replays frames > ``last_seq``
+``reattach_ok``     nd→gw   exec known; replay (if any) precedes this binding
+``reattach_fail``   nd→gw   exec unknown (node restarted / replay TTL expired)
+``fin``             gw→nd   terminal processed durably; the node may drop the
+                            execution's replay buffer
+``ping``/``pong``   both    app-level liveness probe (aiohttp's WS heartbeat
+                            owns transport liveness; this is for diagnostics)
+==================  ======  =====================================================
+
+Failure semantics (docs/FAULT_TOLERANCE.md mid-stream table): a submit that
+was never ``accepted`` is retried/failed-over by the gateway dispatch loop
+exactly like a failed POST (zero frames exist, replay is safe). Once frames
+have been published to the client-visible stream, a lost channel may only
+REATTACH (by exec_id + last acked seq) — if reattach fails the execution
+dead-letters with the frame count recorded, never replays (replay would
+duplicate tokens a client already consumed). Exactly one terminal frame
+reaches the stream per execution.
+
+Fallback: a node that does not advertise ``metadata.channel`` (or a gateway
+with ``AGENTFIELD_CHANNEL=0``) uses the per-execution POST path unchanged —
+channel off is bit-compatible with the pre-channel gateway, pinned by test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import os
+import random
+import time
+from typing import Any, Awaitable, Callable
+
+import aiohttp
+from aiohttp import web
+
+from agentfield_tpu._compat import aio_timeout
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.logging import get_logger
+
+log = get_logger("channel")
+
+CHANNEL_PATH = "/channel"
+
+
+class ChannelUnavailable(Exception):
+    """The channel could not carry this submit (connect/handshake/send
+    failure). Zero frames exist, so the caller falls back to the POST path
+    for this call — behavior identical to a channel-less node."""
+
+
+# ---------------------------------------------------------------------------
+# Gateway-side: per-execution stream registry (frames the CLIENT can see)
+
+
+class StreamSubscription:
+    """One consumer of an execution's frame stream: the replay snapshot it
+    attached with, then live frames. ``get()`` pops replay first so a late
+    subscriber sees every frame exactly once, in order."""
+
+    def __init__(self, entry: "_StreamEntry", replay: list[dict]):
+        self._entry = entry
+        self._replay = collections.deque(replay)
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=8192)
+        self.dropped = False
+
+    async def get(self) -> dict | None:
+        """Next frame; None means this subscriber lagged and was dropped
+        (the stream itself continues for other consumers)."""
+        if self._replay:
+            return self._replay.popleft()
+        return await self.q.get()
+
+    def close(self) -> None:
+        self._entry.subs.discard(self.q)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> dict:
+        frame = await self.get()
+        if frame is None:
+            raise StopAsyncIteration
+        if frame.get("kind") == "terminal":
+            self.close()
+            # the terminal frame itself is still yielded; the NEXT pull ends
+            self._replay.append(None)  # type: ignore[arg-type]
+        return frame
+
+
+class _StreamEntry:
+    __slots__ = ("frames", "tokens", "done", "done_at", "subs")
+
+    def __init__(self):
+        self.frames: list[dict] = []  # token frames + (eventually) terminal
+        self.tokens = 0  # token frames published — the "client saw N" count
+        self.done = False
+        self.done_at = 0.0
+        self.subs: set[asyncio.Queue] = set()
+
+
+class ExecutionStreams:
+    """Per-execution frame buffer + subscriber fanout on the gateway.
+
+    Every token frame received over a node channel is published here the
+    moment it arrives — buffered for late subscribers (``GET
+    /api/v1/executions/{id}/stream`` replays from frame 0) and fanned out to
+    live SSE consumers. The buffer IS the client-visible record: once
+    ``tokens_published`` is non-zero the execution may never be replayed
+    (docs/FAULT_TOLERANCE.md mid-stream semantics).
+
+    Entries retire ``retain_s`` after their terminal frame (late subscribers
+    within the window still get the full replay + terminal); the lazy purge
+    runs on every mutation so no background task is needed.
+    """
+
+    def __init__(self, retain_s: float = 60.0, max_entries: int = 4096):
+        self.retain_s = retain_s
+        self.max_entries = max_entries
+        self._entries: dict[str, _StreamEntry] = {}
+        self._done_order: collections.OrderedDict[str, float] = collections.OrderedDict()
+
+    def _purge(self) -> None:
+        cutoff = time.monotonic() - self.retain_s
+        while self._done_order:
+            eid, t = next(iter(self._done_order.items()))
+            if t > cutoff and len(self._entries) <= self.max_entries:
+                break
+            self._done_order.pop(eid, None)
+            self._entries.pop(eid, None)
+
+    def ensure(self, execution_id: str) -> None:
+        """Open an execution's stream entry without subscribing (async
+        executions submitted with ``stream: true`` — frames buffer for a
+        later ``GET /executions/{id}/stream`` attach)."""
+        if execution_id not in self._entries:
+            self._entries[execution_id] = _StreamEntry()
+            self._purge()
+
+    def wants(self, execution_id: str) -> bool:
+        """Should the node emit token frames for this execution? True when
+        a stream entry is open (a streaming caller or async ``stream:
+        true`` asked). Plain sync/async traffic skips per-token framing
+        entirely — the channel then carries submit + terminal only."""
+        entry = self._entries.get(execution_id)
+        return entry is not None and not entry.done
+
+    def attach(self, execution_id: str) -> StreamSubscription:
+        """Subscribe to an execution's stream, creating the entry if the
+        execution is still live (so frames/terminal land somewhere). The
+        replay snapshot + live-queue registration is atomic on the event
+        loop: no frame can fall between them."""
+        self._purge()
+        entry = self._entries.get(execution_id)
+        if entry is None:
+            entry = self._entries[execution_id] = _StreamEntry()
+        sub = StreamSubscription(entry, list(entry.frames))
+        if not entry.done:
+            entry.subs.add(sub.q)
+        return sub
+
+    def publish(self, execution_id: str, frame: dict) -> None:
+        """One token frame from the node channel → buffer + live fanout."""
+        entry = self._entries.get(execution_id)
+        if entry is None:
+            entry = self._entries[execution_id] = _StreamEntry()
+            self._purge()
+        if entry.done:
+            return  # late frame after terminal: exactly-one-terminal holds
+        entry.frames.append(frame)
+        if frame.get("kind") == "token":
+            entry.tokens += 1
+        self._fanout(entry, frame)
+
+    def _fanout(self, entry: _StreamEntry, frame: dict) -> None:
+        for q in list(entry.subs):
+            try:
+                q.put_nowait(frame)
+            except asyncio.QueueFull:
+                # This consumer is hopelessly behind — drop IT, not the
+                # stream. The sentinel lets its handler close with an
+                # explicit "dropped" signal instead of a silent stall.
+                entry.subs.discard(q)
+                try:
+                    q.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass  # afcheck: ignore[except-swallow] queue is full of frames the dead consumer will never read
+
+    def finish(self, ex) -> None:
+        """Publish the exactly-one terminal frame for a terminal execution
+        (idempotent; no-op when nothing ever subscribed/streamed and nothing
+        is watching)."""
+        entry = self._entries.get(ex.execution_id)
+        if entry is None:
+            return
+        if entry.done:
+            return
+        entry.done = True
+        entry.done_at = time.monotonic()
+        self._done_order[ex.execution_id] = entry.done_at
+        result = ex.result
+        frame = {
+            "kind": "terminal",
+            "execution_id": ex.execution_id,
+            "status": ex.status.value,
+            "error": ex.error,
+            "result": result,
+            "frames_delivered": entry.tokens,
+        }
+        if isinstance(result, dict) and result.get("finish_reason"):
+            frame["finish_reason"] = result["finish_reason"]
+        entry.frames.append(frame)
+        self._fanout(entry, frame)
+        entry.subs.clear()
+        self._purge()
+
+    def tokens_published(self, execution_id: str) -> int:
+        entry = self._entries.get(execution_id)
+        return entry.tokens if entry is not None else 0
+
+    def discard(self, execution_id: str) -> None:
+        """Drop an execution's stream entry (operator dead-letter requeue:
+        the NEW incarnation must stream from frame 0 into a fresh buffer,
+        and the old terminal frame must not gag it)."""
+        self._entries.pop(execution_id, None)
+        self._done_order.pop(execution_id, None)
+
+    @staticmethod
+    def terminal_frame(doc: dict) -> dict:
+        """Synthesize the terminal frame for an execution that went terminal
+        before (or without) any stream entry — GET /stream on old rows."""
+        result = doc.get("result")
+        frame = {
+            "kind": "terminal",
+            "execution_id": doc["execution_id"],
+            "status": doc["status"],
+            "error": doc.get("error"),
+            "result": result,
+            "frames_delivered": doc.get("frames_delivered", 0),
+        }
+        if isinstance(result, dict) and result.get("finish_reason"):
+            frame["finish_reason"] = result["finish_reason"]
+        return frame
+
+
+# ---------------------------------------------------------------------------
+# Node-side: the channel server (one WS route on every channel-enabled node)
+
+
+class _ServerExec:
+    __slots__ = ("exec_id", "seq", "frames", "done", "done_at", "task", "conn", "lock")
+
+    def __init__(self, exec_id: str):
+        self.exec_id = exec_id
+        self.seq = 0
+        self.frames: list[dict] = []  # replay buffer (token + terminal)
+        self.done = False
+        self.done_at = 0.0
+        self.task: asyncio.Task | None = None
+        self.conn: "_ServerConn | None" = None
+        # Serializes emission vs reattach-replay so a frame emitted during a
+        # replay cannot reach the new connection before older frames do.
+        self.lock = asyncio.Lock()
+
+
+class _ServerConn:
+    __slots__ = ("ws", "lock")
+
+    def __init__(self, ws: web.WebSocketResponse):
+        self.ws = ws
+        self.lock = asyncio.Lock()  # aiohttp WS writes are not re-entrant
+
+    async def send(self, frame: dict) -> bool:
+        try:
+            async with self.lock:
+                await self.ws.send_str(json.dumps(frame))
+            return True
+        except (ConnectionError, RuntimeError, asyncio.CancelledError):
+            return False
+
+
+# invoke(component_id, payload, headers) -> result
+InvokeFn = Callable[[str, Any, dict[str, str]], Awaitable[Any]]
+# stream handler(payload, headers, emit) -> result; emit(data_dict) is an
+# async callable pushing one token frame
+StreamFn = Callable[..., Awaitable[Any]]
+
+
+class ChannelServer:
+    """Node-side endpoint of the persistent channel (``GET /channel``).
+
+    Executions survive connection loss: a running task keeps generating and
+    BUFFERING frames while unbound; a ``reattach`` from the gateway's next
+    connection replays everything past ``last_seq`` and re-binds the sink —
+    zero token loss, zero duplication (the gateway dedups by seq). Replay
+    buffers for finished executions retire after ``replay_ttl_s`` or on an
+    explicit ``fin``.
+    """
+
+    def __init__(
+        self,
+        invoke: InvokeFn,
+        stream_handlers: dict[str, StreamFn] | None = None,
+        heartbeat_s: float = 15.0,
+        replay_ttl_s: float = 120.0,
+    ):
+        self.invoke = invoke
+        self.stream_handlers = dict(stream_handlers or {})
+        self.heartbeat_s = heartbeat_s
+        self.replay_ttl_s = replay_ttl_s
+        self._execs: dict[str, _ServerExec] = {}
+        self._conns: set[_ServerConn] = set()
+        self.stats = {
+            "channel_server_connections_total": 0,
+            "channel_server_submits_total": 0,
+            "channel_server_frames_total": 0,
+            "channel_server_reattaches_total": 0,
+            "channel_server_cancels_total": 0,
+        }
+
+    def stream_handler(self, component_id: str, fn: StreamFn) -> None:
+        """Register a token-streaming handler for one component (the model
+        node registers ``generate``); everything else goes through
+        ``invoke`` and produces only a terminal frame."""
+        self.stream_handlers[component_id] = fn
+
+    def _purge(self) -> None:
+        cutoff = time.monotonic() - self.replay_ttl_s
+        stale = [
+            eid for eid, st in self._execs.items() if st.done and st.done_at < cutoff
+        ]
+        for eid in stale:
+            self._execs.pop(eid, None)
+
+    async def close(self) -> None:
+        """Node shutdown: cancel running executions (their terminal frames
+        go to the buffer; the gateway's side sees the connection drop) and
+        close every live socket — an open channel would otherwise hold the
+        aiohttp runner's graceful shutdown for its full timeout."""
+        for st in list(self._execs.values()):
+            if st.task is not None and not st.task.done():
+                st.task.cancel()
+        tasks = [st.task for st in self._execs.values() if st.task is not None]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        for conn in list(self._conns):
+            try:
+                await conn.ws.close()
+            except (ConnectionError, RuntimeError) as e:
+                log.debug("channel close failed during shutdown", error=repr(e))
+
+    async def handler(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(heartbeat=self.heartbeat_s)
+        await ws.prepare(request)
+        conn = _ServerConn(ws)
+        self._conns.add(conn)
+        self.stats["channel_server_connections_total"] += 1
+        try:
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                try:
+                    frame = json.loads(msg.data)
+                    if not isinstance(frame, dict):
+                        raise ValueError("frame must be an object")
+                except ValueError as e:
+                    log.warning("malformed channel frame", error=repr(e))
+                    continue
+                await self._handle(conn, frame)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # afcheck: ignore[except-swallow] peer gone / shutdown: running execs keep buffering for reattach
+        finally:
+            # Connection gone: unbind sinks, keep executions running — the
+            # gateway reconnects and reattaches; frames buffer meanwhile.
+            self._conns.discard(conn)
+            for st in self._execs.values():
+                if st.conn is conn:
+                    st.conn = None
+        return ws
+
+    async def _handle(self, conn: _ServerConn, frame: dict) -> None:
+        kind = frame.get("kind")
+        eid = frame.get("exec_id", "")
+        if kind == "submit":
+            await self._submit(conn, eid, frame)
+        elif kind == "cancel":
+            st = self._execs.get(eid)
+            self.stats["channel_server_cancels_total"] += 1
+            if st is not None and not st.done and st.task is not None:
+                st.task.cancel()
+        elif kind == "reattach":
+            await self._reattach(conn, eid, int(frame.get("last_seq", 0)))
+        elif kind == "fin":
+            st = self._execs.get(eid)
+            if st is not None and st.done:
+                self._execs.pop(eid, None)
+        elif kind == "ping":
+            await conn.send({"kind": "pong"})
+
+    async def _submit(self, conn: _ServerConn, eid: str, frame: dict) -> None:
+        self.stats["channel_server_submits_total"] += 1
+        self._purge()
+        st = self._execs.get(eid)
+        if st is not None:
+            # Duplicate submit — the gateway retried/requeued an execution
+            # this node already owns (e.g. a drop the recovery path resolved
+            # by re-dispatch). Idempotent: re-bind and replay from 0; never
+            # run the work twice.
+            await conn.send({"kind": "accepted", "exec_id": eid})
+            await self._replay(conn, st, last_seq=0)
+            return
+        st = _ServerExec(eid)
+        st.conn = conn
+        self._execs[eid] = st
+        await conn.send({"kind": "accepted", "exec_id": eid})
+        st.task = asyncio.create_task(self._run(st, frame))
+
+    async def _reattach(self, conn: _ServerConn, eid: str, last_seq: int) -> None:
+        st = self._execs.get(eid)
+        if st is None:
+            await conn.send(
+                {
+                    "kind": "reattach_fail",
+                    "exec_id": eid,
+                    "error": "unknown execution (restart or replay TTL expired)",
+                }
+            )
+            return
+        self.stats["channel_server_reattaches_total"] += 1
+        await conn.send({"kind": "reattach_ok", "exec_id": eid, "from_seq": last_seq})
+        await self._replay(conn, st, last_seq)
+
+    async def _replay(self, conn: _ServerConn, st: _ServerExec, last_seq: int) -> None:
+        # Under the exec lock: frames emitted DURING the replay wait, then
+        # send directly to the re-bound conn — order preserved end to end.
+        async with st.lock:
+            for f in st.frames:
+                if f["seq"] > last_seq:
+                    await conn.send(f)
+            st.conn = conn
+
+    async def _emit(self, st: _ServerExec, frame: dict) -> None:
+        async with st.lock:
+            st.seq += 1
+            frame["seq"] = st.seq
+            st.frames.append(frame)
+            self.stats["channel_server_frames_total"] += 1
+            if st.conn is not None:
+                ok = await st.conn.send(frame)
+                if not ok:
+                    st.conn = None  # buffer until reattach
+
+    async def _run(self, st: _ServerExec, frame: dict) -> None:
+        target = frame.get("target", "")
+        payload = frame.get("input")
+        headers = frame.get("headers") or {}
+        try:
+            sh = self.stream_handlers.get(target)
+            if sh is not None and frame.get("stream", True):
+
+                async def emit(data: dict) -> None:
+                    await self._emit(
+                        st, {"kind": "token", "exec_id": st.exec_id, "data": data}
+                    )
+
+                result = await sh(payload, headers, emit)
+            else:
+                result = await self.invoke(target, payload, headers)
+            json.dumps(result)  # fail fast: an unserializable result must be
+            # a failed execution, not a dead channel write
+            term = {
+                "kind": "terminal",
+                "exec_id": st.exec_id,
+                "status": "completed",
+                "result": result,
+            }
+        except asyncio.CancelledError:
+            term = {
+                "kind": "terminal",
+                "exec_id": st.exec_id,
+                "status": "failed",
+                "error": "cancelled by gateway",
+            }
+        except Exception as e:
+            term = {
+                "kind": "terminal",
+                "exec_id": st.exec_id,
+                "status": "failed",
+                "error": repr(e),
+            }
+        st.done = True
+        st.done_at = time.monotonic()
+        await self._emit(st, term)
+
+
+# ---------------------------------------------------------------------------
+# Gateway-side: one NodeChannel per node, owned by the ChannelManager
+
+
+class _Call:
+    __slots__ = (
+        "exec_id",
+        "submit_frame",
+        "accept_fut",
+        "last_seq",
+        "frames",
+        "reattach_pending",
+    )
+
+    def __init__(self, exec_id: str, submit_frame: dict):
+        self.exec_id = exec_id
+        self.submit_frame = submit_frame
+        self.accept_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.last_seq = 0
+        self.frames = 0  # token frames received (== published to the stream)
+        self.reattach_pending = False
+
+
+class NodeChannel:
+    """One persistent WS to one node, multiplexing every in-flight execution
+    dispatched to it. Opened lazily on first submit; a drop with live calls
+    triggers reconnect-with-backoff + per-execution reattach."""
+
+    def __init__(self, mgr: "ChannelManager", node_id: str, base_url: str):
+        self.mgr = mgr
+        self.node_id = node_id
+        self.base_url = base_url.rstrip("/")
+        self._ws: aiohttp.ClientWebSocketResponse | None = None
+        self._recv_task: asyncio.Task | None = None
+        self._conn_lock = asyncio.Lock()
+        self._send_lock = asyncio.Lock()
+        self._calls: dict[str, _Call] = {}
+        self._recovering = False
+        self._bg: set[asyncio.Task] = set()
+
+    # -- connection ----------------------------------------------------
+
+    async def _ensure_connected(self) -> None:
+        async with self._conn_lock:
+            if self._ws is not None and not self._ws.closed:
+                return
+            await self._connect_locked()
+
+    async def _connect_locked(self) -> None:  # guarded by: _conn_lock
+        ws = await self.mgr.session.ws_connect(
+            self.base_url + CHANNEL_PATH, heartbeat=self.mgr.heartbeat_s
+        )
+        self._ws = ws
+        self.mgr.metrics.inc("channel_opens_total")
+        self._recv_task = asyncio.create_task(self._recv_loop(ws))
+
+    async def _send(self, frame: dict) -> None:
+        await self._ensure_connected()
+        ws = self._ws
+        assert ws is not None
+        async with self._send_lock:
+            await ws.send_str(json.dumps(frame))
+        self.mgr.metrics.inc("channel_frames_tx_total")
+
+    async def close(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            await asyncio.gather(self._recv_task, return_exceptions=True)
+        if self._ws is not None and not self._ws.closed:
+            await self._ws.close()
+        for t in list(self._bg):
+            t.cancel()
+        if self._bg:
+            await asyncio.gather(*self._bg, return_exceptions=True)
+
+    def _task(self, coro) -> None:
+        t = asyncio.ensure_future(coro)
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    # -- submit --------------------------------------------------------
+
+    async def submit(self, execution_id: str, frame: dict) -> tuple[str, Any]:
+        """Send one submit frame; wait for the node's ``accepted`` ack.
+        Returns ``("deferred", None)`` — from here on the terminal frame
+        (routed through the manager to ``gateway.complete``) owns the
+        execution's completion, exactly like a 202 status callback.
+        Raises ChannelUnavailable when the channel cannot carry the submit
+        at all (caller falls back to the POST path)."""
+        call = _Call(execution_id, frame)
+        old = self._calls.get(execution_id)
+        if old is not None:
+            # Defensive: a resubmit racing a still-live call inherits its
+            # seq watermark so a node-side replay (duplicate submits replay
+            # from 0) can never republish frames the client already saw.
+            call.last_seq = old.last_seq
+            call.frames = old.frames
+        self._calls[execution_id] = call
+        self.mgr.index(execution_id, self)
+        try:
+            await self._send(frame)
+        except (aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            self._drop_call(execution_id)
+            raise ChannelUnavailable(f"channel to {self.node_id}: {e!r}") from e
+        try:
+            async with aio_timeout(self.mgr.accept_timeout_s):
+                await call.accept_fut
+            self.mgr.metrics.inc("channel_submits_total")
+            return ("deferred", None)
+        except TimeoutError:
+            self._drop_call(execution_id)
+            return (
+                "node_error",
+                f"agent call failed: channel submit to {self.node_id} not "
+                f"acknowledged within {self.mgr.accept_timeout_s}s",
+            )
+        except ChannelUnavailable as e:  # recovery failed mid-accept-wait
+            self._drop_call(execution_id)
+            return ("node_error", f"agent call failed: {e}")
+
+    def _drop_call(self, execution_id: str) -> _Call | None:
+        self.mgr.unindex(execution_id)
+        return self._calls.pop(execution_id, None)
+
+    async def cancel(self, execution_id: str) -> None:
+        """Best-effort cancel: drop the call (its terminal, if any, is
+        ignored — the gateway already drove its own) and tell the node to
+        stop burning compute on it."""
+        call = self._drop_call(execution_id)
+        if call is not None and not call.accept_fut.done():
+            call.accept_fut.set_exception(
+                ChannelUnavailable("cancelled while awaiting accept")
+            )
+            call.accept_fut.exception()  # consumed: never an unretrieved warning
+        try:
+            await self._send({"kind": "cancel", "exec_id": execution_id})
+        except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            log.debug(
+                "channel cancel not delivered",
+                node_id=self.node_id, execution_id=execution_id, error=repr(e),
+            )
+
+    # -- receive / recovery --------------------------------------------
+
+    async def _recv_loop(self, ws: aiohttp.ClientWebSocketResponse) -> None:
+        try:
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                f = faults.fire("channel.drop")
+                if f is not None:
+                    # Injected mid-stream channel kill (chaos tests): close
+                    # the socket abruptly and let recovery reattach.
+                    log.warning("injected channel drop", node_id=self.node_id)
+                    break
+                try:
+                    frame = json.loads(msg.data)
+                    if not isinstance(frame, dict):
+                        raise ValueError("frame must be an object")
+                except ValueError as e:
+                    log.warning("malformed channel frame", node_id=self.node_id, error=repr(e))
+                    continue
+                self.mgr.metrics.inc("channel_frames_rx_total")
+                await self._handle_frame(frame)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("channel receive loop failed", node_id=self.node_id, error=repr(e))
+        finally:
+            if self._ws is ws:
+                self._ws = None
+            self._task(ws.close())
+            if self._calls and not self._recovering:
+                self._task(self._recover())
+
+    async def _handle_frame(self, frame: dict) -> None:
+        kind = frame.get("kind")
+        eid = frame.get("exec_id", "")
+        call = self._calls.get(eid)
+        if kind == "accepted":
+            if call is not None and not call.accept_fut.done():
+                call.accept_fut.set_result(True)
+        elif kind == "token":
+            if call is None:
+                return  # cancelled/unknown: discard
+            if not call.accept_fut.done():
+                # A token from the node IS the ack (the `accepted` frame was
+                # lost to a drop): without this, the accept-wait would time
+                # out and retry an execution whose frames are already
+                # client-visible — exactly the duplication the seq protocol
+                # forbids.
+                call.accept_fut.set_result(True)
+            seq = int(frame.get("seq", 0))
+            if seq <= call.last_seq:
+                return  # reattach-replay overlap: dedup by seq
+            call.last_seq = seq
+            call.frames += 1
+            data = frame.get("data") or {}
+            self.mgr.publish_cb(
+                eid, {"kind": "token", "execution_id": eid, "seq": seq, **data}
+            )
+        elif kind == "terminal":
+            if call is None:
+                return
+            if not call.accept_fut.done():
+                call.accept_fut.set_result(True)  # node owns it: that's the ack
+            seq = int(frame.get("seq", 0))
+            if seq <= call.last_seq:
+                return
+            self._drop_call(eid)
+            self._task(self._send({"kind": "fin", "exec_id": eid}))
+            await self.mgr.terminal_cb(eid, frame)
+        elif kind == "reattach_ok":
+            if call is not None:
+                call.reattach_pending = False
+                if not call.accept_fut.done():
+                    # The node owning the exec on the new connection doubles
+                    # as the submit ack (the original `accepted` died with
+                    # the old socket).
+                    call.accept_fut.set_result(True)
+                self.mgr.metrics.inc("channel_reattaches_total")
+        elif kind == "reattach_fail":
+            if call is not None:
+                await self._lose_call(
+                    call, f"reattach refused: {frame.get('error')}"
+                )
+        elif kind == "pong":
+            pass
+
+    async def _lose_call(self, call: _Call, error: str) -> None:
+        self._drop_call(call.exec_id)
+        if not call.accept_fut.done():
+            # submit() is still waiting: surface through its own path
+            call.accept_fut.set_exception(ChannelUnavailable(error))
+            return
+        await self.mgr.lost_cb(call.exec_id, self.node_id, call.frames, error)
+
+    async def _recover(self) -> None:
+        """The channel dropped with live executions on it: reconnect with
+        jittered backoff and reattach every call by (exec_id, last_seq).
+        Exhaustion loses the calls — the manager's lost callback then applies
+        the frames-delivered rule (requeue at zero, dead-letter otherwise)."""
+        self._recovering = True
+        try:
+            for attempt in range(self.mgr.reattach_attempts):
+                if not self._calls:
+                    return
+                await asyncio.sleep(
+                    self.mgr.reattach_backoff_s
+                    * (2**attempt)
+                    * (0.5 + 0.5 * random.random())
+                )
+                try:
+                    async with self._conn_lock:
+                        if self._ws is None or self._ws.closed:
+                            await self._connect_locked()
+                except (aiohttp.ClientError, ConnectionError, OSError) as e:
+                    log.warning(
+                        "channel reconnect failed",
+                        node_id=self.node_id, attempt=attempt + 1, error=repr(e),
+                    )
+                    continue
+                self.mgr.metrics.inc("channel_reconnects_total")
+                pend = list(self._calls.values())
+                try:
+                    for c in pend:
+                        c.reattach_pending = True
+                        await self._send(
+                            {
+                                "kind": "reattach",
+                                "exec_id": c.exec_id,
+                                "last_seq": c.last_seq,
+                            }
+                        )
+                except (aiohttp.ClientError, ConnectionError, OSError, RuntimeError):
+                    continue  # connection died again: next attempt
+                deadline = time.monotonic() + self.mgr.reattach_ack_timeout_s
+                while time.monotonic() < deadline and any(
+                    c.reattach_pending for c in pend if c.exec_id in self._calls
+                ):
+                    await asyncio.sleep(0.02)
+                for c in pend:
+                    if c.exec_id in self._calls and c.reattach_pending:
+                        await self._lose_call(c, "reattach unacknowledged")
+                return
+            for c in list(self._calls.values()):
+                await self._lose_call(
+                    c,
+                    f"channel to {self.node_id} lost and reconnect exhausted "
+                    f"after {self.mgr.reattach_attempts} attempt(s)",
+                )
+        finally:
+            self._recovering = False
+
+
+class ChannelManager:
+    """Owns every NodeChannel on a gateway; the dispatch path asks
+    ``supports(node)`` then ``submit(...)``. Callbacks into the gateway are
+    late-bound (``bind``) to avoid an import/ownership cycle:
+
+    - ``publish(execution_id, frame)`` — token frame → ExecutionStreams
+    - ``terminal(execution_id, frame)`` — drive ``gateway.complete``
+    - ``lost(execution_id, node_id, frames_delivered, error)`` — channel
+      gone for good: requeue (zero frames) or dead-letter (frames exist)
+
+    ``AGENTFIELD_CHANNEL=0`` disables the data plane entirely — every
+    dispatch takes the per-execution POST path, bit-compatible with the
+    pre-channel gateway (pinned by test).
+    """
+
+    def __init__(
+        self,
+        metrics,
+        enabled: bool | None = None,
+        heartbeat_s: float = 15.0,
+        connect_timeout_s: float = 5.0,
+        accept_timeout_s: float = 15.0,
+        reattach_attempts: int = 3,
+        reattach_backoff_s: float = 0.2,
+        reattach_ack_timeout_s: float = 10.0,
+        fallback_cooldown_s: float = 30.0,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("AGENTFIELD_CHANNEL", "1") not in ("0", "false")
+        self.enabled = enabled
+        self.metrics = metrics
+        self.heartbeat_s = heartbeat_s
+        self.connect_timeout_s = connect_timeout_s
+        self.accept_timeout_s = accept_timeout_s
+        self.reattach_attempts = reattach_attempts
+        self.reattach_backoff_s = reattach_backoff_s
+        self.reattach_ack_timeout_s = reattach_ack_timeout_s
+        self.fallback_cooldown_s = fallback_cooldown_s
+        self._session: aiohttp.ClientSession | None = None
+        self._chans: dict[str, NodeChannel] = {}
+        self._call_index: dict[str, NodeChannel] = {}
+        self._broken_until: dict[str, float] = {}
+        self.publish_cb: Callable[[str, dict], None] = lambda eid, f: None
+        self.terminal_cb: Callable[[str, dict], Awaitable[Any]] | None = None
+        self.lost_cb: Callable[[str, str, int, str], Awaitable[Any]] | None = None
+
+    def bind(self, publish, terminal, lost) -> None:
+        self.publish_cb = publish
+        self.terminal_cb = terminal
+        self.lost_cb = lost
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            # Reads are deliberately unbounded (streams are long-lived; the
+            # WS heartbeat owns liveness) but connect/handshake never hang.
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=None,
+                    connect=self.connect_timeout_s,
+                    sock_connect=self.connect_timeout_s,
+                )
+            )
+        return self._session
+
+    async def stop(self) -> None:
+        for chan in list(self._chans.values()):
+            await chan.close()
+        self._chans.clear()
+        self._call_index.clear()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+    # -- routing -------------------------------------------------------
+
+    def supports(self, node) -> bool:
+        """Should this dispatch ride the channel? Node must advertise
+        ``metadata.channel``; a node whose channel recently failed to carry
+        a submit is in a fallback cooldown (POST) so callers never pay a
+        connect timeout per request against a broken endpoint."""
+        if not self.enabled:
+            return False
+        if not (node.metadata or {}).get("channel"):
+            return False
+        until = self._broken_until.get(node.node_id, 0.0)
+        return time.monotonic() >= until
+
+    def mark_broken(self, node_id: str) -> None:
+        self._broken_until[node_id] = time.monotonic() + self.fallback_cooldown_s
+
+    def index(self, execution_id: str, chan: NodeChannel) -> None:
+        self._call_index[execution_id] = chan
+
+    def unindex(self, execution_id: str) -> None:
+        self._call_index.pop(execution_id, None)
+
+    def inflight(self, execution_id: str) -> bool:
+        return execution_id in self._call_index
+
+    async def submit(
+        self, node, execution_id: str, target_component: str,
+        agent_input: Any, headers: dict[str, str], stream: bool = False,
+    ) -> tuple[str, Any]:
+        chan = self._chans.get(node.node_id)
+        if chan is None or chan.base_url != node.base_url.rstrip("/"):
+            if chan is not None:
+                # Node re-registered at a new base_url: retire the stale
+                # channel (socket + recv task) instead of leaking it.
+                await chan.close()
+            chan = NodeChannel(self, node.node_id, node.base_url)
+            self._chans[node.node_id] = chan
+        frame = {
+            "kind": "submit",
+            "exec_id": execution_id,
+            "target": target_component,
+            "input": agent_input,
+            "headers": headers,
+            # Per-token framing only when a client-visible stream is open —
+            # plain traffic rides the channel as submit + terminal, paying
+            # nothing per token.
+            "stream": stream,
+        }
+        try:
+            return await chan.submit(execution_id, frame)
+        except ChannelUnavailable:
+            self.mark_broken(node.node_id)
+            raise
+
+    async def cancel(self, execution_id: str) -> None:
+        chan = self._call_index.get(execution_id)
+        if chan is not None:
+            await chan.cancel(execution_id)
+
+    def cancel_soon(self, execution_id: str) -> None:
+        """Fire-and-forget cancel (terminal transitions must not block on a
+        dead socket)."""
+        chan = self._call_index.get(execution_id)
+        if chan is not None:
+            chan._task(chan.cancel(execution_id))

@@ -31,6 +31,13 @@ Injection points in-tree:
                                or starvation — deterministic preempt/resume
                                churn for overload chaos tests; consulted once
                                per tick where a preemption is possible
+``channel.drop``               the gateway↔node data-plane WebSocket is killed
+                               abruptly (consulted once per received frame in
+                               the gateway's channel receive loop, so ``after``
+                               counts frames — a drop lands mid-stream at a
+                               deterministic token index); recovery must
+                               reattach by exec_id + last seq or apply the
+                               frames-delivered failover rule
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -59,6 +66,7 @@ KNOWN_POINTS = (
     "node.kill",
     "engine.page_pressure",
     "engine.preempt_storm",
+    "channel.drop",
 )
 
 

@@ -28,6 +28,12 @@ from typing import Any
 import aiohttp
 
 from agentfield_tpu.control_plane import faults
+from agentfield_tpu.control_plane.channel import (
+    ChannelManager,
+    ChannelUnavailable,
+    ExecutionStreams,
+    StreamSubscription,
+)
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.metrics import Metrics
 from agentfield_tpu.control_plane.storage import (
@@ -150,6 +156,10 @@ class ExecutionGateway:
         node_cache=None,  # registry.NodeSnapshotCache | None — dispatch fast
         # path: node resolution in _prepare/_pick_node served from the
         # registry's in-memory snapshot instead of a SQLite scan per request
+        channels: ChannelManager | None = None,  # streaming data plane:
+        # persistent multiplexed gateway↔node WebSocket channels. None →
+        # built here with defaults ($AGENTFIELD_CHANNEL gates it); nodes
+        # that don't advertise metadata.channel keep the POST path.
     ):
         self.payloads = payloads
         self.storage = storage
@@ -191,6 +201,18 @@ class ExecutionGateway:
         # window means nothing is moving — no-capacity 503, same as today.
         self._drained: collections.deque[float] = collections.deque(maxlen=1024)
         self._drain_window_s = 30.0
+        # Streaming data plane (docs/ARCHITECTURE.md): client-visible frame
+        # streams + the persistent node channels that feed them.
+        self.streams = ExecutionStreams()
+        self.channels = channels if channels is not None else ChannelManager(metrics)
+        self.channels.bind(
+            publish=self.streams.publish,
+            terminal=self._channel_terminal,
+            lost=self._channel_lost,
+        )
+        # Strong refs for stream-execute driver tasks (loop tasks are weakly
+        # held; a GC'd driver would strand a prepared execution).
+        self._stream_drivers: set[asyncio.Task] = set()
 
     @property
     def queue_depth(self) -> int:
@@ -198,7 +220,14 @@ class ExecutionGateway:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=self.agent_timeout)
+            timeout=aiohttp.ClientTimeout(total=self.agent_timeout),
+            # Even non-channel (POST fallback) nodes stop paying per-request
+            # connection setup: keep-alive pooled connections, bounded
+            # per-host so one hot node can't starve the rest of the fleet's
+            # file descriptors.
+            connector=aiohttp.TCPConnector(
+                limit=256, limit_per_host=32, keepalive_timeout=30.0
+            ),
         )
         self._workers = [
             asyncio.create_task(self._worker_loop(i)) for i in range(self.async_workers)
@@ -210,6 +239,11 @@ class ExecutionGateway:
         await asyncio.gather(*self._workers, return_exceptions=True)
         if self._bg_completions:  # let cancellation-path completions settle
             await asyncio.gather(*list(self._bg_completions), return_exceptions=True)
+        for t in list(self._stream_drivers):
+            t.cancel()
+        if self._stream_drivers:
+            await asyncio.gather(*list(self._stream_drivers), return_exceptions=True)
+        await self.channels.stop()
         if self._session:
             await self._session.close()
 
@@ -347,37 +381,35 @@ class ExecutionGateway:
         }
         if ex.parent_execution_id:
             headers["X-Parent-Execution-ID"] = ex.parent_execution_id
-        agent_input = ex.input
-        if self.payloads is not None:
-            # agents get real bytes; file IO runs off the event loop
-            agent_input = await asyncio.to_thread(self.payloads.resolve, agent_input)
-        if (
-            node.kind == "model"
-            and ex.target.split(".", 1)[1] == "generate"
-            and isinstance(agent_input, dict)
-            and (ex.priority or ex.deadline_s is not None)
-        ):
-            # Overload control rides THROUGH dispatch to the engine: the
-            # execute body's priority/deadline_s become generate() kwargs on
-            # the model node. The deadline forwarded is the REMAINING budget
-            # — queue/retry time already spent counts against it, so a
-            # request that waited out most of its budget at the gateway
-            # cannot monopolize a slot for the full original window. Clamped
-            # above zero: an expired-in-flight deadline becomes an instant
-            # engine-side deadline_exceeded rather than a 400. Explicit
-            # caller-set keys in the input win (setdefault).
-            agent_input = dict(agent_input)
-            if ex.priority:
-                agent_input.setdefault("priority", ex.priority)
-            if ex.deadline_s is not None:
-                remaining = ex.created_at + ex.deadline_s - now()
-                agent_input.setdefault("deadline_s", max(remaining, 0.001))
+        agent_input = await self._agent_input(node, ex)
         f = faults.fire("gateway.agent_call.delay")
         if f is not None and f.delay_s > 0:
             await asyncio.sleep(f.delay_s)
         f = faults.fire("gateway.agent_call.fail")
         if f is not None:
             return "node_error", f"agent call failed: {f.error}"
+        if self.channels.supports(node):
+            # Streaming data plane: one persistent multiplexed WebSocket per
+            # node instead of a POST per execution. ("deferred", None) after
+            # the node's `accepted` ack — the terminal frame completes the
+            # execution exactly like a 202 status callback; token frames
+            # land in self.streams on the way. A channel that cannot carry
+            # the submit at all falls back to the POST below for THIS call
+            # (and starts a cooldown), so a broken channel endpoint degrades
+            # to pre-channel behavior instead of failing dispatch.
+            try:
+                return await self.channels.submit(
+                    node, ex.execution_id, ex.target.split(".", 1)[1],
+                    agent_input, headers,
+                    stream=self.streams.wants(ex.execution_id),
+                )
+            except ChannelUnavailable as e:
+                self.metrics.inc("channel_fallbacks_total")
+                log.warning(
+                    "channel unavailable; falling back to POST",
+                    node_id=node.node_id, execution_id=ex.execution_id,
+                    error=str(e),
+                )
         t0 = time.perf_counter()
         try:
             async with self._session.post(
@@ -403,6 +435,107 @@ class ExecutionGateway:
             return "node_error", f"agent call failed: {e!r}"
         finally:
             self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)
+
+    async def _agent_input(self, node: AgentNode, ex: Execution):
+        """The payload a node actually receives: offloaded payloads resolve
+        to real bytes off the event loop, and overload control rides THROUGH
+        dispatch to the engine — the execute body's priority/deadline_s
+        become generate() kwargs on the model node. The deadline forwarded
+        is the REMAINING budget — queue/retry time already spent counts
+        against it, so a request that waited out most of its budget at the
+        gateway cannot monopolize a slot for the full original window.
+        Clamped above zero: an expired-in-flight deadline becomes an instant
+        engine-side deadline_exceeded rather than a 400. Explicit caller-set
+        keys in the input win (setdefault). Shared by the POST and channel
+        paths so the two transports carry identical inputs."""
+        agent_input = ex.input
+        if self.payloads is not None:
+            # agents get real bytes; file IO runs off the event loop
+            agent_input = await asyncio.to_thread(self.payloads.resolve, agent_input)
+        if (
+            node.kind == "model"
+            and ex.target.split(".", 1)[1] == "generate"
+            and isinstance(agent_input, dict)
+            and (ex.priority or ex.deadline_s is not None)
+        ):
+            agent_input = dict(agent_input)
+            if ex.priority:
+                agent_input.setdefault("priority", ex.priority)
+            if ex.deadline_s is not None:
+                remaining = ex.created_at + ex.deadline_s - now()
+                agent_input.setdefault("deadline_s", max(remaining, 0.001))
+        return agent_input
+
+    # -- streaming data plane hooks (channel.py calls back into these) --
+
+    async def _channel_terminal(self, execution_id: str, frame: dict) -> None:
+        """Terminal frame from a node channel — the channel's analogue of
+        the 202 status callback (handle_status_update)."""
+        if frame.get("status") == "completed":
+            await self.complete(execution_id, result=frame.get("result"))
+        else:
+            await self.complete(
+                execution_id, error=frame.get("error") or "agent reported failure"
+            )
+
+    async def _channel_lost(
+        self, execution_id: str, node_id: str, frames_delivered: int, error: str
+    ) -> None:
+        """The channel died for good (reconnect + reattach exhausted) with
+        this execution still on it. The mid-stream failover rule
+        (docs/FAULT_TOLERANCE.md): an execution that delivered ZERO frames
+        to the client may replay — requeue it through the async queue for
+        normal retry/failover, exactly like an orphan of a dead node. Any
+        delivered frame forbids replay (duplicated tokens); dead-letter with
+        the count recorded for operator triage."""
+        if frames_delivered > 0:
+            self.metrics.inc("channel_midstream_dead_letter_total")
+            await self.complete(
+                execution_id,
+                error=f"channel to node {node_id} lost mid-stream after "
+                f"{frames_delivered} frame(s) reached the client ({error}); "
+                "replay would duplicate streamed tokens",
+                dead_letter=True,
+            )
+            return
+        async with self._complete_lock:
+            cur = await self.db.get_execution(execution_id)
+            if (
+                cur is None
+                or cur.status != ExecutionStatus.RUNNING
+                or cur.execution_id in self._dispatching
+            ):
+                return  # completed/requeued elsewhere (e.g. node-down hook)
+            policy = self.retry_policy.merged(cur.retry_policy)
+            exhausted = cur.attempts >= policy.max_attempts
+            if not exhausted:
+                cur.status = ExecutionStatus.QUEUED
+                await self.db.update_execution(cur)
+        if exhausted:
+            await self.complete(
+                cur.execution_id,
+                error=f"channel to node {node_id} lost ({error}); retry "
+                f"budget exhausted after {cur.attempts} attempt(s) over "
+                f"nodes {cur.nodes_tried}",
+                dead_letter=True,
+            )
+            return
+        try:
+            self._queue.put_nowait(cur)
+        except asyncio.QueueFull:
+            await self.complete(
+                cur.execution_id,
+                error=f"channel to node {node_id} lost ({error}) and the "
+                "requeue found the async queue at capacity",
+                dead_letter=True,
+            )
+            return
+        self._publish(cur)
+        self.metrics.inc("channel_orphans_requeued_total")
+        log.warning(
+            "channel lost pre-stream; execution requeued",
+            execution_id=execution_id, node_id=node_id, error=error,
+        )
 
     @staticmethod
     def _capable_substitute(cand: AgentNode, comp: str, own: AgentNode | None) -> bool:
@@ -623,6 +756,61 @@ class ExecutionGateway:
             await self.complete(ex.execution_id, error="sync wait timeout", timeout=True)
         return await self.db.get_execution(ex.execution_id)  # type: ignore[return-value]
 
+    async def execute_stream(
+        self,
+        target: str,
+        payload: Any,
+        headers: dict[str, str],
+        webhook_url: str | None = None,
+        timeout: float | None = None,
+        retry_policy: dict[str, Any] | None = None,
+        priority: int = 0,
+        deadline_s: float | None = None,
+    ) -> tuple[Execution, StreamSubscription]:
+        """Streaming sync path: prepare + subscribe to the execution's frame
+        stream FIRST (so frame 0 is never missed), then drive dispatch in
+        the background. The caller consumes token frames as the node emits
+        them — first byte at TTFT — and the stream always ends with exactly
+        one terminal frame (the execution's terminal state). Channel-less
+        targets degrade gracefully: the subscription just carries the one
+        terminal frame when the POST completes."""
+        ex, node = await self._prepare(
+            target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
+            retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
+        )
+        sub = self.streams.attach(ex.execution_id)
+
+        async def drive() -> None:
+            try:
+                done = await self._dispatch(ex, node)
+                if done is not None and done.status.terminal:
+                    return  # complete() already published the terminal frame
+                current = await self.db.get_execution(ex.execution_id)
+                if current is not None and current.status.terminal:
+                    self.streams.finish(current)  # raced a callback: idempotent
+                    return
+                await self.bus.wait_for(
+                    EXEC_TOPIC,
+                    lambda ev: ev.get("execution_id") == ex.execution_id
+                    and ev.get("terminal"),
+                    timeout=timeout or self.sync_wait_timeout,
+                )
+            except TimeoutError:
+                await self.complete(
+                    ex.execution_id, error="sync wait timeout", timeout=True
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # driver must leave a terminal, never a hang
+                await self.complete(
+                    ex.execution_id, error=f"internal dispatch error: {e!r}"
+                )
+
+        t = asyncio.create_task(drive())
+        self._stream_drivers.add(t)
+        t.add_done_callback(self._stream_drivers.discard)
+        return ex, sub
+
     async def execute_async(
         self,
         target: str,
@@ -632,6 +820,10 @@ class ExecutionGateway:
         retry_policy: dict[str, Any] | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        stream: bool = False,  # open the execution's frame stream now so a
+        # later GET /executions/{id}/stream attach replays every token
+        # (channel-served targets only; without it async work streams
+        # nothing and the attach sees just the terminal frame)
     ) -> Execution:
         """Async path: enqueue and 202 immediately. Queue-full backpressure
         is SPLIT by what the drain telemetry says (execute.go:327-367 only
@@ -643,6 +835,10 @@ class ExecutionGateway:
             target, payload, headers, webhook_url, ExecutionStatus.QUEUED,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
         )
+        if stream:
+            # BEFORE the enqueue: a worker may dispatch immediately, and the
+            # stream-wanted decision is read at submit time.
+            self.streams.ensure(ex.execution_id)
         try:
             self._queue.put_nowait(ex)
         except asyncio.QueueFull:
@@ -763,6 +959,16 @@ class ExecutionGateway:
             # a single commit, and the caller's acknowledgment still goes
             # out only after that commit (docs/OPERATIONS.md).
             await barrier
+        if ex is not None and ex.status.terminal:
+            # Exactly-one terminal frame to every stream subscriber
+            # (idempotent — a no-op when nothing ever streamed/subscribed)...
+            self.streams.finish(ex)
+            # ...and if the execution is still live on a node channel, the
+            # terminal came from THIS side (sync-wait timeout, deadline,
+            # stale cleanup): propagate cancel down the channel so the
+            # engine's cancel path frees the slot now. Fire-and-forget — a
+            # terminal transition must never block on a dead socket.
+            self.channels.cancel_soon(ex.execution_id)
         return ex
 
     async def _complete_locked(  # guarded by: _complete_lock
@@ -815,6 +1021,11 @@ class ExecutionGateway:
             ex.attempts = attempts
         if nodes_tried is not None:
             ex.nodes_tried = list(nodes_tried)
+        # Record how much of the token stream the client already saw — the
+        # fact that forbids replay (dead-letter triage reads this).
+        frames = self.streams.tokens_published(execution_id)
+        if frames:
+            ex.frames_delivered = frames
         if dead_letter:
             ex.status = ExecutionStatus.DEAD_LETTER
             ex.error = error
@@ -979,6 +1190,9 @@ class ExecutionGateway:
         # the new incarnation's requeue matching or error reports
         ex.result = None  # ditto a late-recorded result from the dead
         # incarnation — and the late-result guard must be open for the new one
+        ex.frames_delivered = 0  # operator accepted the duplication risk by
+        # requeueing; the new incarnation streams from frame 0
+        self.streams.discard(ex.execution_id)
         if ex.deadline_s is not None:
             # Fresh deadline window too: deadline_s counts from created_at,
             # and the original window has usually lapsed by the time an

@@ -16,6 +16,7 @@ from typing import Any
 
 from aiohttp import web
 
+from agentfield_tpu.control_plane.channel import ChannelManager as _Channels
 from agentfield_tpu.control_plane.events import EventBus
 from agentfield_tpu.control_plane.gateway import EXEC_TOPIC, ExecutionGateway, GatewayError
 from agentfield_tpu.control_plane.metrics import Metrics
@@ -62,6 +63,11 @@ class ControlPlane:
         registry_cache: bool | None = None,  # dispatch-path node snapshot
         # cache; None → $AGENTFIELD_REGISTRY_CACHE (default on)
         registry_cache_ttl: float | None = None,  # None → $AGENTFIELD_REGISTRY_CACHE_TTL_S
+        channel: bool | None = None,  # streaming data plane master switch:
+        # persistent gateway↔node WebSocket channels + token streaming.
+        # None → $AGENTFIELD_CHANNEL (default on); False forces every
+        # dispatch onto the per-execution POST path (bit-compatible with the
+        # pre-channel gateway, pinned by test). docs/OPERATIONS.md.
     ):
         try:
             from agentfield_tpu.control_plane.identity import (
@@ -143,6 +149,7 @@ class ControlPlane:
             # Dispatch fast path: _prepare/_pick_node resolve nodes from the
             # registry's in-memory snapshot, not a SQLite scan per request.
             node_cache=self.registry.cache,
+            channels=_Channels(self.metrics, enabled=channel),
         )
 
         from agentfield_tpu.control_plane.health import HealthMonitor
@@ -464,6 +471,88 @@ def create_app(cp: ControlPlane) -> web.Application:
             if k.lower().startswith("x-") and v
         }
 
+    async def _resolve_terminal_frame(frame: dict) -> dict:
+        """Payload-offloaded results resolve to real bytes before the
+        terminal frame goes over the wire (mirrors execute_sync's doc
+        resolution); the stream buffer keeps the offloaded ref."""
+        if cp.payloads is not None and frame.get("result") is not None:
+            frame = dict(frame)
+            frame["result"] = await asyncio.to_thread(
+                cp.payloads.resolve, frame["result"]
+            )
+        return frame
+
+    async def _sse_frames(req: web.Request, sub, first_frame: dict | None = None):
+        """Drain one execution's frame stream as SSE: `: ping` comments keep
+        idle streams alive through proxies, and the stream ALWAYS ends with
+        an explicit terminal frame (or a `dropped` frame for a lagging
+        consumer) before close — a client seeing the connection end without
+        one knows it was a transport drop, not completion."""
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(req)
+        try:
+            if first_frame is not None:
+                await resp.write(
+                    f"data: {json.dumps(first_frame)}\n\n".encode()
+                )
+            while True:
+                try:
+                    async with aio_timeout(15):
+                        frame = await sub.get()
+                except TimeoutError:
+                    await resp.write(b": ping\n\n")
+                    continue
+                if frame is None:
+                    # this consumer lagged and was dropped by the fanout —
+                    # explicit, so the client can distinguish it from done
+                    await resp.write(
+                        b'data: {"kind": "dropped", "error": '
+                        b'"subscriber lagged behind the stream"}\n\n'
+                    )
+                    break
+                if frame.get("kind") == "terminal":
+                    frame = await _resolve_terminal_frame(frame)
+                    await resp.write(f"data: {json.dumps(frame)}\n\n".encode())
+                    break
+                await resp.write(f"data: {json.dumps(frame)}\n\n".encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client gone mid-stream: the execution continues and its result
+            # is recorded; GET /executions/{id}/stream can re-attach.
+            pass
+        finally:
+            sub.close()
+        return resp
+
+    async def _execute_stream(req: web.Request, body: dict, timeout):
+        """`stream=true` sync execute: SSE token frames from TTFT instead of
+        one JSON body at completion (docs/ARCHITECTURE.md data plane)."""
+        try:
+            ex, sub = await cp.gateway.execute_stream(
+                req.match_info["target"],
+                body.get("input"),
+                _headers(req),
+                webhook_url=body.get("webhook_url"),
+                timeout=timeout,
+                retry_policy=body.get("retry_policy"),
+                priority=0 if body.get("priority") is None else body["priority"],
+                deadline_s=body.get("deadline_s"),
+            )
+        except GatewayError as e:
+            return _json_error(e.status, e.message, retry_after=e.retry_after)
+        start = {
+            "kind": "start",
+            "execution_id": ex.execution_id,
+            "run_id": ex.run_id,
+            "target": ex.target,
+        }
+        return await _sse_frames(req, sub, first_frame=start)
+
     @routes.post("/api/v1/execute/{target}")
     async def execute_sync(req: web.Request):
         try:
@@ -475,6 +564,8 @@ def create_app(cp: ControlPlane) -> web.Application:
                 or timeout <= 0
             ):
                 raise _BadBody("timeout must be a positive number")
+            if body.get("stream"):
+                return await _execute_stream(req, body, timeout)
             ex = await cp.gateway.execute_sync(
                 req.match_info["target"],
                 body.get("input"),
@@ -510,6 +601,7 @@ def create_app(cp: ControlPlane) -> web.Application:
                 retry_policy=body.get("retry_policy"),
                 priority=0 if body.get("priority") is None else body["priority"],
                 deadline_s=body.get("deadline_s"),
+                stream=bool(body.get("stream")),
             )
         except GatewayError as e:
             return _json_error(e.status, e.message, retry_after=e.retry_after)
@@ -528,6 +620,43 @@ def create_app(cp: ControlPlane) -> web.Application:
             doc["input"] = await asyncio.to_thread(cp.payloads.resolve, doc["input"])
             doc["result"] = await asyncio.to_thread(cp.payloads.resolve, doc["result"])
         return web.json_response(doc)
+
+    @routes.get("/api/v1/executions/{execution_id}/stream")
+    async def execution_stream(req: web.Request):
+        """Attach to an execution's token stream (any execution — async,
+        sync, or one someone else is already watching): buffered frames
+        replay from frame 0, then live frames, then the terminal frame. An
+        already-terminal execution answers with just its terminal frame."""
+        from agentfield_tpu.control_plane.channel import ExecutionStreams
+
+        eid = req.match_info["execution_id"]
+        ex = await cp.db.get_execution(eid)
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        if ex.status.terminal and cp.gateway.streams.tokens_published(eid) == 0:
+            # Terminal with no retained stream: synthesize the one terminal
+            # frame from the row so the contract (always a terminal before
+            # close) holds for old executions too.
+            frame = await _resolve_terminal_frame(
+                ExecutionStreams.terminal_frame(ex.to_dict())
+            )
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            await resp.prepare(req)
+            await resp.write(f"data: {json.dumps(frame)}\n\n".encode())
+            return resp
+        sub = cp.gateway.streams.attach(eid)
+        # Close the attach-vs-terminal race: a completion landing between
+        # the row read and the attach would have found no entry to finish —
+        # re-read and finish idempotently so the subscriber can never hang.
+        cur = await cp.db.get_execution(eid)
+        if cur is not None and cur.status.terminal:
+            cp.gateway.streams.finish(cur)
+        return await _sse_frames(req, sub)
 
     @routes.post("/api/v1/executions/{execution_id}/status")
     async def status_callback(req: web.Request):
@@ -868,9 +997,19 @@ def create_app(cp: ControlPlane) -> web.Application:
                         _, ev = await q.get()
                     await resp.write(f"data: {json.dumps(ev)}\n\n".encode())
                 except TimeoutError:
-                    await resp.write(b": keepalive\n\n")
-        except (ConnectionResetError, asyncio.CancelledError):
-            pass
+                    # Periodic comment frame: idle streams survive proxies
+                    # and LBs that reap silent connections.
+                    await resp.write(b": ping\n\n")
+        except asyncio.CancelledError:
+            # Server-side close (shutdown): an explicit end event lets the
+            # client distinguish a deliberate close from a dropped link.
+            try:
+                await resp.write(b"event: end\ndata: {}\n\n")
+            except (ConnectionResetError, RuntimeError):
+                pass  # afcheck: ignore[except-swallow] client is gone too; nothing left to tell it
+            raise
+        except ConnectionResetError:
+            pass  # afcheck: ignore[except-swallow] client hung up; nothing to write a terminal to
         finally:
             cp.bus.unsubscribe(topic, q)
         return resp

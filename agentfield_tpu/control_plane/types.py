@@ -161,6 +161,13 @@ class Execution:
     # deadline-out the request mid-queue or mid-decode.
     priority: int = 0
     deadline_s: float | None = None
+    # Streaming data plane (docs/ARCHITECTURE.md): token frames already
+    # delivered to the client-visible stream when this execution went
+    # terminal. Non-zero means the execution may never be transparently
+    # replayed (a retry would duplicate tokens a client consumed) — the
+    # gateway dead-letters instead, and operators triaging the dead letter
+    # see exactly how much of the stream the caller got.
+    frames_delivered: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         # Hand-rolled: dataclasses.asdict() deep-copies every nested value
@@ -192,6 +199,7 @@ class Execution:
             "retry_policy": dict(self.retry_policy) if self.retry_policy else self.retry_policy,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
+            "frames_delivered": self.frames_delivered,
         }
 
     @staticmethod

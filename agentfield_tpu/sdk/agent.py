@@ -26,6 +26,7 @@ from typing import Any, Callable
 
 import random
 
+import aiohttp
 import pydantic
 from aiohttp import web
 
@@ -239,6 +240,12 @@ class Agent:
         ai_defaults: "AIConfig | dict | None" = None,  # agent-level ai()
         # defaults; per-reasoner ai_defaults= and explicit call arguments
         # override field-by-field (reference agent_ai.py:189-215)
+        channel: bool = True,  # serve the persistent gateway↔node channel
+        # (GET /channel, advertised via metadata.channel): the gateway
+        # multiplexes executions over ONE WebSocket instead of a POST per
+        # request, and token-streaming components (model nodes) stream
+        # end-to-end. False → per-execution POSTs only, the pre-channel
+        # wire behavior (docs/ARCHITECTURE.md data plane).
     ):
         if "." in node_id:
             raise ValueError("node_id must not contain '.'")
@@ -248,6 +255,12 @@ class Agent:
         self.host = host
         self.port = port
         self.metadata = metadata or {}
+        self.channel_server = None
+        if channel:
+            from agentfield_tpu.control_plane.channel import ChannelServer
+
+            self.channel_server = ChannelServer(invoke=self._channel_invoke)
+            self.metadata.setdefault("channel", True)
         self.heartbeat_interval = heartbeat_interval
         self.client = ControlPlaneClient(control_plane)
         self.components: dict[str, ComponentDef] = {}
@@ -357,6 +370,8 @@ class Agent:
         app.router.add_get("/health", health)
         app.router.add_get("/reasoners", list_components)
         app.router.add_get("/skills", list_components)
+        if self.channel_server is not None:
+            app.router.add_get("/channel", self.channel_server.handler)
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
         return app
@@ -365,6 +380,28 @@ class Agent:
         """Attach a raw aiohttp route (e.g. the model node's token-stream
         endpoint). Must be called before start()."""
         self.extra_routes.append((method, path, handler))
+
+    async def _channel_invoke(
+        self, comp_id: str, payload: Any, headers: dict[str, str]
+    ) -> Any:
+        """Channel-submitted execution: same component dispatch as the POST
+        handler, but the result rides back as a terminal frame instead of a
+        status callback — one hop fewer, same DAG context propagation.
+        Exceptions become terminal `failed` frames at the channel server
+        (mirroring _run_tracked's repr(e) callbacks)."""
+        comp = self.components.get(comp_id)
+        if comp is None:
+            raise LookupError(f"unknown component {comp_id!r}")
+        ctx = ExecutionContext.from_headers(headers) or ExecutionContext.new_root()
+        return await self._run(comp, payload, ctx)
+
+    def channel_stream(self, comp_id: str, fn) -> None:
+        """Register a token-streaming channel handler for a component (the
+        model node registers `generate`); `fn(payload, headers, emit)`
+        returns the final result after awaiting `emit(frame)` per token."""
+        if self.channel_server is None:
+            raise RuntimeError("channel disabled on this agent")
+        self.channel_server.stream_handler(comp_id, fn)
 
     async def _run(self, comp: ComponentDef, payload: Any, ctx: ExecutionContext) -> Any:
         token = set_context(ctx)
@@ -519,6 +556,12 @@ class Agent:
         deadline_s: float | None = None,  # wall-clock budget from submit;
         # the gateway sheds the call (TIMEOUT) if it expires pre-dispatch
         # and forwards the REMAINING budget to the engine.
+        stream: bool = False,  # token streaming THROUGH the gateway: returns
+        # an async iterator of frames instead of the result dict — token
+        # frames from TTFT, then one {"terminal": True, "result": ...} frame.
+        # Unlike ai_stream() (which bypasses the control plane and hits the
+        # node directly), this path keeps gateway retry/failover, DAG
+        # tracking, and the recorded execution row. Text-only.
     ) -> dict[str, Any]:
         """LLM call served by an in-tree TPU model node (replaces the
         reference's litellm path, agent_ai.py:95-447). Placement v0: first
@@ -565,6 +608,18 @@ class Agent:
             if not messages:
                 raise ValueError("messages must be non-empty")
             messages = [dict(m) for m in messages]  # appends stay caller-invisible
+        if stream:
+            if schema is not None or images or audio or files or output != "text":
+                raise ValueError(
+                    "ai(stream=True) is text-only token streaming; schema/"
+                    "media/output modes use the unary ai() path"
+                )
+            return self._ai_stream_frames(
+                prompt=prompt, tokens=tokens, messages=messages, model=model,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
+                timeout=timeout, priority=priority, deadline_s=deadline_s,
+            )
 
         def _carrier_text() -> str | None:
             """The text the markers/instructions live in: the prompt, or the
@@ -763,6 +818,83 @@ class Agent:
 
             return detect_multimodal_response(result)
         return result
+
+    async def _ai_stream_frames(
+        self, *, prompt, tokens, messages, model, max_new_tokens, temperature,
+        top_k, top_p, stop_token_ids, timeout, priority, deadline_s,
+    ):
+        """ai(stream=True) driver: token frames through the gateway's
+        streaming execute, with node-down failover across model candidates
+        — but ONLY while zero token frames have been yielded (a consumer
+        that saw tokens must never see them twice; mid-stream loss surfaces
+        as the gateway's dead-letter terminal instead)."""
+        payload = {
+            "prompt": prompt,
+            "tokens": tokens,
+            "messages": messages,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "stop_token_ids": stop_token_ids or [],
+            "session_id": (current_context().session_id if current_context() else None),
+        }
+        candidates = await self._model_candidates(model)
+        node_errors: list[str] = []
+        for ci, cand in enumerate(candidates):
+            node_id = cand["node_id"]
+            yielded = False
+            terminal: dict[str, Any] | None = None
+            try:
+                async for frame in self.client.execute_stream(
+                    f"{node_id}.generate",
+                    payload,
+                    headers=self._outbound_ctx().to_headers(),
+                    timeout=timeout,
+                    priority=priority,
+                    deadline_s=deadline_s,
+                ):
+                    kind = frame.get("kind")
+                    if kind == "token":
+                        yielded = True
+                        yield {
+                            "token": frame.get("token"),
+                            "index": frame.get("index"),
+                            "finished": bool(frame.get("finished")),
+                            "finish_reason": frame.get("finish_reason"),
+                            "text": frame.get("text"),
+                            "logprob": frame.get("logprob"),
+                        }
+                    elif kind in ("terminal", "dropped"):
+                        terminal = frame
+                        break
+            except (ControlPlaneError, aiohttp.ClientError) as e:
+                if yielded or ci + 1 >= len(candidates):
+                    raise
+                node_errors.append(f"{node_id}: {e}")
+                continue
+            if terminal is None or terminal.get("kind") == "dropped":
+                raise RuntimeError(
+                    "stream ended without a terminal frame "
+                    f"({(terminal or {}).get('error') or 'connection dropped'})"
+                )
+            if terminal.get("status") == "completed":
+                yield {
+                    "terminal": True,
+                    "finished": True,
+                    "status": "completed",
+                    "result": terminal.get("result"),
+                    "execution_id": terminal.get("execution_id"),
+                }
+                return
+            doc = {"status": terminal.get("status"), "error": terminal.get("error")}
+            if not yielded and self._doc_node_down(doc) and ci + 1 < len(candidates):
+                node_errors.append(f"{node_id}: {doc.get('error')}")
+                continue
+            detail = f"; failed over from {node_errors}" if node_errors else ""
+            raise RuntimeError(
+                f"ai(stream=True) {doc.get('status')}: {doc.get('error')}{detail}"
+            )
 
     async def ai_with_vision(self, prompt: str, image: Any, **kw) -> dict[str, Any]:
         """Image-understanding sugar (reference: ai_with_vision,
@@ -1083,6 +1215,8 @@ class Agent:
         # afcheck: ignore[except-swallow] shutdown courtesy beat; the plane may already be gone and the lease sweep covers us
         except Exception:
             pass
+        if self.channel_server is not None:
+            await self.channel_server.close()
         if self._runner:
             await self._runner.cleanup()
         await self.client.close()

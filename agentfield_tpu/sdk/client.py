@@ -145,6 +145,84 @@ class ControlPlaneClient:
             "POST", f"/api/v1/execute/async/{target}", json=body, headers=headers or {}
         )
 
+    async def execute_stream(
+        self,
+        target: str,
+        payload: Any = None,
+        headers: dict[str, str] | None = None,
+        timeout: float = 600.0,
+        priority: int = 0,
+        deadline_s: float | None = None,
+    ):
+        """Streaming sync execute (`stream=true`): yields the control
+        plane's SSE frames as dicts — a `start` frame with the execution id,
+        `token` frames from time-to-first-token, then exactly one `terminal`
+        frame carrying the execution's final status/result. A `dropped`
+        frame means this consumer lagged behind the stream and was detached
+        (the execution itself continues and its result is recorded)."""
+        import json as _json
+
+        body: dict[str, Any] = {"input": payload, "stream": True}
+        if priority:
+            body["priority"] = priority
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        if timeout is not None:
+            body["timeout"] = timeout
+        s = await self._s()
+        async with s.post(
+            f"{self.base_url}/api/v1/execute/{target}",
+            json=body,
+            headers=headers or {},
+            # sock_read bounds inter-frame gaps, not the whole stream — the
+            # server pings every 15s, so 60s of silence means a dead link.
+            # timeout=None = deliberately unbounded total (the server's own
+            # sync-wait bound owns stream lifetime then).
+            timeout=aiohttp.ClientTimeout(
+                total=timeout + 30 if timeout is not None else None, sock_read=60
+            ),
+        ) as resp:
+            if resp.status >= 400:
+                try:
+                    msg = (await resp.json()).get("error", "")
+                except Exception:
+                    msg = (await resp.text())[:300]
+                raise ControlPlaneError(resp.status, msg)
+            async for line in resp.content:
+                if not line.startswith(b"data: "):
+                    continue
+                frame = _json.loads(line[6:])
+                yield frame
+                if frame.get("kind") in ("terminal", "dropped"):
+                    return
+
+    async def stream_execution(self, execution_id: str, timeout: float = 600.0):
+        """Attach to an execution's token stream (GET
+        /api/v1/executions/{id}/stream): buffered frames replay from frame
+        0, then live frames, then the terminal frame."""
+        import json as _json
+
+        s = await self._s()
+        async with s.get(
+            f"{self.base_url}/api/v1/executions/{execution_id}/stream",
+            timeout=aiohttp.ClientTimeout(
+                total=timeout if timeout is not None else None, sock_read=60
+            ),
+        ) as resp:
+            if resp.status >= 400:
+                try:
+                    msg = (await resp.json()).get("error", "")
+                except Exception:
+                    msg = (await resp.text())[:300]
+                raise ControlPlaneError(resp.status, msg)
+            async for line in resp.content:
+                if not line.startswith(b"data: "):
+                    continue
+                frame = _json.loads(line[6:])
+                yield frame
+                if frame.get("kind") in ("terminal", "dropped"):
+                    return
+
     async def get_execution(self, execution_id: str) -> dict[str, Any]:
         import copy
 

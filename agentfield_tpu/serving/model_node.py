@@ -1119,11 +1119,14 @@ class ModelBackend:
         prefused: tuple | None = None,
         deadline_s: float | None = None,
         priority: int = 0,
-    ) -> tuple[str, asyncio.Queue]:
-        """Streaming variant: returns (request_id, queue of TokenEvents).
-        Raises QueueFullError / RequestTooLongError like generate()."""
+    ) -> tuple[str, asyncio.Queue, int]:
+        """Streaming variant: returns (request_id, queue of TokenEvents,
+        truncated_prompt_tokens) — the truncation count rides along so
+        streaming transports report the same ``truncated_prompt_tokens`` a
+        unary generate() does. Raises QueueFullError / RequestTooLongError
+        like generate()."""
         q: asyncio.Queue = asyncio.Queue(maxsize=4096)
-        rid, _ = self._submit(
+        rid, truncated = self._submit(
             prompt,
             tokens,
             max_new_tokens,
@@ -1143,7 +1146,7 @@ class ModelBackend:
             deadline_s=deadline_s,
             priority=priority,
         )
-        return rid, q
+        return rid, q, truncated
 
     async def drain(self, grace_s: float = 30.0) -> dict[str, Any]:
         """Graceful drain (rolling restart): stop admitting, let in-flight
@@ -1317,10 +1320,61 @@ def build_model_node(
         **backend.engine.grammar_bank_stats(),
         **backend.engine.prefix_cache_stats(),
         **backend.engine.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
+        # node-side data-plane counters ride the same heartbeat → /stats →
+        # per-node Prometheus gauge pipeline as the engine counters
+        **(agent.channel_server.stats if agent.channel_server is not None else {}),
         "active_slots": backend.engine.num_active,
         "free_pages": backend.engine.allocator.free_pages,
         "draining": int(backend._draining),
     }
+
+    async def _prep_stream_kwargs(body: dict) -> dict:
+        """Shared request prep for both token-stream transports (direct SSE
+        and the gateway channel): chat template, grammar pre-warm, media
+        pre-fusion — one recipe, so the two paths cannot drift."""
+        gen_kwargs = {
+            k: body[k]
+            for k in (
+                "prompt", "tokens", "stop_token_ids", "session_id",
+                "max_new_tokens", "temperature", "top_k", "top_p",
+                "response_schema", "context_overflow", "images", "audios",
+                "deadline_s", "priority",
+            )
+            if body.get(k) is not None
+        }
+        if body.get("messages") is not None:
+            if gen_kwargs.get("prompt") is not None or gen_kwargs.get("tokens") is not None:
+                raise ValueError("messages is exclusive with prompt/tokens")
+            gen_kwargs["prompt"] = backend.apply_chat_template(body["messages"])
+        if body.get("output") not in (None, "text"):
+            raise ValueError(
+                "the token stream is text-only; use the unary generate "
+                "path for output='audio'/'speech'/'image'"
+            )
+        if gen_kwargs.get("response_schema") is not None:
+            gen_kwargs["grammar_obj"] = await backend.ensure_grammar(
+                gen_kwargs["response_schema"]
+            )
+        if (gen_kwargs.get("images") or gen_kwargs.get("audios")) \
+                and gen_kwargs.get("prompt") is not None \
+                and gen_kwargs.get("tokens") is None:
+            gen_kwargs["prefused"] = await backend.ensure_media(
+                gen_kwargs["prompt"], gen_kwargs.get("images"),
+                gen_kwargs.get("audios"),
+            )
+        return gen_kwargs
+
+    def _event_frame(ev) -> dict:
+        frame = {
+            "token": ev.token,
+            "index": ev.index,
+            "finished": ev.finished,
+            "finish_reason": ev.finish_reason,
+            "logprob": ev.logprob,
+        }
+        if backend.tokenizer is not None and ev.token >= 0:
+            frame["text"] = backend.tokenizer.decode([ev.token])
+        return frame
 
     async def stream_handler(req):
         """SSE token stream — the data-plane path: callers hit the model node
@@ -1335,37 +1389,8 @@ def build_model_node(
             body = await req.json()
             if not isinstance(body, dict):
                 raise ValueError("JSON object body required")
-            gen_kwargs = {
-                k: body[k]
-                for k in (
-                    "prompt", "tokens", "stop_token_ids", "session_id",
-                    "max_new_tokens", "temperature", "top_k", "top_p",
-                    "response_schema", "context_overflow", "images", "audios",
-                    "deadline_s", "priority",
-                )
-                if body.get(k) is not None
-            }
-            if body.get("messages") is not None:
-                if gen_kwargs.get("prompt") is not None or gen_kwargs.get("tokens") is not None:
-                    raise ValueError("messages is exclusive with prompt/tokens")
-                gen_kwargs["prompt"] = backend.apply_chat_template(body["messages"])
-            if body.get("output") not in (None, "text"):
-                raise ValueError(
-                    "the token stream is text-only; use the unary generate "
-                    "path for output='audio'/'speech'/'image'"
-                )
-            if gen_kwargs.get("response_schema") is not None:
-                gen_kwargs["grammar_obj"] = await backend.ensure_grammar(
-                    gen_kwargs["response_schema"]
-                )
-            if (gen_kwargs.get("images") or gen_kwargs.get("audios")) \
-                    and gen_kwargs.get("prompt") is not None \
-                    and gen_kwargs.get("tokens") is None:
-                gen_kwargs["prefused"] = await backend.ensure_media(
-                    gen_kwargs["prompt"], gen_kwargs.get("images"),
-                    gen_kwargs.get("audios"),
-                )
-            rid, q = backend.submit_stream(**gen_kwargs)
+            gen_kwargs = await _prep_stream_kwargs(body)
+            rid, q, _truncated = backend.submit_stream(**gen_kwargs)
         except (QueueFullError,) as e:
             return _web.json_response({"error": str(e)}, status=503)
         except Exception as e:
@@ -1376,17 +1401,15 @@ def build_model_node(
         await resp.prepare(req)
         try:
             while True:
-                ev = await q.get()
-                frame = {
-                    "token": ev.token,
-                    "index": ev.index,
-                    "finished": ev.finished,
-                    "finish_reason": ev.finish_reason,
-                    "logprob": ev.logprob,
-                }
-                if backend.tokenizer is not None and ev.token >= 0:
-                    frame["text"] = backend.tokenizer.decode([ev.token])
-                await resp.write(f"data: {_json.dumps(frame)}\n\n".encode())
+                try:
+                    async with aio_timeout(10):
+                        ev = await q.get()
+                except TimeoutError:
+                    # Idle decode gap (deep queue / long prefill): comment
+                    # frames keep the stream alive through proxies.
+                    await resp.write(b": ping\n\n")
+                    continue
+                await resp.write(f"data: {_json.dumps(_event_frame(ev))}\n\n".encode())
                 if ev.finished:
                     break
         except (ConnectionResetError, asyncio.CancelledError):
@@ -1394,12 +1417,73 @@ def build_model_node(
             # dead reader wastes TPU steps and pins pages (same policy as
             # generate()'s CancelledError path).
             backend.cancel(rid)
+        except Exception as e:
+            # The terminal-before-close contract: a transport-capable client
+            # must be able to tell "server failed" from "link dropped".
+            try:
+                await resp.write(
+                    f"data: {_json.dumps({'token': -1, 'index': -1, 'finished': True, 'finish_reason': f'error: {e!r}'})}\n\n".encode()
+                )
+            except (ConnectionResetError, RuntimeError):
+                pass  # afcheck: ignore[except-swallow] client is gone too; the engine-side cancel below still runs
+            backend.cancel(rid)
         finally:
             backend.release_stream(rid)  # disconnected consumers must not
             # accumulate in _streams
         return resp
 
     agent.add_route("POST", "/generate/stream", stream_handler)
+
+    async def channel_generate(payload, headers, emit):
+        """Gateway-channel streaming handler for `generate`: TokenEvents →
+        channel token frames, final result identical in shape to the unary
+        generate() (so an execution's recorded result is transport-
+        independent). Cancellation (gateway cancel frame / deadline) lands
+        here as CancelledError → engine cancel path frees the slot."""
+        if not isinstance(payload, dict):
+            raise ValueError("generate input must be a JSON object")
+        if payload.get("output") not in (None, "text"):
+            # Non-text outputs don't stream: serve them unary over the
+            # channel (terminal frame only), result identical to POST.
+            return await backend.generate(
+                **{k: v for k, v in payload.items() if v is not None}
+            )
+        gen_kwargs = await _prep_stream_kwargs(payload)
+        rid, q, truncated = backend.submit_stream(**gen_kwargs)
+        records: list[tuple[int, float | None]] = []
+        finish_reason = None
+        try:
+            while True:
+                ev = await q.get()
+                await emit(_event_frame(ev))
+                if ev.token < 0:
+                    pass  # terminal marker without content (deadline/error)
+                elif not (ev.finished and ev.finish_reason == "stop"):
+                    records.append((ev.token, ev.logprob))
+                if ev.finished:
+                    finish_reason = ev.finish_reason
+                    break
+        except asyncio.CancelledError:
+            backend.cancel(rid)
+            raise
+        finally:
+            backend.release_stream(rid)
+        if finish_reason and finish_reason.startswith("error:"):
+            raise RuntimeError(f"engine stream failed ({finish_reason})")
+        result = {
+            "tokens": [t for t, _ in records],
+            "logprobs": [lp for _, lp in records],
+            "finish_reason": finish_reason,
+            "model": backend.model_name,
+        }
+        if backend.tokenizer is not None:
+            result["text"] = backend.tokenizer.decode(result["tokens"])
+        if truncated:
+            result["truncated_prompt_tokens"] = truncated
+        return result
+
+    if agent.channel_server is not None:
+        agent.channel_stream("generate", channel_generate)
 
     async def stats_handler(_req):
         from aiohttp import web as _web

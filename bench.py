@@ -1219,19 +1219,34 @@ def _free_port() -> int:
 class _EchoNode:
     """Minimal in-process agent node shared by the control-plane scenarios
     (fault_storm, gateway_qps): POST /reasoners/{rid} echoes; killable
-    mid-burst (kill() == stop())."""
+    mid-burst (kill() == stop()).
 
-    def __init__(self):
+    With ``n_tokens``/``token_delay_s`` it models a generation: the POST
+    path sleeps the FULL decode time before answering (what a sync caller
+    experiences without streaming), while ``channel=True`` additionally
+    serves the gateway channel (`/channel`) and streams one token frame per
+    ``token_delay_s`` — the first frame leaves after ONE delay, which is
+    exactly the TTFT-vs-completion gap the streaming data plane exists to
+    expose (docs/PERFORMANCE.md)."""
+
+    def __init__(self, n_tokens: int = 0, token_delay_s: float = 0.0, channel: bool = False):
         self.port = _free_port()
         self.base_url = f"http://127.0.0.1:{self.port}"
         self.runner = None
         self.calls = 0
+        self.n_tokens = n_tokens
+        self.token_delay_s = token_delay_s
+        self.channel = channel
 
     async def _task(self, req):
+        import asyncio
+
         from aiohttp import web
 
         body = await req.json()
         self.calls += 1
+        if self.n_tokens and self.token_delay_s:
+            await asyncio.sleep(self.n_tokens * self.token_delay_s)
         return web.json_response({"result": {"echo": body.get("input")}})
 
     async def _health(self, _req):
@@ -1240,16 +1255,51 @@ class _EchoNode:
         return web.json_response({"status": "ok"})
 
     async def start(self):
+        import asyncio
+
         from aiohttp import web
 
         app = web.Application()
         app.router.add_post("/reasoners/{rid}", self._task)
         app.router.add_get("/health", self._health)
+        if self.channel:
+            from agentfield_tpu.control_plane.channel import ChannelServer
+
+            async def invoke(_target, payload, _headers):
+                self.calls += 1
+                if self.n_tokens and self.token_delay_s:
+                    await asyncio.sleep(self.n_tokens * self.token_delay_s)
+                return {"echo": payload}
+
+            async def stream(payload, _headers, emit):
+                self.calls += 1
+                # Absolute emission schedule (like an engine's own tick
+                # cadence): per-token sleep drift must not compound into
+                # fake generation time — the POST path pays the sleep once,
+                # so the streaming path must not pay the drift N times.
+                loop = asyncio.get_running_loop()
+                t0 = loop.time()
+                for i in range(self.n_tokens):
+                    delay = t0 + (i + 1) * self.token_delay_s - loop.time()
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    await emit({"token": i, "index": i, "finished": i == self.n_tokens - 1,
+                                "finish_reason": "stop" if i == self.n_tokens - 1 else None})
+                return {"echo": payload, "tokens": list(range(self.n_tokens)),
+                        "finish_reason": "stop"}
+
+            self.chan = ChannelServer(invoke=invoke, stream_handlers={"task": stream})
+            app.router.add_get("/channel", self.chan.handler)
         self.runner = web.AppRunner(app)
         await self.runner.setup()
         await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
 
     async def kill(self):
+        if self.channel and getattr(self, "chan", None) is not None:
+            # Close live channel sockets first: an open WS would hold the
+            # runner's graceful shutdown for its full timeout.
+            await self.chan.close()
+            self.chan = None
         if self.runner is not None:
             await self.runner.cleanup()
             self.runner = None
@@ -1416,13 +1466,18 @@ def _gateway_qps() -> None:
       gateway's ``_call_agent_once`` seam (identically for both modes) —
       this isolates the DISPATCH path (registry + gateway + storage), the
       layer this fast path optimizes, from localhost-HTTP throughput.
-    - ``with_agent_hop``: the same burst with a real aiohttp stub agent
-      node — end-to-end sync numbers where the wire hop (which no control-
-      plane change can remove) dilutes the dispatch speedup.
+    - ``with_agent_hop``: the same burst against a real aiohttp stub agent
+      node that models a generation (n_tokens × token_delay of "decode") —
+      end-to-end numbers where the wire hop dominates. This is now the
+      HEADLINE comparison for the streaming data plane: streaming OFF
+      (channel disabled, per-execution POST, full-completion latency) vs
+      streaming ON (persistent channel, token frames, TTFT measured at the
+      first frame). The hop cannot be removed, but streaming moves the
+      first byte from completion time to TTFT (docs/PERFORMANCE.md).
 
-    Headline value = fast-path-ON dispatch req/s; the report carries both
-    runs of both variants, the speedups, and the registry-cache/journal
-    counters that explain them."""
+    Dispatch headline value = fast-path-ON req/s; the with_agent_hop block
+    reports TTFT p50/p99 (streaming on) vs completion p50/p99 (streaming
+    off), req/s for both, and the channel counters that explain them."""
     import asyncio
     import shutil
     import tempfile
@@ -1440,7 +1495,7 @@ def _gateway_qps() -> None:
     from agentfield_tpu.control_plane.server import ControlPlane
     from tools.perf.load_gen import run_load
 
-    async def one_run(fast: bool, agent_hop: bool) -> dict:
+    async def one_run(fast: bool) -> dict:
         tmp = tempfile.mkdtemp(prefix="gateway_qps_")
         cp = ControlPlane(
             db_path=os.path.join(tmp, "cp.db"),
@@ -1450,19 +1505,16 @@ def _gateway_qps() -> None:
             registry_cache=fast,
         )
         await cp.start()
-        stub = _EchoNode() if agent_hop else None
-        if stub is not None:
-            await stub.start()
-        if not agent_hop:
-            # Stub the agent call at the gateway's own seam (both modes
-            # identically): the burst then measures pure dispatch.
-            async def _stub_call(node, ex):
-                await asyncio.sleep(0)  # keep one real scheduling point
-                return "completed", {"echo": ex.input}
 
-            cp.gateway._call_agent_once = _stub_call
+        # Stub the agent call at the gateway's own seam (both modes
+        # identically): the burst then measures pure dispatch.
+        async def _stub_call(node, ex):
+            await asyncio.sleep(0)  # keep one real scheduling point
+            return "completed", {"echo": ex.input}
+
+        cp.gateway._call_agent_once = _stub_call
         try:
-            base_url = stub.base_url if stub else "http://127.0.0.1:9"
+            base_url = "http://127.0.0.1:9"
             await cp.registry.register(
                 {
                     "node_id": "stub",
@@ -1491,11 +1543,79 @@ def _gateway_qps() -> None:
                 "misses": cp.metrics.counter_value("registry_cache_misses_total"),
             }
             report["journal"] = cp.storage.journal_stats()
-            if stub is not None:
-                report["agent_calls"] = stub.calls
         finally:
-            if stub is not None:
-                await stub.stop()
+            await cp.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+        return report
+
+    # with_agent_hop: a stub node modeling an n_tok × tok_delay generation
+    # (defaults ≈ a short completion at realistic CPU-proxy decode cadence).
+    # Everything here shares ONE event loop (driver + gateway + node), so
+    # the hop concurrency is kept moderate — the A/B isolates the transport,
+    # not loop saturation.
+    n_hop = int(os.environ.get("AGENTFIELD_BENCH_HOP_REQUESTS") or 192)
+    hop_conc = int(os.environ.get("AGENTFIELD_BENCH_HOP_CONCURRENCY") or 16)
+    n_tok = int(os.environ.get("AGENTFIELD_BENCH_HOP_TOKENS") or 16)
+    tok_delay = float(os.environ.get("AGENTFIELD_BENCH_HOP_TOKEN_DELAY_S") or 0.015)
+
+    async def hop_run(streaming: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix="gateway_qps_hop_")
+        cp = ControlPlane(
+            db_path=os.path.join(tmp, "cp.db"),
+            db_group_commit_ms=2.0,  # PR 4 fast path ON for both: this A/B
+            registry_cache=True,     # isolates the TRANSPORT
+            channel=streaming,
+        )
+        await cp.start()
+        stub = _EchoNode(n_tokens=n_tok, token_delay_s=tok_delay, channel=streaming)
+        await stub.start()
+        try:
+            await cp.registry.register(
+                {
+                    "node_id": "stub",
+                    "base_url": stub.base_url,
+                    "reasoners": [{"id": "task"}],
+                    "metadata": {"channel": True} if streaming else {},
+                }
+            )
+
+            if streaming:
+
+                async def call(i: int):
+                    t0 = time.perf_counter()
+                    _ex, sub = await cp.gateway.execute_stream("stub.task", i, {})
+                    ttft, status = None, "?"
+                    while True:
+                        frame = await sub.get()
+                        if frame is None:
+                            status = "dropped"
+                            break
+                        if frame["kind"] == "token" and ttft is None:
+                            ttft = time.perf_counter() - t0
+                        if frame["kind"] == "terminal":
+                            status = frame["status"]
+                            break
+                    return status, ttft
+
+            else:
+
+                async def call(i: int):
+                    ex = await cp.gateway.execute_sync("stub.task", i, {})
+                    # No streaming: the first byte IS the completion — TTFT
+                    # and full latency coincide by construction.
+                    return ex.status.value
+
+            await run_load("", "stub.task", 32, hop_conc, "sync", execute=call)
+            report = await run_load("", "stub.task", n_hop, hop_conc, "sync", execute=call)
+            report["agent_calls"] = stub.calls
+            report["channel"] = {
+                "opens": cp.metrics.counter_value("channel_opens_total"),
+                "submits": cp.metrics.counter_value("channel_submits_total"),
+                "reconnects": cp.metrics.counter_value("channel_reconnects_total"),
+                "fallbacks": cp.metrics.counter_value("channel_fallbacks_total"),
+            }
+        finally:
+            await stub.stop()
             await cp.stop()
             shutil.rmtree(tmp, ignore_errors=True)
         return report
@@ -1504,12 +1624,12 @@ def _gateway_qps() -> None:
     # noisy neighbor can halve one round; the best round per mode is the
     # honest estimate of each configuration's capability (and every round
     # is reported).
-    def ab(agent_hop: bool) -> tuple[dict, dict, dict]:
+    def ab(runner) -> tuple[dict, dict, dict]:
         off_rounds, on_rounds = [], []
         for _ in range(2):
-            off_rounds.append(asyncio.run(one_run(fast=False, agent_hop=agent_hop)))
+            off_rounds.append(asyncio.run(runner(False)))
             _partial["gateway_qps_off"] = off_rounds[-1]
-            on_rounds.append(asyncio.run(one_run(fast=True, agent_hop=agent_hop)))
+            on_rounds.append(asyncio.run(runner(True)))
         off = max(off_rounds, key=lambda r: r["rps"])
         on = max(on_rounds, key=lambda r: r["rps"])
         rounds = {
@@ -1519,10 +1639,16 @@ def _gateway_qps() -> None:
         }
         return on, off, rounds
 
-    on, off, rounds = ab(agent_hop=False)  # headline: pure dispatch path
+    on, off, rounds = ab(lambda fast: one_run(fast))  # pure dispatch path
     _partial["gateway_qps_dispatch"] = {"on": on["rps"], "off": off["rps"]}
-    hop_on, hop_off, hop_rounds = ab(agent_hop=True)
+    hop_on, hop_off, hop_rounds = ab(lambda s: hop_run(s))
     speedup = round(on["rps"] / max(off["rps"], 1e-9), 2)
+    # The with_agent_hop headline: with a real hop in the loop, streaming
+    # moves the caller's first byte from full-completion p50 to TTFT p50.
+    ttft_p50 = hop_on.get("ttft_ms", {}).get("p50", 0.0)
+    ttft_speedup = round(
+        hop_off["latency_ms"]["p50"] / max(ttft_p50, 1e-9), 2
+    ) if ttft_p50 else None
     _emit(
         {
             "metric": f"gateway_qps_{n}req_c{conc}_sync_dispatch",
@@ -1536,11 +1662,17 @@ def _gateway_qps() -> None:
             "off": off,
             "rounds": rounds,
             "with_agent_hop": {
-                "speedup_rps": round(
+                "note": "streaming data plane A/B: ON = persistent channel "
+                "+ token frames (TTFT = first frame), OFF = per-execution "
+                "POST (first byte at completion); PR 4 fast path on in both",
+                "stub_generation": {"n_tokens": n_tok, "token_delay_s": tok_delay},
+                "requests": n_hop,
+                "ttft_p50_speedup_vs_completion": ttft_speedup,
+                "rps_ratio_on_vs_off": round(
                     hop_on["rps"] / max(hop_off["rps"], 1e-9), 2
                 ),
-                "on": hop_on,
-                "off": hop_off,
+                "streaming_on": hop_on,
+                "streaming_off": hop_off,
                 "rounds": hop_rounds,
             },
             "requests": n,

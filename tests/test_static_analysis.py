@@ -508,6 +508,49 @@ def test_http_timeout_passes_explicit(tmp_path):
     assert found == []
 
 
+def test_http_timeout_flags_heartbeatless_websockets(tmp_path):
+    """The streaming data plane lives on WebSockets: a ws_connect without
+    heartbeat= (or timeout=) and a WebSocketResponse without heartbeat= are
+    hang/leak hazards — both flagged (channel.py is lint-covered from day
+    one)."""
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/x.py",
+        """
+        from aiohttp import web
+
+        async def mk(session, request):
+            ws_client = await session.ws_connect("http://n/channel")
+            ws_server = web.WebSocketResponse()
+            await ws_server.prepare(request)
+            return ws_client, ws_server
+        """,
+        pass_ids=["http-timeout"],
+    )
+    assert _ids(found) == ["http-timeout"] * 2
+    msgs = "\n".join(f.message for f in found)
+    assert "WebSocket connect" in msgs and "WebSocketResponse" in msgs
+
+
+def test_http_timeout_passes_heartbeat_websockets(tmp_path):
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/x.py",
+        """
+        from aiohttp import web
+
+        async def mk(session, request):
+            ws_client = await session.ws_connect("http://n/channel", heartbeat=15)
+            ws_bounded = await session.ws_connect("http://n/channel", timeout=10)
+            ws_server = web.WebSocketResponse(heartbeat=20)
+            await ws_server.prepare(request)
+            return ws_client, ws_bounded, ws_server
+        """,
+        pass_ids=["http-timeout"],
+    )
+    assert found == []
+
+
 # ---------------------------------------------------------------------------
 # the gate: the shipped tree is clean, and the CLI agrees
 

@@ -8,6 +8,14 @@ retry/deadline layer exists to bound (docs/FAULT_TOLERANCE.md). A
 deliberately unbounded stream still passes
 ``timeout=ClientTimeout(total=None, connect=...)``: "no bound" must be an
 explicit decision at the call site, never a default.
+
+WebSockets get the same discipline (the streaming data plane lives on
+them): a ``ws_connect(...)`` without ``heartbeat=`` (or an explicit
+``timeout=``) never notices a half-dead peer — the read just hangs, which
+on the gateway channel means in-flight streams stall instead of triggering
+reconnect+reattach; and a ``web.WebSocketResponse()`` built without
+``heartbeat=`` leaves dead server-side sockets (and their buffered
+executions) open until the TCP stack gives up.
 """
 
 from __future__ import annotations
@@ -35,29 +43,65 @@ def _is_client_ctor(func: ast.expr) -> bool:
     )
 
 
+def _is_ws_connect(func: ast.expr) -> bool:
+    chain = attr_chain(func)
+    return bool(chain) and chain[-1] == "ws_connect"
+
+
+def _is_ws_response_ctor(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name) and func.id == "WebSocketResponse":
+        return True
+    chain = attr_chain(func)
+    return bool(chain) and chain[-1] == "WebSocketResponse"
+
+
 class HttpTimeoutPass(Pass):
     id = _ID
     description = (
         "aiohttp/httpx client constructions pass an explicit timeout= "
-        "(unbounded must be spelled ClientTimeout(total=None, ...))"
+        "(unbounded must be spelled ClientTimeout(total=None, ...)); "
+        "ws_connect and WebSocketResponse carry heartbeat= liveness"
     )
 
     def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
         findings: list[Finding] = []
         for node in ast.walk(f.tree):
-            if not (isinstance(node, ast.Call) and _is_client_ctor(node.func)):
-                continue
-            if any(kw.arg == "timeout" for kw in node.keywords):
+            if not isinstance(node, ast.Call):
                 continue
             if any(kw.arg is None for kw in node.keywords):
                 continue  # **kwargs may carry it; reviewers own that site
-            findings.append(
-                Finding(
-                    self.id, f.rel, node.lineno,
-                    "HTTP client built without an explicit timeout=",
-                    hint="pass timeout=..., or timeout=ClientTimeout("
-                    "total=None, connect=...) for a deliberately unbounded "
-                    "stream",
-                )
-            )
+            kwargs = {kw.arg for kw in node.keywords}
+            if _is_client_ctor(node.func):
+                if "timeout" not in kwargs:
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, node.lineno,
+                            "HTTP client built without an explicit timeout=",
+                            hint="pass timeout=..., or timeout=ClientTimeout("
+                            "total=None, connect=...) for a deliberately "
+                            "unbounded stream",
+                        )
+                    )
+            elif _is_ws_connect(node.func):
+                if "heartbeat" not in kwargs and "timeout" not in kwargs:
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, node.lineno,
+                            "WebSocket connect without heartbeat= (or an "
+                            "explicit timeout=): a half-dead peer hangs the "
+                            "read forever",
+                            hint="pass heartbeat=<seconds> so liveness is "
+                            "probed and the reconnect path can run",
+                        )
+                    )
+            elif _is_ws_response_ctor(node.func):
+                if "heartbeat" not in kwargs:
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, node.lineno,
+                            "WebSocketResponse built without heartbeat=: "
+                            "dead client sockets are never reaped",
+                            hint="pass heartbeat=<seconds>",
+                        )
+                    )
         return findings

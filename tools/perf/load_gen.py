@@ -71,8 +71,13 @@ async def run_load(
     ``execute`` (async callable ``(i) -> status_str``) replaces the HTTP
     request with an in-process call — the gateway_qps bench drives
     ``ExecutionGateway.execute_sync`` directly through the same loop,
-    percentile math, and report shape as the HTTP tool."""
+    percentile math, and report shape as the HTTP tool. An execute hook may
+    instead return ``(status_str, ttft_seconds | None)`` — streaming-capable
+    scenarios report time-to-first-frame percentiles (``ttft_ms``)
+    alongside full-completion latency, since TTFT, not completion, is the
+    latency an agent loop actually waits on."""
     latencies: list[float] = []
+    ttfts: list[float] = []
     statuses: dict[str, int] = {}
     http_errors: dict[str, int] = {}
     sem = asyncio.Semaphore(concurrency)
@@ -97,6 +102,10 @@ async def run_load(
             try:
                 if execute is not None:
                     status = await execute(i)
+                    if isinstance(status, tuple):
+                        status, ttft = status
+                        if ttft is not None:
+                            ttfts.append(ttft)
                 elif mode == "sync":
                     async with session.post(
                         f"{url}/api/v1/execute/{target}", json={"input": payload}
@@ -132,7 +141,7 @@ async def run_load(
         elapsed = time.perf_counter() - t_start
 
     ok = statuses.get("completed", 0)
-    return {
+    report = {
         "target": target,
         "mode": mode,
         "requests": requests,
@@ -149,6 +158,14 @@ async def run_load(
         "statuses": statuses,
         "errors": http_errors,
     }
+    if ttfts:
+        report["ttft_ms"] = {
+            "p50": round(percentile(ttfts, 50) * 1e3, 1),
+            "p95": round(percentile(ttfts, 95) * 1e3, 1),
+            "p99": round(percentile(ttfts, 99) * 1e3, 1),
+            "samples": len(ttfts),
+        }
+    return report
 
 
 async def _poll(session, url: str, eid: str, timeout: float) -> str:

@@ -38,6 +38,17 @@ Injection points in-tree:
                                deterministic token index); recovery must
                                reattach by exec_id + last seq or apply the
                                frames-delivered failover rule
+``kv.offload_stall``           the KV offload worker's device→host page copy
+                               stalls ``delay_s`` before committing (consulted
+                               once per demote, OFF the scheduler thread) —
+                               a stalled copy must never corrupt the pool or
+                               block the tick path; meanwhile the page stays
+                               HBM-resident and evictable as usual
+``kv.restore_fail``            a host-tier KV restore fails before the
+                               host→device copy (consulted once per restore
+                               attempt; ``times: K`` fails the first K) — the
+                               lookup degrades to a shorter cached prefix and
+                               the engine re-prefills the rest, token-exact
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -67,6 +78,8 @@ KNOWN_POINTS = (
     "engine.page_pressure",
     "engine.preempt_storm",
     "channel.drop",
+    "kv.offload_stall",
+    "kv.restore_fail",
 )
 
 

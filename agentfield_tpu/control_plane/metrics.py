@@ -9,12 +9,13 @@ step duration histogram, backpressure counter) plus serving-engine gauges
 Gauges and counters take an optional ``labels`` dict (rendered as
 ``name{k="v"}``); label values are escaped per the exposition format. The
 label path exists for per-node engine gauges — a model node's heartbeat
-stats (prefix-cache hit/miss/eviction/shared-page counters, and the
-scheduler-latency gauges ``itl_ms_p50``/``itl_ms_p99``/``tokens_per_tick``
-from the mixed token-budget scheduler, docs/MIXED_SCHEDULING.md) are
-re-exported here by the registry via :func:`export_engine_stats`, so one
-control-plane /metrics scrape covers the whole fleet's cache and
-scheduling behavior.
+stats (prefix-cache hit/miss/eviction/shared-page counters, the tiered-KV
+offload family ``kv_offload_{demoted,restored,restore_fail,host_pages}``
+(docs/PREFIX_CACHING.md "Tiered cache"), and the scheduler-latency gauges
+``itl_ms_p50``/``itl_ms_p99``/``tokens_per_tick`` from the mixed
+token-budget scheduler, docs/MIXED_SCHEDULING.md) are re-exported here by
+the registry via :func:`export_engine_stats`, so one control-plane
+/metrics scrape covers the whole fleet's cache and scheduling behavior.
 """
 
 from __future__ import annotations

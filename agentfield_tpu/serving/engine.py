@@ -46,6 +46,7 @@ from agentfield_tpu.serving.grammar import Grammar
 from agentfield_tpu.serving.kv_cache import (
     PagedKVCache,
     PrefixPagePool,
+    _kv_fault,
     build_page_table,
     pack_ragged_rows,
     page_chain_hashes,
@@ -128,6 +129,18 @@ class EngineConfig:
     # stops paying for max_batch (one extra compile per bucket)
     session_ttl: float = 600.0  # idle cached sessions release their pages
     # after this long even without allocation pressure (0 disables)
+    host_cache_bytes: int = 0  # tiered KV (docs/PREFIX_CACHING.md "Tiered
+    # cache"): byte budget of a host-RAM second tier under the shared-prefix
+    # pool. Refcount-0 cached pages demote HBM→host (async device-to-host
+    # copy on an offload worker, OFF the tick path) under allocation
+    # pressure and when idle sessions expire; a prefix lookup or session
+    # resume that matches a host-tier entry restores it into a freshly
+    # allocated HBM page before admission — token-exact under greedy,
+    # slower than an HBM hit, far cheaper than a re-prefill. Under HBM
+    # pressure the engine thus degrades long-lived sessions to a slower
+    # tier instead of silently losing them. 0 (the default) disables the
+    # tier — the pool is bit-compatible with the single-tier behavior.
+    # Requires shared_prefix_cache (the tier is content-addressed).
     grammar_slots: int = 0  # constrained-decoding state capacity (rows of the
     # device-resident token-transition bank). 0 disables the masking path —
     # the decode step then skips the [B, V] mask gather entirely. Each
@@ -720,6 +733,32 @@ def _copy_page_fn():
 
 
 @functools.lru_cache(maxsize=None)
+def _restore_page_fn():
+    """Jitted host→device page restore (tiered KV, docs/PREFIX_CACHING.md
+    "Tiered cache"): write a BATCH of pages' K/V across all layers back
+    into the paged pool in one dispatch (``dst`` is [N]; values [L, N,
+    ...]) — one lookup's worth of restores costs one call, not one per
+    page. jit re-specializes per (pool shape, N) like _copy_page_fn."""
+
+    def up(kp, vp, k, v, dst):
+        return (
+            kp.at[:, dst].set(k.astype(kp.dtype)),
+            vp.at[:, dst].set(v.astype(vp.dtype)),
+        )
+
+    return jax.jit(up, donate_argnums=(0, 1))
+
+
+def _fetch_page_kv(handle):
+    """Offload-worker side of a KV demote: the blocking device→host
+    transfer of one captured page (runs on the pool's offload thread, no
+    locks held — see InferenceEngine._capture_page_kv for why the handle's
+    content is immune to the scheduler's concurrent donating dispatches)."""
+    k_slice, v_slice = handle
+    return np.asarray(k_slice), np.asarray(v_slice)
+
+
+@functools.lru_cache(maxsize=None)
 def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
     ps = ecfg.page_size
 
@@ -963,20 +1002,11 @@ def _mixed_step_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None)
     return jax.jit(mixed, donate_argnums=(1, 2))
 
 
-def _engine_fault(point: str):
-    """Consult the control-plane fault injector WITHOUT importing the (HTTP-
-    heavy) control_plane package into every engine process: if the faults
-    module was never imported and the env knob is unset, no injector can
-    exist and this is two dict lookups."""
-    import os
-    import sys
-
-    m = sys.modules.get("agentfield_tpu.control_plane.faults")
-    if m is None:
-        if not os.environ.get("AGENTFIELD_FAULTS"):
-            return None
-        from agentfield_tpu.control_plane import faults as m
-    return m.fire(point)
+# Fault-injector probe without importing the HTTP-heavy control_plane
+# package into every engine process; ONE definition (kv_cache._kv_fault,
+# shared with the offload worker's kv.* points) so the activation contract
+# cannot drift between the scheduler's and the pool's consultations.
+_engine_fault = _kv_fault
 
 
 def _setup_compile_cache(ecfg: EngineConfig) -> None:
@@ -1330,6 +1360,32 @@ class InferenceEngine:
         # free_session() run on the event loop: session+allocator mutations
         # need mutual exclusion.
         self._session_lock = threading.RLock()
+        # Tiered KV (docs/PREFIX_CACHING.md "Tiered cache"): a host-RAM
+        # second tier under the shared-prefix pool. The pool owns the tier
+        # state and the offload worker; the engine supplies the three
+        # device-copy callbacks and its _session_lock as the serializer.
+        if self.ecfg.host_cache_bytes > 0:
+            if not self._shared_prefix:
+                raise ValueError(
+                    f"host_cache_bytes={self.ecfg.host_cache_bytes} requires "
+                    "enable_prefix_cache and shared_prefix_cache: the host "
+                    "tier is content-addressed"
+                )
+            kb = self.cache.k_pages
+            page_bytes = 2 * (kb.size // kb.shape[1]) * kb.dtype.itemsize
+            self.allocator.enable_host_tier(
+                budget_bytes=self.ecfg.host_cache_bytes,
+                page_bytes=page_bytes,
+                lock=self._session_lock,
+                capture=self._capture_page_kv,
+                fetch=_fetch_page_kv,
+                upload=self._upload_page_kv,
+                # Restore targets come from the session-evicting allocator:
+                # a pool fully pinned by idle LIVE sessions must still
+                # restore (the resume it serves is a live request — it
+                # wins over cached prefixes, same rule as admission).
+                restore_alloc=lambda: self._alloc_with_eviction(1),
+            )
         # Guards self.pending: submit() appends from the event-loop thread
         # while _drain_cancels() rebuilds the deque on the worker thread —
         # unguarded, an append during the rebuild raises RuntimeError or is
@@ -1648,9 +1704,20 @@ class InferenceEngine:
         t = at if at is not None else time.time()
         with self._session_lock:
             dead = [sid for sid, s in self._sessions.items() if t - s.last_used > ttl]
+            demote: list[int] = []
             for sid in dead:
-                self.allocator.free(self._sessions.pop(sid).pages)
+                pages = self._sessions.pop(sid).pages
+                self.allocator.free(pages)
                 self.stats["sessions_evicted"] += 1
+                demote += pages
+            if demote:
+                # Idle-session expiry is the canonical demote trigger
+                # (docs/PREFIX_CACHING.md "Tiered cache"): the session's
+                # published pages just went refcount-0 — move them to host
+                # RAM now so a later resume restores instead of
+                # re-prefilling once churn evicts them. No-op with the
+                # host tier off; partial tail pages (not indexed) skip.
+                self.allocator.demote_pages(demote)
         return len(dead)
 
     def free_session(self, session_id: str) -> bool:
@@ -2181,6 +2248,40 @@ class InferenceEngine:
                 jnp.int32(src), jnp.int32(dst),
             )
 
+    def _capture_page_kv(self, page: int):
+        """Demote capture (pool callback; scheduler/event-loop thread under
+        _session_lock): lazy device slices of one page's K/V. Slicing
+        dispatches NEW device buffers whose content is the page AT CAPTURE
+        TIME — later donating decode/prefill dispatches recycle the parent
+        pool buffer, never these — so the offload worker can run the
+        device→host transfer (_fetch_page_kv) off-thread without racing the
+        tick path. Target cache only: a restored page's DRAFT-cache twin
+        stays stale, which can only lower speculative acceptance (the
+        verify forward reads the target cache — emitted tokens are exact)."""
+        return (self.cache.k_pages[:, page], self.cache.v_pages[:, page])
+
+    def _upload_page_kv(self, payloads, pages: list[int]) -> None:
+        """Restore host-tier payloads into HBM `pages` (pool callback;
+        admission path under _session_lock) — ONE jitted scatter for the
+        whole batch. The round-tripped bytes are bit-identical, so
+        attention over restored pages is token-exact."""
+        k_host = np.stack([p[0] for p in payloads], axis=1)  # [L, N, ...]
+        v_host = np.stack([p[1] for p in payloads], axis=1)
+        fn = _restore_page_fn()
+        self.cache.k_pages, self.cache.v_pages = fn(
+            self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(k_host), jnp.asarray(v_host),
+            jnp.asarray(np.asarray(pages, np.int32)),
+        )
+
+    def close(self) -> None:
+        """Release background resources (the KV offload worker). Idempotent;
+        the engine stays steppable afterwards — demotion simply stops."""
+        # close() only joins the worker thread (no pool bookkeeping is
+        # touched) and MUST NOT hold _session_lock — the worker needs the
+        # lock to commit its in-flight item before it can exit.
+        self.allocator.close()  # afcheck: ignore[guarded-by] thread join only; holding the lock would deadlock the worker's final commit
+
     def scheduler_stats(self) -> dict[str, float]:
         """Scheduler-latency gauges (docs/MIXED_SCHEDULING.md): inter-token
         arrival percentiles over a rolling window (the stall the mixed tick
@@ -2209,6 +2310,10 @@ class InferenceEngine:
                 "prefix_cached_pages": a.cached_pages,
                 "prefix_shared_pages": a.shared_pages,
                 "cached_sessions": len(self._sessions),
+                # Tiered KV: demoted entries resident in the host store
+                # (counters kv_offload_{demoted,restored,restore_fail} live
+                # in self.stats; this is the matching occupancy gauge).
+                "kv_offload_host_pages": a.host_pages,
             }
 
     def _install(
@@ -2583,17 +2688,24 @@ class InferenceEngine:
         with self._session_lock:
             cached_pages = self._cached_prefix_len(cand) // self.ecfg.page_size
             evictable_overlap = 0
+            host_overlap = 0
             if (
                 cached_pages
                 and self._shared_prefix
                 and not (cand.session_id and cand.session_id in self._sessions)
             ):
-                evictable_overlap = self.allocator.evictable_prefix_pages(
+                # One chain walk for both counts. HOST-tier prefix pages
+                # count as cached (peek matches them: no prefill FLOPs)
+                # but each restore CONSUMES a fresh HBM page — add them
+                # back to the allocation need, or a host-heavy prefix
+                # reads "not starved" in exactly the band where
+                # admission's restore path fails on pages.
+                evictable_overlap, host_overlap = self.allocator.prefix_overlap_pages(
                     cand.prompt[: len(cand.prompt) - 1],
                     hashes=self._prompt_hashes(cand),
                 )
             return (
-                self._pages_needed(cand) - cached_pages
+                self._pages_needed(cand) - cached_pages + host_overlap
                 > self.allocator.free_pages - evictable_overlap
             )
 

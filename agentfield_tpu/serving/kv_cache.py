@@ -21,7 +21,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 import hashlib
-from typing import Iterator, Sequence
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -176,15 +179,48 @@ def page_chain_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
     return out
 
 
+def _kv_fault(point: str):
+    """Consult the control-plane fault injector WITHOUT importing the (HTTP-
+    heavy) control_plane package into every engine process (the engine
+    aliases this as _engine_fault — one definition of the activation
+    contract): if the faults module was never imported and the env knob is
+    unset, no injector can exist and this is two dict lookups."""
+    import os
+    import sys
+
+    m = sys.modules.get("agentfield_tpu.control_plane.faults")
+    if m is None:
+        if not os.environ.get("AGENTFIELD_FAULTS"):
+            return None
+        from agentfield_tpu.control_plane import faults as m
+    return m.fire(point)
+
+
+TIER_HBM = "hbm"
+TIER_HOST = "host"
+
+# Bound on queued demotes: each queue entry pins a captured device-side page
+# copy until the worker transfers it, so an unbounded queue under a stalled
+# worker would silently double the HBM the offload exists to reclaim.
+_DEMOTE_QUEUE_MAX = 64
+
+
 @dataclasses.dataclass
 class PageRecord:
     """One content-addressed page: the chain hash that names it and the page
-    of token ids backing that hash (kept for collision verification)."""
+    of token ids backing that hash (kept for collision verification).
+
+    ``tier`` is the record's residence (docs/PREFIX_CACHING.md "Tiered
+    cache"): TIER_HBM entries live in a device page (``page`` valid, the
+    single-tier behavior); TIER_HOST entries were demoted — their KV sits in
+    the pool's host store keyed by ``chain`` and ``page`` is -1 until a
+    restore re-adopts them into a freshly allocated HBM page."""
 
     page: int
     chain: bytes
     tokens: tuple[int, ...]
     last_used: float  # logical LRU clock, maintained by the pool
+    tier: str = TIER_HBM
 
 
 class PrefixPagePool:
@@ -233,8 +269,46 @@ class PrefixPagePool:
         # Shared counter surface (the engine passes its stats dict so pool
         # events ride heartbeats/metrics without a mirror-copy step).
         self.stats = stats if stats is not None else {}
-        for k in ("prefix_pages_published", "prefix_pages_evicted", "prefix_pages_reused"):
+        for k in (
+            "prefix_pages_published",
+            "prefix_pages_evicted",
+            "prefix_pages_reused",
+            # Tiered KV (docs/PREFIX_CACHING.md "Tiered cache") — exported
+            # even with the tier off so the /stats→heartbeat→Prometheus
+            # pipeline always carries the family:
+            "kv_offload_demoted",
+            "kv_offload_restored",
+            "kv_offload_restore_fail",
+            "kv_offload_demote_fail",
+            "kv_offload_host_evicted",
+        ):
             self.stats.setdefault(k, 0)
+        # ---- host (offload) tier — inert until enable_host_tier() wires the
+        # device-copy callbacks; every branch below checks _host_enabled so
+        # the disabled pool is bit-compatible with the single-tier one.
+        self._host_enabled = False
+        # Host store: chain hash -> opaque KV payload, insertion-ordered so
+        # the oldest demotion evicts first. Together with _lru this forms ONE
+        # logical LRU spanning both tiers: demotion moves the LRU's oldest
+        # entries here, budget pressure drops this dict's oldest entries.
+        self._host: collections.OrderedDict[bytes, Any] = collections.OrderedDict()  # guarded by: external(engine _session_lock)
+        self._host_bytes = 0  # guarded by: external(engine _session_lock)
+        # Demote queue: (chain, page, captured device handle) awaiting the
+        # worker's device→host transfer; _demote_inflight tracks chains
+        # queued or mid-copy so a page is never captured twice.
+        self._demote_q: collections.deque[tuple[bytes, int, Any]] = collections.deque()  # guarded by: external(engine _session_lock)
+        self._demote_inflight: set[bytes] = set()  # guarded by: external(engine _session_lock)
+        self._host_budget = 0
+        self._page_bytes = 1
+        self._demote_watermark = 0
+        self._ext_lock: Any = None  # the OWNER's serializer (engine _session_lock)
+        self._capture: Callable[[int], Any] | None = None
+        self._fetch: Callable[[Any], Any] | None = None
+        self._upload: Callable[[list[Any], list[int]], None] | None = None
+        self._restore_alloc: Callable[[], list[int] | None] | None = None
+        self._offload_wake = threading.Event()
+        self._offload_stop = False
+        self._offload_thread: threading.Thread | None = None
 
     # -- gauges ---------------------------------------------------------
 
@@ -248,6 +322,17 @@ class PrefixPagePool:
     def cached_pages(self) -> int:
         """Pages resident in the content index (live shared + refcount-0)."""
         return len(self._by_page)
+
+    @property
+    def host_pages(self) -> int:
+        """Host-tier (demoted) entries. These are NOT instantly allocatable
+        — each restore consumes a fresh HBM page — so they never count in
+        :attr:`free_pages`."""
+        return len(self._host)
+
+    @property
+    def host_bytes(self) -> int:
+        return self._host_bytes
 
     @property
     def shared_pages(self) -> int:
@@ -287,6 +372,12 @@ class PrefixPagePool:
                 self.stats["prefix_pages_evicted"] += 1
             self._refs[p] = 1
             out.append(p)
+        if self._host_enabled and len(self._free) < self._demote_watermark:
+            # Allocation pressure: start demoting the LRU tail BEFORE the
+            # free list runs dry, so the eviction above (which loses the
+            # page's KV for good) stays the rare path. The copies run on the
+            # offload worker — this only enqueues.
+            self.demote_lru(8)
         return out
 
     def incref(self, pages: list[int]) -> None:
@@ -350,27 +441,84 @@ class PrefixPagePool:
         :attr:`free_pages`, but an admission :meth:`lookup` increfs them OUT
         of the evictable pool — capacity probes that subtract the cached
         prefix from a request's page need must also subtract this overlap
-        from ``free_pages``, or they double-count the same pages."""
+        from ``free_pages``, or they double-count the same pages. HOST-tier
+        entries are excluded: a demoted page is not instantly allocatable
+        (its restore CONSUMES a fresh page instead of supplying one)."""
         return sum(
             1
             for rec in self._prefix_chain(tokens, hashes)
-            if self._refs[rec.page] == 0
+            if rec.tier == TIER_HBM and self._refs[rec.page] == 0
         )
+
+    def host_prefix_pages(
+        self, tokens: Sequence[int], hashes: list[bytes] | None = None
+    ) -> int:
+        """Of the longest indexed full-page prefix of `tokens`, how many
+        entries are HOST-tier? Each such page needs a FRESH HBM page as its
+        restore target, so capacity probes must add this count back to the
+        request's allocation need (peek() counts host entries as cached).
+        Keyed on store occupancy, not the enabled flag: entries demoted
+        before a close() still restore (and still cost a page)."""
+        if not self._host:
+            return 0
+        return sum(
+            1 for rec in self._prefix_chain(tokens, hashes) if rec.tier == TIER_HOST
+        )
+
+    def prefix_overlap_pages(
+        self, tokens: Sequence[int], hashes: list[bytes] | None = None
+    ) -> tuple[int, int]:
+        """(evictable, host) counts of the prompt's indexed prefix in ONE
+        chain walk — the pair every starvation probe needs per tick; the
+        two single-count methods above remain for callers wanting one."""
+        evictable = host = 0
+        for rec in self._prefix_chain(tokens, hashes):
+            if rec.tier == TIER_HOST:
+                host += 1
+            elif self._refs[rec.page] == 0:
+                evictable += 1
+        return evictable, host
 
     def lookup(
         self, tokens: Sequence[int], hashes: list[bytes] | None = None
     ) -> tuple[list[int], int]:
         """Longest indexed full-page chain prefix of `tokens`. Returns
         (pages, matched_token_count); the caller owns one reference on each
-        returned page (balance with free())."""
+        returned page (balance with free()).
+
+        HOST-tier entries restore on the way (host→device copy into freshly
+        allocated pages, ONE batched upload per lookup — per-page dispatch
+        overhead would eat the saving on short pages) so the caller sees
+        ordinary HBM pages; a restore that cannot proceed (no allocatable
+        page, injected ``kv.restore_fail``, copy error) truncates the match
+        at that page — the caller admits with the shorter prefix and
+        re-prefills the rest, token-exact."""
         pages: list[int] = []
         t = self._tick()
+        # (record, tentative target page, payload) awaiting the one upload
+        pending: list[tuple[PageRecord, int, Any]] = []
         for rec in self._prefix_chain(tokens, hashes):
+            if rec.tier == TIER_HOST:
+                prep = self._prepare_restore(rec)
+                if prep is None:
+                    break  # degrade to a plain re-prefill of the remainder
+                rec.last_used = t
+                pending.append(prep)
+                pages.append(prep[1])  # alloc above IS our reference
+                continue
             rec.last_used = t
             if self._refs[rec.page] == 0:
                 self._lru.pop(rec.page, None)
             self._refs[rec.page] += 1
             pages.append(rec.page)
+        if pending and not self._commit_restores(pending):
+            # The batched upload failed: truncate the match at the FIRST
+            # pending restore — release the tentative pages (never indexed;
+            # they go back to the free list) and the references taken on
+            # anything matched after that point.
+            cut = pages.index(pending[0][1])
+            self.free(pages[cut:])
+            pages = pages[:cut]
         self.stats["prefix_pages_reused"] += len(pages)
         return pages, len(pages) * self.page_size
 
@@ -396,7 +544,22 @@ class PrefixPagePool:
             if rec is not None:
                 if rec.tokens == page_toks:
                     rec.last_used = t
-                    if self._refs[rec.page] == 0:
+                    if rec.tier == TIER_HOST:
+                        # The publisher holds this exact chain's KV in HBM
+                        # RIGHT NOW: re-adopt its page instead of keeping the
+                        # slower host copy — a free un-demote (the host
+                        # payload is dropped; the publisher's release later
+                        # lands the page refcount-0 cached as usual).
+                        p = pages[i]
+                        if p not in self._by_page:
+                            if self._host.pop(rec.chain, None) is not None:
+                                self._host_bytes -= self._page_bytes
+                            rec.tier = TIER_HBM
+                            rec.page = p
+                            self._by_page[p] = rec
+                            if self._refs[p] == 0:
+                                self._lru[p] = None
+                    elif self._refs[rec.page] == 0:
                         self._lru.move_to_end(rec.page)
                 continue  # same chain cached, or a hash collision: keep incumbent
             p = pages[i]
@@ -437,3 +600,277 @@ class PrefixPagePool:
             del self._lru[page]
         if self._refs[page] == 0:
             self._free.append(page)
+
+    # -- host (offload) tier -------------------------------------------
+    #
+    # docs/PREFIX_CACHING.md "Tiered cache". Lifecycle of one page:
+    #
+    #   HBM cached (refcount-0 LRU)
+    #     --enqueue (pressure watermark / idle-session expiry)-->  demote queue
+    #     --worker: D2H copy OFF the tick path, then commit under the
+    #       external lock (aborts if the page was reused/incref'd/evicted
+    #       meanwhile — a stalled or failed copy can never corrupt)-->
+    #   HOST (record.tier=HOST, HBM page back on the free list)
+    #     --lookup()/session-resume hit: alloc fresh page + H2D copy-->
+    #   HBM cached again (restore), or
+    #     --host budget pressure: oldest host entry dropped-->  gone.
+    #
+    # All state below is serialized by the OWNER's lock (the engine's
+    # _session_lock, passed to enable_host_tier); the worker takes it only
+    # for O(1) queue pops and commits, never across a device copy, so it can
+    # never deadlock or stall the scheduler thread.
+
+    def enable_host_tier(
+        self,
+        *,
+        budget_bytes: int,
+        page_bytes: int,
+        lock: Any,
+        capture: Callable[[int], Any],
+        fetch: Callable[[Any], Any],
+        upload: Callable[[list[Any], list[int]], None],
+        restore_alloc: Callable[[], list[int] | None] | None = None,
+        watermark: int | None = None,
+    ) -> None:
+        """Arm the host tier. ``capture(page)`` snapshots a page's KV as an
+        opaque device handle (cheap, called under the lock at enqueue time —
+        the handle's CONTENT is fixed at capture, so later reuse of the HBM
+        page cannot corrupt the copy); ``fetch(handle)`` is the blocking
+        device→host transfer (worker thread, no lock held); ``upload
+        (payloads, pages)`` is the BATCHED host→device restore — one call
+        per lookup, however many pages it matched in the host tier (caller
+        thread, under the lock). ``restore_alloc`` supplies the restore's target page —
+        the engine passes its session-evicting allocator, because a pool
+        fully pinned by LIVE idle sessions would otherwise fail every
+        restore (the pool itself cannot evict sessions: they hold live
+        references) and silently degrade resumes to re-prefill forever.
+        ``lock`` must be the same lock that serializes every other pool
+        call."""
+        if budget_bytes <= 0:
+            raise ValueError(f"budget_bytes={budget_bytes} must be > 0")
+        if page_bytes <= 0:
+            raise ValueError(f"page_bytes={page_bytes} must be > 0")
+        if self._host_enabled:
+            raise RuntimeError("host tier already enabled")
+        if self._offload_thread is not None:
+            # close() timed out on a stalled worker: starting a second one
+            # would race the first's eventual commit attempts
+            raise RuntimeError("previous offload worker still draining")
+        self._host_budget = int(budget_bytes)
+        self._page_bytes = int(page_bytes)
+        self._ext_lock = lock
+        self._capture, self._fetch, self._upload = capture, fetch, upload
+        self._restore_alloc = restore_alloc
+        # Start demoting while this many free pages remain: early enough
+        # that the async copy usually wins the race against hard eviction,
+        # late enough that a lightly loaded pool never churns D2H copies.
+        self._demote_watermark = (
+            watermark if watermark is not None else max(2, self.num_pages // 8)
+        )
+        self._offload_stop = False  # close() may have armed it: a re-enabled
+        # tier must get a worker that actually runs (and commits)
+        self._host_enabled = True
+        self._offload_thread = threading.Thread(
+            target=self._offload_worker, name="kv-offload", daemon=True
+        )
+        self._offload_thread.start()
+
+    def demote_lru(self, n: int | None = None) -> int:
+        """Enqueue up to `n` (all, when None) of the OLDEST refcount-0
+        cached pages for demotion to the host tier. Returns the number
+        enqueued; the copies land asynchronously (offload_drain to wait).
+
+        Runs on the admission hot path (alloc's watermark trigger), so:
+        full demote queue → immediate no-op, and the bounded form scans at
+        most 4n LRU entries (the oldest few may already be in flight)
+        instead of materializing the whole LRU. _enqueue_demote never
+        mutates _lru, so iterating the live dict is safe."""
+        if not self._host_enabled or len(self._demote_q) >= _DEMOTE_QUEUE_MAX:
+            return 0
+        scan = iter(self._lru) if n is None else itertools.islice(self._lru, 4 * n)
+        count = 0
+        for p in scan:
+            if n is not None and count >= n:
+                break
+            if self._enqueue_demote(p):
+                count += 1
+        return count
+
+    def demote_pages(self, pages: Sequence[int]) -> int:
+        """Enqueue specific pages for demotion — the idle-session expiry
+        hook (engine.gc_sessions): an expired session's KV should move to
+        host RAM, not linger as HBM-evictable until churn drops it. Pages
+        that are not refcount-0 indexed entries are skipped."""
+        if not self._host_enabled:
+            return 0
+        return sum(1 for p in pages if self._enqueue_demote(p))
+
+    def _enqueue_demote(self, page: int) -> bool:
+        rec = self._by_page.get(page)
+        if (
+            rec is None
+            or self._refs[page] != 0
+            or rec.chain in self._demote_inflight
+            or len(self._demote_q) >= _DEMOTE_QUEUE_MAX
+            or self._page_bytes > self._host_budget
+        ):
+            return False
+        try:
+            handle = self._capture(page)
+        except Exception:
+            self.stats["kv_offload_demote_fail"] += 1
+            return False
+        self._demote_q.append((rec.chain, page, handle))
+        self._demote_inflight.add(rec.chain)
+        self._offload_wake.set()
+        return True
+
+    def _offload_worker(self) -> None:
+        """Drain the demote queue: device→host copy OUTSIDE the lock, O(1)
+        commit under it. The only thread besides the pool's owner that
+        touches pool state — and only in the two `with` blocks below."""
+        while True:
+            self._offload_wake.wait(timeout=0.5)
+            self._offload_wake.clear()
+            if self._offload_stop:
+                return
+            while True:
+                with self._ext_lock:
+                    if not self._demote_q:
+                        break
+                    chain, page, handle = self._demote_q.popleft()
+                try:
+                    payload = self._fetch(handle)  # blocking D2H, no lock
+                except Exception:
+                    with self._ext_lock:
+                        self._demote_inflight.discard(chain)
+                        # demote failure keeps the HBM page: the record was
+                        # never touched, the page stays cached/evictable
+                        self.stats["kv_offload_demote_fail"] += 1
+                    continue
+                fault = _kv_fault("kv.offload_stall")
+                if fault is not None and fault.delay_s > 0:
+                    time.sleep(fault.delay_s)  # chaos: a slow copy — the
+                    # pool must keep working on the captured-at-enqueue
+                    # snapshot semantics while this sleeps
+                with self._ext_lock:
+                    self._demote_inflight.discard(chain)
+                    self._commit_demote(chain, page, payload)
+
+    def _commit_demote(self, chain: bytes, page: int, payload: Any) -> None:  # guarded by: external(engine _session_lock)
+        if self._offload_stop:
+            return  # close() promised demotion stops: a worker surfacing
+            # from a stalled copy after (or during) close commits nothing
+        rec = self._by_hash.get(chain)
+        if (
+            rec is None
+            or rec.tier != TIER_HBM
+            or rec.page != page
+            or self._refs[page] != 0
+        ):
+            # The page was evicted, re-allocated, or incref'd while the copy
+            # was in flight: the HBM state wins, the copy is discarded. This
+            # is the corruption guard the kv.offload_stall chaos test leans
+            # on — a late copy commits NOTHING unless the record is exactly
+            # as captured.
+            return
+        self._host[chain] = payload
+        self._host_bytes += self._page_bytes
+        del self._by_page[page]
+        self._lru.pop(page, None)
+        self._free.append(page)
+        rec.tier = TIER_HOST
+        rec.page = -1
+        self.stats["kv_offload_demoted"] += 1
+        while self._host_bytes > self._host_budget and self._host:
+            # Budget pressure drops the OLDEST host entries — the spanning
+            # LRU's far end. Gone for real (re-prefill recreates them).
+            old_chain, _ = self._host.popitem(last=False)
+            self._host_bytes -= self._page_bytes
+            self._by_hash.pop(old_chain, None)
+            self.stats["kv_offload_host_evicted"] += 1
+
+    def _prepare_restore(self, rec: PageRecord) -> tuple[PageRecord, int, Any] | None:
+        """Phase 1 of a restore (caller holds the external lock): consult
+        the fault schedule, find the payload, and allocate the target page.
+        Returns (record, page, payload) for the batched upload, or None —
+        in which case the HOST entry is KEPT (a transient failure may
+        succeed on the next attempt; a permanently failing entry heals when
+        a re-prefill re-publishes the chain, which re-adopts the record
+        into HBM and drops the payload)."""
+        fault = _kv_fault("kv.restore_fail")
+        if fault is not None:
+            self.stats["kv_offload_restore_fail"] += 1
+            return None
+        payload = self._host.get(rec.chain)
+        if payload is None:
+            return None  # defensive: record/store desync degrades to a miss
+        # The engine's allocator can evict idle SESSIONS for the target
+        # page (live requests win over cached prefixes — and this restore
+        # serves a live request); the plain pool alloc is the fallback.
+        got = self._restore_alloc() if self._restore_alloc is not None else self.alloc(1)
+        if got is None:
+            # No allocatable target page: the caller re-prefills instead.
+            # Counted — docs/OPERATIONS.md tells operators a restore_fail
+            # spike means "too full to restore into", and sustained page
+            # exhaustion is exactly the common real-world shape of that.
+            self.stats["kv_offload_restore_fail"] += 1
+            return None
+        return rec, got[0], payload
+
+    def _commit_restores(self, pending: list[tuple[PageRecord, int, Any]]) -> bool:
+        """Phase 2: ONE batched host→device upload for every page the walk
+        matched in the host tier, then the index flips. All-or-nothing: on
+        upload failure nothing commits (entries kept, caller truncates)."""
+        try:
+            self._upload([p for _, _, p in pending], [pg for _, pg, _ in pending])
+        except Exception:
+            self.stats["kv_offload_restore_fail"] += 1
+            return False
+        for rec, page, _ in pending:
+            del self._host[rec.chain]
+            self._host_bytes -= self._page_bytes
+            rec.tier = TIER_HBM
+            rec.page = page
+            self._by_page[page] = rec
+            self.stats["kv_offload_restored"] += 1
+        return True
+
+    def offload_drain(self, timeout: float = 10.0) -> bool:
+        """Block until the demote queue is empty and no copy is in flight
+        (tests, bench, shutdown). Must be called WITHOUT the external lock
+        held — the worker needs it to commit."""
+        if not self._host_enabled:
+            return True
+        deadline = time.monotonic() + timeout
+        self._offload_wake.set()
+        while time.monotonic() < deadline:
+            with self._ext_lock:
+                if not self._demote_q and not self._demote_inflight:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    def close(self) -> None:
+        """Stop the offload worker (idempotent; no-op when the tier was
+        never enabled). The pool remains usable and HOST entries still
+        restore on lookup — only DEMOTION stops: the enabled flag drops and
+        the queue is cleared, or post-close watermark/expiry triggers would
+        keep capturing device page copies into a queue nothing drains."""
+        t = self._offload_thread
+        if t is None:
+            return
+        self._offload_stop = True
+        self._offload_wake.set()
+        # Disarm BEFORE the join: once the stop flag is up, _commit_demote
+        # refuses, so even a worker stalled past the join timeout can never
+        # demote after close() returns.
+        with self._ext_lock:
+            self._host_enabled = False
+            self._demote_q.clear()  # drop captured device buffers
+            self._demote_inflight.clear()
+        t.join(timeout=5.0)
+        if not t.is_alive():
+            # A worker stalled in a long copy keeps its handle: a repeat
+            # close() re-joins instead of silently orphaning the thread.
+            self._offload_thread = None

@@ -381,6 +381,9 @@ class ModelBackend:
         for fut in self._futures.values():
             if not fut.done():
                 fut.cancel()
+        # Stop the KV offload worker (tiered KV; no-op with the tier off) —
+        # a drive loop is gone, so nothing frees pages to demote anyway.
+        await asyncio.to_thread(self.engine.close)
 
     async def _drive_loop(self) -> None:
         """Continuous-batching driver: engine.step() on a worker thread, token

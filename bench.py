@@ -59,6 +59,15 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     Headline value = high-priority success rate (acceptance: 1.0).
     AGENTFIELD_BENCH_LOW/_HIGH size the tiers,
     AGENTFIELD_BENCH_LOW_DEADLINE (s) tunes the shed pressure.
+  session_churn — tiered-KV survival bench (docs/PREFIX_CACHING.md "Tiered
+    cache"): N long-lived sessions each take a turn, go idle past
+    session_ttl (expiry frees AND demotes their KV to the host tier), then
+    all resume — under an HBM budget that holds only a fraction of the idle
+    set. Run twice on the same backend: host tier ON (resumes restore KV
+    host→device) vs OFF (idle KV is lost; resumes re-prefill from scratch).
+    Reports resume TTFT p50/p99 both modes, restore hit rate, and the
+    kv_offload_* counters; headline value = resume TTFT p50 speedup
+    (OFF/ON; acceptance: > 1.0). AGENTFIELD_BENCH_SESSIONS sizes the set.
   fault_storm — control-plane failure-domain bench (no model, no chip;
     docs/FAULT_TOLERANCE.md): a real in-process control plane + two agent
     nodes serving the same component; a seeded FaultInjector schedule kills
@@ -489,11 +498,15 @@ def _run_bench() -> None:
         _overload_storm(model, cfg, params, attn)
         _done.set()
         return
+    if scenario == "session_churn":
+        _session_churn(model, cfg, params, attn)
+        _done.set()
+        return
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
             "(have: shared_prefix_burst, mixed_interference, overload_storm, "
-            "fault_storm, gateway_qps)"
+            "session_churn, fault_storm, gateway_qps)"
         )
 
     demoted = None
@@ -962,6 +975,168 @@ def _overload_storm(model: str, cfg, params, attn: str) -> None:
             "num_pages": ecfg.num_pages,
             "pages_demanded": demand,
             "preempt_fence_ticks": ecfg.preempt_fence_ticks,
+            "attn_impl": attn,
+            "device": str(jax.devices()[0]),
+        }
+    )
+
+
+def _session_churn(model: str, cfg, params, attn: str) -> None:
+    """Tiered-KV survival churn (docs/PREFIX_CACHING.md "Tiered cache"): N
+    long-lived sessions take a turn and go idle past session_ttl — expiry
+    frees AND (host tier on) demotes their KV — under an HBM pool that holds
+    only a fraction of the idle set, then every session resumes. Host tier
+    ON restores KV host→device at admission; OFF re-prefills whatever churn
+    already evicted. Headline: resume TTFT p50 speedup (OFF/ON); acceptance
+    is strictly > 1.0 — surviving the demotion must beat recomputing."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    import dataclasses
+
+    n_sessions = int(os.environ.get("AGENTFIELD_BENCH_SESSIONS") or 12)
+    # History long enough that a cold resume's full re-prefill (bucket 512)
+    # costs real FLOPs next to the warm suffix prefill (bucket 32) — at
+    # short histories, per-dispatch overhead and 1-core timing noise hide
+    # the saving the tier exists to bank.
+    prompt_len, turn_new, resume_new, tail_len = 448, 16, 8, 8
+    page_size = 32
+    # Idle KV per session = the 14 full published pages of its 464-token
+    # history; the pool holds about a third of the idle set, so survival
+    # REQUIRES the second tier.
+    idle_demand = 14 * n_sessions
+    ecfg_on = EngineConfig(
+        max_batch=2,
+        page_size=page_size,
+        num_pages=64,  # 63 usable ≈ 1/3 of idle_demand + active headroom
+        max_pages_per_seq=16,
+        max_pending=64,
+        prefill_batch=1,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: honest TTFT
+        session_ttl=30.0,
+        host_cache_bytes=1 << 30,
+    )
+    ecfg_off = dataclasses.replace(ecfg_on, host_cache_bytes=0)
+
+    def turn1_prompt(i):
+        return jax.random.randint(
+            jax.random.PRNGKey(100 + i), (prompt_len,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    def tail(i):
+        return jax.random.randint(
+            jax.random.PRNGKey(400 + i), (tail_len,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    def run_one(engine, req):
+        """Submit one request on an idle engine; returns (ttft_ms, tokens)."""
+        engine.submit(req)
+        t0 = time.perf_counter()
+        ttft, toks = None, []
+        while engine.has_work():
+            for ev in engine.step():
+                if ev.token >= 0 and ev.request_id == req.id:
+                    if ttft is None:
+                        ttft = (time.perf_counter() - t0) * 1e3
+                    toks.append(ev.token)
+        return ttft, toks
+
+    def req(rid, prompt, max_new, session):
+        return Request(
+            id=rid, prompt=prompt,
+            sampling=SamplingParams(max_new_tokens=max_new), session_id=session,
+        )
+
+    if not _budget_gate("session_churn", 120):
+        _emit(_fallback_payload("budget exhausted before session_churn"))
+        return
+
+    def run_mode(ecfg):
+        # Warm every compile path out of the timing: turn-1 prefill (bucket
+        # 512) + decode, the warm-resume suffix prefill (bucket 32), and
+        # the cold full re-prefill.
+        warm = InferenceEngine(params, cfg, ecfg)
+        _, w_out = run_one(warm, req("w", turn1_prompt(999), turn_new, "w"))
+        warm.gc_sessions(at=time.time() + ecfg.session_ttl + 1)
+        warm.allocator.offload_drain(30.0)
+        run_one(
+            warm,
+            req("w2", turn1_prompt(999) + w_out + tail(999), resume_new, "w"),
+        )
+        # The COLD resume path too: a churn-evicted session re-prefills its
+        # full history (bucket 512) — without this, the OFF run's first
+        # cold resume pays that compile inside its measured TTFT.
+        cold_prompt = jax.random.randint(
+            jax.random.PRNGKey(998), (prompt_len + turn_new + tail_len,), 0,
+            cfg.vocab_size, jnp.int32,
+        ).tolist()
+        run_one(warm, req("w3", cold_prompt, resume_new, None))
+        warm.free_session("w")
+        warm.close()
+        del warm
+
+        engine = InferenceEngine(params, cfg, ecfg)
+        outs: dict[int, list[int]] = {}
+        # Phase A: turns, in groups — after each group every session has
+        # gone idle past the TTL (expiry demotes with the tier on), so the
+        # NEXT group's allocations churn what is left in HBM.
+        for g in range(0, n_sessions, 4):
+            for i in range(g, min(g + 4, n_sessions)):
+                _, outs[i] = run_one(
+                    engine, req(f"t{i}", turn1_prompt(i), turn_new, f"s{i}")
+                )
+            engine.gc_sessions(at=time.time() + ecfg.session_ttl + 1)
+            engine.allocator.offload_drain(30.0)
+        # Phase B: every session resumes (history + fresh user tokens).
+        ttfts, restored_resumes, index_hits = [], 0, 0
+        for i in range(n_sessions):
+            r_before = engine.stats["kv_offload_restored"]
+            h_before = engine.stats["prefix_index_hits"]
+            t, _ = run_one(
+                engine,
+                req(f"r{i}", turn1_prompt(i) + outs[i] + tail(i), resume_new, f"s{i}"),
+            )
+            ttfts.append(t)
+            restored_resumes += engine.stats["kv_offload_restored"] > r_before
+            index_hits += engine.stats["prefix_index_hits"] > h_before
+        stats = dict(engine.stats)
+        host_pages = engine.allocator.host_pages
+        engine.close()
+        return ttfts, restored_resumes, index_hits, stats, host_pages
+
+    _partial["stage"] = "session_churn host tier ON"
+    on_ttfts, on_restored, on_hits, on_stats, on_host = run_mode(ecfg_on)
+    _partial["stage"] = "session_churn host tier OFF"
+    off_ttfts, _, off_hits, off_stats, _ = run_mode(ecfg_off)
+
+    on_p50, off_p50 = _pctile(on_ttfts, 50), _pctile(off_ttfts, 50)
+    _emit(
+        {
+            "metric": f"session_churn_{model}_{n_sessions}sessions_{ecfg_on.num_pages}pages",
+            "value": _ratio(off_p50, on_p50),
+            "unit": "resume_ttft_p50_speedup_off_over_on",
+            "resume_ttft_ms_p50_on": round(on_p50, 1),
+            "resume_ttft_ms_p99_on": round(_pctile(on_ttfts, 99), 1),
+            "resume_ttft_ms_p50_off": round(off_p50, 1),
+            "resume_ttft_ms_p99_off": round(_pctile(off_ttfts, 99), 1),
+            "restore_hit_rate": round(on_restored / n_sessions, 4),
+            "resume_index_hit_rate_on": round(on_hits / n_sessions, 4),
+            "resume_index_hit_rate_off": round(off_hits / n_sessions, 4),
+            "kv_offload_demoted": on_stats["kv_offload_demoted"],
+            "kv_offload_restored": on_stats["kv_offload_restored"],
+            "kv_offload_restore_fail": on_stats["kv_offload_restore_fail"],
+            "kv_offload_host_evicted": on_stats["kv_offload_host_evicted"],
+            "host_pages_at_end": on_host,
+            "prefill_tokens_on": on_stats["prefill_tokens"],
+            "prefill_tokens_off": off_stats["prefill_tokens"],
+            "sessions": n_sessions,
+            "num_pages": ecfg_on.num_pages,
+            "idle_pages_demanded": idle_demand,
+            "host_cache_bytes": ecfg_on.host_cache_bytes,
             "attn_impl": attn,
             "device": str(jax.devices()[0]),
         }

@@ -2,6 +2,10 @@
 
 Usage: python examples/run_model_node.py [control_plane_url] [model]
 Env:   AGENTFIELD_MODEL_CPU=1   — serve on the CPU backend (debug/demo)
+       AGENTFIELD_HOST_CACHE_BYTES=<n>
+                                — tiered KV: host-RAM offload tier for idle
+                                  session/prefix KV (docs/PREFIX_CACHING.md
+                                  "Tiered cache"; 0/unset = off)
        AGENTFIELD_QUANT=int8    — weight-only int8 serving (models/quant.py)
        AGENTFIELD_SPEC_DRAFT=<preset|ckpt> + AGENTFIELD_SPEC_K=4
                                 — speculative decoding (draft-verify)
@@ -31,7 +35,10 @@ from agentfield_tpu.serving.model_node import build_model_node, install_sigterm_
 async def main() -> None:
     cp_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8800"
     model = sys.argv[2] if len(sys.argv) > 2 else "llama-tiny"
-    ecfg = EngineConfig(max_batch=8, page_size=16, num_pages=256, max_pages_per_seq=16)
+    ecfg = EngineConfig(
+        max_batch=8, page_size=16, num_pages=256, max_pages_per_seq=16,
+        host_cache_bytes=int(os.environ.get("AGENTFIELD_HOST_CACHE_BYTES") or "0"),
+    )
     # empty string means unset (wrapper scripts export optional knobs blank)
     spec_draft = os.environ.get("AGENTFIELD_SPEC_DRAFT") or None
     agent, backend = build_model_node(

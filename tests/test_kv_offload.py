@@ -1,0 +1,419 @@
+"""Tiered KV survival (ISSUE 8): host-RAM offload + restore.
+
+Pool level: demote/restore mechanics against a fake device, the spanning-LRU
+budget drop, and the stall-abort corruption guard. Engine level: idle-session
+expiry demotes, resume restores token-exactly, seeded kv.restore_fail
+degrades to a plain re-prefill, seeded kv.offload_stall churn never corrupts
+or deadlocks, and host_cache_bytes=0 (the default) is bit-compatible with
+the single-tier pool.
+
+Reuses test_prefix_cache's ECFG shape so few new compilations enter tier-1;
+every offload-on engine is close()d so no worker threads outlive a test.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+from agentfield_tpu.serving.kv_cache import TIER_HOST, PrefixPagePool
+
+CFG = get_config("llama-tiny")
+# One engine shape for every engine-level test in this file (jit caches key
+# on the full EngineConfig): a 15-usable-page pool that cannot hold many
+# idle sessions, with a 64 MiB host budget (llama-tiny pages are tiny).
+ECFG = EngineConfig(
+    max_batch=2, page_size=8, num_pages=16, max_pages_per_seq=8,
+    host_cache_bytes=64 << 20, session_ttl=60.0,
+)
+NO_TIER = EngineConfig(
+    max_batch=2, page_size=8, num_pages=16, max_pages_per_seq=8,
+    enable_prefix_cache=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faults.install(None)
+
+
+def _prompt(key, n):
+    return jax.random.randint(
+        jax.random.PRNGKey(key), (n,), 0, CFG.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _run(engine, rid, prompt, max_new=4, session=None):
+    return engine.run_to_completion(
+        [
+            Request(
+                id=rid, prompt=prompt,
+                sampling=SamplingParams(max_new_tokens=max_new),
+                session_id=session,
+            )
+        ]
+    )[rid]
+
+
+# ---------------------------------------------------------------------------
+# pool unit tests (fake device: a dict of page -> payload)
+
+
+def _fake_tier(pool: PrefixPagePool, budget_pages: int = 8):
+    """Wire the host tier against a dict 'device'. Returns (dev, lock)."""
+    dev: dict[int, object] = {}
+    lock = threading.RLock()
+    pool.enable_host_tier(
+        budget_bytes=budget_pages * 100,
+        page_bytes=100,
+        lock=lock,
+        capture=lambda p: ("snap", dev.get(p)),  # content AT CAPTURE TIME
+        fetch=lambda h: h[1],
+        upload=lambda payloads, pages: dev.update(zip(pages, payloads)),
+    )
+    return dev, lock
+
+
+def test_pool_demote_restore_round_trip():
+    """A refcount-0 cached page demotes to the host store (HBM page back on
+    the free list) and a later lookup restores it into a fresh page carrying
+    the captured payload — refcounts, gauges, and counters all consistent."""
+    pool = PrefixPagePool(8, page_size=4)
+    dev, lock = _fake_tier(pool)
+    try:
+        with lock:
+            pages = pool.alloc(2)
+            for p in pages:
+                dev[p] = f"kv-{p}"
+            toks = list(range(8))
+            pool.publish(toks, pages)
+            pool.free(pages)
+            assert pool.free_pages == 7  # refcount-0 cached = allocatable
+            assert pool.demote_lru() == 2
+        assert pool.offload_drain(5.0)
+        with lock:
+            assert pool.host_pages == 2
+            assert pool.stats["kv_offload_demoted"] == 2
+            assert pool.cached_pages == 0  # nothing HBM-resident anymore
+            assert pool.free_pages == 7  # pages returned to the free list
+            assert pool.evictable_prefix_pages(toks) == 0  # HOST != evictable
+            assert pool.host_prefix_pages(toks) == 2
+            assert pool.peek(toks) == 8  # still a (restorable) prefix hit
+            got, n = pool.lookup(toks)
+            assert n == 8 and len(got) == 2
+            assert all(pool.refcount(p) == 1 for p in got)
+            assert [dev[p] for p in got] == [f"kv-{p}" for p in pages]
+            assert pool.host_pages == 0
+            assert pool.stats["kv_offload_restored"] == 2
+            pool.free(got)  # back to refcount-0 HBM cached
+            assert pool.evictable_prefix_pages(toks) == 2
+    finally:
+        pool.close()
+
+
+def test_pool_host_budget_drops_oldest():
+    """The host store is the far end of ONE spanning LRU: over budget, the
+    OLDEST demotion drops (chain truncated from that page on)."""
+    pool = PrefixPagePool(8, page_size=4)
+    dev, lock = _fake_tier(pool, budget_pages=1)
+    try:
+        with lock:
+            pages = pool.alloc(2)
+            for p in pages:
+                dev[p] = f"kv-{p}"
+            toks = list(range(8))
+            pool.publish(toks, pages)
+            pool.free(pages)
+            pool.demote_lru()
+        assert pool.offload_drain(5.0)
+        with lock:
+            assert pool.host_pages == 1  # page 2 pushed page 1 out
+            assert pool.stats["kv_offload_host_evicted"] == 1
+            # the chain is broken at the dropped first page: no prefix hit
+            assert pool.peek(toks) == 0
+            assert pool.lookup(toks) == ([], 0)
+    finally:
+        pool.close()
+
+
+def test_pool_stalled_copy_aborts_after_eviction():
+    """Corruption guard: a page evicted-and-reused while its demote copy is
+    stalled in flight must NOT commit — the late copy is discarded and the
+    pool state is exactly what plain eviction produces."""
+    faults.install(
+        faults.FaultInjector(
+            seed=3, spec={"kv.offload_stall": {"prob": 1.0, "delay_s": 0.3}}
+        )
+    )
+    pool = PrefixPagePool(4, page_size=4)  # 3 usable pages
+    dev, lock = _fake_tier(pool)
+    try:
+        with lock:
+            pages = pool.alloc(1)
+            dev[pages[0]] = "old-kv"
+            pool.publish(list(range(4)), pages)
+            pool.free(pages)
+            assert pool.demote_lru() == 1  # capture happens NOW
+        # while the worker stalls, allocation pressure evicts + reuses the
+        # page (the single-tier hard-eviction path)
+        with lock:
+            grabbed = pool.alloc(3)
+            assert grabbed is not None and pages[0] in grabbed
+            assert pool.stats["prefix_pages_evicted"] == 1
+            dev[pages[0]] = "new-kv"  # the reuser's writes
+        assert pool.offload_drain(5.0)
+        with lock:
+            assert pool.stats["kv_offload_demoted"] == 0  # commit aborted
+            assert pool.host_pages == 0
+            assert pool.peek(list(range(4))) == 0  # nothing resurrected
+            pool.free(grabbed)
+            assert pool.free_pages == 3
+    finally:
+        pool.close()
+
+
+def test_pool_disabled_tier_is_inert():
+    """Without enable_host_tier the pool has no worker thread and every
+    demote/restore surface is a no-op — the bit-compat half of the knob."""
+    pool = PrefixPagePool(8, page_size=4)
+    assert pool._offload_thread is None
+    assert pool.demote_lru() == 0 and pool.demote_pages([1, 2]) == 0
+    assert pool.offload_drain() is True
+    assert pool.host_pages == 0 and pool.host_prefix_pages([0, 1, 2, 3]) == 0
+    pool.close()  # no-op, idempotent
+    pool.close()
+
+
+# ---------------------------------------------------------------------------
+# engine level
+
+
+def test_idle_session_expiry_demotes_and_resume_restores_token_exact(params):
+    """The headline cycle: a session goes idle past session_ttl, gc_sessions
+    frees AND demotes its KV to host RAM; the next turn restores it through
+    the shared-prefix lookup and continues token-exactly."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    try:
+        t1 = _prompt(1, 16)  # 2 full pages
+        out1 = _run(engine, "a", t1, session="conv")
+        assert engine.gc_sessions(at=time.time() + 120) == 1
+        assert engine.allocator.offload_drain(10.0)
+        assert engine.allocator.host_pages >= 2
+        assert engine.stats["kv_offload_demoted"] >= 2
+        t2 = t1 + out1 + _prompt(2, 3)
+        out2 = _run(engine, "b", t2, session="conv")
+        assert engine.stats["kv_offload_restored"] >= 2
+        assert engine.stats["prefix_index_hits"] == 1
+        assert engine.stats["kv_offload_restore_fail"] == 0
+        fresh = InferenceEngine(params, CFG, NO_TIER)
+        assert out2 == _run(fresh, "b", t2), "restored KV diverged from re-prefill"
+    finally:
+        engine.close()
+
+
+def test_restore_fail_degrades_to_reprefill_token_exact(params):
+    """Seeded kv.restore_fail: the failed restore ends the cached-prefix
+    walk and the engine re-prefills — token-exact, counter bumped, and the
+    re-publish heals the entry so LATER resumes hit again."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    try:
+        t1 = _prompt(10, 16)
+        out1 = _run(engine, "a", t1, session="s")
+        engine.gc_sessions(at=time.time() + 120)
+        assert engine.allocator.offload_drain(10.0)
+        host_before = engine.allocator.host_pages
+        assert host_before >= 2
+        faults.install(
+            faults.FaultInjector(
+                seed=5, spec={"kv.restore_fail": {"prob": 1.0, "times": 1}}
+            )
+        )
+        t2 = t1 + out1 + _prompt(11, 3)
+        out2 = _run(engine, "b", t2, session="s")
+        assert engine.stats["kv_offload_restore_fail"] == 1
+        fresh = InferenceEngine(params, CFG, NO_TIER)
+        assert out2 == _run(fresh, "b", t2), "re-prefill fallback diverged"
+        # the failed chain re-published at install: its host payload was
+        # re-adopted into HBM (no dangling host copy of a live chain)
+        assert engine.allocator.host_pages < host_before
+        # with the fault budget spent, the NEXT expiry/resume cycle restores
+        engine.gc_sessions(at=time.time() + 240)
+        assert engine.allocator.offload_drain(10.0)
+        restored_before = engine.stats["kv_offload_restored"]
+        t3 = t2 + out2 + _prompt(12, 3)
+        out3 = _run(engine, "c", t3, session="s")
+        assert engine.stats["kv_offload_restored"] > restored_before
+        fresh2 = InferenceEngine(params, CFG, NO_TIER)
+        assert out3 == _run(fresh2, "c", t3)
+    finally:
+        engine.close()
+
+
+def test_offload_stall_churn_never_corrupts_or_deadlocks(params):
+    """Seeded kv.offload_stall on every demote while sessions churn through
+    an undersized pool: outputs stay exactly the no-tier engine's, nothing
+    wedges (bounded wall clock), and the pool accounting balances at the
+    end — a stalled copy can delay demotion, never break the pool."""
+    faults.install(
+        faults.FaultInjector(
+            seed=7, spec={"kv.offload_stall": {"prob": 1.0, "delay_s": 0.05}}
+        )
+    )
+    engine = InferenceEngine(params, CFG, ECFG)
+    try:
+        want: dict[str, list[int]] = {}
+        got: dict[str, list[int]] = {}
+        clock = time.time()
+        for turn in range(4):
+            # two sessions alternate turns; between turns BOTH expire, so
+            # every resume races the stalled demote pipeline
+            for s in ("x", "y"):
+                rid = f"{s}{turn}"
+                p = _prompt(40 + turn if s == "x" else 60 + turn, 12)
+                got[rid] = _run(engine, rid, p, session=s)
+                fresh = InferenceEngine(params, CFG, NO_TIER)
+                want[rid] = _run(fresh, rid, p)
+            clock += 120
+            engine.gc_sessions(at=clock)
+        assert got == want, "offload churn changed emitted tokens"
+        assert engine.allocator.offload_drain(10.0), "offload worker wedged"
+        with engine._session_lock:
+            a = engine.allocator
+            # every page is free, HBM-cached, or demoted — none leaked
+            held = (ECFG.num_pages - 1) - a.free_pages
+            assert held == 0, f"{held} pages leaked"
+            assert not a._demote_q and not a._demote_inflight
+    finally:
+        engine.close()
+
+
+@pytest.mark.parametrize("mixed", [False, True], ids=["classic", "mixed"])
+def test_offload_on_equals_offload_off(params, mixed):
+    """Same multi-request shared-prefix workload, host tier ON vs OFF (the
+    bit-compat pin for host_cache_bytes=0 and the exactness pin for >0):
+    identical token streams under both schedulers."""
+    import dataclasses
+
+    base = dataclasses.replace(ECFG, host_cache_bytes=0)
+    on = ECFG
+    if mixed:
+        base = dataclasses.replace(base, mixed_step=True, mixed_step_budget=32)
+        on = dataclasses.replace(on, mixed_step=True, mixed_step_budget=32)
+    shared = _prompt(80, 16)
+    reqs = lambda: [  # noqa: E731
+        Request(
+            id=f"r{i}", prompt=shared + _prompt(81 + i, 3),
+            sampling=SamplingParams(max_new_tokens=3),
+        )
+        for i in range(4)
+    ]
+    e_off = InferenceEngine(params, CFG, base)
+    assert e_off.allocator._offload_thread is None  # 0 = today's pool
+    want = e_off.run_to_completion(reqs())
+    e_on = InferenceEngine(params, CFG, on)
+    try:
+        # force churn through the host tier mid-burst
+        got = e_on.run_to_completion(reqs()[:2])
+        with e_on._session_lock:
+            e_on.allocator.demote_lru()
+        assert e_on.allocator.offload_drain(10.0)
+        got.update(e_on.run_to_completion(reqs()[2:]))
+        assert got == want
+        if e_on.stats["kv_offload_demoted"]:
+            assert e_on.stats["kv_offload_restored"] >= 0  # restores legal
+    finally:
+        e_on.close()
+
+
+def test_restore_evicts_idle_live_sessions_for_target_pages(params):
+    """Regression: when LIVE idle sessions pin the whole pool, a restore
+    must still find target pages by evicting the session LRU (the resume it
+    serves is a live request — live wins over cached, same as admission).
+    Without the engine-backed restore allocator, every restore fails with
+    free_pages=0 and resumes silently re-prefill forever."""
+    engine = InferenceEngine(params, CFG, ECFG)
+    try:
+        # session "old" takes a turn, expires, demotes — its KV is host-only
+        t_old = _prompt(30, 16)
+        out_old = _run(engine, "a", t_old, session="old")
+        engine.gc_sessions(at=time.time() + 120)
+        assert engine.allocator.offload_drain(10.0)
+        assert engine.allocator.host_pages >= 2
+        # live sessions then pin (nearly) the whole 15-page pool: 3 sessions
+        # x ~4-5 retained pages; none are expired when "old" resumes
+        for i in range(3):
+            _run(engine, f"pin{i}", _prompt(31 + i, 24), max_new=12, session=f"pin{i}")
+        with engine._session_lock:
+            free_now = engine.allocator.free_pages
+        assert free_now < 2, f"pool not pinned enough ({free_now} free)"
+        t2 = t_old + out_old + _prompt(40, 3)
+        out2 = _run(engine, "b", t2, session="old")
+        assert engine.stats["kv_offload_restored"] >= 2, (
+            "restore failed to evict idle live sessions for its target pages"
+        )
+        assert engine.stats["sessions_evicted"] >= 1
+        fresh = InferenceEngine(params, CFG, NO_TIER)
+        assert out2 == _run(fresh, "b", t2)
+    finally:
+        engine.close()
+
+
+def test_host_tier_requires_shared_prefix_cache(params):
+    import dataclasses
+
+    with pytest.raises(ValueError, match="host_cache_bytes"):
+        InferenceEngine(
+            params, CFG,
+            dataclasses.replace(ECFG, shared_prefix_cache=False),
+        )
+    with pytest.raises(ValueError, match="host_cache_bytes"):
+        InferenceEngine(
+            params, CFG,
+            dataclasses.replace(ECFG, enable_prefix_cache=False),
+        )
+
+
+def test_default_engine_has_no_offload_machinery(params):
+    """host_cache_bytes defaults to 0: no worker thread, no host entries
+    after expiry — the pre-tier engine, bit for bit."""
+    import dataclasses
+
+    engine = InferenceEngine(
+        params, CFG, dataclasses.replace(ECFG, host_cache_bytes=0)
+    )
+    _run(engine, "a", _prompt(90, 16), session="s")
+    engine.gc_sessions(at=time.time() + 120)
+    assert engine.allocator._offload_thread is None
+    assert engine.allocator.host_pages == 0
+    assert engine.stats["kv_offload_demoted"] == 0
+    assert engine.stats["kv_offload_restored"] == 0
+    # the counters still EXIST (the metrics pipeline always exports them)
+    assert "kv_offload_restore_fail" in engine.stats
+    assert engine.prefix_cache_stats()["kv_offload_host_pages"] == 0
+
+
+def test_host_gauge_rides_metrics_pipeline():
+    """kv_offload_* counters/gauges export like every other engine stat."""
+    from agentfield_tpu.control_plane.metrics import Metrics, export_engine_stats
+
+    m = Metrics()
+    n = export_engine_stats(
+        m, "model-1",
+        {"kv_offload_demoted": 3, "kv_offload_restored": 2,
+         "kv_offload_restore_fail": 0, "kv_offload_host_pages": 1},
+    )
+    assert n == 4
+    text = m.render()
+    assert 'agentfield_engine_kv_offload_demoted{node="model-1"} 3.0' in text
+    assert 'agentfield_engine_kv_offload_host_pages{node="model-1"} 1.0' in text

@@ -165,16 +165,24 @@ def test_adapter_artifact_and_node_serving(tmp_path, params):
     from agentfield_tpu.serving.model_node import build_model_node
     from agentfield_tpu.training import load_adapter, save_adapter
 
+    # The tuned behavior is a constant-token mode ("always emit 42"), which
+    # attention-only adapters cannot represent at rank 4 — the hidden state
+    # must align with one unembed row at EVERY position, a constant-direction
+    # write that w_down provides directly (wq/wv alone plateau ~2% on the
+    # target and the greedy mode lands elsewhere). Train with w_down in the
+    # targets; the artifact round trip is what this test pins, not the
+    # adapter placement.
+    lcfg = LoRAConfig(rank=4, alpha=8.0, targets=("wq", "wv", "w_down"))
     opt = optax.adam(1e-2)
-    state = init_lora_state(CFG, LCFG, jax.random.PRNGKey(9), opt)
-    step = make_lora_train_step(CFG, LCFG, opt)
+    state = init_lora_state(CFG, lcfg, jax.random.PRNGKey(9), opt)
+    step = make_lora_train_step(CFG, lcfg, opt)
     batch = _batch(9)
     batch["targets"] = jnp.full_like(batch["targets"], 42).at[:, -1].set(-1)
     for _ in range(40):
         state, _ = step(state, params, batch)
-    save_adapter(tmp_path / "ad", state.params, LCFG)
+    save_adapter(tmp_path / "ad", state.params, lcfg)
     lcfg2, back = load_adapter(tmp_path / "ad")
-    assert lcfg2 == LCFG
+    assert lcfg2 == lcfg
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         state.params, back,

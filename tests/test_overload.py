@@ -289,6 +289,87 @@ def test_preempt_storm_chaos_token_exact(params):
     assert not engine._deadline_at and not engine._req_hashes
 
 
+def test_parked_pages_demoted_to_host_still_resume_token_exact(params):
+    """park() × tiered KV (ISSUE 8): a preempted request whose parked pages
+    demote to the HOST tier while it waits must still resume token-exactly —
+    the resume lookup restores the pages host→device instead of finding
+    them HBM-resident, and the stream is indistinguishable either way."""
+    ecfg = dataclasses.replace(TIGHT, host_cache_bytes=64 << 20)
+    ref = InferenceEngine(params, CFG, ecfg)
+    want_victim = ref.run_to_completion(
+        [_req("victim", _prompt(0, 12), max_new=24)]
+    )["victim"]
+    ref.close()
+
+    engine = InferenceEngine(params, CFG, ecfg)
+    try:
+        engine.submit(_req("victim", _prompt(0, 12), max_new=24))
+        early = list(engine.step())  # victim admits
+        engine.submit(_req("rival", _prompt(1, 12), max_new=8, priority=1))
+        t0 = time.monotonic()
+        while engine.stats["preemptions_total"] < 1:
+            assert time.monotonic() - t0 < 120, "preemption never fired"
+            early += engine.step()
+        # the victim's KV is parked refcount-0: push it to the host tier
+        # before the resume can come back for it
+        with engine._session_lock:
+            assert engine.allocator.demote_lru() >= 1
+        assert engine.allocator.offload_drain(10.0)
+        assert engine.stats["kv_offload_demoted"] >= 1
+        tokens, finals = _drain(engine)
+        for ev in reversed(early):
+            if ev.token >= 0:
+                tokens.setdefault(ev.request_id, []).insert(0, ev.token)
+        assert engine.stats["kv_offload_restored"] >= 1, (
+            "resume should have restored the demoted parked pages"
+        )
+        assert engine.stats["resume_prefix_hits_total"] >= 1
+        assert tokens["victim"] == want_victim, (
+            "host-tier round trip changed the resumed stream"
+        )
+        assert [e.finish_reason for e in finals["victim"]] == ["length"]
+        assert [e.finish_reason for e in finals["rival"]] == ["length"]
+    finally:
+        engine.close()
+
+
+def test_cand_starved_counts_host_pages_as_allocations(params):
+    """evictable_prefix_pages must not count HOST-tier pages as instantly
+    allocatable, and the starvation probe must charge each host-tier prefix
+    page as a FRESH allocation (its restore consumes a page): a rival whose
+    prefix sits in the host store is starved when free pages cannot cover
+    pages_needed - cached + host_overlap."""
+    ecfg = dataclasses.replace(TIGHT, num_pages=9, host_cache_bytes=64 << 20)
+    engine = InferenceEngine(params, CFG, ecfg)  # 8 usable pages
+    try:
+        warm_prompt = _prompt(5, 16)  # 2 full pages, indexed at completion
+        engine.run_to_completion([_req("warm", warm_prompt, max_new=8)])
+        with engine._session_lock:
+            engine.allocator.demote_lru()
+        assert engine.allocator.offload_drain(10.0)
+        assert engine.allocator.host_pages >= 2
+        rival = _req("rival", warm_prompt + _prompt(6, 1), max_new=16, priority=1)
+        with engine._session_lock:
+            hp = engine.allocator.host_prefix_pages(
+                rival.prompt[:-1], hashes=None
+            )
+            assert hp == 2
+            assert engine.allocator.evictable_prefix_pages(rival.prompt[:-1]) == 0
+        # occupy the pool so free pages < rival's need incl. restore targets
+        engine.submit(_req("victim", _prompt(0, 12), max_new=24))
+        engine.step()  # victim admits: 5 of 8 pages taken
+        engine.submit(rival)
+        # rival: needs 5 pages, 2 cached-in-host → alloc need 3+2(restores)=5
+        # > 3 free → starved; the fence must age and preemption must fire
+        tokens, finals = _drain(engine)
+        assert engine.stats["preemptions_total"] >= 1, (
+            "host-tier prefix fooled the starvation probe"
+        )
+        assert len(tokens["rival"]) == 16
+    finally:
+        engine.close()
+
+
 # ---------------------------------------------------------------------------
 # Deadline-aware shedding of pending work
 

@@ -261,6 +261,49 @@ def test_prefix_pool_evictable_prefix_pages():
     assert pool.evictable_prefix_pages(toks) == 2
 
 
+def test_publish_readopts_host_tier_entry():
+    """Tiered KV (docs/PREFIX_CACHING.md "Tiered cache"): publishing a chain
+    whose incumbent record was demoted to the HOST tier re-adopts the
+    publisher's HBM page and drops the host payload — a free un-demote, and
+    the self-heal path for a host entry whose restores keep failing."""
+    import threading
+
+    from agentfield_tpu.serving.kv_cache import PrefixPagePool
+
+    pool = PrefixPagePool(10, page_size=4)
+    dev: dict[int, object] = {}
+    lock = threading.RLock()
+    pool.enable_host_tier(
+        budget_bytes=800, page_bytes=100, lock=lock,
+        capture=lambda p: ("snap", dev.get(p)),
+        fetch=lambda h: h[1],
+        upload=lambda payloads, pages: dev.update(zip(pages, payloads)),
+    )
+    try:
+        toks = list(range(8))
+        with lock:
+            pages = pool.alloc(2)
+            for p in pages:
+                dev[p] = f"kv-{p}"
+            pool.publish(toks, pages)
+            pool.free(pages)
+            pool.demote_lru()
+        assert pool.offload_drain(5.0)
+        with lock:
+            assert pool.host_pages == 2
+            # a re-prefill of the same prompt publishes the same chain from
+            # fresh pages (the restore path was skipped/failed)
+            fresh = pool.alloc(2)
+            pool.publish(toks, fresh)
+            assert pool.host_pages == 0  # payloads dropped, records re-adopted
+            assert pool.stats["kv_offload_restored"] == 0  # no H2D copy paid
+            got, n = pool.lookup(toks)
+            assert got == fresh and n == 8
+            pool.free(got + fresh)
+    finally:
+        pool.close()
+
+
 def test_cross_request_prefix_reuse_is_logit_exact(params):
     """A second, sessionless request sharing a multi-page prefix reuses the
     first request's pages (suffix-only prefill) and emits exactly the tokens

@@ -61,7 +61,8 @@ class ModelNodeConfig:
     prefill_chunk: int | None = None  # chunked prefill (>= 16) or whole-prompt
     decode_span: int = 1  # decode steps per device dispatch (one token
     # readback per span — set 8-16 on high-latency device links)
-    kv_write_impl: str = "ref"  # "ref" scatter | "pallas" page-patch kernel
+    kv_write_impl: str = "ref"  # DEPRECATED alias of attn_impl: "pallas"
+    # selects the fused ragged kernel path (docs/KERNELS.md)
     grammar_slots: int = 256  # constrained-decoding bank rows (0 disables)
     grammar_whitespace: bool = False  # accept bounded whitespace in
     # schema-constrained output (pretty-printed JSON) instead of canonical

@@ -1,1 +1,7 @@
-from agentfield_tpu.ops.paged_attention import paged_attention  # noqa: F401
+from agentfield_tpu.ops.paged_attention import (  # noqa: F401
+    RaggedRows,
+    paged_attention,  # deprecated shim — ragged_paged_attention replaces it
+    paged_attention_ref,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)

@@ -1,24 +1,225 @@
-"""Paged decode attention.
+"""Ragged paged attention: the ONE attention entry point for serving.
 
-``paged_attention`` computes single-token GQA attention where K/V live in a
-paged HBM pool indexed through per-sequence page tables (the kernel pattern
-from the ragged-paged-attention line of work — see PAPERS.md).
+Every forward the engine issues — classic span decode, the mixed
+token-budget tick, chunked/suffix prefill, the speculative verify window —
+is a batch of *ragged rows*: R rows of up to W query tokens each, every row
+at its own start position over its own page table, with its own count of
+already-cached keys. ``ragged_paged_attention`` consumes that descriptor
+directly and FUSES the KV-cache write: each row's new K/V land in the paged
+pool in the same dispatch that attends over them (same-launch keys are
+served from the ``k_new``/``v_new`` operands, so the kernel never reads its
+own writes). This replaces the four special-case kernels the engine used to
+route between (decode, chunk, batch-chunk, kv-write) — and the scheduler
+special-cases that existed only because the per-page patch kernel could not
+take multi-row writes. See docs/KERNELS.md.
 
 Two implementations:
 
-- ``ref``   — gather pages with XLA (materializes [B, max_ctx] K/V in HBM,
-  correct everywhere incl. CPU tests; bandwidth-wasteful).
-- ``pallas`` — Pallas TPU kernel that streams pages HBM→VMEM per sequence
-  and never materializes the gathered context (added in ops/pallas; selected
-  automatically on TPU backends once registered).
+- ``ragged_paged_attention_ref`` — XLA: exact multi-row scatter into the
+  pool, then a page-gather masked attention (materializes [R, max_ctx] K/V
+  in HBM; correct everywhere incl. CPU tests; bandwidth-wasteful).
+- ``ops/pallas/ragged_paged_attention_kernel.py`` — Pallas TPU kernel that
+  streams pages HBM→VMEM per row and patches pool pages in place; block
+  sizes come from the autotable (``ops/pallas/kernel_autotune.py``,
+  ``AGENTFIELD_KERNEL_AUTOTUNE``). Runs in the Pallas interpreter on CPU.
+
+The row descriptor (``RaggedRows``) is produced by
+``serving.kv_cache.pack_ragged_rows``; its invariants:
+
+- row r's queries sit at absolute positions ``[row_starts[r],
+  row_starts[r] + n_tokens[r])``; ``n_tokens[r] == 0`` marks a padding row
+  (zero output, no writes).
+- ``ctx_lens[r]`` keys for the row's sequence are already in the pool;
+  positions ``[ctx_lens[r], row_starts[r])`` are covered by EARLIER rows of
+  the same launch carrying the same ``seq_ids[r]`` (a chunk wider than W
+  splits into several rows).
+- pages are looked up as ``page_tables[r, pos // page_size]``; positions at
+  or past ``max_pages * page_size`` route to the reserved garbage page 0.
 """
 
 from __future__ import annotations
+
+import typing
 
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+
+class RaggedRows(typing.NamedTuple):
+    """Host-side ragged forward descriptor (one kernel launch)."""
+
+    tokens: typing.Any  # [R, W] int32 token ids (model input, not consumed here)
+    page_tables: typing.Any  # [R, maxp] int32
+    row_starts: typing.Any  # [R] int32 — absolute position of row r's first query
+    n_tokens: typing.Any  # [R] int32 — valid queries in row r (0 = padding row)
+    ctx_lens: typing.Any  # [R] int32 — keys already in the pool for row r's seq
+    seq_ids: typing.Any  # [R] int32 — launch-local sequence identity (-1 padding)
+    last_flat: list  # flat token index of each packed entry's LAST token
+
+
+def ragged_paged_attention_ref(
+    q: jax.Array,  # [R, W, H, hd]
+    k_new: jax.Array,  # [R, W, Kh, hd] — new K per query token (pre-write)
+    v_new: jax.Array,  # [R, W, Kh, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,  # [P, Kh, ps, hd]
+    page_tables: jax.Array,  # [R, maxp] int32
+    row_starts: jax.Array,  # [R] int32
+    n_tokens: jax.Array,  # [R] int32
+    ctx_lens: jax.Array,  # [R] int32 (unused by the ref: the scatter-first
+    # pool already holds same-launch keys; kept for signature parity)
+    seq_ids: jax.Array,  # [R] int32 (unused by the ref, same reason)
+    sm_scale: float | None = None,
+    window: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """XLA reference: exact multi-row scatter of the new K/V into the paged
+    pool, then masked gather attention per row. Returns
+    ``(out [R, W, H, hd], k_pages, v_pages)``. Semantics match the Pallas
+    kernel exactly — per-row causal masking on absolute positions, sliding
+    window, zeros for padding rows/tokens — so it serves as the parity
+    oracle in tests AND as the engine's attention on backends without the
+    kernel."""
+    del ctx_lens, seq_ids
+    R, W, H, hd = q.shape
+    P, Kh, ps, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    T = maxp * ps
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    j = jnp.arange(W, dtype=jnp.int32)[None]  # [1, W]
+    pos = row_starts[:, None] + j  # [R, W]
+    valid = j < n_tokens[:, None]  # [R, W]
+    lookup = pos // ps
+    in_table = (lookup < maxp) & valid
+    page_ids = jnp.where(
+        in_table,
+        jnp.take_along_axis(page_tables, jnp.minimum(lookup, maxp - 1), axis=1),
+        0,
+    )  # [R, W] — padding/over-budget tokens write the garbage page
+    slot_ids = pos % ps
+    # Multi-row scatter: advanced [R, W] indices at dims 0,2 of
+    # [P, Kh, ps, hd] put the broadcast dims first → values [R, W, Kh, hd].
+    k_pages = k_pages.at[page_ids, :, slot_ids].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[page_ids, :, slot_ids].set(v_new.astype(v_pages.dtype))
+
+    # [R, maxp, Kh, ps, hd] → [R, T, Kh, hd] gathered context (now holding
+    # this launch's keys too — the mask below only ever admits key positions
+    # the launch has actually populated).
+    k = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
+    v = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
+    qg = q.reshape(R, W, Kh, rep, hd)
+    logits = jnp.einsum(
+        "bwkrh,btkh->bkrwt", qg, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None, None]  # [1, 1, T]
+    keep = (k_pos <= pos[..., None]) & valid[..., None]  # [R, W, T]
+    if window is not None:  # HF Mistral semantics (llama.attention_ref)
+        keep = keep & (k_pos > pos[..., None] - window)
+    logits = jnp.where(keep[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkrwt,btkh->bwkrh", probs, v, preferred_element_type=jnp.float32
+    ).reshape(R, W, H, hd)
+    # padding rows/tokens return zeros like the kernel's un-accumulated rows
+    out = jnp.where(valid[..., None, None], out, 0.0).astype(q.dtype)
+    return out, k_pages, v_pages
+
+
+def ragged_paged_attention(
+    q,
+    k_new,
+    v_new,
+    k_pages,
+    v_pages,
+    page_tables,
+    row_starts,
+    n_tokens,
+    ctx_lens,
+    seq_ids,
+    impl: str = "ref",
+    mesh=None,
+    window: int | None = None,
+    sm_scale: float | None = None,
+):
+    """Dispatch one ragged fused write+attention launch.
+
+    With `mesh` (tensor parallelism) the Pallas kernel runs under shard_map
+    over the KV-head axis: each shard owns its slice of the page pool and
+    its heads' queries/new-KV ([.., Kh/tp, ..] — matching wk/wv's TP
+    sharding) and computes with NO collectives; the psum over the output
+    projection downstream is the only cross-chip traffic, exactly as in the
+    ref GSPMD path (XLA partitions the scatter+gather itself)."""
+    if impl == "ref":
+        return ragged_paged_attention_ref(
+            q, k_new, v_new, k_pages, v_pages, page_tables, row_starts,
+            n_tokens, ctx_lens, seq_ids, sm_scale=sm_scale, window=window,
+        )
+    if impl != "pallas":
+        raise ValueError(f"unknown ragged_paged_attention impl {impl!r}")
+    from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+        ragged_paged_attention_pallas,
+    )
+    from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
+
+    blocks = lookup_blocks(
+        page_size=k_pages.shape[2],
+        head_dim=k_pages.shape[3],
+        bucket=q.shape[0] * q.shape[1],
+    )
+    # Mosaic kernels only compile for TPU; on CPU backends (tests, local
+    # demos) run the same kernel in the Pallas interpreter.
+    interpret = jax.default_backend() == "cpu"
+    import functools
+
+    fn = functools.partial(
+        ragged_paged_attention_pallas,
+        sm_scale=sm_scale,
+        window=window,
+        block_n=blocks.block_n,
+        interpret=interpret,
+    )
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from agentfield_tpu.parallel.mesh import AXIS_MODEL
+        from agentfield_tpu.parallel.mesh import shard_map  # version compat
+
+        if mesh.shape.get(AXIS_MODEL, 1) > 1:
+            fn = shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(
+                    P(None, None, AXIS_MODEL, None),  # q [R, W, H, hd]
+                    P(None, None, AXIS_MODEL, None),  # k_new [R, W, Kh, hd]
+                    P(None, None, AXIS_MODEL, None),  # v_new
+                    P(None, AXIS_MODEL, None, None),  # pages on Kh
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, None),  # page_tables replicated
+                    P(None), P(None), P(None), P(None),
+                ),
+                out_specs=(
+                    P(None, None, AXIS_MODEL, None),
+                    P(None, AXIS_MODEL, None, None),
+                    P(None, AXIS_MODEL, None, None),
+                ),
+            )
+    return fn(
+        q, k_new, v_new, k_pages, v_pages, page_tables, row_starts,
+        n_tokens, ctx_lens, seq_ids,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Legacy single-purpose entry points (deprecated shims — one release).
+# ``paged_attention_ref`` stays a real implementation: tests use it as an
+# independent decode oracle. The dispatchers below now ride the ragged path.
+# ---------------------------------------------------------------------------
 
 
 def paged_attention_ref(
@@ -30,7 +231,8 @@ def paged_attention_ref(
     window: int | None = None,  # sliding window (Mistral): the query (at
     # position seq_len-1) attends keys within the most recent `window` only
 ) -> jax.Array:
-    """Reference implementation via page gather. Returns [B, H, hd]."""
+    """Single-token decode attention via page gather (the pre-ragged decode
+    reference, kept as an independent oracle). Returns [B, H, hd]."""
     B, H, hd = q.shape
     P, Kh, ps, _ = k_pages.shape
     maxp = page_tables.shape[1]
@@ -58,51 +260,20 @@ def paged_attention(
     q, k_pages, v_pages, page_tables, seq_lens, impl: str = "ref", mesh=None,
     window: int | None = None,
 ):
-    """Dispatch decode attention.
+    """DEPRECATED: decode-only dispatch over a pre-written pool. Use
+    ``ragged_paged_attention`` (fused write + any n_tokens mix). Kept one
+    release for out-of-tree callers; both impls resolve to the XLA
+    reference."""
+    import warnings
 
-    With `mesh` (tensor parallelism), the Pallas kernel runs under shard_map
-    over the KV-head axis: each shard owns its slice of the page pool
-    ([P, Kh/tp, ps, hd] — KV pages shard on Kh, matching wk/wv's TP sharding)
-    and computes its heads' attention with NO collectives — the psum over the
-    output projection downstream is the only cross-chip traffic, exactly as
-    in the ref GSPMD path. The `ref` impl needs no wrapper (XLA partitions
-    the gather itself)."""
-    if impl == "ref":
-        return paged_attention_ref(
-            q, k_pages, v_pages, page_tables, seq_lens, window=window
-        )
-    if impl == "pallas":
-        from agentfield_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
-
-        # Mosaic kernels only compile for TPU; on CPU backends (tests, local
-        # demos) run the same kernel in the Pallas interpreter.
-        interpret = jax.default_backend() == "cpu"
-        if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-            from jax.experimental.shard_map import shard_map
-
-            from agentfield_tpu.parallel.mesh import AXIS_MODEL
-
-            if mesh.shape.get(AXIS_MODEL, 1) > 1:
-                import functools
-
-                return shard_map(
-                    functools.partial(
-                        paged_attention_pallas, interpret=interpret, window=window
-                    ),
-                    mesh=mesh,
-                    in_specs=(
-                        P(None, AXIS_MODEL, None),  # q [B, H, hd] on heads
-                        P(None, AXIS_MODEL, None, None),  # k_pages [P, Kh, ps, hd]
-                        P(None, AXIS_MODEL, None, None),
-                        P(None, None),  # page_tables replicated
-                        P(None),  # seq_lens replicated
-                    ),
-                    out_specs=P(None, AXIS_MODEL, None),
-                    check_rep=False,
-                )(q, k_pages, v_pages, page_tables, seq_lens)
-        return paged_attention_pallas(
-            q, k_pages, v_pages, page_tables, seq_lens, interpret=interpret,
-            window=window,
-        )
-    raise ValueError(f"unknown paged_attention impl {impl!r}")
+    warnings.warn(
+        "ops.paged_attention.paged_attention is deprecated; use "
+        "ragged_paged_attention (fused ragged kernel)",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    if impl not in ("ref", "pallas"):
+        raise ValueError(f"unknown paged_attention impl {impl!r}")
+    return paged_attention_ref(
+        q, k_pages, v_pages, page_tables, seq_lens, window=window
+    )

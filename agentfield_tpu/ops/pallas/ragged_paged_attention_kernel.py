@@ -1,0 +1,389 @@
+"""Pallas TPU RAGGED paged attention with a fused KV-cache write.
+
+ONE grid program serves every forward shape the engine issues: R ragged
+rows of up to W query tokens, each row at its own absolute start over its
+own page table — decode rows (n_tokens=1), prefill chunks (a chunk wider
+than W splits into several rows sharing a ``seq_id``), and the speculative
+verify window are all just descriptors (see ``ops/paged_attention.py``).
+The new K/V ride in as operands and the kernel:
+
+1. walks the row's CACHED pool pages (positions ``< ctx_lens[r]``) with the
+   usual online-softmax page stream — pages DMA HBM→VMEM, the gathered
+   context never materializes;
+2. attends the launch's own new keys (``k_new``) in ``block_n``-token
+   slices, masked to the same sequence and causal on absolute positions —
+   same-launch keys are NEVER read back from the pool, so the attention
+   pass has no read-after-write ordering on the page arrays;
+3. patches the new K/V into their pool pages in place
+   (``input_output_aliases``). Each write step rebuilds a page as
+   copy-then-patch-ALL-launch-tokens targeting it, which makes overlapping
+   writes IDEMPOTENT: two rows straddling one page (or a torn read of a
+   concurrently written page) both produce the identical final content, so
+   the multi-row-write restriction of the old per-page patch kernel is
+   unrepresentable here.
+
+Grid is ``(R, kv_heads, maxp + new_steps + write_steps)``; block sizes come
+from ``kernel_autotune`` (``AGENTFIELD_KERNEL_AUTOTUNE``). Padding rows
+(``n_tokens == 0``) produce zero output and only ever touch the reserved
+garbage page 0, whose content is meaningless by contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    pt_ref,  # [R, maxp] int32
+    starts_ref,  # [R] int32
+    ctx_ref,  # [R] int32
+    ntok_ref,  # [R] int32
+    seq_ref,  # [R] int32
+    # inputs
+    q_ref,  # [1, 1, W, rep, hd] — the (row, kv-head) tile
+    starts2_ref,  # [rn, 1] int32 — this new-step's row slice
+    ntok2_ref,  # [rn, 1] int32
+    seq2_ref,  # [rn, 1] int32
+    tokp_ref,  # [R, W] int32 — per-token target page (-1 = no write)
+    toks_ref,  # [R, W] int32 — per-token target slot
+    kn_sl_ref,  # [rn, W, 1, hd] — new-key slice for the current new-step
+    vn_sl_ref,  # [rn, W, 1, hd]
+    kn_full_ref,  # [R, W, 1, hd] — every new key (write-phase patching)
+    vn_full_ref,  # [R, W, 1, hd]
+    kp_ref,  # [1, 1, ps, hd] — walk page, or the write-target page
+    vp_ref,  # [1, 1, ps, hd]
+    # outputs
+    o_ref,  # [1, 1, W, rep, hd]
+    kp_out_ref,  # [1, 1, ps, hd] (aliased with the pool)
+    vp_out_ref,  # [1, 1, ps, hd]
+    # scratch
+    m_scr,  # [W * rep, 1] f32
+    l_scr,  # [W * rep, 1] f32
+    acc_scr,  # [W * rep, hd] f32
+    *,
+    sm_scale: float,
+    page_size: int,
+    num_page_steps: int,
+    num_new_steps: int,
+    num_write_steps: int,
+    num_rows: int,
+    rows_per_new_step: int,
+    rep: int,
+    window: int | None,
+):
+    r = pl.program_id(0)
+    pi = pl.program_id(2)
+    ps = page_size
+    W = q_ref.shape[2]
+    hd = q_ref.shape[4]
+    R, rn = num_rows, rows_per_new_step
+    q_rows = W * rep
+    start = starts_ref[r]
+    ctx = ctx_ref[r]
+    ntok = ntok_ref[r]
+    my_seq = seq_ref[r]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_iota = jax.lax.broadcasted_iota(jnp.int32, (q_rows, 1), 0) // rep
+    q_pos = start + q_iota  # [q_rows, 1] absolute query positions
+    q_valid = q_iota < ntok
+
+    def accumulate(s, v):  # s [q_rows, K] f32 (masked), v [K, hd] f32
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # fully-masked rows have m_new == s == -inf; exp(s - m_new) would be
+        # 1 there, so re-mask instead of accumulating garbage V
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = m_new
+
+    # --- phase A: cached-pool page walk. Pages wholly past the row's cached
+    # context contribute nothing; with a sliding window, pages wholly before
+    # even the first query's window skip too.
+    relevant = (pi < num_page_steps) & (pi * ps < ctx) & (ntok > 0)
+    if window is not None:
+        relevant &= (pi + 1) * ps - 1 > start - window
+
+    @pl.when(relevant)
+    def _pool():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(q_rows, hd) * sm_scale
+        k = kp_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [q_rows, ps]
+        k_pos = pi * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        keep = (k_pos < ctx) & q_valid  # ctx <= start ⇒ causal by construction
+        if window is not None:  # HF Mistral semantics (llama.attention_ref)
+            keep &= k_pos > q_pos - window
+        s = jnp.where(keep, s, _NEG_INF)
+        accumulate(s, vp_ref[0, 0].astype(jnp.float32))
+
+    # --- phase B: same-launch new keys, one block_n-token row slice per step.
+    in_new = (
+        (pi >= num_page_steps)
+        & (pi < num_page_steps + num_new_steps)
+        & (ntok > 0)
+    )
+
+    @pl.when(in_new)
+    def _new_keys():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(q_rows, hd) * sm_scale
+        kn = kn_sl_ref[...].astype(jnp.float32).reshape(rn * W, hd)
+        st = starts2_ref[...]  # [rn, 1]
+        nt = ntok2_ref[...]
+        sq = seq2_ref[...]
+        jw = jax.lax.broadcasted_iota(jnp.int32, (rn, W), 1)
+        k_pos = (st + jw).reshape(1, rn * W)
+        k_valid = (jw < nt).reshape(1, rn * W)
+        k_seq = jnp.broadcast_to(sq, (rn, W)).reshape(1, rn * W)
+        s = jax.lax.dot_general(
+            q, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [q_rows, rn * W]
+        keep = k_valid & (k_seq == my_seq) & (k_pos <= q_pos) & q_valid
+        if window is not None:
+            keep &= k_pos > q_pos - window
+        s = jnp.where(keep, s, _NEG_INF)
+        accumulate(s, vn_sl_ref[...].astype(jnp.float32).reshape(rn * W, hd))
+
+    @pl.when(pi == num_page_steps + num_new_steps - 1)
+    def _finalize():
+        # rows/tokens that never accumulated (padding) divide 0 by the floor
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0, ...] = (acc_scr[...] / l).reshape(W, rep, hd).astype(o_ref.dtype)
+
+    # --- phase C: patch this row's pages in place. Copy-then-patch with ALL
+    # launch tokens targeting the page keeps overlapping writes idempotent
+    # (module docstring); pages nobody targets copy through unchanged.
+    @pl.when(pi >= num_page_steps + num_new_steps)
+    def _write():
+        wj = pi - (num_page_steps + num_new_steps)
+        x = pt_ref[
+            r, jnp.clip(start // ps + wj, 0, num_page_steps - 1)
+        ]
+        match = (tokp_ref[...] == x).reshape(1, R * W)
+        slots = toks_ref[...].reshape(1, R * W)
+        slot_iota = jax.lax.broadcasted_iota(jnp.int32, (ps, R * W), 0)
+        sel = ((slot_iota == slots) & match).astype(jnp.float32)  # [ps, R*W]
+        hit = jnp.sum(sel, axis=1, keepdims=True) > 0  # [ps, 1]
+        pk = jax.lax.dot_general(
+            sel,
+            kn_full_ref[...].astype(jnp.float32).reshape(R * W, hd),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        pv = jax.lax.dot_general(
+            sel,
+            vn_full_ref[...].astype(jnp.float32).reshape(R * W, hd),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        kp_out_ref[0, 0, ...] = jnp.where(hit, pk.astype(kp_out_ref.dtype), kp_ref[0, 0])
+        vp_out_ref[0, 0, ...] = jnp.where(hit, pv.astype(vp_out_ref.dtype), vp_ref[0, 0])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "window", "block_n", "interpret")
+)
+def ragged_paged_attention_pallas(
+    q: jax.Array,  # [R, W, H, hd]
+    k_new: jax.Array,  # [R, W, Kh, hd]
+    v_new: jax.Array,  # [R, W, Kh, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    v_pages: jax.Array,  # [P, Kh, ps, hd]
+    page_tables: jax.Array,  # [R, maxp] int32
+    row_starts: jax.Array,  # [R] int32
+    n_tokens: jax.Array,  # [R] int32 (0 = padding row)
+    ctx_lens: jax.Array,  # [R] int32 — keys already in the pool per row
+    seq_ids: jax.Array,  # [R] int32 — launch-local sequence identity
+    sm_scale: float | None = None,
+    window: int | None = None,
+    block_n: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns ``(out [R, W, H, hd], k_pages, v_pages)`` with the new K/V
+    written in place (the pool operands are aliased)."""
+    R, W, H, hd = q.shape
+    P, Kh, ps, _ = k_pages.shape
+    maxp = page_tables.shape[1]
+    if H % Kh:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    rep = H // Kh
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    rn = max(1, min(block_n // W, R))
+    R_pad = -(-R // rn) * rn
+    if R_pad > R:
+        padr = R_pad - R
+        q = jnp.pad(q, ((0, padr), (0, 0), (0, 0), (0, 0)))
+        k_new = jnp.pad(k_new, ((0, padr), (0, 0), (0, 0), (0, 0)))
+        v_new = jnp.pad(v_new, ((0, padr), (0, 0), (0, 0), (0, 0)))
+        page_tables = jnp.pad(page_tables, ((0, padr), (0, 0)))
+        row_starts = jnp.pad(row_starts, (0, padr))
+        n_tokens = jnp.pad(n_tokens, (0, padr))
+        ctx_lens = jnp.pad(ctx_lens, (0, padr))
+        seq_ids = jnp.pad(seq_ids, (0, padr), constant_values=-1)
+    ns = R_pad // rn
+    WP = (W + ps - 2) // ps + 1
+
+    # Per-token write targets, precomputed once per launch: page -1 marks
+    # tokens that must not write (padding, or positions past the table —
+    # the pipelined scheduler's one-step-over-budget dispatch).
+    j = jnp.arange(W, dtype=jnp.int32)[None]
+    pos = row_starts[:, None] + j
+    valid = j < n_tokens[:, None]
+    lookup = pos // ps
+    in_table = (lookup < maxp) & valid
+    tok_pages = jnp.where(
+        in_table,
+        jnp.take_along_axis(page_tables, jnp.minimum(lookup, maxp - 1), axis=1),
+        -1,
+    ).astype(jnp.int32)
+    tok_slots = jnp.where(in_table, pos % ps, 0).astype(jnp.int32)
+
+    qg = q.reshape(R_pad, W, Kh, rep, hd).transpose(0, 2, 1, 3, 4)
+    kernel = functools.partial(
+        _ragged_kernel,
+        sm_scale=sm_scale,
+        page_size=ps,
+        num_page_steps=maxp,
+        num_new_steps=ns,
+        num_write_steps=WP,
+        num_rows=R_pad,
+        rows_per_new_step=rn,
+        rep=rep,
+        window=window,
+    )
+
+    def _nb(pi):
+        return jnp.clip(pi - maxp, 0, ns - 1)
+
+    def _wpage(r, pi, pt, st):
+        wj = jnp.clip(pi - (maxp + ns), 0, WP - 1)
+        return pt[r, jnp.clip(st[r] // ps + wj, 0, maxp - 1)]
+
+    def _page_in(r, kvh, pi, pt, st, cx, nt, sq):
+        walk = pt[r, jnp.minimum(pi, maxp - 1)]
+        return (jnp.where(pi < maxp, walk, _wpage(r, pi, pt, st)), kvh, 0, 0)
+
+    def _page_out(r, kvh, pi, pt, st, cx, nt, sq):
+        return (_wpage(r, pi, pt, st), kvh, 0, 0)
+
+    page_block = pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R_pad, Kh, maxp + ns + WP),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, W, rep, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rn, 1),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rn, 1),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rn, 1),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (R_pad, W),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (R_pad, W),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rn, W, 1, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rn, W, 1, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (R_pad, W, 1, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (R_pad, W, 1, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            page_block,
+            pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, W, rep, hd),
+                lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((W * rep, 1), jnp.float32),
+            pltpu.VMEM((W * rep, 1), jnp.float32),
+            pltpu.VMEM((W * rep, hd), jnp.float32),
+        ],
+    )
+    starts2 = row_starts[:, None]
+    ntok2 = n_tokens[:, None]
+    seq2 = seq_ids[:, None]
+    out, kp, vp = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((R_pad, Kh, W, rep, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # operand numbering includes the five scalar-prefetch args
+        input_output_aliases={15: 1, 16: 2},
+        cost_estimate=pl.CostEstimate(
+            flops=4 * R_pad * W * H * (maxp * ps + R_pad * W) * hd,
+            bytes_accessed=(
+                2 * R_pad * (maxp + WP) * Kh * ps * hd * k_pages.dtype.itemsize
+            ),
+            transcendentals=R_pad * W * H * (maxp * ps + R_pad * W),
+        ),
+        interpret=interpret,
+    )(
+        page_tables, row_starts, ctx_lens, n_tokens, seq_ids,
+        qg, starts2, ntok2, seq2, tok_pages, tok_slots,
+        k_new, v_new, k_new, v_new, k_pages, v_pages,
+    )
+    out = out.transpose(0, 2, 1, 3, 4).reshape(R_pad, W, H, hd)
+    return out[:R], kp, vp

@@ -40,8 +40,7 @@ import numpy as np
 
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
-from agentfield_tpu.ops.paged_attention import paged_attention
-from agentfield_tpu.ops.pallas.kv_write_kernel import kv_write
+from agentfield_tpu.ops.paged_attention import ragged_paged_attention
 from agentfield_tpu.serving.grammar import Grammar
 from agentfield_tpu.serving.kv_cache import (
     PagedKVCache,
@@ -65,11 +64,13 @@ class EngineConfig:
     max_pages_per_seq: int = 32  # max context = max_pages_per_seq * page_size
     max_pending: int = 1024  # admission queue bound (reference queue default:
     # AGENTFIELD_EXEC_ASYNC_QUEUE_CAPACITY=1024, execute.go:1373)
-    attn_impl: str = "ref"  # decode attention: "ref" | "pallas"
-    kv_write_impl: str = "ref"  # decode KV append: "ref" (XLA scatter) |
-    # "pallas" (per-page patch kernel — XLA lowers the [B]-row advanced-index
-    # scatter as a serialized loop on TPU; the kernel DMAs each row's page,
-    # patches one slot, writes back in place)
+    attn_impl: str = "ref"  # decode-tick attention+KV-write: "ref" (XLA
+    # scatter + gather) | "pallas" (the ONE ragged paged-attention kernel,
+    # fused write — docs/KERNELS.md)
+    kv_write_impl: str = "ref"  # DEPRECATED alias: the ragged kernel fuses
+    # the decode KV append into the attention launch, so "pallas" here now
+    # selects the same fused kernel attn_impl="pallas" does (kept one
+    # release so existing configs keep meaning "run the kernel path")
     prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas) |
     # "ring" (sequence-parallel prefill over the mesh's `seq` axis — the
     # long-context serving path: no device materializes full-context
@@ -117,12 +118,14 @@ class EngineConfig:
     # chunk kernel's VMEM budget caps at ~512 rows; without a default, long
     # prompts silently fell back to the O(T)-materializing gather) and to
     # no chunking otherwise.
-    chunk_attn_impl: str = "auto"  # suffix/chunked-prefill attention:
-    # "pallas" (paged chunk kernel streams pages HBM→VMEM) | "ref" (per-layer
-    # full-context page gather) | "auto" (pallas when the engine already runs
-    # pallas anywhere: attn_impl=="pallas" or prefill_impl=="flash").
-    # Previously this was keyed on attn_impl alone, which silently kept
-    # prefill_impl="flash", attn_impl="ref" configs on the gather path.
+    chunk_attn_impl: str = "auto"  # chunk-shaped launches (suffix/chunked
+    # prefill, mixed ticks, speculative verify) through the ragged kernel:
+    # "pallas" (pages stream HBM→VMEM, write fused) | "ref" (XLA scatter +
+    # per-layer full-context page gather) | "auto" (pallas when the engine
+    # already runs pallas anywhere: attn_impl=="pallas" or
+    # prefill_impl=="flash"). Previously this was keyed on attn_impl alone,
+    # which silently kept prefill_impl="flash", attn_impl="ref" configs on
+    # the gather path.
     decode_buckets: tuple[int, ...] | None = None  # e.g. (4, 16): when fewer
     # slots are active, compact them into the smallest bucket width — the
     # unembed/attention cost scales with batch width, so low-occupancy decode
@@ -337,6 +340,15 @@ def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
     return cfg
 
 
+def _decode_impl(ecfg: EngineConfig) -> str:
+    """Impl for decode-tick ragged launches: the fused kernel replaces both
+    the old decode-attention kernel and the kv-write patch kernel, so either
+    legacy knob saying "pallas" selects it."""
+    if ecfg.attn_impl == "pallas" or ecfg.kv_write_impl == "pallas":
+        return "pallas"
+    return "ref"
+
+
 def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
     """The sliding window, or None when it cannot bind within this engine's
     context budget (kernels stay usable for short-context serving of
@@ -352,7 +364,6 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     """Jitted decode dispatch, cached per (model, engine, mesh) config so
     every engine instance shares one compilation. Runs ``ecfg.decode_span``
     steps as one on-device scan; returns [span, B] tokens/logprobs."""
-    ps = ecfg.page_size
 
     def one_step(
         params, k_pages, v_pages, tokens, seq_lens, page_tables, rng, temps,
@@ -362,33 +373,20 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
         positions = seq_lens  # 0-based position of the incoming token
         x = llama.embed_tokens(params, cfg, tokens)[:, None, :]  # [B,1,D]
         cos, sin = llama.rope_sincos(positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-        # Page lookup clamps + routes past-the-table writes to the garbage
-        # page: the pipelined scheduler can dispatch ONE speculative step past
-        # a request's budget (its output is discarded at harvest), and that
-        # step's KV write must not clobber a live page (XLA would otherwise
-        # silently clamp the out-of-range index onto the last table entry).
-        lookup = seq_lens // ps
-        in_table = lookup < page_tables.shape[1]
-        page_idx = jnp.take_along_axis(
-            page_tables, jnp.minimum(lookup, page_tables.shape[1] - 1)[:, None], axis=1
-        )[:, 0]  # [B] page holding this token (garbage page 0 when inactive)
-        page_idx = jnp.where(in_table, page_idx, 0)
-        slot_idx = seq_lens % ps
+        # Decode is B one-token ragged rows: row b's cached context is its
+        # seq_len keys, its single new token sits AT seq_len. The ragged
+        # kernel fuses the KV write (over-budget speculative steps route to
+        # the garbage page inside it) and the attention over cache + self.
+        n_toks = (seq_lens > 0).astype(seq_lens.dtype)
+        row_ids = jnp.arange(B, dtype=jnp.int32)
 
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
-            # kp: [P, Kh, ps, hd]; write row b's new K at (page_idx[b], :,
-            # slot_idx[b], :) — ref: advanced-index scatter (batch dim first,
-            # matching k[:, 0]'s [B, Kh, hd]); pallas: per-page patch kernel.
-            kp, vp = kv_write(
-                kp, vp, k[:, 0], v[:, 0], page_idx, slot_idx,
-                impl=ecfg.kv_write_impl, mesh=mesh,
-            )
-            attn = paged_attention(
-                q[:, 0], kp, vp, page_tables, seq_lens + 1,
-                impl=ecfg.attn_impl, mesh=mesh,
+            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)  # [B, 1, ...]
+            attn, kp, vp = ragged_paged_attention(
+                q, k, v, kp, vp, page_tables, seq_lens, n_toks, seq_lens,
+                row_ids, impl=_decode_impl(ecfg), mesh=mesh,
                 window=_binding_window(cfg, ecfg),
             )
             x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
@@ -463,41 +461,6 @@ def _decode_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     return jax.jit(decode, donate_argnums=(1, 2))
 
 
-def _ragged_chunk_attn_fn(cfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
-    """Batched ragged chunk attention dispatch shared by the speculative
-    verify forward and the mixed token-budget tick: the pallas kernel
-    (interpret-mode on CPU backends), under shard_map over the KV-head axis
-    when the mesh is tensor-parallel. Returns a callable
-    ``(q [B,W,H,hd], k_pages, v_pages, page_tables, starts, k_lens)``."""
-    from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
-        paged_batch_chunk_attention_pallas,
-    )
-
-    fn = functools.partial(
-        paged_batch_chunk_attention_pallas,
-        interpret=jax.default_backend() == "cpu",
-        window=_binding_window(cfg, ecfg),
-    )
-    if mesh is not None:
-        from jax.sharding import PartitionSpec as P
-
-        from agentfield_tpu.parallel.mesh import AXIS_MODEL
-        from agentfield_tpu.parallel.mesh import shard_map  # version compat
-
-        if mesh.shape.get(AXIS_MODEL, 1) > 1:
-            fn = shard_map(
-                fn, mesh=mesh,
-                in_specs=(
-                    P(None, None, AXIS_MODEL, None),  # q [B,W,H,hd]
-                    P(None, AXIS_MODEL, None, None),  # pages on Kh
-                    P(None, AXIS_MODEL, None, None),
-                    P(None, None), P(None), P(None),
-                ),
-                out_specs=P(None, None, AXIS_MODEL, None),
-            )
-    return fn
-
-
 @functools.lru_cache(maxsize=None)
 def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mesh=None):
     """Jitted speculative decode step with PER-ROW verification modes: the
@@ -530,9 +493,6 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
     everything is accepted."""
     k = ecfg.spec_k
     W = k + 1  # verify width
-    ps = ecfg.page_size
-    maxp = ecfg.max_pages_per_seq
-    T = maxp * ps
 
     def draft_step(dparams, kp, vp, tokens, seq_lens, page_tables, temps, rng):
         """One draft step: greedy rows take the argmax, sampled rows draw
@@ -543,25 +503,16 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
         cos, sin = llama.rope_sincos(
             seq_lens[:, None], dcfg.head_dim, dcfg.rope_theta, dcfg.rope_scaling
         )
-        lookup = seq_lens // ps
-        in_table = lookup < page_tables.shape[1]
-        page_idx = jnp.take_along_axis(
-            page_tables, jnp.minimum(lookup, page_tables.shape[1] - 1)[:, None], axis=1
-        )[:, 0]
-        page_idx = jnp.where(in_table, page_idx, 0)
-        slot_idx = seq_lens % ps
+        n_toks = (seq_lens > 0).astype(seq_lens.dtype)
+        row_ids = jnp.arange(B, dtype=jnp.int32)
 
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], dcfg.rms_norm_eps)
             q, kk, vv = llama.qkv_proj(lp, h, dcfg, cos, sin)
-            kp, vp = kv_write(
-                kp, vp, kk[:, 0], vv[:, 0], page_idx, slot_idx,
-                impl=ecfg.kv_write_impl, mesh=mesh,
-            )
-            attn = paged_attention(
-                q[:, 0], kp, vp, page_tables, seq_lens + 1,
-                impl=ecfg.attn_impl, mesh=mesh,
+            attn, kp, vp = ragged_paged_attention(
+                q, kk, vv, kp, vp, page_tables, seq_lens, n_toks, seq_lens,
+                row_ids, impl=_decode_impl(ecfg), mesh=mesh,
                 window=_binding_window(dcfg, ecfg),
             )
             x = x + (attn.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
@@ -586,45 +537,20 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
         positions = seq_lens[:, None] + jnp.arange(W, dtype=seq_lens.dtype)  # [B, W]
         x = llama.embed_tokens(params, cfg, x_tokens)  # [B, W, D]
         cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-        lookup = positions // ps
-        in_table = (lookup < maxp) & active[:, None]
-        page_ids = jnp.where(
-            in_table,
-            jnp.take_along_axis(page_tables, jnp.minimum(lookup, maxp - 1), axis=1),
-            0,
-        )  # [B, W] (garbage page 0 for inactive/over-budget writes)
-        slot_ids = positions % ps
-        k_pos = jnp.arange(T, dtype=jnp.int32)[None]  # [1, T]
-        k_valid = (k_pos < (seq_lens + W)[:, None]) & active[:, None]
-
-        k_lens = jnp.where(active, seq_lens + W, 0)
-
-        def _batch_chunk_attn(q, kp, vp):
-            """Verify attention over the paged cache: the batched chunk
-            kernel streams each row's pages HBM→VMEM (chunk_attn_impl=
-            "pallas"); the ref path gathers [B, T] context per layer."""
-            return _ragged_chunk_attn_fn(cfg, ecfg, mesh)(
-                q, kp, vp, page_tables, seq_lens, k_lens
-            )
+        # One W-token ragged row per sequence: cached context = seq_len keys,
+        # the W verify tokens are the launch's new keys (write fused).
+        n_toks = jnp.where(active, W, 0).astype(seq_lens.dtype)
+        row_ids = jnp.arange(B, dtype=jnp.int32)
 
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, kk, vv = llama.qkv_proj(lp, h, cfg, cos, sin)
-            # scatter W new K/V per row: kp[page_ids[b,i], :, slot_ids[b,i]]
-            # — non-adjacent advanced indices put [B, W] first: [B, W, Kh, hd]
-            kp = kp.at[page_ids, :, slot_ids].set(kk)
-            vp = vp.at[page_ids, :, slot_ids].set(vv)
-            if ecfg.chunk_attn_impl == "pallas":
-                attn = _batch_chunk_attn(q, kp, vp)
-            else:
-                # ref path: gather each row's pages → [B, T, Kh, hd] context
-                ctx_k = kp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-                ctx_v = vp[page_tables].transpose(0, 1, 3, 2, 4).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-                attn = llama.attention_ref(
-                    q, ctx_k, ctx_v, positions, jnp.broadcast_to(k_pos, (B, T)), k_valid,
-                    window=_binding_window(cfg, ecfg),
-                )
+            attn, kp, vp = ragged_paged_attention(
+                q, kk, vv, kp, vp, page_tables, seq_lens, n_toks, seq_lens,
+                row_ids, impl=ecfg.chunk_attn_impl, mesh=mesh,
+                window=_binding_window(cfg, ecfg),
+            )
             x = x + (attn.reshape(B, W, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
             return x, (kp, vp)
@@ -850,56 +776,50 @@ def _prefill_inject_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=N
 @functools.lru_cache(maxsize=None)
 def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     """Prefill `n_new` suffix tokens starting at absolute position `start`,
-    attending over the session's CACHED pages as well as the freshly written
-    ones (prefix-cache hit path: only the suffix pays prefill FLOPs).
+    attending over the session's CACHED pages as well as the chunk's own
+    keys (prefix-cache hit path: only the suffix pays prefill FLOPs).
 
-    With ``attn_impl="pallas"`` the per-layer attention is the paged CHUNK
-    kernel — pages stream HBM→VMEM and the gathered [max_context] context is
-    never materialized (the ref path gathers it per layer per chunk)."""
-    ps = ecfg.page_size
-    maxp = ecfg.max_pages_per_seq
-    T = maxp * ps
+    The chunk runs as ragged rows of the autotuned ``block_q`` width (one
+    row covering the whole bucket by default): the kernel streams the cached
+    pages HBM→VMEM, serves intra-chunk causality from its same-launch
+    new-key phase, and writes the chunk's K/V into the pool in the same
+    launch — there is no separate scatter step and no per-layer
+    [max_context] gather on the kernel path."""
+    from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
+
+    W = min(lookup_blocks(ecfg.page_size, cfg.head_dim, bucket).block_q, bucket)
+    R = -(-bucket // W)
+    n_pad = R * W - bucket
 
     def prefill(params, k_pages, v_pages, tokens, start, n_new, page_table_row):
         positions = (start + jnp.arange(bucket, dtype=jnp.int32))[None]  # [1, B]
         x = llama.embed_tokens(params, cfg, tokens)
         cos, sin = llama.rope_sincos(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-        pos = positions[0]
         rel = jnp.arange(bucket, dtype=jnp.int32)
         in_range = rel < n_new
-        page_ids = jnp.where(in_range, page_table_row[(pos // ps) % maxp], 0)
-        slot_ids = pos % ps
-        k_pos = jnp.arange(T, dtype=jnp.int32)[None]
-        k_valid = k_pos < (start + n_new)
+        tables = jnp.broadcast_to(page_table_row[None], (R, page_table_row.shape[0]))
+        row_starts = start + jnp.arange(R, dtype=jnp.int32) * W
+        n_toks = jnp.clip(n_new - jnp.arange(R, dtype=jnp.int32) * W, 0, W)
+        ctx_lens = jnp.full((R,), start, jnp.int32)
+        seq_ids = jnp.zeros((R,), jnp.int32)
+
+        def as_rows(t):  # [1, bucket, ...] → [R, W, ...]
+            t = t[0]
+            if n_pad:
+                t = jnp.pad(t, ((0, n_pad),) + ((0, 0),) * (t.ndim - 1))
+            return t.reshape((R, W) + t.shape[1:])
 
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
-            kp = kp.at[page_ids, :, slot_ids].set(k[0])
-            vp = vp.at[page_ids, :, slot_ids].set(v[0])
-            # Kernel VMEM (q/o blocks + f32 accumulator) scales with the
-            # chunk width; past ~512 rows it blows the ~16MB budget, so wide
-            # suffixes fall back to the gather path (prefill_chunk defaults
-            # to <=512 when the kernel is on, keeping long prompts here).
-            if ecfg.chunk_attn_impl == "pallas" and bucket <= 512:
-                from agentfield_tpu.ops.pallas.paged_chunk_attention_kernel import (
-                    paged_chunk_attention_pallas,
-                )
-
-                attn = paged_chunk_attention_pallas(
-                    q[0], kp, vp, page_table_row, start, start + n_new,
-                    interpret=jax.default_backend() == "cpu",
-                    window=_binding_window(cfg, ecfg),
-                )[None]
-            else:
-                # [maxp, Kh, ps, hd] → [1, T, Kh, hd]
-                kk = kp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-                vv = vp[page_table_row].transpose(0, 2, 1, 3).reshape(1, T, cfg.num_kv_heads, cfg.head_dim)
-                attn = llama.attention_ref(
-                    q, kk, vv, positions, k_pos, k_valid,
-                    window=_binding_window(cfg, ecfg),
-                )
+            attn, kp, vp = ragged_paged_attention(
+                as_rows(q), as_rows(k), as_rows(v), kp, vp, tables,
+                row_starts, n_toks, ctx_lens, seq_ids,
+                impl=ecfg.chunk_attn_impl,
+                window=_binding_window(cfg, ecfg),
+            )
+            attn = attn.reshape(R * W, cfg.num_heads, cfg.head_dim)[:bucket][None]
             x = x + (attn.reshape(1, bucket, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg, in_range[None])
             return x, (kp, vp)
@@ -918,75 +838,39 @@ def _mixed_step_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None)
     forward over ``mixed_step_budget`` packed tokens, each its own
     n_tokens=1 row — decode tokens (one per active slot, at its sequence's
     next position) and prefill-chunk tokens (consecutive positions of an
-    admitting prompt) ride the same batched ragged chunk attention
-    (paged_batch_chunk_kernel; decode rows walk exactly their pages). KV
-    scatters into the paged pool through the same multi-row kv_write the
-    decode step uses; per-token ``k_lens`` (position+1, or 0 for padding)
-    gives causal masking within a chunk for free since a chunk's KV lands
-    before its attention each layer. Every position's logits are sampled
-    with per-token params (the host reads only the rows it needs: decode
-    rows, and a chunk's last token when it completes the prompt). One
-    compile per ``bucket`` (EngineConfig.mixed_bucket widths up to the
-    budget) — the whole prefill-bucket x decode-bucket matrix collapses to
-    this one ladder."""
-    ps = ecfg.page_size
-    maxp = ecfg.max_pages_per_seq
+    admitting prompt, sharing a launch-local ``seq_id``) are the ragged
+    paged-attention kernel's NATIVE input (``pack_ragged_rows``). The kernel
+    fuses the multi-row KV write into the launch; a chunk's later tokens see
+    its earlier ones through the kernel's same-launch new-key phase, so
+    causal masking within a chunk is exact with no pre-scatter. Every
+    position's logits are sampled with per-token params (the host reads only
+    the rows it needs: decode rows, and a chunk's last token when it
+    completes the prompt). One compile per ``bucket``
+    (EngineConfig.mixed_bucket widths up to the budget) — the whole
+    prefill-bucket x decode-bucket matrix collapses to this one ladder."""
     N = bucket
 
-    def chunk_attn(q, kp, vp, page_tables, starts, k_lens):
-        # q: [N, 1, H, hd] — n_tokens=1 rows through the ragged chunk path
-        if ecfg.chunk_attn_impl != "pallas":
-            from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
-                paged_batch_chunk_attention_ref,
-            )
-
-            return paged_batch_chunk_attention_ref(
-                q, kp, vp, page_tables, starts, k_lens,
-                window=_binding_window(cfg, ecfg),
-            )
-        return _ragged_chunk_attn_fn(cfg, ecfg, mesh)(
-            q, kp, vp, page_tables, starts, k_lens
-        )
-
     def mixed(
-        params, k_pages, v_pages, tokens, positions, page_tables, k_lens,
-        rng, temps, top_ks, top_ps,
+        params, k_pages, v_pages, tokens, page_tables, row_starts, n_toks,
+        ctx_lens, seq_ids, rng, temps, top_ks, top_ps,
     ):
-        # tokens/positions/k_lens: [N]; page_tables: [N, maxp] — one page
-        # table ROW per token (decode rows repeat their slot's row; chunk
-        # rows repeat their job's row). k_lens == 0 marks padding.
-        active = k_lens > 0
-        x = llama.embed_tokens(params, cfg, tokens)[:, None, :]  # [N,1,D]
+        # tokens [N, 1]; page_tables [N, maxp]; row_starts/n_toks/ctx_lens/
+        # seq_ids [N] — pack_ragged_rows' W=1 descriptor (n_toks == 0 marks
+        # padding; a chunk's rows share seq_id and its ctx_len).
+        x = llama.embed_tokens(params, cfg, tokens[:, 0])[:, None, :]  # [N,1,D]
         cos, sin = llama.rope_sincos(
-            positions[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+            row_starts[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
         )
-        lookup = positions // ps
-        in_table = (lookup < maxp) & active
-        page_idx = jnp.where(
-            in_table,
-            jnp.take_along_axis(
-                page_tables, jnp.minimum(lookup, maxp - 1)[:, None], axis=1
-            )[:, 0],
-            0,
-        )  # [N] (garbage page 0 for padding/over-budget writes)
-        slot_idx = positions % ps
 
         def body(x, xs):
             lp, kp, vp = xs
             h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)
-            # Multi-row ragged scatter: token i's K/V land at
-            # (page_idx[i], slot_idx[i]). A prefill chunk writes MULTIPLE
-            # slots of the same page in this one call — the pallas kv_write
-            # kernel's per-row copy-then-patch assumes decode's one-write-
-            # per-page invariant and would keep only the last row's slot, so
-            # mixed ticks always use the exact XLA scatter (distinct
-            # (page, slot) pairs; kv_write_impl governs the decode step only).
-            kp, vp = kv_write(
-                kp, vp, k[:, 0], v[:, 0], page_idx, slot_idx,
-                impl="ref", mesh=mesh,
+            q, k, v = llama.qkv_proj(lp, h, cfg, cos, sin)  # [N, 1, ...]
+            attn, kp, vp = ragged_paged_attention(
+                q, k, v, kp, vp, page_tables, row_starts, n_toks, ctx_lens,
+                seq_ids, impl=ecfg.chunk_attn_impl, mesh=mesh,
+                window=_binding_window(cfg, ecfg),
             )
-            attn = chunk_attn(q, kp, vp, page_tables, positions, k_lens)
             x = x + (attn.reshape(N, 1, -1) @ lp["wo"]).astype(x.dtype)
             x = x + llama.mlp_block(lp, x, cfg)
             return x, (kp, vp)
@@ -1070,9 +954,16 @@ class InferenceEngine:
         # Normalize the "auto" knobs ONCE so every jit cache key (the ecfg is
         # part of the lru_cache key) sees resolved values.
         if self.ecfg.chunk_attn_impl == "auto":
+            # "the engine already runs pallas anywhere" includes the
+            # deprecated kv_write_impl alias — a legacy kernel-path config
+            # must not silently keep chunk launches on the gather path
             resolved = (
                 "pallas"
-                if (self.ecfg.attn_impl == "pallas" or self.ecfg.prefill_impl == "flash")
+                if (
+                    self.ecfg.attn_impl == "pallas"
+                    or self.ecfg.prefill_impl == "flash"
+                    or self.ecfg.kv_write_impl == "pallas"
+                )
                 else "ref"
             )
             self.ecfg = dataclasses.replace(self.ecfg, chunk_attn_impl=resolved)
@@ -1080,6 +971,16 @@ class InferenceEngine:
             raise ValueError(
                 f"chunk_attn_impl={self.ecfg.chunk_attn_impl!r} must be "
                 "'auto', 'pallas', or 'ref'"
+            )
+        if self.ecfg.attn_impl not in ("pallas", "ref"):
+            raise ValueError(
+                f"attn_impl={self.ecfg.attn_impl!r} must be 'pallas' or 'ref'"
+            )
+        if self.ecfg.kv_write_impl not in ("pallas", "ref"):
+            raise ValueError(
+                f"kv_write_impl={self.ecfg.kv_write_impl!r} must be 'pallas' "
+                "or 'ref' (deprecated alias of attn_impl — the ragged kernel "
+                "fuses the decode KV write)"
             )
         if self.ecfg.prefill_chunk is None and self.ecfg.chunk_attn_impl == "pallas":
             # Long prompts default onto the chunk kernel instead of the
@@ -3011,35 +2912,34 @@ class InferenceEngine:
             (job.row, job.pos, job.req.prompt[job.pos : job.pos + n])
             for job, n in chunks
         ]
-        tokens, positions, tables, k_lens = pack_ragged_rows(
-            rows, self.ecfg.max_pages_per_seq, bucket
-        )
+        rr = pack_ragged_rows(rows, self.ecfg.max_pages_per_seq, bucket)
         temps = np.zeros((bucket,), np.float32)
         top_ks = np.zeros((bucket,), np.int32)
         top_ps = np.ones((bucket,), np.float32)
         for j, (i, _) in enumerate(active):
-            temps[j] = self.temps[i]
-            top_ks[j] = self.top_ks[i]
-            top_ps[j] = self.top_ps[i]
-        base = n_active
-        for job, n in chunks:
+            temps[rr.last_flat[j]] = self.temps[i]
+            top_ks[rr.last_flat[j]] = self.top_ks[i]
+            top_ps[rr.last_flat[j]] = self.top_ps[i]
+        for j, (job, n) in enumerate(chunks):
             if job.pos + n == len(job.req.prompt):
                 # the chunk reaches the prompt's last token: its logits
                 # sample the request's FIRST generated token this tick
                 s = job.req.sampling
-                temps[base + n - 1] = s.temperature
-                top_ks[base + n - 1] = s.top_k
-                top_ps[base + n - 1] = s.top_p
-            base += n
+                flat = rr.last_flat[n_active + j]
+                temps[flat] = s.temperature
+                top_ks[flat] = s.top_k
+                top_ps[flat] = s.top_p
         fn = _mixed_step_fn(self.cfg, self.ecfg, bucket, self.mesh)
         toks, lps, self.cache.k_pages, self.cache.v_pages = fn(
             self.params,
             self.cache.k_pages,
             self.cache.v_pages,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(tables),
-            jnp.asarray(k_lens),
+            jnp.asarray(rr.tokens),
+            jnp.asarray(rr.page_tables),
+            jnp.asarray(rr.row_starts),
+            jnp.asarray(rr.n_tokens),
+            jnp.asarray(rr.ctx_lens),
+            jnp.asarray(rr.seq_ids),
             self._next_rng(),
             jnp.asarray(temps),
             jnp.asarray(top_ks),
@@ -3048,7 +2948,8 @@ class InferenceEngine:
         toks_np, lps_np = np.asarray(toks), np.asarray(lps)
         events: list[TokenEvent] = []
         for j, (i, slot) in enumerate(active):
-            tok, logprob = int(toks_np[j]), float(lps_np[j])
+            flat = rr.last_flat[j]
+            tok, logprob = int(toks_np[flat]), float(lps_np[flat])
             slot.length += 1
             slot.generated += 1
             slot.last_token = tok
@@ -3057,19 +2958,18 @@ class InferenceEngine:
             self.last_tokens[i] = tok
             self.stats["decode_tokens"] += 1
             events.append(self._emit(i, slot, tok, logprob))
-        base = n_active
-        for job, n in chunks:
+        for j, (job, n) in enumerate(chunks):
             job.pos += n
             self.stats["prefill_tokens"] += n
             if job.pos == len(job.req.prompt):
-                tok = int(toks_np[base + n - 1])
-                logprob = float(lps_np[base + n - 1])
+                flat = rr.last_flat[n_active + j]
+                tok = int(toks_np[flat])
+                logprob = float(lps_np[flat])
                 self._prefill_jobs.remove(job)
                 free_slot = next(i for i, s in enumerate(self.slots) if s is None)
                 events.append(
                     self._install(job.req, free_slot, job.pages, job.row, tok, logprob)
                 )
-            base += n
         if n_active:
             self.stats["decode_steps"] += 1
         carried = n_active + sum(n for _, n in chunks)

@@ -9,8 +9,8 @@ resident in HBM pages so agent→agent call chains never re-prefill
 Layout: ``[num_layers, num_pages, num_kv_heads, page_size, head_dim]`` —
 layers stacked on axis 0 so the decode step scans over them; the trailing
 ``(page_size, head_dim)`` block is a whole VMEM tile per (page, kv-head), which
-is exactly the unit the Pallas paged-decode kernel DMAs (Mosaic requires the
-last two block dims be full array dims or (8,128)-aligned — the former
+is exactly the unit the ragged paged-attention kernel DMAs (Mosaic requires
+the last two block dims be full array dims or (8,128)-aligned — the former
 ``[.., ps, Kh, hd]`` layout forced (1, hd) blocks and failed TPU lowering).
 Page 0 is reserved as a garbage sink: inactive decode slots write
 there, which keeps the decode step shape-static with no host branching.
@@ -122,36 +122,64 @@ def pack_ragged_rows(
     rows: Sequence[tuple[np.ndarray, int, Sequence[int]]],
     max_pages: int,
     budget: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Flatten ragged ``(page_table_row, start_pos, tokens)`` descriptors into
-    the fixed-width per-token arrays the mixed token-budget forward consumes
-    (docs/MIXED_SCHEDULING.md): every token becomes its own n_tokens=1 ragged
-    row against its sequence's page table. Decode rows are 1-token
-    descriptors; prefill chunks contribute one entry per chunk token.
+    block_q: int = 1,
+) -> "RaggedRows":
+    """Pack ragged ``(page_table_row, start_pos, tokens)`` entries into the
+    ragged paged-attention kernel's NATIVE descriptor
+    (``ops.paged_attention.RaggedRows``, docs/KERNELS.md): each entry
+    becomes ``ceil(len(tokens) / block_q)`` kernel rows of width ``block_q``
+    sharing a launch-local ``seq_id``, so an entry's later tokens attend its
+    earlier ones through the kernel's same-launch new-key phase. Decode
+    entries are 1-token; prefill chunks contribute their whole chunk.
 
-    Returns ``(tokens [budget], positions [budget], tables [budget, max_pages],
-    k_lens [budget])`` — padding entries carry k_len 0 (inactive: attention
-    returns zeros, KV writes route to garbage page 0). The multi-row scatter
-    install into the paged pool follows from these arrays: token i writes at
-    ``(tables[i][positions[i] // page_size], positions[i] % page_size)``.
+    ``ctx_lens`` is the entry's ``start_pos`` for every row it spans — the
+    keys already IN the pool when the launch begins; everything from
+    ``start_pos`` on is written BY the launch (the kernel fuses the write).
+    Padding rows carry ``n_tokens`` 0 / ``seq_id`` -1 (zero output, no
+    writes). Capacity is ``budget`` tokens = ``budget // block_q`` rows;
+    overflow raises.
     """
-    tokens = np.zeros((budget,), np.int32)
-    positions = np.zeros((budget,), np.int32)
-    tables = np.zeros((budget, max_pages), np.int32)
-    k_lens = np.zeros((budget,), np.int32)
-    idx = 0
-    for row, start, toks in rows:
+    from agentfield_tpu.ops.paged_attention import RaggedRows
+
+    W = max(1, block_q)
+    R = budget // W
+    tokens = np.zeros((R, W), np.int32)
+    tables = np.zeros((R, max_pages), np.int32)
+    row_starts = np.zeros((R,), np.int32)
+    n_tokens = np.zeros((R,), np.int32)
+    ctx_lens = np.zeros((R,), np.int32)
+    seq_ids = np.full((R,), -1, np.int32)
+    last_flat: list[int] = []
+    r = 0
+    for sid, (row, start, toks) in enumerate(rows):
         n = len(toks)
-        if idx + n > budget:
+        if n == 0:
+            raise ValueError("ragged entry with zero tokens")
+        need = -(-n // W)
+        if r + need > R:
             raise ValueError(
-                f"ragged rows hold {idx + n}+ tokens > budget {budget}"
+                f"ragged rows need {r + need}+ rows > capacity "
+                f"{R} (budget {budget} / block_q {W})"
             )
-        tokens[idx : idx + n] = np.asarray(toks, np.int32)
-        positions[idx : idx + n] = start + np.arange(n, dtype=np.int32)
-        tables[idx : idx + n] = row
-        k_lens[idx : idx + n] = positions[idx : idx + n] + 1
-        idx += n
-    return tokens, positions, tables, k_lens
+        for i in range(need):
+            chunk = toks[i * W : (i + 1) * W]
+            tokens[r, : len(chunk)] = np.asarray(chunk, np.int32)
+            tables[r] = row
+            row_starts[r] = start + i * W
+            n_tokens[r] = len(chunk)
+            ctx_lens[r] = start
+            seq_ids[r] = sid
+            r += 1
+        last_flat.append((r - 1) * W + (n - 1) % W)
+    return RaggedRows(
+        tokens=tokens,
+        page_tables=tables,
+        row_starts=row_starts,
+        n_tokens=n_tokens,
+        ctx_lens=ctx_lens,
+        seq_ids=seq_ids,
+        last_flat=last_flat,
+    )
 
 
 def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:

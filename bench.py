@@ -68,6 +68,15 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     Reports resume TTFT p50/p99 both modes, restore hit rate, and the
     kv_offload_* counters; headline value = resume TTFT p50 speedup
     (OFF/ON; acceptance: > 1.0). AGENTFIELD_BENCH_SESSIONS sizes the set.
+  kernels — ragged paged-attention kernel microbench (no model;
+    docs/KERNELS.md): the canonical shape mixes (pure_decode, pure_prefill,
+    mixed_ragged, long_context_paged — tools/perf/kernel_gate.SHAPES, the
+    same shapes the tier-1 regression gate replays) with nearest-rank
+    p50/p99 per mix, Pallas-interpret parity vs the XLA ref, Mosaic kernel
+    wall-times on a real accelerator, and an optional autotune sweep
+    (AGENTFIELD_BENCH_KERNEL_SWEEP=1) reporting the winning block sizes.
+    The JSON's "kernel" block is the BENCH_r10-style record kernel_gate
+    diffs against. Headline value = mixed_ragged ref p50 (ms).
   fault_storm — control-plane failure-domain bench (no model, no chip;
     docs/FAULT_TOLERANCE.md): a real in-process control plane + two agent
     nodes serving the same component; a seeded FaultInjector schedule kills
@@ -346,6 +355,13 @@ def _run_bench() -> None:
         _gateway_qps()
         _done.set()
         return
+    # kernels needs no model either — only the attention shapes. On CPU it
+    # times the XLA ref + checks Pallas-interpret parity; on TPU it also
+    # times the Mosaic kernel at the same shapes.
+    if os.environ.get("AGENTFIELD_BENCH_SCENARIO") == "kernels":
+        _kernel_bench(cpu)
+        _done.set()
+        return
 
     # --- Stage 1: probe (claim discipline). Budget: enough for one slow
     # claim + retry, but bounded so the compile gate always gets its share.
@@ -506,7 +522,7 @@ def _run_bench() -> None:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
             "(have: shared_prefix_burst, mixed_interference, overload_storm, "
-            "session_churn, fault_storm, gateway_qps)"
+            "session_churn, fault_storm, gateway_qps, kernels)"
         )
 
     demoted = None
@@ -516,8 +532,12 @@ def _run_bench() -> None:
             demoted = "budget exhausted before pallas correctness gate"
         else:
             from agentfield_tpu.models import llama as _llama
-            from agentfield_tpu.ops.paged_attention import paged_attention_ref
-            from agentfield_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
+            from agentfield_tpu.ops.paged_attention import (
+                ragged_paged_attention_ref,
+            )
+            from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+                ragged_paged_attention_pallas,
+            )
 
             key = jax.random.PRNGKey(7)
             # prefill: flash vs ref logits on one short prompt
@@ -526,20 +546,29 @@ def _run_bench() -> None:
             lr, _ = _llama.forward(params, cfg, toks, pos, collect_kv=False, attn_impl="ref")
             lf, _ = _llama.forward(params, cfg, toks, pos, collect_kv=False, attn_impl="flash")
             prefill_err = float(jnp.max(jnp.abs(lr - lf)) / (jnp.max(jnp.abs(lr)) + 1e-6))
-            # decode: paged kernel vs gather reference on a random pool
+            # decode: the ragged kernel (fused write, 1-token rows) vs the
+            # XLA scatter+gather reference on a random pool
+            import numpy as _np
+
             hd, kh = cfg.head_dim, cfg.num_kv_heads
-            ks = jax.random.split(key, 5)
+            ks = jax.random.split(key, 6)
             kp = jax.random.normal(ks[0], (65, kh, 32, hd), jnp.bfloat16)
             vp = jax.random.normal(ks[1], (65, kh, 32, hd), jnp.bfloat16)
-            q = jax.random.normal(ks[2], (4, cfg.num_heads, hd), jnp.bfloat16)
-            pt = jax.random.randint(ks[3], (4, 8), 1, 65, jnp.int32)
+            q = jax.random.normal(ks[2], (4, 1, cfg.num_heads, hd), jnp.bfloat16)
+            kn = jax.random.normal(ks[4], (4, 1, kh, hd), jnp.bfloat16)
+            vn = jax.random.normal(ks[5], (4, 1, kh, hd), jnp.bfloat16)
+            perm = _np.random.default_rng(7).permutation(64) + 1
+            pt = jnp.asarray(perm[: 4 * 8].reshape(4, 8), jnp.int32)
             sl = jnp.asarray([200, 7, 96, 33], jnp.int32)
-            ref_jit = jax.jit(paged_attention_ref)
+            nt = jnp.ones((4,), jnp.int32)
+            sq = jnp.arange(4, dtype=jnp.int32)
+            ref_jit = jax.jit(ragged_paged_attention_ref)
             pal_jit = jax.jit(
-                lambda *a: paged_attention_pallas(*a, interpret=not on_tpu)
+                lambda *a: ragged_paged_attention_pallas(*a, interpret=not on_tpu)
             )
-            o_ref = ref_jit(q, kp, vp, pt, sl)
-            o_pal = pal_jit(q, kp, vp, pt, sl)
+            args = (q, kn, vn, kp, vp, pt, sl, nt, sl, sq)
+            o_ref, _, _ = ref_jit(*args)
+            o_pal, _, _ = pal_jit(*args)
             decode_err = float(
                 jnp.max(jnp.abs(o_ref.astype(jnp.float32) - o_pal.astype(jnp.float32)))
             )
@@ -547,13 +576,12 @@ def _run_bench() -> None:
                 # kernel-vs-ref timing, real readback each iter (dispatch-only
                 # timings lie on this tunnel). Interpret-mode timings on CPU
                 # are meaningless and minutes-slow, so TPU only.
-                import numpy as _np
 
                 def _time(fn, iters=6):
-                    fn(q, kp, vp, pt, sl)  # warm
+                    fn(*args)  # warm
                     t = time.perf_counter()
                     for _ in range(iters):
-                        float(_np.asarray(jnp.sum(fn(q, kp, vp, pt, sl))))
+                        float(_np.asarray(jnp.sum(fn(*args)[0])))
                     return (time.perf_counter() - t) / iters * 1e3
 
                 _partial["paged_decode_ref_ms"] = round(_time(ref_jit), 2)
@@ -1241,10 +1269,12 @@ def _mixed_interference(model: str, cfg, params, attn: str) -> None:
                 fn = _mixed_step_fn(eng.cfg, eng.ecfg, w_, None)
                 _, _, kp, vp = fn(
                     eng.params, kp, vp,
-                    jnp.zeros((w_,), jnp.int32),
-                    jnp.zeros((w_,), jnp.int32),
+                    jnp.zeros((w_, 1), jnp.int32),
                     jnp.zeros((w_, ecfg.max_pages_per_seq), jnp.int32),
-                    jnp.zeros((w_,), jnp.int32),  # k_lens 0: all padding
+                    jnp.zeros((w_,), jnp.int32),
+                    jnp.zeros((w_,), jnp.int32),  # n_tokens 0: all padding
+                    jnp.zeros((w_,), jnp.int32),
+                    jnp.full((w_,), -1, jnp.int32),
                     jax.random.PRNGKey(0),
                     jnp.zeros((w_,), jnp.float32),
                     jnp.zeros((w_,), jnp.int32),
@@ -1480,6 +1510,76 @@ class _EchoNode:
             self.runner = None
 
     stop = kill
+
+
+def _kernel_bench(cpu: bool) -> None:
+    """FlashInfer-Bench-style kernel microbench (docs/KERNELS.md): the
+    canonical ragged shape mixes (tools/perf/kernel_gate.SHAPES — the SAME
+    shapes the tier-1 regression gate replays) timed with nearest-rank
+    p50/p99, Pallas-interpret parity vs the XLA ref on the fast subset, and
+    — on a real accelerator — Mosaic kernel wall-times. With
+    AGENTFIELD_BENCH_KERNEL_SWEEP=1 it also runs the autotune sweep over
+    the DEFAULT_TABLE keys and reports the winning blocks (the runbook's
+    regeneration step). Headline value = mixed_ragged ref p50 (ms); the
+    JSON's "kernel" block is what BENCH_r10.json checks in and what
+    tools/perf/kernel_gate diffs against."""
+    from tools.perf.kernel_gate import (
+        _pin_microbench_env,
+        compare,
+        latest_committed_bench,
+        run_microbench,
+    )
+
+    # Pin BEFORE anything (incl. the backend probe below) can initialize
+    # XLA: the committed baseline must be measured under the same topology
+    # the tier-1 gate replays, or matched shapes stop being comparable.
+    _pin_microbench_env()
+    import jax
+
+    on_accel = not cpu and jax.default_backend() not in ("cpu",)
+    block = run_microbench(
+        fast=False, iters=9, parity=True, kernel_timings=on_accel
+    )
+    # the fast block is what the tier-1 gate replays: extra iters give its
+    # min-of-N floor a stable committed reference
+    fast_block = run_microbench(fast=True, iters=25, parity=False)
+    payload: dict = {
+        "metric": "kernels_ragged_paged_attention",
+        "value": block["shapes"]["mixed_ragged"]["p50_ms"],
+        "unit": "ref_p50_ms_mixed_ragged",
+        "kernel": block,
+        "kernel_fast": fast_block,
+        "device": str(jax.devices()[0]),
+    }
+    parity_ok = all(
+        s.get("parity_pool_exact", True)
+        and s.get("parity_max_abs_err", 0.0) < 2e-3
+        for s in block["shapes"].values()
+    )
+    payload["parity_ok"] = parity_ok
+    prev = latest_committed_bench(os.path.dirname(os.path.abspath(__file__)))
+    if prev is not None:
+        import json as _json
+
+        committed = _json.loads(open(prev).read()).get("kernel")
+        if committed:
+            payload["vs_committed"] = {
+                "file": os.path.basename(str(prev)),
+                "regressions": compare(block, committed),
+            }
+    if os.environ.get("AGENTFIELD_BENCH_KERNEL_SWEEP") == "1":
+        from agentfield_tpu.ops.pallas.kernel_autotune import (
+            DEFAULT_TABLE,
+            sweep,
+        )
+
+        keys = sorted(DEFAULT_TABLE)
+        winners = sweep(keys[: int(os.environ.get("AGENTFIELD_BENCH_SWEEP_KEYS", "4"))])
+        payload["autotune_sweep"] = {
+            f"{k}": {"block_q": v.block_q, "block_n": v.block_n}
+            for k, v in winners.items()
+        }
+    _emit(payload)
 
 
 def _fault_storm() -> None:

@@ -175,7 +175,12 @@ def test_model_backend_drain(params):
                     break
                 await asyncio.sleep(0.01)
             assert backend.engine.has_work()
-            summary = await backend.drain(grace_s=0.05)
+            # grace 0: the cutoff fires immediately, so the deadline-out is
+            # deterministic — with a nonzero grace a fully WARM jit cache
+            # (tier-1 runs this after other engine batteries share the
+            # persistent compile cache) let all 48 tokens finish inside the
+            # grace window and the drain had nothing left to cancel
+            summary = await backend.drain(grace_s=0.0)
             assert summary["drained"], summary
             assert summary["deadline_outed"] == 1
             result = await asyncio.wait_for(task, timeout=30)

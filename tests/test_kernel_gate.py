@@ -1,0 +1,96 @@
+"""tools/perf/kernel_gate: the kernel layer's measured-regression loop
+(docs/KERNELS.md). Tier-1 runs the FAST CPU-ref subset only: compare()
+fixtures (must-fail / must-pass), calibration normalization, matched-shape
+discipline, and the live gate against the last committed BENCH_r*.json
+kernel block."""
+
+import json
+
+import pytest
+
+from tools.perf.kernel_gate import (
+    DEFAULT_THRESHOLD,
+    SHAPES,
+    compare,
+    gate_against,
+    latest_committed_bench,
+    run_microbench,
+)
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+def _block(p50s: dict, calib=1.0, tokens=8, rows=8):
+    return {
+        "calib_ms": calib,
+        "shapes": {
+            name: {"p50_ms": v, "min_ms": v, "tokens": tokens, "rows": rows}
+            for name, v in p50s.items()
+        },
+    }
+
+
+def test_compare_flags_regression_over_threshold():
+    committed = _block({"pure_decode": 1.0, "mixed_ragged": 2.0})
+    current = _block({"pure_decode": 1.25, "mixed_ragged": 2.05})
+    regs = compare(current, committed, threshold=0.10)
+    assert len(regs) == 1 and "pure_decode" in regs[0]
+
+
+def test_compare_passes_within_threshold_and_improvements():
+    committed = _block({"pure_decode": 1.0, "mixed_ragged": 2.0})
+    current = _block({"pure_decode": 1.05, "mixed_ragged": 0.6})
+    assert compare(current, committed, threshold=0.10) == []
+
+
+def test_compare_normalizes_by_calibration():
+    """2x slower machine (2x calib) at 2x wall time is NOT a regression;
+    same machine at 2x wall time is."""
+    committed = _block({"pure_decode": 1.0}, calib=1.0)
+    slower_machine = _block({"pure_decode": 2.0}, calib=2.0)
+    assert compare(slower_machine, committed, threshold=0.10) == []
+    same_machine = _block({"pure_decode": 2.0}, calib=1.0)
+    assert len(compare(same_machine, committed, threshold=0.10)) == 1
+
+
+def test_compare_skips_unmatched_shapes_but_not_all():
+    """Fast-subset numbers must never gate against full-scenario numbers:
+    shapes with different (tokens, rows) are not matched — but a run where
+    NOTHING matched fails loudly instead of passing vacuously (a SHAPES
+    retune without a rebaseline would otherwise green-light forever)."""
+    committed = _block({"pure_decode": 1.0, "mixed_ragged": 1.0})
+    current = _block({"pure_decode": 99.0, "mixed_ragged": 1.0})
+    current["shapes"]["pure_decode"]["tokens"] = 999  # size mismatch: skipped
+    assert compare(current, committed, threshold=0.10) == []  # mixed matched
+    zero_matched = _block({"pure_decode": 99.0}, tokens=999, rows=999)
+    regs = compare(zero_matched, committed, threshold=0.10)
+    assert len(regs) == 1 and "no matched shapes" in regs[0]
+    regs = compare({"shapes": {}, "calib_ms": 1.0}, committed)
+    assert len(regs) == 1 and "no matched shapes" in regs[0]
+
+
+def test_gate_against_committed_bench(tmp_path):
+    """The live tier-1 gate: fresh fast microbench vs the newest committed
+    BENCH_r*.json kernel block — >10% normalized regression at matched
+    shapes fails the suite."""
+    committed = latest_committed_bench(REPO_ROOT)
+    if committed is None:
+        pytest.skip("no committed BENCH_r*.json with a kernel block yet")
+    # retries=4: a regression must persist across five measurements to fail
+    # (preemption under suite load inflates samples one-sidedly; a real
+    # kernel slowdown reproduces every time)
+    regs, current = gate_against(
+        committed, threshold=DEFAULT_THRESHOLD, retries=4, fast=True
+    )
+    assert regs == [], (
+        f"kernel microbench regressed vs {committed.name}: {regs} "
+        f"(current={json.dumps(current['shapes'])})"
+    )
+
+
+def test_gate_self_comparison_is_stable():
+    """A run compared against itself can never regress (sanity on the
+    comparison arithmetic end-to-end with real measurements)."""
+    block = run_microbench(fast=True, iters=3, parity=False)
+    assert compare(block, block, threshold=0.0) == []
+    assert set(block["shapes"]) == set(SHAPES)

@@ -164,41 +164,49 @@ def test_mixed_off_is_default_and_inert(params):
 
 
 def test_kernel_w1_rows_parity():
-    """n_tokens=1 rows (the mixed tick's shape) through the batched chunk
-    kernel match the ref fallback — decode-style rows at ragged starts,
-    inactive padding rows, and a mixed-width comparison at W=3."""
-    from agentfield_tpu.ops.pallas.paged_batch_chunk_kernel import (
-        paged_batch_chunk_attention_pallas,
-        paged_batch_chunk_attention_ref,
+    """n_tokens=1 rows (the mixed tick's shape) through the ragged kernel
+    match the XLA reference — decode-style rows at ragged starts (incl.
+    page boundaries), DISJOINT per-row pages, inactive padding rows, and a
+    mixed-width comparison at W=3."""
+    from agentfield_tpu.ops.paged_attention import ragged_paged_attention_ref
+    from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+        ragged_paged_attention_pallas,
     )
 
     key = jax.random.PRNGKey(33)
-    B, H, Kh, hd, P, ps, maxp = 12, 4, 2, 32, 33, 8, 6
-    ks = jax.random.split(key, 4)
+    B, H, Kh, hd, ps, maxp = 12, 4, 2, 32, 8, 6
+    P = B * maxp + 1
+    ks = jax.random.split(key, 6)
     kp = jax.random.normal(ks[0], (P, Kh, ps, hd), jnp.float32)
     vp = jax.random.normal(ks[1], (P, Kh, ps, hd), jnp.float32)
     perm = np.asarray(jax.random.permutation(ks[3], P - 1) + 1)
-    tables = jnp.asarray(
-        np.stack([perm[i % 3 : i % 3 + maxp] for i in range(B)]), jnp.int32
-    )
+    tables = jnp.asarray(perm[: B * maxp].reshape(B, maxp), jnp.int32)
     # ragged decode-token positions incl. page boundaries; rows 10-11 padding
     starts = jnp.asarray([0, 1, 7, 8, 9, 15, 16, 23, 30, 40, 0, 0], jnp.int32)
-    k_lens = jnp.where(jnp.arange(B) < 10, starts + 1, 0).astype(jnp.int32)
+    active = jnp.arange(B) < 10
+    seqs = jnp.where(active, jnp.arange(B), -1).astype(jnp.int32)
     for W in (1, 3):
         q = jax.random.normal(ks[2], (B, W, H, hd), jnp.float32)
-        kl = jnp.where(k_lens > 0, k_lens + (W - 1), 0)
+        kn = jax.random.normal(ks[4], (B, W, Kh, hd), jnp.float32)
+        vn = jax.random.normal(ks[5], (B, W, Kh, hd), jnp.float32)
+        ntoks = jnp.where(active, W, 0).astype(jnp.int32)
         for window in (None, 6):
-            out = paged_batch_chunk_attention_pallas(
-                q, kp, vp, tables, starts, kl, interpret=True, window=window
+            out, ok, ov = ragged_paged_attention_pallas(
+                q, kn, vn, kp, vp, tables, starts, ntoks, starts, seqs,
+                interpret=True, window=window,
             )
-            ref = paged_batch_chunk_attention_ref(
-                q, kp, vp, tables, starts, kl, window=window
+            ref, rk, rv = ragged_paged_attention_ref(
+                q, kn, vn, kp, vp, tables, starts, ntoks, starts, seqs,
+                window=window,
             )
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
                 err_msg=f"W={W} window={window}",
             )
             assert np.allclose(np.asarray(ref)[10:], 0.0)  # inactive rows
+            live = np.arange(1, P)
+            np.testing.assert_array_equal(np.asarray(ok)[live], np.asarray(rk)[live])
+            np.testing.assert_array_equal(np.asarray(ov)[live], np.asarray(rv)[live])
 
 
 def test_scheduler_stats_exported(params):

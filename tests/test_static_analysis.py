@@ -422,6 +422,70 @@ def test_tracer_safety_flags_traced_branch_in_scan_callback(tmp_path):
     assert len(found) == 1 and "carry" in found[0].message
 
 
+def test_tracer_safety_descends_pallas_kernel_bodies(tmp_path):
+    """pl.pallas_call traces its kernel exactly once (to lower to Mosaic):
+    a Python branch or host concretization on a Ref param inside the kernel
+    body is the same bug as in a jitted fn — flagged through the
+    functools.partial alias indirection the kernels actually use."""
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/x.py",
+        """
+        import functools
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, *, block):
+            if block > 128:        # static partial kwarg: python branch fine
+                o_ref[...] = x_ref[...]
+            if x_ref[0] > 0:       # traced Ref value: flagged
+                o_ref[...] = x_ref[...]
+            v = float(x_ref[0])    # concretizes a traced value: flagged
+            o_ref[...] = x_ref[...] * v
+
+        def launch(x):
+            kernel = functools.partial(_kernel, block=64)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """,
+        pass_ids=["tracer-safety"],
+    )
+    assert len(found) == 2
+    assert any("x_ref" in f.message for f in found)
+
+
+def test_tracer_safety_passes_clean_pallas_kernel(tmp_path):
+    """Must-pass: static-kwarg branches, shape reads, and Ref math inside a
+    kernel handed to pallas_call directly and via an inline partial."""
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/x.py",
+        """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _scale_kernel(x_ref, o_ref, *, sm_scale, window):
+            n = x_ref.shape[0]                # shapes are static
+            if window is not None:            # static partial kwarg
+                o_ref[...] = x_ref[...] * sm_scale
+            else:
+                o_ref[...] = jnp.where(x_ref[...] > 0, x_ref[...], 0.0)
+
+        def _copy_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            a = pl.pallas_call(
+                functools.partial(_scale_kernel, sm_scale=2.0, window=None),
+                out_shape=x,
+            )(x)
+            return pl.pallas_call(_copy_kernel, out_shape=a)(a)
+        """,
+        pass_ids=["tracer-safety"],
+    )
+    assert found == []
+
+
 # ---------------------------------------------------------------------------
 # knob-docs
 

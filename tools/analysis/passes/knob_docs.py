@@ -8,8 +8,9 @@ field list is read from the AST):
 - every ``EngineConfig`` dataclass field must appear in docs/*.md (the
   reference table in docs/ARCHITECTURE.md);
 - every ``AGENTFIELD_*`` environment variable mentioned by
-  ``control_plane/*.py`` sources must appear in docs/*.md — operators learn
-  knobs from OPERATIONS.md, not from grepping the tree.
+  ``control_plane/*.py`` or ``ops/**`` sources must appear in docs/*.md —
+  operators learn knobs from OPERATIONS.md (and kernel knobs from
+  KERNELS.md), not from grepping the tree.
 
 Allowlist: ``knob_allow`` entries for env vars the control plane reads but
 operators never set (test scaffolding); empty on purpose today.
@@ -36,12 +37,17 @@ def _docs_text(ctx: Context) -> str:
 class KnobDocsPass(Pass):
     id = _ID
     description = (
-        "EngineConfig fields and control-plane AGENTFIELD_* env knobs are "
-        "documented in docs/*.md"
+        "EngineConfig fields and control-plane/ops AGENTFIELD_* env knobs "
+        "are documented in docs/*.md"
     )
 
+    @staticmethod
+    def _env_scanned(rel: str) -> bool:
+        parts = rel.split("/")
+        return "control_plane" in parts or "ops" in parts
+
     def relevant(self, rel: str) -> bool:
-        return rel == _ENGINE_REL or "control_plane" in rel.split("/")
+        return rel == _ENGINE_REL or self._env_scanned(rel)
 
     def run(self, ctx: Context) -> list[Finding]:
         if not any(
@@ -75,7 +81,7 @@ class KnobDocsPass(Pass):
         allow = set(ctx.cfg(self.id).get("knob_allow", []))
         seen: set[str] = set()
         for f in ctx.files:
-            if "control_plane" not in f.rel.split("/") or ctx.skipped(self.id, f.rel):
+            if not self._env_scanned(f.rel) or ctx.skipped(self.id, f.rel):
                 continue
             for i, line in enumerate(f.lines, 1):
                 for knob in _ENV_KNOB_RE.findall(line):
@@ -86,10 +92,10 @@ class KnobDocsPass(Pass):
                     findings.append(
                         Finding(
                             self.id, f.rel, i,
-                            f"control-plane env knob {knob} is not documented "
-                            "in docs/*.md",
-                            hint="document it in docs/OPERATIONS.md (or list "
-                            "it under knob_allow if operators never set it)",
+                            f"env knob {knob} is not documented in docs/*.md",
+                            hint="document it in docs/OPERATIONS.md or "
+                            "docs/KERNELS.md (or list it under knob_allow "
+                            "if operators never set it)",
                         )
                     )
         return findings

@@ -7,11 +7,21 @@ concrete value — ``.item()``, ``float()``/``int()`` on a traced array, host
 fails under tracing or, worse, silently bakes one tick's value into the
 compiled program forever. The accelerator guide's first rule, as a pass.
 
+Pallas KERNEL bodies are jit-traced too (``pl.pallas_call`` traces the
+kernel exactly once to lower it to Mosaic), so the pass descends into
+them: a function reached by ``pl.pallas_call(f, ...)`` — directly, via
+``functools.partial(f, **statics)`` inline, or through a local alias
+``k = functools.partial(f, **statics); pl.pallas_call(k, ...)`` — has
+every parameter traced (they are Refs) EXCEPT the keywords the partial
+bound, which are trace-time Python values (block sizes, sm_scale,
+window).
+
 Detection is deliberately name-based and local:
 
 - a function is *jitted* when it is (a) the first argument of a
   ``jax.jit(...)``/``jit(...)`` call naming it, or (b) decorated with
-  ``jax.jit`` / ``functools.partial(jax.jit, ...)``;
+  ``jax.jit`` / ``functools.partial(jax.jit, ...)``, or (c) a Pallas
+  kernel per the rule above;
 - its *traced* names are its parameters minus ``static_argnames``/
   ``static_argnums`` entries parsed from the jit call when literal; nested
   defs handed to jax/lax combinators (scan carries, cond branches) add
@@ -125,6 +135,76 @@ def find_jitted(tree: ast.AST) -> dict[str, set[str]]:
                         out[target] |= {
                             p for i, p in enumerate(_params(d)) if i in nums
                         }
+    return out
+
+
+def _partial_target(call: ast.expr) -> tuple[str | None, set[str]]:
+    """For a ``functools.partial(f, **statics)``-shaped expression, return
+    (f's name, the statically bound keyword names)."""
+    if not isinstance(call, ast.Call):
+        return None, set()
+    if attr_chain(call.func)[-1:] != ["partial"]:
+        return None, set()
+    if not call.args or not isinstance(call.args[0], ast.Name):
+        return None, set()
+    return call.args[0].id, {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _scope_nodes(owner: ast.AST):
+    """Nodes belonging to `owner`'s own scope — nested function bodies are
+    NOT descended (they are their own scopes, visited recursively)."""
+    stack = list(getattr(owner, "body", []))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def find_pallas_kernels(tree: ast.AST) -> dict[str, set[str]]:
+    """Map kernel function name -> static argnames for every function handed
+    to ``pl.pallas_call`` in the module (directly, via an inline
+    ``functools.partial``, or through a partial alias). Aliases resolve
+    PER SCOPE (each function sees its own assignments plus enclosing ones)
+    so two launchers both naming their local partial ``kernel`` do not
+    clobber each other's target/static sets."""
+    out: dict[str, set[str]] = {}
+
+    def visit(owner: ast.AST, inherited: dict[str, tuple[str, set[str]]]) -> None:
+        aliases = dict(inherited)
+        nodes = list(_scope_nodes(owner))
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                tgt = n.targets[0]
+                if isinstance(tgt, ast.Name):
+                    fn, statics = _partial_target(n.value)
+                    if fn is not None:
+                        aliases[tgt.id] = (fn, statics)
+        for n in nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            if attr_chain(n.func) not in (["pl", "pallas_call"], ["pallas_call"]):
+                continue
+            if not n.args:
+                continue
+            head = n.args[0]
+            fn: str | None = None
+            statics: set[str] = set()
+            if isinstance(head, ast.Name):
+                if head.id in aliases:
+                    fn, statics = aliases[head.id]
+                else:
+                    fn = head.id
+            else:
+                fn, statics = _partial_target(head)
+            if fn is not None:
+                out[fn] = out.get(fn, set()) | statics
+        for n in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(n, aliases)
+
+    visit(tree, {})
     return out
 
 
@@ -288,6 +368,8 @@ class TracerSafetyPass(Pass):
     def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
         findings: list[Finding] = []
         jitted = find_jitted(f.tree)
+        for name, statics in find_pallas_kernels(f.tree).items():
+            jitted[name] = jitted.get(name, set()) | statics
         if not jitted:
             return findings
         for node in ast.walk(f.tree):

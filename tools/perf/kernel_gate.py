@@ -1,0 +1,419 @@
+"""Kernel microbench + regression gate for the ragged paged-attention kernel.
+
+FlashInfer-Bench-style measured-regression loop for the KERNEL layer
+(docs/KERNELS.md): the control plane has had one since BENCH_r04 — this
+gives kernel iteration the same discipline. Two pieces:
+
+- ``run_microbench`` — times the ragged paged-attention dispatch over the
+  canonical SHAPE MIXES (pure-decode, pure-prefill, mixed ragged,
+  long-context paged) with nearest-rank p50/p99 per mix, plus a PARITY
+  probe (Pallas-interpret kernel vs the XLA reference, max abs err) on the
+  fast shapes. ``fast=True`` is the CPU-ref subset tier-1 runs; the full
+  set (bigger shapes, kernel timings) feeds the
+  ``AGENTFIELD_BENCH_SCENARIO=kernels`` scenario's BENCH_r10.json block.
+- ``compare`` / CLI — diffs a fresh microbench against the last committed
+  ``BENCH_r*.json`` kernel block and FAILS on >10% regression at matched
+  shapes. The gated metric is the min-of-N floor, normalized by
+  ``calib_ms`` (a fixed JITTED matmul sized like the longest gated launch,
+  timed in the same run): ratios, not raw milliseconds — and every
+  microbench pins the tier-1 suite's XLA-CPU topology (8 virtual devices +
+  serialized codegen) so baseline and gate measure the same machine
+  configuration (see ``_pin_microbench_env``).
+
+CLI:
+    python -m tools.perf.kernel_gate                # fast run, print JSON
+    python -m tools.perf.kernel_gate --against BENCH_r10.json   # gate
+    python -m tools.perf.kernel_gate --full         # scenario-sized shapes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np
+
+from tools.perf.load_gen import percentile
+
+# One entry per canonical mix. ``fast`` is the tier-1 CPU-ref subset —
+# sized so one ref dispatch costs MILLISECONDS (sub-millisecond launches
+# measure python/XLA dispatch overhead, which inflates under suite load and
+# flakes a 10% gate); ``full`` is the bench-scenario size. All shapes honor
+# the allocator invariant (live rows own disjoint pages; page 0 garbage).
+SHAPES: dict[str, dict] = {
+    # B decode rows, each mid-generation over a paged context
+    "pure_decode": dict(
+        fast=dict(rows=16, ctx=200, page_size=16, maxp=16, kh=2, rep=2, hd=64),
+        full=dict(rows=32, ctx=440, page_size=16, maxp=32, kh=4, rep=2, hd=64),
+    ),
+    # one fresh chunk (ctx 0): intra-chunk causality rides the new-key phase
+    "pure_prefill": dict(
+        fast=dict(chunk=128, ctx=0, page_size=16, maxp=16, kh=2, rep=2, hd=64),
+        full=dict(chunk=256, ctx=0, page_size=16, maxp=32, kh=4, rep=2, hd=64),
+    ),
+    # decode slots + two admitting chunks in one launch (the mixed tick)
+    "mixed_ragged": dict(
+        fast=dict(rows=8, ctx=120, chunk=48, chunks=2, page_size=16, maxp=16, kh=2, rep=2, hd=64),
+        full=dict(rows=16, ctx=200, chunk=112, chunks=2, page_size=16, maxp=32, kh=4, rep=2, hd=64),
+    ),
+    # few rows, long cached context: the page-walk-bound corner
+    "long_context_paged": dict(
+        fast=dict(rows=2, ctx=760, page_size=16, maxp=48, kh=2, rep=2, hd=64),
+        full=dict(rows=4, ctx=2040, page_size=16, maxp=128, kh=4, rep=2, hd=64),
+    ),
+}
+
+DEFAULT_THRESHOLD = 0.10
+
+
+def _gate_metric(entry: dict) -> tuple[str, float] | None:
+    """min_ms when both sides have it (noise-robust floor), else p50_ms."""
+    for m in ("min_ms", "p50_ms"):
+        if m in entry:
+            return m, entry[m]
+    return None
+
+
+def build_case(name: str, fast: bool = True, seed: int = 0):
+    """Materialize one shape mix as ragged descriptor arrays. The split of
+    sequence entries into W-wide kernel rows is the ENGINE'S OWN packer
+    (``kv_cache.pack_ragged_rows``), so the gated shapes are by
+    construction what the engine dispatches — the microbench cannot drift
+    from the packing contract."""
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving.kv_cache import pack_ragged_rows
+
+    p = SHAPES[name]["fast" if fast else "full"]
+    ps, maxp, kh, rep, hd = (
+        p["page_size"], p["maxp"], p["kh"], p["rep"], p["hd"]
+    )
+    H = kh * rep
+    entries = []  # (start, n_tokens) per sequence-entry
+    if "rows" in p:
+        for r in range(p["rows"]):
+            entries.append((p["ctx"] + (r % 7), 1))
+    for _ in range(p.get("chunks", 1 if "chunk" in p else 0)):
+        entries.append((p["ctx"], p["chunk"]))
+    n_seqs = len(entries)
+    P = n_seqs * maxp + 1
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(P - 1) + 1
+    seq_tables = perm[: n_seqs * maxp].reshape(n_seqs, maxp)
+    W = min(max(n for _, n in entries), 128)
+    need = sum(-(-n // W) for _, n in entries)
+    rr = pack_ragged_rows(
+        [
+            (seq_tables[sid], start, [0] * n)
+            for sid, (start, n) in enumerate(entries)
+        ],
+        maxp,
+        budget=need * W,
+        block_q=W,
+    )
+    R = rr.row_starts.shape[0]
+    q = rng.standard_normal((R, W, H, hd)).astype(np.float32) * 0.3
+    kn = rng.standard_normal((R, W, kh, hd)).astype(np.float32) * 0.3
+    vn = rng.standard_normal((R, W, kh, hd)).astype(np.float32) * 0.3
+    kp = rng.standard_normal((P, kh, ps, hd)).astype(np.float32) * 0.3
+    vp = rng.standard_normal((P, kh, ps, hd)).astype(np.float32) * 0.3
+    return tuple(
+        jnp.asarray(a)
+        for a in (
+            q, kn, vn, kp, vp,
+            rr.page_tables, rr.row_starts, rr.n_tokens, rr.ctx_lens,
+            rr.seq_ids,
+        )
+    )
+
+
+def calibrate() -> float:
+    """Machine-speed yardstick: min-of-N ms of a fixed JITTED XLA matmul —
+    the same dispatch+execution stack the gated launches ride, so CPU
+    contention (a loaded tier-1 run, a slower container generation) slows
+    the yardstick and the measurement TOGETHER and cancels out of the
+    gate's normalized ratios. A numpy-side yardstick does not track XLA's
+    slowdown proportionally and reads contention as a kernel regression."""
+    import jax
+    import jax.numpy as jnp
+
+    # sized so one yardstick launch lasts about as long as the LONGEST
+    # gated launch: preemption under load inflates a wall-time sample with
+    # probability proportional to its length, so a much-shorter yardstick
+    # finds a clean min while the gated op cannot, and the ratio reads as a
+    # phantom regression
+    a = jnp.asarray(
+        np.random.default_rng(0).standard_normal((768, 768)), jnp.float32
+    )
+    fn = jax.jit(lambda x: (x @ x).sum())
+    jax.block_until_ready(fn(a))  # compile
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(a))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(min(times))
+
+
+def _pin_microbench_env() -> None:
+    """Pin the XLA-CPU environment every microbench runs under to the
+    tier-1 suite's (the gate's home): 8 virtual host devices + serialized
+    codegen, exactly what tests/conftest.py sets. The topology CHANGES THE
+    MEASUREMENT — 8 virtual devices slow some launch shapes 50%+ (shared
+    threadpool partitioning) while barely moving others, so a baseline
+    committed from a 1-device run never compares to a gate run inside the
+    suite, no matter the calibration. Best-effort: only effective before
+    the first backend init, which holds for the bench kernels scenario
+    (dispatches before any other jax compute), the CLI, and tier-1 alike;
+    the host-platform flags are inert for real-accelerator timings."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    for flag, val in (
+        ("xla_cpu_parallel_codegen_split_count", "1"),
+        ("xla_force_host_platform_device_count", "8"),
+    ):
+        if flag not in flags:
+            flags = f"{flags} --{flag}={val}".strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def run_microbench(
+    fast: bool = True,
+    iters: int = 7,
+    parity: bool = True,
+    kernel_timings: bool = False,
+) -> dict:
+    """Measure the ragged dispatch per shape mix. Returns the BENCH kernel
+    block: {"shapes": {mix: {p50_ms, p99_ms, tokens, parity_max_abs_err?}},
+    "calib_ms": float}. Ref (XLA) timings always; Pallas-interpret PARITY on
+    the fast shapes when ``parity``; kernel wall-times only when
+    ``kernel_timings`` (real accelerator — interpret timings lie)."""
+    _pin_microbench_env()
+    import jax
+
+    from agentfield_tpu.ops.paged_attention import ragged_paged_attention_ref
+    from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+        ragged_paged_attention_pallas,
+    )
+
+    ref = jax.jit(ragged_paged_attention_ref)
+    out: dict = {"shapes": {}, "calib_ms": round(calibrate(), 3)}
+    for name in SHAPES:
+        args = build_case(name, fast=fast)
+        o, _, _ = ref(*args)  # compile
+        jax.block_until_ready(o)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            o, kpo, vpo = ref(*args)
+            jax.block_until_ready(o)
+            times.append((time.perf_counter() - t0) * 1e3)
+        entry = {
+            "p50_ms": round(percentile(times, 50), 3),
+            "p99_ms": round(percentile(times, 99), 3),
+            # min-of-N is the noise-robust estimator the gate compares: a
+            # real kernel regression raises the floor, scheduler blips don't
+            "min_ms": round(min(times), 3),
+            "tokens": int(np.asarray(args[7]).sum()),
+            "rows": int(args[0].shape[0]),
+        }
+        if parity:
+            pargs = build_case(name, fast=True)
+            po, pk, pv = ragged_paged_attention_pallas(*pargs, interpret=True)
+            ro, rk, rv = ref(*pargs)
+            live = np.ones(rk.shape[0], bool)
+            live[0] = False  # garbage page content is unspecified
+            entry["parity_max_abs_err"] = float(
+                np.max(np.abs(np.asarray(po) - np.asarray(ro)))
+            )
+            entry["parity_pool_exact"] = bool(
+                np.array_equal(np.asarray(pk)[live], np.asarray(rk)[live])
+                and np.array_equal(np.asarray(pv)[live], np.asarray(rv)[live])
+            )
+        if kernel_timings:
+            kt = []
+            kernel = jax.jit(
+                lambda *a: ragged_paged_attention_pallas(*a, interpret=False)
+            )
+            o, _, _ = kernel(*args)
+            jax.block_until_ready(o)
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                o, _, _ = kernel(*args)
+                jax.block_until_ready(o)
+                kt.append((time.perf_counter() - t0) * 1e3)
+            entry["kernel_p50_ms"] = round(percentile(kt, 50), 3)
+            entry["kernel_p99_ms"] = round(percentile(kt, 99), 3)
+        out["shapes"][name] = entry
+    return out
+
+
+def compare(
+    current: dict, committed: dict, threshold: float = DEFAULT_THRESHOLD
+) -> list[str]:
+    """Regressions of the calib-normalized gate metric at matched shapes
+    (> threshold). Shapes present (or sized) on only one side are skipped —
+    but if the committed block has shapes and NONE matched, that is itself
+    a failure: a gate that compares nothing would otherwise stay green
+    forever after a SHAPES retune without a rebaseline."""
+    regressions = []
+    matched = 0
+    cur_cal = current.get("calib_ms") or 1.0
+    com_cal = committed.get("calib_ms") or 1.0
+    for name, com in committed.get("shapes", {}).items():
+        cur = current.get("shapes", {}).get(name)
+        if cur is None:
+            continue
+        if (com.get("tokens"), com.get("rows")) != (
+            cur.get("tokens"), cur.get("rows")
+        ):
+            continue  # only MATCHED shapes gate (fast vs full never compares)
+        picked = _gate_metric(com)
+        if picked is None or picked[0] not in cur:
+            continue
+        metric, com_ms = picked
+        com_norm = com_ms / com_cal
+        cur_norm = cur[metric] / cur_cal
+        if com_norm <= 0:
+            continue
+        matched += 1
+        ratio = cur_norm / com_norm
+        if ratio > 1.0 + threshold:
+            regressions.append(
+                f"{name}: normalized {metric} {ratio:.2f}x committed "
+                f"({cur[metric]}ms/calib {cur_cal} vs "
+                f"{com_ms}ms/calib {com_cal})"
+            )
+    if matched == 0 and committed.get("shapes"):
+        regressions.append(
+            "no matched shapes between current and committed blocks — the "
+            "shape set changed without a rebaseline "
+            "(kernel_gate --rebaseline, docs/KERNELS.md); the gate refuses "
+            "to pass vacuously"
+        )
+    return regressions
+
+
+def gate_against(
+    committed_path: str | Path,
+    threshold: float = DEFAULT_THRESHOLD,
+    retries: int = 2,
+    fast: bool = True,
+) -> tuple[list[str], dict]:
+    """Measure, compare, and re-measure on regression. A shape regresses
+    only if it regresses in EVERY run (set intersection): OS preemption can
+    only inflate a wall-time sample, so a real kernel regression reproduces
+    in all runs while a scheduling blip vanishes from at least one. Returns
+    (persistent regressions, last current block)."""
+    committed = json.loads(Path(committed_path).read_text())
+    key = "kernel_fast" if fast else "kernel"
+    block = committed.get(key) or committed
+    if not block.get("shapes"):
+        return (
+            [
+                f"committed file {Path(committed_path).name} has no "
+                f"{key!r} shapes block to gate against — regenerate it "
+                "(AGENTFIELD_BENCH_SCENARIO=kernels, then "
+                "kernel_gate --rebaseline; docs/KERNELS.md)"
+            ],
+            {},
+        )
+    current = run_microbench(fast=fast, iters=25, parity=False)
+    regs = compare(current, block, threshold)
+    for _ in range(retries):
+        if not regs:
+            break
+        current = run_microbench(fast=fast, iters=25, parity=False)
+        rerun = compare(current, block, threshold)
+        rerun_shapes = {r.split(":", 1)[0] for r in rerun}
+        regs = [r for r in regs if r.split(":", 1)[0] in rerun_shapes]
+    return regs, current
+
+
+def rebaseline(path: str | Path, runs: int = 3) -> dict:
+    """Re-measure the committed file's ``kernel_fast`` block IN THE GATE'S
+    OWN CONTEXT and write it back (per-shape median of ``runs`` fresh
+    microbenches). The full-shape ``kernel`` block (bench.py's scenario
+    output) is left untouched. Needed because a fresh python process and
+    the long-lived bench process measure memory-bound launches with a
+    systematic ~15% offset on shared-CPU boxes (allocator/page warmth) —
+    within one context the spread is ~3%, so the 10% gate is only sound
+    when baseline and gate share a context. The runbook (docs/KERNELS.md)
+    runs this after regenerating BENCH via the kernels scenario."""
+    p = Path(path)
+    doc = json.loads(p.read_text())
+    blocks = [run_microbench(fast=True, iters=25, parity=False) for _ in range(runs)]
+    merged: dict = {"shapes": {}, "context": "gate", "runs": runs}
+    merged["calib_ms"] = sorted(b["calib_ms"] for b in blocks)[runs // 2]
+    for name in blocks[0]["shapes"]:
+        entries = [b["shapes"][name] for b in blocks]
+        rep = dict(entries[0])
+        for metric in ("p50_ms", "p99_ms", "min_ms"):
+            rep[metric] = sorted(e[metric] for e in entries)[runs // 2]
+        merged["shapes"][name] = rep
+    doc["kernel_fast"] = merged
+    p.write_text(json.dumps(doc))
+    return merged
+
+
+def latest_committed_bench(root: str | Path = ".") -> Path | None:
+    """The newest BENCH_r*.json carrying a kernel block."""
+    best: tuple[int, Path] | None = None
+    for p in Path(root).glob("BENCH_r*.json"):
+        try:
+            n = int(p.stem.split("_r")[1])
+        except (IndexError, ValueError):
+            continue
+        try:
+            doc = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if "kernel" not in doc and "shapes" not in doc:
+            continue
+        if best is None or n > best[0]:
+            best = (n, p)
+    return best[1] if best else None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--against", help="committed BENCH_r*.json to gate against")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument("--full", action="store_true", help="scenario-sized shapes")
+    ap.add_argument(
+        "--kernel-timings", action="store_true",
+        help="also time the Pallas kernel (real accelerator only)",
+    )
+    ap.add_argument(
+        "--rebaseline", metavar="FILE",
+        help="re-measure FILE's kernel_fast block in the gate's own "
+        "context and write it back (run after regenerating BENCH via the "
+        "kernels scenario — docs/KERNELS.md)",
+    )
+    args = ap.parse_args()
+    if args.rebaseline:
+        merged = rebaseline(args.rebaseline)
+        print(json.dumps(merged, indent=2))
+        return
+    if args.against:
+        regs, current = gate_against(
+            args.against, threshold=args.threshold, fast=not args.full
+        )
+        print(json.dumps({"regressions": regs, "current": current}, indent=2))
+        if regs:
+            sys.exit(1)
+        return
+    block = run_microbench(
+        fast=not args.full, kernel_timings=args.kernel_timings
+    )
+    print(json.dumps(block, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,16 @@ kind                dir     meaning
                             execution's replay buffer
 ``ping``/``pong``   both    app-level liveness probe (aiohttp's WS heartbeat
                             owns transport liveness; this is for diagnostics)
+``kv_fetch``        both    cross-node KV page request (docs/PREFIX_CACHING.md
+                            "Cluster tier"): node→gw carries ``peer`` (the
+                            node whose sketch advertised the pages) +
+                            ``chains`` (hex chain hashes); the gateway relays
+                            it gw→node to the peer (``peer`` stripped), which
+                            serves it from its prefix index
+``kv_pages``        both    the peer's response: ``fetch_id``-correlated,
+                            seq-framed chunks of serialized pages, size-capped
+                            per frame (``AGENTFIELD_KV_FETCH_MAX_BYTES``),
+                            final frame carries ``done``; relayed gw→requester
 ==================  ======  =====================================================
 
 Failure semantics (docs/FAULT_TOLERANCE.md mid-stream table): a submit that
@@ -70,6 +80,26 @@ from agentfield_tpu.logging import get_logger
 log = get_logger("channel")
 
 CHANNEL_PATH = "/channel"
+
+# Cross-node KV transfer caps (docs/OPERATIONS.md "Cluster prefix cache"):
+# one kv_pages frame never exceeds this many serialized-payload bytes (the
+# serving side chunks the response), and one kv_fetch never names more than
+# _KV_FETCH_MAX_CHAINS pages — a misbehaving peer cannot turn the relay into
+# a bulk copy pipe.
+_KV_FETCH_MAX_BYTES = int(
+    os.environ.get("AGENTFIELD_KV_FETCH_MAX_BYTES", str(8 << 20))
+)
+_KV_FETCH_MAX_CHAINS = 64
+# One kv_pages frame carries at most this much serialized payload; a
+# response larger than one frame is split into seq-numbered chunks the
+# requester accumulates until `done` (a multi-MB WS text frame would stall
+# every other execution multiplexed on the channel while it serializes).
+_KV_PAGES_FRAME_BYTES = 1 << 20
+# Gateway-side relay bookkeeping TTL: an unanswered fetch_id is forgotten
+# after this long (the requester's own timeout is always shorter in
+# practice; this bounds the map against a dead peer).
+_KV_RELAY_TTL_S = 30.0
+_KV_RELAY_MAX = 256
 
 
 class ChannelUnavailable(Exception):
@@ -339,12 +369,21 @@ class ChannelServer:
         self.replay_ttl_s = replay_ttl_s
         self._execs: dict[str, _ServerExec] = {}
         self._conns: set[_ServerConn] = set()
+        # Cross-node KV transfer (docs/PREFIX_CACHING.md "Cluster tier"):
+        # serving side — a registered exporter answers peers' kv_fetch
+        # frames; requesting side — fetch_kv() sends a kv_fetch up the live
+        # gateway connection and collects the relayed kv_pages response.
+        self._kv_export: Callable[[list[str], int], Awaitable[list[dict]]] | None = None
+        self._kv_waiters: dict[str, tuple[asyncio.Future, list[dict]]] = {}
+        self._kv_next_id = 0
+        self._kv_tasks: set[asyncio.Task] = set()
         self.stats = {
             "channel_server_connections_total": 0,
             "channel_server_submits_total": 0,
             "channel_server_frames_total": 0,
             "channel_server_reattaches_total": 0,
             "channel_server_cancels_total": 0,
+            "channel_server_kv_fetches_total": 0,
         }
 
     def stream_handler(self, component_id: str, fn: StreamFn) -> None:
@@ -352,6 +391,148 @@ class ChannelServer:
         node registers ``generate``); everything else goes through
         ``invoke`` and produces only a terminal frame."""
         self.stream_handlers[component_id] = fn
+
+    def set_kv_export(self, fn) -> None:
+        """Register the KV page exporter: ``async fn(chains_hex, max_bytes)
+        -> list[page dict]`` (the model node wires its engine's
+        ``export_kv_pages``). Without one, kv_fetch frames answer with an
+        error — the requesting peer re-prefills locally."""
+        self._kv_export = fn
+
+    # -- cross-node KV transfer (docs/PREFIX_CACHING.md "Cluster tier") --
+
+    async def fetch_kv(
+        self,
+        peer_node_id: str,
+        chains_hex: list[str],
+        timeout_s: float = 5.0,
+        max_bytes: int | None = None,
+    ) -> list[dict] | None:
+        """Request serialized KV pages from `peer_node_id` through the
+        gateway relay, over THIS node's live channel connection. Returns the
+        page dicts the peer served (possibly fewer than asked — best
+        effort), or None when no connection exists, the relay/peer failed,
+        or `timeout_s` expired. Strictly best-effort by design: every
+        failure mode degrades to a local re-prefill on the caller's side."""
+        if not self._conns or not chains_hex:
+            return None
+        conn = next(iter(self._conns))
+        self._kv_next_id += 1
+        fid = f"kvf_{id(self)}_{self._kv_next_id}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._kv_waiters[fid] = (fut, [])
+        try:
+            ok = await conn.send(
+                {
+                    "kind": "kv_fetch",
+                    "fetch_id": fid,
+                    "peer": peer_node_id,
+                    "chains": chains_hex[:_KV_FETCH_MAX_CHAINS],
+                    "max_bytes": int(max_bytes or _KV_FETCH_MAX_BYTES),
+                }
+            )
+            if not ok:
+                return None
+            async with aio_timeout(timeout_s):
+                return await fut
+        except TimeoutError:
+            return None  # the caller re-prefills; late frames are dropped
+        except asyncio.CancelledError:
+            raise  # an EXTERNAL cancel (client gone, drain) must propagate
+        finally:
+            self._kv_waiters.pop(fid, None)
+
+    def _on_kv_pages(self, frame: dict) -> None:
+        """A relayed kv_pages frame for one of OUR fetch_kv calls. Frames
+        past the waiter's timeout (or for an unknown fetch_id) are dropped —
+        a stalled peer's late answer must not adopt pages into a request
+        that already started its local re-prefill."""
+        w = self._kv_waiters.get(frame.get("fetch_id", ""))
+        if w is None:
+            return
+        fut, pages = w
+        if fut.done():
+            return
+        pages.extend(frame.get("pages") or [])
+        if frame.get("error"):
+            fut.set_result(None)
+        elif frame.get("done"):
+            fut.set_result(pages)
+
+    async def _serve_kv_fetch(self, conn: _ServerConn, frame: dict) -> None:
+        """Answer a peer's (gateway-relayed) kv_fetch from this node's
+        prefix index: size-capped, seq-framed kv_pages chunks, final frame
+        ``done``. The seeded ``kv.fetch_fail``/``kv.fetch_stall`` fault
+        points live HERE (the serving side) so chaos tests can pin the
+        requester's degradation: failed or stalled fetch → local re-prefill,
+        token-exact, zero leaked pages."""
+        fid = frame.get("fetch_id", "")
+        chains = frame.get("chains") or []
+        max_bytes = min(
+            int(frame.get("max_bytes") or _KV_FETCH_MAX_BYTES), _KV_FETCH_MAX_BYTES
+        )
+
+        self.stats["channel_server_kv_fetches_total"] += 1
+
+        async def fail(err: str) -> None:
+            await conn.send(
+                {"kind": "kv_pages", "fetch_id": fid, "error": err, "done": True}
+            )
+
+        f = faults.fire("kv.fetch_stall")
+        if f is not None and f.delay_s > 0:
+            await asyncio.sleep(f.delay_s)
+        f = faults.fire("kv.fetch_fail")
+        if f is not None:
+            await fail(f.error)
+            return
+        if self._kv_export is None or not isinstance(chains, list):
+            await fail("node serves no KV export")
+            return
+        try:
+            pages = await self._kv_export(
+                [c for c in chains[:_KV_FETCH_MAX_CHAINS] if isinstance(c, str)],
+                max_bytes,
+            )
+        except Exception as e:
+            await fail(f"kv export failed: {e!r}")
+            return
+        seq = total = 0
+        batch: list[dict] = []
+        batch_bytes = 0
+
+        async def flush(done: bool) -> None:
+            nonlocal batch, batch_bytes, seq
+            seq += 1
+            await conn.send(
+                {
+                    "kind": "kv_pages",
+                    "fetch_id": fid,
+                    "seq": seq,
+                    "pages": batch,
+                    "done": done,
+                }
+            )
+            batch, batch_bytes = [], 0
+
+        for pg in pages:
+            # same byte accounting as the exporter's own max_bytes cap
+            # (kv_export_pages), so this re-check is pure defense — it
+            # drops nothing the exporter admitted
+            sz = sum(len(pg.get(k) or "") for k in ("k", "v"))
+            if total + sz > max_bytes:
+                break  # size cap: the requester re-prefills the tail
+            if batch and batch_bytes + sz > _KV_PAGES_FRAME_BYTES:
+                await flush(done=False)  # chunk: bound each WS frame
+            batch.append(pg)
+            batch_bytes += sz
+            total += sz
+        await flush(done=True)
+
+    def _kv_task(self, coro) -> None:
+        t = asyncio.create_task(coro)
+        self._kv_tasks.add(t)
+        t.add_done_callback(self._kv_tasks.discard)
 
     def _purge(self) -> None:
         cutoff = time.monotonic() - self.replay_ttl_s
@@ -372,6 +553,10 @@ class ChannelServer:
         tasks = [st.task for st in self._execs.values() if st.task is not None]
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        for t in list(self._kv_tasks):
+            t.cancel()
+        if self._kv_tasks:
+            await asyncio.gather(*list(self._kv_tasks), return_exceptions=True)
         for conn in list(self._conns):
             try:
                 await conn.ws.close()
@@ -423,6 +608,12 @@ class ChannelServer:
             st = self._execs.get(eid)
             if st is not None and st.done:
                 self._execs.pop(eid, None)
+        elif kind == "kv_fetch":
+            # A peer's page request, relayed by the gateway: serve it off the
+            # receive loop (the export does a device→host copy).
+            self._kv_task(self._serve_kv_fetch(conn, frame))
+        elif kind == "kv_pages":
+            self._on_kv_pages(frame)
         elif kind == "ping":
             await conn.send({"kind": "pong"})
 
@@ -748,6 +939,13 @@ class NodeChannel:
                 await self._lose_call(
                     call, f"reattach refused: {frame.get('error')}"
                 )
+        elif kind == "kv_fetch":
+            # Node-originated cross-node page request: relay to the peer it
+            # names (docs/PREFIX_CACHING.md "Cluster tier").
+            self._task(self.mgr.relay_kv_fetch(self.node_id, frame))
+        elif kind == "kv_pages":
+            # A serving node's response: route back to the requester.
+            self._task(self.mgr.relay_kv_pages(self.node_id, frame))
         elif kind == "pong":
             pass
 
@@ -859,14 +1057,25 @@ class ChannelManager:
         self._chans: dict[str, NodeChannel] = {}
         self._call_index: dict[str, NodeChannel] = {}
         self._broken_until: dict[str, float] = {}
+        # Cross-node KV relay bookkeeping: the gateway REWRITES each relayed
+        # fetch_id to a gateway-unique one (node-minted ids are only unique
+        # within their process — two identical node binaries can mint the
+        # same id) and maps it back on the response: gateway_fid →
+        # (requesting node_id, the requester's original fetch_id, deadline).
+        self._kv_relays: dict[str, tuple[str, str, float]] = {}
+        self._kv_relay_seq = 0
         self.publish_cb: Callable[[str, dict], None] = lambda eid, f: None
         self.terminal_cb: Callable[[str, dict], Awaitable[Any]] | None = None
         self.lost_cb: Callable[[str, str, int, str], Awaitable[Any]] | None = None
+        # async fn(node_id) -> AgentNode | None — the gateway's node getter,
+        # needed to resolve a kv_fetch's peer to a base_url.
+        self.resolve_node_cb: Callable[[str], Awaitable[Any]] | None = None
 
-    def bind(self, publish, terminal, lost) -> None:
+    def bind(self, publish, terminal, lost, resolve_node=None) -> None:
         self.publish_cb = publish
         self.terminal_cb = terminal
         self.lost_cb = lost
+        self.resolve_node_cb = resolve_node
 
     @property
     def session(self) -> aiohttp.ClientSession:
@@ -917,10 +1126,7 @@ class ChannelManager:
     def inflight(self, execution_id: str) -> bool:
         return execution_id in self._call_index
 
-    async def submit(
-        self, node, execution_id: str, target_component: str,
-        agent_input: Any, headers: dict[str, str], stream: bool = False,
-    ) -> tuple[str, Any]:
+    async def _chan_for(self, node) -> NodeChannel:
         chan = self._chans.get(node.node_id)
         if chan is None or chan.base_url != node.base_url.rstrip("/"):
             if chan is not None:
@@ -929,6 +1135,13 @@ class ChannelManager:
                 await chan.close()
             chan = NodeChannel(self, node.node_id, node.base_url)
             self._chans[node.node_id] = chan
+        return chan
+
+    async def submit(
+        self, node, execution_id: str, target_component: str,
+        agent_input: Any, headers: dict[str, str], stream: bool = False,
+    ) -> tuple[str, Any]:
+        chan = await self._chan_for(node)
         frame = {
             "kind": "submit",
             "exec_id": execution_id,
@@ -950,6 +1163,100 @@ class ChannelManager:
         chan = self._call_index.get(execution_id)
         if chan is not None:
             await chan.cancel(execution_id)
+
+    # -- cross-node KV relay (docs/PREFIX_CACHING.md "Cluster tier") ----
+
+    def _purge_kv_relays(self) -> None:
+        t = time.monotonic()
+        stale = [fid for fid, (_, _, dl) in self._kv_relays.items() if dl < t]
+        for fid in stale:
+            self._kv_relays.pop(fid, None)
+
+    async def _kv_error_to(self, requester_id: str, fid: str, err: str) -> None:
+        """Tell the requesting node its fetch is dead NOW — without this it
+        would burn its full fetch timeout on a peer that was never going to
+        answer."""
+        self.metrics.inc("kv_relay_errors_total")
+        chan = self._chans.get(requester_id)
+        if chan is None:
+            return
+        try:
+            await chan._send(
+                {"kind": "kv_pages", "fetch_id": fid, "error": err, "done": True}
+            )
+        except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            log.debug(
+                "kv relay error frame not delivered",
+                node_id=requester_id, error=repr(e),
+            )
+
+    async def relay_kv_fetch(self, requester_id: str, frame: dict) -> None:
+        """Relay a node's kv_fetch to the peer it names. The gateway is a
+        pure store-and-forward hop: it validates shape and caps, remembers
+        fetch_id → requester, and never touches page bytes."""
+        fid = frame.get("fetch_id")
+        peer = frame.get("peer")
+        chains = frame.get("chains")
+        if not isinstance(fid, str) or not isinstance(peer, str) or not isinstance(chains, list):
+            return
+        self._purge_kv_relays()
+        if len(self._kv_relays) >= _KV_RELAY_MAX:
+            await self._kv_error_to(requester_id, fid, "kv relay at capacity")
+            return
+        if self.resolve_node_cb is None:
+            await self._kv_error_to(requester_id, fid, "kv relay not wired")
+            return
+        node = await self.resolve_node_cb(peer)
+        if node is None or not self.supports(node):
+            await self._kv_error_to(
+                requester_id, fid, f"peer {peer!r} unknown or channel-less"
+            )
+            return
+        self._kv_relay_seq += 1
+        gw_fid = f"kvr_{self._kv_relay_seq}"
+        self._kv_relays[gw_fid] = (
+            requester_id, fid, time.monotonic() + _KV_RELAY_TTL_S
+        )
+        self.metrics.inc("kv_relay_fetches_total")
+        relayed = {
+            "kind": "kv_fetch",
+            "fetch_id": gw_fid,
+            "chains": chains[:_KV_FETCH_MAX_CHAINS],
+            "max_bytes": min(
+                int(frame.get("max_bytes") or _KV_FETCH_MAX_BYTES),
+                _KV_FETCH_MAX_BYTES,
+            ),
+        }
+        try:
+            await (await self._chan_for(node))._send(relayed)
+        except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            self._kv_relays.pop(gw_fid, None)
+            await self._kv_error_to(requester_id, fid, f"peer unreachable: {e!r}")
+
+    async def relay_kv_pages(self, server_id: str, frame: dict) -> None:
+        """Route a serving node's kv_pages response back to the requester.
+        ``server_id`` is informational (the frame correlates by fetch_id);
+        unknown/expired fetch_ids are dropped — late answers must not leak
+        into a request that already re-prefilled."""
+        gw_fid = frame.get("fetch_id")
+        entry = self._kv_relays.get(gw_fid) if isinstance(gw_fid, str) else None
+        if entry is None:
+            return
+        requester_id, orig_fid, _dl = entry
+        if frame.get("done") or frame.get("error"):
+            self._kv_relays.pop(gw_fid, None)
+        self.metrics.inc("kv_relay_frames_total")
+        chan = self._chans.get(requester_id)
+        if chan is None:
+            return
+        try:
+            # translate back to the id the requester is waiting on
+            await chan._send({**frame, "fetch_id": orig_fid})
+        except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            log.debug(
+                "kv relay response not delivered",
+                node_id=requester_id, server=server_id, error=repr(e),
+            )
 
     def cancel_soon(self, execution_id: str) -> None:
         """Fire-and-forget cancel (terminal transitions must not block on a

@@ -49,6 +49,15 @@ Injection points in-tree:
                                attempt; ``times: K`` fails the first K) — the
                                lookup degrades to a shorter cached prefix and
                                the engine re-prefills the rest, token-exact
+``kv.fetch_fail``              a cross-node KV page fetch fails on the SERVING
+                               node before any page is exported (consulted
+                               once per kv_fetch served) — the requester
+                               adopts nothing and re-prefills locally,
+                               token-exact, zero pages leaked
+``kv.fetch_stall``             the serving node stalls ``delay_s`` before
+                               answering a kv_fetch — the requester's fetch
+                               timeout expires and it re-prefills locally; a
+                               late response is discarded by fetch_id
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -80,6 +89,8 @@ KNOWN_POINTS = (
     "channel.drop",
     "kv.offload_stall",
     "kv.restore_fail",
+    "kv.fetch_fail",
+    "kv.fetch_stall",
 )
 
 

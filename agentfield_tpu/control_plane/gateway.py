@@ -21,11 +21,14 @@ import asyncio
 import collections
 import dataclasses
 import math
+import os
 import random
 import time
 from typing import Any
 
 import aiohttp
+
+from agentfield_tpu.prefix_hash import page_chain_hashes, sketch_digest
 
 from agentfield_tpu.control_plane import faults
 from agentfield_tpu.control_plane.channel import (
@@ -46,7 +49,6 @@ from agentfield_tpu.control_plane.types import (
     Execution,
     ExecutionStatus,
     NodeStatus,
-    TargetType,
     new_id,
     now,
 )
@@ -64,6 +66,16 @@ CONTEXT_HEADERS = (
     "X-Session-ID",
     "X-Actor-ID",
 )
+
+# Prefix-affinity routing (docs/PREFIX_CACHING.md "Cluster tier"): cap on
+# how many leading prompt tokens the gateway hashes per dispatch — the
+# consecutive-prefix score saturates long before this, and hashing must stay
+# a negligible slice of the dispatch fast path.
+_AFFINITY_MAX_TOKENS = 4096
+# Load blend: one queued/active request on a candidate outweighs this many
+# cached prefix tokens. Keeps a warm node from absorbing an entire burst
+# serially while cold-but-idle capacity sits unused.
+_AFFINITY_LOAD_WEIGHT = 32.0
 
 
 class GatewayError(Exception):
@@ -160,6 +172,13 @@ class ExecutionGateway:
         # persistent multiplexed gateway↔node WebSocket channels. None →
         # built here with defaults ($AGENTFIELD_CHANNEL gates it); nodes
         # that don't advertise metadata.channel keep the POST path.
+        prefix_affinity: bool | None = None,  # cluster prefix cache
+        # (docs/PREFIX_CACHING.md "Cluster tier"): score model-generate
+        # dispatch candidates by expected cached-prefix length (from
+        # heartbeat sketches) blended with load, and hint losing nodes at
+        # the best-advertising peer for cross-node page transfer. None →
+        # $AGENTFIELD_PREFIX_AFFINITY (default on); OFF (or absent/stale
+        # sketches) is bit-compatible with today's _pick_node order.
     ):
         self.payloads = payloads
         self.storage = storage
@@ -209,7 +228,22 @@ class ExecutionGateway:
             publish=self.streams.publish,
             terminal=self._channel_terminal,
             lost=self._channel_lost,
+            # Cross-node KV relay: a node's kv_fetch names a peer by id; the
+            # manager resolves it to a live node through the same fast-path
+            # getter dispatch uses.
+            resolve_node=self._node_get,
         )
+        # Prefix-affinity routing (docs/PREFIX_CACHING.md "Cluster tier").
+        if prefix_affinity is None:
+            prefix_affinity = os.environ.get(
+                "AGENTFIELD_PREFIX_AFFINITY", "1"
+            ).lower() not in ("0", "false", "no")
+        self.prefix_affinity = prefix_affinity
+        # Per-dispatch transfer hints: execution_id → {node_id, pages,
+        # page_size} of the best-advertising peer, written by _pick_node,
+        # injected into the generate input by _agent_input, dropped when the
+        # dispatch loop exits.
+        self._kv_hints: dict[str, dict] = {}
         # Strong refs for stream-execute driver tasks (loop tasks are weakly
         # held; a GC'd driver would strand a prepared execution).
         self._stream_drivers: set[asyncio.Task] = set()
@@ -456,14 +490,24 @@ class ExecutionGateway:
             node.kind == "model"
             and ex.target.split(".", 1)[1] == "generate"
             and isinstance(agent_input, dict)
-            and (ex.priority or ex.deadline_s is not None)
         ):
-            agent_input = dict(agent_input)
-            if ex.priority:
-                agent_input.setdefault("priority", ex.priority)
-            if ex.deadline_s is not None:
-                remaining = ex.created_at + ex.deadline_s - now()
-                agent_input.setdefault("deadline_s", max(remaining, 0.001))
+            # Cross-node transfer hint (docs/PREFIX_CACHING.md "Cluster
+            # tier"): a peer advertised more of this prompt's prefix than
+            # the node we are dispatching to — tell the node where to pull
+            # the missing pages from. Never points at the serving node
+            # itself.
+            hint = self._kv_hints.get(ex.execution_id)
+            if hint is not None and hint.get("node_id") == node.node_id:
+                hint = None
+            if ex.priority or ex.deadline_s is not None or hint is not None:
+                agent_input = dict(agent_input)
+                if ex.priority:
+                    agent_input.setdefault("priority", ex.priority)
+                if ex.deadline_s is not None:
+                    remaining = ex.created_at + ex.deadline_s - now()
+                    agent_input.setdefault("deadline_s", max(remaining, 0.001))
+                if hint is not None:
+                    agent_input.setdefault("kv_peer", hint)
         return agent_input
 
     # -- streaming data plane hooks (channel.py calls back into these) --
@@ -552,6 +596,107 @@ class ExecutionGateway:
             return False
         return True
 
+    def _affinity_tokens(self, ex: Execution) -> list | None:
+        """The token-id prompt affinity can hash, or None (text prompts have
+        no gateway-computable page hashes — the gateway has no tokenizer —
+        and payload-offloaded inputs are opaque here; both degrade to
+        today's pick order)."""
+        if not self.prefix_affinity or self._node_cache is None:
+            return None
+        # Model-node inference targets: the component is named "generate"
+        # (registered as a reasoner — same criterion _agent_input's
+        # priority/deadline/kv_peer injection keys on).
+        if ex.target.split(".", 1)[1] != "generate":
+            return None
+        inp = ex.input
+        if not isinstance(inp, dict):
+            return None
+        toks = inp.get("tokens")
+        if not isinstance(toks, list) or len(toks) < 2:
+            return None
+        # Client-supplied content: a non-int (or out-of-int32) entry would
+        # raise inside np.asarray(..., np.int32) DEEP in _pick_node, where
+        # no completion path catches it — the execution would hang RUNNING.
+        # Malformed prompts must instead degrade to today's pick order and
+        # fail on the node through the normal fatal-outcome path. Only the
+        # slice we would hash is checked (bounded work per dispatch).
+        for t in toks[:_AFFINITY_MAX_TOKENS]:
+            if isinstance(t, bool) or not isinstance(t, int) or not (
+                -(2**31) <= t < 2**31
+            ):
+                return None
+        return toks
+
+    def _affinity_order(
+        self, ex: Execution, candidates: list[AgentNode]
+    ) -> tuple[list[AgentNode], dict[str, int], tuple | None]:
+        """Reorder dispatch candidates by expected cached-prefix length
+        blended with load (docs/PREFIX_CACHING.md "Cluster tier"). The
+        request's leading chain hashes (same blake2b chaining as
+        PrefixPagePool) walk each candidate's heartbeat sketch; consecutive
+        hits × page_size is the prefill the node would skip. Returns
+        ``(ordered, expected_tokens_by_node_id, best)`` where ``best`` is
+        the ``(pages, page_size, node)`` of the strongest advertiser —
+        _pick_node uses both to count hits and set the transfer hint
+        against the node it ACTUALLY picks (retries may skip the scored
+        winner). Degradation ladder: affinity off, a text/opaque prompt, or
+        no fresh sketch matching anything → the input order returns
+        UNCHANGED (bit-compatible with the pre-affinity pick order, pinned
+        by test). Capability/model filtering already happened — this only
+        permutes nodes that can all legally serve."""
+        toks = self._affinity_tokens(ex)
+        if toks is None or len(candidates) < 2:
+            return candidates, {}, None
+        hashes_by_ps: dict[int, list[bytes]] = {}
+        expected: list[int] = []  # cached-prefix tokens per candidate
+        scores: list[float] = []
+        best = None  # (pages, ps, node) — the best-advertising candidate
+        for node in candidates:
+            got = self._node_cache.get_sketch(node.node_id)
+            if got is None:
+                expected.append(0)
+                scores.append(0.0)
+                continue
+            sketch, load = got
+            ps = sketch.get("page_size")
+            digests = sketch.get("digests")
+            if (
+                isinstance(ps, bool)
+                or not isinstance(ps, int)
+                or ps < 1
+                or not isinstance(digests, list)
+            ):
+                expected.append(0)
+                scores.append(0.0)
+                continue
+            hs = hashes_by_ps.get(ps)
+            if hs is None:
+                # Prompt minus its last token — the engine's own matchable
+                # prefix rule (the final token's logits must be computed).
+                hs = page_chain_hashes(
+                    toks[: len(toks) - 1][:_AFFINITY_MAX_TOKENS], ps
+                )
+                hashes_by_ps[ps] = hs
+            dset = set(digests)
+            pages = 0
+            for h in hs:
+                if sketch_digest(h) not in dset:
+                    break  # consecutive-prefix walk: a gap ends the match
+                pages += 1
+            expected.append(pages * ps)
+            scores.append(pages * ps - _AFFINITY_LOAD_WEIGHT * load)
+            if pages > 0 and (best is None or pages * ps > best[0] * best[1]):
+                best = (pages, ps, node)
+        if best is None:
+            return candidates, {}, None  # nothing advertised: order untouched
+        order = sorted(
+            range(len(candidates)), key=lambda i: (-scores[i], i)
+        )  # stable: ties keep today's order
+        exp_by_id = {
+            candidates[i].node_id: expected[i] for i in range(len(candidates))
+        }
+        return [candidates[i] for i in order], exp_by_id, best
+
     async def _pick_node(
         self, ex: Execution, tried: set[str]
     ) -> AgentNode | None:
@@ -560,7 +705,9 @@ class ExecutionGateway:
         serving the same model, for model nodes — _capable_substitute).
         Nodes in `tried` are deprioritized but NOT forbidden — when every
         capable node has failed once, retrying the original beats giving up
-        before the retry budget says so."""
+        before the retry budget says so. With prefix affinity on (and a
+        fresh sketch matching the request), candidates are re-ordered by
+        expected cached-prefix length blended with load first."""
         own_id, comp = ex.target.split(".", 1)
         candidates: list[AgentNode] = []
         own = await self._node_get(own_id)
@@ -574,10 +721,34 @@ class ExecutionGateway:
                 continue
             if self._capable_substitute(node, comp, own):
                 candidates.append(node)
-        for node in candidates:
-            if node.node_id not in tried:
-                return node
-        return candidates[0] if candidates else None
+        candidates, expected, best = self._affinity_order(ex, candidates)
+        picked = next(
+            (n for n in candidates if n.node_id not in tried),
+            candidates[0] if candidates else None,
+        )
+        # Hit/hint bookkeeping against the node ACTUALLY picked (a retry
+        # may skip the scored winner): a pick with advertised pages is an
+        # affinity hit; a peer advertising MORE than the pick becomes the
+        # transfer hint the pick's restore path pulls from.
+        self._kv_hints.pop(ex.execution_id, None)
+        if picked is not None and best is not None:
+            picked_exp = expected.get(picked.node_id, 0)
+            if picked_exp > 0:
+                self.metrics.inc(
+                    "prefix_affinity_hits_total",
+                    labels={"node": picked.node_id},
+                )
+            best_pages, best_ps, best_node = best
+            if (
+                best_node.node_id != picked.node_id
+                and best_pages * best_ps > picked_exp
+            ):
+                self._kv_hints[ex.execution_id] = {
+                    "node_id": best_node.node_id,
+                    "pages": best_pages,
+                    "page_size": best_ps,
+                }
+        return picked
 
     async def _dispatch(
         self, ex: Execution, node: AgentNode | None = None
@@ -600,6 +771,13 @@ class ExecutionGateway:
         policy = self.retry_policy.merged(ex.retry_policy)
         tried: set[str] = set()
         self._dispatching.add(ex.execution_id)
+        if node is not None and self._affinity_tokens(ex) is not None:
+            # Prefix-affinity routing owns target selection for hashable
+            # model-generate work: drop the _prepare-resolved node so the
+            # first attempt goes through _pick_node's scoring too (with
+            # affinity off or an unhashable prompt this branch never fires
+            # and the pre-affinity dispatch flow is untouched).
+            node = None
 
         async def persist_attempts() -> None:
             cur = await self.db.get_execution(ex.execution_id)
@@ -698,6 +876,7 @@ class ExecutionGateway:
             raise
         finally:
             self._dispatching.discard(ex.execution_id)
+            self._kv_hints.pop(ex.execution_id, None)
 
     # ------------------------------------------------------------------
 

@@ -11,11 +11,18 @@ Gauges and counters take an optional ``labels`` dict (rendered as
 label path exists for per-node engine gauges — a model node's heartbeat
 stats (prefix-cache hit/miss/eviction/shared-page counters, the tiered-KV
 offload family ``kv_offload_{demoted,restored,restore_fail,host_pages}``
-(docs/PREFIX_CACHING.md "Tiered cache"), and the scheduler-latency gauges
+(docs/PREFIX_CACHING.md "Tiered cache"), the cluster-tier transfer family
+``kv_fetch_{requested,served,failed,bytes,pages_adopted}_total`` +
+``prefix_sketch_truncated_total`` (docs/PREFIX_CACHING.md "Cluster tier"),
+and the scheduler-latency gauges
 ``itl_ms_p50``/``itl_ms_p99``/``tokens_per_tick`` from the mixed
 token-budget scheduler, docs/MIXED_SCHEDULING.md) are re-exported here by
 the registry via :func:`export_engine_stats`, so one control-plane
 /metrics scrape covers the whole fleet's cache and scheduling behavior.
+The gateway's own affinity/relay counters
+(``prefix_affinity_hits_total{node=}``,
+``kv_relay_{fetches,frames,errors}_total``) are first-party counters on
+the same registry.
 """
 
 from __future__ import annotations

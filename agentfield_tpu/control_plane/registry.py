@@ -69,6 +69,7 @@ class NodeSnapshotCache:
         metrics: Metrics | None = None,
         enabled: bool | None = None,
         ttl_s: float | None = None,
+        sketch_ttl_s: float | None = None,
     ):
         if enabled is None:
             enabled = os.environ.get("AGENTFIELD_REGISTRY_CACHE", "1").lower() not in (
@@ -81,14 +82,34 @@ class NodeSnapshotCache:
                 ttl_s = float(os.environ.get("AGENTFIELD_REGISTRY_CACHE_TTL_S", "2.0"))
             except ValueError:
                 ttl_s = 2.0
+        if sketch_ttl_s is None:
+            try:
+                sketch_ttl_s = float(
+                    os.environ.get("AGENTFIELD_PREFIX_SKETCH_TTL_S", "15.0")
+                )
+            except ValueError:
+                sketch_ttl_s = 15.0
         self.enabled = enabled
         self.ttl_s = ttl_s
+        # Prefix-sketch staleness bound (docs/PREFIX_CACHING.md "Cluster
+        # tier"): a sketch older than this reads as ABSENT, so affinity
+        # scoring can never act on a node whose heartbeats stopped — the
+        # dispatch fast path degrades to today's load order instead.
+        self.sketch_ttl_s = sketch_ttl_s
         self._db = db
         self._metrics = metrics
         self._gen = 0  # bumped by invalidate()
         self._snap_gen = -1  # generation the current snapshot was built at
         self._snap_at = 0.0
         self._by_id: dict[str, AgentNode] = {}
+        # Prefix-affinity side table (node_id → (sketch, load, stamped_at)):
+        # replaced ATOMICALLY on every sketch-bearing heartbeat — the
+        # explicit invalidation path for sketches. Deliberately OUTSIDE the
+        # generation-stamped node snapshot: sketches change every heartbeat
+        # and must not force node-table rebuilds, and they live only in this
+        # process (a second gateway instance simply routes without affinity
+        # until its own heartbeats arrive).
+        self._sketches: dict[str, tuple[dict, float, float]] = {}
         self._rebuild_lock = asyncio.Lock()
 
     @property
@@ -135,6 +156,29 @@ class NodeSnapshotCache:
             self._count("registry_cache_misses_total")
             return await self._db.list_nodes()
         return list((await self._snapshot()).values())
+
+    # -- prefix-affinity side table (docs/PREFIX_CACHING.md "Cluster tier")
+
+    def put_sketch(self, node_id: str, sketch: dict, load: float = 0.0) -> None:
+        """Install a node's heartbeat prefix sketch + load sample. The whole
+        entry is replaced in one assignment, so a reader can never observe a
+        half-updated (sketch, load) pair."""
+        self._sketches[node_id] = (sketch, float(load), now())
+
+    def get_sketch(self, node_id: str) -> tuple[dict, float] | None:
+        """(sketch, load) when a fresh one exists; None past
+        ``sketch_ttl_s`` — stale sketches are never served (the affinity
+        scorer then treats the node as advertising nothing)."""
+        entry = self._sketches.get(node_id)
+        if entry is None:
+            return None
+        sketch, load, at = entry
+        if now() - at > self.sketch_ttl_s:
+            return None
+        return sketch, load
+
+    def drop_sketch(self, node_id: str) -> None:
+        self._sketches.pop(node_id, None)
 
 
 class NodeRegistry:
@@ -283,6 +327,21 @@ class NodeRegistry:
         # agent_field_handler.py:459); surfaced via node metadata.
         stats = (data or {}).get("stats")
         if isinstance(stats, dict):
+            # Prefix-affinity routing (docs/PREFIX_CACHING.md "Cluster
+            # tier"): a sketch-bearing heartbeat replaces the node's entry
+            # in the cache's side table NOW — the explicit invalidation the
+            # dispatch fast path relies on (a sketch is never served past
+            # sketch_ttl_s either way). Popped before metadata persistence:
+            # the sketch is a routing signal, not node state, and a
+            # several-KB digest list must not ride every node-table row.
+            sketch = stats.pop("prefix_sketch", None)
+            if isinstance(sketch, dict):
+                load = 0.0
+                for k in ("active_slots", "pending_requests"):
+                    v = stats.get(k)
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        load += v
+                self.cache.put_sketch(node_id, sketch, load)
             node.metadata["stats"] = stats
             # Re-export the node's engine counters (prefix-cache hit/miss/
             # eviction/shared-page among them) as per-node /metrics gauges so
@@ -332,6 +391,7 @@ class NodeRegistry:
         ok = await self.db.delete_node(node_id)
         if ok:
             self.cache.invalidate()
+            self.cache.drop_sketch(node_id)
             self._last_persist.pop(node_id, None)
             self._fences.pop(node_id, None)
             # a dead node's engine gauges must not linger in /metrics

@@ -68,6 +68,10 @@ class ControlPlane:
         # None → $AGENTFIELD_CHANNEL (default on); False forces every
         # dispatch onto the per-execution POST path (bit-compatible with the
         # pre-channel gateway, pinned by test). docs/OPERATIONS.md.
+        prefix_affinity: bool | None = None,  # cluster prefix cache
+        # (docs/PREFIX_CACHING.md "Cluster tier"): prefix-affinity dispatch
+        # scoring + cross-node KV transfer hints. None →
+        # $AGENTFIELD_PREFIX_AFFINITY (default on).
     ):
         try:
             from agentfield_tpu.control_plane.identity import (
@@ -150,6 +154,7 @@ class ControlPlane:
             # registry's in-memory snapshot, not a SQLite scan per request.
             node_cache=self.registry.cache,
             channels=_Channels(self.metrics, enabled=channel),
+            prefix_affinity=prefix_affinity,
         )
 
         from agentfield_tpu.control_plane.health import HealthMonitor

@@ -1,0 +1,58 @@
+"""Chained prefix block hashing — the ONE definition shared by the engine's
+prefix page pool and the control plane's affinity router.
+
+The serving side (``serving/kv_cache.PrefixPagePool``) content-addresses KV
+pages by chained blake2b-128 block hashes; the gateway scores dispatch
+candidates by how much of a request's leading hash chain a node's published
+prefix sketch covers (docs/PREFIX_CACHING.md "Cluster tier"). Both sides must
+chain the SAME bytes the SAME way or affinity scores silently read zero, so
+the functions live here — a module with no jax/engine dependency the
+control plane can import without dragging the serving stack onto the
+gateway's event loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+# Bytes of blake2b digest kept per chain link (full collision margin for
+# content addressing); the heartbeat sketch truncates further to
+# SKETCH_DIGEST_BYTES — routing only, verified again at lookup.
+DIGEST_BYTES = 16
+SKETCH_DIGEST_BYTES = 8
+
+
+def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
+    """Chained block hash over one full page of token ids (vLLM/SGLang-style):
+    a page's identity is (everything before it, its own tokens), so two
+    requests share a page iff their prompts agree on the ENTIRE prefix
+    through that page. blake2b-128 makes accidental collisions negligible;
+    lookups still verify token content, so a collision degrades to a miss,
+    never to wrong KV."""
+    h = hashlib.blake2b(prev, digest_size=DIGEST_BYTES)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def page_chain_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
+    """Chained hash per full page of `tokens`. Callers that probe the index
+    repeatedly (the scheduler, every admission tick) compute this once per
+    request and pass it to peek()/lookup() instead of re-hashing the prompt
+    each tick."""
+    out: list[bytes] = []
+    h = b""
+    for off in range(0, (len(tokens) // page_size) * page_size, page_size):
+        h = chain_hash(h, tokens[off : off + page_size])
+        out.append(h)
+    return out
+
+
+def sketch_digest(chain: bytes) -> str:
+    """The truncated hex form of a chain hash as it appears in a node's
+    heartbeat prefix sketch (docs/PREFIX_CACHING.md "Cluster tier"). 8 bytes
+    is plenty for a routing signal: a cross-node false positive only costs a
+    mis-routed request one ordinary prefill."""
+    return chain[:SKETCH_DIGEST_BYTES].hex()

@@ -32,7 +32,7 @@ import functools
 import math
 import threading
 import time
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -144,6 +144,14 @@ class EngineConfig:
     # tier instead of silently losing them. 0 (the default) disables the
     # tier — the pool is bit-compatible with the single-tier behavior.
     # Requires shared_prefix_cache (the tier is content-addressed).
+    prefix_sketch_bytes: int = 4096  # cluster tier (docs/PREFIX_CACHING.md
+    # "Cluster tier"): byte cap on the prefix-index sketch published with
+    # every heartbeat (truncated chain-hash digests, leading pages first) —
+    # the gateway's prefix-affinity router scores dispatch candidates with
+    # it. Overflow drops the deepest records and counts
+    # prefix_sketch_truncated_total. 0 disables publication (the node never
+    # attracts affinity traffic; routing degrades to load order).
+    # $AGENTFIELD_PREFIX_SKETCH_BYTES overrides the default at node build.
     grammar_slots: int = 0  # constrained-decoding state capacity (rows of the
     # device-resident token-transition bank). 0 disables the masking path —
     # the decode step then skips the [B, V] mask gather entirely. Each
@@ -1287,6 +1295,21 @@ class InferenceEngine:
                 # wins over cached prefixes, same rule as admission).
                 restore_alloc=lambda: self._alloc_with_eviction(1),
             )
+        elif self._shared_prefix:
+            # No local demotion tier, but the CLUSTER tier still needs the
+            # restore half armed: peer-fetched pages (adopt_kv_pages) land in
+            # the pool's host store and restore at admission exactly like a
+            # demoted page would (docs/PREFIX_CACHING.md "Cluster tier").
+            # The budget is a transfer staging buffer, not a cache — sized
+            # to a few in-flight prefixes.
+            kb = self.cache.k_pages
+            page_bytes = 2 * (kb.size // kb.shape[1]) * kb.dtype.itemsize
+            self.allocator.enable_restore(
+                budget_bytes=32 * page_bytes,
+                page_bytes=page_bytes,
+                upload=self._upload_page_kv,
+                restore_alloc=lambda: self._alloc_with_eviction(1),
+            )
         # Guards self.pending: submit() appends from the event-loop thread
         # while _drain_cancels() rebuilds the deque on the worker thread —
         # unguarded, an append during the rebuild raises RuntimeError or is
@@ -2216,6 +2239,67 @@ class InferenceEngine:
                 # in self.stats; this is the matching occupancy gauge).
                 "kv_offload_host_pages": a.host_pages,
             }
+
+    # -- cluster tier (docs/PREFIX_CACHING.md "Cluster tier") ----------
+
+    def prefix_sketch(self) -> dict | None:
+        """Compact prefix-index summary for heartbeat publication: truncated
+        chain-hash digests the gateway's affinity router scores dispatch
+        candidates with. None when the shared-prefix index is off or
+        ``prefix_sketch_bytes`` is 0 (the node then never attracts
+        affinity traffic)."""
+        if not self._shared_prefix or self.ecfg.prefix_sketch_bytes <= 0:
+            return None
+        with self._session_lock:
+            return self.allocator.sketch(self.ecfg.prefix_sketch_bytes)
+
+    def peek_prefix(self, tokens: Sequence[int]) -> int:
+        """Length (tokens) of the longest locally indexed full-page prefix
+        of `tokens` — both tiers; no references taken. The peer-prefetch
+        path asks this before fetching, so only the MISSING page range goes
+        over the wire."""
+        if not self._shared_prefix:
+            return 0
+        with self._session_lock:
+            return self.allocator.peek(tokens)
+
+    def adopt_kv_pages(
+        self, entries: Sequence[tuple[bytes, int, tuple[int, ...], Any]]
+    ) -> int:
+        """Install peer-fetched page payloads ``(chain, depth, tokens,
+        (k, v) numpy arrays)`` into the pool's host store; they restore
+        through the ordinary lookup walk at the next admission (batched H2D
+        upload, restore-failure → shorter prefix → re-prefill, token-exact
+        under greedy). Returns the number adopted."""
+        if not self._shared_prefix:
+            return 0
+        with self._session_lock:
+            return self.allocator.adopt_host_pages(entries)
+
+    def export_kv_pages(
+        self, chains: Sequence[bytes], max_pages: int = 64
+    ) -> list[tuple[bytes, int, Any]]:
+        """Serve a peer's ``kv_fetch``: for each requested chain hash that
+        is locally indexed, ``(chain, depth, (k, v) numpy payload)``.
+        Two-phase like demotion — page capture under the session lock
+        (content fixed at capture), the blocking device→host copy OUTSIDE
+        it, so serving a peer never stalls this node's tick path."""
+        if not self._shared_prefix:
+            return []
+        with self._session_lock:
+            prepped = self.allocator.export_prep(
+                list(chains)[: max(0, int(max_pages))], self._capture_page_kv
+            )
+        out: list[tuple[bytes, int, Any]] = []
+        for chain, depth, obj, kind in prepped:
+            if kind == "host":
+                out.append((chain, depth, obj))
+            else:
+                try:
+                    out.append((chain, depth, _fetch_page_kv(obj)))
+                except Exception:  # afcheck: ignore[except-swallow] best-effort peer serving: a failed D2H copy shortens the response and the requester re-prefills
+                    continue
+        return out
 
     def _install(
         self,

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import hashlib
 import itertools
 import threading
 import time
@@ -32,6 +31,7 @@ import numpy as np
 
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models.llama import resolve_dtype
+from agentfield_tpu.prefix_hash import chain_hash, page_chain_hashes, sketch_digest
 
 
 @dataclasses.dataclass
@@ -182,29 +182,10 @@ def pack_ragged_rows(
     )
 
 
-def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
-    """Chained block hash over one full page of token ids (vLLM/SGLang-style):
-    a page's identity is (everything before it, its own tokens), so two
-    requests share a page iff their prompts agree on the ENTIRE prefix
-    through that page. blake2b-128 makes accidental collisions negligible;
-    lookups still verify token content, so a collision degrades to a miss,
-    never to wrong KV."""
-    h = hashlib.blake2b(prev, digest_size=16)
-    h.update(np.asarray(tokens, np.int32).tobytes())
-    return h.digest()
-
-
-def page_chain_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
-    """Chained hash per full page of `tokens`. Callers that probe the index
-    repeatedly (the scheduler, every admission tick) compute this once per
-    request and pass it to peek()/lookup() instead of re-hashing the prompt
-    each tick."""
-    out: list[bytes] = []
-    h = b""
-    for off in range(0, (len(tokens) // page_size) * page_size, page_size):
-        h = chain_hash(h, tokens[off : off + page_size])
-        out.append(h)
-    return out
+# chain_hash / page_chain_hashes moved to agentfield_tpu.prefix_hash (the
+# gateway's affinity scorer chains the same bytes without importing the
+# jax-heavy serving stack); the import above re-exports them for existing
+# importers.
 
 
 def _kv_fault(point: str):
@@ -249,6 +230,11 @@ class PageRecord:
     tokens: tuple[int, ...]
     last_used: float  # logical LRU clock, maintained by the pool
     tier: str = TIER_HBM
+    # Page index within its prefix chain (0 = leading page). The heartbeat
+    # sketch orders records by depth so a byte-capped sketch keeps LEADING
+    # pages first — a deep entry whose ancestors were dropped can never
+    # score (the gateway's consecutive-prefix walk stops at the first miss).
+    depth: int = 0
 
 
 class PrefixPagePool:
@@ -309,6 +295,16 @@ class PrefixPagePool:
             "kv_offload_restore_fail",
             "kv_offload_demote_fail",
             "kv_offload_host_evicted",
+            # Cluster tier (docs/PREFIX_CACHING.md "Cluster tier"): the
+            # heartbeat sketch + cross-node page transfer counter family —
+            # always exported so the /stats→heartbeat→Prometheus pipeline
+            # carries them even on nodes that never fetch.
+            "prefix_sketch_truncated_total",
+            "kv_fetch_requested_total",
+            "kv_fetch_served_total",
+            "kv_fetch_failed_total",
+            "kv_fetch_bytes_total",
+            "kv_fetch_pages_adopted_total",
         ):
             self.stats.setdefault(k, 0)
         # ---- host (offload) tier — inert until enable_host_tier() wires the
@@ -507,6 +503,34 @@ class PrefixPagePool:
                 evictable += 1
         return evictable, host
 
+    def sketch(self, max_bytes: int) -> dict[str, Any]:
+        """Compact summary of the prefix index for heartbeat publication
+        (docs/PREFIX_CACHING.md "Cluster tier"): truncated chain-hash digests
+        of every indexed record (both tiers — a host-resident page is
+        fetchable too), leading pages first. The gateway scores a dispatch
+        candidate by walking a request's chain hashes through this digest
+        set; consecutive leading hits × page_size ≈ the cached-prefix length
+        the node would serve.
+
+        ``max_bytes`` caps the JSON payload (an unbounded index would bloat
+        every heartbeat); overflow drops the DEEPEST records first and
+        counts ``prefix_sketch_truncated_total`` — a capped sketch under-
+        advertises long chains, which only costs routing optimality."""
+        # ~19 bytes per digest in the JSON array ("0123456789abcdef", ), plus
+        # fixed envelope overhead.
+        cap = max(0, (int(max_bytes) - 64) // 19)
+        recs = sorted(self._by_hash.values(), key=lambda r: r.depth)
+        truncated = len(recs) > cap
+        if truncated:
+            self.stats["prefix_sketch_truncated_total"] += 1
+            recs = recs[:cap]
+        return {
+            "v": 1,
+            "page_size": self.page_size,
+            "digests": [sketch_digest(r.chain) for r in recs],
+            "truncated": int(truncated),
+        }
+
     def lookup(
         self, tokens: Sequence[int], hashes: list[bytes] | None = None
     ) -> tuple[list[int], int]:
@@ -594,7 +618,7 @@ class PrefixPagePool:
             if p in self._by_page:
                 continue  # page already names another chain (defensive)
             self._by_page[p] = self._by_hash[h] = PageRecord(
-                page=p, chain=h, tokens=page_toks, last_used=t
+                page=p, chain=h, tokens=page_toks, last_used=t, depth=i
             )
             if self._refs[p] == 0:
                 self._lru[p] = None
@@ -676,19 +700,20 @@ class PrefixPagePool:
         call."""
         if budget_bytes <= 0:
             raise ValueError(f"budget_bytes={budget_bytes} must be > 0")
-        if page_bytes <= 0:
-            raise ValueError(f"page_bytes={page_bytes} must be > 0")
         if self._host_enabled:
             raise RuntimeError("host tier already enabled")
         if self._offload_thread is not None:
             # close() timed out on a stalled worker: starting a second one
             # would race the first's eventual commit attempts
             raise RuntimeError("previous offload worker still draining")
-        self._host_budget = int(budget_bytes)
-        self._page_bytes = int(page_bytes)
+        self.enable_restore(
+            budget_bytes=budget_bytes,
+            page_bytes=page_bytes,
+            upload=upload,
+            restore_alloc=restore_alloc,
+        )
         self._ext_lock = lock
-        self._capture, self._fetch, self._upload = capture, fetch, upload
-        self._restore_alloc = restore_alloc
+        self._capture, self._fetch = capture, fetch
         # Start demoting while this many free pages remain: early enough
         # that the async copy usually wins the race against hard eviction,
         # late enough that a lightly loaded pool never churns D2H copies.
@@ -702,6 +727,106 @@ class PrefixPagePool:
             target=self._offload_worker, name="kv-offload", daemon=True
         )
         self._offload_thread.start()
+
+    def enable_restore(
+        self,
+        *,
+        budget_bytes: int,
+        page_bytes: int,
+        upload: Callable[[list[Any], list[int]], None],
+        restore_alloc: Callable[[], list[int] | None] | None = None,
+    ) -> None:
+        """Arm ONLY the host-store restore half of the tier: the upload
+        callback, the restore allocator, and a byte budget for host-resident
+        payloads — no demote worker, no watermark. This is what the cluster
+        tier rides (docs/PREFIX_CACHING.md "Cluster tier"): pages fetched
+        from a peer node land in the host store via :meth:`adopt_host_pages`
+        and restore through the ordinary lookup path, whether or not the
+        local demotion tier is on. ``enable_host_tier`` calls this too, so
+        there is exactly one definition of "restore is armed"."""
+        if budget_bytes <= 0:
+            raise ValueError(f"budget_bytes={budget_bytes} must be > 0")
+        if page_bytes <= 0:
+            raise ValueError(f"page_bytes={page_bytes} must be > 0")
+        self._host_budget = int(budget_bytes)
+        self._page_bytes = int(page_bytes)
+        self._upload = upload
+        self._restore_alloc = restore_alloc
+
+    def adopt_host_pages(
+        self, entries: Sequence[tuple[bytes, int, tuple[int, ...], Any]]
+    ) -> int:
+        """Install peer-fetched KV payloads into the host store (caller
+        holds the external lock): each entry is ``(chain, depth, tokens,
+        payload)`` exactly as a local demotion would have produced. Chains
+        already indexed are skipped — LOCAL content always wins (an HBM or
+        host record under this chain is at least as good as the peer copy).
+        Adopted entries restore through the ordinary lookup walk at the next
+        admission; budget overflow drops the store's oldest entries, same
+        rule as demotion. Returns the number adopted.
+
+        Safety: the CALLER derives ``chain``/``tokens`` from its own prompt
+        (model_node.prefetch), so a corrupt peer response can only waste
+        host-store budget — the content index never lies about what tokens
+        a chain names, and lookup() still verifies tokens before any reuse.
+        """
+        if self._upload is None:
+            return 0  # restore never armed: adopted pages could never land
+        n = 0
+        for chain, depth, tokens, payload in entries:
+            if chain in self._by_hash:
+                continue
+            self._by_hash[chain] = PageRecord(
+                page=-1,
+                chain=chain,
+                tokens=tuple(tokens),
+                last_used=self._tick(),
+                tier=TIER_HOST,
+                depth=int(depth),
+            )
+            self._host[chain] = payload
+            self._host_bytes += self._page_bytes
+            n += 1
+            self.stats["kv_fetch_pages_adopted_total"] += 1
+        self._evict_host_over_budget()
+        return n
+
+    def _evict_host_over_budget(self) -> None:  # guarded by: external(engine _session_lock)
+        while self._host_bytes > self._host_budget and self._host:
+            # Budget pressure drops the OLDEST host entries — the spanning
+            # LRU's far end. Gone for real (re-prefill recreates them).
+            old_chain, _ = self._host.popitem(last=False)
+            self._host_bytes -= self._page_bytes
+            self._by_hash.pop(old_chain, None)
+            self.stats["kv_offload_host_evicted"] += 1
+
+    def export_prep(
+        self, chains: Sequence[bytes], capture: Callable[[int], Any]
+    ) -> list[tuple[bytes, int, Any, str]]:
+        """Phase 1 of serving a peer's ``kv_fetch`` (caller holds the
+        external lock): for each requested chain hash that is indexed,
+        return ``(chain, depth, obj, kind)`` — ``("host", payload)`` for
+        host-tier entries (wire-ready) or ``("handle", captured slices)``
+        for HBM pages. The handle's content is fixed at capture (same
+        snapshot semantics as demotion), so the caller materializes the
+        device→host copy OUTSIDE the lock without racing the tick path.
+        Unknown chains are simply absent from the result — the requester
+        treats the response as best-effort."""
+        out: list[tuple[bytes, int, Any, str]] = []
+        for chain in chains:
+            rec = self._by_hash.get(chain)
+            if rec is None:
+                continue
+            if rec.tier == TIER_HOST:
+                payload = self._host.get(rec.chain)
+                if payload is not None:
+                    out.append((rec.chain, rec.depth, payload, "host"))
+                continue
+            try:
+                out.append((rec.chain, rec.depth, capture(rec.page), "handle"))
+            except Exception:  # afcheck: ignore[except-swallow] best-effort peer serving: a failed capture shortens the response and the requester re-prefills
+                continue
+        return out
 
     def demote_lru(self, n: int | None = None) -> int:
         """Enqueue up to `n` (all, when None) of the OLDEST refcount-0
@@ -810,13 +935,7 @@ class PrefixPagePool:
         rec.tier = TIER_HOST
         rec.page = -1
         self.stats["kv_offload_demoted"] += 1
-        while self._host_bytes > self._host_budget and self._host:
-            # Budget pressure drops the OLDEST host entries — the spanning
-            # LRU's far end. Gone for real (re-prefill recreates them).
-            old_chain, _ = self._host.popitem(last=False)
-            self._host_bytes -= self._page_bytes
-            self._by_hash.pop(old_chain, None)
-            self.stats["kv_offload_host_evicted"] += 1
+        self._evict_host_over_budget()
 
     def _prepare_restore(self, rec: PageRecord) -> tuple[PageRecord, int, Any] | None:
         """Phase 1 of a restore (caller holds the external lock): consult

@@ -348,6 +348,25 @@ class ModelBackend:
         self._grammars: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
         self._grammars_max = 8
         self._grammar_futs: dict[str, asyncio.Future] = {}
+        # Cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier"):
+        # cross-node KV page transfer. The fetch transport is the node's
+        # gateway channel (build_model_node wires channel_server.fetch_kv);
+        # $AGENTFIELD_KV_FETCH=0 is the node-local safety valve — the node
+        # then neither pulls pages nor honors kv_peer hints (it still
+        # SERVES peers' fetches; disable those by dropping the sketch via
+        # EngineConfig.prefix_sketch_bytes=0).
+        import os as _os
+
+        self._kv_fetch_fn = None  # async (peer, chains_hex, timeout_s) -> pages|None
+        self.kv_fetch_enabled = _os.environ.get(
+            "AGENTFIELD_KV_FETCH", "1"
+        ).lower() not in ("0", "false", "no")
+        self.kv_fetch_timeout_s = 5.0
+        # In-flight prefetch dedup, keyed (peer, first missing chain): a
+        # same-prefix burst landing on a cold node must issue ONE transfer,
+        # not one per request — followers await the leader's adoption and
+        # let admission's ordinary lookup find the pages.
+        self._kv_prefetch_inflight: dict[tuple[str, bytes], asyncio.Future] = {}
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._drive_loop())
@@ -372,8 +391,19 @@ class ModelBackend:
 
     async def stop(self) -> None:
         if self._task:
+            # Re-fire the cancel until the task actually ends: on py3.10 the
+            # aio_timeout backport cancels the enclosing task at its
+            # deadline, and an EXTERNAL cancel landing in that same window
+            # coalesces with it — __aexit__ then relabels the one delivered
+            # CancelledError as TimeoutError and the drive loop's idle-wait
+            # handler swallows it, leaving the task running forever (a
+            # ~1-in-10 teardown hang under load before this loop).
             self._task.cancel()
-            await asyncio.gather(self._task, return_exceptions=True)
+            while True:
+                done, _ = await asyncio.wait({self._task}, timeout=1.0)
+                if done:
+                    break
+                self._task.cancel()
         warm = getattr(self, "_vision_warm", None)
         if warm is not None:
             warm.cancel()
@@ -398,9 +428,16 @@ class ModelBackend:
                     self.engine.gc_sessions()  # bound idle KV retention
                 self._wake.clear()
                 try:
-                    async with aio_timeout(self.idle_sleep * 50):
-                        await self._wake.wait()
-                except TimeoutError:
+                    # wait_for, NOT aio_timeout: the py3.10 backport cancels
+                    # the enclosing task at its deadline, so an external
+                    # stop() cancel racing the timer would be relabeled
+                    # TimeoutError and swallowed by this handler — wait_for
+                    # cancels only the inner waiter and always lets an
+                    # external CancelledError propagate.
+                    await asyncio.wait_for(
+                        self._wake.wait(), timeout=self.idle_sleep * 50
+                    )
+                except (TimeoutError, asyncio.TimeoutError):
                     continue
             try:
                 events = await asyncio.to_thread(self.engine.step)
@@ -958,6 +995,157 @@ class ModelBackend:
             out["truncated_tokens"] = truncated_rows[0]
         return out
 
+    # -- cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier") ----
+
+    async def kv_export_pages(self, chains_hex: list[str], max_bytes: int) -> list[dict]:
+        """Serve a peer's kv_fetch: look the requested chain hashes up in
+        this engine's prefix index (both tiers) and serialize the pages for
+        the wire. The device→host copies run off the event loop; the byte
+        cap stops serialization early (the requester re-prefills the tail)."""
+        import base64
+
+        import numpy as np
+
+        chains = []
+        for c in chains_hex:
+            try:
+                b = bytes.fromhex(c)
+            except ValueError:
+                continue
+            if len(b) == 16:
+                chains.append(b)
+
+        def _export_and_serialize():
+            # ONE thread hop covers both the D2H copies and the base64
+            # encode of up-to-MBs of payload — serializing on the event
+            # loop would stall every stream multiplexed on this node.
+            raw = self.engine.export_kv_pages(chains)
+            pages: list[dict] = []
+            total = 0
+            for chain, depth, payload in raw:
+                k, v = np.asarray(payload[0]), np.asarray(payload[1])
+                kb = base64.b64encode(np.ascontiguousarray(k).tobytes()).decode()
+                vb = base64.b64encode(np.ascontiguousarray(v).tobytes()).decode()
+                if total + len(kb) + len(vb) > max_bytes:
+                    break
+                pages.append(
+                    {
+                        "chain": chain.hex(),
+                        "depth": int(depth),
+                        "k": kb,
+                        "v": vb,
+                        "dtype": str(k.dtype),
+                        "shape": list(k.shape),
+                    }
+                )
+                total += len(kb) + len(vb)
+            return pages, total
+
+        pages, total = await asyncio.to_thread(_export_and_serialize)
+        self.engine.stats["kv_fetch_served_total"] += len(pages)
+        self.engine.stats["kv_fetch_bytes_total"] += total
+        return pages
+
+    async def maybe_prefetch_kv(self, tokens: list[int] | None, hint: Any) -> int:
+        """Best-effort pull of this prompt's missing prefix pages from the
+        peer the gateway's affinity scorer named (the ``kv_peer`` hint in
+        the generate input). Fetched pages land in the pool's host store
+        (adopt) and restore at admission through PR 8's machinery — so
+        EVERY failure mode (no channel, peer gone, timeout, malformed
+        payload, seeded kv.fetch_fail/kv.fetch_stall) degrades to an
+        ordinary local prefill, token-exact, zero pages leaked. Returns the
+        number of pages adopted."""
+        import base64
+
+        import numpy as np
+
+        from agentfield_tpu.prefix_hash import page_chain_hashes
+
+        if (
+            not self.kv_fetch_enabled
+            or self._kv_fetch_fn is None
+            or not isinstance(hint, dict)
+            or not tokens
+            or len(tokens) < 2
+        ):
+            return 0
+        peer = hint.get("node_id")
+        ps = self.engine.ecfg.page_size
+        if not isinstance(peer, str) or hint.get("page_size") != ps:
+            return 0  # mismatched page geometry: chains can never align
+        matchable = list(tokens[: len(tokens) - 1])
+        hashes = page_chain_hashes(matchable, ps)
+        local_pages = self.engine.peek_prefix(matchable) // ps
+        want = int(hint.get("pages") or len(hashes))
+        missing = hashes[local_pages : min(want, len(hashes))]
+        if not missing:
+            return 0
+        key = (peer, missing[0])
+        leader = self._kv_prefetch_inflight.get(key)
+        if leader is not None:
+            # A same-prefix burst-mate is already pulling this range: wait
+            # for its adoption instead of issuing a duplicate transfer
+            # (shielded — a cancelled follower must not kill the leader's
+            # completion signal). Admission's lookup finds whatever the
+            # leader adopted; if it failed, this request just re-prefills.
+            await asyncio.shield(leader)
+            return 0
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._kv_prefetch_inflight[key] = fut
+        try:
+            self.engine.stats["kv_fetch_requested_total"] += 1
+            got = await self._kv_fetch_fn(
+                peer, [h.hex() for h in missing], self.kv_fetch_timeout_s
+            )
+            if not got:
+                self.engine.stats["kv_fetch_failed_total"] += 1
+                return 0
+
+            def _decode_entries():
+                # base64 + frombuffer over up to MBs of payload: off the
+                # event loop, or every stream multiplexed on this node
+                # stalls while one transfer decodes.
+                by_chain = {
+                    pg.get("chain"): pg for pg in got if isinstance(pg, dict)
+                }
+                kp = self.engine.cache.k_pages
+                page_shape = (kp.shape[0], kp.shape[2], kp.shape[3], kp.shape[4])
+                out = []
+                for idx, h in enumerate(missing):
+                    pg = by_chain.get(h.hex())
+                    if pg is None:
+                        break  # a gap ends the adoptable prefix (chain rule)
+                    try:
+                        dt = np.dtype(pg["dtype"])
+                        shape = tuple(pg["shape"])
+                        if shape != page_shape:
+                            raise ValueError(f"page shape {shape} != {page_shape}")
+                        k = np.frombuffer(
+                            base64.b64decode(pg["k"]), dtype=dt
+                        ).reshape(shape)
+                        v = np.frombuffer(
+                            base64.b64decode(pg["v"]), dtype=dt
+                        ).reshape(shape)
+                    except Exception:
+                        self.engine.stats["kv_fetch_failed_total"] += 1
+                        break
+                    depth = local_pages + idx
+                    out.append(
+                        (h, depth,
+                         tuple(matchable[depth * ps : (depth + 1) * ps]),
+                         (k, v))
+                    )
+                return out
+
+            entries = await asyncio.to_thread(_decode_entries)
+            if not entries:
+                return 0
+            return self.engine.adopt_kv_pages(entries)
+        finally:
+            self._kv_prefetch_inflight.pop(key, None)
+            if not fut.done():
+                fut.set_result(None)
+
     async def generate(
         self,
         prompt: str | None = None,
@@ -976,6 +1164,11 @@ class ModelBackend:
         output: str = "text",
         deadline_s: float | None = None,
         priority: int = 0,
+        kv_peer: dict | None = None,  # cluster prefix tier: gateway hint
+        # naming the peer node whose sketch advertised this prompt's prefix;
+        # missing pages are pulled over the channel before admission
+        # (docs/PREFIX_CACHING.md "Cluster tier"). Best-effort: any failure
+        # degrades to an ordinary local prefill.
     ) -> dict[str, Any]:
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
@@ -1054,6 +1247,8 @@ class ModelBackend:
         prefused = None
         if (images or audios) and prompt is not None and tokens is None:
             prefused = await self.ensure_media(prompt, images, audios)
+        if kv_peer is not None and tokens is not None and not (images or audios):
+            await self.maybe_prefetch_kv(tokens, kv_peer)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         rid, truncated = self._submit(
             prompt,
@@ -1267,6 +1462,18 @@ def build_model_node(
         # 256 int16 bank rows (~66 MB at a 128k vocab) cover several live
         # schemas; idle ones evict LRU under pressure.
         ecfg = EngineConfig(grammar_slots=256)
+    import os as _os
+
+    _sk = _os.environ.get("AGENTFIELD_PREFIX_SKETCH_BYTES")
+    if _sk is not None:
+        # Operator override of the heartbeat sketch byte cap (docs/
+        # OPERATIONS.md "Cluster prefix cache"); 0 stops publication.
+        import dataclasses as _dc2
+
+        try:
+            ecfg = _dc2.replace(ecfg, prefix_sketch_bytes=int(_sk))
+        except ValueError:
+            pass  # afcheck: ignore[except-swallow] malformed env override keeps the configured default
     draft = None
     if spec_k is not None:
         import dataclasses as _dc
@@ -1318,18 +1525,30 @@ def build_model_node(
     )
     # Engine counters ride the 2s heartbeats → cluster-visible via
     # /api/v1/nodes metadata and the dashboard.
-    agent.heartbeat_stats = lambda: {
-        **backend.engine.stats,
-        **backend.engine.grammar_bank_stats(),
-        **backend.engine.prefix_cache_stats(),
-        **backend.engine.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
-        # node-side data-plane counters ride the same heartbeat → /stats →
-        # per-node Prometheus gauge pipeline as the engine counters
-        **(agent.channel_server.stats if agent.channel_server is not None else {}),
-        "active_slots": backend.engine.num_active,
-        "free_pages": backend.engine.allocator.free_pages,
-        "draining": int(backend._draining),
-    }
+    def _heartbeat_stats():
+        stats = {
+            **backend.engine.stats,
+            **backend.engine.grammar_bank_stats(),
+            **backend.engine.prefix_cache_stats(),
+            **backend.engine.scheduler_stats(),  # itl_ms_p50/p99, tokens_per_tick
+            # node-side data-plane counters ride the same heartbeat → /stats →
+            # per-node Prometheus gauge pipeline as the engine counters
+            **(agent.channel_server.stats if agent.channel_server is not None else {}),
+            "active_slots": backend.engine.num_active,
+            "pending_requests": len(backend.engine.pending),
+            "free_pages": backend.engine.allocator.free_pages,
+            "draining": int(backend._draining),
+        }
+        # Cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier"): the
+        # prefix-index sketch rides every heartbeat; the registry pops it
+        # into the affinity side table (it is a routing signal, not a
+        # numeric stat — export_engine_stats would skip it anyway).
+        sketch = backend.engine.prefix_sketch()
+        if sketch is not None:
+            stats["prefix_sketch"] = sketch
+        return stats
+
+    agent.heartbeat_stats = _heartbeat_stats
 
     async def _prep_stream_kwargs(body: dict) -> dict:
         """Shared request prep for both token-stream transports (direct SSE
@@ -1365,6 +1584,13 @@ def build_model_node(
                 gen_kwargs["prompt"], gen_kwargs.get("images"),
                 gen_kwargs.get("audios"),
             )
+        if body.get("kv_peer") is not None and gen_kwargs.get("tokens") is not None \
+                and not (gen_kwargs.get("images") or gen_kwargs.get("audios")):
+            # Cluster prefix tier: pull the advertised prefix pages from the
+            # hinted peer BEFORE submit, so admission's lookup restores them
+            # (kv_peer is a transport hint, not a sampling kwarg — it never
+            # reaches submit_stream).
+            await backend.maybe_prefetch_kv(gen_kwargs["tokens"], body["kv_peer"])
         return gen_kwargs
 
     def _event_frame(ev) -> dict:
@@ -1487,6 +1713,11 @@ def build_model_node(
 
     if agent.channel_server is not None:
         agent.channel_stream("generate", channel_generate)
+        # Cluster prefix tier: serve peers' kv_fetch frames from this
+        # engine's prefix index, and ride the same channel (gateway-relayed)
+        # for this node's own pulls.
+        agent.channel_server.set_kv_export(backend.kv_export_pages)
+        backend._kv_fetch_fn = agent.channel_server.fetch_kv
 
     async def stats_handler(_req):
         from aiohttp import web as _web

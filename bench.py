@@ -68,6 +68,17 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     Reports resume TTFT p50/p99 both modes, restore hit rate, and the
     kv_offload_* counters; headline value = resume TTFT p50 speedup
     (OFF/ON; acceptance: > 1.0). AGENTFIELD_BENCH_SESSIONS sizes the set.
+  cluster_prefix_burst — cluster prefix cache bench (docs/PREFIX_CACHING.md
+    "Cluster tier"): ONE in-process gateway × THREE model nodes (CPU
+    llama-tiny proxy, shared weights). Node 1 is warmed with K shared
+    system prompts; a burst whose named targets round-robin the fleet then
+    runs twice — prefix affinity + cross-node KV transfer ON vs OFF.
+    Reports cold-node TTFT p50/p99 (requests whose NAMED target was a cold
+    node), aggregate + per-node prefill tokens, kv_fetch/affinity/relay
+    counters, success rates. Headline value = cold-node TTFT p50 speedup
+    OFF/ON (acceptance: >= 1.5 at parity success rate).
+    AGENTFIELD_BENCH_BURST sizes the burst (24),
+    AGENTFIELD_BENCH_CLUSTER_PREFIXES the distinct shared prompts (8).
   kernels — ragged paged-attention kernel microbench (no model;
     docs/KERNELS.md): the canonical shape mixes (pure_decode, pure_prefill,
     mixed_ragged, long_context_paged — tools/perf/kernel_gate.SHAPES, the
@@ -518,11 +529,16 @@ def _run_bench() -> None:
         _session_churn(model, cfg, params, attn)
         _done.set()
         return
+    if scenario == "cluster_prefix_burst":
+        _cluster_prefix_burst(model, cfg, params, attn)
+        _done.set()
+        return
     if scenario:
         raise ValueError(
             f"unknown AGENTFIELD_BENCH_SCENARIO={scenario!r} "
             "(have: shared_prefix_burst, mixed_interference, overload_storm, "
-            "session_churn, fault_storm, gateway_qps, kernels)"
+            "session_churn, cluster_prefix_burst, fault_storm, gateway_qps, "
+            "kernels)"
         )
 
     demoted = None
@@ -1165,6 +1181,251 @@ def _session_churn(model: str, cfg, params, attn: str) -> None:
             "num_pages": ecfg_on.num_pages,
             "idle_pages_demanded": idle_demand,
             "host_cache_bytes": ecfg_on.host_cache_bytes,
+            "attn_impl": attn,
+            "device": str(jax.devices()[0]),
+        }
+    )
+
+
+def _cluster_prefix_burst(model: str, cfg, params, attn: str) -> None:
+    """Cluster prefix cache A/B (docs/PREFIX_CACHING.md "Cluster tier"):
+    one in-process control plane, three real model nodes sharing weights
+    (greedy outputs identical regardless of placement), K shared system
+    prompts warmed on node 0 only. The measured burst round-robins its
+    NAMED targets across the fleet — the client-side spray the tier exists
+    to absorb. Affinity ON routes cold-targeted requests to the warm node
+    (or lands them cold WITH a kv_peer hint, pulling the prefix over the
+    channel relay); OFF pays a full prefill for every first (prefix, node)
+    touch. Cold-node TTFT = TTFT of requests whose named target was a cold
+    node; both modes run the identical warm phase (all compile paths incl.
+    the batched restore scatter) so neither measures compilation."""
+    import asyncio
+    import json as _json
+
+    import aiohttp
+    import jax
+    import jax.numpy as jnp
+    from aiohttp import web
+
+    from agentfield_tpu.control_plane.server import ControlPlane, create_app
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    _partial["stage"] = "cluster_prefix_burst"
+    os.environ.setdefault("AGENTFIELD_LOG_LEVEL", "warning")
+    n_nodes = 3
+    n_prefixes = int(os.environ.get("AGENTFIELD_BENCH_CLUSTER_PREFIXES") or 8)
+    n_burst = int(os.environ.get("AGENTFIELD_BENCH_BURST") or n_prefixes * n_nodes)
+    conc = int(os.environ.get("AGENTFIELD_BENCH_CLUSTER_CONCURRENCY") or 6)
+    ps, prefix_pages, tail_len, max_new = 32, 8, 16, 8
+    shared_len = ps * prefix_pages  # 256-token system prompt
+
+    ecfg = EngineConfig(
+        max_batch=4,
+        page_size=ps,
+        # node 0 must hold every warmed prefix (n_prefixes × prefix_pages
+        # pages) PLUS active working set without evicting the very cache
+        # the routing advertises
+        num_pages=n_prefixes * prefix_pages + 96,
+        max_pages_per_seq=16,
+        max_pending=256,
+        prefill_batch=1,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: honest TTFT
+    )
+
+    def toks(seed: int, length: int) -> list[int]:
+        return jax.random.randint(
+            jax.random.PRNGKey(seed), (length,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    prefixes = [toks(700 + k, shared_len) for k in range(n_prefixes)]
+    warm_prefix = toks(699, shared_len)  # throwaway, warms transfer machinery
+
+    if not _budget_gate("cluster_prefix_burst", 180):
+        _emit(_fallback_payload("budget exhausted before cluster_prefix_burst"))
+        return
+
+    async def one_run(affinity: bool) -> dict:
+        cp = ControlPlane(db_path=":memory:", prefix_affinity=affinity)
+        app = create_app(cp)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        base = f"http://127.0.0.1:{port}"
+        nodes = []
+        for i in range(n_nodes):
+            agent, back = build_model_node(
+                f"n{i}", base, model=model, params=params, ecfg=ecfg
+            )
+            await back.start()
+            await agent.start()
+            nodes.append((agent, back))
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=180)
+            ) as s:
+
+                async def gen(target: str, body: dict) -> dict:
+                    async with s.post(
+                        f"{base}/api/v1/execute/{target}.generate",
+                        json={"input": body},
+                    ) as r:
+                        doc = await r.json()
+                    assert doc.get("status") == "completed", doc
+                    return doc
+
+                # -- warm phase (identical in both modes): every compile
+                # path out of the measured window. Node 0 additionally
+                # caches every measured prefix (it is the warm node).
+                for k, p in enumerate(prefixes):
+                    await gen("n0", {"tokens": p + toks(800 + k, tail_len),
+                                     "max_new_tokens": max_new})
+                # n0's warm-hit suffix bucket (prefix cached, 16-token tail)
+                await gen("n0", {"tokens": prefixes[0] + toks(830, tail_len),
+                                 "max_new_tokens": max_new})
+                await gen("n0", {"tokens": warm_prefix + toks(831, tail_len),
+                                 "max_new_tokens": max_new})
+                for i in range(1, n_nodes):
+                    # cold full-length prefill bucket + decode
+                    await gen(f"n{i}", {"tokens": toks(840 + i, shared_len + tail_len),
+                                        "max_new_tokens": max_new})
+                    # one full transfer cycle over the throwaway prefix:
+                    # compiles the batched restore scatter + suffix bucket
+                    # and exercises fetch/adopt end to end
+                    await gen(f"n{i}", {
+                        "tokens": warm_prefix + toks(850 + i, tail_len),
+                        "max_new_tokens": max_new,
+                        "kv_peer": {"node_id": "n0", "pages": prefix_pages,
+                                    "page_size": ps},
+                    })
+
+                # -- publish sketches + keep load fresh during the burst
+                async def hb_all() -> None:
+                    for i, (agent, _back) in enumerate(nodes):
+                        await cp.registry.heartbeat(
+                            f"n{i}", {"stats": agent.heartbeat_stats()}
+                        )
+
+                await hb_all()
+                stop = asyncio.Event()
+
+                async def hb_loop() -> None:
+                    while not stop.is_set():
+                        try:
+                            await asyncio.wait_for(stop.wait(), 0.5)
+                        except (TimeoutError, asyncio.TimeoutError):
+                            await hb_all()
+
+                hb_task = asyncio.create_task(hb_loop())
+
+                pre_prefill = [
+                    back.engine.stats["prefill_tokens"] for _, back in nodes
+                ]
+                sem = asyncio.Semaphore(conc)
+                results: list[tuple[bool, float | None, str]] = []
+
+                async def call(j: int) -> None:
+                    target = f"n{j % n_nodes}"
+                    body = {
+                        "tokens": prefixes[j % n_prefixes] + toks(900 + j, tail_len),
+                        "max_new_tokens": max_new,
+                    }
+                    async with sem:
+                        t0 = time.perf_counter()
+                        ttft, status = None, "?"
+                        async with s.post(
+                            f"{base}/api/v1/execute/{target}.generate",
+                            json={"input": body, "stream": True},
+                        ) as r:
+                            async for line in r.content:
+                                if not line.startswith(b"data: "):
+                                    continue
+                                f = _json.loads(line[6:])
+                                if f.get("kind") == "token" and ttft is None:
+                                    ttft = (time.perf_counter() - t0) * 1e3
+                                if f.get("kind") in ("terminal", "dropped"):
+                                    status = f.get("status", "dropped")
+                                    break
+                    results.append((j % n_nodes != 0, ttft, status))
+
+                await asyncio.gather(*(call(j) for j in range(n_burst)))
+                stop.set()
+                await hb_task
+        finally:
+            for agent, back in nodes:
+                await agent.stop()
+                await back.stop()
+            await runner.cleanup()
+
+        cold = sorted(
+            t for is_cold, t, st in results
+            if is_cold and t is not None and st == "completed"
+        )
+        all_t = sorted(t for _c, t, st in results if t is not None and st == "completed")
+        ok = sum(1 for _c, _t, st in results if st == "completed")
+        per_node_prefill = [
+            back.engine.stats["prefill_tokens"] - pre_prefill[i]
+            for i, (_a, back) in enumerate(nodes)
+        ]
+        kv = {
+            "requested": sum(b.engine.stats["kv_fetch_requested_total"] for _a, b in nodes),
+            "failed": sum(b.engine.stats["kv_fetch_failed_total"] for _a, b in nodes),
+            "pages_adopted": sum(
+                b.engine.stats["kv_fetch_pages_adopted_total"] for _a, b in nodes
+            ),
+            "served": sum(b.engine.stats["kv_fetch_served_total"] for _a, b in nodes),
+            "bytes": sum(b.engine.stats["kv_fetch_bytes_total"] for _a, b in nodes),
+        }
+        affinity_hits = sum(
+            cp.metrics.counter_value(
+                "prefix_affinity_hits_total", labels={"node": f"n{i}"}
+            )
+            for i in range(n_nodes)
+        )
+        return {
+            "success_rate": round(ok / n_burst, 4),
+            "cold_ttft_ms_p50": round(_pctile(cold, 50), 1) if cold else None,
+            "cold_ttft_ms_p99": round(_pctile(cold, 99), 1) if cold else None,
+            "all_ttft_ms_p50": round(_pctile(all_t, 50), 1) if all_t else None,
+            "cold_requests": len(cold),
+            "prefill_tokens_total": sum(per_node_prefill),
+            "prefill_tokens_per_node": per_node_prefill,
+            "kv_fetch": kv,
+            "affinity_hits": affinity_hits,
+            "relay_fetches": cp.metrics.counter_value("kv_relay_fetches_total"),
+            "relay_errors": cp.metrics.counter_value("kv_relay_errors_total"),
+        }
+
+    _partial["stage"] = "cluster_prefix_burst affinity+transfer OFF"
+    off = asyncio.run(one_run(affinity=False))
+    _partial["cluster_prefix_burst_off"] = off
+    _partial["stage"] = "cluster_prefix_burst affinity+transfer ON"
+    on = asyncio.run(one_run(affinity=True))
+
+    _emit(
+        {
+            "metric": (
+                f"cluster_prefix_burst_{model}_{n_nodes}nodes_"
+                f"{n_prefixes}prefixes_{n_burst}req"
+            ),
+            "value": _ratio(off["cold_ttft_ms_p50"], on["cold_ttft_ms_p50"]),
+            "unit": "cold_node_ttft_p50_speedup_off_over_on",
+            "on": on,
+            "off": off,
+            "prefill_tokens_saved": off["prefill_tokens_total"]
+            - on["prefill_tokens_total"],
+            "prefill_reduction": _ratio(
+                off["prefill_tokens_total"], on["prefill_tokens_total"]
+            ),
+            "success_parity": on["success_rate"] == off["success_rate"] == 1.0,
+            "nodes": n_nodes,
+            "prefixes": n_prefixes,
+            "burst": n_burst,
+            "concurrency": conc,
+            "shared_prompt_tokens": shared_len,
             "attn_impl": attn,
             "device": str(jax.devices()[0]),
         }

@@ -1,0 +1,500 @@
+"""Cluster-level prefix cache tests (docs/PREFIX_CACHING.md "Cluster tier"):
+prefix-affinity routing + cross-node KV page transfer.
+
+Covers the contracts ISSUE 11 pins:
+  - the heartbeat sketch is byte-capped, leading-pages-first, and counted
+    when truncated;
+  - NodeSnapshotCache serves a sketch only within its TTL bound and the
+    sketch-bearing heartbeat path replaces entries explicitly (never via the
+    node-table snapshot);
+  - `_pick_node` with affinity OFF (knob, absent sketches, stale sketches,
+    or a text prompt) is bit-compatible with the pre-affinity pick order;
+    capability/model filters always beat affinity;
+  - cross-node transfer: a kv_peer-hinted generate pulls the advertised
+    prefix pages over the gateway relay, restores them at admission, and is
+    token-exact with reduced prefill;
+  - seeded kv.fetch_fail / kv.fetch_stall chaos degrades to a local
+    re-prefill — token-exact, zero leaked pages.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.control_plane.registry import NodeSnapshotCache
+from agentfield_tpu.control_plane.types import (
+    Execution,
+    ExecutionStatus,
+    TargetType,
+)
+from agentfield_tpu.prefix_hash import page_chain_hashes, sketch_digest
+from tests.helpers_cp import CPHarness, async_test
+
+# Engine/model imports are deliberately inside the tests that need a real
+# model node, so the pure control-plane tests stay jax-light.
+
+
+# ---------------------------------------------------------------------------
+# sketch format + hygiene (pool-level, no model)
+
+
+def test_pool_sketch_leading_pages_first_and_byte_cap():
+    from agentfield_tpu.serving.kv_cache import PrefixPagePool
+
+    pool = PrefixPagePool(32, 4)
+    toks = list(range(20))  # 5 full pages
+    pages = pool.alloc(5)
+    pool.publish(toks, pages)
+    hashes = page_chain_hashes(toks, 4)
+
+    s = pool.sketch(4096)
+    assert s["v"] == 1 and s["page_size"] == 4 and s["truncated"] == 0
+    # depth order: digest i is the chain through page i
+    assert s["digests"] == [sketch_digest(h) for h in hashes]
+
+    # capped: only the LEADING pages survive, truncation is counted
+    s2 = pool.sketch(64 + 2 * 19)
+    assert s2["truncated"] == 1
+    assert s2["digests"] == [sketch_digest(h) for h in hashes[:2]]
+    assert pool.stats["prefix_sketch_truncated_total"] == 1
+    pool.free(pages)
+
+
+def test_engine_sketch_knob_gates_publication(tiny_engine_factory=None):
+    import jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine
+
+    cfg = get_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=8,
+        prefix_sketch_bytes=0,
+    )
+    engine = InferenceEngine(params, cfg, ecfg)
+    try:
+        assert engine.prefix_sketch() is None
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# NodeSnapshotCache sketch side table: TTL bound + explicit replacement
+
+
+class _NullDB:
+    async def list_nodes(self):
+        return []
+
+    async def get_node(self, node_id):
+        return None
+
+
+def test_sketch_side_table_ttl_bound():
+    cache = NodeSnapshotCache(_NullDB(), sketch_ttl_s=0.05)
+    sketch = {"v": 1, "page_size": 8, "digests": ["ab" * 8], "truncated": 0}
+    cache.put_sketch("n1", sketch, load=2.0)
+    got = cache.get_sketch("n1")
+    assert got == (sketch, 2.0)
+    # replaced atomically: the new entry fully supersedes the old
+    sketch2 = {"v": 1, "page_size": 8, "digests": [], "truncated": 0}
+    cache.put_sketch("n1", sketch2, load=0.0)
+    assert cache.get_sketch("n1") == (sketch2, 0.0)
+    time.sleep(0.06)
+    # past the TTL bound the sketch reads as ABSENT — the dispatch fast
+    # path can never act on a node whose heartbeats stopped
+    assert cache.get_sketch("n1") is None
+    cache.drop_sketch("n1")
+    assert cache.get_sketch("n1") is None
+
+
+@async_test
+async def test_heartbeat_pops_sketch_into_side_table():
+    """A sketch-bearing heartbeat lands in the affinity side table and is
+    POPPED from the stats persisted into node metadata (a several-KB digest
+    list must not ride every node row); deregister drops the entry."""
+    async with CPHarness() as h:
+        await h.register_agent("sk-node")
+        sketch = {"v": 1, "page_size": 8, "digests": ["cd" * 8], "truncated": 0}
+        node = await h.cp.registry.heartbeat(
+            "sk-node",
+            {"stats": {"prefix_sketch": sketch, "active_slots": 1,
+                       "pending_requests": 3}},
+        )
+        got = h.cp.registry.cache.get_sketch("sk-node")
+        assert got is not None
+        assert got[0] == sketch
+        assert got[1] == 4.0  # active_slots + pending_requests
+        assert "prefix_sketch" not in node.metadata.get("stats", {})
+        await h.cp.registry.deregister("sk-node")
+        assert h.cp.registry.cache.get_sketch("sk-node") is None
+
+
+# ---------------------------------------------------------------------------
+# _pick_node affinity scoring (control plane only; stub nodes)
+
+
+def _exec_for(target: str, tokens=None):
+    inp = {"tokens": tokens, "max_new_tokens": 4} if tokens is not None else {"x": 1}
+    return Execution(
+        execution_id="exec_t",
+        target=target,
+        target_type=TargetType.REASONER,
+        status=ExecutionStatus.RUNNING,
+        run_id="run_t",
+        input=inp,
+    )
+
+
+async def _gen_cluster(h, models=("m", "m", "m")):
+    """Three stub model nodes all serving `generate` for the given models."""
+    for i, m in enumerate(models):
+        await h.cp.registry.register(
+            {
+                "node_id": f"g{i}",
+                "base_url": "http://127.0.0.1:9",
+                "kind": "model",
+                "reasoners": [{"id": "generate"}],
+                "metadata": {"model": m, "channel": True},
+            }
+        )
+
+
+def _sketch_for(tokens, page_size, pages):
+    hs = page_chain_hashes(tokens[: len(tokens) - 1], page_size)
+    return {
+        "v": 1,
+        "page_size": page_size,
+        "digests": [sketch_digest(x) for x in hs[:pages]],
+        "truncated": 0,
+    }
+
+
+@async_test
+async def test_pick_node_affinity_scoring_and_fallbacks():
+    async with CPHarness() as h:
+        await _gen_cluster(h)
+        gw = h.cp.gateway
+        cache = h.cp.registry.cache
+        toks = list(range(40))  # 4 full pages + tail at page_size 8
+        ex = _exec_for("g0.generate", toks)
+
+        # (1) no sketches anywhere → bit-compatible with today's order:
+        # own node first, then list order; tried deprioritized.
+        assert (await gw._pick_node(ex, set())).node_id == "g0"
+        assert (await gw._pick_node(ex, {"g0"})).node_id in ("g1", "g2")
+        picked = await gw._pick_node(ex, {"g0", "g1", "g2"})
+        assert picked.node_id == "g0"  # all tried: first candidate wins
+
+        # (2) a warm peer's sketch wins over the named node
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.0)
+        assert (await gw._pick_node(ex, set())).node_id == "g2"
+        assert gw._kv_hints.get("exec_t") is None  # winner IS the advertiser
+        hits = h.cp.metrics.counter_value(
+            "prefix_affinity_hits_total", labels={"node": "g2"}
+        )
+        assert hits >= 1
+
+        # (3) load blend: the warm node under heavy load loses to idle
+        # candidates — and the loser gets the transfer hint at the winner
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=100.0)
+        picked = await gw._pick_node(ex, set())
+        assert picked.node_id == "g0"  # today's order among zero-score nodes
+        hint = gw._kv_hints.get("exec_t")
+        assert hint == {"node_id": "g2", "pages": 4, "page_size": 8}
+
+        # (4) ties on expected pages break by load, then today's order
+        cache.put_sketch("g1", _sketch_for(toks, 8, 4), load=0.5)
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.9)
+        assert (await gw._pick_node(ex, set())).node_id == "g1"
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.5)
+        assert (await gw._pick_node(ex, set())).node_id == "g1"  # g1 before g2
+
+        # (5) stale sketch → bit-compatible fallback to today's order
+        cache._sketches.clear()
+        old_ttl = cache.sketch_ttl_s
+        cache.sketch_ttl_s = 0.0
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.0)
+        time.sleep(0.001)
+        assert (await gw._pick_node(ex, set())).node_id == "g0"
+        cache.sketch_ttl_s = old_ttl
+
+        # (6) text prompts have no gateway-computable hashes → today's order
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.0)
+        ex_text = Execution(
+            execution_id="exec_text", target="g0.generate",
+            target_type=TargetType.REASONER, status=ExecutionStatus.RUNNING,
+            run_id="run_t",
+            input={"prompt": "hello there", "max_new_tokens": 4},
+        )
+        assert (await gw._pick_node(ex_text, set())).node_id == "g0"
+
+        # (7) knob OFF pins the pre-affinity order bit-for-bit
+        gw.prefix_affinity = False
+        assert (await gw._pick_node(ex, set())).node_id == "g0"
+        assert (await gw._pick_node(ex, {"g0"})).node_id == "g1"
+        gw.prefix_affinity = True
+
+        # (8) malformed client tokens (non-int, out-of-int32) must DEGRADE
+        # to today's order, never raise inside _pick_node (an escaped
+        # exception would strand the execution RUNNING forever)
+        cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.0)
+        for bad in (toks[:-1] + ["x"], toks[:-1] + [2**31], toks[:-1] + [True]):
+            ex_bad = Execution(
+                execution_id="exec_bad", target="g0.generate",
+                target_type=TargetType.REASONER, status=ExecutionStatus.RUNNING,
+                run_id="run_t",
+                input={"tokens": bad, "max_new_tokens": 4},
+            )
+            assert (await gw._pick_node(ex_bad, set())).node_id == "g0"
+
+
+@async_test
+async def test_pick_node_model_filter_beats_affinity():
+    """A node serving a DIFFERENT checkpoint is never a candidate, however
+    good its sketch — no silent checkpoint substitution (same rule as the
+    PR 3 failover filter)."""
+    async with CPHarness() as h:
+        await _gen_cluster(h, models=("m1", "m1", "m2"))
+        toks = list(range(40))
+        h.cp.registry.cache.put_sketch("g2", _sketch_for(toks, 8, 4), load=0.0)
+        ex = _exec_for("g0.generate", toks)
+        picked = await h.cp.gateway._pick_node(ex, set())
+        assert picked.node_id == "g0"  # g2 (model m2) filtered out entirely
+        # and even with the named node down, the wrong-model node never wins
+        await h.cp.registry.heartbeat("g0", {"status": "inactive"})
+        picked = await h.cp.gateway._pick_node(ex, set())
+        assert picked.node_id == "g1"
+
+
+# ---------------------------------------------------------------------------
+# cross-node transfer end to end (real engines)
+
+
+def _boot_pair():
+    import jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig
+
+    cfg = get_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=16)
+    return cfg, params, ecfg
+
+
+async def _boot_nodes(h, cfg, params, ecfg):
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    a_agent, a_back = build_model_node(
+        "node-a", h.base_url, model="llama-tiny", params=params, ecfg=ecfg
+    )
+    b_agent, b_back = build_model_node(
+        "node-b", h.base_url, model="llama-tiny", params=params, ecfg=ecfg
+    )
+    await a_back.start()
+    await a_agent.start()
+    await b_back.start()
+    await b_agent.start()
+    return (a_agent, a_back), (b_agent, b_back)
+
+
+async def _stop_nodes(*pairs):
+    for agent, back in pairs:
+        await agent.stop()
+        await back.stop()
+
+
+async def _gen(h, target, body):
+    async with h.http.post(f"/api/v1/execute/{target}", json={"input": body}) as r:
+        doc = await r.json()
+    assert doc["status"] == "completed", doc
+    return doc
+
+
+@async_test
+async def test_cross_node_transfer_token_exact_and_counters():
+    _cfg, params, ecfg = _boot_pair()
+    async with CPHarness() as h:
+        (a_agent, a_back), (b_agent, b_back) = await _boot_nodes(h, _cfg, params, ecfg)
+        # The hint is driven MANUALLY here; affinity off keeps the agents'
+        # background heartbeats (which publish sketches on their own) from
+        # re-routing the hinted request to the warm node mid-test.
+        h.cp.gateway.prefix_affinity = False
+        try:
+            shared = list(range(50, 82))  # 4 full pages at page_size 8
+            # warm A with the shared prefix
+            await _gen(h, "node-a.generate", {"tokens": shared + [1, 2], "max_new_tokens": 4})
+            # reference output for the transfer prompt (same weights, greedy)
+            prompt = shared + [7, 9]
+            ref = await _gen(h, "node-a.generate", {"tokens": prompt, "max_new_tokens": 6})
+            pre = b_back.engine.stats["prefill_tokens"]
+            # B pulls the prefix from A (caller-supplied hint: setdefault
+            # keeps it; this is also the affinity hint's injection shape)
+            doc = await _gen(
+                h, "node-b.generate",
+                {"tokens": prompt, "max_new_tokens": 6,
+                 "kv_peer": {"node_id": "node-a", "pages": 4, "page_size": 8}},
+            )
+            assert doc["result"]["tokens"] == ref["result"]["tokens"]
+            # prefill paid only the un-cached tail, not the whole prompt
+            delta = b_back.engine.stats["prefill_tokens"] - pre
+            assert delta < len(shared), delta
+            assert b_back.engine.stats["kv_fetch_requested_total"] == 1
+            assert b_back.engine.stats["kv_fetch_failed_total"] == 0
+            assert b_back.engine.stats["kv_fetch_pages_adopted_total"] == 4
+            assert a_back.engine.stats["kv_fetch_served_total"] == 4
+            assert a_back.engine.stats["kv_fetch_bytes_total"] > 0
+            assert h.cp.metrics.counter_value("kv_relay_fetches_total") == 1
+            # the engine stats ride the heartbeat → /metrics gauge pipeline
+            await h.cp.registry.heartbeat(
+                "node-b", {"stats": b_agent.heartbeat_stats()}
+            )
+            assert (
+                h.cp.metrics.gauge_value(
+                    "engine_kv_fetch_pages_adopted_total", labels={"node": "node-b"}
+                )
+                == 4.0
+            )
+        finally:
+            await _stop_nodes((a_agent, a_back), (b_agent, b_back))
+
+
+@async_test
+async def test_fetch_fail_and_stall_degrade_token_exact_zero_leak():
+    """Seeded kv.fetch_fail (serving side refuses) and kv.fetch_stall
+    (response outlives the requester's timeout): both degrade to a local
+    re-prefill with identical tokens and no leaked pages."""
+    _cfg, params, ecfg = _boot_pair()
+    async with CPHarness() as h:
+        (a_agent, a_back), (b_agent, b_back) = await _boot_nodes(h, _cfg, params, ecfg)
+        h.cp.gateway.prefix_affinity = False  # manual hints; see above
+        try:
+            shared = list(range(90, 122))
+            await _gen(h, "node-a.generate", {"tokens": shared + [1, 2], "max_new_tokens": 4})
+            prompt = shared + [3, 4]
+            ref = await _gen(h, "node-a.generate", {"tokens": prompt, "max_new_tokens": 6})
+            hint = {"node_id": "node-a", "pages": 4, "page_size": 8}
+
+            # (a) fetch_fail: the serving node answers with an error frame
+            faults.install(
+                faults.FaultInjector(seed=3, spec={"kv.fetch_fail": {"times": 1}})
+            )
+            try:
+                pre = b_back.engine.stats["prefill_tokens"]
+                doc = await _gen(
+                    h, "node-b.generate",
+                    {"tokens": prompt, "max_new_tokens": 6, "kv_peer": hint},
+                )
+            finally:
+                faults.install(None)
+            assert doc["result"]["tokens"] == ref["result"]["tokens"]
+            assert b_back.engine.stats["kv_fetch_failed_total"] == 1
+            assert b_back.engine.stats["kv_fetch_pages_adopted_total"] == 0
+            # full local prefill happened (nothing adopted)
+            assert b_back.engine.stats["prefill_tokens"] - pre == len(prompt)
+
+            # (b) fetch_stall: the answer arrives after the requester gave
+            # up. A FRESH prefix warmed only on A — phase (a)'s local
+            # re-prefill published `shared` on B, which would satisfy the
+            # walk locally and skip the fetch entirely.
+            shared2 = list(range(160, 192))
+            await _gen(h, "node-a.generate", {"tokens": shared2 + [1, 2], "max_new_tokens": 4})
+            b_back.kv_fetch_timeout_s = 0.15
+            faults.install(
+                faults.FaultInjector(
+                    seed=4, spec={"kv.fetch_stall": {"times": 1, "delay_s": 1.0}}
+                )
+            )
+            try:
+                prompt2 = shared2 + [5, 6]
+                ref2 = await _gen(
+                    h, "node-a.generate", {"tokens": prompt2, "max_new_tokens": 6}
+                )
+                doc2 = await _gen(
+                    h, "node-b.generate",
+                    {"tokens": prompt2, "max_new_tokens": 6, "kv_peer": hint},
+                )
+            finally:
+                faults.install(None)
+            assert doc2["result"]["tokens"] == ref2["result"]["tokens"]
+            assert b_back.engine.stats["kv_fetch_failed_total"] == 2
+            # let the stalled serve task finish so its late frames are
+            # provably discarded (the waiter is gone)
+            await asyncio.sleep(1.0)
+            assert b_back.engine.stats["kv_fetch_pages_adopted_total"] == 0
+
+            # zero leaked pages: every page is either free or refcount-0
+            # cached once nothing is running (page 0 reserved)
+            for back in (a_back, b_back):
+                assert not back.engine.has_work()
+                pool = back.engine.allocator
+                assert pool.free_pages == pool.num_pages - 1
+        finally:
+            await _stop_nodes((a_agent, a_back), (b_agent, b_back))
+
+
+@async_test
+async def test_prefetch_dedups_concurrent_same_prefix_fetches():
+    """A same-prefix burst on one cold node issues ONE cross-node transfer:
+    followers await the leader's adoption instead of duplicating the pull."""
+    import jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import ModelBackend
+
+    cfg = get_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    back = ModelBackend(
+        params, cfg,
+        EngineConfig(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=8),
+    )
+    calls = []
+
+    async def slow_fetch(peer, chains_hex, timeout_s):
+        calls.append(peer)
+        await asyncio.sleep(0.05)
+        return None  # leader "fails": followers must still just re-prefill
+
+    back._kv_fetch_fn = slow_fetch
+    toks = list(range(40))
+    hint = {"node_id": "peer-a", "pages": 4, "page_size": 8}
+    try:
+        out = await asyncio.gather(
+            *(back.maybe_prefetch_kv(toks, hint) for _ in range(4))
+        )
+        assert calls == ["peer-a"], calls  # exactly one transfer
+        assert out.count(0) == 4
+        assert back.engine.stats["kv_fetch_requested_total"] == 1
+        assert back._kv_prefetch_inflight == {}
+    finally:
+        back.engine.close()
+
+
+@async_test
+async def test_affinity_routes_burst_to_warm_node_and_off_pin():
+    """End to end through heartbeat sketches: a cold-targeted request routes
+    to the warm advertiser with affinity ON; OFF stays on the named node."""
+    _cfg, params, ecfg = _boot_pair()
+    async with CPHarness() as h:
+        (a_agent, a_back), (b_agent, b_back) = await _boot_nodes(h, _cfg, params, ecfg)
+        try:
+            shared = list(range(130, 162))
+            await _gen(h, "node-a.generate", {"tokens": shared + [1, 2], "max_new_tokens": 4})
+            await h.cp.registry.heartbeat("node-a", {"stats": a_agent.heartbeat_stats()})
+            await h.cp.registry.heartbeat("node-b", {"stats": b_agent.heartbeat_stats()})
+            doc = await _gen(
+                h, "node-b.generate", {"tokens": shared + [3, 4], "max_new_tokens": 4}
+            )
+            assert doc["nodes_tried"][-1] == "node-a"
+            h.cp.gateway.prefix_affinity = False
+            doc2 = await _gen(
+                h, "node-b.generate", {"tokens": shared + [5, 6], "max_new_tokens": 4}
+            )
+            assert doc2["nodes_tried"][-1] == "node-b"
+        finally:
+            await _stop_nodes((a_agent, a_back), (b_agent, b_back))

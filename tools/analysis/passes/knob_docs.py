@@ -8,9 +8,11 @@ field list is read from the AST):
 - every ``EngineConfig`` dataclass field must appear in docs/*.md (the
   reference table in docs/ARCHITECTURE.md);
 - every ``AGENTFIELD_*`` environment variable mentioned by
-  ``control_plane/*.py`` or ``ops/**`` sources must appear in docs/*.md —
-  operators learn knobs from OPERATIONS.md (and kernel knobs from
-  KERNELS.md), not from grepping the tree.
+  ``control_plane/*.py``, ``serving/*.py`` or ``ops/**`` sources must appear
+  in docs/*.md — operators learn knobs from OPERATIONS.md (and kernel knobs
+  from KERNELS.md), not from grepping the tree. (``serving`` joined the scan
+  with the cluster prefix tier: AGENTFIELD_KV_FETCH and the sketch-bytes
+  override are node-side reads.)
 
 Allowlist: ``knob_allow`` entries for env vars the control plane reads but
 operators never set (test scaffolding); empty on purpose today.
@@ -44,7 +46,7 @@ class KnobDocsPass(Pass):
     @staticmethod
     def _env_scanned(rel: str) -> bool:
         parts = rel.split("/")
-        return "control_plane" in parts or "ops" in parts
+        return "control_plane" in parts or "ops" in parts or "serving" in parts
 
     def relevant(self, rel: str) -> bool:
         return rel == _ENGINE_REL or self._env_scanned(rel)

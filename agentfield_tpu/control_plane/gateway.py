@@ -28,6 +28,7 @@ from typing import Any
 
 import aiohttp
 
+from agentfield_tpu.branching import validate_branch_spec
 from agentfield_tpu.prefix_hash import page_chain_hashes, sketch_digest
 
 from agentfield_tpu.control_plane import faults
@@ -303,6 +304,8 @@ class ExecutionGateway:
         retry_policy: dict[str, Any] | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy: Any = None,
     ) -> tuple[Execution, AgentNode]:
         """Parse target, resolve node+component, persist the execution record
         (reference: prepareExecution, execute.go:641)."""
@@ -310,6 +313,16 @@ class ExecutionGateway:
             retry_policy = RetryPolicy.validate(retry_policy)
         if isinstance(priority, bool) or not isinstance(priority, int):
             raise GatewayError(400, f"priority must be an integer, got {priority!r}")
+        try:
+            # Branch decoding (test-time scaling): one shared validation
+            # contract with the model node (agentfield_tpu.branching) —
+            # reject malformed specs HERE with a 400 instead of burning a
+            # dispatch to fail on the node.
+            n_branches, branch_policy = validate_branch_spec(
+                n_branches, branch_policy
+            )
+        except ValueError as e:
+            raise GatewayError(400, str(e)) from None
         if deadline_s is not None and (
             isinstance(deadline_s, bool)
             or not isinstance(deadline_s, (int, float))
@@ -369,6 +382,8 @@ class ExecutionGateway:
             retry_policy=retry_policy,
             priority=priority,
             deadline_s=float(deadline_s) if deadline_s is not None else None,
+            n_branches=n_branches,
+            branch_policy=branch_policy,
         )
         try:
             # Freshly-minted ids skip the journal's duplicate table probe
@@ -499,7 +514,13 @@ class ExecutionGateway:
             hint = self._kv_hints.get(ex.execution_id)
             if hint is not None and hint.get("node_id") == node.node_id:
                 hint = None
-            if ex.priority or ex.deadline_s is not None or hint is not None:
+            branched = ex.n_branches > 1
+            if (
+                ex.priority
+                or ex.deadline_s is not None
+                or hint is not None
+                or branched
+            ):
                 agent_input = dict(agent_input)
                 if ex.priority:
                     agent_input.setdefault("priority", ex.priority)
@@ -508,6 +529,13 @@ class ExecutionGateway:
                     agent_input.setdefault("deadline_s", max(remaining, 0.001))
                 if hint is not None:
                     agent_input.setdefault("kv_peer", hint)
+                if branched:
+                    # Branch decoding rides THROUGH dispatch like priority/
+                    # deadline: the engine forks KV after one prefill and
+                    # the node returns only the winner.
+                    agent_input.setdefault("n_branches", ex.n_branches)
+                    if ex.branch_policy is not None:
+                        agent_input.setdefault("branch_policy", ex.branch_policy)
         return agent_input
 
     # -- streaming data plane hooks (channel.py calls back into these) --
@@ -910,6 +938,8 @@ class ExecutionGateway:
         retry_policy: dict[str, Any] | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy: Any = None,
     ) -> Execution:
         """Sync path: call agent (with retry/failover), then wait on the
         event bus until the execution reaches a terminal state
@@ -917,6 +947,7 @@ class ExecutionGateway:
         ex, node = await self._prepare(
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
+            n_branches=n_branches, branch_policy=branch_policy,
         )
         done = await self._dispatch(ex, node)
         if done is not None and done.status.terminal:
@@ -945,6 +976,8 @@ class ExecutionGateway:
         retry_policy: dict[str, Any] | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy: Any = None,
     ) -> tuple[Execution, StreamSubscription]:
         """Streaming sync path: prepare + subscribe to the execution's frame
         stream FIRST (so frame 0 is never missed), then drive dispatch in
@@ -952,10 +985,13 @@ class ExecutionGateway:
         them — first byte at TTFT — and the stream always ends with exactly
         one terminal frame (the execution's terminal state). Channel-less
         targets degrade gracefully: the subscription just carries the one
-        terminal frame when the POST completes."""
+        terminal frame when the POST completes. Branched executions
+        (n_branches > 1) stream GROUP-AWARE: only the winner's tokens are
+        ever emitted, at group resolution."""
         ex, node = await self._prepare(
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
+            n_branches=n_branches, branch_policy=branch_policy,
         )
         sub = self.streams.attach(ex.execution_id)
 
@@ -999,6 +1035,8 @@ class ExecutionGateway:
         retry_policy: dict[str, Any] | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy: Any = None,
         stream: bool = False,  # open the execution's frame stream now so a
         # later GET /executions/{id}/stream attach replays every token
         # (channel-served targets only; without it async work streams
@@ -1013,6 +1051,7 @@ class ExecutionGateway:
         ex, _node = await self._prepare(
             target, payload, headers, webhook_url, ExecutionStatus.QUEUED,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
+            n_branches=n_branches, branch_policy=branch_policy,
         )
         if stream:
             # BEFORE the enqueue: a worker may dispatch immediately, and the

@@ -14,8 +14,10 @@ offload family ``kv_offload_{demoted,restored,restore_fail,host_pages}``
 (docs/PREFIX_CACHING.md "Tiered cache"), the cluster-tier transfer family
 ``kv_fetch_{requested,served,failed,bytes,pages_adopted}_total`` +
 ``prefix_sketch_truncated_total`` (docs/PREFIX_CACHING.md "Cluster tier"),
-and the scheduler-latency gauges
-``itl_ms_p50``/``itl_ms_p99``/``tokens_per_tick`` from the mixed
+the branch-decoding family
+``branch_{forks,forks_degraded,fork_failed,pruned,verifier_calls}_total``
+(docs/PREFIX_CACHING.md "Fork / COW branches"), and the scheduler-latency
+gauges ``itl_ms_p50``/``itl_ms_p99``/``tokens_per_tick`` from the mixed
 token-budget scheduler, docs/MIXED_SCHEDULING.md) are re-exported here by
 the registry via :func:`export_engine_stats`, so one control-plane
 /metrics scrape covers the whole fleet's cache and scheduling behavior.

@@ -547,6 +547,8 @@ def create_app(cp: ControlPlane) -> web.Application:
                 retry_policy=body.get("retry_policy"),
                 priority=0 if body.get("priority") is None else body["priority"],
                 deadline_s=body.get("deadline_s"),
+                n_branches=1 if body.get("n_branches") is None else body["n_branches"],
+                branch_policy=body.get("branch_policy"),
             )
         except GatewayError as e:
             return _json_error(e.status, e.message, retry_after=e.retry_after)
@@ -580,6 +582,8 @@ def create_app(cp: ControlPlane) -> web.Application:
                 retry_policy=body.get("retry_policy"),
                 priority=0 if body.get("priority") is None else body["priority"],
                 deadline_s=body.get("deadline_s"),
+                n_branches=1 if body.get("n_branches") is None else body["n_branches"],
+                branch_policy=body.get("branch_policy"),
             )
         except _BadBody as e:
             return _json_error(400, str(e))
@@ -606,6 +610,8 @@ def create_app(cp: ControlPlane) -> web.Application:
                 retry_policy=body.get("retry_policy"),
                 priority=0 if body.get("priority") is None else body["priority"],
                 deadline_s=body.get("deadline_s"),
+                n_branches=1 if body.get("n_branches") is None else body["n_branches"],
+                branch_policy=body.get("branch_policy"),
                 stream=bool(body.get("stream")),
             )
         except GatewayError as e:

@@ -161,6 +161,12 @@ class Execution:
     # deadline-out the request mid-queue or mid-decode.
     priority: int = 0
     deadline_s: float | None = None
+    # Branch decoding (test-time scaling, docs/PREFIX_CACHING.md "Fork /
+    # COW branches"): validated at the gateway like priority/deadline_s and
+    # injected into a model node's generate input — the engine forks the
+    # request's KV after one prefill and returns only the winner.
+    n_branches: int = 1
+    branch_policy: Any = None
     # Streaming data plane (docs/ARCHITECTURE.md): token frames already
     # delivered to the client-visible stream when this execution went
     # terminal. Non-zero means the execution may never be transparently
@@ -199,6 +205,10 @@ class Execution:
             "retry_policy": dict(self.retry_policy) if self.retry_policy else self.retry_policy,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
+            "n_branches": self.n_branches,
+            "branch_policy": dict(self.branch_policy)
+            if isinstance(self.branch_policy, dict)
+            else self.branch_policy,
             "frames_delivered": self.frames_delivered,
         }
 

@@ -13,20 +13,16 @@ deep-importing kernel module paths:
   (kernel_autotune.py, ``AGENTFIELD_KERNEL_AUTOTUNE``)
 - ``flash_attention`` — dense prefill flash kernel
 
-The four pre-ragged kernels (decode ``paged_attention_pallas``, chunk
+The four pre-ragged kernel names (decode ``paged_attention_pallas``, chunk
 ``paged_chunk_attention_pallas``, batched-chunk
 ``paged_batch_chunk_attention_pallas``/``_ref``, decode-append
-``kv_write_pallas``/``kv_write``) are DEPRECATED shims for one release:
-same signatures and results, now served by the ragged reference math
-(their specialized Mosaic lowerings are gone — new code uses the ragged
-kernel, which also covers every one of their shapes).
+``kv_write_pallas``/``kv_write``) were deprecation shims for one release
+after the ragged consolidation and are now REMOVED — every shape they
+served is a ragged-row mix (docs/KERNELS.md maps the old call forms onto
+``ragged_paged_attention``).
 """
 
 from __future__ import annotations
-
-import warnings
-
-import jax.numpy as jnp
 
 from agentfield_tpu.ops.paged_attention import (  # noqa: F401
     RaggedRows,
@@ -54,125 +50,4 @@ __all__ = [
     "ragged_paged_attention",
     "ragged_paged_attention_pallas",
     "ragged_paged_attention_ref",
-    # deprecated shims
-    "kv_write",
-    "kv_write_pallas",
-    "paged_attention_pallas",
-    "paged_batch_chunk_attention_pallas",
-    "paged_batch_chunk_attention_ref",
-    "paged_chunk_attention_pallas",
 ]
-
-
-def _warn(old: str) -> None:
-    warnings.warn(
-        f"agentfield_tpu.ops.pallas.{old} is deprecated; use "
-        "ragged_paged_attention (one ragged kernel, fused KV write) — "
-        "removed next release",
-        DeprecationWarning,
-        stacklevel=3,
-    )
-
-
-def _identity_new_kv(k_pages, v_pages, page_tables, pos, valid):
-    """Gather the K/V already AT the query positions so the ragged path's
-    fused write is a no-op re-write of identical values (the legacy kernels
-    attended pools their callers had pre-written)."""
-    ps = k_pages.shape[2]
-    maxp = page_tables.shape[1]
-    lookup = pos // ps
-    page_ids = jnp.where(
-        (lookup < maxp) & valid,
-        jnp.take_along_axis(page_tables, jnp.minimum(lookup, maxp - 1), axis=1),
-        0,
-    )
-    slot_ids = pos % ps
-    return k_pages[page_ids, :, slot_ids], v_pages[page_ids, :, slot_ids]
-
-
-def _legacy_batch_chunk(
-    q, k_pages, v_pages, page_tables, starts, k_lens, sm_scale, window
-):
-    B, W, H, hd = q.shape
-    pos = starts[:, None] + jnp.arange(W, dtype=jnp.int32)[None]
-    n_tokens = jnp.clip(k_lens - starts, 0, W).astype(jnp.int32)
-    valid = jnp.arange(W, dtype=jnp.int32)[None] < n_tokens[:, None]
-    k_new, v_new = _identity_new_kv(k_pages, v_pages, page_tables, pos, valid)
-    out, _, _ = ragged_paged_attention_ref(
-        q, k_new, v_new, k_pages, v_pages, page_tables,
-        starts.astype(jnp.int32), n_tokens, starts.astype(jnp.int32),
-        jnp.arange(B, dtype=jnp.int32), sm_scale=sm_scale, window=window,
-    )
-    return out
-
-
-def paged_attention_pallas(
-    q, k_pages, v_pages, page_tables, seq_lens, sm_scale=None,
-    interpret=False, window=None,
-):
-    """DEPRECATED decode-only attention over a pre-written pool."""
-    del interpret
-    _warn("paged_attention_pallas")
-    pos = jnp.maximum(seq_lens.astype(jnp.int32) - 1, 0)
-    return _legacy_batch_chunk(
-        q[:, None], k_pages, v_pages, page_tables, pos, seq_lens,
-        sm_scale, window,
-    )[:, 0]
-
-
-def paged_batch_chunk_attention_ref(
-    q, k_pages, v_pages, page_tables, starts, k_lens, sm_scale=None,
-    window=None,
-):
-    """DEPRECATED batched ragged-window attention (pool pre-written)."""
-    _warn("paged_batch_chunk_attention_ref")
-    return _legacy_batch_chunk(
-        q, k_pages, v_pages, page_tables, starts, k_lens, sm_scale, window
-    )
-
-
-def paged_batch_chunk_attention_pallas(
-    q, k_pages, v_pages, page_tables, starts, k_lens, sm_scale=None,
-    interpret=False, window=None,
-):
-    """DEPRECATED batched ragged-window attention (pool pre-written)."""
-    del interpret
-    _warn("paged_batch_chunk_attention_pallas")
-    return _legacy_batch_chunk(
-        q, k_pages, v_pages, page_tables, starts, k_lens, sm_scale, window
-    )
-
-
-def paged_chunk_attention_pallas(
-    q, k_pages, v_pages, page_table_row, start, k_len, sm_scale=None,
-    interpret=False, window=None,
-):
-    """DEPRECATED single-sequence chunk attention (pool pre-written)."""
-    del interpret
-    _warn("paged_chunk_attention_pallas")
-    return _legacy_batch_chunk(
-        q[None], k_pages, v_pages, page_table_row[None],
-        jnp.asarray(start, jnp.int32)[None], jnp.asarray(k_len, jnp.int32)[None],
-        sm_scale, window,
-    )[0]
-
-
-def kv_write(k_pages, v_pages, k_new, v_new, page_idx, slot_idx, impl="ref", mesh=None):
-    """DEPRECATED decode-step KV append (the ragged kernel fuses this)."""
-    del mesh
-    _warn("kv_write")
-    if impl not in ("ref", "pallas"):
-        raise ValueError(f"unknown kv_write impl {impl!r}")
-    k_pages = k_pages.at[page_idx, :, slot_idx].set(k_new)
-    v_pages = v_pages.at[page_idx, :, slot_idx].set(v_new)
-    return k_pages, v_pages
-
-
-def kv_write_pallas(k_pages, v_pages, k_new, v_new, page_idx, slot_idx, interpret=False):
-    """DEPRECATED per-page patch kernel (single-row writes only — the
-    restriction the ragged kernel's idempotent patch phase removed)."""
-    del interpret
-    _warn("kv_write_pallas")
-    k_pages = k_pages.at[page_idx, :, slot_idx].set(k_new)
-    v_pages = v_pages.at[page_idx, :, slot_idx].set(v_new)
-    return k_pages, v_pages

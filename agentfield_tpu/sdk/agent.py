@@ -556,6 +556,15 @@ class Agent:
         deadline_s: float | None = None,  # wall-clock budget from submit;
         # the gateway sheds the call (TIMEOUT) if it expires pre-dispatch
         # and forwards the REMAINING budget to the engine.
+        n_branches: int = 1,  # test-time scaling (docs/PREFIX_CACHING.md
+        # "Fork / COW branches"): the ENGINE forks the request's KV after
+        # one prefill into this many branches, decodes them as batch-mates,
+        # prunes per branch_policy, and returns only the winner — the
+        # result gains a "branches" summary block. Text-only.
+        branch_policy: Any = None,  # "best_of_n" (default) | "beam" | a
+        # {"type", "verifier", "beam_width", "beam_interval"} object; a
+        # "verifier" names a reasoner target the node dispatches candidate
+        # texts to (through the gateway) instead of scoring by logprob sum.
         stream: bool = False,  # token streaming THROUGH the gateway: returns
         # an async iterator of frames instead of the result dict — token
         # frames from TTFT, then one {"terminal": True, "result": ...} frame.
@@ -608,6 +617,11 @@ class Agent:
             if not messages:
                 raise ValueError("messages must be non-empty")
             messages = [dict(m) for m in messages]  # appends stay caller-invisible
+        if n_branches != 1 and (schema is not None or images or audio or output != "text"):
+            raise ValueError(
+                "ai(n_branches=...) is text-only branch decoding; schema/"
+                "media/output modes use an unbranched call"
+            )
         if stream:
             if schema is not None or images or audio or files or output != "text":
                 raise ValueError(
@@ -619,6 +633,7 @@ class Agent:
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
                 timeout=timeout, priority=priority, deadline_s=deadline_s,
+                n_branches=n_branches, branch_policy=branch_policy,
             )
 
         def _carrier_text() -> str | None:
@@ -749,6 +764,8 @@ class Agent:
                         timeout=timeout,
                         priority=priority,
                         deadline_s=deadline_s,
+                        n_branches=n_branches,
+                        branch_policy=branch_policy,
                     )
                 except ControlPlaneError as e:
                     has_next = ci + 1 < len(candidates)
@@ -822,6 +839,7 @@ class Agent:
     async def _ai_stream_frames(
         self, *, prompt, tokens, messages, model, max_new_tokens, temperature,
         top_k, top_p, stop_token_ids, timeout, priority, deadline_s,
+        n_branches=1, branch_policy=None,
     ):
         """ai(stream=True) driver: token frames through the gateway's
         streaming execute, with node-down failover across model candidates
@@ -853,6 +871,8 @@ class Agent:
                     timeout=timeout,
                     priority=priority,
                     deadline_s=deadline_s,
+                    n_branches=n_branches,
+                    branch_policy=branch_policy,
                 ):
                     kind = frame.get("kind")
                     if kind == "token":

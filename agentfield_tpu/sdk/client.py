@@ -108,6 +108,8 @@ class ControlPlaneClient:
         webhook_url: str | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy=None,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         kw: dict[str, Any] = {}
@@ -121,6 +123,10 @@ class ControlPlaneClient:
             body["priority"] = priority
         if deadline_s is not None:
             body["deadline_s"] = deadline_s
+        if n_branches != 1:
+            body["n_branches"] = n_branches
+        if branch_policy is not None:
+            body["branch_policy"] = branch_policy
         return await self._req(
             "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}, **kw
         )
@@ -133,6 +139,8 @@ class ControlPlaneClient:
         webhook_url: str | None = None,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy=None,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         if webhook_url:
@@ -141,6 +149,10 @@ class ControlPlaneClient:
             body["priority"] = priority
         if deadline_s is not None:
             body["deadline_s"] = deadline_s
+        if n_branches != 1:
+            body["n_branches"] = n_branches
+        if branch_policy is not None:
+            body["branch_policy"] = branch_policy
         return await self._req(
             "POST", f"/api/v1/execute/async/{target}", json=body, headers=headers or {}
         )
@@ -153,6 +165,8 @@ class ControlPlaneClient:
         timeout: float = 600.0,
         priority: int = 0,
         deadline_s: float | None = None,
+        n_branches: int = 1,
+        branch_policy=None,
     ):
         """Streaming sync execute (`stream=true`): yields the control
         plane's SSE frames as dicts — a `start` frame with the execution id,
@@ -167,6 +181,10 @@ class ControlPlaneClient:
             body["priority"] = priority
         if deadline_s is not None:
             body["deadline_s"] = deadline_s
+        if n_branches != 1:
+            body["n_branches"] = n_branches
+        if branch_policy is not None:
+            body["branch_policy"] = branch_policy
         if timeout is not None:
             body["timeout"] = timeout
         s = await self._s()

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentfield_tpu.branching import branch_rid
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
 from agentfield_tpu.ops.paged_attention import ragged_paged_attention
@@ -281,6 +282,19 @@ class Request:
     # sequence; sampling.max_new_tokens was already decremented by the same
     # amount. 0 for every caller-submitted request.
     resumed_from: int = 0
+    # Branch decoding (test-time scaling, docs/PREFIX_CACHING.md "Fork / COW
+    # branches"): when > 1, the request FORKS into this many sibling
+    # branches the moment its prefill completes — siblings share the
+    # prompt's full KV pages copy-on-write (incref, no re-prefill, no H2D),
+    # only the partial tail page is copied, and each branch samples its
+    # first token from the same last-prompt-token logits under its own RNG
+    # stream. Siblings decode as ordinary batch-mates (ids
+    # ``branching.branch_rid(id, j)``; branch 0 keeps this id and is
+    # token-exact vs the unforked request under greedy). Pruning/scoring
+    # lives OUTSIDE the engine (branching.BranchGroup drives request_cancel
+    # / request_fork). Exclusive with grammar/mm_embeds; sibling clones
+    # drop session_id (N branches must not fight over one session entry).
+    n_branches: int = 1
 
 
 @dataclasses.dataclass
@@ -1205,6 +1219,23 @@ class InferenceEngine:
             # deadline_exceeded; queue-time overload signal)
             "preempt_storm_injected": 0,  # forced preemptions from the
             # engine.preempt_storm fault point (chaos testing)
+            # Branch decoding (docs/PREFIX_CACHING.md "Fork / COW
+            # branches") — always present so the stats→heartbeat→/metrics
+            # pipeline carries the family even on nodes that never branch:
+            "branch_forks_total": 0,  # sibling slots forked (install-time
+            # N-way forks + beam reforks) — each shared the parent's full
+            # KV pages instead of re-prefilling
+            "branch_forks_degraded_total": 0,  # install-time forks that
+            # found no slot/pages and fell back to the pending queue (the
+            # sibling re-admits through the prefix index — correct, just
+            # not free); a sustained nonzero means branch fan-out exceeds
+            # engine capacity (docs/OPERATIONS.md "Branch decoding")
+            "branch_fork_failed_total": 0,  # live reforks (beam) refused —
+            # source finished or no capacity; the group continues narrower
+            "branch_pruned_total": 0,  # branches cancelled by a pruning
+            # policy (their pages freed through the request_cancel path)
+            "branch_verifier_calls_total": 0,  # group resolutions scored by
+            # a control-plane verifier reasoner instead of logprob sum
         }
         # Cross-request sharing rides on the session prefix-cache switch: one
         # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
@@ -1256,6 +1287,12 @@ class InferenceEngine:
         # the worker thread — mutating slots from other threads mid-step
         # would race the decode batch.
         self._cancels: set[str] = set()
+        # Live-fork commands (branch decoding): (src_id, new_id) pairs from
+        # request_fork(), applied inside step() on the scheduler thread —
+        # cloning a slot from another thread would race the decode batch.
+        # Guarded by _pending_lock (same cross-thread discipline as
+        # _deadline_at).
+        self._fork_cmds: list[tuple[str, str]] = []  # guarded by: _pending_lock
         # Request deadlines: id -> monotonic expiry (written at submit under
         # _pending_lock, scanned at the top of step()). Expired ids cancel
         # through the normal _cancels path and emit a terminal
@@ -1405,6 +1442,19 @@ class InferenceEngine:
             raise ValueError(
                 f"request {req.id}: deadline_s={req.deadline_s} must be a "
                 "positive finite number"
+            )
+        if type(req.n_branches) is not int or req.n_branches < 1:
+            raise ValueError(
+                f"request {req.id}: n_branches must be an int >= 1 "
+                f"(got {req.n_branches!r})"
+            )
+        if req.n_branches > 1 and (req.grammar is not None or req.mm_embeds):
+            # A mid-schema DFA state cannot be forked through first-token
+            # re-sampling, and mm prompts are excluded from every KV-reuse
+            # path the fork rides — both admit fine unbranched.
+            raise ValueError(
+                f"request {req.id}: n_branches > 1 is incompatible with "
+                "grammar-constrained or multimodal requests"
             )
         if type(req.priority) is not int:  # bool included: True < 2 would
             # "work" but a flag is never a tier — and a non-int raising
@@ -1663,6 +1713,10 @@ class InferenceEngine:
             or self.num_active > 0
             or self._inflight is not None
             or bool(self._prefill_jobs)
+            # Queued live-fork commands need a step to apply (or to emit
+            # their fork_failed terminal) — an idle drive loop must not
+            # sleep through them.
+            or bool(self._fork_cmds)  # afcheck: ignore[guarded-by] racy truthiness peek like _cancels: a missed append is caught by the next wake, never lost
         )
 
     def _slots_available(self) -> int:
@@ -1846,6 +1900,10 @@ class InferenceEngine:
                 self.ecfg.prefill_chunk is not None
                 and len(req.prompt) > self.ecfg.prefill_chunk
             )
+            # Branched requests take the single path: the fork needs the
+            # last-prompt-token logits, which the batched prefill's padded
+            # multi-row form does not keep per-request.
+            chunked = chunked or req.n_branches > 1
             with self._session_lock:
                 # one hold covers both probes: the has_sess membership test
                 # races gc_sessions/free_session on other threads otherwise
@@ -1921,7 +1979,7 @@ class InferenceEngine:
             row = build_page_table(pages, self.ecfg.max_pages_per_seq)
             last_logits = self._prefill(req.prompt, 0, row)
             self.stats["prefill_tokens"] += len(req.prompt)
-            return [self._sample_first_and_install(req, slot_idx, pages, row, last_logits)]
+            return self._sample_first_and_install(req, slot_idx, pages, row, last_logits)
         return self._admit_batch(batch)
 
     def _admit_batch(self, batch: list[tuple[Request, int, list[int]]]) -> list[TokenEvent]:
@@ -2135,11 +2193,11 @@ class InferenceEngine:
         self.stats["prefill_tokens"] += len(req.prompt) - start
         with self._telemetry_lock:
             self._tick_tokens.append(len(req.prompt) - start)
-        return [self._sample_first_and_install(req, free_slot, pages, row, last_logits)]
+        return self._sample_first_and_install(req, free_slot, pages, row, last_logits)
 
     def _sample_first_and_install(
         self, req: Request, slot_idx: int, pages: list[int], row: np.ndarray, last_logits
-    ) -> TokenEvent:
+    ) -> list[TokenEvent]:
         s = req.sampling
         masked = self._first_token_mask(req)
         sample_from = (
@@ -2156,7 +2214,98 @@ class InferenceEngine:
         )
         tok = int(tok_arr[0])
         first_logprob = float(jax.nn.log_softmax(last_logits)[tok])
-        return self._install(req, slot_idx, pages, row, tok, first_logprob)
+        if req.n_branches <= 1:
+            return [self._install(req, slot_idx, pages, row, tok, first_logprob)]
+        # Branch fork (docs/PREFIX_CACHING.md "Fork / COW branches").
+        # Ordering matters twice: branch 0 sampled FIRST (above) so its RNG
+        # position — and therefore its tokens under greedy AND sampling —
+        # is bit-identical to the unforked request; siblings fork BEFORE
+        # branch 0 installs, while admission still owns `pages`, so a
+        # branch 0 that finishes on its first token (stop id) cannot free
+        # the prompt pages out from under the incref.
+        sibling_events = self._fork_at_install(req, slot_idx, pages, last_logits)
+        ev0 = self._install(req, slot_idx, pages, row, tok, first_logprob)
+        return [ev0] + sibling_events
+
+    def _fork_at_install(
+        self, req: Request, parent_slot: int, parent_pages: list[int], last_logits
+    ) -> list[TokenEvent]:
+        """Fork ``req.n_branches - 1`` sibling branches off a just-prefilled
+        prompt: each shares the prompt's FULL pages copy-on-write (incref —
+        no re-prefill, no H2D), privately copies the partial tail page
+        (decode writes land there), samples its first token from the same
+        last-prompt-token logits under its own RNG stream, and installs as
+        an ordinary decode batch-mate. A sibling that finds no free slot or
+        pages degrades to the pending queue instead (``senior=True`` so it
+        re-admits next — through the prefix index branch 0's install is
+        about to publish, paying only the tail-suffix re-prefill)."""
+        ps = self.ecfg.page_size
+        L = len(req.prompt)
+        full = L // ps
+        total = self._pages_needed(req)
+        lsm = None  # log-softmax of the prompt logits, computed once
+        events: list[TokenEvent] = []
+        s = req.sampling
+        with self._pending_lock:
+            # Every branch shares the parent's submit-time deadline window
+            # (the parent's expiry was registered at submit()).
+            parent_exp = self._deadline_at.get(req.id)
+        for j in range(1, req.n_branches):
+            sub = dataclasses.replace(
+                req, id=branch_rid(req.id, j), n_branches=1, session_id=None
+            )
+            slot_idx = next(
+                (
+                    i
+                    for i, sl in enumerate(self.slots)
+                    if sl is None and i != parent_slot
+                ),
+                None,
+            )
+            pages_j = fresh = None
+            if slot_idx is not None and self._slots_available() > 1:
+                # > 1: this fork must not consume the last slot a mixed
+                # prefill job reserved (branch 0's own slot was already
+                # claimed by admission before jobs could reserve it).
+                with self._session_lock:
+                    fresh = self._alloc_with_eviction(total - full)
+                    if fresh is not None:
+                        self.allocator.incref(parent_pages[:full])
+                        pages_j = parent_pages[:full] + fresh
+            if pages_j is None:
+                # Degraded fork: no slot/pages right now — re-admit through
+                # the queue. Correct (the published prompt prefix makes it
+                # an index hit), just not free; the counter is the operator
+                # signal that fan-out exceeds capacity.
+                with self._pending_lock:
+                    self._enqueue_locked(sub, senior=True)
+                    if parent_exp is not None:
+                        self._deadline_at[sub.id] = parent_exp
+                self.stats["branch_forks_degraded_total"] += 1
+                continue
+            if L % ps:
+                # The only page whose prompt KV the sibling still READS but
+                # whose remaining slots its decode will WRITE: private copy.
+                self._copy_page(parent_pages[full], fresh[0])
+            row_j = build_page_table(pages_j, self.ecfg.max_pages_per_seq)
+            tok_arr = sample_tokens(
+                last_logits[None],
+                self._next_rng(),  # distinct per-branch RNG stream
+                jnp.asarray([s.temperature], jnp.float32),
+                jnp.asarray([s.top_k], jnp.int32),
+                jnp.asarray([s.top_p], jnp.float32),
+            )
+            tok_j = int(tok_arr[0])
+            if lsm is None:
+                lsm = jax.nn.log_softmax(last_logits)
+            if parent_exp is not None:
+                with self._pending_lock:
+                    self._deadline_at[sub.id] = parent_exp
+            events.append(
+                self._install(sub, slot_idx, pages_j, row_j, tok_j, float(lsm[tok_j]))
+            )
+            self.stats["branch_forks_total"] += 1
+        return events
 
     def _copy_page(self, src: int, dst: int) -> None:
         """Copy-on-write: duplicate page `src` into `dst` (all layers), on the
@@ -2521,6 +2670,111 @@ class InferenceEngine:
         that no longer exists must not keep decoding."""
         self._cancels.add(request_id)
 
+    def request_fork(self, src_id: str, new_id: str) -> None:
+        """Fork a LIVE slot mid-decode (branch decoding's beam re-fork,
+        docs/PREFIX_CACHING.md "Fork / COW branches"): at the next step()
+        the source slot's KV is cloned copy-on-write — full pages incref'd,
+        the partial tail page copied — into a new slot continuing from the
+        same state under ``new_id``; its sampling diverges through the
+        decode step's per-row RNG, and its TokenEvent indexes continue from
+        the source's generated count (the consumer reads the fork point off
+        the first event). If the source is gone or capacity is lacking when
+        the command drains, the engine emits a terminal
+        ``finish_reason="fork_failed"`` event for ``new_id`` so the caller's
+        group accounting never hangs. Thread-safe."""
+        with self._pending_lock:
+            self._fork_cmds.append((src_id, new_id))
+
+    def _apply_forks(self) -> list[TokenEvent]:
+        """Drain queued live-fork commands (scheduler thread; the decode
+        pipeline was harvested by the caller so slot state is current)."""
+        with self._pending_lock:
+            cmds, self._fork_cmds = self._fork_cmds, []
+        events: list[TokenEvent] = []
+        for src, new in cmds:
+            if not self._fork_live(src, new):
+                self.stats["branch_fork_failed_total"] += 1
+                events.append(
+                    TokenEvent(
+                        request_id=new, token=-1, index=-1, finished=True,
+                        finish_reason="fork_failed",
+                    )
+                )
+        return events
+
+    def _fork_live(self, src_id: str, new_id: str) -> bool:
+        """Clone the live slot running ``src_id`` into a free slot under
+        ``new_id``. Written KV = the source's first ``slot.length``
+        positions: full pages are shared copy-on-write (decode writes land
+        strictly past them), the partial tail page — which the clone both
+        reads and will write — is privately copied. The clone's pending
+        last token decodes independently from the next step on."""
+        found = next(
+            (
+                (i, s)
+                for i, s in enumerate(self.slots)
+                if s is not None and s.req.id == src_id
+            ),
+            None,
+        )
+        if found is None:
+            return False
+        si, slot = found
+        if slot.req.grammar is not None or slot.req.mm_embeds:
+            return False  # same exclusions as install-time forking
+        slot_idx = next(
+            (i for i, s in enumerate(self.slots) if s is None), None
+        )
+        if slot_idx is None or self._slots_available() <= 0:
+            return False
+        ps = self.ecfg.page_size
+        written = slot.length  # positions 0..length-1 hold KV (the pending
+        # last token's KV is written by the NEXT decode step)
+        full = written // ps
+        total = len(slot.pages)
+        with self._session_lock:
+            fresh = self._alloc_with_eviction(total - full)
+            if fresh is None:
+                return False
+            self.allocator.incref(slot.pages[:full])
+        pages = slot.pages[:full] + fresh
+        if written % ps:
+            self._copy_page(slot.pages[full], fresh[0])
+        child_req = dataclasses.replace(
+            slot.req, id=new_id, n_branches=1, session_id=None
+        )
+        child = _Slot(
+            req=child_req,
+            pages=pages,
+            length=slot.length,
+            generated=slot.generated,
+            last_token=slot.last_token,
+            tokens=list(slot.tokens),
+            draft_len=slot.draft_len,
+        )
+        self.slots[slot_idx] = child
+        self.page_tables[slot_idx] = build_page_table(
+            pages, self.ecfg.max_pages_per_seq
+        )
+        self.seq_lens[slot_idx] = child.length
+        self.last_tokens[slot_idx] = child.last_token
+        s = child_req.sampling
+        self.temps[slot_idx] = s.temperature
+        self.top_ks[slot_idx] = s.top_k
+        self.top_ps[slot_idx] = s.top_p
+        self.grammar_states[slot_idx] = 0
+        self.eos_ids[slot_idx] = -1
+        with self._pending_lock:
+            # The clone inherits the source's remaining wall-clock budget:
+            # a deadline-carrying group must not grow immortal branches.
+            exp = self._deadline_at.get(src_id)
+            if exp is not None:
+                self._deadline_at[new_id] = exp
+        self._dirty = True
+        self._compact = None  # membership changed
+        self.stats["branch_forks_total"] += 1
+        return True
+
     def live_request_ids(self) -> list[str]:
         """Ids the engine currently holds (pending + mid-prefill + active).
         Advisory from other threads (defensive copies): the authoritative
@@ -2820,6 +3074,10 @@ class InferenceEngine:
                 max_new_tokens=req.sampling.max_new_tokens - slot.generated,
             ),
             resumed_from=req.resumed_from + slot.generated,
+            # Branch forking is a ONE-TIME install event: a preempted group
+            # parent resumes as the single branch it now is — re-forking on
+            # resume would mint sibling ids that collide with live branches.
+            n_branches=1,
         )
         with self._pending_lock:
             # Front of its priority tier: the victim keeps its seniority —
@@ -2841,9 +3099,11 @@ class InferenceEngine:
 
     def _mixed_eligible(self, req: Request) -> bool:
         """Mixed prefill jobs carry plain token prompts only: grammar
-        first-token masks and multimodal inject buffers are classic-tick
-        features (such requests still admit through the classic path)."""
-        return req.grammar is None and not req.mm_embeds
+        first-token masks, multimodal inject buffers, and branch forks
+        (which need the prompt's last-token logits — a mixed tick reads
+        back only sampled tokens) are classic-tick features (such requests
+        still admit through the classic path)."""
+        return req.grammar is None and not req.mm_embeds and req.n_branches <= 1
 
     def _mixed_tick_ready(self) -> bool:
         """Should this tick run the packed mixed dispatch? Yes while prefill
@@ -3084,11 +3344,16 @@ class InferenceEngine:
         before any re-use of the freed pages)."""
         events: list[TokenEvent] = []
         expired = self._expire_deadlines()  # no-op when no deadlines are set
-        if self._cancels and self._inflight is not None:
-            # Cancels mutate slots/host shadows: drain the pipeline first so
-            # a post-cancel rebuild starts from harvested (current) state.
+        if (self._cancels or self._fork_cmds) and self._inflight is not None:  # afcheck: ignore[guarded-by] racy truthiness peek like _cancels: a command landing after the peek is drained next step
+            # Cancels/forks mutate slots/host shadows: drain the pipeline
+            # first so a post-mutation rebuild starts from harvested state.
             events += self._harvest_inflight()
         self._drain_cancels(expected=set(expired))
+        if self._fork_cmds:  # afcheck: ignore[guarded-by] racy truthiness peek; _apply_forks swaps the list under the lock
+            # After cancels: a prune-then-refork burst from a branch group
+            # must see the pruned slots already freed (their pages fund the
+            # clones).
+            events += self._apply_forks()
         # Exactly-one-terminal-event: a request whose deadline expired the
         # same tick its in-flight step finished naturally just got its REAL
         # terminal from the pre-cancel harvest above — do not stack a
@@ -3456,5 +3721,7 @@ class InferenceEngine:
         while self.has_work():
             for ev in self.step():
                 if ev.token >= 0:  # deadline/error terminals carry no token
-                    results[ev.request_id].append(ev.token)
+                    # setdefault: branch forks emit under sibling ids the
+                    # caller never submitted (branching.branch_rid)
+                    results.setdefault(ev.request_id, []).append(ev.token)
         return results

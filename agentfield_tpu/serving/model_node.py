@@ -22,6 +22,7 @@ from typing import Any
 
 import jax
 
+from agentfield_tpu.branching import BranchGroup, validate_branch_spec
 from agentfield_tpu.models import get_config, init_params
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.serving.engine import (
@@ -367,6 +368,23 @@ class ModelBackend:
         # not one per request — followers await the leader's adoption and
         # let admission's ordinary lookup find the pages.
         self._kv_prefetch_inflight: dict[tuple[str, bytes], asyncio.Future] = {}
+        # Branch decoding (docs/PREFIX_CACHING.md "Fork / COW branches"):
+        # every branch rid maps to its group; the drive loop routes branch
+        # TokenEvents here INSTEAD of the per-rid future/stream sinks, the
+        # group prunes/reforks through the engine's request_cancel /
+        # request_fork paths, and resolution delivers the WINNER to the
+        # one client-visible sink (pruned branches emit no client frames).
+        self._groups: dict[str, BranchGroup] = {}
+        self._group_sinks: dict[str, tuple[str, Any]] = {}  # parent rid ->
+        # ("future", fut) | ("stream", queue)
+        self._group_meta: dict[str, dict] = {}  # parent rid -> "branches"
+        # result block, for the streaming transports to attach post-replay
+        self._group_tasks: set[asyncio.Task] = set()  # strong refs: a GC'd
+        # resolution task would strand the group's sink forever
+        # Control-plane verifier hook: async (target, payload) -> result
+        # dict, wired by build_model_node through the gateway (the control
+        # plane as a reranker); None = logprob scoring only.
+        self._verifier_call = None
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._drive_loop())
@@ -454,9 +472,18 @@ class ModelBackend:
                 for rid, q in list(self._streams.items()):
                     self._push_stream(rid, q, _error_event(rid, f"engine step failed: {e!r}"))
                 self._streams.clear()
+                for g in {id(g): g for g in self._groups.values()}.values():
+                    self._fail_group(g, f"engine step failed: {e!r}")
                 await asyncio.sleep(0.1)
                 continue
             for ev in events:
+                group = self._groups.get(ev.request_id)
+                if group is not None:
+                    # Branch events feed the group lifecycle, never a
+                    # client-visible sink directly (the winner replays at
+                    # resolution; pruned branches emit nothing).
+                    self._on_group_event(group, ev)
+                    continue
                 stream = self._streams.get(ev.request_id)
                 if stream is not None:
                     alive = self._push_stream(ev.request_id, stream, ev)
@@ -760,6 +787,10 @@ class ModelBackend:
         # admits first within the engine's fairness window; a starved
         # higher-priority request may preempt a lower-priority slot
         # (docs/FAULT_TOLERANCE.md)
+        n_branches: int = 1,  # branch decoding (test-time scaling): fork
+        # this many KV-shared branches at prefill completion; the CALLER
+        # (generate/submit_stream) owns the BranchGroup that scores and
+        # prunes them (docs/PREFIX_CACHING.md "Fork / COW branches")
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -850,6 +881,7 @@ class ModelBackend:
                     mm_embeds=mm_embeds,
                     deadline_s=deadline_s,
                     priority=priority,
+                    n_branches=n_branches,
                 )
             )
         except Exception:
@@ -1164,6 +1196,13 @@ class ModelBackend:
         output: str = "text",
         deadline_s: float | None = None,
         priority: int = 0,
+        n_branches: int = 1,  # test-time scaling (docs/PREFIX_CACHING.md
+        # "Fork / COW branches"): fork the request's KV into this many
+        # branches after ONE prefill, decode them as batch-mates, return
+        # only the winner (plus a "branches" summary block)
+        branch_policy: Any = None,  # "best_of_n" (default) | "beam" | a
+        # {"type", "verifier", "beam_width", "beam_interval"} object —
+        # branching.validate_branch_spec is the one contract definition
         kv_peer: dict | None = None,  # cluster prefix tier: gateway hint
         # naming the peer node whose sketch advertised this prompt's prefix;
         # missing pages are pulled over the channel before admission
@@ -1176,6 +1215,17 @@ class ModelBackend:
                 "(synthesize the prompt) | 'speech' (generate, then "
                 "synthesize the generated text) | 'image' (render the prompt)"
             )
+        n_branches, branch_policy = validate_branch_spec(n_branches, branch_policy)
+        if n_branches > 1:
+            if output != "text":
+                raise ValueError("branch decoding (n_branches > 1) is text-only")
+            if response_schema is not None:
+                raise ValueError(
+                    "branch decoding is incompatible with response_schema "
+                    "(constrained decoding owns the sampler mask)"
+                )
+            if images or audios:
+                raise ValueError("branch decoding does not take media inputs")
         if messages is not None:
             if prompt is not None or tokens is not None:
                 raise ValueError("messages is exclusive with prompt/tokens")
@@ -1250,6 +1300,20 @@ class ModelBackend:
         if kv_peer is not None and tokens is not None and not (images or audios):
             await self.maybe_prefetch_kv(tokens, kv_peer)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        group_holder: dict[str, BranchGroup] = {}
+        if n_branches > 1:
+            def register(r: str) -> None:
+                group_holder["g"] = self._register_group(
+                    r, n_branches, branch_policy, ("future", fut)
+                )
+
+            def unregister(r: str) -> None:
+                g = group_holder.get("g")
+                if g is not None:
+                    self._teardown_group(g)
+        else:
+            register = lambda r: self._futures.__setitem__(r, fut)  # noqa: E731
+            unregister = lambda r: self._futures.pop(r, None)  # noqa: E731
         rid, truncated = self._submit(
             prompt,
             tokens,
@@ -1258,8 +1322,8 @@ class ModelBackend:
             top_k,
             top_p,
             stop_token_ids,
-            register=lambda r: self._futures.__setitem__(r, fut),
-            unregister=lambda r: self._futures.pop(r, None),
+            register=register,
+            unregister=unregister,
             session_id=session_id,
             response_schema=response_schema,
             context_overflow=context_overflow,
@@ -1269,12 +1333,17 @@ class ModelBackend:
             prefused=prefused,
             deadline_s=deadline_s,
             priority=priority,
+            n_branches=n_branches,
         )
         try:
             result = await fut
         except asyncio.CancelledError:
             # Caller gone (gRPC deadline, disconnect): free the engine slot —
             # decoding for a dead reader wastes TPU steps and pins pages.
+            # A branch group cancels its WHOLE fan-out.
+            g = group_holder.get("g")
+            if g is not None and g.parent in self._group_sinks:
+                self._cancel_group(g)
             self._futures.pop(rid, None)
             self._buffers.pop(rid, None)
             self.cancel(rid)
@@ -1317,13 +1386,40 @@ class ModelBackend:
         prefused: tuple | None = None,
         deadline_s: float | None = None,
         priority: int = 0,
+        n_branches: int = 1,
+        branch_policy: Any = None,
     ) -> tuple[str, asyncio.Queue, int]:
         """Streaming variant: returns (request_id, queue of TokenEvents,
         truncated_prompt_tokens) — the truncation count rides along so
         streaming transports report the same ``truncated_prompt_tokens`` a
         unary generate() does. Raises QueueFullError / RequestTooLongError
-        like generate()."""
+        like generate().
+
+        With ``n_branches > 1`` the stream is GROUP-AWARE: nothing is
+        emitted while the branches decode; at resolution the WINNER's
+        tokens replay into the queue (then one terminal) — pruned branches
+        produce no client-visible frames, and the ``branches`` summary is
+        retrievable via :meth:`pop_group_meta` after the terminal."""
+        n_branches, branch_policy = validate_branch_spec(n_branches, branch_policy)
+        if n_branches > 1:
+            if response_schema is not None:
+                raise ValueError(
+                    "branch decoding is incompatible with response_schema "
+                    "(constrained decoding owns the sampler mask)"
+                )
+            if images or audios:
+                raise ValueError("branch decoding does not take media inputs")
         q: asyncio.Queue = asyncio.Queue(maxsize=4096)
+        if n_branches > 1:
+            register = lambda r: self._register_group(  # noqa: E731
+                r, n_branches, branch_policy, ("stream", q)
+            )
+            unregister = lambda r: self._teardown_group(  # noqa: E731
+                self._groups[r]
+            ) if r in self._groups else None
+        else:
+            register = lambda r: self._streams.__setitem__(r, q)  # noqa: E731
+            unregister = lambda r: self._streams.pop(r, None)  # noqa: E731
         rid, truncated = self._submit(
             prompt,
             tokens,
@@ -1332,8 +1428,8 @@ class ModelBackend:
             top_k,
             top_p,
             stop_token_ids,
-            register=lambda r: self._streams.__setitem__(r, q),
-            unregister=lambda r: self._streams.pop(r, None),
+            register=register,
+            unregister=unregister,
             session_id=session_id,
             response_schema=response_schema,
             context_overflow=context_overflow,
@@ -1343,8 +1439,14 @@ class ModelBackend:
             prefused=prefused,
             deadline_s=deadline_s,
             priority=priority,
+            n_branches=n_branches,
         )
         return rid, q, truncated
+
+    def pop_group_meta(self, rid: str) -> dict | None:
+        """The ``branches`` summary of a resolved streaming group (set at
+        winner replay); one-shot so abandoned streams do not accumulate."""
+        return self._group_meta.pop(rid, None)
 
     async def drain(self, grace_s: float = 30.0) -> dict[str, Any]:
         """Graceful drain (rolling restart): stop admitting, let in-flight
@@ -1386,8 +1488,222 @@ class ModelBackend:
 
     def release_stream(self, rid: str) -> None:
         """Consumer gone: stop dispatching to its queue (remaining tokens take
-        the discard path)."""
+        the discard path). A still-unresolved branch GROUP behind the stream
+        is cancelled whole — decoding N branches for a dead reader is N
+        slots of waste."""
         self._streams.pop(rid, None)
+        g = self._groups.get(rid)
+        if g is not None and self._group_sinks.get(g.parent, ("", None))[0] == "stream":
+            self._cancel_group(g)
+        self._group_meta.pop(rid, None)
+
+    # -- branch decoding (docs/PREFIX_CACHING.md "Fork / COW branches") --
+
+    # Winner-replay stall bound: how long one queue put may wait on a slow
+    # stream consumer before the replay declares it dead (seconds).
+    _REPLAY_STALL_S = 30.0
+
+    def _register_group(
+        self, parent_rid: str, n: int, policy: dict, sink: tuple[str, Any]
+    ) -> BranchGroup:
+        g = BranchGroup(parent_rid, n, policy)
+        for rid in g.branch_rids():
+            self._groups[rid] = g
+        self._group_sinks[parent_rid] = sink
+        return g
+
+    def _teardown_group(self, g: BranchGroup) -> None:
+        for rid in [r for r, gg in self._groups.items() if gg is g]:
+            del self._groups[rid]
+        self._group_sinks.pop(g.parent, None)
+
+    def _cancel_group(self, g: BranchGroup) -> None:
+        """Client gone: cancel every LIVE branch through the engine's
+        request_cancel path so the whole fan-out's pages free now (finished
+        branches already released; pruned ones were already cancelled)."""
+        live = [b.rid for b in map(g.branch, g.branch_rids()) if b is not None and b.live]
+        self._teardown_group(g)
+        for rid in live:
+            self.engine.request_cancel(rid)
+        self._wake.set()
+
+    def _fail_group(self, g: BranchGroup, error: str) -> None:
+        sink = self._group_sinks.get(g.parent)
+        self._teardown_group(g)
+        if sink is None:
+            return
+        kind, obj = sink
+        if kind == "future":
+            if not obj.done():
+                obj.set_exception(RuntimeError(error))
+        else:
+            self._push_stream(g.parent, obj, _error_event(g.parent, error))
+
+    def _on_group_event(self, g: BranchGroup, ev) -> None:
+        for act in g.on_event(ev.request_id, ev):
+            if act[0] == "cancel":
+                # Pruned: pages free immediately; no client-visible frames
+                # were ever emitted for this branch.
+                self.engine.stats["branch_pruned_total"] += 1
+                self.cancel(act[1])
+            elif act[0] == "fork":
+                _, src, new_rid = act
+                self._groups[new_rid] = g
+                self.engine.request_fork(src, new_rid)
+                self._wake.set()
+            elif act[0] == "resolve":
+                t = asyncio.create_task(self._resolve_group(g))
+                self._group_tasks.add(t)
+                t.add_done_callback(self._group_tasks.discard)
+
+    @staticmethod
+    def _branch_content(b) -> list[tuple[int, float | None]]:
+        """A branch's CONTENT records: the terminal stop token is a
+        terminator, not content (same rule as the unary buffering path)."""
+        if b.finish_reason == "stop" and b.records:
+            return b.records[:-1]
+        return list(b.records)
+
+    async def _resolve_group(self, g: BranchGroup) -> None:
+        """Every branch settled: pick the winner (cumulative logprob, or
+        the policy's verifier reasoner via the control plane) and deliver
+        it to the group's one client-visible sink."""
+        cands = g.candidates()
+        winner = cands[0] if cands else None
+        verifier_used = False
+        target = g.policy.get("verifier")
+        if (
+            winner is not None
+            and len(cands) > 1
+            and target
+            and self._verifier_call is not None
+            and self.tokenizer is not None
+        ):
+            # Control-plane reranking: the candidate TEXTS go to the named
+            # reasoner through the gateway; its pick overrides the logprob
+            # order. Any failure degrades to the logprob winner — a broken
+            # verifier must not fail a completed generation.
+            self.engine.stats["branch_verifier_calls_total"] += 1
+            texts = [
+                self.tokenizer.decode([t for t, _ in self._branch_content(b)])
+                for b in cands
+            ]
+            try:
+                res = await self._verifier_call(
+                    target,
+                    {
+                        "task": "rerank",
+                        "candidates": texts,
+                        "scores": [round(b.cum_logprob, 4) for b in cands],
+                    },
+                )
+                idx = self._parse_verdict(res, len(cands))
+                if idx is not None:
+                    winner = cands[idx]
+                    verifier_used = True
+            except Exception as e:
+                from agentfield_tpu.logging import get_logger
+
+                get_logger("model_node").warning(
+                    "branch verifier failed; using logprob winner",
+                    target=target, error=repr(e),
+                )
+        if winner is None:
+            winner = g.fallback_branch()
+        meta = g.summary(winner, verifier_used)
+        # Fetch the sink AFTER the verifier await: a client that
+        # disconnected during it already tore the group down
+        # (release_stream/_cancel_group) — delivering to the stale sink
+        # would strand a _group_meta entry nothing ever pops.
+        sink = self._group_sinks.get(g.parent)
+        self._teardown_group(g)
+        if sink is None or winner is None:
+            return
+        kind, obj = sink
+        if kind == "future":
+            content = self._branch_content(winner)
+            if not obj.done():
+                obj.set_result(
+                    {
+                        "tokens": [t for t, _ in content],
+                        "logprobs": [lp for _, lp in content],
+                        "finish_reason": winner.finish_reason,
+                        "branches": meta,
+                    }
+                )
+        else:
+            # Group-aware streaming: the winner's tokens replay into the
+            # client stream only now — pruned/losing branches emitted no
+            # client-visible frames at any point.
+            self._group_meta[g.parent] = meta
+            await self._replay_winner(g.parent, obj, winner)
+
+    @staticmethod
+    def _parse_verdict(res, n: int) -> int | None:
+        """Accept {"best": i} or {"scores": [...]} shaped verdicts (nested
+        under "result" tolerated); anything else → None (logprob wins)."""
+        if isinstance(res, dict) and isinstance(res.get("result"), dict):
+            res = res["result"]
+        if not isinstance(res, dict):
+            return None
+        best = res.get("best")
+        if isinstance(best, bool):
+            return None
+        if isinstance(best, int) and 0 <= best < n:
+            return best
+        scores = res.get("scores")
+        if (
+            isinstance(scores, list)
+            and len(scores) == n
+            and all(isinstance(s, (int, float)) and not isinstance(s, bool) for s in scores)
+        ):
+            return max(range(n), key=lambda i: scores[i])
+        return None
+
+    async def _replay_winner(self, parent_rid: str, q: asyncio.Queue, b) -> None:
+        """Synthesize the winner's TokenEvents (re-labeled under the parent
+        rid) into the stream queue, ending with exactly one terminal.
+        Replay is CLIENT-PACED: a winner longer than the queue's capacity
+        awaits the consumer instead of tripping QueueFull (which would drop
+        the terminal and wedge the stream); a consumer that stops draining
+        for ``_REPLAY_STALL_S`` is treated as gone and the rest drops."""
+        from agentfield_tpu.serving.engine import TokenEvent
+
+        async def push(ev) -> bool:
+            try:
+                q.put_nowait(ev)
+                return True
+            except asyncio.QueueFull:
+                try:
+                    async with aio_timeout(self._REPLAY_STALL_S):
+                        await q.put(ev)
+                    return True
+                except TimeoutError:
+                    return False  # consumer dead: drop the rest
+
+        records = list(b.records)
+        reason = b.finish_reason
+        tokened_terminal = reason in ("stop", "length") and bool(records)
+        for i, (tok, lp) in enumerate(records):
+            last = i == len(records) - 1
+            ev = TokenEvent(
+                request_id=parent_rid,
+                token=tok,
+                index=i,
+                finished=last and tokened_terminal,
+                finish_reason=reason if last and tokened_terminal else None,
+                logprob=lp,
+            )
+            if not await push(ev):
+                return
+        if not tokened_terminal:
+            # deadline/error terminals carry no token (engine convention)
+            await push(
+                TokenEvent(
+                    request_id=parent_rid, token=-1, index=-1, finished=True,
+                    finish_reason=reason or "error: branch group unresolved",
+                )
+            )
 
 
 def build_model_node(
@@ -1560,7 +1876,7 @@ def build_model_node(
                 "prompt", "tokens", "stop_token_ids", "session_id",
                 "max_new_tokens", "temperature", "top_k", "top_p",
                 "response_schema", "context_overflow", "images", "audios",
-                "deadline_s", "priority",
+                "deadline_s", "priority", "n_branches", "branch_policy",
             )
             if body.get(k) is not None
         }
@@ -1638,7 +1954,13 @@ def build_model_node(
                     # frames keep the stream alive through proxies.
                     await resp.write(b": ping\n\n")
                     continue
-                await resp.write(f"data: {_json.dumps(_event_frame(ev))}\n\n".encode())
+                frame = _event_frame(ev)
+                if ev.finished:
+                    meta = backend.pop_group_meta(rid)
+                    if meta is not None:
+                        frame["branches"] = meta  # branch-group summary
+                        # rides the terminal frame
+                await resp.write(f"data: {_json.dumps(frame)}\n\n".encode())
                 if ev.finished:
                     break
         except (ConnectionResetError, asyncio.CancelledError):
@@ -1681,6 +2003,7 @@ def build_model_node(
         rid, q, truncated = backend.submit_stream(**gen_kwargs)
         records: list[tuple[int, float | None]] = []
         finish_reason = None
+        branches_meta = None
         try:
             while True:
                 ev = await q.get()
@@ -1691,6 +2014,10 @@ def build_model_node(
                     records.append((ev.token, ev.logprob))
                 if ev.finished:
                     finish_reason = ev.finish_reason
+                    # Branch groups: the winner's summary lands with its
+                    # replayed terminal (popped BEFORE release_stream's
+                    # abandoned-meta backstop runs in the finally below).
+                    branches_meta = backend.pop_group_meta(rid)
                     break
         except asyncio.CancelledError:
             backend.cancel(rid)
@@ -1705,6 +2032,8 @@ def build_model_node(
             "finish_reason": finish_reason,
             "model": backend.model_name,
         }
+        if branches_meta is not None:
+            result["branches"] = branches_meta
         if backend.tokenizer is not None:
             result["text"] = backend.tokenizer.decode(result["tokens"])
         if truncated:
@@ -1718,6 +2047,20 @@ def build_model_node(
         # for this node's own pulls.
         agent.channel_server.set_kv_export(backend.kv_export_pages)
         backend._kv_fetch_fn = agent.channel_server.fetch_kv
+
+    async def _branch_verifier(target: str, payload: dict) -> Any:
+        """Branch-group verifier hook: dispatch the candidate texts to the
+        named reasoner THROUGH the gateway (the control plane as a
+        reranker — docs/PREFIX_CACHING.md "Fork / COW branches"). A
+        non-completed execution raises; the group falls back to logprob."""
+        doc = await agent.client.execute(target, payload)
+        if doc.get("status") != "completed":
+            raise RuntimeError(
+                f"verifier {target!r} {doc.get('status')}: {doc.get('error')}"
+            )
+        return doc.get("result")
+
+    backend._verifier_call = _branch_verifier
 
     async def stats_handler(_req):
         from aiohttp import web as _web

@@ -211,36 +211,33 @@ def test_autotune_sweep_returns_valid_blocks():
 
 
 # ---------------------------------------------------------------------------
-# deprecation shims (one release): legacy names keep their semantics
+# the legacy shim names are GONE (one-release deprecation window closed):
+# only the ragged surface remains importable
 
 
-def test_legacy_shims_match_pool_attention():
+def test_legacy_shim_names_removed():
     from agentfield_tpu.ops import pallas as ops_pallas
 
-    key = jax.random.PRNGKey(3)
-    P, Kh, ps, hd, maxp, H, B = 33, 2, 8, 32, 6, 4, 3
-    ks = jax.random.split(key, 3)
-    kp = _rand(ks[0], (P, Kh, ps, hd))
-    vp = _rand(ks[1], (P, Kh, ps, hd))
-    perm = np.random.default_rng(3).permutation(P - 1) + 1
-    tables = jnp.asarray(perm[: B * maxp].reshape(B, maxp), jnp.int32)
-    seq_lens = jnp.asarray([1, 17, maxp * ps], jnp.int32)
-    q = _rand(ks[2], (B, H, hd))
-    with pytest.warns(DeprecationWarning):
-        out = ops_pallas.paged_attention_pallas(q, kp, vp, tables, seq_lens)
-    ref = ops_pallas.paged_attention_ref(q, kp, vp, tables, seq_lens)
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
-    )
-    # kv_write shim is the exact scatter
-    kn = _rand(jax.random.PRNGKey(4), (B, Kh, hd))
-    pages = jnp.asarray([3, 5, 9], jnp.int32)
-    slots = jnp.asarray([0, 7, 3], jnp.int32)
-    with pytest.warns(DeprecationWarning):
-        wk, wv = ops_pallas.kv_write(kp, vp, kn, kn, pages, slots)
-    np.testing.assert_array_equal(
-        np.asarray(wk), np.asarray(kp.at[pages, :, slots].set(kn))
-    )
+    for name in (
+        "paged_attention_pallas",
+        "paged_chunk_attention_pallas",
+        "paged_batch_chunk_attention_pallas",
+        "paged_batch_chunk_attention_ref",
+        "kv_write",
+        "kv_write_pallas",
+    ):
+        assert not hasattr(ops_pallas, name), name
+        assert name not in ops_pallas.__all__, name
+    # the ragged surface is intact
+    for name in (
+        "ragged_paged_attention",
+        "ragged_paged_attention_pallas",
+        "ragged_paged_attention_ref",
+        "RaggedRows",
+        "lookup_blocks",
+        "flash_attention",
+    ):
+        assert hasattr(ops_pallas, name), name
 
 
 # ---------------------------------------------------------------------------

@@ -8,11 +8,13 @@ field list is read from the AST):
 - every ``EngineConfig`` dataclass field must appear in docs/*.md (the
   reference table in docs/ARCHITECTURE.md);
 - every ``AGENTFIELD_*`` environment variable mentioned by
-  ``control_plane/*.py``, ``serving/*.py`` or ``ops/**`` sources must appear
-  in docs/*.md — operators learn knobs from OPERATIONS.md (and kernel knobs
-  from KERNELS.md), not from grepping the tree. (``serving`` joined the scan
-  with the cluster prefix tier: AGENTFIELD_KV_FETCH and the sketch-bytes
-  override are node-side reads.)
+  ``control_plane/*.py``, ``serving/*.py``, ``ops/**`` or top-level
+  ``agentfield_tpu/*.py`` sources must appear in docs/*.md — operators
+  learn knobs from OPERATIONS.md (and kernel knobs from KERNELS.md), not
+  from grepping the tree. (``serving`` joined the scan with the cluster
+  prefix tier; the top-level modules joined with branch decoding —
+  AGENTFIELD_BRANCH_MAX is read by the jax-free ``branching.py``, which
+  lives at the package root so the gateway can import it.)
 
 Allowlist: ``knob_allow`` entries for env vars the control plane reads but
 operators never set (test scaffolding); empty on purpose today.
@@ -46,7 +48,12 @@ class KnobDocsPass(Pass):
     @staticmethod
     def _env_scanned(rel: str) -> bool:
         parts = rel.split("/")
-        return "control_plane" in parts or "ops" in parts or "serving" in parts
+        if "control_plane" in parts or "ops" in parts or "serving" in parts:
+            return True
+        # top-level package modules (branching.py, config.py, logging.py,
+        # prefix_hash.py, ...): jax-free leaves both planes import — their
+        # env reads are operator knobs too
+        return len(parts) == 2 and parts[0] == "agentfield_tpu"
 
     def relevant(self, rel: str) -> bool:
         return rel == _ENGINE_REL or self._env_scanned(rel)

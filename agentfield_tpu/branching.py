@@ -173,9 +173,12 @@ class BranchGroup:
         self.n = n
         self.policy = dict(policy)
         self.resolved = False
-        self._next_idx = n  # refork children continue the id sequence
+        self._next_idx = n  # guarded by: external(owner event loop — refork ids are minted only inside on_event)
         self._boundary = self.policy.get("beam_interval", 0) or 0
-        self._branches: dict[str, _Branch] = {}
+        # The per-branch record table: every mutation happens inside
+        # on_event()/resolution on the owner's loop (ModelBackend routes
+        # branch events before any sink) — nothing outside may reach in.
+        self._branches: dict[str, _Branch] = {}  # guarded by: external(owner event loop)
         for j in range(n):
             rid = branch_rid(parent_rid, j)
             self._branches[rid] = _Branch(rid=rid, index=j)

@@ -176,8 +176,11 @@ class ExecutionStreams:
     def __init__(self, retain_s: float = 60.0, max_entries: int = 4096):
         self.retain_s = retain_s
         self.max_entries = max_entries
-        self._entries: dict[str, _StreamEntry] = {}
-        self._done_order: collections.OrderedDict[str, float] = collections.OrderedDict()
+        # Registry + retirement order: mutated only from the gateway's event
+        # loop (channel recv loop, SSE handlers, gateway.complete) — no lock
+        # exists to check, so encapsulation is the enforced half.
+        self._entries: dict[str, _StreamEntry] = {}  # guarded by: external(gateway event loop)
+        self._done_order: collections.OrderedDict[str, float] = collections.OrderedDict()  # guarded by: external(gateway event loop)
 
     def _purge(self) -> None:
         cutoff = time.monotonic() - self.retain_s
@@ -243,7 +246,7 @@ class ExecutionStreams:
                 try:
                     q.put_nowait(None)
                 except asyncio.QueueFull:
-                    pass  # afcheck: ignore[except-swallow] queue is full of frames the dead consumer will never read
+                    pass  # queue is full of frames the dead consumer will never read
 
     def finish(self, ex) -> None:
         """Publish the exactly-one terminal frame for a terminal execution
@@ -582,7 +585,7 @@ class ChannelServer:
                     continue
                 await self._handle(conn, frame)
         except (ConnectionResetError, asyncio.CancelledError):
-            pass  # afcheck: ignore[except-swallow] peer gone / shutdown: running execs keep buffering for reattach
+            pass  # peer gone / shutdown: running execs keep buffering for reattach
         finally:
             # Connection gone: unbind sinks, keep executions running — the
             # gateway reconnects and reattaches; frames buffer meanwhile.
@@ -1062,8 +1065,8 @@ class ChannelManager:
         # within their process — two identical node binaries can mint the
         # same id) and maps it back on the response: gateway_fid →
         # (requesting node_id, the requester's original fetch_id, deadline).
-        self._kv_relays: dict[str, tuple[str, str, float]] = {}
-        self._kv_relay_seq = 0
+        self._kv_relays: dict[str, tuple[str, str, float]] = {}  # guarded by: external(gateway event loop — relay frames arrive on one recv loop)
+        self._kv_relay_seq = 0  # guarded by: external(gateway event loop)
         self.publish_cb: Callable[[str, dict], None] = lambda eid, f: None
         self.terminal_cb: Callable[[str, dict], Awaitable[Any]] | None = None
         self.lost_cb: Callable[[str, str, int, str], Awaitable[Any]] | None = None

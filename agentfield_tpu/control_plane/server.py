@@ -9,8 +9,6 @@ search, SSE event streams, Prometheus /metrics, /health.
 from __future__ import annotations
 
 import asyncio
-
-from agentfield_tpu._compat import aio_timeout
 import json
 from typing import Any
 
@@ -508,9 +506,13 @@ def create_app(cp: ControlPlane) -> web.Application:
                 )
             while True:
                 try:
-                    async with aio_timeout(15):
-                        frame = await sub.get()
-                except TimeoutError:
+                    # wait_for, not aio_timeout: the backport cancels the
+                    # ENCLOSING task at its deadline, so a server-shutdown
+                    # cancel landing in that window was relabeled
+                    # TimeoutError and this loop absorbed it (afcheck
+                    # task-lifecycle; the PR 11 stop()-hang class)
+                    frame = await asyncio.wait_for(sub.get(), 15)
+                except asyncio.TimeoutError:
                     await resp.write(b": ping\n\n")
                     continue
                 if frame is None:
@@ -1004,10 +1006,11 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             while True:
                 try:
-                    async with aio_timeout(15):
-                        _, ev = await q.get()
+                    # wait_for: an external cancel must propagate, never be
+                    # relabeled TimeoutError by the aio_timeout backport
+                    _, ev = await asyncio.wait_for(q.get(), 15)
                     await resp.write(f"data: {json.dumps(ev)}\n\n".encode())
-                except TimeoutError:
+                except asyncio.TimeoutError:
                     # Periodic comment frame: idle streams survive proxies
                     # and LBs that reap silent connections.
                     await resp.write(b": ping\n\n")
@@ -1017,10 +1020,10 @@ def create_app(cp: ControlPlane) -> web.Application:
             try:
                 await resp.write(b"event: end\ndata: {}\n\n")
             except (ConnectionResetError, RuntimeError):
-                pass  # afcheck: ignore[except-swallow] client is gone too; nothing left to tell it
+                pass  # client is gone too; nothing left to tell it
             raise
         except ConnectionResetError:
-            pass  # afcheck: ignore[except-swallow] client hung up; nothing to write a terminal to
+            pass  # client hung up; nothing to write a terminal to
         finally:
             cp.bus.unsubscribe(topic, q)
         return resp
@@ -1055,9 +1058,10 @@ def create_app(cp: ControlPlane) -> web.Application:
         try:
             while not ws.closed:
                 try:
-                    async with aio_timeout(30):
-                        _, ev = await q.get()
-                except TimeoutError:
+                    # wait_for: an external cancel must propagate, never be
+                    # relabeled TimeoutError by the aio_timeout backport
+                    _, ev = await asyncio.wait_for(q.get(), 30)
+                except asyncio.TimeoutError:
                     continue
                 await ws.send_json(ev)
         except (ConnectionResetError, asyncio.CancelledError):

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import asyncio
 
-from agentfield_tpu._compat import aio_timeout
 import hashlib
 import hmac
 import json
@@ -102,9 +101,15 @@ class WebhookDispatcher:
                 processed = await self.process_due()
                 if processed == 0:
                     try:
-                        async with aio_timeout(self.poll_interval):
-                            await self._wake.wait()
-                    except TimeoutError:
+                        # wait_for, not aio_timeout: the backport cancels
+                        # the ENCLOSING task at the deadline, so a stop()
+                        # cancel in that window was relabeled TimeoutError
+                        # and absorbed — the poller hung its own teardown
+                        # (afcheck task-lifecycle; PR 11 stop()-hang class)
+                        await asyncio.wait_for(
+                            self._wake.wait(), self.poll_interval
+                        )
+                    except asyncio.TimeoutError:
                         pass
             except asyncio.CancelledError:
                 raise

@@ -1982,6 +1982,7 @@ class InferenceEngine:
             return self._sample_first_and_install(req, slot_idx, pages, row, last_logits)
         return self._admit_batch(batch)
 
+    # afcheck: owns-pages each row's pages install into its slot (release/preempt free them)
     def _admit_batch(self, batch: list[tuple[Request, int, list[int]]]) -> list[TokenEvent]:
         """One padded multi-row prefill for ≥2 fresh requests, then one
         vectorized first-token sample across all rows."""
@@ -2195,6 +2196,7 @@ class InferenceEngine:
             self._tick_tokens.append(len(req.prompt) - start)
         return self._sample_first_and_install(req, free_slot, pages, row, last_logits)
 
+    # afcheck: owns-pages installs into the slot table (and forks siblings onto shared pages)
     def _sample_first_and_install(
         self, req: Request, slot_idx: int, pages: list[int], row: np.ndarray, last_logits
     ) -> list[TokenEvent]:
@@ -2450,6 +2452,7 @@ class InferenceEngine:
                     continue
         return out
 
+    # afcheck: owns-pages the slot table takes custody; release_slot/preempt free them
     def _install(
         self,
         req: Request,

@@ -367,7 +367,7 @@ class ModelBackend:
         # same-prefix burst landing on a cold node must issue ONE transfer,
         # not one per request — followers await the leader's adoption and
         # let admission's ordinary lookup find the pages.
-        self._kv_prefetch_inflight: dict[tuple[str, bytes], asyncio.Future] = {}
+        self._kv_prefetch_inflight: dict[tuple[str, bytes], asyncio.Future] = {}  # guarded by: external(node event loop — leader/follower dedup runs on one loop)
         # Branch decoding (docs/PREFIX_CACHING.md "Fork / COW branches"):
         # every branch rid maps to its group; the drive loop routes branch
         # TokenEvents here INSTEAD of the per-rid future/stream sinks, the
@@ -1789,7 +1789,7 @@ def build_model_node(
         try:
             ecfg = _dc2.replace(ecfg, prefix_sketch_bytes=int(_sk))
         except ValueError:
-            pass  # afcheck: ignore[except-swallow] malformed env override keeps the configured default
+            pass  # malformed env override keeps the configured default
     draft = None
     if spec_k is not None:
         import dataclasses as _dc
@@ -1947,9 +1947,13 @@ def build_model_node(
         try:
             while True:
                 try:
-                    async with aio_timeout(10):
-                        ev = await q.get()
-                except TimeoutError:
+                    # wait_for, not aio_timeout: the backport cancels the
+                    # ENCLOSING task at the deadline, so a client-disconnect
+                    # cancel in that window was relabeled TimeoutError and
+                    # the loop absorbed it (afcheck task-lifecycle; the
+                    # PR 11 stop()-hang class)
+                    ev = await asyncio.wait_for(q.get(), 10)
+                except asyncio.TimeoutError:
                     # Idle decode gap (deep queue / long prefill): comment
                     # frames keep the stream alive through proxies.
                     await resp.write(b": ping\n\n")
@@ -1976,7 +1980,7 @@ def build_model_node(
                     f"data: {_json.dumps({'token': -1, 'index': -1, 'finished': True, 'finish_reason': f'error: {e!r}'})}\n\n".encode()
                 )
             except (ConnectionResetError, RuntimeError):
-                pass  # afcheck: ignore[except-swallow] client is gone too; the engine-side cancel below still runs
+                pass  # client is gone too; the engine-side cancel below still runs
             backend.cancel(rid)
         finally:
             backend.release_stream(rid)  # disconnected consumers must not

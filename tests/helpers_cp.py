@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import socket
+import threading
 
 import aiohttp
 from aiohttp import web
@@ -67,6 +68,10 @@ class FakeAgent:
         self.base_url = f"http://127.0.0.1:{self.port}"
         self.calls: list[dict] = []
         self.runner: web.AppRunner | None = None
+        # deferred-callback tasks: retained so stop() can drain them and the
+        # harness task-leak audit never sees a stray (the loop holds tasks
+        # weakly — an untracked callback could also be GC'd mid-flight)
+        self._tasks: set[asyncio.Task] = set()
 
     def reasoner_specs(self):
         ids = ("echo", "deferred", "boom", "slow", "silent202", "flaky")
@@ -100,7 +105,9 @@ class FakeAgent:
                         json={"status": "completed", "result": {"deferred": True}},
                     )
 
-            asyncio.create_task(callback())
+            t = asyncio.create_task(callback())
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
             return web.Response(status=202)
         if rid == "silent202":
             return web.Response(status=202)
@@ -119,6 +126,10 @@ class FakeAgent:
         return self
 
     async def stop(self):
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
         if self.runner:
             await self.runner.cleanup()
 
@@ -148,6 +159,10 @@ class CPHarness:
             )
 
     async def __aenter__(self):
+        # Baselines for the teardown leak audit: anything beyond these after
+        # cleanup is work the harness's stack leaked.
+        self._threads_at_enter = set(threading.enumerate())
+        self._tasks_at_enter = set(asyncio.all_tasks())
         self._runner = web.AppRunner(create_app(self.cp))
         await self._runner.setup()
         await web.TCPSite(self._runner, "127.0.0.1", self.port).start()
@@ -155,12 +170,63 @@ class CPHarness:
         self.http = aiohttp.ClientSession(base_url=self.base_url)
         return self
 
+    async def _audit_leaks(self):
+        """Task/thread leak audit: after cleanup, no asyncio task and no
+        non-daemon thread born inside the harness window may still be
+        running — a survivor is exactly the bug the task-lifecycle pass
+        hunts statically (a spawn no close()/stop() can reach). A short
+        grace absorbs in-flight shutdown callbacks, not real leaks."""
+        def _infra(t: asyncio.Task) -> bool:
+            # aiohttp's per-connection handler tasks (RequestHandler.start)
+            # are transport plumbing owned by their AppRunner — with NESTED
+            # harnesses (test_storage_pg runs two CPs in one loop) the other
+            # harness's live keep-alive connections would read as our leak.
+            # Application tasks (drive loops, channel execs, callbacks) keep
+            # their own coro names and stay audited.
+            coro = t.get_coro()
+            return getattr(coro, "__qualname__", "").startswith("RequestHandler.")
+
+        current = asyncio.current_task()
+        leaked = [
+            t for t in asyncio.all_tasks()
+            if t is not current and t not in self._tasks_at_enter
+            and not t.done() and not _infra(t)
+        ]
+        if leaked:
+            await asyncio.wait(leaked, timeout=1.0)
+            leaked = [t for t in leaked if not t.done()]
+        assert not leaked, (
+            f"CPHarness leaked {len(leaked)} asyncio task(s) past teardown: "
+            + ", ".join(repr(t.get_coro()) for t in leaked)
+        )
+        stray = [
+            th for th in threading.enumerate()
+            if th not in self._threads_at_enter
+            and th.is_alive() and not th.daemon
+            # the loop's own to_thread executor workers ("asyncio_N" /
+            # "ThreadPoolExecutor-*") are reaped by asyncio.run() AFTER
+            # this context exits — infrastructure, not a leak
+            and not th.name.startswith(("asyncio_", "ThreadPoolExecutor"))
+        ]
+        for th in stray:
+            th.join(timeout=1.0)
+        stray = [th for th in stray if th.is_alive()]
+        assert not stray, (
+            f"CPHarness leaked {len(stray)} non-daemon thread(s) past "
+            "teardown: " + ", ".join(th.name for th in stray)
+        )
+
     async def __aexit__(self, *exc):
         await self.http.close()
         await self.agent.stop()
         await self._runner.cleanup()
         if exc == (None, None, None):  # never mask the test's own failure
             self.lock_witness.assert_no_cycles()
+            # >50ms sync-lock hold on the loop thread = every coroutine on
+            # the loop stalled that long (the runtime half of afcheck's
+            # task-lifecycle await-under-lock rule)
+            self.lock_witness.assert_no_loop_blocking()
+            await self._audit_leaks()
 
     async def register_agent(self, node_id: str = "fake-agent"):
         return await self.register_fake(self.agent, node_id)

@@ -388,13 +388,28 @@ def test_logprob_present_on_every_scheduler_path(tiny):
 
 def _backend(tiny, **eover):
     from agentfield_tpu.serving.model_node import ByteTokenizer, ModelBackend
+    from tools.analysis.lock_witness import LockWitness
 
     cfg, params = tiny
     ecfg = dataclasses.replace(ECFG, **eover) if eover else ECFG
-    return ModelBackend(
+    b = ModelBackend(
         params, cfg, ecfg, tokenizer=ByteTokenizer(cfg.vocab_size),
         idle_sleep=0.001,
     )
+    # Lock witness on the engine's locks (tools/analysis/lock_witness.py):
+    # the branching paths take _session_lock/_pending_lock from both the
+    # step thread and the loop-side fork/cancel entry points — every backend
+    # test records acquisition order + on-loop hold durations for free.
+    w = b.lock_witness = LockWitness()
+    w.instrument(b.engine, "_session_lock", "engine._session_lock")
+    w.instrument(b.engine, "_pending_lock", "engine._pending_lock")
+    w.instrument(b.engine, "_telemetry_lock", "engine._telemetry_lock")
+    return b
+
+
+def _assert_witness_clean(b) -> None:
+    b.lock_witness.assert_no_cycles()
+    b.lock_witness.assert_no_loop_blocking()
 
 
 def test_backend_best_of_n_and_beam_and_verifier(tiny):
@@ -467,6 +482,7 @@ def test_backend_best_of_n_and_beam_and_verifier(tiny):
             assert not b._groups and not b._group_sinks
         finally:
             await b.stop()
+        _assert_witness_clean(b)
 
     asyncio.run(asyncio.wait_for(run(), timeout=180))
 
@@ -497,6 +513,7 @@ def test_backend_group_stream_winner_only(tiny):
             assert b.engine.allocator.free_pages == ECFG.num_pages - 1
         finally:
             await b.stop()
+        _assert_witness_clean(b)
 
     asyncio.run(asyncio.wait_for(run(), timeout=180))
 
@@ -530,6 +547,7 @@ def test_backend_group_client_cancel_frees_all_branches(tiny):
             assert not b._groups
         finally:
             await b.stop()
+        _assert_witness_clean(b)
 
     asyncio.run(asyncio.wait_for(run(), timeout=180))
 

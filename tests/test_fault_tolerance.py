@@ -107,6 +107,35 @@ async def test_gateway_retries_transient_5xx_then_completes():
 
 
 @async_test
+async def test_gateway_agent_call_delay_injects_latency_not_failure():
+    """gateway.agent_call.delay chaos: the dispatch stalls delay_s before
+    the agent call (slow network / GC pause) and then proceeds normally —
+    latency injection must never change the outcome, and the seeded
+    schedule proves the point actually fired (afcheck's fault-coverage
+    pass pins that every registered point has a test like this one)."""
+    async with CPHarness() as h:
+        await h.register_agent("a")
+        inj = faults.FaultInjector(
+            seed=7,
+            spec={"gateway.agent_call.delay": {"delay_s": 0.3, "times": 1}},
+        )
+        faults.install(inj)
+        try:
+            t0 = time.monotonic()
+            async with h.http.post(
+                "/api/v1/execute/a.echo", json={"input": {"x": 1}}
+            ) as r:
+                doc = await r.json()
+            elapsed = time.monotonic() - t0
+        finally:
+            faults.install(None)
+        assert doc["status"] == "completed", doc
+        assert doc["result"] == {"echo": {"x": 1}}
+        assert inj.stats()["gateway.agent_call.delay"]["fired"] == 1
+        assert elapsed >= 0.3, "the injected delay must actually stall dispatch"
+
+
+@async_test
 async def test_gateway_fatal_4xx_not_retried():
     """Deterministic failures must NOT replay (boom returns 500 → retried;
     a 404-ish agent error is fatal). The fake agent 404s unknown reasoner

@@ -72,6 +72,14 @@ async def test_stream_token_exact_and_reattach_on_drop():
         model_agent, backend = build_model_node(
             "model-tiny", h.base_url, model="llama-tiny", ecfg=ECFG
         )
+        # Witness the engine's locks on the streaming path too (the harness
+        # already witnesses storage/journal): token frames are emitted while
+        # the step thread and the loop-side submit/cancel entry points share
+        # _session_lock/_pending_lock — any order cycle or long on-loop hold
+        # fails this test's teardown (tools/analysis/lock_witness.py).
+        h.lock_witness.instrument(backend.engine, "_session_lock", "engine._session_lock")
+        h.lock_witness.instrument(backend.engine, "_pending_lock", "engine._pending_lock")
+        h.lock_witness.instrument(backend.engine, "_telemetry_lock", "engine._telemetry_lock")
         await backend.start()
         await model_agent.start()
         try:
@@ -279,9 +287,16 @@ class ScriptedChanNode:
         await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
 
     async def stop(self):
+        # Runner first: the loss-path tests simulate a DYING node, so the
+        # gateway must see the channel drop abruptly (a chan.close() first
+        # would politely cancel the handler, whose terminal frame turns the
+        # scripted loss into an ordinary failure). Then reap the scripted
+        # handler tasks the node left hanging — the leak CPHarness's
+        # teardown task audit catches.
         if self.runner is not None:
             await self.runner.cleanup()
             self.runner = None
+        await self.chan.close()
 
     async def register(self, h: CPHarness, node_id: str):
         async with h.http.post(

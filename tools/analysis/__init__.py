@@ -53,7 +53,11 @@ def run_analysis(
             allowlist_path = cand
     allowlist = load_allowlist(allowlist_path) if allowlist_path else {}
     files = discover(root, paths=paths, changed_only=changed_only)
-    ctx = Context(root, files, allowlist)
+    # a full walk = the whole shipped tree: inventory checks (require pins,
+    # stale suppressions, counter/fault coverage) only make sense there
+    ctx = Context(
+        root, files, allowlist, full_walk=not changed_only and not paths
+    )
     wanted = set(pass_ids) if pass_ids is not None else None
     passes: list[Pass] = []
     for cls in ALL_PASSES:
@@ -63,10 +67,12 @@ def run_analysis(
         if changed_only and not any(p.relevant(f.rel) for f in files):
             continue
         passes.append(p)
-    findings = run_passes(ctx, passes)
+    census: dict[str, Any] = {}
+    findings = run_passes(ctx, passes, census=census)
     info = {
         "files_scanned": len(files),
         "passes": [p.id for p in passes],
+        "suppressions": census,
     }
     return findings, info
 

@@ -1,9 +1,14 @@
-"""CLI runner: ``python -m tools.analysis [--json] [--changed] [paths...]``.
+"""CLI runner: ``python -m tools.analysis [--json] [--sarif] [--stats]
+[--changed] [paths...]``.
 
 Exit status 0 = clean, 1 = findings (or unparseable files). ``--changed``
 limits the walk to the git working-tree delta for fast local iteration —
 project-shaped passes (knob-docs) still run when any file they depend on
-changed. ``--json`` emits machine-readable output for CI annotation.
+changed, and inventory-shaped checks (require pins, stale suppressions,
+counter/fault coverage) wait for the full run. ``--json`` emits
+machine-readable output; ``--sarif`` emits SARIF 2.1.0 for per-line CI
+annotations (GitHub code scanning et al.); ``--stats`` prints the
+suppression census (pragmas judged/used/stale).
 """
 
 from __future__ import annotations
@@ -13,7 +18,55 @@ import json
 import pathlib
 import sys
 
-from tools.analysis import PASS_IDS, run_analysis
+from tools.analysis import ALL_PASSES, PASS_IDS, run_analysis
+
+
+def to_sarif(findings, info) -> dict:
+    """SARIF 2.1.0: one run, one rule per pass, one result per finding —
+    the shape CI annotators ingest for per-line PR comments."""
+    descriptions = {p.id: p.description for p in ALL_PASSES}
+    rules = sorted({f.pass_id for f in findings} | set(info.get("passes", [])))
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "afcheck",
+                        "informationUri": "docs/STATIC_ANALYSIS.md",
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {
+                                    "text": descriptions.get(rid, rid)
+                                },
+                            }
+                            for rid in rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.pass_id,
+                        "level": "error",
+                        "message": {
+                            "text": f.message + (f" — {f.hint}" if f.hint else "")
+                        },
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": max(1, f.line)},
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -23,6 +76,14 @@ def main(argv: list[str] | None = None) -> int:
         "(docs/STATIC_ANALYSIS.md)",
     )
     ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--sarif", action="store_true",
+        help="SARIF 2.1.0 output for per-line CI annotations",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="print the suppression census (pragmas judged/used/stale)",
+    )
     ap.add_argument(
         "--changed",
         action="store_true",
@@ -53,7 +114,9 @@ def main(argv: list[str] | None = None) -> int:
         pass_ids=args.passes,
         changed_only=args.changed,
     )
-    if args.json:
+    if args.sarif:
+        print(json.dumps(to_sarif(findings, info), indent=2))
+    elif args.json:
         print(
             json.dumps(
                 {
@@ -73,6 +136,16 @@ def main(argv: list[str] | None = None) -> int:
             f"{', '.join(info['passes']) or 'none'}",
             file=sys.stderr if findings else sys.stdout,
         )
+        if args.stats:
+            c = info.get("suppressions", {})
+            print(
+                "suppression census: "
+                f"{c.get('pragmas_judged', 0)} pragma line(s) judged, "
+                f"{c.get('pragmas_used', 0)} used, "
+                f"{c.get('pragmas_stale', 0)} stale"
+            )
+            for pid, n in (c.get("suppressed_findings_by_pass") or {}).items():
+                print(f"  {pid}: {n} finding(s) suppressed")
     return 1 if findings else 0
 
 

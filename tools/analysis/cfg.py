@@ -1,0 +1,551 @@
+"""Obligation-flow analysis over one function body (the refcount CFG core).
+
+The refcount-pairing pass needs a question answered per function: can a
+page-acquiring call reach a function exit without a paired disposition?
+Answering it takes a small abstract interpreter over the statement-level
+control flow — branches, loops, try/except/finally, early returns — tracking
+which local names may carry an un-disposed acquisition. This module is that
+interpreter, kept generic (ACQUIRE/DISPOSE/transfer sets are injected) so a
+future pass with the same shape (file handles, futures) can reuse it.
+
+The analysis is a LINT, not a verifier — deliberate approximations, chosen
+so false positives stay rare and every miss is a documented class:
+
+- **may-carry aliasing**: ``pages = matched + extra`` makes ``pages`` carry
+  both acquisitions; disposing ANY carrier of an id discharges the id
+  (``free(pages[k:])`` discharges all of ``pages``' ids — partial-quantity
+  bugs are out of scope).
+- **None-kill**: ``if x is None:`` (or ``if not x:`` / ``while x is None``)
+  kills the ids ``x`` carries inside that branch — the allocator's
+  all-or-nothing failure returns None, so the failure path holds nothing.
+  Because ids propagate through aliases, a correlated later test
+  (``if pages_j is None:`` after ``pages_j = parent[:k] + fresh``) kills the
+  same ids.
+- **exception edges** are modeled through explicit ``try``/``except``/
+  ``finally`` structure only: every statement inside a ``try`` body may jump
+  to each handler with any intermediate state. Implicit raises outside a
+  ``try`` are not exits (modeling them would flag every function).
+- **nested defs/lambdas** are not descended into (unknown execution point),
+  matching the guarded-by pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+
+@dataclasses.dataclass
+class Acquisition:
+    """One page-acquiring call site and its eventual fate."""
+
+    ident: int
+    line: int
+    label: str  # e.g. "allocator.alloc" — for the finding message
+    discharged: bool = False
+    leak_line: int | None = None  # first exit line that leaked it
+    leak_kind: str = ""  # "return" / "raise" / "end" / "discard"
+
+
+class _State:
+    """One path's abstract state: which names may carry which obligations."""
+
+    __slots__ = ("carried",)
+
+    def __init__(self, carried: dict[str, set[int]] | None = None):
+        self.carried: dict[str, set[int]] = carried or {}
+
+    def copy(self) -> "_State":
+        return _State({k: set(v) for k, v in self.carried.items()})
+
+    def merge(self, other: "_State") -> None:
+        for k, v in other.carried.items():
+            self.carried.setdefault(k, set()).update(v)
+
+    def ids_of(self, name: str) -> set[int]:
+        return self.carried.get(name, set())
+
+    def kill(self, ids: set[int]) -> None:
+        """Remove `ids` from every carrier (the acquisition failed / was
+        discharged on this path)."""
+        for v in self.carried.values():
+            v.difference_update(ids)
+
+    def live(self) -> set[int]:
+        out: set[int] = set()
+        for v in self.carried.values():
+            out |= v
+        return out
+
+
+def _terminal_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _base_name(node: ast.expr) -> str | None:
+    """Leftmost Name of an expression: ``slot.pages[:k]`` -> "slot"."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _names_in(node: ast.AST) -> Iterable[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+class ObligationWalker:
+    """Run the obligation-flow analysis over one function.
+
+    Parameters
+    ----------
+    acquire: terminal callee names whose call RESULT carries a new
+        obligation (``alloc``, ``lookup``, ...). Functions in this set are
+        also sanctioned to ``return`` carried values (they ARE the acquiring
+        primitives — their caller inherits the obligation at its call site).
+    acquire_by_arg: callee names (``incref``) whose obligation attaches to
+        the first argument's base name instead of the result.
+    dispose: callee names that discharge the ids of every carried name in
+        their arguments (``free``, ``park``, ``release``).
+    transfer_fns: function names whose ``def`` carries an owns-pages
+        annotation — passing a carried value INTO them is a sanctioned
+        custody transfer, and returning carried values FROM them is too.
+    owns_lines: source lines carrying an ``# afcheck: owns-pages`` comment;
+        any statement on such a line discharges the ids it touches.
+    """
+
+    def __init__(
+        self,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        *,
+        acquire: set[str],
+        acquire_by_arg: set[str],
+        dispose: set[str],
+        transfer_fns: set[str],
+        owns_lines: set[int],
+    ):
+        self.fn = fn
+        self.acquire = acquire
+        self.acquire_by_arg = acquire_by_arg
+        self.dispose = dispose
+        self.transfer_fns = transfer_fns
+        self.owns_lines = owns_lines
+        self.acqs: dict[int, Acquisition] = {}
+        self._next = 0
+        self._sanctioned_return = (
+            fn.name in acquire or fn.name in transfer_fns or fn.lineno in owns_lines
+        )
+        # loop bookkeeping: states parked at break/continue statements
+        self._breaks: list[list[_State]] = []
+        self._continues: list[list[_State]] = []
+        # active finally bodies (outermost first): a Return/Raise runs them
+        # before exiting, so a try/finally cleanup can still discharge
+        self._finals: list[list[ast.stmt]] = []
+
+    # -- public entry ---------------------------------------------------
+
+    def run(self) -> list[Acquisition]:
+        state = _State()
+        end = self._exec_block(self.fn.body, state)
+        if end is not None:
+            self._check_exit(end, self.fn.body[-1].end_lineno or 0, "end")
+        return [a for a in self.acqs.values() if a.leak_line]
+
+    # -- helpers --------------------------------------------------------
+
+    def _new_acq(self, node: ast.Call, label: str) -> int:
+        self._next += 1
+        self.acqs[self._next] = Acquisition(
+            ident=self._next, line=node.lineno, label=label
+        )
+        return self._next
+
+    def _discharge(self, ids: set[int]) -> None:
+        for i in ids:
+            self.acqs[i].discharged = True
+
+    def _check_exit(self, state: _State, line: int, kind: str) -> None:
+        """Ids still LIVE in this exit path's state leak here. Liveness is
+        per-path (a free() on the happy path does not absolve an error path
+        that exits holding the pages — the classic leak shape); a disposal
+        only clears the paths it dominates, because kill() edits the one
+        state that flowed through it."""
+        for i in state.live():
+            a = self.acqs[i]
+            if a.leak_line is None:
+                a.leak_line = line
+                a.leak_kind = kind
+
+    # -- expression evaluation -----------------------------------------
+
+    def _eval(self, node: ast.expr | None, state: _State) -> set[int]:
+        """Ids the expression's VALUE may carry; performs acquire/dispose
+        side effects encountered inside it."""
+        if node is None:
+            return set()
+        if isinstance(node, ast.Name):
+            return set(state.ids_of(node.id))
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, state)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return set()
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, state)
+            return self._eval(node.body, state) | self._eval(node.orelse, state)
+        if isinstance(node, ast.BoolOp):
+            out: set[int] = set()
+            for v in node.values:
+                out |= self._eval(v, state)
+            return out
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            base = _base_name(node)
+            if isinstance(node, ast.Subscript):
+                # evaluate the index for side effects only: pages[k]'s VALUE
+                # carries pages' obligations, never k's
+                self._eval(node.slice, state)
+            return set(state.ids_of(base)) if base else set()
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in node.elts:
+                out |= self._eval(e, state)
+            return out
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if k is not None:
+                    out |= self._eval(k, state)
+            for v in node.values:
+                out |= self._eval(v, state)
+            return out
+        if isinstance(node, ast.BinOp):
+            return self._eval(node.left, state) | self._eval(node.right, state)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, state)
+        if isinstance(node, ast.Compare):
+            self._eval(node.left, state)
+            for c in node.comparators:
+                self._eval(c, state)
+            return set()
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self._eval(node.value, state)
+        if isinstance(node, ast.Yield):
+            return self._eval(node.value, state) if node.value else set()
+        if isinstance(node, ast.NamedExpr):
+            ids = self._eval(node.value, state)
+            state.carried[node.target.id] = set(ids)
+            return ids
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            out = set()
+            for gen in node.generators:
+                out |= self._eval(gen.iter, state)
+            out |= self._eval(node.elt, state)
+            return out
+        if isinstance(node, ast.DictComp):
+            out = set()
+            for gen in node.generators:
+                out |= self._eval(gen.iter, state)
+            return out | self._eval(node.key, state) | self._eval(node.value, state)
+        if isinstance(node, ast.Slice):
+            out = set()
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    out |= self._eval(part, state)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self._eval(v, state)
+            return set()
+        if isinstance(node, ast.FormattedValue):
+            self._eval(node.value, state)
+            return set()
+        return set()
+
+    def _arg_ids(self, node: ast.Call, state: _State) -> set[int]:
+        ids: set[int] = set()
+        for a in node.args:
+            ids |= self._eval(a, state)
+        for kw in node.keywords:
+            ids |= self._eval(kw.value, state)
+        return ids
+
+    def _eval_call(self, node: ast.Call, state: _State) -> set[int]:
+        name = _terminal_name(node.func)
+        sanctioned_line = node.lineno in self.owns_lines
+        # container mutators: pages.append(prep[1]) propagates into `pages`
+        # when the receiver is a local name, and is a struct-ownership
+        # transfer when the receiver is an attribute (self._q.append(x)).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and name in ("append", "extend", "insert", "add", "appendleft")
+        ):
+            arg_ids = self._arg_ids(node, state)
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                state.carried.setdefault(recv.id, set()).update(arg_ids)
+            else:
+                self._discharge(arg_ids)
+                state.kill(arg_ids)
+            return set()
+        arg_ids = self._arg_ids(node, state)
+        self._eval(node.func, state)
+        if name in self.dispose or name in self.transfer_fns or sanctioned_line:
+            self._discharge(arg_ids)
+            state.kill(arg_ids)
+            return set()
+        if name in self.acquire_by_arg:
+            if node.args:
+                base = _base_name(node.args[0])
+                if base is not None:
+                    acq = self._new_acq(node, f"{name}({base}...)")
+                    state.carried.setdefault(base, set()).add(acq)
+                    return set()
+            # incref of a non-name expression: obligation cannot be tracked;
+            # treat the line itself as the carrier so a bare statement is
+            # flagged unless sanctioned.
+            acq = self._new_acq(node, f"{name}(...)")
+            self.acqs[acq].leak_line = node.lineno
+            self.acqs[acq].leak_kind = "discard"
+            return set()
+        if name in self.acquire:
+            acq = self._new_acq(node, name)
+            return arg_ids | {acq}
+        # ordinary call: the result may alias its arguments (constructors,
+        # list(), sorted(), dataclasses.replace(...))
+        return arg_ids
+
+    # -- statement execution -------------------------------------------
+
+    def _none_kills(
+        self, test: ast.expr, state: _State
+    ) -> tuple[set[int], set[int]]:
+        """(ids dead in the body, ids dead in the orelse) for a branch
+        test — the allocator-failure idiom (`if pages is None: bail`)."""
+
+        def single(t: ast.expr) -> tuple[set[int], set[int]]:
+            if isinstance(t, ast.Compare) and len(t.ops) == 1:
+                l, op, r = t.left, t.ops[0], t.comparators[0]
+                if isinstance(l, ast.Name) and isinstance(r, ast.Constant) and r.value is None:
+                    ids = set(state.ids_of(l.id))
+                    if isinstance(op, ast.Is):
+                        return ids, set()
+                    if isinstance(op, ast.IsNot):
+                        return set(), ids
+            if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not) and isinstance(
+                t.operand, ast.Name
+            ):
+                return set(state.ids_of(t.operand.id)), set()
+            if isinstance(t, ast.Name):
+                return set(), set(state.ids_of(t.id))
+            return set(), set()
+
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            body_dead: set[int] = set()
+            for v in test.values:
+                body_dead |= single(v)[0]
+            return body_dead, set()
+        return single(test)
+
+    def _assign_to(self, target: ast.expr, ids: set[int], state: _State) -> None:
+        if isinstance(target, ast.Name):
+            state.carried[target.id] = set(ids)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_to(e, ids, state)
+        elif isinstance(target, ast.Starred):
+            self._assign_to(target.value, ids, state)
+        elif isinstance(target, ast.Subscript) and isinstance(target.value, ast.Name):
+            # pages[k] = new_page: the LOCAL list now carries the id too
+            # (it is returned/disposed as a whole); not a custody transfer
+            state.carried.setdefault(target.value.id, set()).update(ids)
+        else:
+            # attribute / non-local-subscript target: custody moved into a
+            # structure (a slot, a session entry, self._q[...])
+            self._discharge(ids)
+            state.kill(ids)
+
+    def _run_finals(self, state: _State) -> None:
+        """Execute active finally bodies (innermost first) on `state` —
+        a Return/Raise travels through them before leaving the function."""
+        for body in reversed(self._finals):
+            self._exec_block(body, state)
+
+    def _exec_block(self, stmts: list[ast.stmt], state: _State) -> _State | None:
+        """Execute statements on `state`; returns the fall-through state or
+        None when every path exited (return/raise/break/continue)."""
+        cur: _State | None = state
+        for s in stmts:
+            if cur is None:
+                break
+            cur = self._exec_stmt(s, cur)
+        return cur
+
+    def _exec_stmt(self, s: ast.stmt, state: _State) -> _State | None:
+        sanctioned_line = s.lineno in self.owns_lines
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = s.value
+            ids = self._eval(value, state) if value is not None else set()
+            if sanctioned_line:
+                self._discharge(ids)
+                state.kill(ids)
+                ids = set()
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            if isinstance(s, ast.AugAssign):
+                # x += expr keeps x's prior ids and adds the RHS's
+                if isinstance(s.target, ast.Name):
+                    state.carried.setdefault(s.target.id, set()).update(ids)
+                else:
+                    self._discharge(ids)
+                    state.kill(ids)
+            else:
+                for t in targets:
+                    self._assign_to(t, ids, state)
+            return state
+        if isinstance(s, ast.Expr):
+            ids = self._eval(s.value, state)
+            if sanctioned_line:
+                self._discharge(ids)
+                state.kill(ids)
+            elif isinstance(s.value, ast.Call):
+                # a bare acquiring call discards its result: nothing can
+                # ever discharge it
+                for i in ids:
+                    a = self.acqs[i]
+                    if a.leak_line is None and a.line == s.value.lineno:
+                        a.leak_line = s.lineno
+                        a.leak_kind = "discard"
+            return state
+        if isinstance(s, ast.Return):
+            ids = self._eval(s.value, state)
+            if self._sanctioned_return or sanctioned_line:
+                self._discharge(ids)
+                state.kill(ids)
+            exit_state = state.copy()
+            self._run_finals(exit_state)
+            self._check_exit(exit_state, s.lineno, "return")
+            return None
+        if isinstance(s, ast.Raise):
+            if s.exc is not None:
+                self._eval(s.exc, state)
+            exit_state = state.copy()
+            self._run_finals(exit_state)
+            self._check_exit(exit_state, s.lineno, "raise")
+            return None
+        if isinstance(s, ast.If):
+            self._eval(s.test, state)
+            dead_body, dead_else = self._none_kills(s.test, state)
+            st_body = state.copy()
+            st_body.kill(dead_body)
+            st_else = state.copy()
+            st_else.kill(dead_else)
+            out_body = self._exec_block(s.body, st_body)
+            out_else = self._exec_block(s.orelse, st_else) if s.orelse else st_else
+            if out_body is None and out_else is None:
+                return None
+            if out_body is None:
+                return out_else
+            if out_else is not None:
+                out_body.merge(out_else)
+            return out_body
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            self._breaks.append([])
+            self._continues.append([])
+            if isinstance(s, ast.While):
+                self._eval(s.test, state)
+                dead_body, _ = self._none_kills(s.test, state)
+            else:
+                # for x in zip(cow_idx, fresh): x may carry fresh's ids —
+                # iteration hands out the container's contents
+                iter_ids = self._eval(s.iter, state)
+                self._assign_to(s.target, iter_ids, state)
+                dead_body = set()
+            st_body = state.copy()
+            st_body.kill(dead_body)
+            out_body = self._exec_block(s.body, st_body)
+            breaks = self._breaks.pop()
+            continues = self._continues.pop()
+            after = state  # zero-iteration path
+            for extra in [out_body] + breaks + continues:
+                if extra is not None:
+                    after.merge(extra)
+            if s.orelse:
+                out = self._exec_block(s.orelse, after)
+                return out
+            return after
+        if isinstance(s, ast.Break):
+            if self._breaks:
+                self._breaks[-1].append(state.copy())
+            return None
+        if isinstance(s, ast.Continue):
+            if self._continues:
+                self._continues[-1].append(state.copy())
+            return None
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._eval(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._assign_to(item.optional_vars, set(), state)
+            return self._exec_block(s.body, state)
+        if isinstance(s, ast.Try):
+            # Any statement in the body may jump to any handler with any
+            # intermediate state: handlers start from the union.
+            if s.finalbody:
+                self._finals.append(s.finalbody)
+            handler_entry = state.copy()
+            cur: _State | None = state.copy()
+            for stmt in s.body:
+                if cur is None:
+                    break
+                cur = self._exec_stmt(stmt, cur)
+                if cur is not None:
+                    handler_entry.merge(cur)
+            after_body = cur
+            if after_body is not None and s.orelse:
+                after_body = self._exec_block(s.orelse, after_body)
+            outs: list[_State] = [] if after_body is None else [after_body]
+            for h in s.handlers:
+                st_h = handler_entry.copy()
+                if h.name:
+                    st_h.carried.pop(h.name, None)
+                out_h = self._exec_block(h.body, st_h)
+                if out_h is not None:
+                    outs.append(out_h)
+            if s.finalbody:
+                self._finals.pop()
+            if not outs:
+                # every path exited inside the try; the finally still ran
+                # for each of them via _run_finals
+                return None
+            merged = outs[0]
+            for o in outs[1:]:
+                merged.merge(o)
+            if s.finalbody:
+                out_f = self._exec_block(s.finalbody, merged)
+                return out_f
+            return merged
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return state  # unknown execution point: not descended
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    state.carried.pop(t.id, None)
+                else:
+                    self._eval(t, state)
+            return state
+        if isinstance(s, (ast.Import, ast.ImportFrom, ast.Pass, ast.Global, ast.Nonlocal)):
+            return state
+        if isinstance(s, ast.Assert):
+            self._eval(s.test, state)
+            return state
+        # anything else: evaluate child expressions for side effects
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._eval(child, state)
+        return state

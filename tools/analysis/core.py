@@ -99,18 +99,24 @@ class SourceFile:
                 self._tree = None
         return self._tree
 
-    def suppressed(self, line: int, pass_id: str) -> bool:
-        """Pragma on the finding's own line, or on a standalone comment line
-        directly above it (for statements too long to carry the pragma)."""
+    def suppression_line(self, line: int, pass_id: str) -> int | None:
+        """The pragma line suppressing a finding at ``line`` for ``pass_id``
+        — the finding's own line, or a standalone comment line directly
+        above it (for statements too long to carry the pragma). None when
+        not suppressed. The returned line feeds the stale-suppression
+        census (a pragma that suppresses nothing is itself a finding)."""
         ids = self.pragmas.get(line)
         if ids is not None and (pass_id in ids or "*" in ids):
-            return True
+            return line
         ids = self.pragmas.get(line - 1)
         if ids is not None and (pass_id in ids or "*" in ids):
             above = self.lines[line - 2].lstrip() if 0 <= line - 2 < len(self.lines) else ""
             if above.startswith("#"):
-                return True
-        return False
+                return line - 1
+        return None
+
+    def suppressed(self, line: int, pass_id: str) -> bool:
+        return self.suppression_line(line, pass_id) is not None
 
 
 def _strip_toml_comment(line: str) -> str:
@@ -166,18 +172,26 @@ def load_allowlist(path: pathlib.Path) -> dict[str, dict[str, Any]]:
 
 
 class Context:
-    """Everything a pass sees: the file set, the allowlist, the repo root."""
+    """Everything a pass sees: the file set, the allowlist, the repo root.
+
+    ``full_walk`` is True when the file set is the whole shipped tree —
+    inventory-shaped checks (require pins, stale suppressions) only run
+    then: a --changed / path-limited walk not seeing something means
+    "outside the walk", not "deleted".
+    """
 
     def __init__(
         self,
         root: pathlib.Path,
         files: list[SourceFile],
         allowlist: dict[str, dict[str, Any]] | None = None,
+        full_walk: bool = True,
     ):
         self.root = root
         self.files = files
         self.by_rel = {f.rel: f for f in files}
         self.allowlist = allowlist or {}
+        self.full_walk = full_walk
 
     def cfg(self, pass_id: str) -> dict[str, Any]:
         return self.allowlist.get(pass_id, {})
@@ -308,10 +322,20 @@ def discover(
 
 
 def run_passes(
-    ctx: Context, passes: Iterable[Pass]
+    ctx: Context,
+    passes: Iterable[Pass],
+    census: dict[str, Any] | None = None,
 ) -> list[Finding]:
     """Run passes over the context, apply pragma suppression, report parse
-    failures once, and return findings sorted by location."""
+    failures once, and return findings sorted by location.
+
+    On a full walk, a suppression that suppresses NOTHING is itself a
+    finding (``stale-suppression``): code churn quietly outliving its
+    pragmas would otherwise grow a fog of dead exemptions that later hides
+    a real violation on the same line. ``census`` (when given) is filled
+    with the suppression inventory for ``--stats``.
+    """
+    passes = list(passes)
     findings: list[Finding] = []
     for f in ctx.files:
         if ctx.skipped("parse", f.rel):
@@ -320,11 +344,84 @@ def run_passes(
             findings.append(
                 Finding("parse", f.rel, 1, "file does not parse; all passes skipped it")
             )
+    used: set[tuple[str, int]] = set()  # (rel, pragma line) that suppressed
+    suppressed_by_pass: dict[str, int] = {}
     for p in passes:
         for fd in p.run(ctx):
             sf = ctx.by_rel.get(fd.path)
-            if sf is not None and sf.suppressed(fd.line, fd.pass_id):
-                continue
+            if sf is not None:
+                pline = sf.suppression_line(fd.line, fd.pass_id)
+                if pline is not None:
+                    used.add((fd.path, pline))
+                    suppressed_by_pass[fd.pass_id] = (
+                        suppressed_by_pass.get(fd.pass_id, 0) + 1
+                    )
+                    continue
             findings.append(fd)
+    active = {p.id for p in passes}
+    all_active = active >= set(_registered_pass_ids())
+    pragma_total = pragma_stale = 0
+    for f in ctx.files:
+        for pline, ids in sorted(f.pragmas.items()):
+            judgeable = all_active if "*" in ids else ids <= active
+            if not judgeable:
+                continue
+            pragma_total += 1
+            if (f.rel, pline) in used:
+                continue
+            if not ctx.full_walk:
+                continue  # partial walk: the finding may live outside it
+            if f.suppressed(pline, "stale-suppression"):
+                continue  # a pragma can opt out of the census itself
+            pragma_stale += 1
+            findings.append(
+                Finding(
+                    "stale-suppression", f.rel, pline,
+                    f"pragma `ignore[{', '.join(sorted(ids))}]` suppresses "
+                    "nothing — the violation it exempted is gone",
+                    hint="delete the pragma (or fix the pass if the "
+                    "violation is real and no longer detected)",
+                )
+            )
+    if ctx.full_walk:
+        findings.extend(_stale_skip_globs(ctx, active))
+    if census is not None:
+        census.update(
+            {
+                "pragmas_judged": pragma_total,
+                "pragmas_used": len(used),
+                "pragmas_stale": pragma_stale,
+                "suppressed_findings_by_pass": dict(sorted(suppressed_by_pass.items())),
+            }
+        )
     findings.sort(key=lambda fd: (fd.path, fd.line, fd.pass_id))
     return findings
+
+
+def _registered_pass_ids() -> tuple[str, ...]:
+    # late import: core must not import the pass registry at module load
+    # (passes import core)
+    from tools.analysis.passes import PASS_IDS
+
+    return PASS_IDS
+
+
+def _stale_skip_globs(ctx: Context, active: set[str]) -> list[Finding]:
+    """Allowlist ``skip`` globs (for active passes) that match no scanned
+    file are dead suppressions too — same honesty rule as pragmas."""
+    out: list[Finding] = []
+    rels = [f.rel for f in ctx.files]
+    for section, cfg in sorted(ctx.allowlist.items()):
+        if section != "global" and section not in active:
+            continue
+        for pat in cfg.get("skip", []):
+            if not any(fnmatch.fnmatch(rel, pat) for rel in rels):
+                out.append(
+                    Finding(
+                        "stale-suppression", "tools/analysis/allowlist.toml", 1,
+                        f"[{section}] skip glob {pat!r} matches no scanned "
+                        "file — the thing it exempted is gone",
+                        hint="delete the entry (or fix the glob)",
+                    )
+                )
+    return out

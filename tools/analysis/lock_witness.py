@@ -1,4 +1,5 @@
-"""Runtime companion to the guarded-by pass: lock-ORDER witnessing.
+"""Runtime companion to the guarded-by pass: lock-ORDER witnessing plus an
+event-loop-blocking detector.
 
 Static analysis proves each guarded attribute sits under its lock; it
 cannot prove two locks are always taken in the same order — the ABBA
@@ -7,6 +8,15 @@ every "acquired B while holding A" edge per thread, and fails on a cycle in
 that graph: a cycle means two code paths disagree about lock order, i.e. a
 deadlock is one unlucky preemption away even if the test run never hung.
 
+It also records HOLD DURATION: a sync lock held for longer than
+``loop_block_threshold_s`` (default 50 ms) while the holding thread is
+running an asyncio event loop means every coroutine multiplexed on that
+loop stalled for the duration — the static twin is task-lifecycle's
+await-under-lock rule, but the runtime witness also catches the sneakier
+shape where the locked section never awaits yet still does slow work
+on-loop (the PR 11 base64-on-loop bug class). ``assert_no_loop_blocking()``
+fails teardown with the worst offenders.
+
 Usage (wired into tests/helpers_cp.py — every CPHarness test witnesses the
 storage/journal locks for free):
 
@@ -14,7 +24,8 @@ storage/journal locks for free):
     w.instrument(journal, "_mu", "journal._mu")
     w.instrument(journal, "_flush_lock", "journal._flush_lock")
     ... run the workload ...
-    w.assert_no_cycles()   # raises LockOrderError listing the cycle
+    w.assert_no_cycles()          # raises LockOrderError listing the cycle
+    w.assert_no_loop_blocking()   # raises LoopBlockError listing the holds
 
 Wrapped locks keep the Lock/RLock interface (acquire/release, context
 manager, ``locked``); re-entrant re-acquisition records no self-edge.
@@ -25,11 +36,18 @@ edges are small and deduplicated, so overhead stays negligible for tests
 
 from __future__ import annotations
 
+import asyncio
 import threading
+import time
 
 
 class LockOrderError(AssertionError):
     """Two code paths acquire the witnessed locks in conflicting order."""
+
+
+class LoopBlockError(AssertionError):
+    """A witnessed sync lock was held on an event-loop thread long enough
+    to visibly stall every coroutine on that loop."""
 
 
 class _WitnessedLock:
@@ -49,6 +67,22 @@ class _WitnessedLock:
     def release(self) -> None:
         self._witness._on_release(self.name)
         self.inner.release()
+
+    # threading.Condition(wrapped_lock) delegates to these on RLocks
+    def _is_owned(self):
+        fn = getattr(self.inner, "_is_owned", None)
+        if fn is not None:
+            return fn()
+        # plain Lock has no _is_owned, but because the proxy exposes the
+        # attr unconditionally Condition picks delegation over its own
+        # fallback — so mirror that fallback (a non-blocking probe)
+        # ourselves instead of raising AttributeError. Probe the inner
+        # lock directly: a probe is not an acquisition the witness
+        # should record.
+        if self.inner.acquire(blocking=False):
+            self.inner.release()
+            return False
+        return True
 
     def locked(self) -> bool:
         fn = getattr(self.inner, "locked", None)
@@ -70,12 +104,16 @@ class _WitnessedLock:
 
 
 class LockWitness:
-    def __init__(self) -> None:
+    def __init__(self, loop_block_threshold_s: float = 0.05) -> None:
         self._mu = threading.Lock()
         # lock name -> names acquired WHILE it was held, with one witnessed
         # stack (site) kept per edge for the error message.
         self._edges: dict[str, dict[str, tuple[str, ...]]] = {}
         self._held = threading.local()  # per-thread acquisition stack
+        self.loop_block_threshold_s = loop_block_threshold_s
+        # (lock name, hold seconds) for every over-threshold hold that
+        # happened on a thread running an asyncio event loop
+        self._loop_blocks: list[tuple[str, float]] = []
 
     # -- instrumentation -------------------------------------------------
 
@@ -103,21 +141,36 @@ class LockWitness:
 
     def _on_acquire(self, name: str) -> None:
         stack = self._stack()
-        if name not in stack:  # re-entrant RLock holds record no edges
+        names = [e[0] for e in stack]
+        if name not in names:  # re-entrant RLock holds record no edges
             with self._mu:
-                for outer in stack:
+                for outer in names:
                     self._edges.setdefault(outer, {}).setdefault(
-                        name, tuple(stack)
+                        name, tuple(names)
                     )
-        stack.append(name)
+        # Coroutine context: this thread is running an event loop, so a long
+        # hold stalls every task multiplexed on it. get_running_loop() is a
+        # thread-local read — cheap enough per acquisition in tests.
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+        stack.append((name, time.monotonic(), on_loop))
 
     def _on_release(self, name: str) -> None:
         stack = self._stack()
         # remove the most recent hold of `name` (locks are not always
         # released LIFO; acquire/release pairs may interleave)
         for i in range(len(stack) - 1, -1, -1):
-            if stack[i] == name:
+            if stack[i][0] == name:
+                _, t0, on_loop = stack[i]
                 del stack[i]
+                if on_loop:
+                    dt = time.monotonic() - t0
+                    if dt > self.loop_block_threshold_s:
+                        with self._mu:
+                            self._loop_blocks.append((name, dt))
                 return
 
     # -- analysis --------------------------------------------------------
@@ -162,6 +215,24 @@ class LockWitness:
                 if found:
                     return found
         return None
+
+    def loop_blocks(self) -> list[tuple[str, float]]:
+        with self._mu:
+            return list(self._loop_blocks)
+
+    def assert_no_loop_blocking(self) -> None:
+        """Fail when a witnessed sync lock was held past the threshold on an
+        event-loop thread — every coroutine on that loop stalled that long."""
+        blocks = self.loop_blocks()
+        if blocks:
+            worst = sorted(blocks, key=lambda b: -b[1])[:5]
+            detail = ", ".join(f"{n} held {dt * 1000:.0f}ms" for n, dt in worst)
+            raise LoopBlockError(
+                f"sync lock held >{self.loop_block_threshold_s * 1000:.0f}ms "
+                f"on an event-loop thread ({len(blocks)} hold(s): {detail}) — "
+                "move the slow section off-loop (asyncio.to_thread) or use "
+                "an asyncio.Lock for loop-only state"
+            )
 
     def assert_no_cycles(self) -> None:
         cyc = self.find_cycle()

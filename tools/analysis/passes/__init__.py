@@ -5,10 +5,14 @@ from __future__ import annotations
 
 from tools.analysis.core import Pass
 from tools.analysis.passes.async_blocking import AsyncBlockingPass
+from tools.analysis.passes.counter_contract import CounterContractPass
 from tools.analysis.passes.except_swallow import ExceptSwallowPass
+from tools.analysis.passes.fault_coverage import FaultCoveragePass
 from tools.analysis.passes.guarded_by import GuardedByPass
 from tools.analysis.passes.http_timeout import HttpTimeoutPass
 from tools.analysis.passes.knob_docs import KnobDocsPass
+from tools.analysis.passes.refcount_pairing import RefcountPairingPass
+from tools.analysis.passes.task_lifecycle import TaskLifecyclePass
 from tools.analysis.passes.tracer_safety import TracerSafetyPass
 
 ALL_PASSES: tuple[type[Pass], ...] = (
@@ -18,6 +22,10 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     TracerSafetyPass,
     KnobDocsPass,
     HttpTimeoutPass,
+    RefcountPairingPass,
+    TaskLifecyclePass,
+    CounterContractPass,
+    FaultCoveragePass,
 )
 
 PASS_IDS: tuple[str, ...] = tuple(p.id for p in ALL_PASSES)

@@ -1,0 +1,218 @@
+"""counter-contract: every counter reaches /metrics and a triage table.
+
+A counter that is incremented but never exported is observability theater:
+the engine bumps ``stats["X_total"]`` on the node, but if the key is not in
+the ALWAYS-PRESENT init surface (the engine stats dict literal / the pool's
+setdefault loop) it only rides heartbeats after it first fires — dashboards
+show "no data" exactly when the operator is deciding whether the feature is
+inert or broken. And a counter no docs page names is untriageable: the
+operator sees ``branch_forks_degraded_total`` climbing and has nowhere to
+look up what it means (docs/OPERATIONS.md keeps the triage tables).
+
+Three checks over ``serving/`` + ``control_plane/`` (scope: constant
+``*_total`` counter names and constant gauge names — dynamically composed
+names like ``engine_{k}`` are runtime-enumerable only and are skipped):
+
+1. **init-surface** — a ``stats["X_total"] += ...`` increment in the
+   serving stack must have an always-present init site: a dict-literal key
+   with value ``0``, or a ``setdefault(...)`` (direct or via the pool's
+   ``for k in (...): stats.setdefault(k, 0)`` idiom). Control-plane
+   ``metrics.inc``/``set_gauge`` calls hit the registry directly (the
+   registry IS the export surface), so they skip this check.
+2. **doc-coverage** — every counter/gauge name must appear in docs/*.md.
+3. **require pins** — ``[counter-contract] require`` entries in
+   allowlist.toml name counters that MUST keep an increment site somewhere
+   in the scanned tree; deleting the export (or renaming the counter)
+   without editing the pin is a finding. The pin list is the reviewed
+   inventory of the counter families tests and runbooks depend on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "counter-contract"
+
+_TOTAL_RE = re.compile(r"\A[a-z][a-z0-9_]*_total\Z")
+
+
+_BRACE_RE = re.compile(r"([A-Za-z0-9_]*)\{([A-Za-z0-9_,]+)\}([A-Za-z0-9_]*)")
+
+
+def _docs_text(ctx: Context) -> str:
+    """docs/*.md corpus, with counter-family brace notation expanded: the
+    runbooks write ``kv_fetch_{requested,failed}_total`` for a family — each
+    member counts as documented."""
+    docs = sorted((ctx.root / "docs").glob("*.md"))
+    text = "\n".join(p.read_text(encoding="utf-8") for p in docs)
+    expanded: list[str] = []
+    for m in _BRACE_RE.finditer(text):
+        pre, alts, post = m.groups()
+        expanded.extend(f"{pre}{alt}{post}" for alt in alts.split(",") if alt)
+    return text + "\n" + "\n".join(expanded)
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _FileFacts:
+    """Counter-relevant sites in one file."""
+
+    def __init__(self) -> None:
+        # name -> first line: stats["X"] += / = increments
+        self.stats_incs: dict[str, int] = {}
+        # name -> first line: metrics.inc("X") / set_gauge("X") constants
+        self.registry_names: dict[str, int] = {}
+        # names with an always-present init site (dict key: 0 / setdefault)
+        self.inits: set[str] = set()
+
+
+def _collect(f: SourceFile) -> _FileFacts:
+    facts = _FileFacts()
+    for node in ast.walk(f.tree):
+        # stats["X"] += 1   (AugAssign on a Subscript of something .stats)
+        if isinstance(node, (ast.AugAssign, ast.Assign)):
+            targets = [node.target] if isinstance(node, ast.AugAssign) else node.targets
+            for t in targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                chain = attr_chain(t.value)
+                if not chain or chain[-1] != "stats":
+                    continue
+                name = _const_str(t.slice)
+                if name is not None:
+                    facts.stats_incs.setdefault(name, t.lineno)
+        elif isinstance(node, ast.Call):
+            term = None
+            if isinstance(node.func, (ast.Attribute, ast.Name)):
+                ch = attr_chain(node.func)
+                term = ch[-1] if ch else None
+            if term in ("inc", "set_gauge") and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    facts.registry_names.setdefault(name, node.lineno)
+            elif term == "setdefault" and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    facts.inits.add(name)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                name = k is not None and _const_str(k)
+                if name and isinstance(v, ast.Constant) and v.value == 0:
+                    facts.inits.add(name)
+        elif isinstance(node, ast.For):
+            # the pool idiom: for k in ("a_total", ...): stats.setdefault(k, 0)
+            body_setdefaults = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "setdefault"
+                for s in node.body
+                for c in ast.walk(s)
+            )
+            if body_setdefaults and isinstance(node.iter, (ast.Tuple, ast.List, ast.Set)):
+                for e in node.iter.elts:
+                    name = _const_str(e)
+                    if name:
+                        facts.inits.add(name)
+    return facts
+
+
+class CounterContractPass(Pass):
+    id = _ID
+    description = (
+        "*_total counters and named gauges are always-present in the "
+        "stats→heartbeat→/metrics export surface, documented in a docs/ "
+        "triage table, and the pinned counter inventory still exists"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        parts = rel.split("/")
+        return "serving" in parts or "control_plane" in parts
+
+    def run(self, ctx: Context) -> list[Finding]:
+        if not ctx.full_walk:
+            # init sites and increment sites live in different files (the
+            # pool initializes what the node increments): a partial walk
+            # cannot tell "missing" from "outside the walk"
+            return []
+        scanned = [
+            f for f in ctx.files
+            if self.relevant(f.rel) and not ctx.skipped(self.id, f.rel)
+            and f.tree is not None
+        ]
+        if not scanned:
+            return []
+        docs = _docs_text(ctx)
+        all_inits: set[str] = set()
+        per_file: list[tuple[SourceFile, _FileFacts]] = []
+        for f in scanned:
+            facts = _collect(f)
+            all_inits |= facts.inits
+            per_file.append((f, facts))
+        findings: list[Finding] = []
+        seen_names: dict[str, tuple[str, int]] = {}  # name -> first site
+        doc_flagged: set[str] = set()
+        for f, facts in per_file:
+            for name, line in sorted(facts.stats_incs.items(), key=lambda kv: kv[1]):
+                if not _TOTAL_RE.match(name):
+                    continue
+                seen_names.setdefault(name, (f.rel, line))
+                if name not in all_inits:
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, line,
+                            f"counter {name!r} is incremented but has no "
+                            "always-present init site — it reaches /metrics "
+                            "only after it first fires",
+                            hint="add it to the engine stats dict literal "
+                            "(or the pool's setdefault loop) with value 0",
+                        )
+                    )
+                if name not in docs and name not in doc_flagged:
+                    doc_flagged.add(name)
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, line,
+                            f"counter {name!r} is not documented in any "
+                            "docs/*.md triage table",
+                            hint="add a triage row (what it counts, what a "
+                            "nonzero means) to docs/OPERATIONS.md",
+                        )
+                    )
+            for name, line in sorted(facts.registry_names.items(), key=lambda kv: kv[1]):
+                if not (_TOTAL_RE.match(name) or name.endswith("_depth")
+                        or name.startswith("nodes_")):
+                    continue
+                seen_names.setdefault(name, (f.rel, line))
+                if name not in docs and name not in doc_flagged:
+                    doc_flagged.add(name)
+                    findings.append(
+                        Finding(
+                            self.id, f.rel, line,
+                            f"metric {name!r} is not documented in any "
+                            "docs/*.md triage table",
+                            hint="add a triage row (what it counts, what a "
+                            "nonzero means) to docs/OPERATIONS.md",
+                        )
+                    )
+        allow_rel = "tools/analysis/allowlist.toml"
+        for pin in ctx.cfg(self.id).get("require", []):
+            if pin not in seen_names:
+                findings.append(
+                    Finding(
+                        self.id, allow_rel, 1,
+                        f"pinned counter {pin!r} has no increment site "
+                        "left in serving/ or control_plane/ — its export "
+                        "was deleted or renamed silently",
+                        hint="restore the counter, or remove the pin in "
+                        "the same reviewed change that removes its "
+                        "dashboards/runbook rows",
+                    )
+                )
+        return findings

@@ -1,0 +1,139 @@
+"""fault-coverage: every registered fault point is consulted, documented,
+and exercised by at least one test.
+
+``control_plane/faults.py`` rejects unknown point names loudly so a typo'd
+chaos spec cannot pass vacuously — but nothing stopped the inverse rot:
+a point that stays in ``KNOWN_POINTS`` after the code that consulted it was
+refactored away (every chaos spec naming it becomes a silent no-op), or a
+point that fires in production code but no doc names and no test pins. This
+pass closes the loop over the registry itself. For each name in
+``KNOWN_POINTS``:
+
+1. **consulted** — the point-name string literal appears as a call argument
+   somewhere in the scanned tree outside faults.py (``faults.fire("...")``,
+   the engine's ``_engine_fault``/``_kv_fault`` aliases, the bench's
+   harness-level consultations);
+2. **documented** — docs/FAULT_TOLERANCE.md names it (the fault-point table
+   is the operator's index of what chaos coverage exists);
+3. **tested** — at least one ``tests/test_*.py`` mentions it (tests are not
+   in the scan set, so their text is read directly — a fault point no chaos
+   test names is untested injection machinery).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass
+
+_ID = "fault-coverage"
+
+_FAULTS_REL = "agentfield_tpu/control_plane/faults.py"
+_DOC_REL = "docs/FAULT_TOLERANCE.md"
+
+
+def _known_points(tree: ast.AST) -> dict[str, int]:
+    """KNOWN_POINTS entries -> line, from the module-level tuple literal."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "KNOWN_POINTS" for t in node.targets
+        ):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out[e.value] = e.lineno
+    return out
+
+
+class FaultCoveragePass(Pass):
+    id = _ID
+    description = (
+        "every fault point in control_plane/faults.py KNOWN_POINTS is "
+        "consulted by reachable code, named in docs/FAULT_TOLERANCE.md, "
+        "and exercised by at least one test"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        # any code change can delete a consultation site; re-run whenever
+        # faults.py or a consulting plane changes
+        parts = rel.split("/")
+        return (
+            rel == _FAULTS_REL
+            or "control_plane" in parts
+            or "serving" in parts
+            or rel == "bench.py"
+        )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        if not ctx.full_walk:
+            # consultation sites live anywhere in the tree: judging them
+            # from a --changed / path-limited subset would flag every point
+            # whose consulting file is simply outside the walk
+            return []
+        faults = ctx.by_rel.get(_FAULTS_REL)
+        if faults is None or faults.tree is None or ctx.skipped(self.id, faults.rel):
+            return []
+        points = _known_points(faults.tree)
+        if not points:
+            return []
+        # call-argument string constants across the scanned tree; tests are
+        # included — harness-level points (node.kill) are BY DESIGN consulted
+        # from the chaos harness, not from production code
+        consulted: set[str] = set()
+        trees = [f.tree for f in ctx.files if f.rel != _FAULTS_REL and f.tree]
+        tests_chunks: list[str] = []
+        for p in sorted((ctx.root / "tests").glob("test_*.py")):
+            text = p.read_text(encoding="utf-8")
+            tests_chunks.append(text)
+            try:
+                trees.append(ast.parse(text))
+            except SyntaxError:
+                pass
+        for tree in trees:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        consulted.add(a.value)
+        doc_path = ctx.root / _DOC_REL
+        doc_text = doc_path.read_text(encoding="utf-8") if doc_path.is_file() else ""
+        tests_text = "\n".join(tests_chunks)
+        findings: list[Finding] = []
+        for point, line in sorted(points.items(), key=lambda kv: kv[1]):
+            if point not in consulted:
+                findings.append(
+                    Finding(
+                        self.id, faults.rel, line,
+                        f"fault point {point!r} is registered but nothing in "
+                        "the tree consults it — every chaos spec naming it "
+                        "is a silent no-op",
+                        hint="wire a faults.fire(...) consultation at the "
+                        "failure site, or remove the dead registry entry",
+                    )
+                )
+            if point not in doc_text:
+                findings.append(
+                    Finding(
+                        self.id, faults.rel, line,
+                        f"fault point {point!r} is not named in "
+                        f"{_DOC_REL} (the fault-point table)",
+                        hint="add its row: what it breaks, what the "
+                        "degradation contract is",
+                    )
+                )
+            if point not in tests_text:
+                findings.append(
+                    Finding(
+                        self.id, faults.rel, line,
+                        f"fault point {point!r} appears in no tests/test_*.py "
+                        "— the injection machinery for it is untested",
+                        hint="add a chaos test consulting the point (seeded, "
+                        "asserting the degradation contract)",
+                    )
+                )
+        return findings

@@ -50,6 +50,11 @@ class KnobDocsPass(Pass):
         parts = rel.split("/")
         if "control_plane" in parts or "ops" in parts or "serving" in parts:
             return True
+        # bench.py + tools/perf: the AGENTFIELD_BENCH_* knob surface is how
+        # anyone reproduces a committed BENCH_r*.json — an undocumented
+        # bench knob makes the numbers unreproducible (PERFORMANCE.md)
+        if rel == "bench.py" or rel.startswith("tools/perf/"):
+            return True
         # top-level package modules (branching.py, config.py, logging.py,
         # prefix_hash.py, ...): jax-free leaves both planes import — their
         # env reads are operator knobs too
@@ -107,4 +112,16 @@ class KnobDocsPass(Pass):
                             "if operators never set it)",
                         )
                     )
+        if ctx.full_walk:
+            # stale knob_allow entries are dead suppressions (same honesty
+            # rule as pragmas): an exempted knob nothing reads any more
+            for knob in sorted(allow - seen):
+                findings.append(
+                    Finding(
+                        self.id, "tools/analysis/allowlist.toml", 1,
+                        f"knob_allow entry {knob} matches no env read in the "
+                        "scanned tree — the knob it exempted is gone",
+                        hint="delete the entry",
+                    )
+                )
         return findings

@@ -1,0 +1,131 @@
+"""refcount-pairing: every page acquisition reaches a paired disposition.
+
+The serving stack's worst bug class is a leaked KV page: an error path that
+acquires pages (``alloc``/``lookup``/``incref``/a COW fork's tail copy) and
+exits without releasing, parking, or handing them to a longer-lived owner.
+Every PR's bench re-proves "zero leaked pages" dynamically; this pass is the
+static twin — it walks each function's CFG (tools/analysis/cfg.py) in the
+refcount-bearing files (``kv_cache.py``, ``engine.py``, ``model_node.py``
+under ``serving/``) and flags acquisitions that can reach a function exit
+(return / raise / fall-off / discarded result) undisposed on some path.
+
+Dispositions the walker recognizes:
+
+- a ``free``/``park``/``release`` call carrying the acquisition;
+- storing the carrying value into an attribute/subscript (custody moves
+  into a structure: a slot, a session entry, the prefill-job list);
+- returning it from a function that is itself in the acquiring set (the
+  allocator primitives) or whose ``def`` line carries the transfer
+  annotation::
+
+      def _install(self, req, slot_idx, pages, ...):  # afcheck: owns-pages slot table owns them until release
+- passing it into a call of such an annotated function, or any statement on
+  a line carrying ``# afcheck: owns-pages <why>``;
+- the allocator-failure idiom ``if pages is None: <bail>`` kills the
+  obligation inside the failure branch (all-or-nothing alloc).
+
+The acquiring/disposing name sets are pinned in ``allowlist.toml``
+(``[refcount-pairing] acquire/dispose``) so growing the custody surface is
+a reviewed edit, with built-in defaults matching the engine's API.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.cfg import ObligationWalker
+from tools.analysis.core import Context, Finding, Pass, SourceFile
+
+_ID = "refcount-pairing"
+
+OWNS_RE = re.compile(r"#\s*afcheck:\s*owns-pages\b")
+
+# Calls whose result carries a fresh page obligation. Functions in this set
+# are also sanctioned to RETURN carried pages (they are the primitives).
+_DEFAULT_ACQUIRE = (
+    "alloc",
+    "lookup",
+    "adopt_host_pages",
+    "_alloc_with_eviction",
+    "_acquire_pages_locked",
+    "_prepare_restore",
+    "_restore_alloc",
+)
+# Calls whose obligation attaches to their first argument (extra references
+# taken on an existing page list).
+_DEFAULT_ACQUIRE_BY_ARG = ("incref",)
+# Calls that discharge every obligation carried by their arguments.
+_DEFAULT_DISPOSE = ("free", "park", "release")
+
+_FILES = ("kv_cache.py", "engine.py", "model_node.py")
+
+
+class RefcountPairingPass(Pass):
+    id = _ID
+    description = (
+        "page-acquiring calls (alloc/lookup/incref/...) reach a paired "
+        "free/park/ownership-transfer on every path, including exception "
+        "edges, in the refcount-bearing serving files"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        parts = rel.split("/")
+        return "serving" in parts and parts[-1] in _FILES
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        cfg = ctx.cfg(self.id)
+        acquire = set(cfg.get("acquire", _DEFAULT_ACQUIRE))
+        acquire_by_arg = set(cfg.get("acquire_by_arg", _DEFAULT_ACQUIRE_BY_ARG))
+        dispose = set(cfg.get("dispose", _DEFAULT_DISPOSE))
+        # trailing comment annotates its own line; a STANDALONE comment line
+        # annotates the statement below it (same convention as pragmas)
+        owns_lines: set[int] = set()
+        for i, c in f.comments.items():
+            if not OWNS_RE.search(c):
+                continue
+            owns_lines.add(i)
+            src = f.lines[i - 1].lstrip() if 0 <= i - 1 < len(f.lines) else ""
+            if src.startswith("#"):
+                owns_lines.add(i + 1)
+        # functions whose def line carries the annotation take custody of
+        # page arguments (and may return pages) — collected per file so a
+        # same-file call by any name form (self.X / bare X) matches
+        transfer_fns: set[str] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ann_lines = {node.lineno} | {d.lineno for d in node.decorator_list}
+                if ann_lines & owns_lines:
+                    transfer_fns.add(node.name)
+        findings: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name == "__init__":
+                continue
+            walker = ObligationWalker(
+                node,
+                acquire=acquire,
+                acquire_by_arg=acquire_by_arg,
+                dispose=dispose,
+                transfer_fns=transfer_fns,
+                owns_lines=owns_lines,
+            )
+            for leak in walker.run():
+                where = (
+                    "its result is discarded"
+                    if leak.leak_kind == "discard"
+                    else f"a path exits ({leak.leak_kind}, line {leak.leak_line}) "
+                    "still holding it"
+                )
+                findings.append(
+                    Finding(
+                        self.id, f.rel, leak.line,
+                        f"page acquisition `{leak.label}` in {node.name}() has "
+                        f"no paired disposition: {where}",
+                        hint="free/park it on that path, store it into its "
+                        "owning structure, or annotate the deliberate "
+                        "transfer with `# afcheck: owns-pages <why>`",
+                    )
+                )
+        return findings

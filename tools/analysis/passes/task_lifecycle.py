@@ -1,0 +1,408 @@
+"""task-lifecycle: asyncio tasks are retained, cancellable, and cancelable.
+
+Three invariants over the repo's async code (docs/STATIC_ANALYSIS.md):
+
+1. **retention + cancellation reachability** — the result of
+   ``asyncio.create_task``/``ensure_future``/``loop.create_task`` must be
+   retained (the loop holds tasks weakly: a discarded handle can be
+   garbage-collected mid-flight) AND, when parked on an attribute or in a
+   collection, that attribute must be reachable from a cancellation path —
+   some function in the file whose name says teardown (``close``/``stop``/
+   ``drain``/``shutdown``/``aclose``/``cancel*``/``teardown``/
+   ``__aexit__``) references it. A deliberate fire-and-forget spawn carries
+   ``# afcheck: fire-and-forget <why>`` on its line instead.
+2. **no await under a sync lock** — ``with self._lock:`` (any lock-ish
+   name: ``*lock*``, ``*mutex*``, ``_mu``) enclosing an ``await`` in an
+   ``async def`` parks the event loop on a thread mutex: every other
+   coroutine stalls until the holder resumes (the PR 11 base64-on-loop bug
+   class). Locks shared with real threads must be taken via
+   ``asyncio.to_thread``; loop-only state wants ``asyncio.Lock``.
+3. **cancellation absorption** — inside a loop in an ``async def``, an
+   ``except`` that can catch ``CancelledError`` (bare, ``BaseException``,
+   or explicit ``CancelledError``) while the try body awaits, and neither
+   re-raises nor leaves the loop, absorbs an external cancel and keeps
+   looping — ``stop()`` then hangs forever awaiting the task (the PR 11
+   ``stop()``-hang class). ``except Exception`` does NOT catch a clean
+   ``CancelledError`` on py3.8+ — but when the try body runs under the
+   ``aio_timeout`` py3.10 backport, an external cancel landing in the
+   deadline window coalesces with the backport's own task.cancel and gets
+   RELABELED ``TimeoutError`` (an ``Exception``), so there an ``except
+   Exception``/``except TimeoutError`` that keeps looping is the same
+   hang — the exact shape of the PR 11 ``ModelBackend.stop()`` bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "task-lifecycle"
+
+FIRE_AND_FORGET_RE = re.compile(r"#\s*afcheck:\s*fire-and-forget\b")
+
+_SPAWN_NAMES = ("create_task", "ensure_future")
+_CANCEL_FN_RE = re.compile(
+    r"(?:^|_)(close|aclose|stop|drain|shutdown|cancel\w*|teardown|disconnect)"
+    r"(?:_|$)|^__aexit__$"
+)
+_LOCKISH_RE = re.compile(r"lock|mutex|^_?mu$", re.IGNORECASE)
+_CANCELLED_NAMES = {"CancelledError", "BaseException"}
+
+
+def _is_spawn(node: ast.Call) -> bool:
+    chain = attr_chain(node.func)
+    return bool(chain) and chain[-1] in _SPAWN_NAMES
+
+
+def _lockish(expr: ast.expr) -> str | None:
+    """The lock-ish terminal name of a `with` context expression, if any."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    term = chain[-1]
+    if _LOCKISH_RE.search(term):
+        return ".".join(chain)
+    return None
+
+
+def _handler_names(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return []
+    names = []
+    for e in t.elts if isinstance(t, ast.Tuple) else [t]:
+        chain = attr_chain(e)
+        if chain:
+            names.append(chain[-1])
+    return names
+
+
+def _catches_cancel(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except
+    return any(n in _CANCELLED_NAMES for n in _handler_names(handler))
+
+
+def _catches_relabeled_cancel(handler: ast.ExceptHandler) -> bool:
+    """Under the aio_timeout backport an external cancel can surface as
+    TimeoutError — caught by Exception/TimeoutError handlers."""
+    return any(
+        n in ("Exception", "TimeoutError", "AsyncTimeoutError")
+        for n in _handler_names(handler)
+    )
+
+
+def _body_uses_timeout_backport(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        chain = attr_chain(expr.func)
+                        if chain and chain[-1] == "aio_timeout":
+                            return True
+    return False
+
+
+def _reraises_or_leaves(handler: ast.ExceptHandler) -> bool:
+    """The handler re-raises, returns, or breaks out of the loop — any of
+    which ends the absorption (the cancel either propagates or the loop
+    stops spinning)."""
+    for n in ast.walk(handler):
+        if isinstance(n, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+class _AsyncWalker(ast.NodeVisitor):
+    """One pass over a module: collects spawn sites, await-under-lock, and
+    cancel-absorbing loop handlers. Tracks async-def nesting and loop depth
+    the same way the async-blocking pass does."""
+
+    def __init__(self, f: SourceFile, findings: list[Finding]):
+        self.f = f
+        self.findings = findings
+        self.async_depth = 0
+        self.loop_depth = 0
+        self.sync_locks: list[str] = []  # `with <lock>` stack inside async defs
+        # attribute names holding tasks -> first spawn line (checked against
+        # the file's cancellation functions afterwards)
+        self.attr_tasks: dict[str, int] = {}
+        # attribute names referenced inside cancellation-path functions
+        self.cancel_reachable: set[str] = set()
+
+    # -- structure tracking --------------------------------------------
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node, is_async=True)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node, is_async=False)
+
+    def _visit_fn(self, node, is_async: bool) -> None:
+        if _CANCEL_FN_RE.search(node.name):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Attribute):
+                    self.cancel_reachable.add(n.attr)
+                elif isinstance(n, ast.Name):
+                    self.cancel_reachable.add(n.id)
+                elif (
+                    # getattr(self, "_vision_warm", None) in stop(): the
+                    # defensive-access idiom still reaches the task
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "getattr"
+                    and len(n.args) >= 2
+                    and isinstance(n.args[1], ast.Constant)
+                    and isinstance(n.args[1].value, str)
+                ):
+                    self.cancel_reachable.add(n.args[1].value)
+        # sync defs nested in async defs are to_thread helpers — their
+        # bodies run OFF the loop, so the loop-bound rules (2/3) key off
+        # async_depth, which a nested sync def leaves untouched; spawn
+        # retention (rule 1) applies everywhere.
+        outer_loops, self.loop_depth = self.loop_depth, 0
+        outer_locks, self.sync_locks = self.sync_locks, []
+        outer_async = self.async_depth
+        self.async_depth = outer_async + 1 if is_async else 0
+        self.generic_visit(node)
+        self.async_depth = outer_async
+        self.loop_depth = outer_loops
+        self.sync_locks = outer_locks
+
+    def visit_While(self, node: ast.While) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    # -- rule 2: await under a sync lock -------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = [
+            lk for item in node.items if (lk := _lockish(item.context_expr))
+        ]
+        self.sync_locks.extend(locks)
+        self.generic_visit(node)
+        if locks:
+            del self.sync_locks[-len(locks):]
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.async_depth and self.sync_locks:
+            self.findings.append(
+                Finding(
+                    _ID, self.f.rel, node.lineno,
+                    f"await while holding sync lock `{self.sync_locks[-1]}` "
+                    "blocks the event loop until the holder resumes",
+                    hint="use asyncio.Lock for loop-only state, or hop the "
+                    "locked section off-loop via asyncio.to_thread",
+                )
+            )
+        self.generic_visit(node)
+
+    # -- rule 3: cancellation absorption in loops ----------------------
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if self.async_depth and self.loop_depth:
+            body_awaits = any(
+                isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+                for stmt in node.body
+                for n in ast.walk(stmt)
+            )
+            backport = body_awaits and _body_uses_timeout_backport(node.body)
+            if body_awaits:
+                for h in node.handlers:
+                    if _reraises_or_leaves(h):
+                        continue
+                    if _catches_cancel(h):
+                        self.findings.append(
+                            Finding(
+                                _ID, self.f.rel, h.lineno,
+                                "except handler in a loop absorbs "
+                                "CancelledError and keeps looping — an "
+                                "external cancel() never lands, stop() "
+                                "hangs awaiting this task",
+                                hint="re-raise CancelledError (add `raise`) "
+                                "or break/return out of the loop",
+                            )
+                        )
+                    elif backport and _catches_relabeled_cancel(h):
+                        self.findings.append(
+                            Finding(
+                                _ID, self.f.rel, h.lineno,
+                                "except handler in a loop can absorb an "
+                                "external cancel RELABELED TimeoutError by "
+                                "the aio_timeout backport (a cancel in the "
+                                "deadline window coalesces with the "
+                                "backport's own task.cancel) and keeps "
+                                "looping — stop() hangs",
+                                hint="use asyncio.wait_for for the idle "
+                                "wait (external cancels propagate), or "
+                                "break/return on timeout",
+                            )
+                        )
+        self.generic_visit(node)
+
+    # -- rule 1: spawn retention ---------------------------------------
+
+    def _spawn_pragma(self, line: int) -> bool:
+        c = self.f.comments.get(line) or self.f.comments.get(line - 1)
+        return bool(c and FIRE_AND_FORGET_RE.search(c))
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if isinstance(node.value, ast.Call) and _is_spawn(node.value):
+            if not self._spawn_pragma(node.lineno):
+                self.findings.append(
+                    Finding(
+                        _ID, self.f.rel, node.lineno,
+                        "task spawned and discarded: the loop holds tasks "
+                        "weakly (it may be GC'd mid-flight) and no teardown "
+                        "can ever cancel it",
+                        hint="retain the handle (attr or tracked set wired "
+                        "into close/stop), or annotate `# afcheck: "
+                        "fire-and-forget <why>`",
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        spawned = self._spawn_in(node.value)
+        if spawned is not None:
+            for t in node.targets:
+                self._record_binding(t, node.lineno)
+        self.generic_visit(node)
+
+    def _spawn_in(self, expr: ast.expr) -> ast.Call | None:
+        """A spawn call at the top of `expr` (direct, or inside a
+        comprehension/list used to build a task collection)."""
+        if isinstance(expr, ast.Call) and _is_spawn(expr):
+            return expr
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            if isinstance(expr.elt, ast.Call) and _is_spawn(expr.elt):
+                return expr.elt
+        if isinstance(expr, (ast.List, ast.Set, ast.Tuple)):
+            for e in expr.elts:
+                if isinstance(e, ast.Call) and _is_spawn(e):
+                    return e
+        return None
+
+    def _record_binding(self, target: ast.expr, line: int) -> None:
+        if isinstance(target, ast.Attribute):
+            # self._task = create_task(...) / st.task = create_task(...)
+            self.attr_tasks.setdefault(target.attr, line)
+        # local-name bindings: checked by _check_local at the call site's
+        # enclosing function via the simpler file-level heuristic below
+        # (the name must be used again: awaited, cancelled, stored, passed)
+
+    # local-name escape analysis lives in check_file (needs the enclosing
+    # function body, which NodeVisitor does not hand us here)
+
+
+class TaskLifecyclePass(Pass):
+    id = _ID
+    description = (
+        "asyncio tasks are retained and reachable from a cancellation path; "
+        "no await under a sync lock; loops never absorb CancelledError"
+    )
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        w = _AsyncWalker(f, findings)
+        w.visit(f.tree)
+        # retention for attr-parked tasks: the attr must appear in some
+        # cancellation-path function in the SAME file
+        for attr, line in sorted(w.attr_tasks.items(), key=lambda kv: kv[1]):
+            if attr in w.cancel_reachable:
+                continue
+            if w._spawn_pragma(line):
+                continue
+            findings.append(
+                Finding(
+                    self.id, f.rel, line,
+                    f"task parked on `.{attr}` is unreachable from any "
+                    "cancellation path (no close/stop/drain/shutdown/cancel "
+                    "function in this file references it)",
+                    hint="cancel it from the owner's close()/stop(), or "
+                    "annotate `# afcheck: fire-and-forget <why>`",
+                )
+            )
+        # local-name retention: a spawn bound to a local that is never used
+        # again in the enclosing function is as good as discarded
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._check_locals(f, fn))
+        return findings
+
+    @staticmethod
+    def _own_scope(fn):
+        """Yield fn's nodes without descending into nested def/lambda
+        scopes — a nested function is its own check_file walk target,
+        and its locals are a different namespace (walking it here would
+        double-report its spawns and let a same-named local in the outer
+        scope mask them)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_locals(self, f: SourceFile, fn) -> list[Finding]:
+        out: list[Finding] = []
+        # spawns in fn's own scope only; nested defs are their own targets
+        for stmt in self._own_scope(fn):
+            if not isinstance(stmt, ast.Assign) or not isinstance(
+                stmt.value, ast.Call
+            ):
+                continue
+            if not _is_spawn(stmt.value):
+                continue
+            names = [
+                t.id for t in stmt.targets if isinstance(t, ast.Name)
+            ]
+            if not names:
+                continue
+            name = names[0]
+            used = False
+            # the use scan DOES descend into nested defs: a closure
+            # referencing the task keeps it reachable
+            for n in ast.walk(fn):
+                if (
+                    isinstance(n, ast.Name)
+                    and n.id == name
+                    and n.lineno > stmt.lineno
+                ):
+                    used = True
+                    break
+            if not used:
+                c = f.comments.get(stmt.lineno) or f.comments.get(stmt.lineno - 1)
+                if c and FIRE_AND_FORGET_RE.search(c):
+                    continue
+                out.append(
+                    Finding(
+                        self.id, f.rel, stmt.lineno,
+                        f"task bound to `{name}` is never awaited, cancelled, "
+                        "or stored — it can be GC'd mid-flight and nothing "
+                        "can cancel it",
+                        hint="track it (set + done-callback discard, or an "
+                        "attr a close()/stop() cancels), await it, or "
+                        "annotate `# afcheck: fire-and-forget <why>`",
+                    )
+                )
+        return out

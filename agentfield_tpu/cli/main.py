@@ -164,7 +164,7 @@ def cmd_model(cfg: Config, args) -> int:
             prefill_impl=mn.prefill_impl,
             prefill_chunk=mn.prefill_chunk,
             decode_span=mn.decode_span,
-            kv_write_impl=mn.kv_write_impl,
+            kv_quant_dtype=mn.kv_quant_dtype,
             grammar_slots=mn.grammar_slots,
         )
         agent, backend = build_model_node(

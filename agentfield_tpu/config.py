@@ -61,8 +61,10 @@ class ModelNodeConfig:
     prefill_chunk: int | None = None  # chunked prefill (>= 16) or whole-prompt
     decode_span: int = 1  # decode steps per device dispatch (one token
     # readback per span — set 8-16 on high-latency device links)
-    kv_write_impl: str = "ref"  # DEPRECATED alias of attn_impl: "pallas"
-    # selects the fused ragged kernel path (docs/KERNELS.md)
+    kv_quant_dtype: str = "none"  # quantized KV pages: "int8" | "fp8"
+    # store K/V pages quantized with per-slot scales (~2x pages per HBM
+    # byte; docs/KERNELS.md "Quantized pages"). (The old kv_write_impl
+    # alias is removed — attn_impl="pallas" selects the fused kernel.)
     grammar_slots: int = 256  # constrained-decoding bank rows (0 disables)
     grammar_whitespace: bool = False  # accept bounded whitespace in
     # schema-constrained output (pretty-printed JSON) instead of canonical

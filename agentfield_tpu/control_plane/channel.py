@@ -40,10 +40,21 @@ kind                dir     meaning
                             ``chains`` (hex chain hashes); the gateway relays
                             it gw→node to the peer (``peer`` stripped), which
                             serves it from its prefix index
-``kv_pages``        both    the peer's response: ``fetch_id``-correlated,
-                            seq-framed chunks of serialized pages, size-capped
-                            per frame (``AGENTFIELD_KV_FETCH_MAX_BYTES``),
-                            final frame carries ``done``; relayed gw→requester
+``kv_pages``        both    the peer's response METADATA: ``fetch_id``-
+                            correlated, seq-framed page descriptors
+                            (chain/depth/leaf dtypes+shapes/segment byte
+                            lengths), size-capped per frame
+                            (``AGENTFIELD_KV_FETCH_MAX_BYTES``), final frame
+                            carries ``done``; relayed gw→requester. The page
+                            BYTES travel separately (below).
+(binary)            both    raw page payloads as binary WS frames —
+                            ``AFKV1`` header (fetch_id, seq) + concatenated
+                            leaf bytes, sent immediately BEFORE the seq's
+                            ``kv_pages`` metadata frame. No base64: the old
+                            text-frame encoding paid ~33% wire overhead plus
+                            a to_thread encode/decode hop on both sides. The
+                            gateway relays blobs by header rewrite only —
+                            payload bytes are never copied into JSON.
 ==================  ======  =====================================================
 
 Failure semantics (docs/FAULT_TOLERANCE.md mid-stream table): a submit that
@@ -100,6 +111,41 @@ _KV_PAGES_FRAME_BYTES = 1 << 20
 # practice; this bounds the map against a dead peer).
 _KV_RELAY_TTL_S = 30.0
 _KV_RELAY_MAX = 256
+# Completed relays (done/error seen) linger this long so a binary blob
+# frame racing its own metadata frame through the relay's per-frame tasks
+# still resolves its fetch_id; capacity purges honor the shortened deadline.
+_KV_RELAY_DRAIN_S = 2.0
+
+# Binary kv-page blob framing: MAGIC | u8 fid_len | fid utf-8 | u32 seq |
+# payload. The header is the ONLY part the gateway relay parses (it
+# rewrites fid between the node-minted and gateway-unique namespaces).
+_KV_BLOB_MAGIC = b"AFKV1"
+
+
+def _pack_kv_blob(fetch_id: str, seq: int, payload: bytes) -> bytes:
+    fid = fetch_id.encode()
+    if len(fid) > 255:
+        raise ValueError(f"fetch_id too long for blob header: {fetch_id!r}")
+    return (
+        _KV_BLOB_MAGIC + bytes([len(fid)]) + fid
+        + int(seq).to_bytes(4, "big") + payload
+    )
+
+
+def _unpack_kv_blob(data: bytes) -> tuple[str, int, bytes] | None:
+    """(fetch_id, seq, payload) or None for frames that are not kv blobs."""
+    n = len(_KV_BLOB_MAGIC)
+    if len(data) < n + 5 or data[:n] != _KV_BLOB_MAGIC:
+        return None
+    fl = data[n]
+    if len(data) < n + 1 + fl + 4:
+        return None
+    try:
+        fid = data[n + 1 : n + 1 + fl].decode()
+    except UnicodeDecodeError:
+        return None
+    seq = int.from_bytes(data[n + 1 + fl : n + 5 + fl], "big")
+    return fid, seq, data[n + 5 + fl :]
 
 
 class ChannelUnavailable(Exception):
@@ -340,6 +386,32 @@ class _ServerConn:
         except (ConnectionError, RuntimeError, asyncio.CancelledError):
             return False
 
+    async def send_bytes(self, payload: bytes) -> bool:
+        try:
+            async with self.lock:
+                await self.ws.send_bytes(payload)
+            return True
+        except (ConnectionError, RuntimeError, asyncio.CancelledError):
+            return False
+
+
+class _KvWaiter:
+    """One in-flight fetch_kv: pairs each seq's metadata frame with its
+    binary blob (whichever arrives first waits for the other — the gateway
+    relays them as independent tasks, so ordering is NOT guaranteed end to
+    end) and resolves the future once every seq up to ``done`` assembled.
+    A lost blob simply never resolves — the caller's timeout degrades to a
+    local re-prefill, the standing best-effort contract."""
+
+    __slots__ = ("fut", "frames", "blobs", "metas", "done_seq")
+
+    def __init__(self, fut: asyncio.Future):
+        self.fut = fut
+        self.frames: dict[int, list[dict]] = {}  # assembled pages per seq
+        self.blobs: dict[int, bytes] = {}
+        self.metas: dict[int, dict] = {}
+        self.done_seq: int | None = None
+
 
 # invoke(component_id, payload, headers) -> result
 InvokeFn = Callable[[str, Any, dict[str, str]], Awaitable[Any]]
@@ -376,8 +448,8 @@ class ChannelServer:
         # serving side — a registered exporter answers peers' kv_fetch
         # frames; requesting side — fetch_kv() sends a kv_fetch up the live
         # gateway connection and collects the relayed kv_pages response.
-        self._kv_export: Callable[[list[str], int], Awaitable[list[dict]]] | None = None
-        self._kv_waiters: dict[str, tuple[asyncio.Future, list[dict]]] = {}
+        self._kv_export: Callable[[list[str], int], Awaitable[list]] | None = None
+        self._kv_waiters: dict[str, _KvWaiter] = {}
         self._kv_next_id = 0
         self._kv_tasks: set[asyncio.Task] = set()
         self.stats = {
@@ -397,9 +469,12 @@ class ChannelServer:
 
     def set_kv_export(self, fn) -> None:
         """Register the KV page exporter: ``async fn(chains_hex, max_bytes)
-        -> list[page dict]`` (the model node wires its engine's
-        ``export_kv_pages``). Without one, kv_fetch frames answer with an
-        error — the requesting peer re-prefills locally."""
+        -> list[(meta dict, payload bytes)]`` — meta carries chain/depth/
+        per-leaf dtypes+shapes/segment lengths, payload the raw
+        concatenated leaf bytes (the model node wires its engine's
+        ``export_kv_pages`` through ``kv_export_pages``). Without one,
+        kv_fetch frames answer with an error — the requesting peer
+        re-prefills locally."""
         self._kv_export = fn
 
     # -- cross-node KV transfer (docs/PREFIX_CACHING.md "Cluster tier") --
@@ -412,18 +487,20 @@ class ChannelServer:
         max_bytes: int | None = None,
     ) -> list[dict] | None:
         """Request serialized KV pages from `peer_node_id` through the
-        gateway relay, over THIS node's live channel connection. Returns the
-        page dicts the peer served (possibly fewer than asked — best
-        effort), or None when no connection exists, the relay/peer failed,
-        or `timeout_s` expired. Strictly best-effort by design: every
-        failure mode degrades to a local re-prefill on the caller's side."""
+        gateway relay, over THIS node's live channel connection. Returns
+        page dicts ``{chain, depth, parts, segs, data: bytes}`` (raw
+        payload assembled from the binary blob frames; possibly fewer
+        pages than asked — best effort), or None when no connection
+        exists, the relay/peer failed, or `timeout_s` expired. Strictly
+        best-effort by design: every failure mode degrades to a local
+        re-prefill on the caller's side."""
         if not self._conns or not chains_hex:
             return None
         conn = next(iter(self._conns))
         self._kv_next_id += 1
         fid = f"kvf_{id(self)}_{self._kv_next_id}"
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._kv_waiters[fid] = (fut, [])
+        self._kv_waiters[fid] = _KvWaiter(fut)
         try:
             ok = await conn.send(
                 {
@@ -446,21 +523,67 @@ class ChannelServer:
             self._kv_waiters.pop(fid, None)
 
     def _on_kv_pages(self, frame: dict) -> None:
-        """A relayed kv_pages frame for one of OUR fetch_kv calls. Frames
-        past the waiter's timeout (or for an unknown fetch_id) are dropped —
-        a stalled peer's late answer must not adopt pages into a request
-        that already started its local re-prefill."""
+        """A relayed kv_pages METADATA frame for one of OUR fetch_kv
+        calls. Frames past the waiter's timeout (or for an unknown
+        fetch_id) are dropped — a stalled peer's late answer must not
+        adopt pages into a request that already started its local
+        re-prefill."""
         w = self._kv_waiters.get(frame.get("fetch_id", ""))
-        if w is None:
+        if w is None or w.fut.done():
             return
-        fut, pages = w
-        if fut.done():
-            return
-        pages.extend(frame.get("pages") or [])
         if frame.get("error"):
-            fut.set_result(None)
-        elif frame.get("done"):
-            fut.set_result(pages)
+            w.fut.set_result(None)
+            return
+        seq = int(frame.get("seq", 0))
+        w.metas[seq] = frame
+        if frame.get("done"):
+            w.done_seq = seq
+        self._kv_assemble(w)
+
+    def _on_kv_blob(self, data: bytes) -> None:
+        """A relayed binary page blob: stash by (fetch_id, seq) and try to
+        pair it with its metadata frame (arrival order across the relay's
+        per-frame tasks is unspecified)."""
+        parsed = _unpack_kv_blob(data)
+        if parsed is None:
+            return
+        fid, seq, payload = parsed
+        w = self._kv_waiters.get(fid)
+        if w is None or w.fut.done():
+            return
+        w.blobs[seq] = payload
+        self._kv_assemble(w)
+
+    def _kv_assemble(self, w: _KvWaiter) -> None:
+        """Pair metadata frames with their blobs, slice per-page segments,
+        and resolve the fetch once every seq up to ``done`` assembled."""
+        for seq in list(w.metas):
+            frame = w.metas[seq]
+            blob_len = int(frame.get("blob_len") or 0)
+            blob = w.blobs.get(seq, b"")
+            if blob_len and seq not in w.blobs:
+                continue  # metadata before blob: wait for the pair
+            if len(blob) != blob_len:
+                w.fut.set_result(None)  # torn relay: poison, caller re-prefills
+                return
+            pages: list[dict] = []
+            off = 0
+            for meta in frame.get("pages") or []:
+                if not isinstance(meta, dict):
+                    continue
+                n = sum(int(s) for s in (meta.get("segs") or []))
+                pages.append({**meta, "data": blob[off : off + n]})
+                off += n
+            w.frames[seq] = pages
+            del w.metas[seq]
+            w.blobs.pop(seq, None)
+        if w.done_seq is not None and all(
+            s in w.frames for s in range(1, w.done_seq + 1)
+        ):
+            if not w.fut.done():
+                w.fut.set_result(
+                    [pg for s in sorted(w.frames) for pg in w.frames[s]]
+                )
 
     async def _serve_kv_fetch(self, conn: _ServerConn, frame: dict) -> None:
         """Answer a peer's (gateway-relayed) kv_fetch from this node's
@@ -502,33 +625,40 @@ class ChannelServer:
             return
         seq = total = 0
         batch: list[dict] = []
-        batch_bytes = 0
+        batch_blob = bytearray()
 
         async def flush(done: bool) -> None:
-            nonlocal batch, batch_bytes, seq
+            # blob FIRST, then the metadata frame that names it: on one
+            # unrelayed connection that is also the arrival order; across
+            # the gateway relay the requester pairs them by (fid, seq)
+            # regardless of order.
+            nonlocal batch, batch_blob, seq
             seq += 1
+            if batch_blob:
+                await conn.send_bytes(_pack_kv_blob(fid, seq, bytes(batch_blob)))
             await conn.send(
                 {
                     "kind": "kv_pages",
                     "fetch_id": fid,
                     "seq": seq,
                     "pages": batch,
+                    "blob_len": len(batch_blob),
                     "done": done,
                 }
             )
-            batch, batch_bytes = [], 0
+            batch, batch_blob = [], bytearray()
 
-        for pg in pages:
+        for meta, payload in pages:
             # same byte accounting as the exporter's own max_bytes cap
             # (kv_export_pages), so this re-check is pure defense — it
             # drops nothing the exporter admitted
-            sz = sum(len(pg.get(k) or "") for k in ("k", "v"))
+            sz = len(payload)
             if total + sz > max_bytes:
                 break  # size cap: the requester re-prefills the tail
-            if batch and batch_bytes + sz > _KV_PAGES_FRAME_BYTES:
+            if batch and len(batch_blob) + sz > _KV_PAGES_FRAME_BYTES:
                 await flush(done=False)  # chunk: bound each WS frame
-            batch.append(pg)
-            batch_bytes += sz
+            batch.append(meta)
+            batch_blob += payload
             total += sz
         await flush(done=True)
 
@@ -574,6 +704,10 @@ class ChannelServer:
         self.stats["channel_server_connections_total"] += 1
         try:
             async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    # relayed kv page blob for one of OUR fetch_kv calls
+                    self._on_kv_blob(msg.data)
+                    continue
                 if msg.type != aiohttp.WSMsgType.TEXT:
                     continue
                 try:
@@ -780,6 +914,14 @@ class NodeChannel:
             await ws.send_str(json.dumps(frame))
         self.mgr.metrics.inc("channel_frames_tx_total")
 
+    async def _send_bytes(self, payload: bytes) -> None:
+        await self._ensure_connected()
+        ws = self._ws
+        assert ws is not None
+        async with self._send_lock:
+            await ws.send_bytes(payload)
+        self.mgr.metrics.inc("channel_frames_tx_total")
+
     async def close(self) -> None:
         if self._recv_task is not None:
             self._recv_task.cancel()
@@ -863,6 +1005,20 @@ class NodeChannel:
     async def _recv_loop(self, ws: aiohttp.ClientWebSocketResponse) -> None:
         try:
             async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    # a serving node's kv page blob: same chaos hook + rx
+                    # accounting as text frames (a dropped blob is the new
+                    # failure mode — the requester's (fid, seq) pairing must
+                    # time out into a local re-prefill, and chaos tests need
+                    # to be able to inject exactly that), then relay by
+                    # header rewrite (payload bytes never enter JSON).
+                    f = faults.fire("channel.drop")
+                    if f is not None:
+                        log.warning("injected channel drop (blob)", node_id=self.node_id)
+                        break
+                    self.mgr.metrics.inc("channel_frames_rx_total")
+                    self._task(self.mgr.relay_kv_blob(self.node_id, msg.data))
+                    continue
                 if msg.type != aiohttp.WSMsgType.TEXT:
                     continue
                 f = faults.fire("channel.drop")
@@ -1237,17 +1393,22 @@ class ChannelManager:
             await self._kv_error_to(requester_id, fid, f"peer unreachable: {e!r}")
 
     async def relay_kv_pages(self, server_id: str, frame: dict) -> None:
-        """Route a serving node's kv_pages response back to the requester.
-        ``server_id`` is informational (the frame correlates by fetch_id);
-        unknown/expired fetch_ids are dropped — late answers must not leak
-        into a request that already re-prefilled."""
+        """Route a serving node's kv_pages metadata response back to the
+        requester. ``server_id`` is informational (the frame correlates by
+        fetch_id); unknown/expired fetch_ids are dropped — late answers
+        must not leak into a request that already re-prefilled. A
+        done/error frame does not delete the relay entry outright: the
+        seq's binary blob may still be in flight on a sibling relay task,
+        so the entry drains for ``_KV_RELAY_DRAIN_S`` instead."""
         gw_fid = frame.get("fetch_id")
         entry = self._kv_relays.get(gw_fid) if isinstance(gw_fid, str) else None
         if entry is None:
             return
         requester_id, orig_fid, _dl = entry
         if frame.get("done") or frame.get("error"):
-            self._kv_relays.pop(gw_fid, None)
+            self._kv_relays[gw_fid] = (
+                requester_id, orig_fid, time.monotonic() + _KV_RELAY_DRAIN_S
+            )
         self.metrics.inc("kv_relay_frames_total")
         chan = self._chans.get(requester_id)
         if chan is None:
@@ -1258,6 +1419,30 @@ class ChannelManager:
         except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
             log.debug(
                 "kv relay response not delivered",
+                node_id=requester_id, server=server_id, error=repr(e),
+            )
+
+    async def relay_kv_blob(self, server_id: str, data: bytes) -> None:
+        """Route a serving node's binary page blob back to the requester:
+        parse the AFKV1 header, rewrite the gateway-unique fetch_id back to
+        the requester's own, and forward the payload bytes untouched."""
+        parsed = _unpack_kv_blob(data)
+        if parsed is None:
+            return
+        gw_fid, seq, payload = parsed
+        entry = self._kv_relays.get(gw_fid)
+        if entry is None:
+            return
+        requester_id, orig_fid, _dl = entry
+        self.metrics.inc("kv_relay_frames_total")
+        chan = self._chans.get(requester_id)
+        if chan is None:
+            return
+        try:
+            await chan._send_bytes(_pack_kv_blob(orig_fid, seq, payload))
+        except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            log.debug(
+                "kv relay blob not delivered",
                 node_id=requester_id, server=server_id, error=repr(e),
             )
 

@@ -325,18 +325,25 @@ def forward_impl(
 
     def attend(q, k, v):
         if attn_impl == "flash":
-            # Pallas flash kernel: causal-from-zero layout [B, H, S, hd].
-            # Valid whenever positions are per-row aranges (prefill), which is
-            # what the serving engine guarantees. Interpreted on CPU backends.
-            # With a TP mesh the kernel runs under shard_map over the head
-            # axis (each shard: full sequence, H/tp query + Kh/tp KV heads;
-            # zero collectives — the wo psum downstream is the only traffic).
+            # Dense causal prefill through the ONE ragged paged-attention
+            # kernel (the standalone flash kernel is deleted — docs/
+            # KERNELS.md): each batch row packs as same-seq ragged rows over
+            # an empty pool, so the whole forward runs in the kernel's
+            # same-launch new-key phase (the flash recurrence, with causal
+            # slice skipping). Valid whenever positions are per-row aranges
+            # (prefill), which is what the serving engine guarantees.
+            # Interpreted on CPU backends. With a TP mesh it runs under
+            # shard_map over the head axis (each shard: full sequence, H/tp
+            # query + Kh/tp KV heads; zero collectives — the wo psum
+            # downstream is the only traffic).
             import functools
 
-            from agentfield_tpu.ops.pallas.flash_attention_kernel import flash_attention
+            from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+                dense_causal_attention,
+            )
 
             fa = functools.partial(
-                flash_attention, causal=True, window=win,
+                dense_causal_attention, window=win,
                 interpret=jax.default_backend() == "cpu",
             )
             if mesh is not None:
@@ -346,16 +353,12 @@ def forward_impl(
                 from agentfield_tpu.parallel.mesh import AXIS_MODEL
 
                 if mesh.shape.get(AXIS_MODEL, 1) > 1:
-                    spec = P(None, AXIS_MODEL, None, None)
+                    spec = P(None, None, AXIS_MODEL, None)
                     fa = shard_map(
                         fa, mesh=mesh, in_specs=(spec, spec, spec),
                         out_specs=spec, check_rep=False,
                     )
-            return fa(
-                q.transpose(0, 2, 1, 3),
-                k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3),
-            ).transpose(0, 2, 1, 3)
+            return fa(q, k, v)
         if attn_impl == "ring":
             # Sequence/context parallelism: S shards over the mesh's `seq`
             # axis — long-context training where no device holds the full

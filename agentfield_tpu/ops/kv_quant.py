@@ -1,0 +1,140 @@
+"""Quantized KV-page representation: int8 / fp8 pages + per-slot scales.
+
+One definition of the quantized page format every layer shares (kernel,
+XLA reference, pool scatter, host tier, wire — docs/KERNELS.md "Quantized
+pages"): K/V values are stored in the quantized dtype with ONE f32 scale
+per (token slot, kv head) — the scale is the max-abs of that token's head
+vector over ``head_dim`` divided by the dtype's representable max. Per-slot
+(not per-page) scales are what make the fused in-kernel write exact and
+cheap: patching a token into a partially filled page touches only that
+slot's value row and scale — no dequant/requant of neighbouring slots, no
+garbage-slot content inflating a shared scale, and a demote→restore or
+cross-node round trip of the raw bytes is bit-exact by construction.
+
+``QuantPages`` is a pytree, so a quantized pool flows through every jitted
+scheduler path (scan carries, donation, device_put/sharding) exactly like
+the plain bf16 array it replaces — host code that only moves pools around
+never branches on the representation.
+
+Quantization math (shared verbatim by the Pallas kernel's write phase and
+``kv_quantize`` so the fused write and the XLA scatter stay BIT-exact):
+
+    scale = max(max_abs(vals over head_dim) / QMAX, 1e-20)
+    int8:  q = clip(round(vals / scale), -127, 127)
+    fp8:   q = (vals / scale).astype(float8_e4m3fn)   # RTNE cast
+
+Dequantization is ``q.astype(f32) * scale`` everywhere. Storage cost per
+(page, kv-head): ``ps * hd`` bytes of values + ``4 * ps`` bytes of scale —
+vs ``2 * ps * hd`` for bf16, i.e. ~1.9x pages per HBM byte at hd=64+.
+"""
+
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+KV_QUANT_DTYPES = ("none", "int8", "fp8")
+
+# fp8 storage uses e4m3 (max normal 448): KV values are small-magnitude and
+# per-slot scales normalize into the format's sweet spot; e5m2's extra
+# exponent range buys nothing here and costs a mantissa bit.
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+QMAX = {"int8": 127.0, "fp8": 448.0}
+# Scales multiply by the PRECOMPUTED reciprocal instead of dividing by
+# QMAX: XLA rewrites division-by-constant into a reciprocal multiply under
+# jit but not in eager mode (1-ulp divergence), and the parity battery
+# compares the eager XLA reference against the jitted kernel bit-for-bit —
+# a single constant multiply is the same instruction in both.
+INV_QMAX = {m: 1.0 / v for m, v in QMAX.items()}
+
+SCALE_FLOOR = 1e-20  # all-zero vectors quantize to 0 with a harmless scale
+
+
+class QuantPages(typing.NamedTuple):
+    """A quantized page pool: values + per-slot scales, as ONE pytree.
+
+    - ``q``     — ``[..., P, Kh, ps, hd]`` int8 / float8_e4m3fn values
+    - ``scale`` — ``[..., P, Kh, ps]`` float32 per-(slot, kv-head) scales
+
+    The leading dims match (the engine stacks layers on axis 0; a
+    ``lax.scan`` over layers slices both leaves together).
+    """
+
+    q: typing.Any
+    scale: typing.Any
+
+    @property
+    def dtype(self):  # convenience: the VALUE dtype names the mode
+        return self.q.dtype
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def quant_mode_supported(mode: str) -> bool:
+    return mode in ("none", "int8") or (mode == "fp8" and _FP8_DTYPE is not None)
+
+
+def quant_value_dtype(mode: str):
+    """jnp dtype storing quantized values for ``mode`` (raises on 'none')."""
+    if mode == "int8":
+        return jnp.int8
+    if mode == "fp8":
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "kv_quant_dtype='fp8' needs jax.numpy.float8_e4m3fn, which "
+                "this jax build does not provide — use 'int8' or 'none'"
+            )
+        return _FP8_DTYPE
+    raise ValueError(f"no quantized value dtype for mode {mode!r}")
+
+
+def quant_mode_of(pages) -> str:
+    """The kv-quant mode a pool operand encodes ('none' for plain arrays)."""
+    if not isinstance(pages, QuantPages):
+        return "none"
+    if pages.q.dtype == jnp.int8:
+        return "int8"
+    return "fp8"
+
+
+def kv_quantize(vals, mode: str):
+    """Per-slot quantization of ``vals [..., hd]`` → ``(q [..., hd],
+    scale [...])``. The ONE quantization formula (module docstring): the
+    Pallas kernel's write phase inlines exactly this math, which is what
+    keeps fused-kernel and XLA-reference pool writes bit-identical."""
+    f = vals.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(f), axis=-1) * INV_QMAX[mode], SCALE_FLOOR
+    )
+    y = f / scale[..., None]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(y), -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = y.astype(quant_value_dtype(mode))
+    return q, scale
+
+
+def kv_dequantize(q, scale):
+    """``q [..., hd]`` + ``scale [...]`` → float32 values."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def write_pages(pages, vals, page_ids, slot_ids):
+    """Scatter per-token K or V vectors into a (possibly quantized) page
+    pool — the ONE write expression the engine's XLA prefill scatters use.
+
+    ``pages`` is ``[L, P, Kh, ps, hd]`` (plain) or the matching
+    :class:`QuantPages`; ``vals`` is ``idx_shape + [L, Kh, hd]`` (the
+    advanced-index value layout of ``pages.at[:, page_ids, :, slot_ids]``
+    with ``page_ids``/``slot_ids`` of shape ``idx_shape``)."""
+    if isinstance(pages, QuantPages):
+        q, s = kv_quantize(vals, quant_mode_of(pages))
+        return QuantPages(
+            pages.q.at[:, page_ids, :, slot_ids].set(q),
+            pages.scale.at[:, page_ids, :, slot_ids].set(s),
+        )
+    return pages.at[:, page_ids, :, slot_ids].set(vals.astype(pages.dtype))

@@ -21,7 +21,15 @@ Two implementations:
 - ``ops/pallas/ragged_paged_attention_kernel.py`` — Pallas TPU kernel that
   streams pages HBM→VMEM per row and patches pool pages in place; block
   sizes come from the autotable (``ops/pallas/kernel_autotune.py``,
-  ``AGENTFIELD_KERNEL_AUTOTUNE``). Runs in the Pallas interpreter on CPU.
+  ``AGENTFIELD_KERNEL_AUTOTUNE``, keyed by KV dtype). Runs in the Pallas
+  interpreter on CPU.
+
+Pool operands may be plain arrays or ``ops.kv_quant.QuantPages`` (int8/fp8
+values + per-slot scales, ``EngineConfig.kv_quant_dtype``): both impls
+dequantize cached pages on the way in and quantize new K/V on the way out
+with the shared ``kv_quantize`` formula, and the dispatcher repacks the
+pytree — callers carry one pool operand either way (docs/KERNELS.md
+"Quantized pages").
 
 The row descriptor (``RaggedRows``) is produced by
 ``serving.kv_cache.pack_ragged_rows``; its invariants:
@@ -63,7 +71,7 @@ def ragged_paged_attention_ref(
     q: jax.Array,  # [R, W, H, hd]
     k_new: jax.Array,  # [R, W, Kh, hd] — new K per query token (pre-write)
     v_new: jax.Array,  # [R, W, Kh, hd]
-    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd] (int8/fp8 when scales are passed)
     v_pages: jax.Array,  # [P, Kh, ps, hd]
     page_tables: jax.Array,  # [R, maxp] int32
     row_starts: jax.Array,  # [R] int32
@@ -71,23 +79,39 @@ def ragged_paged_attention_ref(
     ctx_lens: jax.Array,  # [R] int32 (unused by the ref: the scatter-first
     # pool already holds same-launch keys; kept for signature parity)
     seq_ids: jax.Array,  # [R] int32 (unused by the ref, same reason)
+    k_scales: jax.Array | None = None,  # [P, Kh, ps] f32 per-slot scales
+    v_scales: jax.Array | None = None,  # (quantized pools; ops.kv_quant)
     sm_scale: float | None = None,
     window: int | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+):
     """XLA reference: exact multi-row scatter of the new K/V into the paged
     pool, then masked gather attention per row. Returns
-    ``(out [R, W, H, hd], k_pages, v_pages)``. Semantics match the Pallas
-    kernel exactly — per-row causal masking on absolute positions, sliding
-    window, zeros for padding rows/tokens — so it serves as the parity
+    ``(out [R, W, H, hd], k_pages, v_pages)`` — plus ``(k_scales,
+    v_scales)`` when a quantized pool's scales were passed. Semantics match
+    the Pallas kernel exactly — per-row causal masking on absolute
+    positions, sliding window, zeros for padding rows/tokens; on quantized
+    pools the scatter quantizes per slot with the SHARED
+    ``kv_quant.kv_quantize`` formula, so even the stored bytes are
+    bit-identical to the fused kernel's — and it serves as the parity
     oracle in tests AND as the engine's attention on backends without the
-    kernel."""
+    kernel. One honest divergence under quantization: the kernel attends
+    same-launch keys pre-quantization (they never round-trip the pool)
+    while this gather reads them back quantized — the parity battery pins
+    that gap inside the per-dtype error bound."""
     del ctx_lens, seq_ids
+    from agentfield_tpu.ops.kv_quant import kv_quantize
+
     R, W, H, hd = q.shape
     P, Kh, ps, _ = k_pages.shape
     maxp = page_tables.shape[1]
     T = maxp * ps
     if H % Kh:
         raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    quant = None
+    if k_scales is not None:
+        quant = "int8" if k_pages.dtype == jnp.int8 else "fp8"
     rep = H // Kh
     if sm_scale is None:
         sm_scale = hd**-0.5
@@ -105,14 +129,30 @@ def ragged_paged_attention_ref(
     slot_ids = pos % ps
     # Multi-row scatter: advanced [R, W] indices at dims 0,2 of
     # [P, Kh, ps, hd] put the broadcast dims first → values [R, W, Kh, hd].
-    k_pages = k_pages.at[page_ids, :, slot_ids].set(k_new.astype(k_pages.dtype))
-    v_pages = v_pages.at[page_ids, :, slot_ids].set(v_new.astype(v_pages.dtype))
+    if quant is not None:
+        kq, ks = kv_quantize(k_new, quant)
+        vq, vs = kv_quantize(v_new, quant)
+        k_pages = k_pages.at[page_ids, :, slot_ids].set(kq)
+        v_pages = v_pages.at[page_ids, :, slot_ids].set(vq)
+        k_scales = k_scales.at[page_ids, :, slot_ids].set(ks)
+        v_scales = v_scales.at[page_ids, :, slot_ids].set(vs)
+    else:
+        k_pages = k_pages.at[page_ids, :, slot_ids].set(k_new.astype(k_pages.dtype))
+        v_pages = v_pages.at[page_ids, :, slot_ids].set(v_new.astype(v_pages.dtype))
 
     # [R, maxp, Kh, ps, hd] → [R, T, Kh, hd] gathered context (now holding
     # this launch's keys too — the mask below only ever admits key positions
-    # the launch has actually populated).
-    k = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
-    v = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
+    # the launch has actually populated). Quantized pools dequantize in the
+    # gather: values * per-slot scales, f32; plain pools gather in the page
+    # dtype (the einsums upcast exactly, so the none-mode is bit-unchanged).
+    if quant is not None:
+        k = k_pages[page_tables].astype(jnp.float32) * k_scales[page_tables][..., None]
+        v = v_pages[page_tables].astype(jnp.float32) * v_scales[page_tables][..., None]
+    else:
+        k = k_pages[page_tables]
+        v = v_pages[page_tables]
+    k = k.transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
+    v = v.transpose(0, 1, 3, 2, 4).reshape(R, T, Kh, hd)
     qg = q.reshape(R, W, Kh, rep, hd)
     logits = jnp.einsum(
         "bwkrh,btkh->bkrwt", qg, k, preferred_element_type=jnp.float32
@@ -128,6 +168,8 @@ def ragged_paged_attention_ref(
     ).reshape(R, W, H, hd)
     # padding rows/tokens return zeros like the kernel's un-accumulated rows
     out = jnp.where(valid[..., None, None], out, 0.0).astype(q.dtype)
+    if quant is not None:
+        return out, k_pages, v_pages, k_scales, v_scales
     return out, k_pages, v_pages
 
 
@@ -149,52 +191,69 @@ def ragged_paged_attention(
 ):
     """Dispatch one ragged fused write+attention launch.
 
+    ``k_pages``/``v_pages`` are plain arrays (bf16/f32 pools) or
+    :class:`ops.kv_quant.QuantPages` (int8/fp8 values + per-slot scales —
+    ``EngineConfig.kv_quant_dtype``); the quantized representation flows
+    through both impls and back out as the same pytree, so callers carry
+    ONE pool operand either way.
+
     With `mesh` (tensor parallelism) the Pallas kernel runs under shard_map
     over the KV-head axis: each shard owns its slice of the page pool and
     its heads' queries/new-KV ([.., Kh/tp, ..] — matching wk/wv's TP
     sharding) and computes with NO collectives; the psum over the output
     projection downstream is the only cross-chip traffic, exactly as in the
     ref GSPMD path (XLA partitions the scatter+gather itself)."""
+    from agentfield_tpu.ops.kv_quant import QuantPages, quant_mode_of
+
+    quant = isinstance(k_pages, QuantPages)
+    kq, ksc = (k_pages.q, k_pages.scale) if quant else (k_pages, None)
+    vq, vsc = (v_pages.q, v_pages.scale) if quant else (v_pages, None)
     if impl == "ref":
-        return ragged_paged_attention_ref(
-            q, k_new, v_new, k_pages, v_pages, page_tables, row_starts,
-            n_tokens, ctx_lens, seq_ids, sm_scale=sm_scale, window=window,
+        out = ragged_paged_attention_ref(
+            q, k_new, v_new, kq, vq, page_tables, row_starts,
+            n_tokens, ctx_lens, seq_ids, k_scales=ksc, v_scales=vsc,
+            sm_scale=sm_scale, window=window,
         )
-    if impl != "pallas":
+    elif impl != "pallas":
         raise ValueError(f"unknown ragged_paged_attention impl {impl!r}")
-    from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
-        ragged_paged_attention_pallas,
-    )
-    from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
+    else:
+        from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+            ragged_paged_attention_pallas,
+        )
+        from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
 
-    blocks = lookup_blocks(
-        page_size=k_pages.shape[2],
-        head_dim=k_pages.shape[3],
-        bucket=q.shape[0] * q.shape[1],
-    )
-    # Mosaic kernels only compile for TPU; on CPU backends (tests, local
-    # demos) run the same kernel in the Pallas interpreter.
-    interpret = jax.default_backend() == "cpu"
-    import functools
+        blocks = lookup_blocks(
+            page_size=kq.shape[2],
+            head_dim=kq.shape[3],
+            bucket=q.shape[0] * q.shape[1],
+            kv_dtype=quant_mode_of(k_pages),
+        )
+        # Mosaic kernels only compile for TPU; on CPU backends (tests, local
+        # demos) run the same kernel in the Pallas interpreter.
+        interpret = jax.default_backend() == "cpu"
+        import functools
 
-    fn = functools.partial(
-        ragged_paged_attention_pallas,
-        sm_scale=sm_scale,
-        window=window,
-        block_n=blocks.block_n,
-        interpret=interpret,
-    )
-    if mesh is not None:
-        from jax.sharding import PartitionSpec as P
+        fn = functools.partial(
+            ragged_paged_attention_pallas,
+            sm_scale=sm_scale,
+            window=window,
+            block_n=blocks.block_n,
+            interpret=interpret,
+        )
+        if quant:
+            base = fn
+            fn = lambda q_, kn, vn, kp, vp, pt, rs, nt, cx, sq, ks_, vs_: base(  # noqa: E731
+                q_, kn, vn, kp, vp, pt, rs, nt, cx, sq,
+                k_scales=ks_, v_scales=vs_,
+            )
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
 
-        from agentfield_tpu.parallel.mesh import AXIS_MODEL
-        from agentfield_tpu.parallel.mesh import shard_map  # version compat
+            from agentfield_tpu.parallel.mesh import AXIS_MODEL
+            from agentfield_tpu.parallel.mesh import shard_map  # version compat
 
-        if mesh.shape.get(AXIS_MODEL, 1) > 1:
-            fn = shard_map(
-                fn,
-                mesh=mesh,
-                in_specs=(
+            if mesh.shape.get(AXIS_MODEL, 1) > 1:
+                in_specs = [
                     P(None, None, AXIS_MODEL, None),  # q [R, W, H, hd]
                     P(None, None, AXIS_MODEL, None),  # k_new [R, W, Kh, hd]
                     P(None, None, AXIS_MODEL, None),  # v_new
@@ -202,17 +261,31 @@ def ragged_paged_attention(
                     P(None, AXIS_MODEL, None, None),
                     P(None, None),  # page_tables replicated
                     P(None), P(None), P(None), P(None),
-                ),
-                out_specs=(
+                ]
+                out_specs = [
                     P(None, None, AXIS_MODEL, None),
                     P(None, AXIS_MODEL, None, None),
                     P(None, AXIS_MODEL, None, None),
-                ),
-            )
-    return fn(
-        q, k_new, v_new, k_pages, v_pages, page_tables, row_starts,
-        n_tokens, ctx_lens, seq_ids,
-    )
+                ]
+                if quant:
+                    # scales shard with their pages on the Kh axis
+                    in_specs += [P(None, AXIS_MODEL, None), P(None, AXIS_MODEL, None)]
+                    out_specs += [P(None, AXIS_MODEL, None), P(None, AXIS_MODEL, None)]
+                fn = shard_map(
+                    fn, mesh=mesh,
+                    in_specs=tuple(in_specs), out_specs=tuple(out_specs),
+                )
+        args = [
+            q, k_new, v_new, kq, vq, page_tables, row_starts,
+            n_tokens, ctx_lens, seq_ids,
+        ]
+        if quant:
+            args += [ksc, vsc]
+        out = fn(*args)
+    if quant:
+        o, kp, vp, ks_, vs_ = out
+        return o, QuantPages(kp, ks_), QuantPages(vp, vs_)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -3,48 +3,54 @@
 Callers import from HERE (``agentfield_tpu.ops.pallas``) instead of
 deep-importing kernel module paths:
 
-- ``ragged_paged_attention_pallas`` — the one ragged paged-attention kernel
-  (fused KV write; ragged_paged_attention_kernel.py, docs/KERNELS.md)
+- ``ragged_paged_attention_pallas`` — the ONE ragged paged-attention kernel
+  (fused KV write; quantized int8/fp8 pools dequantize in the page-stream
+  phase — ragged_paged_attention_kernel.py, docs/KERNELS.md)
 - ``ragged_paged_attention`` / ``ragged_paged_attention_ref`` — dispatcher
   and XLA parity reference (ops/paged_attention.py)
+- ``dense_causal_attention`` — dense causal prefill THROUGH the ragged
+  kernel (``EngineConfig.prefill_impl="flash"`` resolves here; the
+  standalone flash-prefill kernel is deleted — docs/KERNELS.md)
 - ``RaggedRows`` — the host-side row-descriptor type
   (built by ``serving.kv_cache.pack_ragged_rows``)
-- ``KernelBlocks`` / ``lookup_blocks`` — the autotuned block-size table
-  (kernel_autotune.py, ``AGENTFIELD_KERNEL_AUTOTUNE``)
-- ``flash_attention`` — dense prefill flash kernel
+- ``QuantPages`` — the quantized page-pool pytree (ops/kv_quant.py,
+  ``EngineConfig.kv_quant_dtype``)
+- ``KernelBlocks`` / ``lookup_blocks`` — the autotuned block-size table,
+  keyed by KV dtype (kernel_autotune.py, ``AGENTFIELD_KERNEL_AUTOTUNE``)
 
 The four pre-ragged kernel names (decode ``paged_attention_pallas``, chunk
 ``paged_chunk_attention_pallas``, batched-chunk
 ``paged_batch_chunk_attention_pallas``/``_ref``, decode-append
 ``kv_write_pallas``/``kv_write``) were deprecation shims for one release
-after the ragged consolidation and are now REMOVED — every shape they
+after the ragged consolidation and are REMOVED; ``flash_attention`` (the
+standalone dense prefill kernel) is likewise gone — every shape they
 served is a ragged-row mix (docs/KERNELS.md maps the old call forms onto
 ``ragged_paged_attention``).
 """
 
 from __future__ import annotations
 
+from agentfield_tpu.ops.kv_quant import QuantPages  # noqa: F401
 from agentfield_tpu.ops.paged_attention import (  # noqa: F401
     RaggedRows,
     paged_attention_ref,
     ragged_paged_attention,
     ragged_paged_attention_ref,
 )
-from agentfield_tpu.ops.pallas.flash_attention_kernel import (  # noqa: F401
-    flash_attention,
-)
 from agentfield_tpu.ops.pallas.kernel_autotune import (  # noqa: F401
     KernelBlocks,
     lookup_blocks,
 )
 from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (  # noqa: F401
+    dense_causal_attention,
     ragged_paged_attention_pallas,
 )
 
 __all__ = [
+    "QuantPages",
     "RaggedRows",
     "KernelBlocks",
-    "flash_attention",
+    "dense_causal_attention",
     "lookup_blocks",
     "paged_attention_ref",
     "ragged_paged_attention",

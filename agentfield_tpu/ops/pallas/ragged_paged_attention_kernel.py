@@ -3,29 +3,45 @@
 ONE grid program serves every forward shape the engine issues: R ragged
 rows of up to W query tokens, each row at its own absolute start over its
 own page table — decode rows (n_tokens=1), prefill chunks (a chunk wider
-than W splits into several rows sharing a ``seq_id``), and the speculative
-verify window are all just descriptors (see ``ops/paged_attention.py``).
+than W splits into several rows sharing a ``seq_id``), DENSE prefill (see
+``dense_causal_attention`` below: a fresh prompt is just rows with
+``ctx_lens == 0`` over an empty pool), and the speculative verify window
+are all just descriptors (see ``ops/paged_attention.py``).
 The new K/V ride in as operands and the kernel:
 
 1. walks the row's CACHED pool pages (positions ``< ctx_lens[r]``) with the
    usual online-softmax page stream — pages DMA HBM→VMEM, the gathered
-   context never materializes;
+   context never materializes. QUANTIZED pools (int8 / fp8 values +
+   per-slot f32 scales, ``ops.kv_quant``) dequantize HERE, inside the
+   page-in/accumulate phase: the page tile arrives at half the HBM
+   bandwidth and widens to f32 only in VMEM;
 2. attends the launch's own new keys (``k_new``) in ``block_n``-token
    slices, masked to the same sequence and causal on absolute positions —
-   same-launch keys are NEVER read back from the pool, so the attention
-   pass has no read-after-write ordering on the page arrays;
+   same-launch keys are NEVER read back from the pool (so quantization
+   never degrades intra-launch attention), and the attention pass has no
+   read-after-write ordering on the page arrays. Slices whose earliest key
+   position lies past the row's last query are skipped wholesale, which
+   makes the dense-prefill packing O(S·W) per row instead of O(S²);
 3. patches the new K/V into their pool pages in place
    (``input_output_aliases``). Each write step rebuilds a page as
    copy-then-patch-ALL-launch-tokens targeting it, which makes overlapping
    writes IDEMPOTENT: two rows straddling one page (or a torn read of a
    concurrently written page) both produce the identical final content, so
    the multi-row-write restriction of the old per-page patch kernel is
-   unrepresentable here.
+   unrepresentable here. On quantized pools each patched slot quantizes
+   with the SHARED formula (``kv_quant.kv_quantize`` inlined) and writes
+   its own scale — untouched slots keep their value row and scale
+   bit-for-bit, so pages are never materialized in bf16 at any point.
 
 Grid is ``(R, kv_heads, maxp + new_steps + write_steps)``; block sizes come
-from ``kernel_autotune`` (``AGENTFIELD_KERNEL_AUTOTUNE``). Padding rows
-(``n_tokens == 0``) produce zero output and only ever touch the reserved
-garbage page 0, whose content is meaningless by contract.
+from ``kernel_autotune`` (``AGENTFIELD_KERNEL_AUTOTUNE``; the table is
+keyed by KV dtype too — a quantized page stream amortizes differently).
+Padding rows (``n_tokens == 0``) produce zero output and only ever touch
+the reserved garbage page 0, whose content is meaningless by contract.
+
+The dense flash-prefill kernel this file's ``dense_causal_attention``
+replaced is DELETED (ROADMAP item 4's consolidation): every attention call
+in the serving stack now lowers to this one kernel.
 """
 
 from __future__ import annotations
@@ -36,6 +52,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from agentfield_tpu.ops.kv_quant import INV_QMAX, SCALE_FLOOR
 
 _NEG_INF = -1e30
 
@@ -60,15 +78,9 @@ def _ragged_kernel(
     vn_full_ref,  # [R, W, 1, hd]
     kp_ref,  # [1, 1, ps, hd] — walk page, or the write-target page
     vp_ref,  # [1, 1, ps, hd]
-    # outputs
-    o_ref,  # [1, 1, W, rep, hd]
-    kp_out_ref,  # [1, 1, ps, hd] (aliased with the pool)
-    vp_out_ref,  # [1, 1, ps, hd]
-    # scratch
-    m_scr,  # [W * rep, 1] f32
-    l_scr,  # [W * rep, 1] f32
-    acc_scr,  # [W * rep, hd] f32
-    *,
+    *rest,  # [ksc_ref, vsc_ref] when quantized ([1, 1, ps, 1] scales),
+    # then outputs o_ref / kp_out_ref / vp_out_ref [/ ksc_out / vsc_out],
+    # then scratch m/l/acc
     sm_scale: float,
     page_size: int,
     num_page_steps: int,
@@ -78,7 +90,16 @@ def _ragged_kernel(
     rows_per_new_step: int,
     rep: int,
     window: int | None,
+    quant: str | None,
 ):
+    if quant is not None:
+        (
+            ksc_ref, vsc_ref, o_ref, kp_out_ref, vp_out_ref,
+            ksc_out_ref, vsc_out_ref, m_scr, l_scr, acc_scr,
+        ) = rest
+    else:
+        ksc_ref = vsc_ref = ksc_out_ref = vsc_out_ref = None
+        o_ref, kp_out_ref, vp_out_ref, m_scr, l_scr, acc_scr = rest
     r = pl.program_id(0)
     pi = pl.program_id(2)
     ps = page_size
@@ -125,6 +146,10 @@ def _ragged_kernel(
     def _pool():
         q = q_ref[0, 0].astype(jnp.float32).reshape(q_rows, hd) * sm_scale
         k = kp_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        if quant is not None:
+            # dequantize in the page-stream phase: per-slot scales [ps, 1]
+            # broadcast over head_dim (ops.kv_quant page format)
+            k = k * ksc_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [q_rows, ps]
@@ -133,13 +158,24 @@ def _ragged_kernel(
         if window is not None:  # HF Mistral semantics (llama.attention_ref)
             keep &= k_pos > q_pos - window
         s = jnp.where(keep, s, _NEG_INF)
-        accumulate(s, vp_ref[0, 0].astype(jnp.float32))
+        v = vp_ref[0, 0].astype(jnp.float32)
+        if quant is not None:
+            v = v * vsc_ref[0, 0]
+        accumulate(s, v)
 
     # --- phase B: same-launch new keys, one block_n-token row slice per step.
+    # Causal skip: every key in the slice sits at an absolute position >= the
+    # slice's earliest valid row start, so a slice starting past the row's
+    # LAST query can never be attended — skip the whole step (this is what
+    # keeps the dense-prefill packing from paying O(S^2) masked work).
+    slice_min_start = jnp.min(
+        jnp.where(ntok2_ref[...] > 0, starts2_ref[...], jnp.int32(2**30))
+    )
     in_new = (
         (pi >= num_page_steps)
         & (pi < num_page_steps + num_new_steps)
         & (ntok > 0)
+        & (slice_min_start <= start + W - 1)
     )
 
     @pl.when(in_new)
@@ -194,8 +230,35 @@ def _ragged_kernel(
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        kp_out_ref[0, 0, ...] = jnp.where(hit, pk.astype(kp_out_ref.dtype), kp_ref[0, 0])
-        vp_out_ref[0, 0, ...] = jnp.where(hit, pv.astype(vp_out_ref.dtype), vp_ref[0, 0])
+        if quant is not None:
+            # per-slot quantization, the SHARED formula (kv_quant.kv_quantize
+            # inlined — identical ops keep the fused write bit-exact vs the
+            # XLA reference scatter). Untouched slots keep value + scale.
+            inv_qmax = INV_QMAX[quant]
+            sk = jnp.maximum(jnp.max(jnp.abs(pk), axis=1) * inv_qmax, SCALE_FLOOR)
+            sv = jnp.maximum(jnp.max(jnp.abs(pv), axis=1) * inv_qmax, SCALE_FLOOR)
+            yk = pk / sk[:, None]
+            yv = pv / sv[:, None]
+            if quant == "int8":
+                qk = jnp.clip(jnp.round(yk), -127.0, 127.0)
+                qv = jnp.clip(jnp.round(yv), -127.0, 127.0)
+            else:
+                qk, qv = yk, yv
+            kp_out_ref[0, 0, ...] = jnp.where(
+                hit, qk.astype(kp_out_ref.dtype), kp_ref[0, 0]
+            )
+            vp_out_ref[0, 0, ...] = jnp.where(
+                hit, qv.astype(vp_out_ref.dtype), vp_ref[0, 0]
+            )
+            ksc_out_ref[0, 0, ...] = jnp.where(hit, sk[:, None], ksc_ref[0, 0])
+            vsc_out_ref[0, 0, ...] = jnp.where(hit, sv[:, None], vsc_ref[0, 0])
+        else:
+            kp_out_ref[0, 0, ...] = jnp.where(
+                hit, pk.astype(kp_out_ref.dtype), kp_ref[0, 0]
+            )
+            vp_out_ref[0, 0, ...] = jnp.where(
+                hit, pv.astype(vp_out_ref.dtype), vp_ref[0, 0]
+            )
 
 
 @functools.partial(
@@ -205,25 +268,33 @@ def ragged_paged_attention_pallas(
     q: jax.Array,  # [R, W, H, hd]
     k_new: jax.Array,  # [R, W, Kh, hd]
     v_new: jax.Array,  # [R, W, Kh, hd]
-    k_pages: jax.Array,  # [P, Kh, ps, hd]
+    k_pages: jax.Array,  # [P, Kh, ps, hd] (bf16/f32, or int8/fp8 when quantized)
     v_pages: jax.Array,  # [P, Kh, ps, hd]
     page_tables: jax.Array,  # [R, maxp] int32
     row_starts: jax.Array,  # [R] int32
     n_tokens: jax.Array,  # [R] int32 (0 = padding row)
     ctx_lens: jax.Array,  # [R] int32 — keys already in the pool per row
     seq_ids: jax.Array,  # [R] int32 — launch-local sequence identity
+    k_scales: jax.Array | None = None,  # [P, Kh, ps] f32 per-slot scales
+    v_scales: jax.Array | None = None,  # (both or neither; ops.kv_quant)
     sm_scale: float | None = None,
     window: int | None = None,
     block_n: int = 128,
     interpret: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns ``(out [R, W, H, hd], k_pages, v_pages)`` with the new K/V
-    written in place (the pool operands are aliased)."""
+):
+    """Returns ``(out [R, W, H, hd], k_pages, v_pages)`` — plus
+    ``(k_scales, v_scales)`` when a quantized pool's scales were passed —
+    with the new K/V written in place (the pool operands are aliased)."""
     R, W, H, hd = q.shape
     P, Kh, ps, _ = k_pages.shape
     maxp = page_tables.shape[1]
     if H % Kh:
         raise ValueError(f"num_heads {H} not divisible by num_kv_heads {Kh}")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    quant: str | None = None
+    if k_scales is not None:
+        quant = "int8" if k_pages.dtype == jnp.int8 else "fp8"
     rep = H // Kh
     if sm_scale is None:
         sm_scale = hd**-0.5
@@ -270,6 +341,7 @@ def ragged_paged_attention_pallas(
         rows_per_new_step=rn,
         rep=rep,
         window=window,
+        quant=quant,
     )
 
     def _nb(pi):
@@ -286,92 +358,111 @@ def ragged_paged_attention_pallas(
     def _page_out(r, kvh, pi, pt, st, cx, nt, sq):
         return (_wpage(r, pi, pt, st), kvh, 0, 0)
 
-    page_block = pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, W, rep, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (rn, 1),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (rn, 1),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (rn, 1),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (R_pad, W),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (R_pad, W),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (rn, W, 1, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (rn, W, 1, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (R_pad, W, 1, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (R_pad, W, 1, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM),
+    ]
+    out_specs = [
+        pl.BlockSpec(
+            (1, 1, W, rep, hd),
+            lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((R_pad, Kh, W, rep, hd), q.dtype),
+        jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+        jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+    ]
+    # operand numbering includes the five scalar-prefetch args
+    aliases = {15: 1, 16: 2}
+    operands = [
+        qg, row_starts[:, None], n_tokens[:, None], seq_ids[:, None],
+        tok_pages, tok_slots, k_new, v_new, k_new, v_new, k_pages, v_pages,
+    ]
+    if quant is not None:
+        # Scales ride as [P, Kh, ps, 1] so the (ps, 1) block tail is made of
+        # full array dims (same Mosaic tiling rationale as the page layout);
+        # [ps, 1] also broadcasts directly against the [ps, hd] value tile.
+        sc_spec = pl.BlockSpec((1, 1, ps, 1), _page_in, memory_space=pltpu.VMEM)
+        sc_out = pl.BlockSpec((1, 1, ps, 1), _page_out, memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        out_specs += [sc_out, sc_out]
+        sc_shape = jax.ShapeDtypeStruct((P, Kh, ps, 1), jnp.float32)
+        out_shape += [sc_shape, sc_shape]
+        aliases = {15: 1, 16: 2, 17: 3, 18: 4}
+        operands += [
+            k_scales.reshape(P, Kh, ps, 1).astype(jnp.float32),
+            v_scales.reshape(P, Kh, ps, 1).astype(jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(R_pad, Kh, maxp + ns + WP),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, W, rep, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (rn, 1),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (rn, 1),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (rn, 1),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (R_pad, W),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (R_pad, W),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (rn, W, 1, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (rn, W, 1, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (_nb(pi), 0, kvh, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (R_pad, W, 1, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (R_pad, W, 1, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (0, 0, kvh, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            page_block,
-            pl.BlockSpec((1, 1, ps, hd), _page_in, memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, 1, W, rep, hd),
-                lambda r, kvh, pi, pt, st, cx, nt, sq: (r, kvh, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ps, hd), _page_out, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((W * rep, 1), jnp.float32),
             pltpu.VMEM((W * rep, 1), jnp.float32),
             pltpu.VMEM((W * rep, hd), jnp.float32),
         ],
     )
-    starts2 = row_starts[:, None]
-    ntok2 = n_tokens[:, None]
-    seq2 = seq_ids[:, None]
-    out, kp, vp = pl.pallas_call(
+    results = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((R_pad, Kh, W, rep, hd), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ],
-        # operand numbering includes the five scalar-prefetch args
-        input_output_aliases={15: 1, 16: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         cost_estimate=pl.CostEstimate(
             flops=4 * R_pad * W * H * (maxp * ps + R_pad * W) * hd,
             bytes_accessed=(
@@ -382,8 +473,76 @@ def ragged_paged_attention_pallas(
         interpret=interpret,
     )(
         page_tables, row_starts, ctx_lens, n_tokens, seq_ids,
-        qg, starts2, ntok2, seq2, tok_pages, tok_slots,
-        k_new, v_new, k_new, v_new, k_pages, v_pages,
+        *operands,
     )
-    out = out.transpose(0, 2, 1, 3, 4).reshape(R_pad, W, H, hd)
-    return out[:R], kp, vp
+    out = results[0].transpose(0, 2, 1, 3, 4).reshape(R_pad, W, H, hd)[:R]
+    if quant is not None:
+        kp, vp, ksc, vsc = results[1:5]
+        return out, kp, vp, ksc.reshape(P, Kh, ps), vsc.reshape(P, Kh, ps)
+    return out, results[1], results[2]
+
+
+def dense_causal_attention(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, S, Kh, hd]
+    v: jax.Array,  # [B, S, Kh, hd]
+    window: int | None = None,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dense causal self-attention through the ONE ragged kernel — the
+    replacement for the deleted standalone flash-prefill kernel
+    (``EngineConfig.prefill_impl="flash"`` resolves here; docs/KERNELS.md).
+
+    Each batch row packs as ``ceil(S / block_q)`` same-``seq_id`` ragged
+    rows over an EMPTY one-page pool (``ctx_lens == 0`` — the page walk
+    never fires), so the whole computation runs in the kernel's same-launch
+    new-key phase: online-softmax over ``block_n``-token key slices with
+    causal skipping, exactly the flash recurrence, in the same grid program
+    decode and chunk prefill ride. Writes land on the reserved garbage page
+    (a dense prefill has no pool to fill — the engine scatters K/V into
+    real pages itself); the 128-slot dummy page bounds that write phase at
+    ~ceil(block_q/128)+1 steps, a few percent of the attention FLOPs at the
+    engine's launch sizes.
+
+    Operating envelope: the kernel's new-key operands hold the WHOLE
+    launch's ``B*S`` K/V in VMEM (its ``kn_full`` blocks are per-launch,
+    not per-tile), so very long dense sequences must be chunked BEFORE
+    this call — the engine already does this (``prefill_impl="flash"``
+    auto-resolves ``prefill_chunk=512``, so no dense launch exceeds a
+    512-token bucket; at B=8, S=512, hd=128, bf16 that is ~2MB of new-KV
+    VMEM). Standalone callers with S in the thousands should route through
+    the chunked/paged path instead. Returns ``[B, S, H, hd]``."""
+    from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
+
+    B, S, H, hd = q.shape
+    Kh = k.shape[2]
+    blocks = lookup_blocks(page_size=128, head_dim=hd, bucket=S)
+    W = max(1, min(blocks.block_q, S))
+    nw = -(-S // W)
+    S_pad = nw * W
+    if S_pad > S:
+        padn = S_pad - S
+        q = jnp.pad(q, ((0, 0), (0, padn), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, padn), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, padn), (0, 0), (0, 0)))
+    R = B * nw
+    qr = q.reshape(R, W, H, hd)
+    kr = k.reshape(R, W, Kh, hd)
+    vr = v.reshape(R, W, Kh, hd)
+    starts = jnp.tile(jnp.arange(nw, dtype=jnp.int32) * W, B)
+    n_toks = jnp.tile(
+        jnp.clip(S - jnp.arange(nw, dtype=jnp.int32) * W, 0, W), B
+    )
+    seqs = jnp.repeat(jnp.arange(B, dtype=jnp.int32), nw)
+    ctx = jnp.zeros((R,), jnp.int32)
+    tables = jnp.zeros((R, 1), jnp.int32)
+    # 128-slot dummy page: its only job is bounding the write-phase step
+    # count (WP ≈ W/ps); page 0 is the garbage sink by contract.
+    pool = jnp.zeros((1, Kh, 128, hd), q.dtype)
+    out, _, _ = ragged_paged_attention_pallas(
+        qr, kr, vr, pool, pool, tables, starts, n_toks, ctx, seqs,
+        sm_scale=sm_scale, window=window, block_n=blocks.block_n,
+        interpret=interpret,
+    )
+    return out.reshape(B, S_pad, H, hd)[:, :S]

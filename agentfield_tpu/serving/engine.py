@@ -41,6 +41,7 @@ import numpy as np
 from agentfield_tpu.branching import branch_rid
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
+from agentfield_tpu.ops.kv_quant import write_pages as _write_pages
 from agentfield_tpu.ops.paged_attention import ragged_paged_attention
 from agentfield_tpu.serving.grammar import Grammar
 from agentfield_tpu.serving.kv_cache import (
@@ -68,11 +69,24 @@ class EngineConfig:
     attn_impl: str = "ref"  # decode-tick attention+KV-write: "ref" (XLA
     # scatter + gather) | "pallas" (the ONE ragged paged-attention kernel,
     # fused write — docs/KERNELS.md)
-    kv_write_impl: str = "ref"  # DEPRECATED alias: the ragged kernel fuses
-    # the decode KV append into the attention launch, so "pallas" here now
-    # selects the same fused kernel attn_impl="pallas" does (kept one
-    # release so existing configs keep meaning "run the kernel path")
-    prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (pallas) |
+    kv_write_impl: str | None = None  # REMOVED (was a deprecated alias of
+    # attn_impl after the ragged kernel fused the decode KV append into the
+    # attention launch; its one-release window is over). Any value raises a
+    # ValueError pointing at attn_impl="pallas" — docs/KERNELS.md.
+    kv_quant_dtype: str = "none"  # quantized KV pages (docs/KERNELS.md
+    # "Quantized pages"): "int8" | "fp8" store K/V pages in the quantized
+    # dtype with per-(slot, kv-head) f32 scales — ~1.9x pages per HBM byte
+    # and half the attention-phase page bandwidth; the ragged kernel
+    # dequantizes inside its page-stream phase and the fused write
+    # quantizes new K/V on the way in, so pages are never materialized in
+    # bf16. The SAME representation ships through demote/restore, fork/COW
+    # copies, and cross-node kv_fetch transfer, so the capacity win
+    # compounds across tiers (docs/PREFIX_CACHING.md capacity math).
+    # Greedy outputs can drift within the pinned kernel error bound;
+    # rollback is "none" (the default) — bit-for-bit today's pools.
+    prefill_impl: str = "ref"  # prefill attention: "ref" | "flash" (the
+    # ragged kernel's dense-prefill packing — ops.pallas
+    # dense_causal_attention; the standalone flash kernel is deleted) |
     # "ring" (sequence-parallel prefill over the mesh's `seq` axis — the
     # long-context serving path: no device materializes full-context
     # attention; requires mesh= with a seq axis, prompt buckets divide by
@@ -363,12 +377,9 @@ def _sparse_prefill_cfg(cfg: LlamaConfig, ecfg: "EngineConfig") -> LlamaConfig:
 
 
 def _decode_impl(ecfg: EngineConfig) -> str:
-    """Impl for decode-tick ragged launches: the fused kernel replaces both
-    the old decode-attention kernel and the kv-write patch kernel, so either
-    legacy knob saying "pallas" selects it."""
-    if ecfg.attn_impl == "pallas" or ecfg.kv_write_impl == "pallas":
-        return "pallas"
-    return "ref"
+    """Impl for decode-tick ragged launches (the fused kernel replaced both
+    the old decode-attention kernel and the kv-write patch kernel)."""
+    return "pallas" if ecfg.attn_impl == "pallas" else "ref"
 
 
 def _binding_window(cfg: LlamaConfig, ecfg: EngineConfig) -> int | None:
@@ -671,11 +682,15 @@ def _spec_decode_fn(cfg: LlamaConfig, dcfg: LlamaConfig, ecfg: EngineConfig, mes
 @functools.lru_cache(maxsize=None)
 def _copy_page_fn():
     """Jitted device-side page copy (copy-on-write): duplicate one page's
-    K/V across all layers into a fresh page. jit re-specializes per pool
-    shape, so the target and draft caches share this builder."""
+    K/V across all layers into a fresh page. Pools are pytrees (plain
+    arrays, or QuantPages values+scales under kv_quant_dtype — a COW copy
+    moves the quantized bytes AND their scales, so a forked tail is
+    bit-identical to its parent); jit re-specializes per pool structure,
+    so the target and draft caches share this builder."""
 
     def cp(kp, vp, src, dst):
-        return kp.at[:, dst].set(kp[:, src]), vp.at[:, dst].set(vp[:, src])
+        cp1 = lambda a: a.at[:, dst].set(a[:, src])  # noqa: E731
+        return jax.tree.map(cp1, kp), jax.tree.map(cp1, vp)
 
     return jax.jit(cp, donate_argnums=(0, 1))
 
@@ -684,15 +699,17 @@ def _copy_page_fn():
 def _restore_page_fn():
     """Jitted host→device page restore (tiered KV, docs/PREFIX_CACHING.md
     "Tiered cache"): write a BATCH of pages' K/V across all layers back
-    into the paged pool in one dispatch (``dst`` is [N]; values [L, N,
-    ...]) — one lookup's worth of restores costs one call, not one per
-    page. jit re-specializes per (pool shape, N) like _copy_page_fn."""
+    into the paged pool in one dispatch (``dst`` is [N]; value leaves [L,
+    N, ...]) — one lookup's worth of restores costs one call, not one per
+    page. Quantized pools restore values + scales leaf-by-leaf (the
+    round-tripped bytes are bit-identical either way). jit re-specializes
+    per (pool structure, N) like _copy_page_fn."""
 
     def up(kp, vp, k, v, dst):
-        return (
-            kp.at[:, dst].set(k.astype(kp.dtype)),
-            vp.at[:, dst].set(v.astype(vp.dtype)),
-        )
+        def up1(pool, host):
+            return pool.at[:, dst].set(host.astype(pool.dtype))
+
+        return jax.tree.map(up1, kp, k), jax.tree.map(up1, vp, v)
 
     return jax.jit(up, donate_argnums=(0, 1))
 
@@ -701,9 +718,10 @@ def _fetch_page_kv(handle):
     """Offload-worker side of a KV demote: the blocking device→host
     transfer of one captured page (runs on the pool's offload thread, no
     locks held — see InferenceEngine._capture_page_kv for why the handle's
-    content is immune to the scheduler's concurrent donating dispatches)."""
-    k_slice, v_slice = handle
-    return np.asarray(k_slice), np.asarray(v_slice)
+    content is immune to the scheduler's concurrent donating dispatches).
+    The handle is a (k, v) pair of per-page pytrees (plain slices, or
+    QuantPages values+scales); every leaf lands as numpy."""
+    return jax.tree.map(np.asarray, handle)
 
 
 @functools.lru_cache(maxsize=None)
@@ -723,9 +741,10 @@ def _prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=None):
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
         slot_ids = pos % ps
         # pages: [L, P, Kh, ps, hd]; advanced indices at dims 1,3 put the
-        # token dim first → value layout [bucket, L, Kh, hd].
-        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(ks[:, 0], 0, 1))
-        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(vs[:, 0], 0, 1))
+        # token dim first → value layout [bucket, L, Kh, hd]. write_pages
+        # quantizes per slot when the pool is QuantPages (kv_quant_dtype).
+        k_pages = _write_pages(k_pages, jnp.swapaxes(ks[:, 0], 0, 1), page_ids, slot_ids)
+        v_pages = _write_pages(v_pages, jnp.swapaxes(vs[:, 0], 0, 1), page_ids, slot_ids)
         last = logits[0, length - 1]
         return last, k_pages, v_pages
 
@@ -759,8 +778,8 @@ def _batch_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=No
         # (padding rows all hit garbage page 0; last-write-wins there is fine).
         # Advanced [N, bucket] indices at dims 1,3 of [L, P, Kh, ps, hd] put
         # the broadcast dims first → value layout [N, bucket, L, Kh, hd].
-        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.moveaxis(ks, 0, 2))
-        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.moveaxis(vs, 0, 2))
+        k_pages = _write_pages(k_pages, jnp.moveaxis(ks, 0, 2), page_ids, slot_ids)
+        v_pages = _write_pages(v_pages, jnp.moveaxis(vs, 0, 2), page_ids, slot_ids)
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0]  # [N, V]
@@ -787,8 +806,8 @@ def _prefill_inject_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int, mesh=N
         )
         page_ids = jnp.where(in_range, page_table_row[pos // ps], 0)
         slot_ids = pos % ps
-        k_pages = k_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(ks[:, 0], 0, 1))
-        v_pages = v_pages.at[:, page_ids, :, slot_ids].set(jnp.swapaxes(vs[:, 0], 0, 1))
+        k_pages = _write_pages(k_pages, jnp.swapaxes(ks[:, 0], 0, 1), page_ids, slot_ids)
+        v_pages = _write_pages(v_pages, jnp.swapaxes(vs[:, 0], 0, 1), page_ids, slot_ids)
         last = logits[0, length - 1]
         return last, k_pages, v_pages
 
@@ -809,7 +828,12 @@ def _suffix_prefill_fn(cfg: LlamaConfig, ecfg: EngineConfig, bucket: int):
     [max_context] gather on the kernel path."""
     from agentfield_tpu.ops.pallas.kernel_autotune import lookup_blocks
 
-    W = min(lookup_blocks(ecfg.page_size, cfg.head_dim, bucket).block_q, bucket)
+    W = min(
+        lookup_blocks(
+            ecfg.page_size, cfg.head_dim, bucket, ecfg.kv_quant_dtype
+        ).block_q,
+        bucket,
+    )
     R = -(-bucket // W)
     n_pad = R * W - bucket
 
@@ -975,16 +999,19 @@ class InferenceEngine:
         self.ecfg = ecfg or EngineConfig()
         # Normalize the "auto" knobs ONCE so every jit cache key (the ecfg is
         # part of the lru_cache key) sees resolved values.
+        if self.ecfg.kv_write_impl is not None:
+            raise ValueError(
+                f"EngineConfig.kv_write_impl={self.ecfg.kv_write_impl!r} was "
+                "removed: the ragged kernel fuses the decode KV write into "
+                "the attention launch — set attn_impl='pallas' to run the "
+                "kernel path (docs/KERNELS.md)"
+            )
         if self.ecfg.chunk_attn_impl == "auto":
-            # "the engine already runs pallas anywhere" includes the
-            # deprecated kv_write_impl alias — a legacy kernel-path config
-            # must not silently keep chunk launches on the gather path
             resolved = (
                 "pallas"
                 if (
                     self.ecfg.attn_impl == "pallas"
                     or self.ecfg.prefill_impl == "flash"
-                    or self.ecfg.kv_write_impl == "pallas"
                 )
                 else "ref"
             )
@@ -998,11 +1025,21 @@ class InferenceEngine:
             raise ValueError(
                 f"attn_impl={self.ecfg.attn_impl!r} must be 'pallas' or 'ref'"
             )
-        if self.ecfg.kv_write_impl not in ("pallas", "ref"):
+        from agentfield_tpu.ops.kv_quant import (
+            KV_QUANT_DTYPES,
+            quant_mode_supported,
+        )
+
+        if self.ecfg.kv_quant_dtype not in KV_QUANT_DTYPES:
             raise ValueError(
-                f"kv_write_impl={self.ecfg.kv_write_impl!r} must be 'pallas' "
-                "or 'ref' (deprecated alias of attn_impl — the ragged kernel "
-                "fuses the decode KV write)"
+                f"kv_quant_dtype={self.ecfg.kv_quant_dtype!r} must be one of "
+                f"{KV_QUANT_DTYPES}"
+            )
+        if not quant_mode_supported(self.ecfg.kv_quant_dtype):
+            raise ValueError(
+                f"kv_quant_dtype={self.ecfg.kv_quant_dtype!r} is not "
+                "supported by this jax build (no float8_e4m3fn) — use "
+                "'int8' or 'none'"
             )
         if self.ecfg.prefill_chunk is None and self.ecfg.chunk_attn_impl == "pallas":
             # Long prompts default onto the chunk kernel instead of the
@@ -1118,8 +1155,19 @@ class InferenceEngine:
             jax.tree.leaves(params)[0].dtype if jax.tree.leaves(params) else cfg.dtype
         )
         self.cache = PagedKVCache.create(
-            cfg, self.ecfg.num_pages, self.ecfg.page_size, cache_dtype, mesh=mesh
+            cfg, self.ecfg.num_pages, self.ecfg.page_size, cache_dtype,
+            mesh=mesh, kv_quant=self.ecfg.kv_quant_dtype,
         )
+        # Dense-twin page bytes (what a bf16/f32 pool at the same geometry
+        # would cost): the yardstick for every kv_quant_*_saved counter —
+        # HBM (pool.alloc), host store (demote/adopt), and wire
+        # (model_node.kv_export_pages reads these attrs).
+        _dense_dt = llama.resolve_dtype(cache_dtype)
+        self.kv_page_bytes_dense = (
+            2 * cfg.num_layers * cfg.num_kv_heads
+            * self.ecfg.page_size * cfg.head_dim * jnp.dtype(_dense_dt).itemsize
+        )
+        self.kv_page_bytes = self.cache.page_bytes()
         # Speculative decoding: the draft model mirrors the target's page
         # TABLE (one allocator governs both) with its own page pool sized by
         # the draft config. Prefills replay onto the draft cache so proposals
@@ -1159,7 +1207,7 @@ class InferenceEngine:
                     self.draft_params = _shard(self.draft_params, self.draft_cfg, mesh)
             self.draft_cache = PagedKVCache.create(
                 self.draft_cfg, self.ecfg.num_pages, self.ecfg.page_size,
-                cache_dtype, mesh=mesh,
+                cache_dtype, mesh=mesh, kv_quant=self.ecfg.kv_quant_dtype,
             )
         self.draft_prefill_cfg = (
             _sparse_prefill_cfg(self.draft_cfg, self.ecfg)
@@ -1247,6 +1295,13 @@ class InferenceEngine:
         self.allocator = PrefixPagePool(  # guarded by: _session_lock
             self.ecfg.num_pages, self.ecfg.page_size, stats=self.stats
         )
+        if self.ecfg.kv_quant_dtype != "none":
+            # Arm the kv_quant_* counters: every page the pool hands out
+            # stores quantized KV, saving (dense - quant) bytes vs the
+            # bf16 twin in HBM and in the host store alike.
+            self.allocator.configure_quant(
+                max(0, self.kv_page_bytes_dense - self.kv_page_bytes)
+            )
         # Per-pending-request prompt chain hashes, computed once: the
         # admission probe runs every tick over the whole window, and
         # re-hashing long prompts each tick would tax the decode loop.
@@ -1317,8 +1372,11 @@ class InferenceEngine:
                     "enable_prefix_cache and shared_prefix_cache: the host "
                     "tier is content-addressed"
                 )
-            kb = self.cache.k_pages
-            page_bytes = 2 * (kb.size // kb.shape[1]) * kb.dtype.itemsize
+            # Quantized pools press host_cache_bytes at ~half the dense
+            # rate (page_bytes includes the per-slot scales), so the same
+            # budget holds ~2x the demoted pages — the tier-capacity half
+            # of the kv_quant_dtype win (docs/PREFIX_CACHING.md).
+            page_bytes = self.kv_page_bytes
             self.allocator.enable_host_tier(
                 budget_bytes=self.ecfg.host_cache_bytes,
                 page_bytes=page_bytes,
@@ -1339,8 +1397,7 @@ class InferenceEngine:
             # demoted page would (docs/PREFIX_CACHING.md "Cluster tier").
             # The budget is a transfer staging buffer, not a cache — sized
             # to a few in-flight prefixes.
-            kb = self.cache.k_pages
-            page_bytes = 2 * (kb.size // kb.shape[1]) * kb.dtype.itemsize
+            page_bytes = self.kv_page_bytes
             self.allocator.enable_restore(
                 budget_bytes=32 * page_bytes,
                 page_bytes=page_bytes,
@@ -2333,19 +2390,26 @@ class InferenceEngine:
         tick path. Target cache only: a restored page's DRAFT-cache twin
         stays stale, which can only lower speculative acceptance (the
         verify forward reads the target cache — emitted tokens are exact)."""
-        return (self.cache.k_pages[:, page], self.cache.v_pages[:, page])
+        sl = lambda a: a[:, page]  # noqa: E731
+        return (
+            jax.tree.map(sl, self.cache.k_pages),
+            jax.tree.map(sl, self.cache.v_pages),
+        )
 
     def _upload_page_kv(self, payloads, pages: list[int]) -> None:
         """Restore host-tier payloads into HBM `pages` (pool callback;
         admission path under _session_lock) — ONE jitted scatter for the
-        whole batch. The round-tripped bytes are bit-identical, so
-        attention over restored pages is token-exact."""
-        k_host = np.stack([p[0] for p in payloads], axis=1)  # [L, N, ...]
-        v_host = np.stack([p[1] for p in payloads], axis=1)
+        whole batch, leaf-by-leaf over the (possibly quantized) pool
+        pytree. The round-tripped bytes are bit-identical — scales
+        included — so attention over restored pages is token-exact within
+        the active kv_quant_dtype."""
+        stack = lambda *xs: jnp.asarray(np.stack(xs, axis=1))  # noqa: E731  [L, N, ...]
+        k_host = jax.tree.map(stack, *[p[0] for p in payloads])
+        v_host = jax.tree.map(stack, *[p[1] for p in payloads])
         fn = _restore_page_fn()
         self.cache.k_pages, self.cache.v_pages = fn(
             self.cache.k_pages, self.cache.v_pages,
-            jnp.asarray(k_host), jnp.asarray(v_host),
+            k_host, v_host,
             jnp.asarray(np.asarray(pages, np.int32)),
         )
 
@@ -2413,6 +2477,23 @@ class InferenceEngine:
             return 0
         with self._session_lock:
             return self.allocator.peek(tokens)
+
+    def page_payload_spec(self) -> list[tuple[str, tuple[int, ...]]]:
+        """``(dtype, shape)`` per flattened leaf of ONE exported page
+        payload — the wire contract for cross-node kv transfer
+        (model_node.kv_export_pages / maybe_prefetch_kv). Plain pools have
+        two leaves (k, v); quantized pools four (k values, k scales, v
+        values, v scales) — the scales ride the wire so an adopted page
+        dequantizes identically on the far side."""
+        leaves = jax.tree.leaves((self.cache.k_pages, self.cache.v_pages))
+        return [(str(a.dtype), (a.shape[0],) + a.shape[2:]) for a in leaves]
+
+    def build_page_payload(self, leaves: Sequence[Any]):
+        """Rebuild one host-store payload from its flattened wire leaves
+        (inverse of flattening a captured page — same treedef as the
+        pool)."""
+        treedef = jax.tree.structure((self.cache.k_pages, self.cache.v_pages))
+        return jax.tree.unflatten(treedef, list(leaves))
 
     def adopt_kv_pages(
         self, entries: Sequence[tuple[bytes, int, tuple[int, ...], Any]]

@@ -36,13 +36,17 @@ from agentfield_tpu.prefix_hash import chain_hash, page_chain_hashes, sketch_dig
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: jnp.ndarray  # [L, P, Kh, ps, hd]
-    v_pages: jnp.ndarray  # [L, P, Kh, ps, hd]
+    # Plain [L, P, Kh, ps, hd] arrays, or ops.kv_quant.QuantPages (int8/fp8
+    # values + per-slot f32 scales) when kv_quant != "none" — a pytree
+    # either way, so jitted scheduler paths carry ONE pool operand.
+    k_pages: Any
+    v_pages: Any
     page_size: int
+    kv_quant: str = "none"
 
     @property
     def num_pages(self) -> int:
-        return self.k_pages.shape[1]
+        return jax.tree.leaves(self.k_pages)[0].shape[1]
 
     @staticmethod
     def create(
@@ -51,26 +55,60 @@ class PagedKVCache:
         page_size: int,
         dtype: str | None = None,
         mesh=None,
+        kv_quant: str = "none",
     ) -> "PagedKVCache":
         """With a mesh, pages shard over the KV-head axis on `model` (matching
         the TP sharding of wk/wv, so K/V writes during decode are local — no
-        resharding on the hot path)."""
-        dt = resolve_dtype(dtype or cfg.dtype)
+        resharding on the hot path). ``kv_quant`` ("int8" | "fp8") stores the
+        pages quantized with per-slot scales (docs/KERNELS.md "Quantized
+        pages") — roughly double the pages per HBM byte; scales start at 0,
+        so fresh pages dequantize to the same zeros a plain pool holds."""
+        from agentfield_tpu.ops.kv_quant import QuantPages, quant_value_dtype
+
         shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim)
-        k = jnp.zeros(shape, dt)
-        v = jnp.zeros(shape, dt)
+        if kv_quant != "none":
+            qdt = quant_value_dtype(kv_quant)
+
+            def mk():
+                return QuantPages(
+                    jnp.zeros(shape, qdt), jnp.zeros(shape[:-1], jnp.float32)
+                )
+
+            k, v = mk(), mk()
+        else:
+            dt = resolve_dtype(dtype or cfg.dtype)
+            k = jnp.zeros(shape, dt)
+            v = jnp.zeros(shape, dt)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from agentfield_tpu.parallel.mesh import AXIS_MODEL
 
             if mesh.shape.get(AXIS_MODEL, 1) > 1:
-                s = NamedSharding(mesh, P(None, None, AXIS_MODEL, None, None))
-                k, v = jax.device_put(k, s), jax.device_put(v, s)
-        return PagedKVCache(k_pages=k, v_pages=v, page_size=page_size)
+                def place(a):
+                    # pages and scales both carry Kh at axis 2
+                    spec = P(*([None, None, AXIS_MODEL] + [None] * (a.ndim - 3)))
+                    return jax.device_put(a, NamedSharding(mesh, spec))
+
+                k = jax.tree.map(place, k)
+                v = jax.tree.map(place, v)
+        return PagedKVCache(
+            k_pages=k, v_pages=v, page_size=page_size, kv_quant=kv_quant
+        )
 
     def hbm_bytes(self) -> int:
-        return 2 * self.k_pages.size * self.k_pages.dtype.itemsize
+        return 2 * sum(
+            a.size * a.dtype.itemsize for a in jax.tree.leaves(self.k_pages)
+        )
+
+    def page_bytes(self) -> int:
+        """Bytes ONE page occupies across all layers, K+V, including the
+        per-slot scales of a quantized pool — the unit the host tier and
+        the capacity math budget in."""
+        total = 0
+        for a in jax.tree.leaves((self.k_pages, self.v_pages)):
+            total += (a.size // a.shape[1]) * a.dtype.itemsize
+        return total
 
 
 class PageAllocator:
@@ -305,8 +343,23 @@ class PrefixPagePool:
             "kv_fetch_failed_total",
             "kv_fetch_bytes_total",
             "kv_fetch_pages_adopted_total",
+            # Quantized KV pages (docs/KERNELS.md "Quantized pages",
+            # EngineConfig.kv_quant_dtype): always exported so the
+            # stats→heartbeat→Prometheus pipeline carries the family even
+            # with quantization off. *_bytes_saved are vs the engine's
+            # dense (bf16/f32) page layout at the same page count.
+            "kv_quant_pages_total",
+            "kv_quant_bytes_saved_total",
+            "kv_quant_host_bytes_saved_total",
+            "kv_quant_wire_bytes_saved_total",  # incremented by the model
+            # node's kv_export_pages (cross-node transfer serving side)
         ):
             self.stats.setdefault(k, 0)
+        # Armed by the engine when kv_quant_dtype != none (configure_quant):
+        # bytes one quantized page saves vs its dense twin, in HBM and in
+        # the host store respectively (same payload → same value today).
+        self._quant_hbm_saved = 0
+        self._quant_host_saved = 0
         # ---- host (offload) tier — inert until enable_host_tier() wires the
         # device-copy callbacks; every branch below checks _host_enabled so
         # the disabled pool is bit-compatible with the single-tier one.
@@ -373,6 +426,22 @@ class PrefixPagePool:
         holder references it. Writers must copy-on-write first."""
         return page in self._by_page or self._refs[page] > 1
 
+    def configure_quant(
+        self, hbm_saved_per_page: int, host_saved_per_page: int | None = None
+    ) -> None:
+        """Arm the quantized-page counters (engine init, kv_quant_dtype !=
+        none): every page this pool hands out stores its KV quantized, so
+        ``alloc`` counts ``kv_quant_pages_total`` and banks the per-page HBM
+        saving; demote commits and peer adoptions bank the host-store
+        saving. 0 (the default) keeps the counters inert and the pool
+        bit-compatible with the unquantized one."""
+        self._quant_hbm_saved = max(0, int(hbm_saved_per_page))
+        self._quant_host_saved = (
+            self._quant_hbm_saved
+            if host_saved_per_page is None
+            else max(0, int(host_saved_per_page))
+        )
+
     # -- allocation -----------------------------------------------------
 
     def _tick(self) -> float:
@@ -396,6 +465,11 @@ class PrefixPagePool:
                 self.stats["prefix_pages_evicted"] += 1
             self._refs[p] = 1
             out.append(p)
+        if self._quant_hbm_saved:
+            # every allocated page stores quantized KV: n pages just cost
+            # n * (dense - quant) bytes less HBM than the bf16 pool would
+            self.stats["kv_quant_pages_total"] += n
+            self.stats["kv_quant_bytes_saved_total"] += n * self._quant_hbm_saved
         if self._host_enabled and len(self._free) < self._demote_watermark:
             # Allocation pressure: start demoting the LRU tail BEFORE the
             # free list runs dry, so the eviction above (which loses the
@@ -788,6 +862,8 @@ class PrefixPagePool:
             self._host_bytes += self._page_bytes
             n += 1
             self.stats["kv_fetch_pages_adopted_total"] += 1
+            if self._quant_host_saved:
+                self.stats["kv_quant_host_bytes_saved_total"] += self._quant_host_saved
         self._evict_host_over_budget()
         return n
 
@@ -935,6 +1011,10 @@ class PrefixPagePool:
         rec.tier = TIER_HOST
         rec.page = -1
         self.stats["kv_offload_demoted"] += 1
+        if self._quant_host_saved:
+            # a quantized payload presses the host budget at ~half the
+            # dense rate: bank the difference for the capacity runbook
+            self.stats["kv_quant_host_bytes_saved_total"] += self._quant_host_saved
         self._evict_host_over_budget()
 
     def _prepare_restore(self, rec: PageRecord) -> tuple[PageRecord, int, Any] | None:

@@ -1029,13 +1029,19 @@ class ModelBackend:
 
     # -- cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier") ----
 
-    async def kv_export_pages(self, chains_hex: list[str], max_bytes: int) -> list[dict]:
+    async def kv_export_pages(
+        self, chains_hex: list[str], max_bytes: int
+    ) -> list[tuple[dict, bytes]]:
         """Serve a peer's kv_fetch: look the requested chain hashes up in
-        this engine's prefix index (both tiers) and serialize the pages for
-        the wire. The device→host copies run off the event loop; the byte
-        cap stops serialization early (the requester re-prefills the tail)."""
-        import base64
-
+        this engine's prefix index (both tiers) and serialize each page as
+        ``(meta, payload)`` — meta describes the flattened payload leaves
+        (dtype/shape/byte segments; a quantized pool ships values AND
+        scales), payload is the raw concatenated bytes the channel carries
+        as a BINARY frame (no base64: the old text encoding paid ~33% wire
+        overhead on every transferred page). The device→host copies run
+        off the event loop; the byte cap stops serialization early (the
+        requester re-prefills the tail)."""
+        import jax
         import numpy as np
 
         chains = []
@@ -1046,36 +1052,47 @@ class ModelBackend:
                 continue
             if len(b) == 16:
                 chains.append(b)
+        # what a dense (bf16/f32) page would put on the wire — the yardstick
+        # for the kv_quant wire-saving counter
+        dense_page = self.engine.kv_page_bytes_dense
+        quant_on = self.engine.ecfg.kv_quant_dtype != "none"
 
         def _export_and_serialize():
-            # ONE thread hop covers both the D2H copies and the base64
-            # encode of up-to-MBs of payload — serializing on the event
-            # loop would stall every stream multiplexed on this node.
+            # ONE thread hop covers both the D2H copies and the payload
+            # flattening of up-to-MBs — serializing on the event loop
+            # would stall every stream multiplexed on this node.
             raw = self.engine.export_kv_pages(chains)
-            pages: list[dict] = []
-            total = 0
+            pages: list[tuple[dict, bytes]] = []
+            total = wire_saved = 0
             for chain, depth, payload in raw:
-                k, v = np.asarray(payload[0]), np.asarray(payload[1])
-                kb = base64.b64encode(np.ascontiguousarray(k).tobytes()).decode()
-                vb = base64.b64encode(np.ascontiguousarray(v).tobytes()).decode()
-                if total + len(kb) + len(vb) > max_bytes:
+                leaves = [
+                    np.ascontiguousarray(np.asarray(a))
+                    for a in jax.tree.leaves(payload)
+                ]
+                blobs = [a.tobytes() for a in leaves]
+                sz = sum(len(b) for b in blobs)
+                if total + sz > max_bytes:
                     break
-                pages.append(
-                    {
-                        "chain": chain.hex(),
-                        "depth": int(depth),
-                        "k": kb,
-                        "v": vb,
-                        "dtype": str(k.dtype),
-                        "shape": list(k.shape),
-                    }
-                )
-                total += len(kb) + len(vb)
-            return pages, total
+                meta = {
+                    "chain": chain.hex(),
+                    "depth": int(depth),
+                    "parts": [
+                        {"dtype": str(a.dtype), "shape": list(a.shape)}
+                        for a in leaves
+                    ],
+                    "segs": [len(b) for b in blobs],
+                }
+                pages.append((meta, b"".join(blobs)))
+                total += sz
+                if quant_on:
+                    wire_saved += max(0, dense_page - sz)
+            return pages, total, wire_saved
 
-        pages, total = await asyncio.to_thread(_export_and_serialize)
+        pages, total, wire_saved = await asyncio.to_thread(_export_and_serialize)
         self.engine.stats["kv_fetch_served_total"] += len(pages)
         self.engine.stats["kv_fetch_bytes_total"] += total
+        if wire_saved:
+            self.engine.stats["kv_quant_wire_bytes_saved_total"] += wire_saved
         return pages
 
     async def maybe_prefetch_kv(self, tokens: list[int] | None, hint: Any) -> int:
@@ -1087,8 +1104,6 @@ class ModelBackend:
         payload, seeded kv.fetch_fail/kv.fetch_stall) degrades to an
         ordinary local prefill, token-exact, zero pages leaked. Returns the
         number of pages adopted."""
-        import base64
-
         import numpy as np
 
         from agentfield_tpu.prefix_hash import page_chain_hashes
@@ -1134,30 +1149,47 @@ class ModelBackend:
                 return 0
 
             def _decode_entries():
-                # base64 + frombuffer over up to MBs of payload: off the
-                # event loop, or every stream multiplexed on this node
-                # stalls while one transfer decodes.
+                # frombuffer over up to MBs of payload: off the event
+                # loop, or every stream multiplexed on this node stalls
+                # while one transfer decodes.
                 by_chain = {
                     pg.get("chain"): pg for pg in got if isinstance(pg, dict)
                 }
-                kp = self.engine.cache.k_pages
-                page_shape = (kp.shape[0], kp.shape[2], kp.shape[3], kp.shape[4])
+                # per-leaf (dtype, shape) contract of ONE page payload —
+                # validated against THIS engine's pool geometry (incl. the
+                # quantized value/scale leaves), so a mismatched or corrupt
+                # peer can only end the adoptable prefix early
+                spec = self.engine.page_payload_spec()
                 out = []
                 for idx, h in enumerate(missing):
                     pg = by_chain.get(h.hex())
                     if pg is None:
                         break  # a gap ends the adoptable prefix (chain rule)
                     try:
-                        dt = np.dtype(pg["dtype"])
-                        shape = tuple(pg["shape"])
-                        if shape != page_shape:
-                            raise ValueError(f"page shape {shape} != {page_shape}")
-                        k = np.frombuffer(
-                            base64.b64decode(pg["k"]), dtype=dt
-                        ).reshape(shape)
-                        v = np.frombuffer(
-                            base64.b64decode(pg["v"]), dtype=dt
-                        ).reshape(shape)
+                        parts = pg["parts"]
+                        segs = [int(s) for s in pg["segs"]]
+                        data = pg["data"]
+                        if len(parts) != len(spec) or len(segs) != len(spec):
+                            raise ValueError("payload leaf count mismatch")
+                        leaves = []
+                        off = 0
+                        for part, seg, (want_dt, want_shape) in zip(
+                            parts, segs, spec
+                        ):
+                            dt = np.dtype(part["dtype"])
+                            shape = tuple(part["shape"])
+                            if (str(dt), shape) != (want_dt, want_shape):
+                                raise ValueError(
+                                    f"leaf {part} != expected "
+                                    f"{(want_dt, want_shape)}"
+                                )
+                            leaves.append(
+                                np.frombuffer(
+                                    data[off : off + seg], dtype=dt
+                                ).reshape(shape)
+                            )
+                            off += seg
+                        payload = self.engine.build_page_payload(leaves)
                     except Exception:
                         self.engine.stats["kv_fetch_failed_total"] += 1
                         break
@@ -1165,7 +1197,7 @@ class ModelBackend:
                     out.append(
                         (h, depth,
                          tuple(matchable[depth * ps : (depth + 1) * ps]),
-                         (k, v))
+                         payload)
                     )
                 return out
 

@@ -86,6 +86,17 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     Reports resume TTFT p50/p99 both modes, restore hit rate, and the
     kv_offload_* counters; headline value = resume TTFT p50 speedup
     (OFF/ON; acceptance: > 1.0). AGENTFIELD_BENCH_SESSIONS sizes the set.
+  kv_quant — quantized-KV capacity bench (docs/PREFIX_CACHING.md
+    "Capacity math", docs/KERNELS.md "Quantized pages"): the session-churn
+    overload shape at a FIXED HBM byte budget, run twice on fresh engines —
+    kv_quant_dtype=int8 (AGENTFIELD_BENCH_KV_QUANT_DTYPE overrides) vs
+    none. The budget buys ~1.9-3.8x more pages quantized (dtype-dependent),
+    so the ON engine retains ~2x more idle-session KV under churn: more
+    resumes hit the prefix index, fewer pay a full re-prefill. Reports the
+    effective page-capacity ratio at equal bytes (headline; acceptance:
+    >= 1.7x), the bf16-normalized ratio, resume index hit rates, prefill
+    tokens, kv_quant_* counters, per-dtype kernel parity (kernel_gate's
+    quantized mixes), and zero-leaked-pages audits in both modes.
   cluster_prefix_burst — cluster prefix cache bench (docs/PREFIX_CACHING.md
     "Cluster tier"): ONE in-process gateway × THREE model nodes (CPU
     llama-tiny proxy, shared weights). Node 1 is warmed with K shared
@@ -383,6 +394,11 @@ SCENARIOS: dict[str, dict] = {
         "dispatch_before_probe": False,
         "run": lambda c: _cluster_prefix_burst(c["model"], c["cfg"], c["params"], c["attn"]),
         "doc": "1 gateway x 3 nodes: prefix-affinity routing + KV transfer",
+    },
+    "kv_quant": {
+        "dispatch_before_probe": False,
+        "run": lambda c: _kv_quant(c["model"], c["cfg"], c["params"], c["attn"]),
+        "doc": "quantized KV pages: capacity A/B at fixed HBM bytes, quant on vs off",
     },
     "best_of_n": {
         "dispatch_before_probe": False,
@@ -1285,6 +1301,179 @@ def _session_churn(model: str, cfg, params, attn: str) -> None:
             "num_pages": ecfg_on.num_pages,
             "idle_pages_demanded": idle_demand,
             "host_cache_bytes": ecfg_on.host_cache_bytes,
+            "attn_impl": attn,
+            "device": str(jax.devices()[0]),
+        }
+    )
+
+
+
+def _kv_quant(model: str, cfg, params, attn: str) -> None:
+    """Quantized-KV capacity A/B (docs/PREFIX_CACHING.md "Capacity math"):
+    one FIXED HBM byte budget, two engines — kv_quant_dtype on vs off —
+    each given as many pages as the budget buys its representation. The
+    workload is the churn shape capacity actually serves: N sessions take
+    a turn and go idle; the pool holds only a fraction of the idle set, so
+    LRU churn evicts what doesn't fit; then every session resumes. The ON
+    engine's extra pages retain ~2x the idle KV → resumes hit the prefix
+    index instead of re-prefilling. Headline = measured pages-at-equal-
+    bytes ratio (acceptance >= 1.7x; the bf16-normalized ratio is reported
+    alongside because a CPU f32 baseline makes the raw ratio ~2x more
+    favorable than production bf16)."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+    qdt = os.environ.get("AGENTFIELD_BENCH_KV_QUANT_DTYPE") or "int8"
+    n_sessions = int(os.environ.get("AGENTFIELD_BENCH_SESSIONS") or 12)
+    page_size = 32
+    prompt_len, turn_new, resume_new, tail_len = 224, 16, 8, 8
+    pages_per_session = -(-(prompt_len + turn_new) // page_size)  # full hist
+
+    def build(kv_quant: str, num_pages: int):
+        return InferenceEngine(
+            params, cfg,
+            EngineConfig(
+                max_batch=2, page_size=page_size, num_pages=num_pages,
+                max_pages_per_seq=16, max_pending=64, prefill_batch=1,
+                attn_impl="pallas" if attn == "pallas" else "ref",
+                prefill_impl="flash" if attn == "pallas" else "ref",
+                kv_quant_dtype=kv_quant, session_ttl=0.0,
+            ),
+        )
+
+    # Size the budget so the OFF pool holds ~half the idle set — capacity
+    # is the binding constraint by construction, like overload admission.
+    probe_off = build("none", 32)
+    page_bytes_off = probe_off.kv_page_bytes
+    dense_bf16_page = page_bytes_off // jnp.dtype(
+        jax.tree.leaves(probe_off.cache.k_pages)[0].dtype
+    ).itemsize * 2
+    probe_off.close()
+    probe_on = build(qdt, 32)
+    page_bytes_on = probe_on.kv_page_bytes
+    probe_on.close()
+    pages_off = n_sessions * pages_per_session // 2 + 2
+    budget_bytes = pages_off * page_bytes_off
+    pages_on = max(2, budget_bytes // page_bytes_on)
+    capacity_ratio = (pages_on - 1) / (pages_off - 1)  # page 0 reserved
+    bf16_ratio = dense_bf16_page / page_bytes_on
+
+    def run_one(engine, req):
+        engine.submit(req)
+        t0 = time.perf_counter()
+        ttft, toks = None, []
+        while engine.has_work():
+            for ev in engine.step():
+                if ev.token >= 0 and ev.request_id == req.id:
+                    if ttft is None:
+                        ttft = (time.perf_counter() - t0) * 1e3
+                    toks.append(ev.token)
+        return ttft, toks
+
+    def req(rid, prompt, max_new, session):
+        return Request(
+            id=rid, prompt=prompt,
+            sampling=SamplingParams(max_new_tokens=max_new), session_id=session,
+        )
+
+    def turn1_prompt(i):
+        return jax.random.randint(
+            jax.random.PRNGKey(100 + i), (prompt_len,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    def tail(i):
+        return jax.random.randint(
+            jax.random.PRNGKey(400 + i), (tail_len,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    if not _budget_gate("kv_quant", 120):
+        _emit(_fallback_payload("budget exhausted before kv_quant"))
+        return
+
+    def run_mode(kv_quant: str, num_pages: int):
+        # warm engine: compile turn-1 prefill + decode, warm-resume suffix
+        # prefill, and the cold full re-prefill outside the measurement
+        warm = build(kv_quant, num_pages)
+        _, w_out = run_one(warm, req("w", turn1_prompt(999), turn_new, "w"))
+        warm.free_session("w")
+        run_one(warm, req("w2", turn1_prompt(999) + w_out + tail(999), resume_new, "w"))
+        warm.free_session("w")
+        warm.close()
+        del warm
+
+        engine = build(kv_quant, num_pages)
+        outs: dict[int, list[int]] = {}
+        for i in range(n_sessions):
+            _, outs[i] = run_one(
+                engine, req(f"t{i}", turn1_prompt(i), turn_new, f"s{i}")
+            )
+            # sessions go idle immediately (churn pressure comes from the
+            # NEXT sessions' allocations evicting the LRU tail)
+            engine.free_session(f"s{i}")
+        ttfts, index_hits, prefill0 = [], 0, engine.stats["prefill_tokens"]
+        for i in range(n_sessions):
+            h_before = engine.stats["prefix_index_hits"]
+            t_ms, _ = run_one(
+                engine,
+                req(f"r{i}", turn1_prompt(i) + outs[i] + tail(i), resume_new, f"s{i}"),
+            )
+            ttfts.append(t_ms)
+            index_hits += engine.stats["prefix_index_hits"] > h_before
+            engine.free_session(f"s{i}")
+        stats = dict(engine.stats)
+        pool = engine.allocator
+        leak_free = pool.free_pages == pool.num_pages - 1
+        engine.close()
+        return {
+            "resume_index_hits": index_hits,
+            "resume_prefill_tokens": stats["prefill_tokens"] - prefill0,
+            "prefix_pages_evicted": stats["prefix_pages_evicted"],
+            "kv_quant_pages_total": stats["kv_quant_pages_total"],
+            "kv_quant_bytes_saved_total": stats["kv_quant_bytes_saved_total"],
+            "resume_ttft_ms_p50": round(_pctile(ttfts, 50), 1),
+            "zero_leaked_pages": leak_free,
+        }
+
+    _partial["stage"] = f"kv_quant {qdt} ON ({pages_on} pages)"
+    on = run_mode(qdt, int(pages_on))
+    _partial["stage"] = f"kv_quant OFF ({pages_off} pages)"
+    off = run_mode("none", int(pages_off))
+
+    # per-dtype kernel parity at the gated quantized mixes (the same
+    # numbers tier-1's microbench parity gate pins)
+    from tools.perf.kernel_gate import PARITY_TOL, run_microbench
+
+    parity = {}
+    block = run_microbench(fast=True, iters=1, parity=True)
+    for name, entry in block["shapes"].items():
+        if entry["kv_dtype"] != "none":
+            parity[name] = {
+                "max_abs_err": entry["parity_max_abs_err"],
+                "bound": PARITY_TOL[entry["kv_dtype"]],
+                "pool_exact": entry["parity_pool_exact"],
+            }
+
+    _emit(
+        {
+            "metric": f"kv_quant_{qdt}_{model}_{n_sessions}sessions",
+            "value": round(capacity_ratio, 3),
+            "unit": "effective_page_capacity_ratio_at_equal_hbm",
+            "bf16_normalized_ratio": round(bf16_ratio, 3),
+            "page_bytes_dense": page_bytes_off,
+            "page_bytes_quant": page_bytes_on,
+            "budget_bytes": int(budget_bytes),
+            "num_pages_on": int(pages_on),
+            "num_pages_off": int(pages_off),
+            "sessions": n_sessions,
+            "pages_per_session": pages_per_session,
+            "on": on,
+            "off": off,
+            "resume_index_hit_rate_on": round(on["resume_index_hits"] / n_sessions, 4),
+            "resume_index_hit_rate_off": round(off["resume_index_hits"] / n_sessions, 4),
+            "kernel_parity": parity,
+            "kv_quant_dtype": qdt,
             "attn_impl": attn,
             "device": str(jax.devices()[0]),
         }

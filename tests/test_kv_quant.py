@@ -1,0 +1,436 @@
+"""Quantized KV pages (EngineConfig.kv_quant_dtype; docs/KERNELS.md
+"Quantized pages"): per-dtype kernel↔reference parity with BIT-exact pool
+writes and scales, quantized demote→restore and cross-node transfer round
+trips (scales survive; zero leaked pages), the on/off generation-quality
+pin at tiny scale, the binary wire framing, and the always-present
+kv_quant_* counter family."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.ops.kv_quant import (
+    KV_QUANT_DTYPES,
+    QuantPages,
+    kv_dequantize,
+    kv_quantize,
+    quant_mode_supported,
+)
+from agentfield_tpu.ops.paged_attention import ragged_paged_attention_ref
+from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
+    ragged_paged_attention_pallas,
+)
+from agentfield_tpu.serving import (
+    EngineConfig,
+    InferenceEngine,
+    Request,
+    SamplingParams,
+)
+
+QUANT_MODES = [m for m in KV_QUANT_DTYPES if m != "none" and quant_mode_supported(m)]
+
+# kernel-vs-ref attention bound per dtype (tools/perf/kernel_gate.PARITY_TOL
+# is the same pin on the microbench side)
+TOL = {"int8": 2e-2, "fp8": 6e-2}
+
+
+# ---------------------------------------------------------------------------
+# quantization helpers
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+def test_quantize_roundtrip_error_bound(mode):
+    """The dequant error bound per format: int8 is uniform (half a step of
+    the vector's max-abs / 127); fp8 e4m3 is RELATIVE (3 mantissa bits ⇒
+    ≤ 2^-4 of each element's own magnitude). All-zero vectors round-trip
+    to exact zeros."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 7, 64)) * 3.0, jnp.float32)
+    q, s = kv_quantize(x, mode)
+    back = kv_dequantize(q, s)
+    maxabs = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    if mode == "int8":
+        assert (err <= maxabs * (0.51 / 127.0) + 1e-7).all()
+    else:
+        assert (err <= np.abs(np.asarray(x)) * 2.0**-4 + maxabs * 1e-3).all()
+    zq, zs = kv_quantize(jnp.zeros((2, 64)), mode)
+    assert np.all(np.asarray(kv_dequantize(zq, zs)) == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# per-dtype kernel parity battery (quantized twin of the bf16 battery in
+# tests/test_pallas_kernels.py — allocator-valid launches via the engine's
+# own packer)
+
+_CASES = {
+    "all_decode": dict(
+        entries=[(0, 1), (7, 1), (8, 1), (15, 1), (16, 1), (40, 1)],
+        ps=8, maxp=6, kh=2, rep=2, hd=32, W=1,
+    ),
+    "adversarial_interleave": dict(
+        entries=[(11, 1), (5, 13), (30, 1), (3, 7), (47, 1)],
+        ps=8, maxp=8, kh=2, rep=4, hd=32, W=4,
+    ),
+    "all_prefill": dict(
+        entries=[(0, 19), (0, 8), (0, 1)],
+        ps=8, maxp=6, kh=2, rep=2, hd=32, W=8,
+    ),
+}
+
+
+def _build(case, mode, seed=0):
+    from agentfield_tpu.serving.kv_cache import pack_ragged_rows
+
+    ps, maxp, kh, rep, hd, W = (
+        case["ps"], case["maxp"], case["kh"], case["rep"], case["hd"], case["W"]
+    )
+    entries = case["entries"]
+    H = kh * rep
+    n_seqs = len(entries)
+    P = n_seqs * maxp + 3
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(P - 1) + 1
+    seq_tables = perm[: n_seqs * maxp].reshape(n_seqs, maxp)
+    need = sum(-(-n // W) for _, n in entries)
+    rr = pack_ragged_rows(
+        [
+            (seq_tables[sid], start, [0] * n)
+            for sid, (start, n) in enumerate(entries)
+        ],
+        maxp, budget=need * W, block_q=W,
+    )
+    R = rr.row_starts.shape[0]
+    q = jnp.asarray(rng.standard_normal((R, W, H, hd)), jnp.float32) * 0.5
+    kn = jnp.asarray(rng.standard_normal((R, W, kh, hd)), jnp.float32) * 0.5
+    vn = jnp.asarray(rng.standard_normal((R, W, kh, hd)), jnp.float32) * 0.5
+    pool_f = jnp.asarray(rng.standard_normal((P, kh, ps, hd)), jnp.float32) * 0.5
+    kq, ks = kv_quantize(pool_f, mode)
+    args = (
+        q, kn, vn, kq, kq,
+        jnp.asarray(rr.page_tables), jnp.asarray(rr.row_starts),
+        jnp.asarray(rr.n_tokens), jnp.asarray(rr.ctx_lens),
+        jnp.asarray(rr.seq_ids), ks, ks,
+    )
+    return args, P
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+@pytest.mark.parametrize("name", sorted(_CASES))
+def test_quantized_parity_battery(name, mode):
+    """Quantized kernel vs the quantized-scatter XLA reference: attention
+    inside the pinned per-dtype bound; stored VALUES and SCALES bit-exact
+    on every live page (the shared kv_quantize formula, inlined in the
+    kernel's write phase)."""
+    args, P = _build(_CASES[name], mode)
+    live = np.arange(1, P)
+    for window in (None, 9):
+        ro = ragged_paged_attention_ref(*args, window=window)
+        ko = ragged_paged_attention_pallas(*args, window=window, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(ko[0], np.float32), np.asarray(ro[0], np.float32),
+            rtol=TOL[mode], atol=TOL[mode], err_msg=f"{name} {mode} w={window}",
+        )
+        for i, what in ((1, "K"), (2, "V"), (3, "K scales"), (4, "V scales")):
+            np.testing.assert_array_equal(
+                np.asarray(ko[i])[live].astype(np.float32),
+                np.asarray(ro[i])[live].astype(np.float32),
+                err_msg=f"{name} {mode} {what}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# engine level
+
+
+def _tiny():
+    cfg = get_config("llama-tiny")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+BASE = dict(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=8)
+
+
+def _run(engine, rid, prompt, n, sess=None):
+    res: dict[str, list] = {"toks": [], "lps": []}
+    engine.submit(
+        Request(
+            id=rid, prompt=list(prompt), session_id=sess,
+            sampling=SamplingParams(max_new_tokens=n),
+        )
+    )
+    while engine.has_work():
+        for ev in engine.step():
+            if ev.request_id == rid and ev.token >= 0:
+                res["toks"].append(ev.token)
+                res["lps"].append(ev.logprob)
+    return res
+
+
+def _prompt(seed, n, cfg):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def test_none_mode_is_plain_arrays_and_counters_present():
+    """kv_quant_dtype='none' (default) keeps plain array pools — the
+    bit-for-bit pin is the whole existing suite running on them — and the
+    kv_quant_* counter family is ALWAYS present (zeros) so dashboards can
+    tell 'off' from 'broken'."""
+    cfg, params = _tiny()
+    e = InferenceEngine(params, cfg, EngineConfig(**BASE))
+    assert not isinstance(e.cache.k_pages, QuantPages)
+    for k in (
+        "kv_quant_pages_total",
+        "kv_quant_bytes_saved_total",
+        "kv_quant_host_bytes_saved_total",
+        "kv_quant_wire_bytes_saved_total",
+    ):
+        assert e.stats[k] == 0
+    _run(e, "r", _prompt(1, 9, cfg), 3)
+    assert e.stats["kv_quant_pages_total"] == 0
+    e.close()
+
+
+def test_kv_quant_dtype_validation():
+    cfg, params = _tiny()
+    with pytest.raises(ValueError, match="kv_quant_dtype"):
+        InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype="int4", **BASE))
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+def test_generation_quality_pin_on_vs_off(mode):
+    """The end-to-end quality pin at tiny scale: quantized greedy output
+    matches the unquantized engine on the pinned prompt (per-slot scales
+    keep attention drift under the margin at this scale), per-token
+    logprob drift is bounded, the quantized run is deterministic, and the
+    capacity counters fire."""
+    cfg, params = _tiny()
+    prompt = _prompt(11, 17, cfg)
+    e_off = InferenceEngine(params, cfg, EngineConfig(**BASE))
+    off = _run(e_off, "r", prompt, 6)
+    e_off.close()
+    e_on = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype=mode, **BASE))
+    on = _run(e_on, "r", prompt, 6)
+    assert isinstance(e_on.cache.k_pages, QuantPages)
+    assert e_on.stats["kv_quant_pages_total"] > 0
+    assert e_on.stats["kv_quant_bytes_saved_total"] > 0
+    e_on.close()
+    assert on["toks"] == off["toks"], (mode, on["toks"], off["toks"])
+    drift = max(abs(a - b) for a, b in zip(on["lps"], off["lps"]))
+    assert drift < 0.05, (mode, drift)
+    e_on2 = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype=mode, **BASE))
+    assert _run(e_on2, "r", prompt, 6) == on
+    e_on2.close()
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+def test_quantized_demote_restore_roundtrip(mode):
+    """Demote→restore of quantized pages is bit-exact WITHIN the mode
+    (values + scales round-trip the host store): the resumed session's
+    tokens equal an undemoted quantized run's, restores fire, and the
+    drained pool leaks nothing."""
+    cfg, params = _tiny()
+    p1 = _prompt(21, 20, cfg)
+    ref_e = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype=mode, **BASE))
+    o1 = _run(ref_e, "a", p1, 4, "s")["toks"]
+    p2 = p1 + o1 + [3, 4, 5]
+    ref2 = _run(ref_e, "b", p2, 4, "s")["toks"]
+    ref_e.close()
+
+    ecfg = EngineConfig(
+        kv_quant_dtype=mode, host_cache_bytes=1 << 24, session_ttl=1.0, **BASE
+    )
+    e = InferenceEngine(params, cfg, ecfg)
+    assert _run(e, "a", p1, 4, "s")["toks"] == o1
+    e.gc_sessions(at=time.time() + 100)
+    assert e.allocator.offload_drain(15.0)
+    assert e.stats["kv_offload_demoted"] > 0
+    assert e.stats["kv_quant_host_bytes_saved_total"] > 0
+    got = _run(e, "b", p2, 4, "s")["toks"]
+    assert got == ref2
+    assert e.stats["kv_offload_restored"] > 0
+    e.free_session("s")
+    pool = e.allocator
+    assert pool.free_pages == pool.num_pages - 1  # zero leaked pages
+    e.close()
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+def test_quantized_cross_node_transfer_roundtrip(mode):
+    """export_kv_pages → adopt_kv_pages between two quantized engines:
+    the payload pytree (values + scales) survives intact, the adopter's
+    generation is token-exact vs the source, and both pools drain to zero
+    leaked pages."""
+    from agentfield_tpu.prefix_hash import page_chain_hashes
+
+    cfg, params = _tiny()
+    ecfg = EngineConfig(kv_quant_dtype=mode, **BASE)
+    a = InferenceEngine(params, cfg, ecfg)
+    shared = _prompt(31, 24, cfg)  # 3 full pages at page_size 8
+    _run(a, "w", shared + [1, 2], 4)
+    prompt = shared + [7, 9]
+    want = _run(a, "ref", prompt, 6)["toks"]
+
+    chains = page_chain_hashes(shared, 8)
+    exported = a.export_kv_pages(chains)
+    assert len(exported) == 3
+    # quantized payloads carry 4 leaves per side-pair: values + scales
+    leaves = jax.tree.leaves(exported[0][2])
+    assert len(leaves) == 4
+
+    b = InferenceEngine(params, cfg, ecfg)
+    entries = [
+        (chain, depth, tuple(shared[depth * 8 : (depth + 1) * 8]), payload)
+        for chain, depth, payload in exported
+    ]
+    assert b.adopt_kv_pages(entries) == 3
+    pre = b.stats["prefill_tokens"]
+    got = _run(b, "r", prompt, 6)["toks"]
+    assert got == want
+    # only the un-cached tail prefilled — the adopted pages restored
+    assert b.stats["prefill_tokens"] - pre < len(shared)
+    assert b.stats["kv_offload_restored"] == 3
+    for e in (a, b):
+        assert not e.has_work()
+        e.allocator.offload_drain(5.0)
+        e.close()
+
+
+def test_transfer_shape_check_rejects_mismatched_dtype():
+    """A quantized node must not adopt a dense peer's pages (and vice
+    versa): the payload spec differs, so the model node's wire validation
+    ends the adoptable prefix — pinned here at the spec level."""
+    cfg, params = _tiny()
+    e_on = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype="int8", **BASE))
+    e_off = InferenceEngine(params, cfg, EngineConfig(**BASE))
+    assert e_on.page_payload_spec() != e_off.page_payload_spec()
+    assert len(e_on.page_payload_spec()) == 4
+    assert len(e_off.page_payload_spec()) == 2
+    e_on.close()
+    e_off.close()
+
+
+@pytest.mark.parametrize("mode", QUANT_MODES)
+def test_fork_cow_tail_copy_carries_scales(mode):
+    """Branch decoding over a quantized pool: the COW tail copy moves
+    values AND scales, so sibling branch 0 is token-exact vs the unforked
+    quantized request under greedy."""
+    from agentfield_tpu.branching import branch_rid
+
+    cfg, params = _tiny()
+    prompt = _prompt(41, 11, cfg)  # partial tail page at page_size 8
+    plain = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype=mode, **BASE))
+    want = _run(plain, "r", prompt, 5)["toks"]
+    plain.close()
+    e = InferenceEngine(
+        params, cfg,
+        EngineConfig(kv_quant_dtype=mode, max_batch=4, page_size=8,
+                     num_pages=64, max_pages_per_seq=8),
+    )
+    outs: dict[str, list[int]] = {}
+    e.submit(
+        Request(id="r", prompt=list(prompt), n_branches=2,
+                sampling=SamplingParams(max_new_tokens=5))
+    )
+    while e.has_work():
+        for ev in e.step():
+            if ev.token >= 0:
+                outs.setdefault(ev.request_id, []).append(ev.token)
+    assert outs["r"] == want  # branch 0 keeps the parent id, token-exact
+    assert branch_rid("r", 1) in outs
+    pool = e.allocator
+    assert pool.free_pages == pool.num_pages - 1
+    e.close()
+
+
+def test_quant_counters_ride_heartbeat_metrics():
+    """The kv_quant_* family reaches the stats→heartbeat→/metrics gauge
+    pipeline like every other engine counter."""
+    from agentfield_tpu.control_plane.metrics import Metrics, export_engine_stats
+
+    cfg, params = _tiny()
+    e = InferenceEngine(params, cfg, EngineConfig(kv_quant_dtype="int8", **BASE))
+    _run(e, "r", _prompt(51, 9, cfg), 3)
+    m = Metrics()
+    export_engine_stats(m, "node-q", {k: v for k, v in e.stats.items()})
+    assert m.gauge_value(
+        "engine_kv_quant_pages_total", labels={"node": "node-q"}
+    ) > 0
+    assert m.gauge_value(
+        "engine_kv_quant_wire_bytes_saved_total", labels={"node": "node-q"}
+    ) == 0.0
+    e.close()
+
+
+# ---------------------------------------------------------------------------
+# binary wire framing (the kv_pages payload satellite)
+
+
+def test_kv_blob_header_roundtrip_and_rejection():
+    from agentfield_tpu.control_plane.channel import (
+        _pack_kv_blob,
+        _unpack_kv_blob,
+    )
+
+    payload = b"\x00\x01quantized bytes" * 7
+    blob = _pack_kv_blob("kvf_123_9", 42, payload)
+    assert _unpack_kv_blob(blob) == ("kvf_123_9", 42, payload)
+    assert _unpack_kv_blob(b"not a blob") is None
+    assert _unpack_kv_blob(blob[:6]) is None
+    with pytest.raises(ValueError):
+        _pack_kv_blob("x" * 300, 1, b"")
+
+
+def test_kv_waiter_pairs_blob_and_metadata_any_order():
+    """The requester assembles (metadata, blob) pairs regardless of relay
+    arrival order and resolves only when every seq up to done is whole."""
+    import asyncio
+
+    from agentfield_tpu.control_plane.channel import ChannelServer, _KvWaiter, _pack_kv_blob
+
+    async def run():
+        srv = ChannelServer(invoke=None)
+        fut = asyncio.get_running_loop().create_future()
+        srv._kv_waiters["f1"] = _KvWaiter(fut)
+        meta1 = {"chain": "aa", "depth": 0, "parts": [], "segs": [4, 3]}
+        # metadata FIRST (blob delayed by relay task racing)
+        srv._on_kv_pages(
+            {"kind": "kv_pages", "fetch_id": "f1", "seq": 1,
+             "pages": [meta1], "blob_len": 7, "done": False}
+        )
+        assert not fut.done()
+        srv._on_kv_blob(_pack_kv_blob("f1", 1, b"AAAABBB"))
+        assert not fut.done()  # done frame not seen yet
+        # blob BEFORE metadata for seq 2 (the done frame)
+        srv._on_kv_blob(_pack_kv_blob("f1", 2, b"CC"))
+        srv._on_kv_pages(
+            {"kind": "kv_pages", "fetch_id": "f1", "seq": 2,
+             "pages": [{"chain": "bb", "depth": 1, "parts": [], "segs": [2]}],
+             "blob_len": 2, "done": True}
+        )
+        pages = await fut
+        assert [p["chain"] for p in pages] == ["aa", "bb"]
+        assert pages[0]["data"] == b"AAAABBB"
+        assert pages[1]["data"] == b"CC"
+
+        # the new failure mode — metadata delivered, blob lost in the relay:
+        # the waiter must NEVER resolve (the caller's fetch timeout degrades
+        # to a local re-prefill), and a torn blob poisons the fetch to None
+        fut2 = asyncio.get_running_loop().create_future()
+        srv._kv_waiters["f2"] = _KvWaiter(fut2)
+        srv._on_kv_pages(
+            {"kind": "kv_pages", "fetch_id": "f2", "seq": 1,
+             "pages": [meta1], "blob_len": 7, "done": True}
+        )
+        assert not fut2.done()  # blob never arrived: unresolved, not wrong
+        srv._on_kv_blob(_pack_kv_blob("f2", 1, b"short"))  # torn: 5 != 7
+        assert fut2.done() and fut2.result() is None
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())

@@ -345,23 +345,36 @@ def test_mixed_defers_same_leading_page(params):
     assert mix_eng.stats["mixed_ticks"] > 0
 
 
-def test_mixed_with_pallas_kv_write_config(params):
-    """kv_write_impl='pallas' (the TPU decode-write kernel, one write per
-    page per call) must not corrupt mixed prefill chunks, which write
-    MULTIPLE slots of one page per call: the mixed forward pins its scatter
-    to the exact XLA path regardless of the knob. Token parity vs the all-ref
-    classic scheduler is the proof — a clobbered chunk would corrupt the KV
-    the very next attention reads."""
-    kv_ecfg = dataclasses.replace(ECFG, kv_write_impl="pallas")
+def test_mixed_with_quantized_kv_pages(params):
+    """Mixed token-budget ticks over a QUANTIZED page pool
+    (kv_quant_dtype='int8'): chunk rows write multiple slots of one page
+    per launch — each slot must quantize independently (per-slot scales)
+    or the very next attention reads a corrupted page. Token parity vs the
+    quantized CLASSIC scheduler is the proof (quantization may drift from
+    the bf16 oracle, but the two schedulers must agree bit-for-bit)."""
     script = [
         (0, _req("d", _prompt(90, 5), max_new=12)),
         (3, _req("p", _prompt(91, 30), max_new=5)),
     ]
-    _, seq = _drive(SEQ_ECFG, params, script)
-    eng, mix = _drive(kv_ecfg, params, script)
+    _, seq = _drive(
+        dataclasses.replace(SEQ_ECFG, kv_quant_dtype="int8"), params, script
+    )
+    eng, mix = _drive(
+        dataclasses.replace(ECFG, kv_quant_dtype="int8"), params, script
+    )
     assert eng.stats["mixed_ticks"] > 0
+    assert eng.stats["kv_quant_pages_total"] > 0
     for rid in seq:
-        assert mix[rid] == seq[rid], f"{rid} diverged under kv_write_impl=pallas"
+        assert mix[rid] == seq[rid], f"{rid} diverged under kv_quant_dtype=int8"
+
+
+def test_kv_write_impl_knob_removed(params):
+    """The deprecated kv_write_impl alias is gone: any value raises a
+    ValueError that points at the replacement (attn_impl='pallas')."""
+    with pytest.raises(ValueError, match="attn_impl='pallas'"):
+        InferenceEngine(
+            params, CFG, dataclasses.replace(ECFG, kv_write_impl="pallas")
+        )
 
 
 def test_mixed_tensor_parallel_matches_single_device(params):

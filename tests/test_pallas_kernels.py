@@ -1,6 +1,8 @@
 """Ragged paged-attention kernel vs the XLA reference (interpret mode on
-CPU; the same kernel compiles to Mosaic on TPU), plus the dense flash
-prefill kernel and the engine-level greedy parity gates.
+CPU; the same kernel compiles to Mosaic on TPU), plus the ragged-backed
+dense prefill path (the standalone flash kernel is deleted) and the
+engine-level greedy parity gates. The QUANTIZED (int8/fp8) page-pool
+battery lives in tests/test_kv_quant.py.
 
 The descriptor battery builds allocator-valid launches (live rows own
 DISJOINT pages; page 0 reserved garbage; non-contiguous permuted page
@@ -18,7 +20,7 @@ from agentfield_tpu.models.llama import attention_ref
 from agentfield_tpu.ops.paged_attention import (
     ragged_paged_attention_ref,
 )
-from agentfield_tpu.ops.pallas import flash_attention
+from agentfield_tpu.ops.pallas import dense_causal_attention
 from agentfield_tpu.ops.pallas.ragged_paged_attention_kernel import (
     ragged_paged_attention_pallas,
 )
@@ -225,6 +227,8 @@ def test_legacy_shim_names_removed():
         "paged_batch_chunk_attention_ref",
         "kv_write",
         "kv_write_pallas",
+        "flash_attention",  # the dense prefill kernel is deleted too:
+        # prefill_impl="flash" rides dense_causal_attention (ragged kernel)
     ):
         assert not hasattr(ops_pallas, name), name
         assert name not in ops_pallas.__all__, name
@@ -233,9 +237,10 @@ def test_legacy_shim_names_removed():
         "ragged_paged_attention",
         "ragged_paged_attention_pallas",
         "ragged_paged_attention_ref",
+        "dense_causal_attention",
+        "QuantPages",
         "RaggedRows",
         "lookup_blocks",
-        "flash_attention",
     ):
         assert hasattr(ops_pallas, name), name
 
@@ -287,20 +292,16 @@ def test_engine_with_pallas_impls_matches_oracle():
         assert results[f"r{i}"] == _oracle(params, cfg, p, 4)
 
 
-def test_engine_kv_write_alias_selects_fused_kernel():
-    """kv_write_impl='pallas' (deprecated alias) still means "run the kernel
-    path": decode dispatches the fused ragged kernel and stays token-exact."""
-    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+def test_engine_kv_write_alias_removed():
+    """The kv_write_impl alias completed its deprecation: any value raises
+    a ValueError naming the replacement (attn_impl='pallas')."""
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine
 
     cfg, params = _tiny()
     ecfg = EngineConfig(max_batch=2, page_size=8, num_pages=32, max_pages_per_seq=4,
                         kv_write_impl="pallas", decode_span=3)
-    eng = InferenceEngine(params, cfg, ecfg)
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (7,), 0, cfg.vocab_size, jnp.int32).tolist()
-    out = eng.run_to_completion(
-        [Request(id="r", prompt=prompt, sampling=SamplingParams(max_new_tokens=6))]
-    )["r"]
-    assert out == _oracle(params, cfg, prompt, 6)
+    with pytest.raises(ValueError, match="attn_impl='pallas'"):
+        InferenceEngine(params, cfg, ecfg)
 
 
 def test_session_second_turn_pallas_chunk_path_matches_oracle():
@@ -413,23 +414,31 @@ def test_mixed_tick_on_ragged_kernel_matches_ref_engine():
 
 def test_kernel_microbench_fast_parity_gate():
     """The FlashInfer-Bench-style microbench's fast CPU subset: every
-    canonical shape mix must hold kernel↔ref parity (attention within
-    tolerance, pool writes bit-exact)."""
-    from tools.perf.kernel_gate import run_microbench
+    canonical shape mix — the bf16 ones AND the quantized int8/fp8 mixes —
+    must hold kernel↔ref parity (attention within the per-dtype bound,
+    pool writes + scales bit-exact)."""
+    from tools.perf.kernel_gate import PARITY_TOL, run_microbench
 
     block = run_microbench(fast=True, iters=2, parity=True)
+    dtypes_seen = set()
     for name, entry in block["shapes"].items():
-        assert entry["parity_max_abs_err"] < 2e-3, (name, entry)
+        dtypes_seen.add(entry["kv_dtype"])
+        assert entry["parity_max_abs_err"] < PARITY_TOL[entry["kv_dtype"]], (
+            name, entry,
+        )
         assert entry["parity_pool_exact"], name
         assert entry["p50_ms"] > 0 and entry["p99_ms"] >= entry["p50_ms"]
+    # the quantized mixes are first-class gate citizens, not an optional run
+    assert dtypes_seen == {"none", "int8", "fp8"}
 
 
 # ---------------------------------------------------------------------------
-# dense flash prefill kernel (unchanged by the ragged unification)
+# dense prefill THROUGH the ragged kernel (the standalone flash kernel is
+# deleted): causal layouts the serving engine's prefill_impl="flash" issues
 
 
-@pytest.mark.parametrize("S,hd,H,Kh", [(128, 64, 4, 2), (256, 64, 4, 4)])
-def test_flash_attention_matches_ref(S, hd, H, Kh):
+@pytest.mark.parametrize("S,hd,H,Kh", [(128, 64, 4, 2), (100, 64, 4, 4)])
+def test_dense_causal_attention_matches_ref(S, hd, H, Kh):
     B = 2
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = _rand(ks[0], (B, S, H, hd))
@@ -437,40 +446,12 @@ def test_flash_attention_matches_ref(S, hd, H, Kh):
     v = _rand(ks[2], (B, S, Kh, hd))
     pos = jnp.arange(S, dtype=jnp.int32)[None].repeat(B, 0)
     ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
-
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3),
-        k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3),
-        causal=True,
-        block_q=128,
-        block_k=128,
-        interpret=True,
-    ).transpose(0, 2, 1, 3)
+    out = dense_causal_attention(q, k, v, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
-def test_flash_attention_non_causal():
-    B, S, H, Kh, hd = 1, 128, 2, 2, 64
-    ks = jax.random.split(jax.random.PRNGKey(1), 3)
-    q = _rand(ks[0], (B, S, H, hd))
-    k = _rand(ks[1], (B, S, Kh, hd))
-    v = _rand(ks[2], (B, S, Kh, hd))
-    pos = jnp.arange(S, dtype=jnp.int32)[None]
-    # non-causal == every key visible to every query
-    ref = attention_ref(q, k, v, jnp.full_like(pos, S), pos, jnp.ones_like(pos, bool))
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3),
-        k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3),
-        causal=False,
-        interpret=True,
-    ).transpose(0, 2, 1, 3)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
-
-
-def test_flash_attention_non_pow2_multiple_of_16():
-    """192 = 3×64: bucket lengths capped by a non-pow2 max_context still work."""
+def test_dense_causal_attention_non_pow2_multiple_of_16():
+    """192 = 3x64: bucket lengths capped by a non-pow2 max_context still work."""
     B, S, H, Kh, hd = 1, 192, 2, 2, 64
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q = _rand(ks[0], (B, S, H, hd))
@@ -478,25 +459,13 @@ def test_flash_attention_non_pow2_multiple_of_16():
     v = _rand(ks[2], (B, S, Kh, hd))
     pos = jnp.arange(S, dtype=jnp.int32)[None]
     ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3),
-        k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3),
-        causal=True,
-        interpret=True,
-    ).transpose(0, 2, 1, 3)
+    out = dense_causal_attention(q, k, v, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
-def test_flash_attention_rejects_ragged():
-    q = jnp.zeros((1, 2, 100, 64))
-    with pytest.raises(ValueError, match="multiple of 16"):
-        flash_attention(q, q[:, :2], q[:, :2], block_q=64, block_k=64, interpret=True)
-
-
-def test_flash_attention_windowed_matches_ref():
-    """Sliding-window flash: in-kernel window mask + block skipping must
-    reproduce attention_ref's windowed output (HF Mistral semantics)."""
+def test_dense_causal_attention_windowed_matches_ref():
+    """Sliding window through the ragged packing (HF Mistral semantics),
+    plus window-wider-than-sequence == plain causal."""
     B, S, H, Kh, hd, window = 2, 128, 4, 2, 64, 20
     ks = jax.random.split(jax.random.PRNGKey(9), 3)
     q = _rand(ks[0], (B, S, H, hd))
@@ -504,15 +473,8 @@ def test_flash_attention_windowed_matches_ref():
     v = _rand(ks[2], (B, S, Kh, hd))
     pos = jnp.arange(S, dtype=jnp.int32)[None].repeat(B, 0)
     ref = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool), window=window)
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-        causal=True, block_q=32, block_k=32, interpret=True, window=window,
-    ).transpose(0, 2, 1, 3)
+    out = dense_causal_attention(q, k, v, window=window, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
-    # window wider than the sequence == plain causal
-    wide = flash_attention(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-        causal=True, block_q=32, block_k=32, interpret=True, window=4 * S,
-    ).transpose(0, 2, 1, 3)
+    wide = dense_causal_attention(q, k, v, window=4 * S, interpret=True)
     plain = attention_ref(q, k, v, pos, pos, jnp.ones_like(pos, bool))
     np.testing.assert_allclose(np.asarray(wide), np.asarray(plain), rtol=2e-3, atol=2e-3)

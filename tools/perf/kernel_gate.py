@@ -68,6 +68,28 @@ SHAPES: dict[str, dict] = {
     ),
 }
 
+# QUANTIZED mixes (EngineConfig.kv_quant_dtype; docs/KERNELS.md "Quantized
+# pages"): the decode and mixed shapes again over int8/fp8 pools — the
+# page stream dequantizes in-kernel, the fused write quantizes per slot.
+# Same gate discipline as the bf16 mixes: per-dtype parity bounds
+# (PARITY_TOL) + the >10% normalized-regression gate at matched shapes.
+for _base, _dt in (
+    ("pure_decode", "int8"),
+    ("mixed_ragged", "int8"),
+    ("pure_decode", "fp8"),
+    ("mixed_ragged", "fp8"),
+):
+    SHAPES[f"{_base}_{_dt}"] = {
+        tier: dict(params, kv_dtype=_dt)
+        for tier, params in SHAPES[_base].items()
+    }
+
+# kernel↔ref attention parity bound per KV dtype (pool writes + scales are
+# bit-exact in every mode; the attention gap comes from the ref reading
+# same-launch keys back quantized while the kernel attends them exactly —
+# see ragged_paged_attention_ref's docstring)
+PARITY_TOL = {"none": 2e-3, "int8": 2e-2, "fp8": 6e-2}
+
 DEFAULT_THRESHOLD = 0.10
 
 
@@ -93,6 +115,7 @@ def build_case(name: str, fast: bool = True, seed: int = 0):
     ps, maxp, kh, rep, hd = (
         p["page_size"], p["maxp"], p["kh"], p["rep"], p["hd"]
     )
+    kv_dtype = p.get("kv_dtype", "none")
     H = kh * rep
     entries = []  # (start, n_tokens) per sequence-entry
     if "rows" in p:
@@ -122,14 +145,22 @@ def build_case(name: str, fast: bool = True, seed: int = 0):
     vn = rng.standard_normal((R, W, kh, hd)).astype(np.float32) * 0.3
     kp = rng.standard_normal((P, kh, ps, hd)).astype(np.float32) * 0.3
     vp = rng.standard_normal((P, kh, ps, hd)).astype(np.float32) * 0.3
-    return tuple(
+    args = [
         jnp.asarray(a)
         for a in (
             q, kn, vn, kp, vp,
             rr.page_tables, rr.row_starts, rr.n_tokens, rr.ctx_lens,
             rr.seq_ids,
         )
-    )
+    ]
+    if kv_dtype != "none":
+        from agentfield_tpu.ops.kv_quant import kv_quantize
+
+        kq, ks = kv_quantize(args[3], kv_dtype)
+        vq, vs = kv_quantize(args[4], kv_dtype)
+        args[3], args[4] = kq, vq
+        args += [ks, vs]  # ref/kernel take (k_scales, v_scales) after seq_ids
+    return tuple(args)
 
 
 def calibrate() -> float:
@@ -206,12 +237,12 @@ def run_microbench(
     out: dict = {"shapes": {}, "calib_ms": round(calibrate(), 3)}
     for name in SHAPES:
         args = build_case(name, fast=fast)
-        o, _, _ = ref(*args)  # compile
+        o = ref(*args)[0]  # compile
         jax.block_until_ready(o)
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            o, kpo, vpo = ref(*args)
+            o = ref(*args)[0]
             jax.block_until_ready(o)
             times.append((time.perf_counter() - t0) * 1e3)
         entry = {
@@ -223,29 +254,40 @@ def run_microbench(
             "tokens": int(np.asarray(args[7]).sum()),
             "rows": int(args[0].shape[0]),
         }
+        entry["kv_dtype"] = SHAPES[name]["fast"].get("kv_dtype", "none")
         if parity:
             pargs = build_case(name, fast=True)
-            po, pk, pv = ragged_paged_attention_pallas(*pargs, interpret=True)
-            ro, rk, rv = ref(*pargs)
-            live = np.ones(rk.shape[0], bool)
+            pres = ragged_paged_attention_pallas(*pargs, interpret=True)
+            rres = ref(*pargs)
+            live = np.ones(np.asarray(pres[1]).shape[0], bool)
             live[0] = False  # garbage page content is unspecified
             entry["parity_max_abs_err"] = float(
-                np.max(np.abs(np.asarray(po) - np.asarray(ro)))
+                np.max(
+                    np.abs(
+                        np.asarray(pres[0], np.float32)
+                        - np.asarray(rres[0], np.float32)
+                    )
+                )
             )
-            entry["parity_pool_exact"] = bool(
-                np.array_equal(np.asarray(pk)[live], np.asarray(rk)[live])
-                and np.array_equal(np.asarray(pv)[live], np.asarray(rv)[live])
+            # pool writes — and, for quantized mixes, the per-slot scales —
+            # must be BIT-exact on every live page in every mode
+            entry["parity_pool_exact"] = all(
+                np.array_equal(
+                    np.asarray(pres[i])[live].astype(np.float32),
+                    np.asarray(rres[i])[live].astype(np.float32),
+                )
+                for i in range(1, len(pres))
             )
         if kernel_timings:
             kt = []
             kernel = jax.jit(
                 lambda *a: ragged_paged_attention_pallas(*a, interpret=False)
             )
-            o, _, _ = kernel(*args)
+            o = kernel(*args)[0]
             jax.block_until_ready(o)
             for _ in range(iters):
                 t0 = time.perf_counter()
-                o, _, _ = kernel(*args)
+                o = kernel(*args)[0]
                 jax.block_until_ready(o)
                 kt.append((time.perf_counter() - t0) * 1e3)
             entry["kernel_p50_ms"] = round(percentile(kt, 50), 3)

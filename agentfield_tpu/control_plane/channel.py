@@ -18,7 +18,10 @@ resume exactly where the last connection died):
 kind                dir     meaning
 ==================  ======  =====================================================
 ``submit``          gw→nd   start an execution: target component, input, headers,
-                            stream flag
+                            stream flag, optional ``trace`` (TraceContext —
+                            request-scoped tracing, docs/OBSERVABILITY.md;
+                            the terminal frame then carries the node's
+                            collected spans under ``trace``)
 ``accepted``        nd→gw   submit received; the node owns the execution now
                             (the channel's 202-equivalent)
 ``token``           nd→gw   one streamed token event (``seq``, ``data``)
@@ -356,7 +359,10 @@ class ExecutionStreams:
 
 
 class _ServerExec:
-    __slots__ = ("exec_id", "seq", "frames", "done", "done_at", "task", "conn", "lock")
+    __slots__ = (
+        "exec_id", "seq", "frames", "done", "done_at", "task", "conn",
+        "lock", "trace",
+    )
 
     def __init__(self, exec_id: str):
         self.exec_id = exec_id
@@ -369,6 +375,9 @@ class _ServerExec:
         # Serializes emission vs reattach-replay so a frame emitted during a
         # replay cannot reach the new connection before older frames do.
         self.lock = asyncio.Lock()
+        # TraceContext from the submit frame (docs/OBSERVABILITY.md): the
+        # terminal frame carries the node's collected spans for it.
+        self.trace: dict | None = None
 
 
 class _ServerConn:
@@ -449,6 +458,12 @@ class ChannelServer:
         # frames; requesting side — fetch_kv() sends a kv_fetch up the live
         # gateway connection and collects the relayed kv_pages response.
         self._kv_export: Callable[[list[str], int], Awaitable[list]] | None = None
+        # Tracing hook (docs/OBSERVABILITY.md): sync fn(trace_ctx) ->
+        # list[span dict], called when an execution's terminal frame is
+        # built so node-side spans ride it back to the gateway — for
+        # SUCCESS, FAILURE, and CANCEL terminals alike (a node that failed
+        # an execution still ships its evidence).
+        self._trace_collect: Callable[[dict], list] | None = None
         self._kv_waiters: dict[str, _KvWaiter] = {}
         self._kv_next_id = 0
         self._kv_tasks: set[asyncio.Task] = set()
@@ -466,6 +481,12 @@ class ChannelServer:
         node registers ``generate``); everything else goes through
         ``invoke`` and produces only a terminal frame."""
         self.stream_handlers[component_id] = fn
+
+    def set_trace_collect(self, fn) -> None:
+        """Register the span collector for traced executions (the model
+        node wires ``ModelBackend.collect_trace_spans``). Without one,
+        terminal frames never carry a ``trace`` key."""
+        self._trace_collect = fn
 
     def set_kv_export(self, fn) -> None:
         """Register the KV page exporter: ``async fn(chains_hex, max_bytes)
@@ -768,6 +789,9 @@ class ChannelServer:
             return
         st = _ServerExec(eid)
         st.conn = conn
+        tr = frame.get("trace")
+        if isinstance(tr, dict) and isinstance(tr.get("trace_id"), str):
+            st.trace = tr
         self._execs[eid] = st
         await conn.send({"kind": "accepted", "exec_id": eid})
         st.task = asyncio.create_task(self._run(st, frame))
@@ -845,6 +869,20 @@ class ChannelServer:
                 "status": "failed",
                 "error": repr(e),
             }
+        if st.trace is not None and self._trace_collect is not None:
+            # Ship the node's spans on the terminal frame — the gateway's
+            # TraceStore is the assembly point. Tracing off → no submit
+            # ctx → st.trace is None → the frame is bit-identical to
+            # today's (pinned).
+            try:
+                spans = self._trace_collect(st.trace)
+            except Exception as e:
+                log.debug("trace collection failed", error=repr(e))
+                spans = None
+            if spans:
+                term["trace"] = {
+                    "trace_id": st.trace.get("trace_id"), "spans": spans
+                }
         st.done = True
         st.done_at = time.monotonic()
         await self._emit(st, term)
@@ -1299,6 +1337,7 @@ class ChannelManager:
     async def submit(
         self, node, execution_id: str, target_component: str,
         agent_input: Any, headers: dict[str, str], stream: bool = False,
+        trace: dict | None = None,
     ) -> tuple[str, Any]:
         chan = await self._chan_for(node)
         frame = {
@@ -1312,6 +1351,12 @@ class ChannelManager:
             # nothing per token.
             "stream": stream,
         }
+        if trace is not None:
+            # Request-scoped tracing (docs/OBSERVABILITY.md): the node's
+            # channel server collects this trace's spans onto the terminal
+            # frame. Key absent entirely when tracing is off — the submit
+            # frame stays bit-identical (pinned).
+            frame["trace"] = trace
         try:
             return await chan.submit(execution_id, frame)
         except ChannelUnavailable:

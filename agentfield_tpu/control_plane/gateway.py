@@ -28,6 +28,7 @@ from typing import Any
 
 import aiohttp
 
+from agentfield_tpu import tracing
 from agentfield_tpu.branching import validate_branch_spec
 from agentfield_tpu.prefix_hash import page_chain_hashes, sketch_digest
 
@@ -248,6 +249,15 @@ class ExecutionGateway:
         # Strong refs for stream-execute driver tasks (loop tasks are weakly
         # held; a GC'd driver would strand a prepared execution).
         self._stream_drivers: set[asyncio.Task] = set()
+        # Request-scoped tracing (docs/OBSERVABILITY.md): the gateway mints
+        # one trace id per execution (_prepare), records its own spans
+        # (root, queue wait, per-attempt dispatch, channel submit) straight
+        # into the store, and harvests node-side spans off terminal frames
+        # / results. Served at GET /api/v1/executions/{id}/trace.
+        self.traces = tracing.TraceStore()
+        # execution_id -> (trace_id, t0_wall, t0_mono): the open root span,
+        # closed by the terminal transition in complete().
+        self._trace_roots: dict[str, tuple[str, float, float]] = {}
 
     @property
     def queue_depth(self) -> int:
@@ -367,6 +377,12 @@ class ExecutionGateway:
         if self.payloads is not None:
             payload = await asyncio.to_thread(self.payloads.offload, payload)
         caller_supplied_id = bool(headers.get("X-Execution-Id"))
+        # One trace per execution (docs/OBSERVABILITY.md): the id is minted
+        # here, persisted on the row (operators find the trace FROM the
+        # execution), and threaded through dispatch as a TraceContext.
+        # Tracing off mints nothing — every downstream layer keys on ctx
+        # presence, so the off mode is bit-compatible with today's wire.
+        trace_id = tracing.new_trace_id() if tracing.enabled() else None
         ex = Execution(
             execution_id=headers.get("X-Execution-Id") or new_id("exec"),
             target=target,
@@ -384,6 +400,7 @@ class ExecutionGateway:
             deadline_s=float(deadline_s) if deadline_s is not None else None,
             n_branches=n_branches,
             branch_policy=branch_policy,
+            trace_id=trace_id,
         )
         try:
             # Freshly-minted ids skip the journal's duplicate table probe
@@ -396,6 +413,13 @@ class ExecutionGateway:
                 ) from None
             raise
         self.metrics.inc("gateway_executions_total")
+        if trace_id is not None:
+            # The open root span: closed by the terminal transition in
+            # complete(). Registered only once the row exists (a 409'd
+            # duplicate must not leak an open root).
+            self._trace_roots[ex.execution_id] = (
+                trace_id, time.time(), time.perf_counter()
+            )
         return ex, node
 
     def _agent_url(self, node: AgentNode, ex: Execution) -> str:
@@ -430,7 +454,19 @@ class ExecutionGateway:
         }
         if ex.parent_execution_id:
             headers["X-Parent-Execution-ID"] = ex.parent_execution_id
-        agent_input = await self._agent_input(node, ex)
+        # Per-attempt TraceContext (docs/OBSERVABILITY.md): attempt number
+        # and target node ride INTO the node so its spans come back
+        # attempt-labeled — a failover waterfall must say which node served
+        # which attempt.
+        trace_ctx = None
+        if ex.trace_id is not None:
+            trace_ctx = {
+                "trace_id": ex.trace_id,
+                "attempt": ex.attempts,
+                "node": node.node_id,
+            }
+            headers["X-Trace-ID"] = ex.trace_id
+        agent_input = await self._agent_input(node, ex, trace=trace_ctx)
         f = faults.fire("gateway.agent_call.delay")
         if f is not None and f.delay_s > 0:
             await asyncio.sleep(f.delay_s)
@@ -447,11 +483,19 @@ class ExecutionGateway:
             # (and starts a cooldown), so a broken channel endpoint degrades
             # to pre-channel behavior instead of failing dispatch.
             try:
-                return await self.channels.submit(
+                t0w, t0m = time.time(), time.perf_counter()
+                out = await self.channels.submit(
                     node, ex.execution_id, ex.target.split(".", 1)[1],
                     agent_input, headers,
                     stream=self.streams.wants(ex.execution_id),
+                    trace=trace_ctx,
                 )
+                self.traces.record_span(
+                    "channel.submit", ex.trace_id, t0w,
+                    (time.perf_counter() - t0m) * 1e3,
+                    {"node": node.node_id, "attempt": ex.attempts},
+                )
+                return out
             except ChannelUnavailable as e:
                 self.metrics.inc("channel_fallbacks_total")
                 log.warning(
@@ -470,7 +514,14 @@ class ExecutionGateway:
                     body = await resp.json()
                     if not isinstance(body, dict):
                         raise ValueError(f"agent 200 body must be an object, got {type(body).__name__}")
-                    return "completed", body.get("result")
+                    result = body.get("result")
+                    if isinstance(result, dict) and "trace" in result:
+                        # Node-side spans ride the result on the POST path
+                        # (the channel path ships them on the terminal
+                        # frame); popped BEFORE the result is persisted or
+                        # returned to the caller.
+                        self._harvest_trace(result.pop("trace"))
+                    return "completed", result
                 if resp.status == 202:
                     return "deferred", None  # agent will POST the status callback
                 text = (await resp.text())[:500]
@@ -485,7 +536,7 @@ class ExecutionGateway:
         finally:
             self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)
 
-    async def _agent_input(self, node: AgentNode, ex: Execution):
+    async def _agent_input(self, node: AgentNode, ex: Execution, trace: dict | None = None):
         """The payload a node actually receives: offloaded payloads resolve
         to real bytes off the event loop, and overload control rides THROUGH
         dispatch to the engine — the execute body's priority/deadline_s
@@ -520,6 +571,8 @@ class ExecutionGateway:
                 or ex.deadline_s is not None
                 or hint is not None
                 or branched
+                or trace is not None
+                or "trace" in agent_input
             ):
                 agent_input = dict(agent_input)
                 if ex.priority:
@@ -529,6 +582,17 @@ class ExecutionGateway:
                     agent_input.setdefault("deadline_s", max(remaining, 0.001))
                 if hint is not None:
                     agent_input.setdefault("kv_peer", hint)
+                # Request-scoped tracing rides THROUGH dispatch like
+                # priority/deadline — but unlike those, the GATEWAY's value
+                # always wins (plain assignment + unconditional strip, NOT
+                # setdefault): a caller-supplied "trace" key would otherwise
+                # inject this request's spans into an arbitrary victim
+                # trace id, and force span recording with tracing off
+                # (docs/OBSERVABILITY.md). Callers wanting the trace id get
+                # it from the execution row, not by picking their own.
+                agent_input.pop("trace", None)
+                if trace is not None:
+                    agent_input["trace"] = trace
                 if branched:
                     # Branch decoding rides THROUGH dispatch like priority/
                     # deadline: the engine forks KV after one prefill and
@@ -540,11 +604,48 @@ class ExecutionGateway:
 
     # -- streaming data plane hooks (channel.py calls back into these) --
 
+    def _close_trace_root(self, ex: Execution) -> None:
+        """Close the execution's open root span: the whole gateway-observed
+        lifetime, labeled with the terminal status. Idempotent via the pop
+        — requeues and late callbacks find nothing open. EVERY path that
+        terminates an execution without complete() (the async queue-full
+        rejection) must call this too, or the open root leaks for the
+        process lifetime."""
+        root = self._trace_roots.pop(ex.execution_id, None)
+        if root is not None:
+            tid, t0w, t0m = root
+            self.traces.record_span(
+                "gateway.execute", tid, t0w,
+                (time.perf_counter() - t0m) * 1e3,
+                {
+                    "status": ex.status.value,
+                    "target": ex.target,
+                    "attempts": ex.attempts,
+                },
+            )
+
+    def _harvest_trace(self, payload) -> None:
+        """Land node-shipped spans in the TraceStore. Best-effort and
+        shape-validated (the store drops malformed spans) — a garbled
+        trace payload must never fail the execution it rode in on."""
+        if isinstance(payload, dict):
+            self.traces.extend(payload.get("trace_id"), payload.get("spans"))
+
     async def _channel_terminal(self, execution_id: str, frame: dict) -> None:
         """Terminal frame from a node channel — the channel's analogue of
         the 202 status callback (handle_status_update)."""
+        if "trace" in frame:
+            # Node spans ride the terminal frame (success AND failure
+            # terminals); harvested before completion so the trace endpoint
+            # is complete the moment the caller sees the terminal.
+            self._harvest_trace(frame.get("trace"))
+        result = frame.get("result")
+        if isinstance(result, dict) and "trace" in result:
+            # Unary-over-channel results (non-text outputs) carry spans in
+            # the result body instead; popped before persistence.
+            self._harvest_trace(result.pop("trace"))
         if frame.get("status") == "completed":
-            await self.complete(execution_id, result=frame.get("result"))
+            await self.complete(execution_id, result=result)
         else:
             await self.complete(
                 execution_id, error=frame.get("error") or "agent reported failure"
@@ -831,7 +932,17 @@ class ExecutionGateway:
                 # dispatch order, so its last element is always the node the
                 # work was last handed to — the orphan requeue's "holder".
                 ex.nodes_tried.append(node.node_id)
+                t0w, t0m = time.time(), time.perf_counter()
                 outcome, data = await self._call_agent_once(node, ex)
+                self.traces.record_span(
+                    "gateway.dispatch", ex.trace_id, t0w,
+                    (time.perf_counter() - t0m) * 1e3,
+                    {
+                        "node": node.node_id,
+                        "attempt": ex.attempts,
+                        "outcome": outcome,
+                    },
+                )
                 if outcome == "completed":
                     return await self.complete(
                         ex.execution_id,
@@ -1064,6 +1175,9 @@ class ExecutionGateway:
             ex.error = "async queue at capacity"
             ex.finished_at = now()
             await self.db.update_execution(ex)
+            # This terminal bypasses complete(): close the root here or it
+            # (and its _trace_roots entry) leaks per rejected request.
+            self._close_trace_root(ex)
             self.metrics.inc("gateway_backpressure_total")
             ra = self.overload_retry_after()
             if ra is not None:
@@ -1123,6 +1237,11 @@ class ExecutionGateway:
                     # worker and a node slot on an answer nobody can use.
                     await self._shed_expired(ex)
                     continue
+                self.traces.record_span(
+                    "gateway.queue_wait", ex.trace_id, ex.created_at,
+                    max(now() - ex.created_at, 0.0) * 1e3,
+                    {"worker": idx},
+                )
                 ex.status = ExecutionStatus.RUNNING
                 await self.db.update_execution(ex)
                 self._publish(ex)
@@ -1178,6 +1297,7 @@ class ExecutionGateway:
             # out only after that commit (docs/OPERATIONS.md).
             await barrier
         if ex is not None and ex.status.terminal:
+            self._close_trace_root(ex)
             # Exactly-one terminal frame to every stream subscriber
             # (idempotent — a no-op when nothing ever streamed/subscribed)...
             self.streams.finish(ex)
@@ -1202,6 +1322,12 @@ class ExecutionGateway:
         """Returns (execution, durability_barrier). The barrier is None on
         the eager-commit path; with the group-commit journal it is an
         awaitable the caller must await AFTER releasing _complete_lock."""
+        if isinstance(result, dict) and "trace" in result:
+            # Node spans may arrive embedded in ANY completion path's result
+            # (direct 200, 202 status callback, channel unary, late result):
+            # harvest + pop here, the one choke point, so the persisted and
+            # served result never exposes the span payload.
+            self._harvest_trace(result.pop("trace"))
         ex = await self.db.get_execution(execution_id)
         if ex is None:
             return None, None
@@ -1410,6 +1536,13 @@ class ExecutionGateway:
         # incarnation — and the late-result guard must be open for the new one
         ex.frames_delivered = 0  # operator accepted the duplication risk by
         # requeueing; the new incarnation streams from frame 0
+        # Fresh trace too: the old root closed at the dead-letter terminal
+        # (and its spans have usually aged out of the TTL-bounded store by
+        # triage time) — appending the rerun's attempt-1 spans onto the old
+        # id would yield a root-less waterfall with colliding attempt
+        # labels. The new id's root is registered after the enqueue
+        # succeeds, mirroring _prepare.
+        ex.trace_id = tracing.new_trace_id() if tracing.enabled() else None
         self.streams.discard(ex.execution_id)
         if ex.deadline_s is not None:
             # Fresh deadline window too: deadline_s counts from created_at,
@@ -1434,6 +1567,10 @@ class ExecutionGateway:
             ex.finished_at = now()
             await self.db.update_execution(ex)
             raise GatewayError(503, "async execution queue is full") from None
+        if ex.trace_id is not None:
+            self._trace_roots[ex.execution_id] = (
+                ex.trace_id, time.time(), time.perf_counter()
+            )
         self._publish(ex)
         self.metrics.inc("gateway_dead_letter_requeued_total")
         self.metrics.set_gauge("gateway_queue_depth", self._queue.qsize())

@@ -342,13 +342,24 @@ class NodeRegistry:
                     if isinstance(v, (int, float)) and not isinstance(v, bool):
                         load += v
                 self.cache.put_sketch(node_id, sketch, load)
+            # Engine latency histograms (docs/OBSERVABILITY.md): popped off
+            # the stats like the sketch (a multi-bucket block must not ride
+            # every node-table row) and re-published as REAL per-node
+            # Prometheus histogram series — TTFT/ITL/queue-wait/tick
+            # distributions, fleet-wide, from one control-plane scrape.
+            latency_hist = stats.pop("latency_hist", None)
             node.metadata["stats"] = stats
             # Re-export the node's engine counters (prefix-cache hit/miss/
             # eviction/shared-page among them) as per-node /metrics gauges so
             # one Prometheus scrape of the control plane covers the fleet.
-            from agentfield_tpu.control_plane.metrics import export_engine_stats
+            from agentfield_tpu.control_plane.metrics import (
+                export_engine_histograms,
+                export_engine_stats,
+            )
 
             export_engine_stats(self.metrics, node_id, stats)
+            if isinstance(latency_hist, dict):
+                export_engine_histograms(self.metrics, node_id, latency_hist)
         old_status = node.status
         if requested is not None:
             try:

@@ -560,6 +560,11 @@ def create_app(cp: ControlPlane) -> web.Application:
             "run_id": ex.run_id,
             "target": ex.target,
         }
+        if ex.trace_id is not None:
+            # Streaming callers learn the trace id up front (the terminal
+            # frame may be minutes away); key absent when tracing is off —
+            # the start frame stays bit-identical (pinned).
+            start["trace_id"] = ex.trace_id
         return await _sse_frames(req, sub, first_frame=start)
 
     @routes.post("/api/v1/execute/{target}")
@@ -618,10 +623,14 @@ def create_app(cp: ControlPlane) -> web.Application:
             )
         except GatewayError as e:
             return _json_error(e.status, e.message, retry_after=e.retry_after)
-        return web.json_response(
-            {"execution_id": ex.execution_id, "run_id": ex.run_id, "status": ex.status.value},
-            status=202,
-        )
+        doc = {
+            "execution_id": ex.execution_id,
+            "run_id": ex.run_id,
+            "status": ex.status.value,
+        }
+        if ex.trace_id is not None:
+            doc["trace_id"] = ex.trace_id
+        return web.json_response(doc, status=202)
 
     @routes.get("/api/v1/executions/{execution_id}")
     async def get_execution(req: web.Request):
@@ -670,6 +679,42 @@ def create_app(cp: ControlPlane) -> web.Application:
         if cur is not None and cur.status.terminal:
             cp.gateway.streams.finish(cur)
         return await _sse_frames(req, sub)
+
+    @routes.get("/api/v1/executions/{execution_id}/trace")
+    async def execution_trace(req: web.Request):
+        """The execution's assembled trace waterfall (docs/OBSERVABILITY.md
+        "Trace anatomy"): every span the gateway recorded or harvested for
+        the execution's trace id — gateway root + queue wait + per-attempt
+        dispatch + channel submit, then the serving node's engine lifecycle
+        spans (queue-wait, prefill, decode, park/resume, kv-restore, fork)
+        — ordered by wall-clock start. 404 when tracing was off for this
+        execution or the trace aged out of the TTL-bounded store."""
+        eid = req.match_info["execution_id"]
+        ex = await cp.db.get_execution(eid)
+        if ex is None:
+            return _json_error(404, "unknown execution")
+        if not ex.trace_id:
+            return _json_error(
+                404,
+                "no trace recorded for this execution (tracing off — "
+                "AGENTFIELD_TRACE=0 — or the row predates the trace subsystem)",
+            )
+        spans = cp.gateway.traces.get(ex.trace_id)
+        if not spans:
+            return _json_error(
+                404,
+                f"trace {ex.trace_id!r} is no longer retained "
+                "(in-memory TraceStore TTL; see docs/OBSERVABILITY.md)",
+            )
+        return web.json_response(
+            {
+                "execution_id": eid,
+                "trace_id": ex.trace_id,
+                "status": ex.status.value,
+                "target": ex.target,
+                "spans": spans,
+            }
+        )
 
     @routes.post("/api/v1/executions/{execution_id}/status")
     async def status_callback(req: web.Request):

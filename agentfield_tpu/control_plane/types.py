@@ -174,6 +174,13 @@ class Execution:
     # gateway dead-letters instead, and operators triaging the dead letter
     # see exactly how much of the stream the caller got.
     frames_delivered: int = 0
+    # Request-scoped tracing (docs/OBSERVABILITY.md): the trace id the
+    # gateway minted for this execution, persisted so operators can go from
+    # any execution row to GET /api/v1/executions/{id}/trace. None when
+    # tracing is off (AGENTFIELD_TRACE=0) or for rows predating the trace
+    # subsystem. The spans themselves live in the gateway's in-memory
+    # TraceStore (TTL-bounded), not the database.
+    trace_id: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         # Hand-rolled: dataclasses.asdict() deep-copies every nested value
@@ -210,6 +217,7 @@ class Execution:
             if isinstance(self.branch_policy, dict)
             else self.branch_policy,
             "frames_delivered": self.frames_delivered,
+            "trace_id": self.trace_id,
         }
 
     @staticmethod

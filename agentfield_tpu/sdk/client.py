@@ -241,6 +241,18 @@ class ControlPlaneClient:
                 if frame.get("kind") in ("terminal", "dropped"):
                     return
 
+    async def get_trace(self, execution_id: str) -> dict[str, Any]:
+        """The execution's assembled trace waterfall (GET
+        /api/v1/executions/{id}/trace, docs/OBSERVABILITY.md): one ordered
+        list of spans covering gateway dispatch (every retry/failover
+        attempt, attempt-labeled), the channel submit, and the serving
+        node's engine lifecycle. Raises ControlPlaneError 404 when tracing
+        was off for the execution or the trace aged out of the gateway's
+        TTL-bounded store — trace early, the spans are in memory only.
+        Never cached: the waterfall can still be accumulating spans when
+        the execution row is already terminal."""
+        return await self._req("GET", f"/api/v1/executions/{execution_id}/trace")
+
     async def get_execution(self, execution_id: str) -> dict[str, Any]:
         import copy
 

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentfield_tpu import tracing
 from agentfield_tpu.branching import branch_rid
 from agentfield_tpu.models.configs import LlamaConfig
 from agentfield_tpu.models import llama
@@ -309,6 +310,15 @@ class Request:
     # / request_fork). Exclusive with grammar/mm_embeds; sibling clones
     # drop session_id (N branches must not fight over one session entry).
     n_branches: int = 1
+    # Request-scoped tracing (docs/OBSERVABILITY.md): the TraceContext dict
+    # minted by the gateway ({"trace_id", "attempt", "node"}), threaded
+    # through the generate input. When present, the engine records lifecycle
+    # spans (queue-wait, prefill, decode, park/resume, kv-restore, fork)
+    # against the trace id in the process tracer buffer; the node ships them
+    # back on the terminal frame. None (the default, and anything that fails
+    # tracing.valid_context) records nothing — the untraced hot path costs
+    # one dict miss per event.
+    trace: Any = None
 
 
 @dataclasses.dataclass
@@ -1445,6 +1455,28 @@ class InferenceEngine:
         self._telemetry_lock = threading.Lock()
         self._itl_window: collections.deque[float] = collections.deque(maxlen=4096)  # guarded by: _telemetry_lock
         self._tick_tokens: collections.deque[int] = collections.deque(maxlen=1024)  # guarded by: _telemetry_lock
+        # Observability (docs/OBSERVABILITY.md). Always-on: fixed-bucket
+        # latency histograms shipped on every heartbeat (real Prometheus
+        # histograms fleet-wide, not just local percentile gauges) and the
+        # flight recorder — a fixed ring of per-tick scheduler records,
+        # served by the node debug endpoint and dumped on step failure.
+        self.latency = tracing.HistogramSet(
+            ("ttft_ms", "itl_ms", "queue_wait_ms", "tick_ms")
+        )
+        self.flight = tracing.FlightRecorder()
+        self._tick_mode = "decode"  # scheduler-thread state, like the fences
+        self._tick_carried = 0
+        # Request-scoped tracing: per-request mark dicts (enqueue/prefill/
+        # decode monotonic anchors + the trace id), present only for
+        # requests that arrived with a valid TraceContext. Individual
+        # get/set/pop per rid — the same GIL-atomic cross-thread discipline
+        # as _cancels.
+        self._tracer = tracing.tracer()
+        self._traces: dict[str, dict] = {}
+        # Submit-time monotonic stamps for EVERY request (traced or not):
+        # the queue-wait and TTFT histograms read them at queue-exit and
+        # first token. Entries pop at install or cancel.
+        self._submit_t: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # host-side scheduling
@@ -1540,6 +1572,12 @@ class InferenceEngine:
                     raise QueueFullError(
                         f"pending queue at capacity {self.ecfg.max_pending}"
                     )
+                # Stamp BEFORE the enqueue: the scheduler thread may admit
+                # and install the request the instant it lands in the
+                # queue — a post-release stamp would miss the install's
+                # pop (leaking the entry and mistiming the trace).
+                self._submit_t[req.id] = time.monotonic()
+                self._tr_submit(req)
                 self._enqueue_locked(req)
                 if req.deadline_s is not None:
                     self._deadline_at[req.id] = time.monotonic() + req.deadline_s
@@ -1571,6 +1609,122 @@ class InferenceEngine:
     def _pages_needed(self, req: Request) -> int:
         total = len(req.prompt) + req.sampling.max_new_tokens
         return -(-total // self.ecfg.page_size)
+
+    # ------------------------------------------------------------------
+    # request-scoped tracing (docs/OBSERVABILITY.md "Trace anatomy"):
+    # lifecycle spans recorded on the EXISTING event paths — every helper
+    # is a dict miss and early return for untraced requests.
+    # ------------------------------------------------------------------
+
+    def _tr_submit(self, req: Request) -> None:
+        ctx = tracing.valid_context(req.trace)
+        if ctx is None:
+            return
+        self._traces[req.id] = {
+            "tid": ctx["trace_id"],
+            "enq_w": time.time(),
+            "enq_m": time.perf_counter(),
+        }
+
+    def _tr_dequeue(self, req: Request, start: int = 0) -> None:
+        """Queue-exit (classic single, batch, or mixed-job creation): close
+        the queue-wait span — or, for a preempted request re-admitting, the
+        park span — and anchor the prefill span. ``start`` is the cached-
+        prefix length prefill skips (the prefill span's ``cached`` attr)."""
+        e = self._traces.get(req.id)
+        if e is None:
+            return
+        now_m = time.perf_counter()
+        parked = e.pop("parked", None)
+        if parked is not None:
+            self._tracer.record_span(
+                "engine.park", e["tid"], parked[0], (now_m - parked[1]) * 1e3,
+                {"resumed_tokens": req.resumed_from},
+            )
+        else:
+            self._tracer.record_span(
+                "engine.queue_wait", e["tid"], e["enq_w"],
+                (now_m - e["enq_m"]) * 1e3,
+            )
+        e["pf_w"], e["pf_m"] = time.time(), now_m
+        e["start"] = start
+
+    def _tr_first_token(self, req: Request) -> None:
+        """First sampled token: close the prefill span, anchor decode."""
+        e = self._traces.get(req.id)
+        if e is None:
+            return
+        now_m = time.perf_counter()
+        pf_m = e.pop("pf_m", None)
+        pf_w = e.pop("pf_w", None)
+        if pf_m is not None:
+            self._tracer.record_span(
+                "engine.prefill", e["tid"], pf_w, (now_m - pf_m) * 1e3,
+                {"tokens": len(req.prompt), "cached": e.pop("start", 0)},
+            )
+        e["dec_w"], e["dec_m"] = time.time(), now_m
+
+    def _tr_close(self, rid: str, reason: str, generated: int | None = None) -> None:
+        """Terminal (natural finish, cancel, deadline): close the decode
+        span and drop the entry. A request that never decoded (shed from
+        the queue) closes its queue-wait span instead — the waterfall shows
+        it died waiting, which is the point of the trace."""
+        e = self._traces.pop(rid, None)
+        if e is None:
+            return
+        now_m = time.perf_counter()
+        if e.get("dec_m") is not None:
+            attrs = {"finish": reason}
+            if generated is not None:
+                attrs["tokens"] = generated
+            self._tracer.record_span(
+                "engine.decode", e["tid"], e["dec_w"], (now_m - e["dec_m"]) * 1e3,
+                attrs,
+            )
+        elif e.get("pf_m") is None:
+            parked = e.get("parked")
+            t0w, t0m = (
+                (parked[0], parked[1]) if parked else (e["enq_w"], e["enq_m"])
+            )
+            self._tracer.record_span(
+                "engine.queue_wait", e["tid"], t0w, (now_m - t0m) * 1e3,
+                {"finish": reason},
+            )
+
+    def _tr_preempt(self, slot: _Slot) -> None:
+        """Preemption: close the current decode segment (labeled) and start
+        the park clock — the resume path turns it into an ``engine.park``
+        span at re-admission."""
+        e = self._traces.get(slot.req.id)
+        if e is None:
+            return
+        now_m = time.perf_counter()
+        if e.get("dec_m") is not None:
+            self._tracer.record_span(
+                "engine.decode", e["tid"], e["dec_w"], (now_m - e["dec_m"]) * 1e3,
+                {"finish": "preempted", "tokens": slot.generated},
+            )
+        for k in ("dec_m", "dec_w", "pf_m", "pf_w"):
+            e.pop(k, None)
+        e["parked"] = (time.time(), now_m)
+
+    def _tr_fork(self, parent_id: str, child_id: str, degraded: bool = False) -> None:
+        """Branch fork (install-time fan-out or live beam re-fork): the
+        child inherits the parent's trace id so the whole group — winner
+        and pruned branches alike — lands in ONE waterfall."""
+        e = self._traces.get(parent_id)
+        if e is None:
+            return
+        now_w, now_m = time.time(), time.perf_counter()
+        attrs = {"branch": child_id}
+        if degraded:
+            attrs["degraded"] = 1
+        self._tracer.record_span("engine.fork", e["tid"], now_w, 0.0, attrs)
+        child = {"tid": e["tid"], "enq_w": now_w, "enq_m": now_m}
+        if not degraded:
+            # installs as a live batch-mate immediately: decode starts now
+            child["dec_w"], child["dec_m"] = now_w, now_m
+        self._traces[child_id] = child
 
     def grammar_bank_stats(self) -> dict[str, int]:
         """Capacity gauges for the constrained-decoding bank (VERDICT r2 item
@@ -2021,6 +2175,10 @@ class InferenceEngine:
             with self._pending_lock:
                 self.pending.remove(req)
             self._req_hashes.pop(req.id, None)
+            st = self._submit_t.get(req.id)
+            if st is not None:
+                self.latency.observe("queue_wait_ms", (time.monotonic() - st) * 1e3)
+            self._tr_dequeue(req)
             claimed.add(free_slot)
             batch.append((req, free_slot, pages))
         if head_starved and batch:
@@ -2103,6 +2261,29 @@ class InferenceEngine:
         ]
 
     def _acquire_pages_locked(
+        self, req: Request
+    ) -> tuple[list[int], int, str] | None:
+        """Tracing shim over :meth:`_acquire_pages_impl`: host/peer KV
+        restores happen inside the acquisition's lookup walk (batched H2D
+        upload), so a counter delta across the call is the exact "this
+        admission paid a tier restore" signal — recorded as an
+        ``engine.kv_restore`` span for traced requests, zero extra work
+        for the rest."""
+        e = self._traces.get(req.id)
+        if e is None:
+            return self._acquire_pages_impl(req)
+        r0 = self.stats.get("kv_offload_restored", 0)
+        t0_w, t0_m = time.time(), time.perf_counter()
+        acq = self._acquire_pages_impl(req)
+        restored = self.stats.get("kv_offload_restored", 0) - r0
+        if restored and acq is not None:
+            self._tracer.record_span(
+                "engine.kv_restore", e["tid"], t0_w,
+                (time.perf_counter() - t0_m) * 1e3, {"pages": restored},
+            )
+        return acq
+
+    def _acquire_pages_impl(
         self, req: Request
     ) -> tuple[list[int], int, str] | None:
         """Page acquisition for ONE request (caller holds the session lock):
@@ -2220,6 +2401,10 @@ class InferenceEngine:
         with self._pending_lock:
             self.pending.remove(req)
         self._req_hashes.pop(req.id, None)
+        st = self._submit_t.get(req.id)
+        if st is not None:
+            self.latency.observe("queue_wait_ms", (time.monotonic() - st) * 1e3)
+        self._tr_dequeue(req, start)
         if kind == "session":
             self.stats["prefix_cache_hits"] += 1
             self.stats["prefix_tokens_reused"] += start
@@ -2341,6 +2526,7 @@ class InferenceEngine:
                     if parent_exp is not None:
                         self._deadline_at[sub.id] = parent_exp
                 self.stats["branch_forks_degraded_total"] += 1
+                self._tr_fork(req.id, sub.id, degraded=True)
                 continue
             if L % ps:
                 # The only page whose prompt KV the sibling still READS but
@@ -2360,6 +2546,7 @@ class InferenceEngine:
             if parent_exp is not None:
                 with self._pending_lock:
                     self._deadline_at[sub.id] = parent_exp
+            self._tr_fork(req.id, sub.id)
             events.append(
                 self._install(sub, slot_idx, pages_j, row_j, tok_j, float(lsm[tok_j]))
             )
@@ -2551,6 +2738,12 @@ class InferenceEngine:
             # never rewritten by their owner.
             with self._session_lock:
                 self.allocator.publish(req.prompt, pages)
+        st = self._submit_t.pop(req.id, None)
+        if st is not None:
+            # TTFT as the engine sees it: submit → first sampled token
+            # (queue wait + prefill), the latency an agent loop waits on.
+            self.latency.observe("ttft_ms", (time.monotonic() - st) * 1e3)
+        self._tr_first_token(req)
         slot = _Slot(
             req=req,
             pages=pages,
@@ -2677,6 +2870,7 @@ class InferenceEngine:
         if slot.last_emit_t > 0.0:
             with self._telemetry_lock:
                 self._itl_window.append(now - slot.last_emit_t)
+            self.latency.observe("itl_ms", (now - slot.last_emit_t) * 1e3)
         slot.last_emit_t = now
         s = slot.req.sampling
         reason = None
@@ -2695,6 +2889,7 @@ class InferenceEngine:
             logprob=logprob,
         )
         if ev.finished:
+            self._tr_close(slot.req.id, reason or "stop", generated=slot.generated)
             self._release(slot_idx, slot)
         return ev
 
@@ -2857,6 +3052,7 @@ class InferenceEngine:
         self._dirty = True
         self._compact = None  # membership changed
         self.stats["branch_forks_total"] += 1
+        self._tr_fork(src_id, new_id)
         return True
 
     def live_request_ids(self) -> list[str]:
@@ -2972,6 +3168,12 @@ class InferenceEngine:
                 self._dirty = True
                 self._compact = None
                 self.stats["requests_cancelled"] += 1
+        for rid in matched:
+            self._submit_t.pop(rid, None)
+            self._tr_close(
+                rid,
+                "deadline_exceeded" if expected and rid in expected else "cancelled",
+            )
         # Cancels that matched nothing: the client thinks a request is in
         # flight that the engine does not hold (finished already, or never
         # submitted). Silent disagreement hides bugs — count it.
@@ -3180,6 +3382,7 @@ class InferenceEngine:
         self._dirty = True
         self._compact = None  # membership changed
         self.stats["preemptions_total"] += 1
+        self._tr_preempt(slot)
 
     def _mixed_eligible(self, req: Request) -> bool:
         """Mixed prefill jobs carry plain token prompts only: grammar
@@ -3401,6 +3604,8 @@ class InferenceEngine:
         if n_active:
             self.stats["decode_steps"] += 1
         carried = n_active + sum(n for _, n in chunks)
+        self._tick_mode = "mixed"
+        self._tick_carried = carried
         self.stats["mixed_ticks"] += 1
         self.stats["mixed_tokens"] += carried
         with self._telemetry_lock:
@@ -3412,6 +3617,71 @@ class InferenceEngine:
         return events
 
     def step(self) -> list[TokenEvent]:
+        """One scheduler tick (see :meth:`_step_inner` for the scheduling
+        contract). This wrapper is the observability shell
+        (docs/OBSERVABILITY.md): it times the tick into the ``tick_ms``
+        heartbeat histogram and appends one flight-recorder row — tick mode
+        (classic/mixed/prefill/spec), batch composition, token load,
+        free/host pages, and the overload counters — so the last
+        ``AGENTFIELD_FLIGHT_TICKS`` ticks are always reconstructible. A
+        step that RAISES records an ``error`` row first: the ring is the
+        crash dump."""
+        t0 = time.perf_counter()
+        self._tick_mode = "decode"
+        self._tick_carried = 0
+        try:
+            events = self._step_inner()
+        except Exception as e:
+            self.flight.record(
+                {
+                    "t": round(time.time(), 3),
+                    "mode": "error",
+                    "error": repr(e)[:300],
+                    "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                    "active": self.num_active,
+                    "pending": len(self.pending),
+                    "jobs": len(self._prefill_jobs),
+                    "free_pages": self.allocator.free_pages,  # afcheck: ignore[guarded-by] crash-dump telemetry: one int read; a torn value beats holding a lock the failed step may still own
+                }
+            )
+            raise
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        active = self.num_active
+        if events or active or self._prefill_jobs or self.pending:
+            self.latency.observe("tick_ms", dur_ms)
+            row = {
+                "t": round(time.time(), 3),
+                "mode": self._tick_mode,
+                "dur_ms": round(dur_ms, 3),
+                "active": active,
+                "pending": len(self.pending),
+                "jobs": len(self._prefill_jobs),
+                "events": len(events),
+                "finished": sum(1 for ev in events if ev.finished),
+                "tokens": self._tick_carried or len(events),
+                "free_pages": self.allocator.free_pages,  # afcheck: ignore[guarded-by] telemetry snapshot: scheduler-thread int read between ticks, same discipline as the heartbeat's free_pages read
+                "host_pages": self.allocator.host_pages,  # afcheck: ignore[guarded-by] telemetry snapshot: ditto
+                "preemptions_total": self.stats["preemptions_total"],
+                "shed_pending_deadline_total": self.stats["shed_pending_deadline_total"],
+                "deadline_exceeded": self.stats["deadline_exceeded"],
+            }
+            if self._tick_mode == "mixed":
+                # token-budget utilization: real tokens / configured budget
+                row["budget_util"] = round(
+                    self._tick_carried / max(1, self.ecfg.mixed_step_budget), 3
+                )
+            self.flight.record(row)
+        return events
+
+    def latency_histograms(self) -> dict:
+        """The engine's always-on latency histogram snapshots (TTFT /
+        inter-token / queue-wait / tick-duration, ms buckets) — shipped on
+        every heartbeat under ``latency_hist`` and re-exported by the
+        control plane as per-node Prometheus histograms
+        (metrics.export_engine_histograms)."""
+        return self.latency.snapshot()
+
+    def _step_inner(self) -> list[TokenEvent]:
         """One scheduler tick: admit (prefill) if possible, else decode —
         unless ``mixed_step`` is on and prompts are contending with active
         decodes, in which case ONE packed ragged forward carries a decode
@@ -3481,6 +3751,7 @@ class InferenceEngine:
             events += self._harvest_inflight()
             admitted = self._try_admit()
             if admitted:
+                self._tick_mode = "prefill"
                 return events + admitted
         if self.num_active == 0:
             return events + self._harvest_inflight()
@@ -3532,6 +3803,7 @@ class InferenceEngine:
         counts = None
         if self._spec_eligible(active_idx):
             toks, lps, counts, compact = self._decode_spec_dispatch(active_idx)
+            self._tick_mode = "spec"
             self.stats["decode_steps"] += 1
             self.stats["spec_steps"] += 1
         else:

@@ -333,6 +333,13 @@ class PrefixPagePool:
             "kv_offload_restore_fail",
             "kv_offload_demote_fail",
             "kv_offload_host_evicted",
+            # Restore wall-clock (docs/OBSERVABILITY.md): cumulative ms the
+            # batched host→device restore uploads took. With
+            # kv_offload_restored it gives avg restore latency — the
+            # aggregate twin of the per-request ``engine.kv_restore`` trace
+            # span, and the number that says whether a tier restore is
+            # still cheaper than the re-prefill it replaces.
+            "kv_offload_restore_ms_total",
             # Cluster tier (docs/PREFIX_CACHING.md "Cluster tier"): the
             # heartbeat sketch + cross-node page transfer counter family —
             # always exported so the /stats→heartbeat→Prometheus pipeline
@@ -1049,11 +1056,15 @@ class PrefixPagePool:
         """Phase 2: ONE batched host→device upload for every page the walk
         matched in the host tier, then the index flips. All-or-nothing: on
         upload failure nothing commits (entries kept, caller truncates)."""
+        t0 = time.perf_counter()
         try:
             self._upload([p for _, _, p in pending], [pg for _, pg, _ in pending])
         except Exception:
             self.stats["kv_offload_restore_fail"] += 1
             return False
+        self.stats["kv_offload_restore_ms_total"] += (
+            time.perf_counter() - t0
+        ) * 1e3
         for rec, page, _ in pending:
             del self._host[rec.chain]
             self._host_bytes -= self._page_bytes

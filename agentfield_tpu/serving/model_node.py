@@ -22,6 +22,7 @@ from typing import Any
 
 import jax
 
+from agentfield_tpu import tracing
 from agentfield_tpu.branching import BranchGroup, validate_branch_spec
 from agentfield_tpu.models import get_config, init_params
 from agentfield_tpu.models.configs import LlamaConfig
@@ -464,6 +465,16 @@ class ModelBackend:
             except Exception as e:
                 # Fail everything in flight with the real error; the engine's
                 # state may be corrupt, so don't pretend those requests live.
+                # The flight recorder IS the crash dump (docs/
+                # OBSERVABILITY.md): the last ticks before the failure go to
+                # the log now, while the evidence is still in the ring.
+                from agentfield_tpu.logging import get_logger
+
+                get_logger("model_node").error(
+                    "engine step failed; flight recorder dump",
+                    error=repr(e),
+                    flight_recorder=self.engine.flight.snapshot(last=64),
+                )
                 for rid, fut in list(self._futures.items()):
                     if not fut.done():
                         fut.set_exception(RuntimeError(f"engine step failed: {e!r}"))
@@ -791,6 +802,10 @@ class ModelBackend:
         # this many KV-shared branches at prefill completion; the CALLER
         # (generate/submit_stream) owns the BranchGroup that scores and
         # prunes them (docs/PREFIX_CACHING.md "Fork / COW branches")
+        trace: dict | None = None,  # validated TraceContext (or None): the
+        # engine records lifecycle spans against its trace_id
+        # (docs/OBSERVABILITY.md); collected at terminal by
+        # collect_trace_spans
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -882,6 +897,7 @@ class ModelBackend:
                     deadline_s=deadline_s,
                     priority=priority,
                     n_branches=n_branches,
+                    trace=trace,
                 )
             )
         except Exception:
@@ -1240,6 +1256,11 @@ class ModelBackend:
         # missing pages are pulled over the channel before admission
         # (docs/PREFIX_CACHING.md "Cluster tier"). Best-effort: any failure
         # degrades to an ordinary local prefill.
+        trace: dict | None = None,  # request-scoped tracing
+        # (docs/OBSERVABILITY.md): the gateway's TraceContext — engine
+        # lifecycle spans are recorded against its trace_id and shipped
+        # back in ``result["trace"]`` (the gateway pops the key before the
+        # result is persisted). Absent/invalid → no spans, no result key.
     ) -> dict[str, Any]:
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
@@ -1323,6 +1344,8 @@ class ModelBackend:
             if tts_trunc:
                 out["tts_truncated_chars"] = tts_trunc
             return out
+        trace = tracing.valid_context(trace)
+        t0_w, t0_m = time.time(), time.perf_counter()
         grammar_obj = None
         if response_schema is not None:
             grammar_obj = await self.ensure_grammar(response_schema)
@@ -1366,6 +1389,7 @@ class ModelBackend:
             deadline_s=deadline_s,
             priority=priority,
             n_branches=n_branches,
+            trace=trace,
         )
         try:
             result = await fut
@@ -1398,6 +1422,22 @@ class ModelBackend:
             ]
             if tts_trunc:
                 result["tts_truncated_chars"] = tts_trunc
+        if trace is not None:
+            # Node-side spans ride the result back to the gateway's
+            # TraceStore (the gateway pops the key before persisting): the
+            # node.generate envelope plus every engine lifecycle span the
+            # request recorded. Tracing off → no ctx → no key — the result
+            # shape is bit-compatible with today's (pinned).
+            _tr = tracing.tracer()
+            _tr.record_span(
+                "node.generate", trace["trace_id"], t0_w,
+                (time.perf_counter() - t0_m) * 1e3,
+                {"rid": rid, "finish": result.get("finish_reason")},
+            )
+            result["trace"] = {
+                "trace_id": trace["trace_id"],
+                "spans": self.collect_trace_spans(trace),
+            }
         return result
 
     def submit_stream(
@@ -1420,6 +1460,7 @@ class ModelBackend:
         priority: int = 0,
         n_branches: int = 1,
         branch_policy: Any = None,
+        trace: dict | None = None,
     ) -> tuple[str, asyncio.Queue, int]:
         """Streaming variant: returns (request_id, queue of TokenEvents,
         truncated_prompt_tokens) — the truncation count rides along so
@@ -1472,8 +1513,29 @@ class ModelBackend:
             deadline_s=deadline_s,
             priority=priority,
             n_branches=n_branches,
+            trace=tracing.valid_context(trace),
         )
         return rid, q, truncated
+
+    def collect_trace_spans(self, ctx) -> list[dict]:
+        """Pop this trace's spans from the process buffer and stamp each
+        with the dispatch labels the gateway put in the TraceContext
+        (``node``, ``attempt``) — the waterfall must say WHICH node served
+        WHICH attempt, and engine spans cannot know that themselves.
+        Called at terminal time by generate() (unary) and by the channel
+        server's trace-collect hook (streaming + failure terminals)."""
+        ctx = tracing.valid_context(ctx)
+        if ctx is None:
+            return []
+        spans = tracing.tracer().pop(ctx["trace_id"])
+        node = ctx.get("node")
+        attempt = ctx.get("attempt")
+        for s in spans:
+            if node is not None:
+                s.setdefault("node", node)
+            if attempt is not None:
+                s.setdefault("attempt", attempt)
+        return spans
 
     def pop_group_meta(self, rid: str) -> dict | None:
         """The ``branches`` summary of a resolved streaming group (set at
@@ -1886,6 +1948,12 @@ def build_model_node(
             "pending_requests": len(backend.engine.pending),
             "free_pages": backend.engine.allocator.free_pages,
             "draining": int(backend._draining),
+            # Always-on latency histograms (TTFT/ITL/queue-wait/tick, ms
+            # buckets): popped by the registry like prefix_sketch and
+            # re-exported as REAL per-node Prometheus histograms —
+            # percentile gauges can't aggregate across a fleet, bucket
+            # counts can (docs/OBSERVABILITY.md).
+            "latency_hist": backend.engine.latency_histograms(),
         }
         # Cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier"): the
         # prefix-index sketch rides every heartbeat; the registry pops it
@@ -1909,6 +1977,7 @@ def build_model_node(
                 "max_new_tokens", "temperature", "top_k", "top_p",
                 "response_schema", "context_overflow", "images", "audios",
                 "deadline_s", "priority", "n_branches", "branch_policy",
+                "trace",
             )
             if body.get(k) is not None
         }
@@ -2035,6 +2104,8 @@ def build_model_node(
             return await backend.generate(
                 **{k: v for k, v in payload.items() if v is not None}
             )
+        trace_ctx = tracing.valid_context(payload.get("trace"))
+        t0_w, t0_m = time.time(), time.perf_counter()
         gen_kwargs = await _prep_stream_kwargs(payload)
         rid, q, truncated = backend.submit_stream(**gen_kwargs)
         records: list[tuple[int, float | None]] = []
@@ -2060,6 +2131,17 @@ def build_model_node(
             raise
         finally:
             backend.release_stream(rid)
+            if trace_ctx is not None:
+                # The node-side envelope span, streamed path (its unary twin
+                # lives in generate()): recorded in the finally so a cancel
+                # or engine failure still leaves it for the channel
+                # server's terminal-time collection.
+                _tr = tracing.tracer()
+                _tr.record_span(
+                    "node.generate", trace_ctx["trace_id"], t0_w,
+                    (time.perf_counter() - t0_m) * 1e3,
+                    {"rid": rid, "finish": finish_reason, "stream": 1},
+                )
         if finish_reason and finish_reason.startswith("error:"):
             raise RuntimeError(f"engine stream failed ({finish_reason})")
         result = {
@@ -2083,6 +2165,11 @@ def build_model_node(
         # for this node's own pulls.
         agent.channel_server.set_kv_export(backend.kv_export_pages)
         backend._kv_fetch_fn = agent.channel_server.fetch_kv
+        # Tracing: the channel server collects this trace's spans at
+        # TERMINAL time — success, failure, and cancel terminals alike, so
+        # a node that failed an execution still ships its evidence
+        # (docs/OBSERVABILITY.md).
+        agent.channel_server.set_trace_collect(backend.collect_trace_spans)
 
     async def _branch_verifier(target: str, payload: dict) -> Any:
         """Branch-group verifier hook: dispatch the candidate texts to the
@@ -2115,6 +2202,31 @@ def build_model_node(
         )
 
     agent.add_route("GET", "/stats", stats_handler)
+
+    async def flight_handler(req):
+        """Node debug endpoint (docs/OBSERVABILITY.md "Flight recorder"):
+        the last N per-tick scheduler records — tick mode, batch
+        composition, token load, page headroom, overload counters. Always
+        on; ``?last=64`` bounds the dump."""
+        from aiohttp import web as _web
+
+        try:
+            last = int(req.query.get("last", "0")) or None
+        except ValueError:
+            last = None
+        eng = backend.engine
+        return _web.json_response(
+            {
+                "node_id": node_id,
+                "max_ticks": eng.flight.max_ticks,
+                "ticks_recorded": eng.flight.ticks_recorded,
+                "trace_buffer_spans": eng._tracer.span_count(),
+                "trace_spans_dropped": eng._tracer.dropped_spans,
+                "ticks": eng.flight.snapshot(last=last),
+            }
+        )
+
+    agent.add_route("GET", "/debug/flight", flight_handler)
 
     profile_state = {"active": False, "dir": None}
 

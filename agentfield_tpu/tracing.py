@@ -1,0 +1,334 @@
+"""Request-scoped distributed tracing + engine flight recorder primitives.
+
+jax-free by design (package root, like ``prefix_hash``/``branching``): the
+gateway, the channel layer, and the serving engine all import this module,
+and the control plane must be able to assemble traces without dragging the
+serving stack onto its event loop.
+
+One execution = ONE trace. The gateway mints a :func:`new_trace_id` per
+execution (``Execution.trace_id``), threads a small ``TraceContext`` dict
+through the dispatch path — channel ``submit`` frames / the model-node
+``generate`` input — and every layer records :class:`spans <Tracer>` against
+that id: monotonic-clock begin/end pairs anchored to a wall-clock ``t0`` so
+cross-process spans order into one waterfall. Node-side spans accumulate in
+a bounded per-process :class:`Tracer` buffer and ride the execution's
+terminal frame back to the gateway's :class:`TraceStore`, served at
+``GET /api/v1/executions/{id}/trace`` (docs/OBSERVABILITY.md).
+
+Span dict shape (the wire format — plain JSON)::
+
+    {"name": "engine.prefill", "t0": 1722772800.123, "dur_ms": 14.2,
+     "attrs": {"tokens": 128, "cached": 96}, "node": "node-a", "attempt": 1}
+
+Always-on siblings (independent of per-request tracing):
+
+- :class:`HistogramSet` — fixed-bucket latency histograms (TTFT / ITL /
+  queue-wait / tick-duration) the engine ships on every heartbeat; the
+  control plane re-exports them as per-node Prometheus histograms.
+- :class:`FlightRecorder` — a fixed-size ring of per-tick scheduler records,
+  exposed on the node debug endpoint and dumped when an engine step fails.
+
+Knobs (docs/OBSERVABILITY.md knob table):
+
+- ``AGENTFIELD_TRACE`` — master switch (default on). Off is bit-compatible
+  with the pre-tracing wire: no ``trace`` key on any frame or payload.
+- ``AGENTFIELD_TRACE_BUFFER_SPANS`` — per-process span buffer cap (node
+  side; oldest traces evict whole when the total overflows).
+- ``AGENTFIELD_TRACE_TTL_S`` — gateway TraceStore retention after the last
+  span of a trace landed.
+- ``AGENTFIELD_FLIGHT_TICKS`` — flight-recorder ring size (per-tick rows).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import os
+import threading
+import time
+import uuid
+
+# Per-trace span cap: one runaway request (a branch fan-out, a preempt storm)
+# must not evict every other trace from the buffer.
+_MAX_SPANS_PER_TRACE = 512
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_enabled_override: bool | None = None
+
+
+def enabled() -> bool:
+    """Is request-scoped tracing on? ``AGENTFIELD_TRACE`` (default on),
+    overridable in-process via :func:`set_enabled` (tests, the
+    ``trace_overhead`` bench A/B). The flight recorder and the latency
+    histograms are always-on and do NOT consult this."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("AGENTFIELD_TRACE", "1").lower() not in ("0", "false", "no")
+
+
+def set_enabled(on: bool | None) -> None:
+    """In-process override of the ``AGENTFIELD_TRACE`` knob (None restores
+    the env default). The gateway reads :func:`enabled` per execution, so
+    flipping this mid-run affects only executions prepared afterwards."""
+    global _enabled_override
+    _enabled_override = on
+
+
+def new_trace_id() -> str:
+    return f"tr_{uuid.uuid4().hex[:20]}"
+
+
+def valid_context(ctx) -> dict | None:
+    """The one TraceContext validation: a dict with a str ``trace_id`` (plus
+    optional ``attempt``/``node`` labels) passes through; anything else —
+    client-supplied garbage included — reads as "not traced"."""
+    if isinstance(ctx, dict) and isinstance(ctx.get("trace_id"), str):
+        return ctx
+    return None
+
+
+def make_span(
+    name: str, t0: float, dur_ms: float, attrs: dict | None = None
+) -> dict:
+    span = {"name": name, "t0": round(t0, 6), "dur_ms": round(dur_ms, 3)}
+    if attrs:
+        span["attrs"] = attrs
+    return span
+
+
+class Tracer:
+    """Bounded per-process span buffer, indexed by trace id.
+
+    Writers are the engine's scheduler thread and the node's event loop;
+    readers pop a whole trace at terminal time — one lock serializes both.
+    When the total span count overflows ``max_spans`` the OLDEST trace
+    evicts whole (a trace with half its spans missing reads as corrupt, not
+    as cheap)."""
+
+    def __init__(self, max_spans: int | None = None):
+        self.max_spans = max_spans or _env_int("AGENTFIELD_TRACE_BUFFER_SPANS", 8192)
+        self._lock = threading.Lock()
+        self._traces: "collections.OrderedDict[str, list[dict]]" = collections.OrderedDict()
+        self._total = 0
+        self.dropped_spans = 0  # overflow accounting (debug endpoint)
+
+    def record_span(
+        self,
+        name: str,
+        trace_id: str | None,
+        t0: float,
+        dur_ms: float,
+        attrs: dict | None = None,
+    ) -> None:
+        """Record one finished span against ``trace_id`` (no-op when None —
+        call sites stay unconditional and cheap for untraced requests)."""
+        if not trace_id:
+            return
+        span = make_span(name, t0, dur_ms, attrs)
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                spans = self._traces[trace_id] = []
+            if len(spans) >= _MAX_SPANS_PER_TRACE:
+                self.dropped_spans += 1
+                return
+            spans.append(span)
+            self._total += 1
+            while self._total > self.max_spans and len(self._traces) > 1:
+                _, evicted = self._traces.popitem(last=False)
+                self._total -= len(evicted)
+                self.dropped_spans += len(evicted)
+
+    def pop(self, trace_id: str) -> list[dict]:
+        """Remove and return a trace's spans (terminal-frame shipping)."""
+        with self._lock:
+            spans = self._traces.pop(trace_id, None)
+            if spans is None:
+                return []
+            self._total -= len(spans)
+            return spans
+
+    def peek(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def span_count(self) -> int:
+        with self._lock:
+            return self._total
+
+
+_TRACER: Tracer | None = None
+
+
+def tracer() -> Tracer:
+    """The process-wide span buffer (engine + model backend share it; a
+    process serves one node, so one buffer is the natural scope)."""
+    global _TRACER
+    if _TRACER is None:
+        _TRACER = Tracer()
+    return _TRACER
+
+
+class TraceStore:
+    """Gateway-side trace assembly: spans from every layer and every node
+    accumulate under their trace id; ``get`` returns the ordered waterfall.
+    In-memory with TTL retention — traces are a debugging substrate, not an
+    audit log (the execution row is the durable record; it carries the
+    trace id so operators know which trace WOULD have answered)."""
+
+    def __init__(self, retain_s: float | None = None, max_traces: int = 4096):
+        self.retain_s = (
+            retain_s
+            if retain_s is not None
+            else float(_env_int("AGENTFIELD_TRACE_TTL_S", 600))
+        )
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self._traces: "collections.OrderedDict[str, tuple[float, list[dict]]]" = (
+            collections.OrderedDict()
+        )
+
+    def _purge_locked(self) -> None:
+        cutoff = time.monotonic() - self.retain_s
+        while self._traces:
+            tid, (touched, _) = next(iter(self._traces.items()))
+            if touched > cutoff and len(self._traces) <= self.max_traces:
+                break
+            self._traces.pop(tid, None)
+
+    def record_span(
+        self,
+        name: str,
+        trace_id: str | None,
+        t0: float,
+        dur_ms: float,
+        attrs: dict | None = None,
+        node: str = "gateway",
+    ) -> None:
+        """Gateway-local span (dispatch attempts, queue wait, the root):
+        recorded straight into the store — the gateway IS the assembly
+        point, so it skips the per-process buffer + terminal-frame hop."""
+        if not trace_id:
+            return
+        span = make_span(name, t0, dur_ms, attrs)
+        span.setdefault("node", node)
+        self.extend(trace_id, [span])
+
+    def extend(self, trace_id: str, spans) -> int:
+        """Land shipped spans (terminal frames / result payloads). Shapes
+        are validated span-by-span — a malformed payload from one node must
+        not poison the trace or the endpoint."""
+        if not isinstance(trace_id, str) or not isinstance(spans, list):
+            return 0
+        ok = [
+            s
+            for s in spans
+            if isinstance(s, dict)
+            and isinstance(s.get("name"), str)
+            and isinstance(s.get("t0"), (int, float))
+            and isinstance(s.get("dur_ms"), (int, float))
+        ]
+        if not ok:
+            return 0
+        with self._lock:
+            _, existing = self._traces.pop(trace_id, (0.0, []))
+            existing.extend(ok[: max(0, _MAX_SPANS_PER_TRACE - len(existing))])
+            self._traces[trace_id] = (time.monotonic(), existing)
+            self._purge_locked()
+        return len(ok)
+
+    def get(self, trace_id: str) -> list[dict]:
+        """The assembled waterfall: spans ordered by wall-clock start, with
+        the longer span first on ties (a parent that began the same instant
+        as its child renders above it)."""
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            spans = list(entry[1]) if entry is not None else []
+        return sorted(spans, key=lambda s: (s.get("t0", 0.0), -s.get("dur_ms", 0.0)))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+# ---------------------------------------------------------------------------
+# Latency histograms (always-on; ride the stats→heartbeat→/metrics pipeline)
+
+# ms-scale buckets for serving latencies: sub-ms ticks through 30s tails.
+MS_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class HistogramSet:
+    """A fixed family of fixed-bucket latency histograms, cheap enough for
+    the scheduler tick path (one bisect + two adds per observe, one shared
+    lock). ``snapshot()`` is the heartbeat payload — cumulative counters,
+    so the control plane re-publishes the latest snapshot per node exactly
+    like the engine's counter gauges (the node owns the counter)."""
+
+    def __init__(self, names: tuple[str, ...], buckets: tuple[float, ...] = MS_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        # per name: per-bucket counts (+1 overflow slot), sum, count
+        self._h: dict[str, list] = {
+            n: [[0] * (len(self.buckets) + 1), 0.0, 0] for n in names
+        }
+
+    def observe(self, name: str, value_ms: float) -> None:
+        h = self._h.get(name)
+        if h is None:
+            raise KeyError(f"histogram {name!r} is not in this set")
+        i = bisect.bisect_left(self.buckets, value_ms)
+        with self._lock:
+            h[0][i] += 1
+            h[1] += value_ms
+            h[2] += 1
+
+    def snapshot(self) -> dict:
+        """{name: {buckets, counts (per-bucket, +Inf last), sum, count}} —
+        JSON-safe, shipped verbatim in heartbeat stats under
+        ``latency_hist`` (popped by the registry like ``prefix_sketch``)."""
+        with self._lock:
+            return {
+                name: {
+                    "buckets": list(self.buckets),
+                    "counts": list(h[0]),
+                    "sum": round(h[1], 3),
+                    "count": h[2],
+                }
+                for name, h in self._h.items()
+            }
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (always-on ring of per-tick scheduler records)
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-tick engine records — the crash-dump substrate
+    for "why was this tick slow / what was the engine doing when it died".
+    Appends are deque-atomic (scheduler thread); snapshots copy (event
+    loop). Dumped on engine-step failure and served by the node debug
+    endpoint ``GET /debug/flight`` (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, max_ticks: int | None = None):
+        self.max_ticks = max_ticks or _env_int("AGENTFIELD_FLIGHT_TICKS", 512)
+        self._ring: collections.deque[dict] = collections.deque(maxlen=self.max_ticks)
+        self.ticks_recorded = 0
+
+    def record(self, row: dict) -> None:
+        self._ring.append(row)
+        self.ticks_recorded += 1
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        rows = list(self._ring)
+        return rows[-last:] if last else rows

@@ -405,6 +405,11 @@ SCENARIOS: dict[str, dict] = {
         "run": lambda c: _best_of_n(c["model"], c["cfg"], c["params"], c["attn"]),
         "doc": "KV-fork best-of-8 vs 8 independent requests (+ verifier run)",
     },
+    "trace_overhead": {
+        "dispatch_before_probe": False,
+        "run": lambda c: _trace_overhead(c["model"], c["cfg"], c["params"], c["attn"]),
+        "doc": "request tracing A/B: streamed load, tracing on vs off (<3% req/s)",
+    },
     "kernels": {
         "dispatch_before_probe": True,
         "run": lambda c: _kernel_bench(c["cpu"]),
@@ -2512,6 +2517,178 @@ def _fault_storm() -> None:
             "zero_hung": storm["hung_executions"] == 0
             and baseline["hung_executions"] == 0,
             "requests": n,
+        }
+    )
+
+
+def _trace_overhead(model: str, cfg, params, attn: str) -> None:
+    """Request-scoped tracing A/B (BENCH_r15, docs/OBSERVABILITY.md): the
+    IDENTICAL streamed burst through one in-process control plane + one
+    real model node, tracing ON vs OFF (``tracing.set_enabled``). The
+    driver is tools/perf/load_gen.run_load with a 3-tuple execute hook
+    ``(status, ttft, trace_id)`` — the same slow-tail linkage the operator
+    tool ships, so the artifact's ``slow_traces`` block links p99 outliers
+    to their trace ids. Acceptance: tracing ON costs <3% req/s and <5%
+    TTFT p50, and EVERY traced request assembles exactly one waterfall
+    containing all lifecycle spans (gateway dispatch → channel submit →
+    node envelope → engine queue-wait/prefill/decode)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    from aiohttp import web
+
+    from agentfield_tpu import tracing
+    from agentfield_tpu.control_plane.server import ControlPlane, create_app
+    from agentfield_tpu.serving import EngineConfig
+    from agentfield_tpu.serving.model_node import build_model_node
+    from tools.perf.load_gen import run_load
+
+    _partial["stage"] = "trace_overhead"
+    os.environ.setdefault("AGENTFIELD_LOG_LEVEL", "warning")
+    n = int(os.environ.get("AGENTFIELD_BENCH_TRACE_REQUESTS") or 96)
+    conc = int(os.environ.get("AGENTFIELD_BENCH_TRACE_CONCURRENCY") or 8)
+    prompt_len, max_new = 48, 8
+
+    ecfg = EngineConfig(
+        max_batch=8,
+        page_size=16,
+        num_pages=256,
+        max_pages_per_seq=8,
+        max_pending=256,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: honest TTFT
+    )
+
+    def toks(seed: int) -> list[int]:
+        return jax.random.randint(
+            jax.random.PRNGKey(seed), (prompt_len,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    # Distinct prompts, identical across modes: the prefix cache behaves
+    # the same in both runs, so the delta is pure tracing overhead.
+    prompts = [toks(4000 + i) for i in range(n)]
+    warm_prompts = [toks(4900 + i) for i in range(8)]
+
+    required_spans = (
+        "gateway.execute", "gateway.dispatch", "channel.submit",
+        "node.generate", "engine.queue_wait", "engine.prefill",
+        "engine.decode",
+    )
+
+    if not _budget_gate("trace_overhead", 120):
+        _emit(_fallback_payload("budget exhausted before trace_overhead"))
+        return
+
+    async def one_run(trace_on: bool) -> dict:
+        tracing.set_enabled(trace_on)
+        cp = ControlPlane(db_path=":memory:")
+        app = create_app(cp)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        agent, back = build_model_node(
+            "tnode", f"http://127.0.0.1:{port}", model=model, params=params,
+            ecfg=ecfg,
+        )
+        await back.start()
+        await agent.start()
+        trace_ids: list[str | None] = []
+        try:
+            async def call(i: int, prompt=None, record=True):
+                t0 = time.perf_counter()
+                _ex, sub = await cp.gateway.execute_stream(
+                    "tnode.generate",
+                    {"tokens": prompt if prompt is not None else prompts[i],
+                     "max_new_tokens": max_new},
+                    {},
+                )
+                ttft, status = None, "?"
+                while True:
+                    frame = await sub.get()
+                    if frame is None:
+                        status = "dropped"
+                        break
+                    if frame["kind"] == "token" and ttft is None:
+                        ttft = time.perf_counter() - t0
+                    if frame["kind"] == "terminal":
+                        status = frame["status"]
+                        break
+                if record:
+                    trace_ids.append(_ex.trace_id)
+                return status, ttft, _ex.trace_id
+
+            for j, wp in enumerate(warm_prompts):  # compiles out of the window
+                await call(j, prompt=wp, record=False)
+            report = await run_load(
+                "", "tnode.generate", n, conc, "sync", execute=call
+            )
+            if trace_on:
+                # Waterfall completeness: every request has exactly ONE
+                # trace carrying all lifecycle spans.
+                complete = 0
+                missing: dict[str, int] = {}
+                for tid in trace_ids:
+                    spans = cp.gateway.traces.get(tid) if tid else []
+                    names = {s["name"] for s in spans}
+                    lacking = [r for r in required_spans if r not in names]
+                    roots = sum(1 for s in spans if s["name"] == "gateway.execute")
+                    if not lacking and roots == 1:
+                        complete += 1
+                    for r in lacking:
+                        missing[r] = missing.get(r, 0) + 1
+                report["waterfalls"] = {
+                    "checked": len(trace_ids),
+                    "complete": complete,
+                    "required_spans": list(required_spans),
+                    "missing_by_span": missing,
+                }
+        finally:
+            await agent.stop()
+            await back.stop()
+            await runner.cleanup()
+            tracing.set_enabled(None)
+        return report
+
+    # Interleaved best-of-2 per mode (shared-CPU noise; same policy as
+    # gateway_qps): the best round per mode is each configuration's honest
+    # capability, and every round is reported.
+    off_rounds, on_rounds = [], []
+    for _ in range(2):
+        off_rounds.append(asyncio.run(one_run(False)))
+        _partial["trace_overhead_off"] = off_rounds[-1]
+        on_rounds.append(asyncio.run(one_run(True)))
+        _partial["trace_overhead_on"] = on_rounds[-1]
+    off = max(off_rounds, key=lambda r: r["rps"])
+    on = max(on_rounds, key=lambda r: r["rps"])
+    rps_ratio = round(on["rps"] / max(off["rps"], 1e-9), 4)
+    ttft_on = on.get("ttft_ms", {}).get("p50", 0.0)
+    ttft_off = off.get("ttft_ms", {}).get("p50", 0.0)
+    ttft_ratio = round(ttft_on / max(ttft_off, 1e-9), 4)
+    wf = on.get("waterfalls", {})
+    _emit(
+        {
+            "metric": f"trace_overhead_{n}req_c{conc}_streamed",
+            "value": rps_ratio,
+            "unit": "rps_ratio_trace_on_vs_off",
+            "acceptance": {
+                "rps_overhead_lt_3pct": rps_ratio >= 0.97,
+                "ttft_p50_overhead_lt_5pct": ttft_ratio <= 1.05,
+                "waterfalls_complete": wf.get("complete") == wf.get("checked"),
+            },
+            "ttft_p50_ratio_on_vs_off": ttft_ratio,
+            "tracing_on": on,
+            "tracing_off": off,
+            "rounds": {
+                "off_rps": [r["rps"] for r in off_rounds],
+                "on_rps": [r["rps"] for r in on_rounds],
+                "note": "interleaved best-of-2 per mode (shared-CPU noise)",
+            },
+            "requests": n,
+            "concurrency": conc,
+            "stream_tokens": max_new,
         }
     )
 

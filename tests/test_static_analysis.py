@@ -1025,6 +1025,79 @@ def test_counter_contract_require_pin_catches_deleted_export(tmp_path):
     assert "gone_total" in found[0].message and "no increment site" in found[0].message
 
 
+def _span_repo(tmp: pathlib.Path, docs: str):
+    (tmp / "docs").mkdir(parents=True, exist_ok=True)
+    (tmp / "docs" / "OPS.md").write_text(docs, encoding="utf-8")
+    f = tmp / f"{SERVING}/engine.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(
+        textwrap.dedent(
+            """
+            class E:
+                def __init__(self, tracer, tracing):
+                    self._tracer = tracer
+                    self.latency = tracing.HistogramSet(("spin_ms",))
+
+                def spin(self):
+                    self._tracer.record_span("engine.spin", "tid", 0.0, 1.0)
+                    self.latency.observe("spin_ms", 2.0)
+            """
+        ),
+        encoding="utf-8",
+    )
+
+
+def test_counter_contract_flags_undocumented_span_and_histogram(tmp_path):
+    """Must-flag (ISSUE 15): a record_span name and a histogram name with
+    no docs/*.md row are findings — an undocumented span family is
+    untriageable exactly like an undocumented counter."""
+    _span_repo(tmp_path, "nothing documented")
+    found, _ = run_analysis(root=tmp_path, pass_ids=["counter-contract"])
+    msgs = "\n".join(f.message for f in found)
+    assert "trace span 'engine.spin'" in msgs
+    assert "histogram 'spin_ms'" in msgs
+
+
+def test_counter_contract_span_and_hist_documented_pass(tmp_path):
+    """Must-pass twin: documented span + histogram names are clean."""
+    _span_repo(tmp_path, "`engine.spin` span; `spin_ms` histogram rows")
+    found, _ = run_analysis(root=tmp_path, pass_ids=["counter-contract"])
+    assert found == []
+
+
+def test_counter_contract_require_span_pin_catches_deleted_emitter(tmp_path):
+    """Deleting a pinned span family's record_span site (or a pinned
+    histogram's observe site) fails the suite, exactly like a counter."""
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        "[counter-contract]\n"
+        'require_span = ["engine.spin", "engine.gone"]\n'
+        'require_hist = ["spin_ms", "gone_ms"]\n',
+        encoding="utf-8",
+    )
+    _span_repo(tmp_path, "`engine.spin` span; `spin_ms` histogram rows")
+    found, _ = run_analysis(
+        root=tmp_path, pass_ids=["counter-contract"], allowlist_path=allow
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2, msgs
+    assert "engine.gone" in msgs and "record_span site" in msgs
+    assert "gone_ms" in msgs and "observe/HistogramSet site" in msgs
+
+
+def test_repo_pins_span_and_histogram_inventory():
+    """The ISSUE 15 acceptance contract: the checked-in allowlist pins the
+    load-bearing span families and heartbeat histograms, and the pins hold
+    right now (every pinned name still has an emitter in the tree)."""
+    cfg = load_allowlist(ALLOWLIST_PATH)["counter-contract"]
+    for name in ("gateway.dispatch", "engine.prefill", "engine.park", "engine.fork"):
+        assert name in cfg["require_span"], name
+    for name in ("ttft_ms", "itl_ms", "queue_wait_ms", "tick_ms"):
+        assert name in cfg["require_hist"], name
+    findings, _ = run_analysis(pass_ids=["counter-contract"])
+    assert [f.message for f in findings] == []
+
+
 def test_repo_pins_counter_inventory():
     """The acceptance contract: the checked-in allowlist pins the counter
     families the runbooks depend on, and the pins hold right now."""

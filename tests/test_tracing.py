@@ -1,0 +1,826 @@
+"""Request-scoped distributed tracing + flight recorder tests (ISSUE 15,
+docs/OBSERVABILITY.md).
+
+Covers the acceptance contracts:
+  - GET /api/v1/executions/{id}/trace returns one complete, ORDERED
+    waterfall (gateway dispatch → channel submit → engine lifecycle) for a
+    streamed request, a preempted-and-resumed request, and a branched
+    request — and across retry+failover (attempt-labeled spans) and a
+    seeded channel.drop reattach;
+  - tracing OFF is bit-compatible with today's wire: no trace keys on
+    frames/inputs/results, no trace_id minted, no spans buffered;
+  - TTFT/ITL/queue-wait/tick histograms ride stats→heartbeat→/metrics as
+    real per-node Prometheus histograms;
+  - Metrics.observe bucket registry: ms defaults for *_ms metrics and a
+    HARD error on conflicting bucket specs (the old first-caller-wins);
+  - bounded buffers: Tracer evicts oldest traces whole, TraceStore is
+    TTL-bounded, FlightRecorder is a fixed ring.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from agentfield_tpu import tracing
+from agentfield_tpu.control_plane import faults
+from tests.helpers_cp import CPHarness, async_test
+
+# ---------------------------------------------------------------------------
+# unit: tracer buffer / trace store / flight recorder / histograms
+
+
+def test_tracer_buffer_bounds_evict_oldest_trace_whole():
+    t = tracing.Tracer(max_spans=6)
+    for tid in ("tr_a", "tr_b", "tr_c"):
+        for i in range(2):
+            t.record_span("engine.decode", tid, float(i), 1.0)
+    assert t.span_count() == 6
+    # overflow: the OLDEST trace (tr_a) evicts whole, not span-by-span
+    t.record_span("engine.decode", "tr_d", 0.0, 1.0)
+    assert t.pop("tr_a") == []
+    assert len(t.pop("tr_b")) == 2
+    assert t.dropped_spans == 2
+    # per-trace cap: a runaway trace stops accumulating, others survive
+    t2 = tracing.Tracer(max_spans=10_000)
+    for i in range(tracing._MAX_SPANS_PER_TRACE + 5):
+        t2.record_span("engine.decode", "tr_big", float(i), 1.0)
+    assert len(t2.pop("tr_big")) == tracing._MAX_SPANS_PER_TRACE
+    # no-op on falsy trace ids: call sites stay unconditional
+    t2.record_span("engine.decode", None, 0.0, 1.0)
+    assert t2.span_count() == 0
+
+
+def test_trace_store_orders_validates_and_expires():
+    st = tracing.TraceStore(retain_s=0.05, max_traces=8)
+    st.record_span("gateway.execute", "tr_x", 5.0, 100.0)
+    # malformed spans are dropped span-by-span, valid ones land
+    n = st.extend(
+        "tr_x",
+        [
+            {"name": "engine.decode", "t0": 7.0, "dur_ms": 1.0},
+            {"name": "engine.prefill", "t0": 6.0, "dur_ms": 2.0},
+            {"no_name": 1},
+            "not a dict",
+        ],
+    )
+    assert n == 2
+    names = [s["name"] for s in st.get("tr_x")]
+    assert names == ["gateway.execute", "engine.prefill", "engine.decode"]
+    # non-list / non-str ids are rejected wholesale
+    assert st.extend(None, [{"name": "x.y", "t0": 0.0, "dur_ms": 0.0}]) == 0
+    assert st.extend("tr_x", "nope") == 0
+    time.sleep(0.06)
+    st.extend("tr_other", [{"name": "x.y", "t0": 0.0, "dur_ms": 0.0}])  # purge tick
+    assert st.get("tr_x") == []
+
+
+def test_flight_recorder_fixed_ring():
+    fr = tracing.FlightRecorder(max_ticks=4)
+    for i in range(9):
+        fr.record({"i": i})
+    assert [r["i"] for r in fr.snapshot()] == [5, 6, 7, 8]
+    assert [r["i"] for r in fr.snapshot(last=2)] == [7, 8]
+    assert fr.ticks_recorded == 9
+
+
+def test_histogram_set_buckets_and_snapshot():
+    h = tracing.HistogramSet(("ttft_ms",), buckets=(1.0, 10.0))
+    h.observe("ttft_ms", 0.5)
+    h.observe("ttft_ms", 5.0)
+    h.observe("ttft_ms", 50.0)  # overflow slot
+    snap = h.snapshot()["ttft_ms"]
+    assert snap["buckets"] == [1.0, 10.0]
+    assert snap["counts"] == [1, 1, 1]
+    assert snap["count"] == 3 and snap["sum"] == pytest.approx(55.5)
+    with pytest.raises(KeyError):
+        h.observe("nope_ms", 1.0)
+
+
+def test_metrics_bucket_registry_ms_defaults_and_conflict_hard_error():
+    from agentfield_tpu.control_plane.metrics import Metrics
+
+    m = Metrics()
+    # *_ms names get ms-scale defaults; *_seconds keep the historical scale
+    m.observe("queue_wait_ms", 3.0)
+    m.observe("execution_duration_seconds", 0.1)
+    assert m._hist_buckets["queue_wait_ms"] == Metrics.MS_BUCKETS
+    assert m._hist_buckets["execution_duration_seconds"] == Metrics.DEFAULT_BUCKETS
+    # the satellite contract: a conflicting bucket spec is a HARD error,
+    # not a silent first-caller-wins
+    with pytest.raises(ValueError):
+        m.observe("queue_wait_ms", 1.0, buckets=(1, 2, 3))
+    with pytest.raises(ValueError):
+        m.declare_histogram("execution_duration_seconds", (5, 10))
+    # identical re-declaration is fine (idempotent registration)
+    m.declare_histogram("queue_wait_ms", Metrics.MS_BUCKETS)
+    # explicit first registration wins and is enforced thereafter
+    m.declare_histogram("custom_ms", (2.0, 4.0))
+    m.observe("custom_ms", 3.0)
+    with pytest.raises(ValueError):
+        m.observe("custom_ms", 3.0, buckets=(1.0,))
+
+
+def test_metrics_histogram_snapshot_render_and_node_removal():
+    from agentfield_tpu.control_plane.metrics import (
+        Metrics,
+        export_engine_histograms,
+    )
+
+    m = Metrics()
+    n = export_engine_histograms(
+        m,
+        "node-a",
+        {
+            "ttft_ms": {"buckets": [1.0, 10.0], "counts": [2, 3, 1], "sum": 25.0, "count": 6},
+            "bad block": {"buckets": [1], "counts": [1, 1], "sum": 0, "count": 0},
+            "torn": {"buckets": [1.0], "counts": [1]},  # missing +Inf slot
+            "not_a_dict": 7,
+        },
+    )
+    assert n == 1
+    text = m.render()
+    assert "# TYPE agentfield_engine_ttft_ms histogram" in text
+    # cumulative render with merged labels, +Inf = total count
+    assert 'agentfield_engine_ttft_ms_bucket{node="node-a",le="1.0"} 2' in text
+    assert 'agentfield_engine_ttft_ms_bucket{node="node-a",le="+Inf"} 6.0' in text
+    assert 'agentfield_engine_ttft_ms_count{node="node-a"} 6.0' in text
+    # a deregistered node's histogram series vanish with its gauges
+    m.set_gauge("engine_x", 1.0, labels={"node": "node-a"})
+    removed = m.remove_gauges({"node": "node-a"})
+    assert removed == 2
+    assert "engine_ttft_ms_bucket" not in m.render()
+
+
+def test_valid_context_and_enable_override():
+    assert tracing.valid_context({"trace_id": "tr_1", "attempt": 2}) is not None
+    assert tracing.valid_context({"trace_id": 7}) is None
+    assert tracing.valid_context("tr_1") is None
+    assert tracing.valid_context(None) is None
+    try:
+        tracing.set_enabled(False)
+        assert tracing.enabled() is False
+        tracing.set_enabled(True)
+        assert tracing.enabled() is True
+    finally:
+        tracing.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# engine-level: preempt/resume spans + park continuity (no control plane)
+
+
+def _tiny_engine(**kw):
+    import jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine
+
+    cfg = get_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(**{
+        "max_batch": 2, "page_size": 8, "num_pages": 64, "max_pages_per_seq": 8,
+        **kw,
+    })
+    return InferenceEngine(params, cfg, ecfg)
+
+
+def test_engine_preempt_resume_spans_and_continuous_indexes():
+    """Seeded preempt_storm mid-decode: the victim's trace shows TWO decode
+    segments (the first closed `preempted`) bridged by an engine.park span,
+    and its TokenEvent indexes stay continuous across the park — the
+    waterfall and the stream tell one coherent story."""
+    from agentfield_tpu.serving.engine import Request
+    from agentfield_tpu.serving.sampler import SamplingParams
+
+    eng = _tiny_engine(max_batch=1, preempt_fence_ticks=4)
+    tracer = tracing.tracer()
+    try:
+        faults.install(
+            faults.FaultInjector(seed=3, spec={"engine.preempt_storm": {"times": 1}})
+        )
+        r1 = Request(
+            id="victim", prompt=list(range(12)),
+            sampling=SamplingParams(max_new_tokens=10),
+            trace={"trace_id": "tr_preempt", "attempt": 1, "node": "n1"},
+        )
+        r2 = Request(
+            id="rival", prompt=list(range(20, 30)),
+            sampling=SamplingParams(max_new_tokens=3),
+        )
+        eng.submit(r1)
+        events = []
+        # step until r1 decodes, then enqueue the rival (pending + active ⇒
+        # the storm consults and fires on its first opportunity)
+        for _ in range(200):
+            events += eng.step()
+            if any(e.request_id == "victim" for e in events) and r2.id not in {
+                e.request_id for e in events
+            }:
+                break
+        eng.submit(r2)
+        for _ in range(400):
+            events += eng.step()
+            done = {e.request_id for e in events if e.finished}
+            if {"victim", "rival"} <= done:
+                break
+        assert eng.stats["preempt_storm_injected"] == 1
+        assert eng.stats["preemptions_total"] == 1
+        v_idx = [e.index for e in events if e.request_id == "victim"]
+        assert v_idx == list(range(len(v_idx))) and len(v_idx) == 10
+        spans = tracer.pop("tr_preempt")
+        names = [s["name"] for s in spans]
+        assert "engine.park" in names, names
+        decodes = [s for s in spans if s["name"] == "engine.decode"]
+        assert len(decodes) == 2
+        assert decodes[0]["attrs"]["finish"] == "preempted"
+        assert decodes[1]["attrs"]["finish"] in ("stop", "length")
+        # the resume's suffix re-prefill is its own span, after the park
+        assert names.count("engine.prefill") == 2
+    finally:
+        faults.install(None)
+        eng.close()
+
+
+def test_engine_branch_fork_and_pruned_spans_one_trace():
+    """A branch group lands WHOLE in one trace: engine.fork spans mark the
+    fan-out, every branch decodes under the parent's trace id, and a
+    cancelled (pruned) branch closes its decode span `cancelled`."""
+    from agentfield_tpu.branching import branch_rid
+    from agentfield_tpu.serving.engine import Request
+    from agentfield_tpu.serving.sampler import SamplingParams
+
+    eng = _tiny_engine(max_batch=4, num_pages=128, max_pages_per_seq=8)
+    tracer = tracing.tracer()
+    try:
+        req = Request(
+            id="grp", prompt=list(range(12)),
+            sampling=SamplingParams(max_new_tokens=8, temperature=0.8),
+            n_branches=3,
+            trace={"trace_id": "tr_branch", "attempt": 1, "node": "n1"},
+        )
+        eng.submit(req)
+        events = []
+        pruned = branch_rid("grp", 2)
+        cancelled = False
+        for _ in range(400):
+            events += eng.step()
+            if not cancelled and any(
+                e.request_id == pruned and e.index >= 1 for e in events
+            ):
+                eng.request_cancel(pruned)  # prune like a beam policy would
+                cancelled = True
+            live = {e.request_id for e in events if e.finished}
+            if {"grp", branch_rid("grp", 1)} <= live and cancelled:
+                break
+        spans = tracer.pop("tr_branch")
+        forks = [s for s in spans if s["name"] == "engine.fork"]
+        assert len(forks) == 2
+        assert {f["attrs"]["branch"] for f in forks} == {
+            branch_rid("grp", 1), pruned,
+        }
+        decodes = [s for s in spans if s["name"] == "engine.decode"]
+        finishes = [d["attrs"]["finish"] for d in decodes]
+        assert "cancelled" in finishes  # the pruned branch's evidence
+        assert len(decodes) >= 3  # winner + sibling + pruned
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: control plane + model node → GET /api/v1/executions/{id}/trace
+
+
+def _ecfg(**kw):
+    from agentfield_tpu.serving import EngineConfig
+
+    return EngineConfig(**{
+        "max_batch": 4, "page_size": 8, "num_pages": 128,
+        "max_pages_per_seq": 16, **kw,
+    })
+
+
+async def _boot_node(h, node_id="model-tr", **ecfg_kw):
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    agent, backend = build_model_node(
+        node_id, h.base_url, model="llama-tiny", ecfg=_ecfg(**ecfg_kw)
+    )
+    await backend.start()
+    await agent.start()
+    return agent, backend
+
+
+async def _stop(*pairs):
+    for agent, backend in pairs:
+        await agent.stop()
+        await backend.stop()
+
+
+async def _get_trace(h, execution_id):
+    async with h.http.get(f"/api/v1/executions/{execution_id}/trace") as r:
+        doc = await r.json()
+        return r.status, doc
+
+
+def _names(doc):
+    return [s["name"] for s in doc["spans"]]
+
+
+@async_test
+async def test_streamed_execution_full_ordered_waterfall():
+    """The headline acceptance: a streamed execution's trace endpoint
+    returns ONE ordered waterfall covering gateway dispatch → channel
+    submit → node envelope → engine lifecycle, node spans attempt-labeled;
+    the client-visible result carries no span payload; and the heartbeat
+    pipeline turns the engine's histograms into per-node /metrics series."""
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h)
+        try:
+            frames = []
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"prompt": "trace me", "max_new_tokens": 8},
+                      "stream": True},
+            ) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    if not line.startswith(b"data: "):
+                        continue
+                    f = json.loads(line[6:])
+                    frames.append(f)
+                    if f.get("kind") in ("terminal", "dropped"):
+                        break
+            assert frames[0]["kind"] == "start"
+            eid = frames[0]["execution_id"]
+            # streaming callers learn the trace id on frame 0
+            assert frames[0]["trace_id"].startswith("tr_")
+            term = frames[-1]
+            assert term["status"] == "completed"
+            # no span payload ever reaches the client-visible result
+            assert "trace" not in (term.get("result") or {})
+
+            status, doc = await _get_trace(h, eid)
+            assert status == 200, doc
+            assert doc["trace_id"] == frames[0]["trace_id"]
+            names = _names(doc)
+            for required in (
+                "gateway.execute", "gateway.dispatch", "channel.submit",
+                "node.generate", "engine.queue_wait", "engine.prefill",
+                "engine.decode",
+            ):
+                assert required in names, (required, names)
+            assert names.count("gateway.execute") == 1
+            # ordered waterfall: ascending wall-clock start
+            t0s = [s["t0"] for s in doc["spans"]]
+            assert t0s == sorted(t0s)
+            by_name = {s["name"]: s for s in doc["spans"]}
+            assert by_name["engine.queue_wait"]["t0"] <= by_name["engine.prefill"]["t0"]
+            assert by_name["engine.prefill"]["t0"] <= by_name["engine.decode"]["t0"]
+            # node spans are stamped with the serving node + attempt
+            for n in ("engine.prefill", "engine.decode", "node.generate"):
+                assert by_name[n]["node"] == "model-tr"
+                assert by_name[n]["attempt"] == 1
+            assert by_name["gateway.dispatch"]["attrs"]["outcome"] == "deferred"
+            # the row carries the trace id too (triage starts from any doc)
+            async with h.http.get(f"/api/v1/executions/{eid}") as r2:
+                row = await r2.json()
+            assert row["trace_id"] == doc["trace_id"]
+
+            # histograms ride the heartbeat pipeline into /metrics
+            await h.cp.registry.heartbeat(
+                "model-tr", {"stats": agent.heartbeat_stats()}
+            )
+            async with h.http.get("/metrics") as r3:
+                metrics_text = await r3.text()
+            for fam in ("engine_ttft_ms", "engine_itl_ms",
+                        "engine_queue_wait_ms", "engine_tick_ms"):
+                assert f'{fam}_bucket{{le="1.0",node="model-tr"}}' in metrics_text \
+                    or f'{fam}_bucket{{node="model-tr",le="1.0"}}' in metrics_text, fam
+            # and the node-table metadata does NOT carry the histogram blob
+            node = await h.cp.db.get_node("model-tr")
+            assert "latency_hist" not in (node.metadata.get("stats") or {})
+        finally:
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_retry_failover_one_waterfall_attempt_labeled():
+    """Retry + failover: attempt 1 fails (seeded node-level fault), attempt
+    2 serves on the substitute node — ONE trace whose dispatch spans are
+    attempt-labeled per node, with the serving node's engine spans stamped
+    attempt=2."""
+    import jax
+
+    from agentfield_tpu.models import get_config, init_params
+    from agentfield_tpu.serving.model_node import build_model_node
+
+    cfg = get_config("llama-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    async with CPHarness() as h:
+        a_agent, a_back = build_model_node(
+            "node-a", h.base_url, model="llama-tiny", params=params, ecfg=_ecfg()
+        )
+        b_agent, b_back = build_model_node(
+            "node-b", h.base_url, model="llama-tiny", params=params, ecfg=_ecfg()
+        )
+        for back, ag in ((a_back, a_agent), (b_back, b_agent)):
+            await back.start()
+            await ag.start()
+        h.cp.gateway.prefix_affinity = False  # deterministic pick order
+        try:
+            faults.install(
+                faults.FaultInjector(
+                    seed=5, spec={"gateway.agent_call.fail": {"times": 1}}
+                )
+            )
+            async with h.http.post(
+                "/api/v1/execute/node-a.generate",
+                json={"input": {"tokens": list(range(40, 52)),
+                                "max_new_tokens": 4}},
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert doc["attempts"] == 2 and doc["nodes_tried"] == ["node-a", "node-b"]
+            status, tr = await _get_trace(h, doc["execution_id"])
+            assert status == 200, tr
+            dispatches = [s for s in tr["spans"] if s["name"] == "gateway.dispatch"]
+            assert [(d["attrs"]["attempt"], d["attrs"]["node"], d["attrs"]["outcome"])
+                    for d in dispatches] == [
+                (1, "node-a", "node_error"),
+                (2, "node-b", "deferred"),
+            ]
+            engine_spans = [s for s in tr["spans"] if s["name"].startswith("engine.")]
+            assert engine_spans, tr["spans"]
+            assert all(
+                s["node"] == "node-b" and s["attempt"] == 2 for s in engine_spans
+            )
+            root = [s for s in tr["spans"] if s["name"] == "gateway.execute"]
+            assert len(root) == 1 and root[0]["attrs"]["attempts"] == 2
+        finally:
+            faults.install(None)
+            await _stop((a_agent, a_back), (b_agent, b_back))
+
+
+@async_test
+async def test_preempted_resumed_streamed_waterfall_and_continuity():
+    """Acceptance: a preempted-and-resumed request through the WHOLE stack
+    — park/resume spans in the endpoint's waterfall, continuous token
+    indexes on the client-visible stream."""
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h, max_batch=1, preempt_fence_ticks=4)
+        try:
+            faults.install(
+                faults.FaultInjector(
+                    seed=7, spec={"engine.preempt_storm": {"times": 1}}
+                )
+            )
+
+            frames = []
+
+            async def stream_victim():
+                async with h.http.post(
+                    "/api/v1/execute/model-tr.generate",
+                    json={"input": {"tokens": list(range(60, 76)),
+                                    "max_new_tokens": 24},
+                          "stream": True},
+                ) as r:
+                    assert r.status == 200
+                    async for line in r.content:
+                        if not line.startswith(b"data: "):
+                            continue
+                        f = json.loads(line[6:])
+                        frames.append(f)
+                        if f.get("kind") in ("terminal", "dropped"):
+                            break
+
+            task = asyncio.create_task(stream_victim())
+            # wait for the victim's first token, then offer a rival so the
+            # storm has a pending candidate to preempt for
+            for _ in range(400):
+                if any(f.get("kind") == "token" for f in frames):
+                    break
+                await asyncio.sleep(0.02)
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"tokens": list(range(90, 100)),
+                                "max_new_tokens": 3}},
+            ) as r2:
+                rival = await r2.json()
+            assert rival["status"] == "completed"
+            await asyncio.wait_for(task, timeout=60)
+
+            assert backend.engine.stats["preemptions_total"] == 1
+            eid = frames[0]["execution_id"]
+            idx = [f["index"] for f in frames if f.get("kind") == "token"]
+            assert idx == list(range(len(idx))), idx  # continuity across park
+            status, tr = await _get_trace(h, eid)
+            assert status == 200, tr
+            names = _names(tr)
+            assert "engine.park" in names, names
+            decodes = [s for s in tr["spans"] if s["name"] == "engine.decode"]
+            assert len(decodes) == 2
+            assert decodes[0]["attrs"]["finish"] == "preempted"
+            # park bridges the two decode segments in wall-clock order
+            park = next(s for s in tr["spans"] if s["name"] == "engine.park")
+            assert decodes[0]["t0"] <= park["t0"] <= decodes[1]["t0"]
+        finally:
+            faults.install(None)
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_branched_execution_waterfall_winner_and_pruned():
+    """Acceptance: a branched (beam) execution's waterfall shows the fork
+    topology and the pruned branches' cancelled decode segments, all under
+    the execution's one trace id."""
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h)
+        try:
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"tokens": list(range(30, 42)),
+                                "max_new_tokens": 12, "temperature": 0.8},
+                      "n_branches": 3,
+                      "branch_policy": {"type": "beam", "beam_width": 1,
+                                        "beam_interval": 3}},
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert doc["result"]["branches"]["n"] == 3
+            assert "trace" not in doc["result"]
+            status, tr = await _get_trace(h, doc["execution_id"])
+            assert status == 200, tr
+            names = _names(tr)
+            assert names.count("engine.fork") >= 2, names
+            decodes = [s for s in tr["spans"] if s["name"] == "engine.decode"]
+            finishes = [d["attrs"].get("finish") for d in decodes]
+            assert "cancelled" in finishes, finishes  # pruned branches
+            assert any(f in ("stop", "length") for f in finishes)  # winner path
+            assert names.count("gateway.execute") == 1
+        finally:
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_channel_drop_reattach_still_one_complete_waterfall():
+    """A seeded channel.drop mid-stream (reconnect + reattach) must not
+    tear or duplicate the trace: the terminal frame arrives once, spans
+    land once, the waterfall is complete."""
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h)
+        try:
+            faults.install(
+                faults.FaultInjector(
+                    seed=11, spec={"channel.drop": {"times": 1, "after": 3}}
+                )
+            )
+            frames = []
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"prompt": "drop me mid stream",
+                                "max_new_tokens": 10},
+                      "stream": True},
+            ) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    if not line.startswith(b"data: "):
+                        continue
+                    f = json.loads(line[6:])
+                    frames.append(f)
+                    if f.get("kind") in ("terminal", "dropped"):
+                        break
+            assert h.cp.metrics.counter_value("channel_reattaches_total") >= 1
+            term = [f for f in frames if f.get("kind") == "terminal"]
+            assert len(term) == 1 and term[0]["status"] == "completed"
+            eid = frames[0]["execution_id"]
+            status, tr = await _get_trace(h, eid)
+            assert status == 200, tr
+            names = _names(tr)
+            for required in ("gateway.execute", "gateway.dispatch",
+                             "node.generate", "engine.prefill", "engine.decode"):
+                assert required in names, (required, names)
+            assert names.count("engine.decode") == 1
+            assert names.count("node.generate") == 1
+        finally:
+            faults.install(None)
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_post_path_waterfall_and_result_stays_clean():
+    """Channel disabled (POST transport): node spans ride the unary result
+    and the gateway pops them — the persisted/served result never exposes
+    the span payload, and the waterfall is still complete."""
+    async with CPHarness(channel=False) as h:
+        agent, backend = await _boot_node(h)
+        try:
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"prompt": "post path", "max_new_tokens": 6}},
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            assert "trace" not in doc["result"]
+            status, tr = await _get_trace(h, doc["execution_id"])
+            assert status == 200, tr
+            names = _names(tr)
+            for required in ("gateway.execute", "gateway.dispatch",
+                             "node.generate", "engine.prefill", "engine.decode"):
+                assert required in names, (required, names)
+            assert "channel.submit" not in names  # POST transport
+            # the stored row's result is clean too (not just the response)
+            row = await h.cp.db.get_execution(doc["execution_id"])
+            assert "trace" not in (row.result or {})
+        finally:
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_tracing_off_is_bit_compatible_and_buffers_stay_empty():
+    """The tracing-off pin: no trace ids minted, no `trace` key on the
+    submit frame, the node terminal frame, the generate input, or the
+    result; the span buffer and the TraceStore stay untouched; the trace
+    endpoint answers 404."""
+    tracer = tracing.tracer()
+    try:
+        tracing.set_enabled(False)
+        async with CPHarness() as h:
+            agent, backend = await _boot_node(h)
+            try:
+                spans_before = tracer.span_count()
+                store_before = len(h.cp.gateway.traces)
+                seen_payloads = []
+                orig_invoke = agent.channel_server.invoke
+
+                async def spy_invoke(target, payload, headers):
+                    seen_payloads.append(payload)
+                    return await orig_invoke(target, payload, headers)
+
+                agent.channel_server.invoke = spy_invoke
+                emitted = []
+                orig_emit = agent.channel_server._emit
+
+                async def spy_emit(st, frame):
+                    emitted.append((st, frame))
+                    return await orig_emit(st, frame)
+
+                agent.channel_server._emit = spy_emit
+                async with h.http.post(
+                    "/api/v1/execute/model-tr.generate",
+                    json={"input": {"prompt": "dark mode", "max_new_tokens": 5}},
+                ) as r:
+                    doc = await r.json()
+                assert doc["status"] == "completed", doc
+                assert doc.get("trace_id") is None
+                assert "trace" not in doc["result"]
+                # the node-side channel exec saw no trace ctx, and its
+                # terminal frame carries no span payload
+                terms = [
+                    (st, f) for st, f in emitted if f.get("kind") == "terminal"
+                ]
+                assert terms, emitted
+                st, term_frame = terms[-1]
+                assert st.trace is None
+                assert "trace" not in term_frame
+                # the generate input carried no trace key either
+                assert seen_payloads and "trace" not in seen_payloads[0]
+                # nothing buffered anywhere
+                assert tracer.span_count() == spans_before
+                assert len(h.cp.gateway.traces) == store_before
+                assert backend.engine._traces == {}
+                status, err = await _get_trace(h, doc["execution_id"])
+                assert status == 404 and "tracing off" in err["error"]
+                # flight recorder + histograms stay ON (aggregate, no wire)
+                assert backend.engine.flight.ticks_recorded > 0
+                assert backend.engine.latency_histograms()["ttft_ms"]["count"] == 1
+            finally:
+                await _stop((agent, backend))
+    finally:
+        tracing.set_enabled(None)
+
+
+@async_test
+async def test_forged_trace_input_cannot_hijack_and_rejection_closes_root():
+    """Review hardening pins: (1) a caller-supplied `trace` input key is
+    stripped/overridden by the gateway — it can neither inject spans into
+    a victim trace id nor force span recording with tracing off; (2) the
+    async queue-full rejection (a terminal that bypasses complete()) still
+    closes and releases the open root span."""
+    from agentfield_tpu.control_plane.types import (
+        Execution,
+        ExecutionStatus,
+        TargetType,
+    )
+
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h)
+        try:
+            forged = {"prompt": "forge", "max_new_tokens": 4,
+                      "trace": {"trace_id": "tr_victim"}}
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate", json={"input": forged}
+            ) as r:
+                doc = await r.json()
+            assert doc["status"] == "completed", doc
+            # the victim trace stays empty; the execution's OWN trace works
+            assert h.cp.gateway.traces.get("tr_victim") == []
+            status, tr = await _get_trace(h, doc["execution_id"])
+            assert status == 200 and "engine.decode" in _names(tr)
+
+            tracing.set_enabled(False)
+            try:
+                async with h.http.post(
+                    "/api/v1/execute/model-tr.generate", json={"input": forged}
+                ) as r:
+                    doc2 = await r.json()
+                assert doc2["status"] == "completed", doc2
+                # the forged key was stripped, not honored: nothing recorded
+                assert h.cp.gateway.traces.get("tr_victim") == []
+                assert tracing.tracer().peek("tr_victim") == []
+            finally:
+                tracing.set_enabled(None)
+
+            # (2) queue-full 429/503 closes the root it opened in _prepare
+            g = h.cp.gateway
+            before = len(g._trace_roots)
+            old_q = g._queue
+            dummy = Execution(
+                execution_id="exec_dummy", target="x.y",
+                target_type=TargetType.REASONER,
+                status=ExecutionStatus.QUEUED, run_id="r",
+            )
+            g._queue = asyncio.Queue(maxsize=1)
+            g._queue.put_nowait(dummy)
+            try:
+                with pytest.raises(Exception):
+                    await g.execute_async("model-tr.generate", {"prompt": "q"}, {})
+            finally:
+                g._queue = old_q
+            assert len(g._trace_roots) == before
+        finally:
+            await _stop((agent, backend))
+
+
+@async_test
+async def test_load_gen_links_p99_outliers_to_trace_ids():
+    """tools/perf/load_gen: a 3-tuple execute hook (status, ttft, trace_id)
+    feeds the report's slow_traces block — the p99 outlier requests, each
+    with its trace id, slowest first (docs/OBSERVABILITY.md slow-tail
+    triage)."""
+    from tools.perf.load_gen import run_load
+
+    async def hook(i: int):
+        await asyncio.sleep(0.05 if i == 7 else 0.001)  # one clear outlier
+        return "completed", 0.001, f"tr_req{i}"
+
+    report = await run_load("", "t.x", 16, 4, "sync", execute=hook)
+    assert report["success_rate"] == 1.0
+    slow = report["slow_traces"]
+    assert slow and slow[0]["trace_id"] == "tr_req7"
+    assert slow[0]["latency_ms"] == max(s["latency_ms"] for s in slow)
+    # a hook without trace ids (legacy 2-tuple) emits no slow_traces block
+    report2 = await run_load(
+        "", "t.x", 4, 2, "sync",
+        execute=lambda i: _no_trace_hook(i),
+    )
+    assert "slow_traces" not in report2
+
+
+async def _no_trace_hook(i: int):
+    return "completed", 0.001
+
+
+@async_test
+async def test_node_debug_flight_endpoint():
+    """GET /debug/flight on the node: ring metadata + per-tick rows with
+    the documented fields; ?last bounds the dump."""
+    import aiohttp
+
+    async with CPHarness() as h:
+        agent, backend = await _boot_node(h)
+        try:
+            async with h.http.post(
+                "/api/v1/execute/model-tr.generate",
+                json={"input": {"prompt": "tick tick", "max_new_tokens": 6}},
+            ) as r:
+                assert (await r.json())["status"] == "completed"
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{agent.host}:{agent.port}/debug/flight?last=8",
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as r:
+                    doc = await r.json()
+            assert doc["node_id"] == "model-tr"
+            assert doc["max_ticks"] >= len(doc["ticks"]) > 0
+            assert len(doc["ticks"]) <= 8
+            row = doc["ticks"][-1]
+            for key in ("t", "mode", "dur_ms", "active", "pending",
+                        "free_pages", "preemptions_total"):
+                assert key in row, row
+            assert any(
+                t["mode"] in ("prefill", "mixed") for t in doc["ticks"]
+            ) or doc["ticks"][-1]["mode"] == "decode"
+        finally:
+            await _stop((agent, backend))

@@ -25,6 +25,14 @@ names like ``engine_{k}`` are runtime-enumerable only and are skipped):
    in the scanned tree; deleting the export (or renaming the counter)
    without editing the pin is a finding. The pin list is the reviewed
    inventory of the counter families tests and runbooks depend on.
+4. **spans + histograms** (ISSUE 15) — every trace span name emitted via
+   ``record_span("engine.prefill", ...)`` and every histogram name
+   (``observe("ttft_ms", v)`` / ``HistogramSet(("ttft_ms", ...))``) in the
+   scanned tree must appear in docs/*.md (docs/OBSERVABILITY.md keeps the
+   trace-anatomy and histogram-triage tables), and the load-bearing
+   families are pinned via ``require_span`` / ``require_hist`` exactly
+   like counters — silently deleting a span family a runbook walks
+   through fails the suite.
 """
 
 from __future__ import annotations
@@ -61,6 +69,9 @@ def _const_str(node: ast.AST) -> str | None:
     return None
 
 
+_SPAN_NAME_RE = re.compile(r"\A[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+
+
 class _FileFacts:
     """Counter-relevant sites in one file."""
 
@@ -71,6 +82,10 @@ class _FileFacts:
         self.registry_names: dict[str, int] = {}
         # names with an always-present init site (dict key: 0 / setdefault)
         self.inits: set[str] = set()
+        # trace span names: record_span("engine.prefill", ...) constants
+        self.span_names: dict[str, int] = {}
+        # histogram metric names: observe("x_ms", v) / HistogramSet((...))
+        self.hist_names: dict[str, int] = {}
 
 
 def _collect(f: SourceFile) -> _FileFacts:
@@ -97,6 +112,26 @@ def _collect(f: SourceFile) -> _FileFacts:
                 name = _const_str(node.args[0])
                 if name is not None:
                     facts.registry_names.setdefault(name, node.lineno)
+            elif term == "record_span" and node.args:
+                # Trace spans (docs/OBSERVABILITY.md): every span family
+                # emitted in the serving stack must be triage-documented,
+                # and the load-bearing ones are pinned (require_span).
+                name = _const_str(node.args[0])
+                if name is not None and _SPAN_NAME_RE.match(name):
+                    facts.span_names.setdefault(name, node.lineno)
+            elif term == "observe" and len(node.args) >= 2:
+                # Histogram observations (Metrics.observe / HistogramSet
+                # .observe share the verb and the contract).
+                name = _const_str(node.args[0])
+                if name is not None:
+                    facts.hist_names.setdefault(name, node.lineno)
+            elif term == "HistogramSet" and node.args:
+                # The engine's histogram family declaration: the names in
+                # the tuple ARE the heartbeat-exported metric names.
+                for e in ast.walk(node.args[0]):
+                    name = _const_str(e)
+                    if name is not None:
+                        facts.hist_names.setdefault(name, node.lineno)
             elif term == "setdefault" and node.args:
                 name = _const_str(node.args[0])
                 if name is not None:
@@ -126,9 +161,9 @@ def _collect(f: SourceFile) -> _FileFacts:
 class CounterContractPass(Pass):
     id = _ID
     description = (
-        "*_total counters and named gauges are always-present in the "
-        "stats→heartbeat→/metrics export surface, documented in a docs/ "
-        "triage table, and the pinned counter inventory still exists"
+        "*_total counters, named gauges, trace span names, and histogram "
+        "names are always-present in their export surface, documented in "
+        "a docs/ triage table, and the pinned inventory still exists"
     )
 
     def relevant(self, rel: str) -> bool:
@@ -201,18 +236,44 @@ class CounterContractPass(Pass):
                             "nonzero means) to docs/OPERATIONS.md",
                         )
                     )
+            # Trace spans + histograms (docs/OBSERVABILITY.md): same
+            # contract as counters — an undocumented span family is
+            # untriageable, and a histogram no runbook names is noise.
+            for names, what, hint in (
+                (facts.span_names, "trace span", "add a row to the trace "
+                 "anatomy table in docs/OBSERVABILITY.md"),
+                (facts.hist_names, "histogram", "add a row to the "
+                 "histogram triage table in docs/OBSERVABILITY.md"),
+            ):
+                for name, line in sorted(names.items(), key=lambda kv: kv[1]):
+                    seen_names.setdefault(name, (f.rel, line))
+                    if name not in docs and name not in doc_flagged:
+                        doc_flagged.add(name)
+                        findings.append(
+                            Finding(
+                                self.id, f.rel, line,
+                                f"{what} {name!r} is not documented in any "
+                                "docs/*.md triage table",
+                                hint=hint,
+                            )
+                        )
         allow_rel = "tools/analysis/allowlist.toml"
-        for pin in ctx.cfg(self.id).get("require", []):
-            if pin not in seen_names:
-                findings.append(
-                    Finding(
-                        self.id, allow_rel, 1,
-                        f"pinned counter {pin!r} has no increment site "
-                        "left in serving/ or control_plane/ — its export "
-                        "was deleted or renamed silently",
-                        hint="restore the counter, or remove the pin in "
-                        "the same reviewed change that removes its "
-                        "dashboards/runbook rows",
+        for key, what, where in (
+            ("require", "counter", "increment site"),
+            ("require_span", "trace span", "record_span site"),
+            ("require_hist", "histogram", "observe/HistogramSet site"),
+        ):
+            for pin in ctx.cfg(self.id).get(key, []):
+                if pin not in seen_names:
+                    findings.append(
+                        Finding(
+                            self.id, allow_rel, 1,
+                            f"pinned {what} {pin!r} has no {where} "
+                            "left in serving/ or control_plane/ — its export "
+                            "was deleted or renamed silently",
+                            hint=f"restore the {what}, or remove the pin in "
+                            "the same reviewed change that removes its "
+                            "dashboards/runbook rows",
+                        )
                     )
-                )
         return findings

@@ -49,6 +49,8 @@ _DEFAULT_ACQUIRE = (
     "adopt_host_pages",
     "_alloc_with_eviction",
     "_acquire_pages_locked",
+    "_acquire_pages_impl",  # the body behind _acquire_pages_locked (the
+    # tracing shim wraps it; both are the same sanctioned primitive)
     "_prepare_restore",
     "_restore_alloc",
 )

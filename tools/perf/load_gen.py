@@ -75,9 +75,21 @@ async def run_load(
     instead return ``(status_str, ttft_seconds | None)`` — streaming-capable
     scenarios report time-to-first-frame percentiles (``ttft_ms``)
     alongside full-completion latency, since TTFT, not completion, is the
-    latency an agent loop actually waits on."""
+    latency an agent loop actually waits on — or a 3-tuple
+    ``(status, ttft, trace_id)`` to feed the slow-tail linkage below.
+
+    Slow-tail linkage (docs/OBSERVABILITY.md): when trace ids are known
+    (the HTTP sync path reads ``trace_id`` off the execution document; an
+    in-process hook returns it), the report's ``slow_traces`` block lists
+    the p99-outlier requests WITH their trace ids, so triage starts from
+    the artifact: paste the id into
+    ``GET /api/v1/executions/{id}/trace`` while the gateway's TraceStore
+    still retains it."""
     latencies: list[float] = []
     ttfts: list[float] = []
+    # (latency_s, trace_id) per completed request — trace_id may be None
+    # (tracing off / non-trace-aware hook); feeds the slow_traces block.
+    records: list[tuple[float, str | None]] = []
     statuses: dict[str, int] = {}
     http_errors: dict[str, int] = {}
     sem = asyncio.Semaphore(concurrency)
@@ -99,11 +111,15 @@ async def run_load(
                 # whenever the event loop got around to sending: missed
                 # schedule IS queueing delay the client experienced.
                 t0 = t_start + i / qps
+            trace_id = None
             try:
                 if execute is not None:
                     status = await execute(i)
                     if isinstance(status, tuple):
-                        status, ttft = status
+                        if len(status) == 3:
+                            status, ttft, trace_id = status
+                        else:
+                            status, ttft = status
                         if ttft is not None:
                             ttfts.append(ttft)
                 elif mode == "sync":
@@ -112,6 +128,7 @@ async def run_load(
                     ) as resp:
                         doc = await resp.json()
                         status = doc.get("status", f"http_{resp.status}")
+                        trace_id = doc.get("trace_id")
                 else:
                     async with session.post(
                         f"{url}/api/v1/execute/async/{target}", json={"input": payload}
@@ -122,7 +139,9 @@ async def run_load(
                             eid = (await resp.json())["execution_id"]
                             status = await _poll(session, url, eid, timeout)
                 statuses[status] = statuses.get(status, 0) + 1
-                latencies.append(time.perf_counter() - t0)
+                lat = time.perf_counter() - t0
+                latencies.append(lat)
+                records.append((lat, trace_id))
             except Exception as e:
                 http_errors[type(e).__name__] = http_errors.get(type(e).__name__, 0) + 1
 
@@ -165,6 +184,18 @@ async def run_load(
             "p99": round(percentile(ttfts, 99) * 1e3, 1),
             "samples": len(ttfts),
         }
+    if any(tid for _, tid in records):
+        # Slow-tail linkage: the requests AT or above the p99 latency, each
+        # with its trace id — triage starts from this artifact
+        # (docs/OBSERVABILITY.md "Slow-tail triage").
+        p99 = percentile(latencies, 99)
+        outliers = sorted(
+            (r for r in records if r[0] >= p99), key=lambda r: -r[0]
+        )[:10]
+        report["slow_traces"] = [
+            {"latency_ms": round(lat * 1e3, 1), "trace_id": tid}
+            for lat, tid in outliers
+        ]
     return report
 
 

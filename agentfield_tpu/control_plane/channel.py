@@ -42,7 +42,11 @@ kind                dir     meaning
                             node whose sketch advertised the pages) +
                             ``chains`` (hex chain hashes); the gateway relays
                             it gw→node to the peer (``peer`` stripped), which
-                            serves it from its prefix index
+                            serves it from its prefix index. An optional
+                            ``handoff`` id (disaggregated pools, phase 2)
+                            additionally pulls the peer's stashed live tail
+                            page for that handoff — its page descriptor
+                            carries ``handoff`` instead of ``chain``
 ``kv_pages``        both    the peer's response METADATA: ``fetch_id``-
                             correlated, seq-framed page descriptors
                             (chain/depth/leaf dtypes+shapes/segment byte
@@ -506,6 +510,7 @@ class ChannelServer:
         chains_hex: list[str],
         timeout_s: float = 5.0,
         max_bytes: int | None = None,
+        handoff: str | None = None,
     ) -> list[dict] | None:
         """Request serialized KV pages from `peer_node_id` through the
         gateway relay, over THIS node's live channel connection. Returns
@@ -514,8 +519,14 @@ class ChannelServer:
         pages than asked — best effort), or None when no connection
         exists, the relay/peer failed, or `timeout_s` expired. Strictly
         best-effort by design: every failure mode degrades to a local
-        re-prefill on the caller's side."""
-        if not self._conns or not chains_hex:
+        re-prefill on the caller's side.
+
+        ``handoff`` (disaggregated pools, phase 2) also requests the
+        peer's stashed live tail page for that handoff id; its descriptor
+        comes back with ``handoff`` instead of ``chain``. A handoff fetch
+        with zero missing chain pages (short prompt fully cached locally)
+        is still sent — the tail is the whole point."""
+        if not self._conns or not (chains_hex or handoff):
             return None
         conn = next(iter(self._conns))
         self._kv_next_id += 1
@@ -523,15 +534,16 @@ class ChannelServer:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._kv_waiters[fid] = _KvWaiter(fut)
         try:
-            ok = await conn.send(
-                {
-                    "kind": "kv_fetch",
-                    "fetch_id": fid,
-                    "peer": peer_node_id,
-                    "chains": chains_hex[:_KV_FETCH_MAX_CHAINS],
-                    "max_bytes": int(max_bytes or _KV_FETCH_MAX_BYTES),
-                }
-            )
+            frame = {
+                "kind": "kv_fetch",
+                "fetch_id": fid,
+                "peer": peer_node_id,
+                "chains": chains_hex[:_KV_FETCH_MAX_CHAINS],
+                "max_bytes": int(max_bytes or _KV_FETCH_MAX_BYTES),
+            }
+            if handoff is not None:
+                frame["handoff"] = handoff
+            ok = await conn.send(frame)
             if not ok:
                 return None
             async with aio_timeout(timeout_s):
@@ -615,6 +627,9 @@ class ChannelServer:
         token-exact, zero leaked pages."""
         fid = frame.get("fetch_id", "")
         chains = frame.get("chains") or []
+        handoff = frame.get("handoff")
+        if not isinstance(handoff, str):
+            handoff = None
         max_bytes = min(
             int(frame.get("max_bytes") or _KV_FETCH_MAX_BYTES), _KV_FETCH_MAX_BYTES
         )
@@ -629,6 +644,15 @@ class ChannelServer:
         f = faults.fire("kv.fetch_stall")
         if f is not None and f.delay_s > 0:
             await asyncio.sleep(f.delay_s)
+        if handoff is not None:
+            # Disaggregated pools: a stalled handoff transfer must degrade
+            # like a stalled prefix fetch — the decode node's wait times
+            # out and admission falls back to prefilling from whatever
+            # prefix it adopted (token-exact; the prefill node's published
+            # pages stay reusable, its stash expires by TTL).
+            f = faults.fire("kv.handoff_stall")
+            if f is not None and f.delay_s > 0:
+                await asyncio.sleep(f.delay_s)
         f = faults.fire("kv.fetch_fail")
         if f is not None:
             await fail(f.error)
@@ -637,10 +661,15 @@ class ChannelServer:
             await fail("node serves no KV export")
             return
         try:
-            pages = await self._kv_export(
-                [c for c in chains[:_KV_FETCH_MAX_CHAINS] if isinstance(c, str)],
-                max_bytes,
-            )
+            chains_clean = [
+                c for c in chains[:_KV_FETCH_MAX_CHAINS] if isinstance(c, str)
+            ]
+            if handoff is not None:
+                # 3rd positional only when present: pre-handoff exporters
+                # (2-arg test doubles) keep working for plain fetches
+                pages = await self._kv_export(chains_clean, max_bytes, handoff)
+            else:
+                pages = await self._kv_export(chains_clean, max_bytes)
         except Exception as e:
             await fail(f"kv export failed: {e!r}")
             return
@@ -1431,6 +1460,10 @@ class ChannelManager:
                 _KV_FETCH_MAX_BYTES,
             ),
         }
+        if isinstance(frame.get("handoff"), str):
+            # disaggregated pools: the handoff id rides the relay so the
+            # serving peer can attach its stashed live tail page
+            relayed["handoff"] = frame["handoff"]
         try:
             await (await self._chan_for(node))._send(relayed)
         except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:

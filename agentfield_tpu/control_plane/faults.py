@@ -58,6 +58,18 @@ Injection points in-tree:
                                answering a kv_fetch — the requester's fetch
                                timeout expires and it re-prefills locally; a
                                late response is discarded by fetch_id
+``kv.handoff_fail``            the prefill node's handoff export is vetoed at
+                               decision time (consulted once per eligible
+                               prefill) — the slot simply keeps decoding
+                               locally: single-node prefill+decode, token-
+                               exact, zero pages leaked on either node
+``kv.handoff_stall``           the serving node stalls ``delay_s`` before
+                               answering a kv_fetch that carries a handoff
+                               tail — the decode node's fetch times out, it
+                               adopts nothing and re-prefills the whole
+                               prompt locally (greedy re-samples the same
+                               first token); the stale tail stash expires
+                               by TTL, zero pages leaked
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -91,6 +103,8 @@ KNOWN_POINTS = (
     "kv.restore_fail",
     "kv.fetch_fail",
     "kv.fetch_stall",
+    "kv.handoff_fail",
+    "kv.handoff_stall",
 )
 
 

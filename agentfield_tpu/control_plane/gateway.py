@@ -246,6 +246,19 @@ class ExecutionGateway:
         # injected into the generate input by _agent_input, dropped when the
         # dispatch loop exits.
         self._kv_hints: dict[str, dict] = {}
+        # Disaggregated prefill/decode pools (docs/ARCHITECTURE.md
+        # "Two-phase dispatch"): execution_id → phase state.
+        #   {"phase": 1, "prefill_node": id}           — dispatched to the
+        #       prefill pool with handoff_export set; the terminal
+        #       interceptors watch for the handoff descriptor
+        #   {"phase": 2, "prefill_node", "desc", "t0w", "t0m"} — re-dispatch
+        #       to the decode pool with the descriptor + kv_peer hint; t0*
+        #       anchor the gateway.handoff span (phase-1 terminal →
+        #       phase-2 accepted)
+        # Entries are dropped on EVERY terminal/fallback path; a mixed-only
+        # fleet never creates one (bit-compatible dispatch, pinned).
+        self._handoff: dict[str, dict] = {}
+        self._handoff_rr = 0  # round-robin cursor over the decode pool
         # Strong refs for stream-execute driver tasks (loop tasks are weakly
         # held; a GC'd driver would strand a prepared execution).
         self._stream_drivers: set[asyncio.Task] = set()
@@ -483,11 +496,18 @@ class ExecutionGateway:
             # (and starts a cooldown), so a broken channel endpoint degrades
             # to pre-channel behavior instead of failing dispatch.
             try:
+                ho = self._handoff.get(ex.execution_id)
+                wants_stream = self.streams.wants(ex.execution_id)
+                if ho is not None and ho.get("phase") == 1:
+                    # Phase 1 is always unary: its only client-relevant
+                    # outcome is the handoff descriptor (one discarded
+                    # token otherwise) — token frames start with phase 2.
+                    wants_stream = False
                 t0w, t0m = time.time(), time.perf_counter()
                 out = await self.channels.submit(
                     node, ex.execution_id, ex.target.split(".", 1)[1],
                     agent_input, headers,
-                    stream=self.streams.wants(ex.execution_id),
+                    stream=wants_stream,
                     trace=trace_ctx,
                 )
                 self.traces.record_span(
@@ -566,6 +586,7 @@ class ExecutionGateway:
             if hint is not None and hint.get("node_id") == node.node_id:
                 hint = None
             branched = ex.n_branches > 1
+            ho = self._handoff.get(ex.execution_id)
             if (
                 ex.priority
                 or ex.deadline_s is not None
@@ -573,6 +594,7 @@ class ExecutionGateway:
                 or branched
                 or trace is not None
                 or "trace" in agent_input
+                or ho is not None
             ):
                 agent_input = dict(agent_input)
                 if ex.priority:
@@ -593,6 +615,18 @@ class ExecutionGateway:
                 agent_input.pop("trace", None)
                 if trace is not None:
                     agent_input["trace"] = trace
+                # Two-phase dispatch keys are gateway-owned (plain assign +
+                # unconditional strip, same hygiene as "trace"): a caller
+                # injecting handoff_export would burn a dispatch on a
+                # 1-token stub, and a forged handoff descriptor could adopt
+                # foreign KV into its slot.
+                agent_input.pop("handoff_export", None)
+                agent_input.pop("handoff", None)
+                if ho is not None:
+                    if ho.get("phase") == 1:
+                        agent_input["handoff_export"] = True
+                    elif isinstance(ho.get("desc"), dict):
+                        agent_input["handoff"] = ho["desc"]
                 if branched:
                     # Branch decoding rides THROUGH dispatch like priority/
                     # deadline: the engine forks KV after one prefill and
@@ -645,8 +679,18 @@ class ExecutionGateway:
             # the result body instead; popped before persistence.
             self._harvest_trace(result.pop("trace"))
         if frame.get("status") == "completed":
+            ho = self._handoff.get(execution_id)
+            if ho is not None and ho.get("phase") == 1:
+                # Disaggregated pools: a phase-1 terminal either carries the
+                # handoff descriptor (re-dispatch phase 2; this stub result
+                # is discarded) or the prefill node's full single-node
+                # answer (export declined — complete with it below).
+                if await self._handoff_resume(execution_id, result):
+                    return
             await self.complete(execution_id, result=result)
         else:
+            if self._handoff.pop(execution_id, None) is not None:
+                self.metrics.inc("gateway_handoff_fallback_total")
             await self.complete(
                 execution_id, error=frame.get("error") or "agent reported failure"
             )
@@ -661,6 +705,10 @@ class ExecutionGateway:
         normal retry/failover, exactly like an orphan of a dead node. Any
         delivered frame forbids replay (duplicated tokens); dead-letter with
         the count recorded for operator triage."""
+        if self._handoff.pop(execution_id, None) is not None:
+            # Node died mid-handoff (either phase); the requeue/dead-letter
+            # below degrades the execution to plain single-node dispatch.
+            self.metrics.inc("gateway_handoff_fallback_total")
         if frames_delivered > 0:
             self.metrics.inc("channel_midstream_dead_letter_total")
             await self.complete(
@@ -723,6 +771,170 @@ class ExecutionGateway:
         own_model = (own.metadata or {}).get("model") if own is not None else None
         if own_model is not None and cand.metadata.get("model") != own_model:
             return False
+        return True
+
+    # -- disaggregated prefill/decode pools (docs/ARCHITECTURE.md
+    # "Two-phase dispatch") --------------------------------------------
+
+    @staticmethod
+    def _node_role(node: AgentNode) -> str:
+        """The node's advertised pool role. Absent/unknown is "mixed" —
+        the bit-compatible default: a role-less fleet must dispatch
+        exactly like the pre-pools gateway (pinned by test)."""
+        role = (node.metadata or {}).get("role")
+        return role if role in ("prefill", "decode") else "mixed"
+
+    def _handoff_eligible(self, ex: Execution) -> bool:
+        """Can this execution ride two-phase dispatch? Token-prompt
+        model-generate work only (the same shape the cluster prefix tier
+        can transfer), single-branch, session-less (session KV pins work
+        to one node), text-only. Ineligible work takes the normal path —
+        and the ENGINE independently declines ineligible exports, so this
+        is routing policy, not the safety net."""
+        if ex.target.split(".", 1)[1] != "generate":
+            return False
+        if ex.n_branches > 1 or ex.session_id is not None:
+            return False
+        inp = ex.input
+        if not isinstance(inp, dict):
+            return False
+        toks = inp.get("tokens")
+        if not isinstance(toks, list) or len(toks) < 2:
+            return False
+        if any(
+            inp.get(k)
+            for k in ("images", "audios", "response_schema", "session_id")
+        ):
+            return False
+        return inp.get("n_branches") in (None, 0, 1)
+
+    def _handoff_transition(
+        self, ex: Execution, node: AgentNode, result: Any
+    ) -> bool:
+        """Classify a phase-1 terminal. True → the caller must re-dispatch
+        (phase 2 normally; a plain re-run when the descriptor is missing —
+        a 1-token phase-1 result must never complete the execution). False
+        → the result is terminal as-is: the prefill node declined the
+        export (engine-side ineligibility) and decoded the whole request
+        itself, which IS the single-node degradation contract."""
+        ho = self._handoff.get(ex.execution_id)
+        if ho is None or ho.get("phase") != 1:
+            return False
+        if not (
+            isinstance(result, dict)
+            and result.get("finish_reason") == "handoff"
+        ):
+            self._handoff.pop(ex.execution_id, None)
+            self.metrics.inc("gateway_handoff_fallback_total")
+            return False
+        desc = result.get("handoff")
+        if not (
+            isinstance(desc, dict)
+            and isinstance(desc.get("id"), str)
+            and isinstance(desc.get("pages"), int)
+            and isinstance(desc.get("page_size"), int)
+        ):
+            # handoff terminal without a usable descriptor (stash expired/
+            # evicted): re-dispatch plain — the pages the prefill published
+            # make the re-run a cached prefill, token-exact under greedy
+            self._handoff.pop(ex.execution_id, None)
+            self.metrics.inc("gateway_handoff_fallback_total")
+            return True
+        self._handoff[ex.execution_id] = {
+            "phase": 2,
+            "prefill_node": node.node_id,
+            "desc": desc,
+            "t0w": time.time(),
+            "t0m": time.perf_counter(),
+        }
+        return True
+
+    def _pick_decode_node(
+        self,
+        ex: Execution,
+        tried: set[str],
+        candidates: list[AgentNode],
+        ho: dict,
+    ) -> AgentNode | None:
+        """Phase-2 target selection: a decode-pool node (mixed as backup),
+        round-robined so a steady handoff stream spreads over the pool, and
+        never the prefill node itself; sets the kv_peer hint that pulls the
+        whole prompt's pages PLUS the live tail from the prefill node. An
+        empty or fully-failed decode pool degrades to single-node execution
+        on the prefill node — its published pages make the re-run a cached
+        prefill that re-samples the first token identically under greedy."""
+        self._kv_hints.pop(ex.execution_id, None)
+        pnode = ho.get("prefill_node")
+        desc = ho.get("desc") or {}
+        pool = [
+            n for n in candidates
+            if self._node_role(n) == "decode" and n.node_id != pnode
+        ] or [
+            n for n in candidates
+            if self._node_role(n) == "mixed" and n.node_id != pnode
+        ]
+        if pool:
+            self._handoff_rr = (self._handoff_rr + 1) % len(pool)
+            pool = pool[self._handoff_rr:] + pool[: self._handoff_rr]
+        picked = next(
+            (n for n in pool if n.node_id not in tried),
+            pool[0] if pool else None,
+        )
+        if picked is not None:
+            self._kv_hints[ex.execution_id] = {
+                "node_id": pnode,
+                "pages": desc.get("pages"),
+                "page_size": desc.get("page_size"),
+                "handoff": desc.get("id"),
+            }
+            return picked
+        self._handoff.pop(ex.execution_id, None)
+        self.metrics.inc("gateway_handoff_fallback_total")
+        return next(
+            (n for n in candidates if n.node_id == pnode),
+            next(
+                (n for n in candidates if n.node_id not in tried),
+                candidates[0] if candidates else None,
+            ),
+        )
+
+    async def _handoff_resume(self, execution_id: str, result: Any) -> bool:
+        """Channel-path phase transition: the phase-1 terminal frame
+        arrives outside the _dispatch loop (channel submits return
+        deferred), so dispatch is re-entered from here for phase 2 — as a
+        task, because a POST-path decode node would otherwise block the
+        channel receive loop for the whole decode. Returns True when the
+        re-dispatch owns completion (the phase-1 result is discarded),
+        False when the caller should complete with the result it has."""
+        ex = await self.db.get_execution(execution_id)
+        if (
+            ex is None
+            or ex.status.terminal
+            or execution_id in self._dispatching
+        ):
+            self._handoff.pop(execution_id, None)
+            return False
+        node_id = (self._handoff.get(execution_id) or {}).get("prefill_node")
+        node = await self._node_get(node_id) if node_id else None
+        if node is None:
+            # Prefill node vanished between terminal and resume: phase 2
+            # cannot pull from it. A non-stub result completes as-is (the
+            # node declined and decoded single-node); a handoff stub must
+            # re-dispatch plain instead of completing with 1 token.
+            self._handoff.pop(execution_id, None)
+            self.metrics.inc("gateway_handoff_fallback_total")
+            if not (
+                isinstance(result, dict)
+                and result.get("finish_reason") == "handoff"
+            ):
+                return False
+        elif not self._handoff_transition(ex, node, result):
+            return False
+        ex.attempts = max(0, ex.attempts - 1)  # the phase switch (or the
+        # descriptor-less re-run) costs no retry budget
+        t = asyncio.ensure_future(self._dispatch(ex))
+        self._bg_completions.add(t)
+        t.add_done_callback(self._bg_completions.discard)
         return True
 
     def _affinity_tokens(self, ex: Execution) -> list | None:
@@ -850,6 +1062,36 @@ class ExecutionGateway:
                 continue
             if self._capable_substitute(node, comp, own):
                 candidates.append(node)
+        # Disaggregated pools: role-aware routing only engages when the
+        # candidate set actually contains a prefill-role node — a mixed
+        # fleet takes the unmodified path below, bit-for-bit.
+        ho = self._handoff.get(ex.execution_id)
+        if ho is not None and ho.get("phase") == 2:
+            return self._pick_decode_node(ex, tried, candidates, ho)
+        phase1 = False
+        roles = {n.node_id: self._node_role(n) for n in candidates}
+        if any(r == "prefill" for r in roles.values()):
+            if (
+                (ho is None or ho.get("phase") == 1)
+                and any(r == "decode" for r in roles.values())
+                and self._handoff_eligible(ex)
+            ):
+                # phase 1: the prefill pool owns the long-prompt work
+                candidates = [
+                    n for n in candidates if roles[n.node_id] == "prefill"
+                ]
+                phase1 = True
+            else:
+                # ineligible work in a role-split fleet keeps OFF the
+                # prefill pool (that is the pool's whole point: prefill
+                # bursts must not inflate anyone's decode ITL) — unless
+                # nothing else can serve
+                self._handoff.pop(ex.execution_id, None)
+                others = [
+                    n for n in candidates if roles[n.node_id] != "prefill"
+                ]
+                if others:
+                    candidates = others
         candidates, expected, best = self._affinity_order(ex, candidates)
         picked = next(
             (n for n in candidates if n.node_id not in tried),
@@ -877,6 +1119,11 @@ class ExecutionGateway:
                     "pages": best_pages,
                     "page_size": best_ps,
                 }
+        if phase1 and picked is not None:
+            self._handoff[ex.execution_id] = {
+                "phase": 1,
+                "prefill_node": picked.node_id,
+            }
         return picked
 
     async def _dispatch(
@@ -915,6 +1162,8 @@ class ExecutionGateway:
                 cur.nodes_tried = ex.nodes_tried
                 await self.db.update_execution(cur)
 
+        keep_handoff = False  # deferred channel submits keep phase-1 state
+        # alive for the terminal interceptor; every other exit drops it
         try:
             last_err = "no capable active node"
             while ex.attempts < policy.max_attempts:
@@ -943,7 +1192,32 @@ class ExecutionGateway:
                         "outcome": outcome,
                     },
                 )
+                ho = self._handoff.get(ex.execution_id)
+                if (
+                    ho is not None
+                    and ho.get("phase") == 2
+                    and outcome in ("completed", "deferred")
+                ):
+                    # Phase 2 accepted: close the cross-node handoff span
+                    # (phase-1 terminal → phase-2 accepted) and drop the
+                    # state — completion is ordinary from here on.
+                    self.traces.record_span(
+                        "gateway.handoff", ex.trace_id, ho["t0w"],
+                        (time.perf_counter() - ho["t0m"]) * 1e3,
+                        {
+                            "prefill_node": ho.get("prefill_node"),
+                            "decode_node": node.node_id,
+                        },
+                    )
+                    self._handoff.pop(ex.execution_id, None)
                 if outcome == "completed":
+                    if self._handoff_transition(ex, node, data):
+                        # phase-1 terminal on the POST path: discard the
+                        # stub result, re-enter selection for phase 2 (or a
+                        # plain re-run). The phase switch costs no budget.
+                        ex.attempts -= 1
+                        node = None
+                        continue
                     return await self.complete(
                         ex.execution_id,
                         result=data,
@@ -952,6 +1226,7 @@ class ExecutionGateway:
                     )
                 if outcome == "deferred":
                     await persist_attempts()
+                    keep_handoff = True
                     return None
                 if outcome == "fatal":
                     return await self.complete(
@@ -961,6 +1236,12 @@ class ExecutionGateway:
                         nodes_tried=ex.nodes_tried,
                     )
                 # node_error — retryable
+                if self._handoff.pop(ex.execution_id, None) is not None:
+                    # Mid-handoff node failure (either phase): degrade to a
+                    # plain single-node retry. The prefill node's published
+                    # pages make a re-run cheap, its tail stash expires by
+                    # TTL — zero leaked pages on both nodes.
+                    self.metrics.inc("gateway_handoff_fallback_total")
                 last_err = data
                 tried.add(node.node_id)
                 self.metrics.inc("gateway_retries_total")
@@ -1016,6 +1297,8 @@ class ExecutionGateway:
         finally:
             self._dispatching.discard(ex.execution_id)
             self._kv_hints.pop(ex.execution_id, None)
+            if not keep_handoff:
+                self._handoff.pop(ex.execution_id, None)
 
     # ------------------------------------------------------------------
 

@@ -437,6 +437,7 @@ class NodeRegistry:
         (reference: PresenceManager.checkExpirations, presence_manager.go:113)."""
         t = at or now()
         marked = evicted = active = 0
+        by_role = {"prefill": 0, "decode": 0, "mixed": 0}
         for node in await self.db.list_nodes():  # single pass; gauge derived inline
             age = t - node.last_heartbeat
             if age > self.evict_after:
@@ -450,7 +451,13 @@ class NodeRegistry:
                 marked += 1
             elif node.status == NodeStatus.ACTIVE:
                 active += 1
+                role = str((node.metadata or {}).get("role") or "mixed")
+                by_role[role if role in by_role else "mixed"] += 1
         self.metrics.set_gauge("nodes_active", active)
+        for role, n in by_role.items():
+            # Always publish all three roles (zeros included) so operators can
+            # alert on "decode pool empty" without absent-series ambiguity.
+            self.metrics.set_gauge("nodes_by_role", float(n), labels={"role": role})
         return {"marked_inactive": marked, "evicted": evicted}
 
     async def _sweep_loop(self) -> None:

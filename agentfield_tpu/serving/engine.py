@@ -249,6 +249,15 @@ class EngineConfig:
         return min(b, self.mixed_step_budget)
 
 
+# Live-slot handoff stash bounds (disaggregated pools): how long a phase-1
+# export waits for the decode node's tail fetch (and an adopted tail waits
+# for its phase-2 admission) before aging out, and how many entries either
+# stash may hold. Each entry pins one host page copy (~page bytes), so the
+# cap bounds handoff memory at ~64 pages even under a stuck decode pool.
+_HANDOFF_TTL_S = 60.0
+_HANDOFF_STASH_MAX = 64
+
+
 @dataclasses.dataclass
 class Request:
     id: str
@@ -319,6 +328,23 @@ class Request:
     # tracing.valid_context) records nothing — the untraced hot path costs
     # one dict miss per event.
     trace: Any = None
+    # Disaggregated prefill/decode pools (docs/ARCHITECTURE.md "Two-phase
+    # dispatch"). handoff_export=True: this node is PHASE ONE — prefill,
+    # sample the first token, publish the prompt's full pages into the
+    # prefix index, stash the partial tail page + sampler state for export,
+    # and emit ONE terminal event (finish_reason="handoff") instead of
+    # decoding. Ineligible requests (grammar/mm/branched, too-short prompt,
+    # first token already terminal, shared-prefix cache off) silently fall
+    # through to ordinary single-node prefill+decode — the degradation
+    # contract every failure mode shares.
+    handoff_export: bool = False
+    # PHASE TWO marker: the descriptor the phase-1 node returned
+    # ({"id", "t0", "logprob", "prompt_tokens", "pages", "page_size"}).
+    # When the adopted tail payload for desc["id"] is present and the prefix
+    # walk matched every full prompt page, admission installs the slot LIVE
+    # (zero prefill, first token = t0); otherwise the request admits
+    # normally and greedy re-samples the same t0 — token-exact fallback.
+    handoff: dict | None = None
 
 
 @dataclasses.dataclass
@@ -1294,6 +1320,30 @@ class InferenceEngine:
             # policy (their pages freed through the request_cancel path)
             "branch_verifier_calls_total": 0,  # group resolutions scored by
             # a control-plane verifier reasoner instead of logprob sum
+            # Disaggregated prefill/decode pools (docs/OPERATIONS.md
+            # "Disaggregated pools") — always present so the stats→
+            # heartbeat→/metrics pipeline carries the family even on
+            # mixed-only fleets that never hand off:
+            "kv_handoff_initiated_total": 0,  # phase-1 prefills that ended
+            # in a handoff terminal (tail + sampler state stashed for export)
+            "kv_handoff_completed_total": 0,  # phase-2 admissions installed
+            # LIVE from an adopted tail (zero prefill on the decode node)
+            "kv_handoff_failed_total": 0,  # handoff attempts that degraded
+            # to ordinary single-node prefill+decode — export declined,
+            # tail fetch/adopt failed, or the prefix walk fell short; the
+            # request still completes token-exact, this counts the fallback
+            "kv_handoff_bytes_total": 0,  # raw tail-payload bytes served
+            # by the phase-1 node (the wire cost of live-slot handoff)
+            # failed_total split by cause, the first question a fallback
+            # spike raises (docs/OPERATIONS.md "Disaggregated pools"):
+            "kv_handoff_fail_walk_total": 0,  # prefix walk fell short of
+            # the full prompt (adoption missing/evicted, restore declined)
+            "kv_handoff_fail_stash_total": 0,  # tail payload absent or
+            # aged out of the inbound stash at admission time
+            "kv_handoff_fail_upload_total": 0,  # tail-page device upload
+            # raised — pool donated mid-install or backend error
+            "kv_handoff_fail_export_total": 0,  # phase-1 export declined
+            # (ineligible request, injected fault, D2H capture failure)
         }
         # Cross-request sharing rides on the session prefix-cache switch: one
         # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
@@ -1317,6 +1367,17 @@ class InferenceEngine:
         # re-hashing long prompts each tick would tax the decode loop.
         # Entries drop at admission/cancel.
         self._req_hashes: dict[str, list[bytes]] = {}
+        # Live-slot handoff stashes (docs/ARCHITECTURE.md "Two-phase
+        # dispatch"). _handoff_out: phase-1 exports awaiting the decode
+        # node's tail fetch — request id → (expiry, descriptor, host tail
+        # payload). _handoff_in: adopted tail payloads awaiting their
+        # phase-2 admission — handoff id → (expiry, payload). Both are
+        # TTL-bounded and size-capped so an orphaned entry (decode pool
+        # died mid-handoff, phase-2 shed from the queue) ages out instead
+        # of pinning host page copies forever; an aged-out entry just
+        # means the other side re-prefills, token-exact.
+        self._handoff_out: dict[str, tuple[float, dict, Any]] = {}  # guarded by: _session_lock
+        self._handoff_in: dict[str, tuple[float, Any]] = {}  # guarded by: _session_lock
         B, maxp = self.ecfg.max_batch, self.ecfg.max_pages_per_seq
         self.page_tables = np.zeros((B, maxp), np.int32)
         self.seq_lens = np.zeros((B,), np.int32)
@@ -1406,10 +1467,17 @@ class InferenceEngine:
             # the pool's host store and restore at admission exactly like a
             # demoted page would (docs/PREFIX_CACHING.md "Cluster tier").
             # The budget is a transfer staging buffer, not a cache — sized
-            # to a few in-flight prefixes.
+            # to TWO admission windows of full prefixes (floor 32 pages):
+            # under a disaggregated phase-2 burst every queued request
+            # adopts its whole prompt before ANY of them admits, and an
+            # undersized buffer evicts the oldest adoption before its
+            # owner reaches the prefix walk — a silent full re-prefill.
             page_bytes = self.kv_page_bytes
+            staging_pages = max(
+                32, 2 * self.ecfg.max_batch * self.ecfg.max_pages_per_seq
+            )
             self.allocator.enable_restore(
-                budget_bytes=32 * page_bytes,
+                budget_bytes=staging_pages * page_bytes,
                 page_bytes=page_bytes,
                 upload=self._upload_page_kv,
                 restore_alloc=lambda: self._alloc_with_eviction(1),
@@ -1544,6 +1612,14 @@ class InferenceEngine:
             raise ValueError(
                 f"request {req.id}: n_branches > 1 is incompatible with "
                 "grammar-constrained or multimodal requests"
+            )
+        if req.handoff is not None and not isinstance(req.handoff, dict):
+            # Anything else about a malformed descriptor (wrong page_size,
+            # wrong prompt length, unknown id) degrades at admission to a
+            # normal token-exact prefill — only the type is load-bearing.
+            raise ValueError(
+                f"request {req.id}: handoff must be a descriptor dict "
+                f"(got {type(req.handoff).__name__})"
             )
         if type(req.priority) is not int:  # bool included: True < 2 would
             # "work" but a flag is never a tier — and a non-int raising
@@ -2113,8 +2189,15 @@ class InferenceEngine:
             )
             # Branched requests take the single path: the fork needs the
             # last-prompt-token logits, which the batched prefill's padded
-            # multi-row form does not keep per-request.
-            chunked = chunked or req.n_branches > 1
+            # multi-row form does not keep per-request. Handoff phases do
+            # too: export needs those logits, adoption installs live with
+            # no prefill — both are _admit_single features.
+            chunked = (
+                chunked
+                or req.n_branches > 1
+                or req.handoff is not None
+                or req.handoff_export
+            )
             with self._session_lock:
                 # one hold covers both probes: the has_sess membership test
                 # races gc_sessions/free_session on other threads otherwise
@@ -2425,6 +2508,14 @@ class InferenceEngine:
         if acq is None:
             return []  # page-starved; decode will free pages
         pages, start, kind = acq
+        if req.handoff is not None:
+            live = self._try_handoff_install(req, free_slot, pages, start, kind)
+            if live is not None:
+                return live
+            # Shortfall (walk fell short, tail aged out, upload failed):
+            # fall through to the ordinary suffix prefill below, which
+            # re-samples the same first token under greedy — token-exact.
+            self.stats["kv_handoff_failed_total"] += 1
         self._dequeue_acquired(req, kind, start)
         row = build_page_table(pages, self.ecfg.max_pages_per_seq)
         if req.mm_embeds:
@@ -2458,6 +2549,15 @@ class InferenceEngine:
         )
         tok = int(tok_arr[0])
         first_logprob = float(jax.nn.log_softmax(last_logits)[tok])
+        if req.handoff_export:
+            ev = self._try_handoff_export(req, pages, tok, first_logprob)
+            if ev is not None:
+                return [ev]
+            # Export declined (ineligible request, injected fault, D2H
+            # failure): decode locally — single-node prefill+decode on the
+            # would-be prefill node is the degradation contract.
+            self.stats["kv_handoff_failed_total"] += 1
+            self.stats["kv_handoff_fail_export_total"] += 1
         if req.n_branches <= 1:
             return [self._install(req, slot_idx, pages, row, tok, first_logprob)]
         # Branch fork (docs/PREFIX_CACHING.md "Fork / COW branches").
@@ -2578,10 +2678,28 @@ class InferenceEngine:
         stays stale, which can only lower speculative acceptance (the
         verify forward reads the target cache — emitted tokens are exact)."""
         sl = lambda a: a[:, page]  # noqa: E731
-        return (
-            jax.tree.map(sl, self.cache.k_pages),
-            jax.tree.map(sl, self.cache.v_pages),
-        )
+        for _ in range(1000):
+            try:
+                return (
+                    jax.tree.map(sl, self.cache.k_pages),
+                    jax.tree.map(sl, self.cache.v_pages),
+                )
+            except RuntimeError:
+                # Lost the donation race: a concurrent donating dispatch on
+                # the worker thread deleted the pool buffer between our
+                # attribute read and the slice (the worker reassigns the new
+                # buffers WITHOUT _session_lock — so waiting here cannot
+                # deadlock). Captured pages are immutable (published
+                # prefixes, refcount-0 cached, released handoff tails), so a
+                # post-tick recapture is bit-identical. Seen at scale on the
+                # kv_fetch export path, which captures from the event-loop
+                # thread while ticks run. The ~1s budget covers backends
+                # whose dispatch is SYNCHRONOUS (CPU): there the buffer
+                # stays deleted for the whole prefill step, hundreds of ms
+                # for long prompts, not the microseconds an async TPU
+                # dispatch leaves between delete and reassign.
+                time.sleep(0.001)
+        raise RuntimeError(f"page {page} capture kept losing the donation race")
 
     def _upload_page_kv(self, payloads, pages: list[int]) -> None:
         """Restore host-tier payloads into HBM `pages` (pool callback;
@@ -2719,6 +2837,203 @@ class InferenceEngine:
                 except Exception:  # afcheck: ignore[except-swallow] best-effort peer serving: a failed D2H copy shortens the response and the requester re-prefills
                     continue
         return out
+
+    # ------------------------------------------------------------------
+    # Live-slot handoff (disaggregated prefill/decode pools,
+    # docs/ARCHITECTURE.md "Two-phase dispatch"): the full prompt pages
+    # move through the ordinary publish→kv_fetch→adopt path above; what
+    # ships HERE is the piece that path cannot carry — the partial tail
+    # page (lookup never matches a page holding the last prompt token)
+    # plus the sampler state (first token + its logprob), so the decode
+    # node resumes the exact slot the prefill node would have decoded.
+
+    def _gc_handoffs_locked(self) -> None:
+        """Expire + bound both handoff stashes (caller holds _session_lock).
+        Oldest-first eviction under the cap: a stuck decode pool sheds its
+        stalest exports, and every shed is just a future re-prefill."""
+        now = time.monotonic()
+        for stash in (self._handoff_out, self._handoff_in):
+            for key in [k for k, v in stash.items() if v[0] < now]:
+                del stash[key]
+            while len(stash) >= _HANDOFF_STASH_MAX:
+                del stash[next(iter(stash))]
+
+    def pop_handoff_desc(self, request_id: str) -> dict | None:
+        """The phase-1 result attachment: the descriptor for a request that
+        just finished with ``finish_reason="handoff"``. The stash entry
+        (and its tail payload) stays resident for the decode node's fetch —
+        only ``export_handoff_tail`` or the TTL removes it."""
+        with self._session_lock:
+            entry = self._handoff_out.get(request_id)
+        return dict(entry[1]) if entry is not None else None
+
+    def export_handoff_tail(self, handoff_id: str) -> tuple[dict, Any] | None:
+        """Serve the decode node's tail fetch: pop the stashed (descriptor,
+        host payload) for one handoff id, or None if it aged out / never
+        exported. One-shot — the protocol fetches exactly once, and a
+        popped entry cannot keep pinning its host page copy."""
+        with self._session_lock:
+            self._gc_handoffs_locked()
+            entry = self._handoff_out.pop(handoff_id, None)
+        if entry is None:
+            return None
+        return entry[1], entry[2]
+
+    def adopt_handoff_tail(self, handoff_id: str, payload: Any) -> bool:
+        """Stash a fetched tail payload for its phase-2 admission. The
+        caller (model_node.maybe_prefetch_kv) already validated the wire
+        leaves against ``page_payload_spec`` and rebuilt the pool pytree
+        via ``build_page_payload`` — mixed-dtype fleets fail validation
+        there and degrade to a re-prefill."""
+        if not self._shared_prefix:
+            return False
+        with self._session_lock:
+            self._gc_handoffs_locked()
+            self._handoff_in[handoff_id] = (
+                time.monotonic() + _HANDOFF_TTL_S,
+                payload,
+            )
+        return True
+
+    def _try_handoff_export(
+        self, req: Request, pages: list[int], tok: int, first_logprob: float
+    ) -> TokenEvent | None:
+        """Phase 1 of a two-phase dispatch: instead of installing the slot,
+        publish the prompt's full pages into the prefix index (the decode
+        node pulls them over the ordinary kv_fetch path), capture + stash
+        the partial tail page with the sampled first token, release every
+        page ref, and emit ONE terminal event (finish_reason="handoff").
+        Returns None to DECLINE — ineligible request, injected fault, or a
+        failed D2H copy — in which case the caller installs normally and
+        this node decodes the request itself, the degradation contract
+        every handoff failure mode shares."""
+        s = req.sampling
+        if (
+            not self._shared_prefix
+            or req.grammar is not None
+            or req.mm_embeds
+            or req.n_branches > 1
+            or len(req.prompt) < 2
+            # a preempted-and-resumed incarnation already decoded locally:
+            # exporting now would hand off mid-generation state the
+            # phase-2 request (the ORIGINAL prompt) cannot validate
+            or req.resumed_from > 0
+            # first token already terminal: there is nothing to hand off
+            or tok in s.stop_token_ids
+            or s.max_new_tokens <= 1
+        ):
+            return None
+        if _engine_fault("kv.handoff_fail") is not None:
+            return None
+        ps = self.ecfg.page_size
+        L = len(req.prompt)
+        k = (L - 1) // ps  # tail page: positions [k*ps, L)
+        t0_w, t0_m = time.time(), time.perf_counter()
+        with self._session_lock:
+            handle = self._capture_page_kv(pages[k])
+        try:
+            payload = _fetch_page_kv(handle)
+        except Exception:
+            return None  # decline: decode locally, pages still owned
+        desc = {
+            "id": req.id,
+            "t0": tok,
+            "logprob": first_logprob,
+            "prompt_tokens": L,
+            "pages": k,
+            "page_size": ps,
+        }
+        with self._session_lock:
+            # Same disposition as _release's non-session path: published
+            # full pages survive the free as refcount-0 cached index
+            # entries; the tail + growth pages return to the free list.
+            self.allocator.publish(req.prompt, pages)
+            self.allocator.free(pages)
+            self._gc_handoffs_locked()
+            self._handoff_out[req.id] = (
+                time.monotonic() + _HANDOFF_TTL_S,
+                desc,
+                payload,
+            )
+        self.stats["kv_handoff_initiated_total"] += 1
+        st = self._submit_t.pop(req.id, None)
+        if st is not None:
+            # phase-1 TTFT: submit → the first token the handoff carries
+            self.latency.observe("ttft_ms", (time.monotonic() - st) * 1e3)
+        self._tr_first_token(req)
+        e = self._traces.get(req.id)
+        if e is not None:
+            nbytes = sum(
+                int(a.nbytes) for a in jax.tree.leaves(payload)
+            )
+            self._tracer.record_span(
+                "engine.kv_export", e["tid"], t0_w,
+                (time.perf_counter() - t0_m) * 1e3,
+                {"pages": k, "tail_bytes": nbytes},
+            )
+        self._tr_close(req.id, "handoff", generated=1)
+        self.stats["requests_finished"] += 1
+        with self._pending_lock:
+            self._deadline_at.pop(req.id, None)
+        return TokenEvent(
+            request_id=req.id,
+            token=tok,
+            index=req.resumed_from,
+            finished=True,
+            finish_reason="handoff",
+            logprob=first_logprob,
+        )
+
+    # afcheck: owns-pages success installs into the slot table; None returns custody to the caller's prefill path
+    def _try_handoff_install(
+        self, req: Request, free_slot: int, pages: list[int], start: int, kind: str
+    ) -> list[TokenEvent] | None:
+        """Phase 2 live install: when the prefix walk matched every full
+        prompt page and the phase-1 tail payload was adopted, upload the
+        tail page directly and install the slot with the phase-1 first
+        token — zero prefill, and the slot state is bit-identical to what
+        the prefill node would have decoded from. Any shortfall (walk fell
+        short, payload missing/aged out, upload failure) returns None: the
+        caller re-prefills the suffix normally and greedy re-samples the
+        same first token — the token-exact fallback."""
+        desc = req.handoff
+        ps = self.ecfg.page_size
+        L = len(req.prompt)
+        k = (L - 1) // ps
+        if (
+            not isinstance(desc, dict)
+            or desc.get("page_size") != ps
+            or desc.get("prompt_tokens") != L
+            or desc.get("pages") != k
+            or not isinstance(desc.get("t0"), int)
+            or isinstance(desc.get("t0"), bool)
+            or start != k * ps
+        ):
+            self.stats["kv_handoff_fail_walk_total"] += 1
+            return None
+        with self._session_lock:
+            entry = self._handoff_in.pop(str(desc.get("id")), None)
+        if entry is None or entry[0] < time.monotonic():
+            self.stats["kv_handoff_fail_stash_total"] += 1
+            return None
+        try:
+            with self._session_lock:
+                self._upload_page_kv([entry[1]], [pages[k]])
+        except Exception:
+            # harmless: the fallback prefills prompt[start:], which
+            # rewrites the whole tail page
+            self.stats["kv_handoff_fail_upload_total"] += 1
+            return None
+        self._dequeue_acquired(req, kind, start)
+        row = build_page_table(pages, self.ecfg.max_pages_per_seq)
+        self.stats["kv_handoff_completed_total"] += 1
+        lp = desc.get("logprob")
+        return [
+            self._install(
+                req, free_slot, pages, row, int(desc["t0"]),
+                float(lp) if lp is not None else 0.0,
+            )
+        ]
 
     # afcheck: owns-pages the slot table takes custody; release_slot/preempt free them
     def _install(
@@ -3386,11 +3701,19 @@ class InferenceEngine:
 
     def _mixed_eligible(self, req: Request) -> bool:
         """Mixed prefill jobs carry plain token prompts only: grammar
-        first-token masks, multimodal inject buffers, and branch forks
+        first-token masks, multimodal inject buffers, branch forks
         (which need the prompt's last-token logits — a mixed tick reads
-        back only sampled tokens) are classic-tick features (such requests
-        still admit through the classic path)."""
-        return req.grammar is None and not req.mm_embeds and req.n_branches <= 1
+        back only sampled tokens) and handoff phases (export samples from
+        the last-prompt-token logits; adoption installs a live slot with
+        no prefill at all) are classic-tick features (such requests still
+        admit through the classic path)."""
+        return (
+            req.grammar is None
+            and not req.mm_embeds
+            and req.n_branches <= 1
+            and req.handoff is None
+            and not req.handoff_export
+        )
 
     def _mixed_tick_ready(self) -> bool:
         """Should this tick run the packed mixed dispatch? Yes while prefill

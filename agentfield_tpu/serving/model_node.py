@@ -359,7 +359,9 @@ class ModelBackend:
         # EngineConfig.prefix_sketch_bytes=0).
         import os as _os
 
-        self._kv_fetch_fn = None  # async (peer, chains_hex, timeout_s) -> pages|None
+        self._kv_fetch_fn = None  # async (peer, chains_hex, timeout_s,
+        # handoff=None) -> pages|None; the handoff kwarg is only passed
+        # when set (3-arg test doubles stay valid)
         self.kv_fetch_enabled = _os.environ.get(
             "AGENTFIELD_KV_FETCH", "1"
         ).lower() not in ("0", "false", "no")
@@ -806,6 +808,13 @@ class ModelBackend:
         # engine records lifecycle spans against its trace_id
         # (docs/OBSERVABILITY.md); collected at terminal by
         # collect_trace_spans
+        handoff_export: bool = False,  # disaggregated pools, phase 1: the
+        # engine prefills, publishes the prompt's pages, stashes the tail
+        # page + first sampled token, and terminates with
+        # finish_reason="handoff" instead of decoding
+        handoff: dict | None = None,  # disaggregated pools, phase 2: the
+        # prefill node's handoff descriptor — admission live-installs the
+        # adopted pages + stashed tail and resumes decoding token-exact
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -898,6 +907,8 @@ class ModelBackend:
                     priority=priority,
                     n_branches=n_branches,
                     trace=trace,
+                    handoff_export=handoff_export,
+                    handoff=handoff,
                 )
             )
         except Exception:
@@ -1046,7 +1057,7 @@ class ModelBackend:
     # -- cluster prefix tier (docs/PREFIX_CACHING.md "Cluster tier") ----
 
     async def kv_export_pages(
-        self, chains_hex: list[str], max_bytes: int
+        self, chains_hex: list[str], max_bytes: int, handoff: str | None = None
     ) -> list[tuple[dict, bytes]]:
         """Serve a peer's kv_fetch: look the requested chain hashes up in
         this engine's prefix index (both tiers) and serialize each page as
@@ -1056,7 +1067,13 @@ class ModelBackend:
         as a BINARY frame (no base64: the old text encoding paid ~33% wire
         overhead on every transferred page). The device→host copies run
         off the event loop; the byte cap stops serialization early (the
-        requester re-prefills the tail)."""
+        requester re-prefills the tail).
+
+        With ``handoff`` (disaggregated pools, phase 2 pulling its live
+        handoff), the stashed tail page for that handoff id is serialized
+        FIRST — its meta carries ``handoff`` instead of ``chain`` so the
+        requester's chain matching never confuses it with an indexed page
+        — and the stash entry is consumed (one-shot)."""
         import jax
         import numpy as np
 
@@ -1079,7 +1096,37 @@ class ModelBackend:
             # would stall every stream multiplexed on this node.
             raw = self.engine.export_kv_pages(chains)
             pages: list[tuple[dict, bytes]] = []
-            total = wire_saved = 0
+            total = wire_saved = handoff_bytes = 0
+            tail = (
+                self.engine.export_handoff_tail(handoff) if handoff else None
+            )
+            if tail is not None:
+                # Tail page ahead of the chain pages: the byte cap must
+                # never starve the one payload phase 2 cannot re-derive
+                # from the published index.
+                _desc, t_payload = tail
+                t_leaves = [
+                    np.ascontiguousarray(np.asarray(a))
+                    for a in jax.tree.leaves(t_payload)
+                ]
+                t_blobs = [a.tobytes() for a in t_leaves]
+                sz = sum(len(b) for b in t_blobs)
+                if sz <= max_bytes:
+                    pages.append(
+                        (
+                            {
+                                "handoff": handoff,
+                                "parts": [
+                                    {"dtype": str(a.dtype), "shape": list(a.shape)}
+                                    for a in t_leaves
+                                ],
+                                "segs": [len(b) for b in t_blobs],
+                            },
+                            b"".join(t_blobs),
+                        )
+                    )
+                    total += sz
+                    handoff_bytes = sz
             for chain, depth, payload in raw:
                 leaves = [
                     np.ascontiguousarray(np.asarray(a))
@@ -1102,13 +1149,17 @@ class ModelBackend:
                 total += sz
                 if quant_on:
                     wire_saved += max(0, dense_page - sz)
-            return pages, total, wire_saved
+            return pages, total, wire_saved, handoff_bytes
 
-        pages, total, wire_saved = await asyncio.to_thread(_export_and_serialize)
+        pages, total, wire_saved, handoff_bytes = await asyncio.to_thread(
+            _export_and_serialize
+        )
         self.engine.stats["kv_fetch_served_total"] += len(pages)
         self.engine.stats["kv_fetch_bytes_total"] += total
         if wire_saved:
             self.engine.stats["kv_quant_wire_bytes_saved_total"] += wire_saved
+        if handoff_bytes:
+            self.engine.stats["kv_handoff_bytes_total"] += handoff_bytes
         return pages
 
     async def maybe_prefetch_kv(self, tokens: list[int] | None, hint: Any) -> int:
@@ -1119,7 +1170,14 @@ class ModelBackend:
         EVERY failure mode (no channel, peer gone, timeout, malformed
         payload, seeded kv.fetch_fail/kv.fetch_stall) degrades to an
         ordinary local prefill, token-exact, zero pages leaked. Returns the
-        number of pages adopted."""
+        number of pages adopted.
+
+        Disaggregated pools: when the hint carries a ``handoff`` id, the
+        same fetch also pulls the prefill node's stashed tail page (last
+        partial page + first sampled token's KV) and stashes it for
+        admission's live-slot install — so phase 2 resumes with ZERO
+        prefill work. A missing/torn tail only costs the live install:
+        admission falls back to prefilling from the adopted prefix."""
         import numpy as np
 
         from agentfield_tpu.prefix_hash import page_chain_hashes
@@ -1136,14 +1194,19 @@ class ModelBackend:
         ps = self.engine.ecfg.page_size
         if not isinstance(peer, str) or hint.get("page_size") != ps:
             return 0  # mismatched page geometry: chains can never align
+        hid = hint.get("handoff")
+        if not isinstance(hid, str):
+            hid = None  # plain prefix prefetch (no live-slot tail)
         matchable = list(tokens[: len(tokens) - 1])
         hashes = page_chain_hashes(matchable, ps)
         local_pages = self.engine.peek_prefix(matchable) // ps
         want = int(hint.get("pages") or len(hashes))
         missing = hashes[local_pages : min(want, len(hashes))]
-        if not missing:
+        if not missing and hid is None:
             return 0
-        key = (peer, missing[0])
+        # A handoff pull is unique to its id (the serving stash is one-shot),
+        # so it never shares a leader with a plain same-prefix burst-mate.
+        key = (peer, ("handoff", hid) if hid is not None else missing[0])
         leader = self._kv_prefetch_inflight.get(key)
         if leader is not None:
             # A same-prefix burst-mate is already pulling this range: wait
@@ -1157,9 +1220,17 @@ class ModelBackend:
         self._kv_prefetch_inflight[key] = fut
         try:
             self.engine.stats["kv_fetch_requested_total"] += 1
-            got = await self._kv_fetch_fn(
-                peer, [h.hex() for h in missing], self.kv_fetch_timeout_s
-            )
+            if hid is not None:
+                got = await self._kv_fetch_fn(
+                    peer, [h.hex() for h in missing], self.kv_fetch_timeout_s,
+                    handoff=hid,
+                )
+            else:
+                # keyword omitted on the plain path: test doubles (and any
+                # older transport) keep the 3-arg signature
+                got = await self._kv_fetch_fn(
+                    peer, [h.hex() for h in missing], self.kv_fetch_timeout_s
+                )
             if not got:
                 self.engine.stats["kv_fetch_failed_total"] += 1
                 return 0
@@ -1176,36 +1247,57 @@ class ModelBackend:
                 # quantized value/scale leaves), so a mismatched or corrupt
                 # peer can only end the adoptable prefix early
                 spec = self.engine.page_payload_spec()
+
+                def _leaves_of(pg: dict) -> list | None:
+                    # one page payload, validated leaf-by-leaf against THIS
+                    # pool's geometry (shared by chain pages and the tail)
+                    parts = pg["parts"]
+                    segs = [int(s) for s in pg["segs"]]
+                    data = pg["data"]
+                    if len(parts) != len(spec) or len(segs) != len(spec):
+                        raise ValueError("payload leaf count mismatch")
+                    leaves = []
+                    off = 0
+                    for part, seg, (want_dt, want_shape) in zip(parts, segs, spec):
+                        dt = np.dtype(part["dtype"])
+                        shape = tuple(part["shape"])
+                        if (str(dt), shape) != (want_dt, want_shape):
+                            raise ValueError(
+                                f"leaf {part} != expected {(want_dt, want_shape)}"
+                            )
+                        leaves.append(
+                            np.frombuffer(data[off : off + seg], dtype=dt).reshape(
+                                shape
+                            )
+                        )
+                        off += seg
+                    return leaves
+
+                tail_payload = None
+                if hid is not None:
+                    tpg = next(
+                        (
+                            pg
+                            for pg in got
+                            if isinstance(pg, dict) and pg.get("handoff") == hid
+                        ),
+                        None,
+                    )
+                    if tpg is not None:
+                        try:
+                            tail_payload = self.engine.build_page_payload(
+                                _leaves_of(tpg)
+                            )
+                        except Exception:
+                            tail_payload = None  # admission counts the
+                            # failed handoff when the stash comes up empty
                 out = []
                 for idx, h in enumerate(missing):
                     pg = by_chain.get(h.hex())
                     if pg is None:
                         break  # a gap ends the adoptable prefix (chain rule)
                     try:
-                        parts = pg["parts"]
-                        segs = [int(s) for s in pg["segs"]]
-                        data = pg["data"]
-                        if len(parts) != len(spec) or len(segs) != len(spec):
-                            raise ValueError("payload leaf count mismatch")
-                        leaves = []
-                        off = 0
-                        for part, seg, (want_dt, want_shape) in zip(
-                            parts, segs, spec
-                        ):
-                            dt = np.dtype(part["dtype"])
-                            shape = tuple(part["shape"])
-                            if (str(dt), shape) != (want_dt, want_shape):
-                                raise ValueError(
-                                    f"leaf {part} != expected "
-                                    f"{(want_dt, want_shape)}"
-                                )
-                            leaves.append(
-                                np.frombuffer(
-                                    data[off : off + seg], dtype=dt
-                                ).reshape(shape)
-                            )
-                            off += seg
-                        payload = self.engine.build_page_payload(leaves)
+                        payload = self.engine.build_page_payload(_leaves_of(pg))
                     except Exception:
                         self.engine.stats["kv_fetch_failed_total"] += 1
                         break
@@ -1215,9 +1307,15 @@ class ModelBackend:
                          tuple(matchable[depth * ps : (depth + 1) * ps]),
                          payload)
                     )
-                return out
+                return out, tail_payload
 
-            entries = await asyncio.to_thread(_decode_entries)
+            entries, tail = await asyncio.to_thread(_decode_entries)
+            if hid is not None and tail is not None:
+                # Stash the live tail page for admission's live-slot install
+                # (engine._try_handoff_install); chain pages adopt below as
+                # usual. Order is irrelevant — both sit in host stores until
+                # this request is admitted.
+                self.engine.adopt_handoff_tail(hid, tail)
             if not entries:
                 return 0
             return self.engine.adopt_kv_pages(entries)
@@ -1256,6 +1354,15 @@ class ModelBackend:
         # missing pages are pulled over the channel before admission
         # (docs/PREFIX_CACHING.md "Cluster tier"). Best-effort: any failure
         # degrades to an ordinary local prefill.
+        handoff_export: bool = False,  # disaggregated pools, phase 1
+        # (docs/ARCHITECTURE.md "Two-phase dispatch"): prefill + publish
+        # pages, return a ``handoff`` descriptor in the result instead of
+        # decoding. Best-effort: an ineligible request (grammar, media,
+        # branches, tiny prompt) silently decodes here instead.
+        handoff: dict | None = None,  # disaggregated pools, phase 2: the
+        # phase-1 descriptor; paired with a kv_peer hint carrying the same
+        # handoff id so the tail page rides the prefetch. Any failure
+        # degrades to a local (re-)prefill — token-exact under greedy.
         trace: dict | None = None,  # request-scoped tracing
         # (docs/OBSERVABILITY.md): the gateway's TraceContext — engine
         # lifecycle spans are recorded against its trace_id and shipped
@@ -1390,6 +1497,8 @@ class ModelBackend:
             priority=priority,
             n_branches=n_branches,
             trace=trace,
+            handoff_export=handoff_export,
+            handoff=handoff,
         )
         try:
             result = await fut
@@ -1409,6 +1518,14 @@ class ModelBackend:
         result["model"] = self.model_name
         if truncated:
             result["truncated_prompt_tokens"] = truncated
+        if handoff_export and result.get("finish_reason") == "handoff":
+            # Phase-1 terminal: the descriptor rides the result back to the
+            # gateway, which re-dispatches phase 2 to a decode node. A
+            # missing descriptor (stash expired/evicted) leaves the key off
+            # — the gateway treats that as an ordinary completed result.
+            desc = self.engine.pop_handoff_desc(rid)
+            if desc is not None:
+                result["handoff"] = desc
         if output == "speech":
             # Speak the GENERATED text (reference chat-audio shape,
             # agent_ai.py:864: text response + audio of that response).
@@ -1461,6 +1578,9 @@ class ModelBackend:
         n_branches: int = 1,
         branch_policy: Any = None,
         trace: dict | None = None,
+        handoff: dict | None = None,  # disaggregated pools, phase 2 (a
+        # streamed phase-2 resume): see generate(). Phase 1 itself is
+        # never streamed — the gateway submits it unary.
     ) -> tuple[str, asyncio.Queue, int]:
         """Streaming variant: returns (request_id, queue of TokenEvents,
         truncated_prompt_tokens) — the truncation count rides along so
@@ -1514,6 +1634,7 @@ class ModelBackend:
             priority=priority,
             n_branches=n_branches,
             trace=tracing.valid_context(trace),
+            handoff=handoff,
         )
         return rid, q, truncated
 
@@ -1823,6 +1944,10 @@ def build_model_node(
     spec_k: int | None = None,  # proposals per step; sets ecfg.spec_k
     lora: str | None = None,  # LoRA adapter dir (training.lora.save_adapter):
     # merged into the base weights at load — fine-tune → merge → serve
+    role: str | None = None,  # disaggregated pools (docs/OPERATIONS.md
+    # "Disaggregated pools"): "prefill" | "decode" | "mixed". Default is
+    # the AGENTFIELD_NODE_ROLE env knob, else "mixed" — which keeps the
+    # gateway's dispatch bit-compatible with a role-less fleet (pinned).
 ) -> tuple[Agent, ModelBackend]:
     """Construct (agent, backend): the agent exposes `generate` and handles
     registration/heartbeats; the backend drives the engine. Caller sequence:
@@ -1918,9 +2043,19 @@ def build_model_node(
         modalities.append("audio-out")
     if backend.imagegen_cfg is not None:
         modalities.append("image-out")
+    # Role advertisement (disaggregated pools): registration metadata is the
+    # ONE channel — the registry's snapshot cache surfaces it to _pick_node
+    # without a schema change, and the sweep loop turns it into the
+    # per-role nodes_by_role gauge.
+    role = role or _os.environ.get("AGENTFIELD_NODE_ROLE") or "mixed"
+    if role not in ("prefill", "decode", "mixed"):
+        raise ValueError(
+            f"unknown node role {role!r}: 'prefill' | 'decode' | 'mixed' "
+            "(AGENTFIELD_NODE_ROLE / build_model_node(role=...))"
+        )
     kwargs: dict[str, Any] = {
         "kind": "model",
-        "metadata": {"model": model, "modalities": modalities},
+        "metadata": {"model": model, "modalities": modalities, "role": role},
     }
     if control_plane:
         kwargs["control_plane"] = control_plane
@@ -1977,7 +2112,7 @@ def build_model_node(
                 "max_new_tokens", "temperature", "top_k", "top_p",
                 "response_schema", "context_overflow", "images", "audios",
                 "deadline_s", "priority", "n_branches", "branch_policy",
-                "trace",
+                "trace", "handoff",
             )
             if body.get(k) is not None
         }

@@ -15,6 +15,16 @@ Scenarios (pair with tools/perf/stress_agent.py):
     --payload-bytes-sweep 1024,65536,1048576  # one run per payload size
     --scenario-file scenarios.json            # list of run configs
 
+Bimodal prompt lengths (models prefill bursts against serving targets):
+    --long-frac 0.1 --long-len 512   # 10% of requests carry a long prompt
+
+A --long-frac fraction of requests (evenly spread through the arrival
+order, deterministically — see bimodal_is_long) have their payload's
+``tokens`` list tiled out to --long-len. The report then splits ITL:
+``itl_ms`` covers all requests (mixed traffic), ``decode_itl_ms`` only the
+short ones — the decode-traffic tail that disaggregated prefill/decode
+pools are supposed to protect (docs/OPERATIONS.md "Disaggregated pools").
+
 Prints one JSON report to stdout.
 """
 
@@ -47,6 +57,29 @@ def percentile(values: list[float], p: float) -> float:
     return values[min(max(rank, 1), len(values)) - 1]
 
 
+def bimodal_is_long(i: int, long_frac: float) -> bool:
+    """Whether request ``i`` is a long-prompt request under ``long_frac``.
+
+    Long requests land wherever the cumulative long fraction crosses an
+    integer — evenly spread through the arrival order and a pure function
+    of (i, long_frac), so an execute hook (the disaggregated_pools bench)
+    can classify requests with the same rule the generator used."""
+    if long_frac <= 0:
+        return False
+    return math.floor((i + 1) * long_frac) > math.floor(i * long_frac)
+
+
+def _lengthen_payload(payload, long_len: int):
+    """Tile the payload's ``tokens`` list out to ``long_len`` (serving
+    targets take token-ids input); non-token payloads pass through — the
+    bimodal split then only affects the ITL report, not the wire bytes."""
+    if not isinstance(payload, dict) or not isinstance(payload.get("tokens"), list):
+        return payload
+    base = payload["tokens"] or [1]
+    reps = math.ceil(long_len / len(base))
+    return {**payload, "tokens": (base * reps)[:long_len]}
+
+
 async def run_load(
     url: str,
     target: str,
@@ -57,6 +90,8 @@ async def run_load(
     timeout: float = 120.0,
     qps: float | None = None,
     execute=None,
+    long_frac: float = 0.0,
+    long_len: int = 512,
 ) -> dict:
     """Closed-loop by default (`concurrency` in-flight callers, each issuing
     the next request only after its previous one finished). With ``qps``
@@ -76,7 +111,12 @@ async def run_load(
     scenarios report time-to-first-frame percentiles (``ttft_ms``)
     alongside full-completion latency, since TTFT, not completion, is the
     latency an agent loop actually waits on — or a 3-tuple
-    ``(status, ttft, trace_id)`` to feed the slow-tail linkage below.
+    ``(status, ttft, trace_id)`` to feed the slow-tail linkage below, or a
+    4-tuple ``(status, ttft, trace_id, itl_samples)`` where ``itl_samples``
+    is a list of inter-token latencies (seconds) — with ``long_frac`` set,
+    ITLs split into mixed-traffic (``itl_ms``) and decode-only
+    (``decode_itl_ms``, short requests per :func:`bimodal_is_long`)
+    percentile blocks.
 
     Slow-tail linkage (docs/OBSERVABILITY.md): when trace ids are known
     (the HTTP sync path reads ``trace_id`` off the execution document; an
@@ -87,6 +127,9 @@ async def run_load(
     still retains it."""
     latencies: list[float] = []
     ttfts: list[float] = []
+    itl_all: list[float] = []
+    itl_decode: list[float] = []  # short-request ITLs only (bimodal mode)
+    long_count = 0
     # (latency_s, trace_id) per completed request — trace_id may be None
     # (tracing off / non-trace-aware hook); feeds the slow_traces block.
     records: list[tuple[float, str | None]] = []
@@ -105,33 +148,46 @@ async def run_load(
         t_start = time.perf_counter()
 
         async def issue(i: int) -> None:
+            nonlocal long_count
             t0 = time.perf_counter()
             if qps:
                 # Latency is charged from the scheduled arrival, not from
                 # whenever the event loop got around to sending: missed
                 # schedule IS queueing delay the client experienced.
                 t0 = t_start + i / qps
+            is_long = bimodal_is_long(i, long_frac)
+            if is_long:
+                long_count += 1
             trace_id = None
             try:
                 if execute is not None:
                     status = await execute(i)
                     if isinstance(status, tuple):
-                        if len(status) == 3:
+                        itls = None
+                        if len(status) == 4:
+                            status, ttft, trace_id, itls = status
+                        elif len(status) == 3:
                             status, ttft, trace_id = status
                         else:
                             status, ttft = status
                         if ttft is not None:
                             ttfts.append(ttft)
+                        if itls:
+                            itl_all.extend(itls)
+                            if not is_long:
+                                itl_decode.extend(itls)
                 elif mode == "sync":
+                    body = _lengthen_payload(payload, long_len) if is_long else payload
                     async with session.post(
-                        f"{url}/api/v1/execute/{target}", json={"input": payload}
+                        f"{url}/api/v1/execute/{target}", json={"input": body}
                     ) as resp:
                         doc = await resp.json()
                         status = doc.get("status", f"http_{resp.status}")
                         trace_id = doc.get("trace_id")
                 else:
+                    body = _lengthen_payload(payload, long_len) if is_long else payload
                     async with session.post(
-                        f"{url}/api/v1/execute/async/{target}", json={"input": payload}
+                        f"{url}/api/v1/execute/async/{target}", json={"input": body}
                     ) as resp:
                         if resp.status == 503:
                             status = "backpressure_503"
@@ -184,6 +240,29 @@ async def run_load(
             "p99": round(percentile(ttfts, 99) * 1e3, 1),
             "samples": len(ttfts),
         }
+    if long_frac > 0:
+        report["bimodal"] = {
+            "long_frac": long_frac,
+            "long_len": long_len,
+            "long_requests": long_count,
+        }
+    if itl_all:
+        # Mixed-traffic ITL vs decode-only ITL (short requests only): the
+        # gap between the two p99s is the prefill-burst interference that
+        # disaggregated prefill/decode pools exist to remove.
+        report["itl_ms"] = {
+            "p50": round(percentile(itl_all, 50) * 1e3, 2),
+            "p95": round(percentile(itl_all, 95) * 1e3, 2),
+            "p99": round(percentile(itl_all, 99) * 1e3, 2),
+            "samples": len(itl_all),
+        }
+        if long_frac > 0:
+            report["decode_itl_ms"] = {
+                "p50": round(percentile(itl_decode, 50) * 1e3, 2),
+                "p95": round(percentile(itl_decode, 95) * 1e3, 2),
+                "p99": round(percentile(itl_decode, 99) * 1e3, 2),
+                "samples": len(itl_decode),
+            }
     if any(tid for _, tid in records):
         # Slow-tail linkage: the requests AT or above the p99 latency, each
         # with its trace id — triage starts from this artifact
@@ -262,6 +341,8 @@ async def run_scenario(args_ns) -> dict:
             _scenario_payload(args_ns, size),
             timeout=args_ns.timeout,
             qps=getattr(args_ns, "qps", None),
+            long_frac=getattr(args_ns, "long_frac", 0.0) or 0.0,
+            long_len=getattr(args_ns, "long_len", 512),
         )
         if args_ns.scenario == "nested":
             r["scenario"] = {
@@ -294,6 +375,20 @@ async def main() -> None:
         "free of coordinated omission (default: closed-loop --concurrency)",
     )
     ap.add_argument("--payload", default=None, help="JSON input payload")
+    ap.add_argument(
+        "--long-frac",
+        type=float,
+        default=0.0,
+        help="bimodal prompt lengths: this fraction of requests (evenly "
+        "spread, deterministic) get their payload's tokens tiled out to "
+        "--long-len; the report splits decode-only ITL from mixed traffic",
+    )
+    ap.add_argument(
+        "--long-len",
+        type=int,
+        default=512,
+        help="token length of the long-prompt requests (with --long-frac)",
+    )
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--scenario", choices=("plain", "nested"), default="plain")
     ap.add_argument("--depth", type=int, default=1, help="nested: recursion depth")

@@ -37,6 +37,22 @@ def aggregate_status(statuses: list[ExecutionStatus]) -> str:
     return "unknown"
 
 
+def infer_expect_followup(parent_execution_id: str | None, session_id: str | None) -> bool:
+    """DAG-successor inference for agent-aware serving (docs/OPERATIONS.md
+    "Agent-aware serving"): should dispatch hint the serving node that a
+    follow-up on the same session is likely, without the caller saying so?
+
+    The structural signal is the one the flat executions table already
+    carries: a NON-ROOT step of a session-carrying chain. A child execution
+    (``parent_execution_id`` set) reusing a session is, by construction, an
+    agent program mid-flight — reasoner → tool → reasoner — and its session
+    will be hit again when the tool result lands. Roots stay cold (a
+    one-shot call with a session id is the common non-agent case), so the
+    inference never pins single-turn traffic. Pure function of the two
+    columns: no storage read on the dispatch hot path."""
+    return bool(parent_execution_id) and bool(session_id)
+
+
 _DAG_LIMIT = 5000
 
 

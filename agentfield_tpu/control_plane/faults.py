@@ -70,6 +70,18 @@ Injection points in-tree:
                                prompt locally (greedy re-samples the same
                                first token); the stale tail stash expires
                                by TTL, zero pages leaked
+``spec.fail``                  speculative next-step prefill is vetoed at
+                               enqueue time (consulted once per keep-warm
+                               release with declared candidates) — the
+                               session stays pinned but nothing is
+                               speculated: the follow-up pays the ordinary
+                               suffix prefill over the retained session,
+                               token-exact, zero pages leaked
+``spec.stall``                 speculative jobs sit out ``delay_s`` before
+                               becoming admissible — a follow-up that wins
+                               the race absorbs nothing (the deferred jobs
+                               cancel unstarted), token-exact, zero pages
+                               leaked
 ========================== =====================================================
 
 Activation: explicitly via :func:`install` (tests, bench), or process-wide
@@ -105,6 +117,8 @@ KNOWN_POINTS = (
     "kv.fetch_stall",
     "kv.handoff_fail",
     "kv.handoff_stall",
+    "spec.fail",
+    "spec.stall",
 )
 
 

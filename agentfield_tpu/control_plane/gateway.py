@@ -33,6 +33,7 @@ from agentfield_tpu.branching import validate_branch_spec
 from agentfield_tpu.prefix_hash import page_chain_hashes, sketch_digest
 
 from agentfield_tpu.control_plane import faults
+from agentfield_tpu.control_plane.dag import infer_expect_followup
 from agentfield_tpu.control_plane.channel import (
     ChannelManager,
     ChannelUnavailable,
@@ -78,6 +79,18 @@ _AFFINITY_MAX_TOKENS = 4096
 # cached prefix tokens. Keeps a warm node from absorbing an entire burst
 # serially while cold-but-idle capacity sits unused.
 _AFFINITY_LOAD_WEIGHT = 32.0
+
+
+def _spec_gateway_enabled() -> bool:
+    """Agent-aware serving master switch, gateway side (docs/OPERATIONS.md
+    "Agent-aware serving"): with AGENTFIELD_SPEC_PREFILL=0 the gateway
+    injects no expect_followup key at all — declared or inferred — so the
+    dispatch wire bodies are bit-compatible with the pre-hint control
+    plane, not merely ignored at the engine. Read per dispatch (cheap) so
+    tests and operators can flip it without a restart."""
+    return os.environ.get("AGENTFIELD_SPEC_PREFILL", "1").strip().lower() not in (
+        "0", "false", "no", "off",
+    )
 
 
 class GatewayError(Exception):
@@ -329,6 +342,7 @@ class ExecutionGateway:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy: Any = None,
+        expect_followup: bool = False,
     ) -> tuple[Execution, AgentNode]:
         """Parse target, resolve node+component, persist the execution record
         (reference: prepareExecution, execute.go:641)."""
@@ -336,6 +350,10 @@ class ExecutionGateway:
             retry_policy = RetryPolicy.validate(retry_policy)
         if isinstance(priority, bool) or not isinstance(priority, int):
             raise GatewayError(400, f"priority must be an integer, got {priority!r}")
+        if not isinstance(expect_followup, bool):
+            raise GatewayError(
+                400, f"expect_followup must be a boolean, got {expect_followup!r}"
+            )
         try:
             # Branch decoding (test-time scaling): one shared validation
             # contract with the model node (agentfield_tpu.branching) —
@@ -414,6 +432,7 @@ class ExecutionGateway:
             n_branches=n_branches,
             branch_policy=branch_policy,
             trace_id=trace_id,
+            expect_followup=expect_followup,
         )
         try:
             # Freshly-minted ids skip the journal's duplicate table probe
@@ -587,6 +606,17 @@ class ExecutionGateway:
                 hint = None
             branched = ex.n_branches > 1
             ho = self._handoff.get(ex.execution_id)
+            # Agent-aware serving (docs/OPERATIONS.md "Agent-aware serving"):
+            # the keep-warm hint is either declared on the execute body or
+            # inferred from the execution's DAG position (a non-root step of
+            # a session-carrying chain WILL see a follow-up). Gated on the
+            # same env knob the engine honors, so AGENTFIELD_SPEC_PREFILL=0
+            # injects NOTHING — dispatch is bit-compatible with pre-hint
+            # wire bodies.
+            ef = _spec_gateway_enabled() and (
+                ex.expect_followup
+                or infer_expect_followup(ex.parent_execution_id, ex.session_id)
+            )
             if (
                 ex.priority
                 or ex.deadline_s is not None
@@ -595,6 +625,7 @@ class ExecutionGateway:
                 or trace is not None
                 or "trace" in agent_input
                 or ho is not None
+                or ef
             ):
                 agent_input = dict(agent_input)
                 if ex.priority:
@@ -634,6 +665,10 @@ class ExecutionGateway:
                     agent_input.setdefault("n_branches", ex.n_branches)
                     if ex.branch_policy is not None:
                         agent_input.setdefault("branch_policy", ex.branch_policy)
+                if ef:
+                    # setdefault: a caller that already set expect_followup
+                    # (or set it False explicitly) wins over the inference.
+                    agent_input.setdefault("expect_followup", True)
         return agent_input
 
     # -- streaming data plane hooks (channel.py calls back into these) --
@@ -876,6 +911,24 @@ class ExecutionGateway:
         if pool:
             self._handoff_rr = (self._handoff_rr + 1) % len(pool)
             pool = pool[self._handoff_rr:] + pool[: self._handoff_rr]
+        if len(pool) > 1 and self._node_cache is not None:
+            # Pool-aware placement: score candidates by heartbeat-fresh
+            # capacity — free KV pages minus the affinity load blend
+            # (active slots + queued), same tradeoff as _affinity_order —
+            # so an idle decode node beats a loaded one instead of taking
+            # its round-robin turn. Nodes without fresh stats score 0.0;
+            # a stats-less fleet therefore sorts into the unchanged
+            # round-robin order (stable sort) — bit-compatible with the
+            # pre-scoring dispatch.
+            scores = []
+            for n in pool:
+                ps = self._node_cache.get_pool_stats(n.node_id)
+                scores.append(
+                    0.0 if ps is None else ps[0] - _AFFINITY_LOAD_WEIGHT * ps[1]
+                )
+            if any(s != 0.0 for s in scores):
+                order = sorted(range(len(pool)), key=lambda i: (-scores[i], i))
+                pool = [pool[i] for i in order]
         picked = next(
             (n for n in pool if n.node_id not in tried),
             pool[0] if pool else None,
@@ -1334,6 +1387,7 @@ class ExecutionGateway:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy: Any = None,
+        expect_followup: bool = False,
     ) -> Execution:
         """Sync path: call agent (with retry/failover), then wait on the
         event bus until the execution reaches a terminal state
@@ -1342,6 +1396,7 @@ class ExecutionGateway:
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
             n_branches=n_branches, branch_policy=branch_policy,
+            expect_followup=expect_followup,
         )
         done = await self._dispatch(ex, node)
         if done is not None and done.status.terminal:
@@ -1372,6 +1427,7 @@ class ExecutionGateway:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy: Any = None,
+        expect_followup: bool = False,
     ) -> tuple[Execution, StreamSubscription]:
         """Streaming sync path: prepare + subscribe to the execution's frame
         stream FIRST (so frame 0 is never missed), then drive dispatch in
@@ -1386,6 +1442,7 @@ class ExecutionGateway:
             target, payload, headers, webhook_url, ExecutionStatus.RUNNING,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
             n_branches=n_branches, branch_policy=branch_policy,
+            expect_followup=expect_followup,
         )
         sub = self.streams.attach(ex.execution_id)
 
@@ -1431,6 +1488,7 @@ class ExecutionGateway:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy: Any = None,
+        expect_followup: bool = False,
         stream: bool = False,  # open the execution's frame stream now so a
         # later GET /executions/{id}/stream attach replays every token
         # (channel-served targets only; without it async work streams
@@ -1446,6 +1504,7 @@ class ExecutionGateway:
             target, payload, headers, webhook_url, ExecutionStatus.QUEUED,
             retry_policy=retry_policy, priority=priority, deadline_s=deadline_s,
             n_branches=n_branches, branch_policy=branch_policy,
+            expect_followup=expect_followup,
         )
         if stream:
             # BEFORE the enqueue: a worker may dispatch immediately, and the

@@ -110,6 +110,12 @@ class NodeSnapshotCache:
         # process (a second gateway instance simply routes without affinity
         # until its own heartbeats arrive).
         self._sketches: dict[str, tuple[dict, float, float]] = {}
+        # Pool-capacity side table (node_id → (free_pages, load, stamped_at)):
+        # same lifecycle/TTL discipline as sketches, but fed from EVERY
+        # stats-bearing heartbeat (sketch-less nodes included) — phase-2
+        # decode placement scores candidates by it, and a stale entry reads
+        # as absent so the picker degrades to plain round-robin.
+        self._pool_stats: dict[str, tuple[float, float, float]] = {}
         self._rebuild_lock = asyncio.Lock()
 
     @property
@@ -179,6 +185,24 @@ class NodeSnapshotCache:
 
     def drop_sketch(self, node_id: str) -> None:
         self._sketches.pop(node_id, None)
+        self._pool_stats.pop(node_id, None)
+
+    # -- pool-capacity side table (phase-2 decode placement) --
+
+    def put_pool_stats(self, node_id: str, free_pages: float, load: float) -> None:
+        self._pool_stats[node_id] = (float(free_pages), float(load), now())
+
+    def get_pool_stats(self, node_id: str) -> tuple[float, float] | None:
+        """(free_pages, load) when heartbeat-fresh; None past
+        ``sketch_ttl_s`` — a node whose heartbeats stopped must not keep
+        winning placement on its last good capacity sample."""
+        entry = self._pool_stats.get(node_id)
+        if entry is None:
+            return None
+        free_pages, load, at = entry
+        if now() - at > self.sketch_ttl_s:
+            return None
+        return free_pages, load
 
 
 class NodeRegistry:
@@ -335,13 +359,20 @@ class NodeRegistry:
             # the sketch is a routing signal, not node state, and a
             # several-KB digest list must not ride every node-table row.
             sketch = stats.pop("prefix_sketch", None)
+            load = 0.0
+            for k in ("active_slots", "pending_requests"):
+                v = stats.get(k)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    load += v
             if isinstance(sketch, dict):
-                load = 0.0
-                for k in ("active_slots", "pending_requests"):
-                    v = stats.get(k)
-                    if isinstance(v, (int, float)) and not isinstance(v, bool):
-                        load += v
                 self.cache.put_sketch(node_id, sketch, load)
+            # Pool-aware phase-2 placement: every stats-bearing heartbeat
+            # refreshes the node's capacity sample (free KV pages + load),
+            # sketch or no sketch — decode-pool nodes skip prefix sketches
+            # entirely but still need scoring.
+            fp = stats.get("free_pages")
+            if isinstance(fp, (int, float)) and not isinstance(fp, bool):
+                self.cache.put_pool_stats(node_id, fp, load)
             # Engine latency histograms (docs/OBSERVABILITY.md): popped off
             # the stats like the sketch (a multi-bucket block must not ride
             # every node-table row) and re-published as REAL per-node

@@ -551,6 +551,9 @@ def create_app(cp: ControlPlane) -> web.Application:
                 deadline_s=body.get("deadline_s"),
                 n_branches=1 if body.get("n_branches") is None else body["n_branches"],
                 branch_policy=body.get("branch_policy"),
+                expect_followup=False
+                if body.get("expect_followup") is None
+                else body["expect_followup"],
             )
         except GatewayError as e:
             return _json_error(e.status, e.message, retry_after=e.retry_after)
@@ -591,6 +594,9 @@ def create_app(cp: ControlPlane) -> web.Application:
                 deadline_s=body.get("deadline_s"),
                 n_branches=1 if body.get("n_branches") is None else body["n_branches"],
                 branch_policy=body.get("branch_policy"),
+                expect_followup=False
+                if body.get("expect_followup") is None
+                else body["expect_followup"],
             )
         except _BadBody as e:
             return _json_error(400, str(e))
@@ -619,6 +625,9 @@ def create_app(cp: ControlPlane) -> web.Application:
                 deadline_s=body.get("deadline_s"),
                 n_branches=1 if body.get("n_branches") is None else body["n_branches"],
                 branch_policy=body.get("branch_policy"),
+                expect_followup=False
+                if body.get("expect_followup") is None
+                else body["expect_followup"],
                 stream=bool(body.get("stream")),
             )
         except GatewayError as e:

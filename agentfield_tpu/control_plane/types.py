@@ -181,6 +181,12 @@ class Execution:
     # subsystem. The spans themselves live in the gateway's in-memory
     # TraceStore (TTL-bounded), not the database.
     trace_id: str | None = None
+    # Agent-aware serving (docs/OPERATIONS.md "Agent-aware serving"): the
+    # caller (or the gateway's DAG-successor inference) declared a follow-up
+    # step will reuse this execution's session — the serving node pins the
+    # session's KV warm and may speculatively prefill the next step. A pure
+    # hint: it can never change results, only latency.
+    expect_followup: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         # Hand-rolled: dataclasses.asdict() deep-copies every nested value
@@ -218,6 +224,7 @@ class Execution:
             else self.branch_policy,
             "frames_delivered": self.frames_delivered,
             "trace_id": self.trace_id,
+            "expect_followup": self.expect_followup,
         }
 
     @staticmethod

@@ -565,6 +565,19 @@ class Agent:
         # {"type", "verifier", "beam_width", "beam_interval"} object; a
         # "verifier" names a reasoner target the node dispatches candidate
         # texts to (through the gateway) instead of scoring by logprob sum.
+        expect_followup: bool = False,  # agent-aware serving (docs/
+        # OPERATIONS.md "Agent-aware serving"): declare that this session
+        # will be hit again right after this call (a tool-call loop) — the
+        # serving node pins the session's KV warm instead of racing its
+        # TTL. The gateway also INFERS this for non-root steps of a
+        # session-carrying chain; the explicit flag covers roots and
+        # out-of-band callers. A latency hint only: results are identical.
+        followup_candidates: list[str] | None = None,  # candidate next-step
+        # texts (e.g. likely tool results rendered into the next prompt's
+        # suffix) the node may speculatively prefill while the tool runs —
+        # the real follow-up then pays TTFT only for what diverges.
+        # Requires expect_followup; invalid entries are dropped, never
+        # errors. Text-only.
         stream: bool = False,  # token streaming THROUGH the gateway: returns
         # an async iterator of frames instead of the result dict — token
         # frames from TTFT, then one {"terminal": True, "result": ...} frame.
@@ -634,6 +647,8 @@ class Agent:
                 top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
                 timeout=timeout, priority=priority, deadline_s=deadline_s,
                 n_branches=n_branches, branch_policy=branch_policy,
+                expect_followup=expect_followup,
+                followup_candidates=followup_candidates,
             )
 
         def _carrier_text() -> str | None:
@@ -728,6 +743,14 @@ class Agent:
             "response_schema": schema,
             "context_overflow": context_overflow,
         }
+        if expect_followup:
+            # Agent-aware serving: generate-input hints (the execute-body
+            # flag below drives the gateway; these drive the model node).
+            # Omitted entirely when unset — the generate schema's strict
+            # bool rejects an explicit null.
+            payload["expect_followup"] = True
+            if followup_candidates:
+                payload["followup_candidates"] = followup_candidates
         # Backpressure retry (the reference's rate limiter plays this role for
         # provider 429s — rate_limiter.py). Engine exhaustion reaches us two
         # ways: HTTP 503 (node inactive / async queue full) OR a FAILED
@@ -766,6 +789,7 @@ class Agent:
                         deadline_s=deadline_s,
                         n_branches=n_branches,
                         branch_policy=branch_policy,
+                        expect_followup=expect_followup,
                     )
                 except ControlPlaneError as e:
                     has_next = ci + 1 < len(candidates)
@@ -839,7 +863,8 @@ class Agent:
     async def _ai_stream_frames(
         self, *, prompt, tokens, messages, model, max_new_tokens, temperature,
         top_k, top_p, stop_token_ids, timeout, priority, deadline_s,
-        n_branches=1, branch_policy=None,
+        n_branches=1, branch_policy=None, expect_followup=False,
+        followup_candidates=None,
     ):
         """ai(stream=True) driver: token frames through the gateway's
         streaming execute, with node-down failover across model candidates
@@ -857,6 +882,10 @@ class Agent:
             "stop_token_ids": stop_token_ids or [],
             "session_id": (current_context().session_id if current_context() else None),
         }
+        if expect_followup:
+            payload["expect_followup"] = True
+            if followup_candidates:
+                payload["followup_candidates"] = followup_candidates
         candidates = await self._model_candidates(model)
         node_errors: list[str] = []
         for ci, cand in enumerate(candidates):
@@ -873,6 +902,7 @@ class Agent:
                     deadline_s=deadline_s,
                     n_branches=n_branches,
                     branch_policy=branch_policy,
+                    expect_followup=expect_followup,
                 ):
                     kind = frame.get("kind")
                     if kind == "token":

@@ -110,6 +110,7 @@ class ControlPlaneClient:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy=None,
+        expect_followup: bool = False,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         kw: dict[str, Any] = {}
@@ -127,6 +128,10 @@ class ControlPlaneClient:
             body["n_branches"] = n_branches
         if branch_policy is not None:
             body["branch_policy"] = branch_policy
+        if expect_followup:
+            # Agent-aware serving hint: the serving node keeps this
+            # session's KV warm for the follow-up (a latency hint only).
+            body["expect_followup"] = True
         return await self._req(
             "POST", f"/api/v1/execute/{target}", json=body, headers=headers or {}, **kw
         )
@@ -141,6 +146,7 @@ class ControlPlaneClient:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy=None,
+        expect_followup: bool = False,
     ) -> dict[str, Any]:
         body: dict[str, Any] = {"input": payload}
         if webhook_url:
@@ -153,6 +159,10 @@ class ControlPlaneClient:
             body["n_branches"] = n_branches
         if branch_policy is not None:
             body["branch_policy"] = branch_policy
+        if expect_followup:
+            # Agent-aware serving hint: the serving node keeps this
+            # session's KV warm for the follow-up (a latency hint only).
+            body["expect_followup"] = True
         return await self._req(
             "POST", f"/api/v1/execute/async/{target}", json=body, headers=headers or {}
         )
@@ -167,6 +177,7 @@ class ControlPlaneClient:
         deadline_s: float | None = None,
         n_branches: int = 1,
         branch_policy=None,
+        expect_followup: bool = False,
     ):
         """Streaming sync execute (`stream=true`): yields the control
         plane's SSE frames as dicts — a `start` frame with the execution id,
@@ -185,6 +196,10 @@ class ControlPlaneClient:
             body["n_branches"] = n_branches
         if branch_policy is not None:
             body["branch_policy"] = branch_policy
+        if expect_followup:
+            # Agent-aware serving hint: the serving node keeps this
+            # session's KV warm for the follow-up (a latency hint only).
+            body["expect_followup"] = True
         if timeout is not None:
             body["timeout"] = timeout
         s = await self._s()

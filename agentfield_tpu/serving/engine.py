@@ -227,6 +227,27 @@ class EngineConfig:
     # draft-verify; exact greedy equivalence). Eligibility is per dispatch:
     # every active row greedy (temperature 0) and unconstrained; mixed
     # batches fall back to normal decode for that step.
+    spec_prefill: bool = True  # agent-aware serving (docs/OPERATIONS.md
+    # "Agent-aware serving"): session keep-warm pins + speculative next-step
+    # prefill for requests that arrive with expect_followup. DISTINCT from
+    # spec_k (speculative DECODING above): this speculates the next
+    # REQUEST's prefill, not the current request's tokens. Only
+    # expect_followup traffic takes any new path — default traffic is
+    # untouched either way — and False (env AGENTFIELD_SPEC_PREFILL=0 at
+    # node build) gates every pin/speculation code path off, bit-compatible
+    # with the pre-agent-aware scheduler (pinned by test).
+    spec_pin_ttl: float = 120.0  # seconds a keep-warm session pin survives
+    # without its follow-up arriving. An expired pin releases its
+    # speculative pages and the session falls back to the ordinary
+    # session_ttl clock — a tool call that never returns cannot hold HBM
+    # forever.
+    spec_pin_budget: int = 32  # max concurrently pinned sessions. Pinning
+    # past the budget spills the OLDEST pin (LRU), and the allocation
+    # pressure ladder (_alloc_with_eviction) spills pins before failing —
+    # pins can never starve admission.
+    spec_max_candidates: int = 4  # cap on declared candidate tool outcomes
+    # speculatively prefilled per step (the COW fan-out bound: each
+    # candidate is one engine-internal prefill job + its suffix pages).
     dtype: str | None = None
 
     @property
@@ -256,6 +277,12 @@ class EngineConfig:
 # cap bounds handoff memory at ~64 pages even under a stuck decode pool.
 _HANDOFF_TTL_S = 60.0
 _HANDOFF_STASH_MAX = 64
+
+# Admission priority of engine-internal speculative prefill jobs: the bottom
+# of every tier order, so speculation only ever consumes idle budget — any
+# caller-submitted request (even priority -1 traffic) admits first, and the
+# preemption probe picks spec slots as its first victims.
+_SPEC_PRIORITY = -(1 << 30)
 
 
 @dataclasses.dataclass
@@ -345,6 +372,29 @@ class Request:
     # (zero prefill, first token = t0); otherwise the request admits
     # normally and greedy re-samples the same t0 — token-exact fallback.
     handoff: dict | None = None
+    # Agent-aware serving (docs/OPERATIONS.md "Agent-aware serving"): the
+    # caller expects a fast follow-up on this session (a tool call is about
+    # to run and its result comes straight back). On finish the engine PINS
+    # the session — its KV stays warm instead of racing session_ttl/LRU
+    # eviction until the follow-up admits or the pin expires
+    # (EngineConfig.spec_pin_ttl). No-op with spec_prefill off or without a
+    # session_id.
+    expect_followup: bool = False
+    # Declared candidate tool outcomes (token sequences): with
+    # expect_followup, each candidate is speculatively prefilled as a
+    # zero-priority engine-internal job over the session's cached prefix in
+    # idle budget; when the real follow-up arrives the prefix index absorbs
+    # the winner (TTFT pays only the unspeculated suffix) and the losers'
+    # pages are freed immediately. Capped at
+    # EngineConfig.spec_max_candidates.
+    followup_candidates: list[list[int]] | None = None
+    # INTERNAL marker: this request IS a speculative prefill job the engine
+    # spawned on behalf of parent request id ``spec_parent``. Such jobs are
+    # disposable — max_new_tokens=1, bottom-priority, their pages stash
+    # into the parent session's speculation state at release instead of
+    # freeing, and no caller ever holds a future/stream for them. Never set
+    # by callers.
+    spec_parent: str | None = None
 
 
 @dataclasses.dataclass
@@ -1344,6 +1394,24 @@ class InferenceEngine:
             # raised — pool donated mid-install or backend error
             "kv_handoff_fail_export_total": 0,  # phase-1 export declined
             # (ineligible request, injected fault, D2H capture failure)
+            # Agent-aware serving (docs/OPERATIONS.md "Agent-aware
+            # serving") — always present so the stats→heartbeat→/metrics
+            # pipeline carries the family even on fleets that never send
+            # expect_followup. These count speculative next-step PREFILL
+            # jobs, not speculative decoding (spec_steps/spec_emitted):
+            "spec_started_total": 0,  # speculative prefill jobs enqueued
+            # (one per declared candidate that passed the caps)
+            "spec_hit_total": 0,  # follow-up admissions that absorbed a
+            # speculated prefix from the index — TTFT paid only the suffix
+            "spec_wasted_tokens_total": 0,  # candidate tokens prefilled for
+            # losers (the price of speculation; see the wasted-tokens
+            # budget guidance in docs/OPERATIONS.md)
+            "spec_cancelled_total": 0,  # speculative jobs cancelled or
+            # their stashed pages dropped (losers at absorb, pin expiry/
+            # spill, client cancel of the parent session)
+            "session_pins_active": 0,  # GAUGE: sessions currently
+            # keep-warm-pinned awaiting a follow-up (bounded by
+            # spec_pin_budget; assigned, not incremented)
         }
         # Cross-request sharing rides on the session prefix-cache switch: one
         # knob (enable_prefix_cache=False) turns ALL KV reuse off for A/B runs.
@@ -1545,6 +1613,21 @@ class InferenceEngine:
         # the queue-wait and TTFT histograms read them at queue-exit and
         # first token. Entries pop at install or cancel.
         self._submit_t: dict[str, float] = {}
+        # Agent-aware serving (docs/OPERATIONS.md "Agent-aware serving").
+        # _pins: session id → pinned-at wall time; a pinned session is
+        # skipped by gc_sessions and by the eviction ladder's first rung
+        # until the follow-up admits or spec_pin_ttl expires.
+        # _spec_by_session: session id → speculation state (parent id,
+        # candidate suffixes by spec-job id, stashed page refs of finished
+        # jobs, trace anchors) — the absorb/cancel bookkeeping for
+        # speculative next-step prefills.
+        self._pins: dict[str, float] = {}  # guarded by: _session_lock
+        self._spec_by_session: dict[str, dict] = {}  # guarded by: _session_lock
+        # Deferred speculative jobs (the spec.stall fault point): (ready-at
+        # monotonic, request) pairs enqueued at the top of step() once ready.
+        # Scheduler-thread state like the starvation fences — _release and
+        # _step_inner both run there.
+        self._spec_stalled: list[tuple[float, Request]] = []
 
     # ------------------------------------------------------------------
     # host-side scheduling
@@ -1958,13 +2041,27 @@ class InferenceEngine:
     def gc_sessions(self, at: float | None = None) -> int:
         """Release pages of sessions idle longer than session_ttl (eviction
         under pressure remains the primary mechanism; this bounds idle
-        retention). Called opportunistically by the model-node drive loop."""
+        retention). Called opportunistically by the model-node drive loop.
+        Keep-warm-pinned sessions (docs/OPERATIONS.md "Agent-aware
+        serving") are exempt while their pin lives; a pin whose follow-up
+        never arrived expires here after spec_pin_ttl — releasing any
+        speculative pages — and the session rejoins the ordinary ttl clock."""
+        t = at if at is not None else time.time()
+        with self._session_lock:
+            if self._pins:
+                for sid in [
+                    s for s, p in self._pins.items()
+                    if t - p > self.ecfg.spec_pin_ttl
+                ]:
+                    self._unpin_session_locked(sid)
         ttl = self.ecfg.session_ttl
         if not ttl:
             return 0
-        t = at if at is not None else time.time()
         with self._session_lock:
-            dead = [sid for sid, s in self._sessions.items() if t - s.last_used > ttl]
+            dead = [
+                sid for sid, s in self._sessions.items()
+                if t - s.last_used > ttl and sid not in self._pins
+            ]
             demote: list[int] = []
             for sid in dead:
                 pages = self._sessions.pop(sid).pages
@@ -1984,6 +2081,11 @@ class InferenceEngine:
     def free_session(self, session_id: str) -> bool:
         """Explicitly drop a session's cached prefix (thread-safe vs step())."""
         with self._session_lock:
+            # An explicit drop is a terminal for the session's agent program:
+            # release its keep-warm pin and speculation state too (no-op
+            # when unpinned) — a freed session must never keep pages warm.
+            if session_id in self._pins or session_id in self._spec_by_session:
+                self._unpin_session_locked(session_id)
             sess = self._sessions.pop(session_id, None)
             if sess is None:
                 return False
@@ -2004,6 +2106,9 @@ class InferenceEngine:
             # their fork_failed terminal) — an idle drive loop must not
             # sleep through them.
             or bool(self._fork_cmds)  # afcheck: ignore[guarded-by] racy truthiness peek like _cancels: a missed append is caught by the next wake, never lost
+            # Stalled speculative prefills (spec.stall chaos) need a step to
+            # re-admit or cancel once their delay elapses.
+            or bool(self._spec_stalled)
         )
 
     def _slots_available(self) -> int:
@@ -2026,10 +2131,29 @@ class InferenceEngine:
             self.stats["page_pressure_injected"] += 1
             return None
         pages = self.allocator.alloc(n)
-        while pages is None and self._sessions:
-            lru_sid = min(self._sessions, key=lambda s: self._sessions[s].last_used)
-            self.allocator.free(self._sessions.pop(lru_sid).pages)
-            self.stats["sessions_evicted"] += 1
+        while pages is None:
+            # Pressure ladder (docs/OPERATIONS.md "Agent-aware serving"):
+            # unpinned idle sessions first (exactly the pre-pin behavior —
+            # with no pins the ladder IS the old LRU loop), then
+            # speculative stashes (disposable by contract), then pinned
+            # sessions LRU-by-pin-age. Pins never starve admission: a live
+            # request always outranks a keep-warm promise.
+            unpinned = [s for s in self._sessions if s not in self._pins]
+            if unpinned:
+                lru_sid = min(unpinned, key=lambda s: self._sessions[s].last_used)
+                self.allocator.free(self._sessions.pop(lru_sid).pages)
+                self.stats["sessions_evicted"] += 1
+            elif self._spec_by_session:
+                self._spec_release_locked(next(iter(self._spec_by_session)))
+            elif self._pins:
+                spill = min(self._pins, key=self._pins.get)  # type: ignore[arg-type]
+                self._unpin_session_locked(spill)
+                sess = self._sessions.pop(spill, None)
+                if sess is not None:
+                    self.allocator.free(sess.pages)
+                    self.stats["sessions_evicted"] += 1
+            else:
+                break
             pages = self.allocator.alloc(n)
         return pages
 
@@ -2056,6 +2180,181 @@ class InferenceEngine:
         # Mismatched history (edited conversation, collision): drop the entry.
         self.allocator.free(self._sessions.pop(req.session_id).pages)
         return None
+
+    # ------------------------------------------------------------------
+    # agent-aware serving: session keep-warm pins + speculative next-step
+    # prefill (docs/OPERATIONS.md "Agent-aware serving"). All state lives
+    # under _session_lock next to the sessions it protects; every failure
+    # mode below degrades to today's cold path (no pin, full prefill on
+    # the follow-up) — never to an error the caller sees.
+    # ------------------------------------------------------------------
+
+    def _pin_session_locked(self, sid: str) -> None:  # guarded by: _session_lock
+        """Keep-warm pin: exempt the session from gc/LRU until its follow-up
+        admits or spec_pin_ttl expires. Over-budget pins spill OLDEST-first
+        — the budget, not demand, bounds pinned HBM."""
+        budget = max(1, self.ecfg.spec_pin_budget)
+        while sid not in self._pins and len(self._pins) >= budget:
+            self._unpin_session_locked(min(self._pins, key=self._pins.get))  # type: ignore[arg-type]
+        self._pins[sid] = time.time()
+        self.stats["session_pins_active"] = len(self._pins)
+
+    def _unpin_session_locked(self, sid: str) -> None:  # guarded by: _session_lock
+        """Drop a session's pin AND its speculation state (stashed pages
+        freed, in-flight spec jobs cancelled). Idempotent — every terminal
+        path may call it."""
+        self._pins.pop(sid, None)
+        self.stats["session_pins_active"] = len(self._pins)
+        self._spec_release_locked(sid)
+
+    def _spec_release_locked(self, sid: str) -> None:  # guarded by: _session_lock
+        """Tear down a session's speculative prefills: finished jobs' page
+        stashes are freed NOW (forget + free — no lingering refcount-0
+        ghosts of wrong guesses), jobs still pending/prefilling cancel
+        through the normal request_cancel path next step."""
+        st = self._spec_by_session.pop(sid, None)
+        if st is None:
+            return
+        for rid in st["cands"]:
+            pages = st["stashes"].pop(rid, None)
+            if pages is None:
+                self._cancels.add(rid)
+            else:
+                self._free_spec_stash_locked(pages)
+            self.stats["spec_cancelled_total"] += 1
+
+    def _free_spec_stash_locked(self, pages: list[int]) -> None:  # guarded by: _session_lock
+        """Free a stashed speculative page chain immediately: sole-holder
+        indexed pages drop their mapping first so free() returns them to
+        the free list instead of leaving refcount-0 cached entries — pages
+        the session (or another stash) still references just decref."""
+        for p in pages:
+            if self.allocator.is_shared(p) and self.allocator.refcount(p) <= 1:
+                self.allocator.forget(p)
+        self.allocator.free(pages)
+
+    def _agent_keepwarm_locked(self, sid: str, slot: _Slot) -> None:  # guarded by: _session_lock
+        """A step of an agent program finished with expect_followup: pin the
+        session, then (for reasoners that declared candidate tool outcomes)
+        enqueue one bottom-priority speculative prefill per candidate over
+        the just-retained session prefix. The spec.fail fault point vetoes
+        speculation (keep-warm only — the degradation every failure shares);
+        spec.stall defers the jobs by delay_s (a follow-up that wins the
+        race absorbs nothing and the stalled jobs cancel, token-exact)."""
+        self._pin_session_locked(sid)
+        cands = slot.req.followup_candidates or []
+        if not cands or not self._shared_prefix:
+            return
+        if _engine_fault("spec.fail") is not None:
+            return  # chaos: keep-warm only, the cold-path ladder's first rung
+        if sid not in self._sessions:
+            return  # retention did not happen (e.g. page churn): cold path
+        stall = _engine_fault("spec.stall")
+        # Speculate over the FULL transcript (slot.tokens = prompt + every
+        # generated token): the agent's next prompt resubmits the whole
+        # response, while the session entry holds tokens[:-1] (the last
+        # token's KV was never written) — the spec job re-prefills that one
+        # token plus the candidate, and publishes the chain the follow-up
+        # will actually walk.
+        st = {
+            "parent": slot.req.id,
+            "base_len": len(slot.tokens),
+            "cands": {},
+            "stashes": {},
+            "t0": {},
+            "tid": (tracing.valid_context(slot.req.trace) or {}).get("trace_id"),
+        }
+        for j, cand in enumerate(cands[: max(0, self.ecfg.spec_max_candidates)]):
+            if not cand:
+                continue
+            srid = f"{slot.req.id}!spec{j}"
+            sreq = Request(
+                id=srid,
+                prompt=list(slot.tokens) + list(cand),
+                sampling=SamplingParams(max_new_tokens=1, temperature=0.0),
+                priority=_SPEC_PRIORITY,
+                spec_parent=slot.req.id,
+            )
+            if self._pages_needed(sreq) > self.ecfg.max_pages_per_seq:
+                continue  # speculated step would overflow a slot: skip it
+            if stall is not None:
+                self._spec_stalled.append(
+                    (time.monotonic() + stall.delay_s, sreq)
+                )
+            elif not self._spec_submit(sreq):
+                continue  # queue saturated: speculation yields, cold path
+            st["cands"][srid] = list(cand)
+            st["t0"][srid] = (time.time(), time.perf_counter())
+            self.stats["spec_started_total"] += 1
+        if st["cands"]:
+            self._spec_by_session[sid] = st
+
+    def _spec_submit(self, sreq: Request) -> bool:
+        """Enqueue an engine-internal speculative job, yielding to real
+        traffic: a full pending queue refuses it (False) instead of ever
+        consuming a caller's backpressure budget."""
+        with self._pending_lock:
+            if len(self.pending) >= self.ecfg.max_pending:
+                return False
+            self._enqueue_locked(sreq)
+        return True
+
+    def _drain_spec_stalled(self) -> None:
+        """Move stall-faulted speculative jobs whose ready-time passed into
+        the pending queue (scheduler thread, top of step). Jobs that cannot
+        enqueue yet (queue full) retry next step; jobs cancelled while
+        deferred were already filtered out by _drain_cancels."""
+        if not self._spec_stalled:
+            return
+        now = time.monotonic()
+        ready = [(rt, r) for rt, r in self._spec_stalled if rt <= now]
+        if not ready:
+            return
+        self._spec_stalled = [(rt, r) for rt, r in self._spec_stalled if rt > now]
+        for rt, r in ready:
+            if not self._spec_submit(r):
+                self._spec_stalled.append((rt, r))
+
+    def _spec_absorb(self, req: Request, start: int) -> None:
+        """The real follow-up for a pinned session just left the queue:
+        release the pin, settle the speculation — the winner's stash refs
+        drop (the follow-up holds its own), losers' pages free immediately,
+        still-running jobs cancel. Counters are the triage surface:
+        hit/wasted/cancelled (docs/OPERATIONS.md "Agent-aware serving")."""
+        sid = req.session_id
+        with self._session_lock:
+            if sid not in self._pins and sid not in self._spec_by_session:
+                return
+            self._pins.pop(sid, None)
+            self.stats["session_pins_active"] = len(self._pins)
+            st = self._spec_by_session.pop(sid, None)
+            if st is None:
+                return
+            suffix = req.prompt[st["base_len"]:]
+            winner = None
+            for rid, cand in st["cands"].items():
+                if (
+                    rid in st["stashes"]
+                    and len(cand) <= len(suffix)
+                    and suffix[: len(cand)] == cand
+                ):
+                    winner = rid
+                    break
+            if winner is not None and start > st["base_len"]:
+                # The acquisition walk matched past the session prefix:
+                # those extra pages ARE the speculated candidate.
+                self.stats["spec_hit_total"] += 1
+            for rid, cand in st["cands"].items():
+                pages = st["stashes"].pop(rid, None)
+                if pages is None:
+                    self._cancels.add(rid)  # still prefilling: disposable
+                    self.stats["spec_cancelled_total"] += 1
+                elif rid == winner:
+                    self.allocator.free(pages)  # follow-up holds its own refs
+                else:
+                    self.stats["spec_wasted_tokens_total"] += len(cand)
+                    self.stats["spec_cancelled_total"] += 1
+                    self._free_spec_stash_locked(pages)
 
     def _prompt_hashes(self, req: Request) -> list[bytes]:
         """Memoized page-chain hashes of the request's matchable prompt
@@ -2382,6 +2681,27 @@ class InferenceEngine:
         index_hit = False
         with self._session_lock:  # RLock: callers may already hold it
             hit = self._session_hit(req)
+            if (
+                hit is not None
+                and self.ecfg.spec_prefill
+                and self._shared_prefix
+                and not req.mm_embeds
+                and len(req.prompt) > 1
+                and self.allocator.peek(
+                    req.prompt[: len(req.prompt) - 1],
+                    hashes=self._prompt_hashes(req),
+                )
+                > hit[1]
+            ):
+                # Agent-aware serving: the shared index holds MORE of this
+                # prompt than the session entry — a speculative next-step
+                # prefill published the follow-up's tokens while the tool
+                # ran. Ride the index walk instead (the absorb); the
+                # session entry stays put and its refs release normally
+                # when this request finishes and re-retains the session.
+                # Gated on spec_prefill: knob-off acquisition is
+                # bit-compatible with today's.
+                hit = None
             total_pages = self._pages_needed(req)
 
             if hit is not None:
@@ -2499,6 +2819,15 @@ class InferenceEngine:
             # preempt/resume cycle rode the prefix index instead of paying a
             # full re-prefill (docs/FAULT_TOLERANCE.md overload control).
             self.stats["resume_prefix_hits_total"] += 1
+        if (
+            self.ecfg.spec_prefill
+            and req.session_id
+            and req.spec_parent is None
+        ):
+            # Agent-aware serving: a follow-up on a pinned session settles
+            # the pin + any speculative prefills (hit/waste accounting,
+            # loser pages freed). One dict check for unpinned sessions.
+            self._spec_absorb(req, start)
 
     def _admit_single(self, req: Request, free_slot: int) -> list[TokenEvent]:
         """Single-request admission: session prefix-cache reuse, cross-request
@@ -3209,6 +3538,13 @@ class InferenceEngine:
         return ev
 
     def _release(self, slot_idx: int, slot: _Slot) -> None:
+        if slot.req.spec_parent is not None:
+            # Engine-internal speculative prefill: publish + stash instead
+            # of session retention (docs/OPERATIONS.md "Agent-aware
+            # serving") — the parent session's absorb/teardown owns the
+            # pages from here.
+            self._release_spec(slot_idx, slot)
+            return
         sid = slot.req.session_id
         with self._session_lock:
             if self._shared_prefix and not slot.req.mm_embeds and len(slot.tokens) > 1:
@@ -3239,6 +3575,12 @@ class InferenceEngine:
                 self._sessions[sid] = _SessionEntry(
                     pages=slot.pages[:keep], tokens=cached, last_used=time.time()
                 )
+                if self.ecfg.spec_prefill and slot.req.expect_followup:
+                    # Agent-aware serving: pin the just-retained session and
+                    # speculatively prefill declared candidate follow-ups in
+                    # idle budget. Gated on spec_prefill so the knob-off
+                    # scheduler is bit-compatible with today's.
+                    self._agent_keepwarm_locked(sid, slot)
             else:
                 self.allocator.free(slot.pages)
         self.stats["requests_finished"] += 1
@@ -3255,6 +3597,51 @@ class InferenceEngine:
         self.eos_ids[slot_idx] = -1
         with self._session_lock:
             self._grammar_release(slot.req.grammar)
+        self._dirty = True
+        self._compact = None  # membership changed
+
+    def _release_spec(self, slot_idx: int, slot: _Slot) -> None:
+        """Release a finished speculative prefill job: publish its pages
+        (the candidate prefix is now content-addressed for the follow-up's
+        acquisition walk to absorb) and STASH the refs in the parent
+        session's speculation state instead of freeing — absorb or teardown
+        settles them. A job whose state was already torn down (pin spilled,
+        session cancelled mid-prefill) just frees; requests_finished is not
+        bumped (internal work is not throughput)."""
+        with self._session_lock:
+            st = None
+            for entry in self._spec_by_session.values():
+                if slot.req.id in entry["cands"]:
+                    st = entry
+                    break
+            if st is not None and self._shared_prefix and len(slot.tokens) > 1:
+                self.allocator.publish(slot.tokens[:-1], slot.pages)
+                st["stashes"][slot.req.id] = slot.pages
+                t0 = st["t0"].get(slot.req.id)
+                if st["tid"] is not None and t0 is not None:
+                    # The speculative window, parent-attributed: enqueue →
+                    # prefill done, with the candidate length it covered.
+                    self._tracer.record_span(
+                        "engine.spec_prefill", st["tid"], t0[0],
+                        (time.perf_counter() - t0[1]) * 1e3,
+                        {
+                            "parent": st["parent"],
+                            "tokens": len(st["cands"][slot.req.id]),
+                        },
+                    )
+            else:
+                self.allocator.free(slot.pages)
+        with self._pending_lock:
+            self._deadline_at.pop(slot.req.id, None)
+        if self.slots[slot_idx] is slot:
+            self.slots[slot_idx] = None
+        self.page_tables[slot_idx] = 0
+        self.seq_lens[slot_idx] = 0
+        self.temps[slot_idx] = 0.0
+        self.top_ks[slot_idx] = 0
+        self.top_ps[slot_idx] = 1.0
+        self.grammar_states[slot_idx] = 0
+        self.eos_ids[slot_idx] = -1
         self._dirty = True
         self._compact = None  # membership changed
 
@@ -3454,6 +3841,15 @@ class InferenceEngine:
             with self._session_lock:
                 for r in dropped:
                     self._grammar_release(r.grammar)
+                    if r.session_id and (
+                        r.session_id in self._pins
+                        or r.session_id in self._spec_by_session
+                    ):
+                        # A cancelled follow-up must not leave its session
+                        # keep-warm: release the pin and any speculative
+                        # stashes so the pages return to the pool now
+                        # instead of riding the pin TTL.
+                        self._unpin_session_locked(r.session_id)
             for r in dropped:
                 self._req_hashes.pop(r.id, None)
                 matched.add(r.id)
@@ -3472,6 +3868,13 @@ class InferenceEngine:
                 with self._session_lock:
                     self.allocator.free(slot.pages)
                     self._grammar_release(slot.req.grammar)
+                    sid = slot.req.session_id
+                    if sid and (
+                        sid in self._pins or sid in self._spec_by_session
+                    ):
+                        # Same terminal-path audit as the pending drop above:
+                        # cancel tears down the session's pin + spec state.
+                        self._unpin_session_locked(sid)
                 self.slots[i] = None
                 self.page_tables[i] = 0
                 self.seq_lens[i] = 0
@@ -3483,6 +3886,17 @@ class InferenceEngine:
                 self._dirty = True
                 self._compact = None
                 self.stats["requests_cancelled"] += 1
+        if self._spec_stalled:
+            # Speculative jobs still sitting out a spec.stall delay are
+            # cancellable too (their session was unpinned above): drop them
+            # before they ever reach the queue.
+            live = [e for e in self._spec_stalled if e[1].id not in cancels]
+            if len(live) != len(self._spec_stalled):
+                for _t, r in self._spec_stalled:
+                    if r.id in cancels:
+                        matched.add(r.id)
+                        self.stats["requests_cancelled"] += 1
+                self._spec_stalled = live
         for rid in matched:
             self._submit_t.pop(rid, None)
             self._tr_close(
@@ -4026,6 +4440,7 @@ class InferenceEngine:
             # first so a post-mutation rebuild starts from harvested state.
             events += self._harvest_inflight()
         self._drain_cancels(expected=set(expired))
+        self._drain_spec_stalled()  # spec.stall releases (no-op when empty)
         if self._fork_cmds:  # afcheck: ignore[guarded-by] racy truthiness peek; _apply_forks swaps the list under the lock
             # After cancels: a prune-then-refork burst from a branch group
             # must see the pruned slots already freed (their pages fund the

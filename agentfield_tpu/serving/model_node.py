@@ -815,6 +815,14 @@ class ModelBackend:
         handoff: dict | None = None,  # disaggregated pools, phase 2: the
         # prefill node's handoff descriptor — admission live-installs the
         # adopted pages + stashed tail and resumes decoding token-exact
+        expect_followup: bool = False,  # agent-aware serving: a follow-up on
+        # this session is expected (declared or gateway-inferred) — the
+        # engine pins the session's KV warm after this request finishes
+        # (docs/OPERATIONS.md "Agent-aware serving")
+        followup_candidates: list | None = None,  # candidate next-step
+        # suffixes (strings or token lists) a reasoner declared: the engine
+        # speculatively prefills each over the retained session in idle
+        # budget; a hint only — invalid entries are dropped, never errors
     ) -> tuple[str, int]:
         """Shared tokenize/validate/submit path for both completion styles.
 
@@ -885,6 +893,9 @@ class ModelBackend:
                 stop_token_ids = [eos]
         if isinstance(priority, bool) or not isinstance(priority, int):
             raise ValueError(f"priority must be an integer, got {priority!r}")
+        cand_tokens = self._followup_cand_tokens(
+            followup_candidates if expect_followup else None
+        )
         self._next += 1
         rid = f"gen_{self._next}"
         register(rid)
@@ -909,6 +920,8 @@ class ModelBackend:
                     trace=trace,
                     handoff_export=handoff_export,
                     handoff=handoff,
+                    expect_followup=bool(expect_followup),
+                    followup_candidates=cand_tokens,
                 )
             )
         except Exception:
@@ -916,6 +929,46 @@ class ModelBackend:
             raise
         self._wake.set()
         return rid, truncated
+
+    def _followup_cand_tokens(self, cands) -> list[list[int]] | None:
+        """Normalize declared follow-up candidates (agent-aware serving)
+        into token lists for the engine's speculative prefill. A HINT, so
+        degradation beats rejection: no tokenizer for a string candidate,
+        an empty candidate, or a non-list container → the candidate (or all
+        of them) is dropped and the request proceeds keep-warm-only.
+        Malformed ELEMENTS inside a declared list still raise — a caller
+        that got the type wrong should hear it, same contract as tokens."""
+        if not cands:
+            return None
+        if not isinstance(cands, (list, tuple)):
+            raise ValueError(
+                f"followup_candidates must be a list, got {type(cands).__name__}"
+            )
+        cap = max(0, self.engine.ecfg.spec_max_candidates)
+        out: list[list[int]] = []
+        for cand in cands:
+            if len(out) >= cap:
+                break  # over-declared: the engine would drop them anyway
+            if isinstance(cand, str):
+                if self.tokenizer is None:
+                    continue  # cannot tokenize: keep-warm only for this one
+                toks = self.tokenizer.encode(cand)
+            elif isinstance(cand, (list, tuple)):
+                toks = list(cand)
+                if not all(
+                    isinstance(t, int) and not isinstance(t, bool) for t in toks
+                ):
+                    raise ValueError(
+                        "followup_candidates token lists must contain only ints"
+                    )
+            else:
+                raise ValueError(
+                    "each followup candidate must be a string or a token list, "
+                    f"got {type(cand).__name__}"
+                )
+            if toks:
+                out.append(toks)
+        return out or None
 
     def apply_chat_template(self, messages: list[dict]) -> str:
         """[{role, content}] → one prompt string. HF tokenizers use their
@@ -1368,6 +1421,12 @@ class ModelBackend:
         # lifecycle spans are recorded against its trace_id and shipped
         # back in ``result["trace"]`` (the gateway pops the key before the
         # result is persisted). Absent/invalid → no spans, no result key.
+        expect_followup: bool = False,  # agent-aware serving: pin this
+        # request's session warm after it finishes — a follow-up is coming
+        # (docs/OPERATIONS.md "Agent-aware serving")
+        followup_candidates: list | None = None,  # candidate next-step
+        # suffixes (strings or token lists) to speculatively prefill over
+        # the retained session in idle budget; requires expect_followup
     ) -> dict[str, Any]:
         if output not in ("text", "audio", "speech", "image"):
             raise ValueError(
@@ -1499,6 +1558,8 @@ class ModelBackend:
             trace=trace,
             handoff_export=handoff_export,
             handoff=handoff,
+            expect_followup=expect_followup,
+            followup_candidates=followup_candidates,
         )
         try:
             result = await fut
@@ -1581,6 +1642,10 @@ class ModelBackend:
         handoff: dict | None = None,  # disaggregated pools, phase 2 (a
         # streamed phase-2 resume): see generate(). Phase 1 itself is
         # never streamed — the gateway submits it unary.
+        expect_followup: bool = False,  # agent-aware serving keep-warm
+        # hint — see generate()
+        followup_candidates: list | None = None,  # speculative next-step
+        # candidates — see generate()
     ) -> tuple[str, asyncio.Queue, int]:
         """Streaming variant: returns (request_id, queue of TokenEvents,
         truncated_prompt_tokens) — the truncation count rides along so
@@ -1635,6 +1700,8 @@ class ModelBackend:
             n_branches=n_branches,
             trace=tracing.valid_context(trace),
             handoff=handoff,
+            expect_followup=expect_followup,
+            followup_candidates=followup_candidates,
         )
         return rid, q, truncated
 
@@ -2009,6 +2076,24 @@ def build_model_node(
             ecfg = _dc2.replace(ecfg, prefix_sketch_bytes=int(_sk))
         except ValueError:
             pass  # malformed env override keeps the configured default
+    # Agent-aware serving knobs (docs/OPERATIONS.md "Agent-aware serving
+    # (runbook)"): same contract as the sketch override — a malformed value
+    # keeps the configured default, never fails serve startup.
+    import dataclasses as _dc3
+
+    _spec_env = (
+        ("AGENTFIELD_SPEC_PREFILL", "spec_prefill", lambda v: v.strip().lower() not in ("0", "false", "no", "off")),
+        ("AGENTFIELD_SPEC_PIN_TTL_S", "spec_pin_ttl", float),
+        ("AGENTFIELD_SPEC_PIN_BUDGET", "spec_pin_budget", int),
+        ("AGENTFIELD_SPEC_MAX_CANDIDATES", "spec_max_candidates", int),
+    )
+    for _env_name, _field, _parse in _spec_env:
+        _v = _os.environ.get(_env_name)
+        if _v is not None:
+            try:
+                ecfg = _dc3.replace(ecfg, **{_field: _parse(_v)})
+            except ValueError:
+                pass
     draft = None
     if spec_k is not None:
         import dataclasses as _dc
@@ -2112,7 +2197,7 @@ def build_model_node(
                 "max_new_tokens", "temperature", "top_k", "top_p",
                 "response_schema", "context_overflow", "images", "audios",
                 "deadline_s", "priority", "n_branches", "branch_policy",
-                "trace", "handoff",
+                "trace", "handoff", "expect_followup", "followup_candidates",
             )
             if body.get(k) is not None
         }

@@ -86,6 +86,17 @@ Scenarios (AGENTFIELD_BENCH_SCENARIO):
     Reports resume TTFT p50/p99 both modes, restore hit rate, and the
     kv_offload_* counters; headline value = resume TTFT p50 speedup
     (OFF/ON; acceptance: > 1.0). AGENTFIELD_BENCH_SESSIONS sizes the set.
+  agent_chain — agent-aware serving bench (docs/OPERATIONS.md "Agent-aware
+    serving"): N-step tool-call chains (session-carrying generates that
+    declare expect_followup + candidate tool outcomes, separated by a
+    tool gap that outlives session_ttl), run twice on fresh engines —
+    spec_prefill ON (keep-warm pin + speculative next-step prefill) vs OFF
+    (bit-compatible pre-hint dispatch; the gap collects the session and
+    follow-ups re-prefill their whole history). Reports per-step and
+    pooled follow-up TTFT p50/p99 both modes, speculation hit rate,
+    wasted-token accounting, prefill tokens, and zero-leaked-pages audits.
+    Headline value = follow-up TTFT p50 speedup OFF/ON (acceptance: >= 2.0
+    at success parity). AGENTFIELD_BENCH_CHAINS / _STEPS size the run.
   kv_quant — quantized-KV capacity bench (docs/PREFIX_CACHING.md
     "Capacity math", docs/KERNELS.md "Quantized pages"): the session-churn
     overload shape at a FIXED HBM byte budget, run twice on fresh engines —
@@ -404,6 +415,11 @@ SCENARIOS: dict[str, dict] = {
         "dispatch_before_probe": False,
         "run": lambda c: _kv_quant(c["model"], c["cfg"], c["params"], c["attn"]),
         "doc": "quantized KV pages: capacity A/B at fixed HBM bytes, quant on vs off",
+    },
+    "agent_chain": {
+        "dispatch_before_probe": False,
+        "run": lambda c: _agent_chain(c["model"], c["cfg"], c["params"], c["attn"]),
+        "doc": "N-step tool-call chains: keep-warm + speculative prefill ON vs OFF",
     },
     "best_of_n": {
         "dispatch_before_probe": False,
@@ -1316,6 +1332,213 @@ def _session_churn(model: str, cfg, params, attn: str) -> None:
         }
     )
 
+
+
+def _agent_chain(model: str, cfg, params, attn: str) -> None:
+    """Agent-aware serving A/B (docs/OPERATIONS.md "Agent-aware serving"):
+    N-step tool-call chains — each step a session-carrying generate that
+    declares expect_followup + candidate tool outcomes, separated by a
+    tool-call gap long enough that session_ttl would collect the idle KV.
+    Run twice on fresh engines: spec_prefill ON (keep-warm pin survives the
+    gap; the speculated candidate absorbs into the follow-up's prefix walk)
+    vs OFF (bit-compatible pre-hint dispatch: the gap collects the session,
+    every follow-up re-prefills its whole history). The gap is simulated
+    deterministically via gc_sessions(at=...) — the same collection the
+    wall clock would run, without sleeping the bench. Headline: follow-up
+    step TTFT p50 speedup OFF/ON (acceptance: >= 2.0 at success parity),
+    plus speculation hit rate, wasted-token accounting, and zero-leaked-
+    pages audits in both modes."""
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+    from tools.perf.load_gen import run_agent_chains
+
+    chains = int(os.environ.get("AGENTFIELD_BENCH_CHAINS") or 6)
+    steps = int(os.environ.get("AGENTFIELD_BENCH_STEPS") or 3)
+    # History long enough that the OFF follow-up's full re-prefill (bucket
+    # 512) costs real FLOPs next to the ON path's few-token suffix prefill;
+    # tool results sized so candidate speculation has something to absorb.
+    prompt_len, step_new, tool_len, tail_len = 320, 8, 24, 4
+    churn_len, churn_reqs = 480, 4  # sessionless gap traffic (15 pages each)
+    ecfg_on = EngineConfig(
+        max_batch=2,
+        page_size=32,
+        num_pages=48,  # small enough that the gap churn cycles the LRU cache
+        max_pages_per_seq=16,
+        max_pending=64,
+        prefill_batch=1,
+        attn_impl="pallas" if attn == "pallas" else "ref",
+        prefill_impl="flash" if attn == "pallas" else "ref",
+        decode_span=1,  # per-token arrival: honest TTFT
+        session_ttl=0.25,  # the tool gap ALWAYS outlives the ttl
+        spec_prefill=True,
+        spec_pin_ttl=120.0,
+    )
+    ecfg_off = dataclasses.replace(ecfg_on, spec_prefill=False)
+
+    def toks(seed, n):
+        return jax.random.randint(
+            jax.random.PRNGKey(seed), (n,), 0, cfg.vocab_size, jnp.int32
+        ).tolist()
+
+    def root(i):
+        return toks(1000 + i, prompt_len)
+
+    def tool_result(i, j):
+        return toks(2000 + i * 37 + j, tool_len)
+
+    def decoy(i, j):
+        return toks(3000 + i * 37 + j, tool_len)
+
+    def tail(i, j):
+        return toks(4000 + i * 37 + j, tail_len)
+
+    def churn(i, j, k):
+        return toks(5000 + i * 1009 + j * 101 + k, churn_len)
+
+    def run_one(engine, req):
+        """Submit one request on an idle engine; returns (ttft_s, tokens)."""
+        engine.submit(req)
+        t0 = time.perf_counter()
+        ttft, out = None, []
+        while engine.has_work():
+            for ev in engine.step():
+                if ev.token >= 0 and ev.request_id == req.id:
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    out.append(ev.token)
+        return ttft, out
+
+    def req(rid, prompt, session, cands=None):
+        return Request(
+            id=rid,
+            prompt=prompt,
+            sampling=SamplingParams(max_new_tokens=step_new),
+            session_id=session,
+            expect_followup=True,
+            followup_candidates=cands,
+        )
+
+    if not _budget_gate("agent_chain", 150):
+        _emit(_fallback_payload("budget exhausted before agent_chain"))
+        return
+
+    def run_mode(ecfg):
+        # Warm every compile path out of the timing: root prefill (bucket
+        # 512), the OFF full re-prefill (same bucket), the warm suffix
+        # prefill (bucket 32), the hit path's few-token absorb (bucket 8),
+        # and decode.
+        warm = InferenceEngine(params, cfg, ecfg)
+        _, w_out = run_one(warm, req("w", root(999), "w", [tool_result(999, 1)]))
+        hist = root(999) + w_out
+        # warm suffix prefill (bucket 32) AND the hit path's few-token
+        # absorb (bucket 8) — on the ON engine the speculated candidate is
+        # already resident, so this follow-up only prefills the tail
+        _, w2_out = run_one(
+            warm, req("w2", hist + tool_result(999, 1) + tail(999, 1), "w")
+        )
+        hist2 = hist + tool_result(999, 1) + tail(999, 1) + w2_out
+        run_one(warm, req("w3", hist2 + tail(997, 1), "w"))
+        run_one(warm, req("w4", hist + decoy(998, 1) + tail(998, 1), None))
+        run_one(
+            warm,
+            Request(
+                id="w5", prompt=churn(999, 0, 0),
+                sampling=SamplingParams(max_new_tokens=1),
+            ),
+        )
+        warm.free_session("w")
+        warm.close()
+        del warm
+
+        engine = InferenceEngine(params, cfg, ecfg)
+        histories: dict[int, list[int]] = {}
+
+        async def execute_step(i, j, prev):
+            if j == 0:
+                prompt = root(i)
+            else:
+                # The simulated tool call "ran" during the gap: the ttl
+                # collects any unpinned session, and unrelated traffic
+                # churns the refcount-0 prefix cache the collected KV fell
+                # into. A pinned session holds REFERENCES, so the ON mode
+                # rides this out; the OFF mode's follow-up finds nothing.
+                engine.gc_sessions(at=time.time() + ecfg.session_ttl + 1)
+                for k in range(churn_reqs):
+                    run_one(
+                        engine,
+                        Request(
+                            id=f"x{i}s{j}k{k}", prompt=churn(i, j, k),
+                            sampling=SamplingParams(max_new_tokens=1),
+                        ),
+                    )
+                prompt = histories[i] + tool_result(i, j) + tail(i, j)
+            cands = (
+                [decoy(i, j + 1), tool_result(i, j + 1)] if j < steps - 1 else None
+            )
+            ttft, out = run_one(engine, req(f"c{i}s{j}", prompt, f"s{i}", cands))
+            histories[i] = prompt + out
+            status = "completed" if len(out) == step_new else "short"
+            if j == steps - 1:
+                engine.free_session(f"s{i}")
+            return status, ttft, None
+
+        report = asyncio.run(
+            run_agent_chains(
+                "", "engine.generate", chains, steps, concurrency=1,
+                execute_step=execute_step,
+            )
+        )
+        for i in range(chains):
+            engine.free_session(f"s{i}")
+        leaked = (ecfg.num_pages - 1) - engine.allocator.free_pages
+        stats = dict(engine.stats)
+        engine.close()
+        return report, stats, leaked
+
+    _partial["stage"] = "agent_chain spec ON"
+    on_rep, on_stats, on_leak = run_mode(ecfg_on)
+    _partial["stage"] = "agent_chain spec OFF"
+    off_rep, off_stats, off_leak = run_mode(ecfg_off)
+
+    followups = chains * (steps - 1)
+    on_p50 = on_rep["followup_ttft_ms"]["p50"]
+    off_p50 = off_rep["followup_ttft_ms"]["p50"]
+    _emit(
+        {
+            "metric": f"agent_chain_{model}_{chains}x{steps}steps",
+            "value": _ratio(off_p50, on_p50),
+            "unit": "followup_ttft_p50_speedup_off_over_on",
+            "followup_ttft_ms_p50_on": on_p50,
+            "followup_ttft_ms_p99_on": on_rep["followup_ttft_ms"]["p99"],
+            "followup_ttft_ms_p50_off": off_p50,
+            "followup_ttft_ms_p99_off": off_rep["followup_ttft_ms"]["p99"],
+            "step_ttft_ms_on": on_rep["step_ttft_ms"],
+            "step_ttft_ms_off": off_rep["step_ttft_ms"],
+            "spec_hit_rate": round(on_stats["spec_hit_total"] / max(1, followups), 4),
+            "spec_started": on_stats["spec_started_total"],
+            "spec_hits": on_stats["spec_hit_total"],
+            "spec_wasted_tokens": on_stats["spec_wasted_tokens_total"],
+            "spec_cancelled": on_stats["spec_cancelled_total"],
+            "spec_started_off": off_stats["spec_started_total"],
+            "prefill_tokens_on": on_stats["prefill_tokens"],
+            "prefill_tokens_off": off_stats["prefill_tokens"],
+            "success_rate_on": on_rep["success_rate"],
+            "success_rate_off": off_rep["success_rate"],
+            "leaked_pages_on": on_leak,
+            "leaked_pages_off": off_leak,
+            "chains": chains,
+            "steps": steps,
+            "prompt_len": prompt_len,
+            "session_ttl_s": ecfg_on.session_ttl,
+            "attn_impl": attn,
+            "device": str(jax.devices()[0]),
+        }
+    )
 
 
 def _kv_quant(model: str, cfg, params, attn: str) -> None:

@@ -1,0 +1,530 @@
+"""Agent-program-aware serving (docs/OPERATIONS.md "Agent-aware serving"):
+session KV keep-warm pins + speculative next-step prefill.
+
+Covers the contracts ISSUE 19 pins:
+  - keep-warm + speculation hit: the follow-up absorbs the speculated
+    candidate through the shared-prefix index, token-exact vs a fresh
+    engine, counted (spec_started/spec_hit), pin released on admission;
+  - the degradation ladder: a miss wastes exactly the candidate's tokens
+    and still runs token-exact over the retained session; pin-budget
+    exhaustion spills oldest-first; page pressure evicts spec stashes and
+    pins last; seeded spec.fail / spec.stall chaos degrades to keep-warm-
+    only, token-exact, zero pages leaked;
+  - knob-off (`spec_prefill=False` / AGENTFIELD_SPEC_PREFILL=0) is
+    bit-compatible with no-hint dispatch: same tokens, same prefill
+    accounting, no new counters move, no wire-body injection;
+  - every terminal path (client cancel, explicit free_session, gc TTL
+    expiry) releases the pin AND the speculation state with zero leaked
+    pages;
+  - the gateway half: execute-body `expect_followup` validation (400 on
+    non-bool), declared-or-DAG-inferred hint injection into model-node
+    dispatch, and pool-aware phase-2 decode placement (an idle decode
+    node beats a loaded one; a stats-less fleet keeps the round-robin
+    order bit-for-bit).
+"""
+
+import dataclasses
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentfield_tpu.control_plane import faults
+from agentfield_tpu.control_plane.dag import infer_expect_followup
+from agentfield_tpu.control_plane.registry import NodeSnapshotCache
+from agentfield_tpu.control_plane.types import (
+    Execution,
+    ExecutionStatus,
+    TargetType,
+)
+from agentfield_tpu.models import get_config, init_params
+from agentfield_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+from tests.helpers_cp import CPHarness, async_test
+
+CFG = get_config("llama-tiny")
+ECFG = EngineConfig(max_batch=2, page_size=8, num_pages=64, max_pages_per_seq=8)
+BASE_FREE = ECFG.num_pages - 1  # page 0 is the reserved garbage page
+
+SPEC_COUNTERS = (
+    "spec_started_total",
+    "spec_hit_total",
+    "spec_wasted_tokens_total",
+    "spec_cancelled_total",
+    "session_pins_active",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompt(key, n):
+    return jax.random.randint(jax.random.PRNGKey(key), (n,), 0, CFG.vocab_size, jnp.int32).tolist()
+
+
+def _run(engine, rid, prompt, max_new=4, session=None, ef=False, cands=None):
+    return engine.run_to_completion(
+        [
+            Request(
+                id=rid,
+                prompt=prompt,
+                sampling=SamplingParams(max_new_tokens=max_new),
+                session_id=session,
+                expect_followup=ef,
+                followup_candidates=cands,
+            )
+        ]
+    )[rid]
+
+
+def _assert_quiescent(engine):
+    """Terminal invariant shared by every test: no pins, no speculation
+    state, no deferred jobs, and every page back in the allocator."""
+    engine.free_session("sess")
+    assert engine._pins == {}
+    assert engine._spec_by_session == {}
+    assert engine._spec_stalled == []
+    assert engine.allocator.free_pages == engine.ecfg.num_pages - 1
+
+
+def test_spec_fault_points_are_known():
+    assert "spec.fail" in faults.KNOWN_POINTS
+    assert "spec.stall" in faults.KNOWN_POINTS
+
+
+def test_infer_expect_followup_dag_rule():
+    # only a NON-ROOT step of a session-carrying chain infers the hint
+    assert infer_expect_followup("exec_parent", "sess") is True
+    assert infer_expect_followup(None, "sess") is False
+    assert infer_expect_followup("exec_parent", None) is False
+    assert infer_expect_followup(None, None) is False
+    assert infer_expect_followup("", "") is False
+
+
+def test_spec_counters_always_present(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    for name in SPEC_COUNTERS:
+        assert engine.stats[name] == 0, name
+
+
+# ---------------------------------------------------------------------------
+# tentpole: keep-warm + speculative next-step prefill
+
+
+def test_keepwarm_hit_absorbs_speculated_prefix(params):
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+
+    engine = InferenceEngine(params, CFG, ECFG)
+    out1 = _run(engine, "s1", t1, session="sess", ef=True, cands=[cand])
+    assert engine.stats["spec_started_total"] == 1
+    assert engine.stats["session_pins_active"] == 1
+    assert "sess" in engine._pins
+
+    follow = t1 + out1 + cand + _prompt(3, 2)
+    prefill_before = engine.stats["prefill_tokens"]
+    out2 = _run(engine, "s2", follow, session="sess")
+    assert engine.stats["spec_hit_total"] == 1
+    assert engine.stats["spec_wasted_tokens_total"] == 0
+    assert engine.stats["session_pins_active"] == 0  # released on admission
+    # TTFT pays only the unspeculated suffix: the follow-up prefilled
+    # strictly fewer tokens than the candidate+suffix it arrived with
+    assert engine.stats["prefill_tokens"] - prefill_before < len(cand) + 2 + 1
+
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "f", follow), "hit path diverged from fresh engine"
+    _assert_quiescent(engine)
+
+
+def test_speculation_miss_degrades_token_exact_zero_leak(params):
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+
+    engine = InferenceEngine(params, CFG, ECFG)
+    out1 = _run(engine, "m1", t1, session="sess", ef=True, cands=[cand])
+    # the real tool result shares nothing with the candidate
+    wrong = t1 + out1 + _prompt(7, 6) + _prompt(3, 2)
+    out2 = _run(engine, "m2", wrong, session="sess")
+    assert engine.stats["spec_hit_total"] == 0
+    assert engine.stats["spec_wasted_tokens_total"] == len(cand)
+    assert engine.stats["spec_cancelled_total"] == 1
+    assert engine.stats["session_pins_active"] == 0
+
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "f", wrong), "miss path diverged from fresh engine"
+    _assert_quiescent(engine)
+
+
+def test_multi_candidate_winner_and_losers(params):
+    t1 = _prompt(1, 10)
+    loser = _prompt(11, 8)
+    winner = _prompt(2, 9)
+
+    engine = InferenceEngine(params, CFG, ECFG)
+    out1 = _run(engine, "c1", t1, session="sess", ef=True, cands=[loser, winner])
+    assert engine.stats["spec_started_total"] == 2
+    follow = t1 + out1 + winner + _prompt(3, 2)
+    out2 = _run(engine, "c2", follow, session="sess")
+    assert engine.stats["spec_hit_total"] == 1
+    assert engine.stats["spec_wasted_tokens_total"] == len(loser)
+
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "f", follow)
+    _assert_quiescent(engine)
+
+
+def test_knob_off_bit_compatible(params):
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+
+    off = InferenceEngine(params, CFG, dataclasses.replace(ECFG, spec_prefill=False))
+    out1 = _run(off, "k1", t1, session="sess", ef=True, cands=[cand])
+    assert off.stats["spec_started_total"] == 0
+    assert off.stats["session_pins_active"] == 0
+    follow = t1 + out1 + cand + _prompt(3, 2)
+    out2 = _run(off, "k2", follow, session="sess")
+
+    base = InferenceEngine(params, CFG, ECFG)  # no hint at all
+    b1 = _run(base, "k1", t1, session="sess")
+    b2 = _run(base, "k2", t1 + b1 + cand + _prompt(3, 2), session="sess")
+    assert (out1, out2) == (b1, b2), "knob-off diverged from no-hint dispatch"
+    assert off.stats["prefill_tokens"] == base.stats["prefill_tokens"]
+    _assert_quiescent(off)
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+
+
+def test_pin_budget_exhaustion_spills_oldest(params):
+    ecfg = dataclasses.replace(ECFG, spec_pin_budget=1)
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "a1", _prompt(1, 10), session="a", ef=True, cands=[_prompt(2, 9)])
+    assert set(engine._pins) == {"a"}
+    _run(engine, "b1", _prompt(4, 10), session="b", ef=True, cands=[_prompt(5, 9)])
+    # the budget, not demand, bounds pinned HBM: oldest pin spilled
+    assert set(engine._pins) == {"b"}
+    assert engine.stats["session_pins_active"] == 1
+    assert "a" not in engine._spec_by_session  # stash freed with the pin
+    engine.free_session("a")
+    engine.free_session("b")
+    assert engine._pins == {}
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+
+
+def test_page_pressure_evicts_spec_state_before_failing(params):
+    """The eviction ladder's last rungs: under page pressure a pinned
+    session's spec stashes, then the pin itself, yield to live traffic."""
+    ecfg = dataclasses.replace(
+        ECFG, num_pages=9, max_pages_per_seq=8
+    )  # 8 allocatable pages
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "a", _prompt(6, 8), session="hog", ef=True, cands=[_prompt(2, 6)])
+    assert "hog" in engine._pins
+    # a sessionless request needing every page forces the full ladder
+    out = _run(engine, "b", _prompt(7, 50), max_new=8)
+    assert len(out) == 8
+    assert engine._pins == {}
+    assert engine._spec_by_session == {}
+    assert "hog" not in engine._sessions
+
+
+def test_spec_fail_chaos_keepwarm_only_token_exact_zero_leak(params):
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+    faults.install(faults.FaultInjector(seed=7, spec={"spec.fail": {}}))
+    try:
+        engine = InferenceEngine(params, CFG, ECFG)
+        out1 = _run(engine, "s1", t1, session="sess", ef=True, cands=[cand])
+        # vetoed at enqueue: keep-warm only, nothing speculated
+        assert engine.stats["spec_started_total"] == 0
+        assert engine.stats["session_pins_active"] == 1
+        follow = t1 + out1 + cand + _prompt(3, 2)
+        out2 = _run(engine, "s2", follow, session="sess")
+        assert engine.stats["spec_hit_total"] == 0
+        assert engine.stats["session_pins_active"] == 0
+        inj = faults.active()
+        assert inj is not None and inj.stats()["spec.fail"]["fired"] == 1
+    finally:
+        faults.install(None)
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "f", follow), "spec.fail chaos diverged"
+    _assert_quiescent(engine)
+
+
+def test_spec_stall_chaos_followup_wins_race_zero_leak(params):
+    """spec.stall defers the speculative jobs; a follow-up that arrives
+    first absorbs nothing — the deferred jobs cancel unstarted."""
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+    faults.install(
+        faults.FaultInjector(seed=7, spec={"spec.stall": {"delay_s": 30.0}})
+    )
+    try:
+        engine = InferenceEngine(params, CFG, ECFG)
+        engine.submit(
+            Request(
+                id="s1",
+                prompt=t1,
+                sampling=SamplingParams(max_new_tokens=4),
+                session_id="sess",
+                expect_followup=True,
+                followup_candidates=[cand],
+            )
+        )
+        out1 = []
+        # drive only until s1 finishes — run_to_completion would spin out
+        # the stall window; the deferred jobs must still be deferred when
+        # the follow-up lands
+        while len(out1) < 4:
+            for ev in engine.step():
+                if ev.request_id == "s1" and ev.token >= 0:
+                    out1.append(ev.token)
+        assert len(engine._spec_stalled) == 1
+        assert engine.stats["spec_started_total"] == 1
+        follow = t1 + out1 + cand + _prompt(3, 2)
+        out2 = _run(engine, "s2", follow, session="sess")
+        assert engine.stats["spec_hit_total"] == 0
+        assert engine.stats["spec_cancelled_total"] == 1
+        assert engine._spec_stalled == []  # cancelled while deferred
+    finally:
+        faults.install(None)
+    fresh = InferenceEngine(params, CFG, ECFG)
+    assert out2 == _run(fresh, "f", follow), "spec.stall chaos diverged"
+    _assert_quiescent(engine)
+
+
+# ---------------------------------------------------------------------------
+# terminal paths: nothing survives, nothing leaks
+
+
+def test_client_cancel_releases_pin_and_spec_state(params):
+    t1 = _prompt(1, 10)
+    cand = _prompt(2, 9)
+    engine = InferenceEngine(params, CFG, ECFG)
+    out1 = _run(engine, "s1", t1, session="sess", ef=True, cands=[cand])
+    assert "sess" in engine._pins and "sess" in engine._spec_by_session
+    follow = t1 + out1 + cand + _prompt(3, 2)
+    engine.submit(
+        Request(
+            id="s2",
+            prompt=follow,
+            sampling=SamplingParams(max_new_tokens=4),
+            session_id="sess",
+        )
+    )
+    engine.request_cancel("s2")  # client gone before admission
+    while engine.has_work():
+        engine.step()
+    assert engine._pins == {}
+    assert engine._spec_by_session == {}
+    assert engine.stats["session_pins_active"] == 0
+    _assert_quiescent(engine)
+
+
+def test_free_session_releases_pin_and_spec_state(params):
+    engine = InferenceEngine(params, CFG, ECFG)
+    _run(engine, "s1", _prompt(1, 10), session="sess", ef=True, cands=[_prompt(2, 9)])
+    assert engine.stats["session_pins_active"] == 1
+    engine.free_session("sess")
+    assert engine.stats["session_pins_active"] == 0
+    assert engine.stats["spec_cancelled_total"] == 1
+    assert engine.allocator.free_pages == BASE_FREE
+
+
+def test_pin_ttl_expiry_via_gc(params):
+    """A pin whose follow-up never arrives expires after spec_pin_ttl and
+    the session rejoins the ordinary ttl clock."""
+    ecfg = dataclasses.replace(ECFG, spec_pin_ttl=0.001, session_ttl=0.001)
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "g1", _prompt(1, 10), session="sess", ef=True, cands=[_prompt(2, 9)])
+    assert engine.stats["session_pins_active"] == 1
+    time.sleep(0.05)
+    engine.gc_sessions()
+    assert engine.stats["session_pins_active"] == 0
+    assert "sess" not in engine._sessions
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+
+
+def test_pin_exempts_session_from_gc_until_ttl(params):
+    """While the pin lives, session_ttl does NOT collect the session — the
+    whole point of keep-warm."""
+    ecfg = dataclasses.replace(ECFG, session_ttl=0.001, spec_pin_ttl=120.0)
+    engine = InferenceEngine(params, CFG, ecfg)
+    _run(engine, "g1", _prompt(1, 10), session="sess", ef=True)
+    time.sleep(0.05)
+    engine.gc_sessions()
+    assert "sess" in engine._sessions  # pinned: survives its ttl
+    assert engine.stats["session_pins_active"] == 1
+    engine.free_session("sess")
+    assert engine.allocator.free_pages == ecfg.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# model-node candidate normalization
+
+
+def _stub_backend(spec_max=4, tokenizer=None):
+    from agentfield_tpu.serving.model_node import ModelBackend
+
+    stub = types.SimpleNamespace(
+        tokenizer=tokenizer,
+        engine=types.SimpleNamespace(
+            ecfg=types.SimpleNamespace(spec_max_candidates=spec_max)
+        ),
+    )
+    return ModelBackend._followup_cand_tokens.__get__(stub)
+
+
+def test_followup_cand_tokens_validation():
+    norm = _stub_backend()
+    assert norm(None) is None
+    assert norm([]) is None
+    assert norm([[1, 2, 3]]) == [[1, 2, 3]]
+    assert norm([[]]) is None  # empty candidates dropped
+    assert norm(["text"]) is None  # no tokenizer: keep-warm only
+    with pytest.raises(ValueError):
+        norm("not-a-list")
+    with pytest.raises(ValueError):
+        norm([[1, "x"]])
+    with pytest.raises(ValueError):
+        norm([{"bad": 1}])
+    # over-declared candidates are capped at spec_max_candidates
+    assert _stub_backend(spec_max=2)([[1], [2], [3]]) == [[1], [2]]
+
+    class Tok:
+        def encode(self, s):
+            return [ord(c) for c in s]
+
+    assert _stub_backend(tokenizer=Tok())(["ab"]) == [[97, 98]]
+
+
+# ---------------------------------------------------------------------------
+# gateway: wire validation, hint injection, pool-aware phase-2 placement
+
+
+def _exec_for(target, tokens, execution_id="exec_t", parent=None, session=None,
+              expect_followup=False):
+    return Execution(
+        execution_id=execution_id,
+        target=target,
+        target_type=TargetType.REASONER,
+        status=ExecutionStatus.RUNNING,
+        run_id="run_t",
+        input={"tokens": tokens, "max_new_tokens": 4},
+        parent_execution_id=parent,
+        session_id=session,
+        expect_followup=expect_followup,
+    )
+
+
+@async_test
+async def test_execute_body_expect_followup_validation():
+    async with CPHarness() as h:
+        await h.register_agent()
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo",
+            json={"input": {"x": 1}, "expect_followup": "yes"},
+        ) as r:
+            assert r.status == 400
+            assert "expect_followup" in await r.text()
+        # a boolean hint passes straight through on a non-model node
+        async with h.http.post(
+            "/api/v1/execute/fake-agent.echo",
+            json={"input": {"x": 1}, "expect_followup": True},
+        ) as r:
+            assert r.status == 200
+
+
+@async_test
+async def test_hint_injection_declared_inferred_and_env_gated(monkeypatch):
+    toks = list(range(12))
+    async with CPHarness() as h:
+        gw = h.cp.gateway
+        await h.cp.registry.register(
+            {
+                "node_id": "m0",
+                "base_url": "http://127.0.0.1:9",
+                "kind": "model",
+                "reasoners": [{"id": "generate"}],
+                "metadata": {"model": "m"},
+            }
+        )
+        node = await h.cp.registry.db.get_node("m0")
+        # declared on the body → injected
+        ai = await gw._agent_input(node, _exec_for("m0.generate", toks, expect_followup=True))
+        assert ai["expect_followup"] is True
+        # DAG-inferred: a non-root step of a session-carrying chain
+        ai = await gw._agent_input(
+            node, _exec_for("m0.generate", toks, parent="exec_p", session="s1")
+        )
+        assert ai["expect_followup"] is True
+        # root step (no parent): nothing injected — bit-compatible body
+        ai = await gw._agent_input(node, _exec_for("m0.generate", toks, session="s1"))
+        assert "expect_followup" not in ai
+        # an explicit caller value wins over the inference (setdefault)
+        ex = _exec_for("m0.generate", toks, parent="exec_p", session="s1")
+        ex.input["expect_followup"] = False
+        ai = await gw._agent_input(node, ex)
+        assert ai["expect_followup"] is False
+        # env knob off: NOTHING is injected even when declared
+        monkeypatch.setenv("AGENTFIELD_SPEC_PREFILL", "0")
+        ai = await gw._agent_input(node, _exec_for("m0.generate", toks, expect_followup=True))
+        assert "expect_followup" not in ai
+
+
+@async_test
+async def test_pool_aware_phase2_placement():
+    toks = list(range(40))
+    async with CPHarness() as h:
+        gw = h.cp.gateway
+        for i in range(3):
+            await h.cp.registry.register(
+                {
+                    "node_id": f"d{i}",
+                    "base_url": "http://127.0.0.1:9",
+                    "kind": "model",
+                    "reasoners": [{"id": "generate"}],
+                    "metadata": {"model": "m", "role": "decode" if i else "prefill"},
+                }
+            )
+        ho = {
+            "phase": 2, "prefill_node": "d0",
+            "desc": {"id": "r1", "pages": 4, "page_size": 8},
+            "t0w": 0.0, "t0m": 0.0,
+        }
+        candidates = await h.cp.registry.cache.list()
+        # (1) stats-less fleet: the round-robin order, bit-for-bit
+        gw._handoff_rr = 0
+        gw._handoff["exec_t"] = dict(ho)
+        picked = gw._pick_decode_node(_exec_for("d0.generate", toks), set(), candidates, ho)
+        assert picked.node_id == "d2"  # rr advanced 0→1 over pool [d1, d2]
+        # (2) heartbeat-fresh stats: the idle node beats the loaded one
+        # regardless of whose round-robin turn it is
+        cache = h.cp.registry.cache
+        cache.put_pool_stats("d1", free_pages=500.0, load=0.0)  # idle
+        cache.put_pool_stats("d2", free_pages=40.0, load=6.0)  # loaded
+        gw._handoff_rr = 0  # rr turn says d2 again
+        gw._handoff["exec_t"] = dict(ho)
+        picked = gw._pick_decode_node(_exec_for("d0.generate", toks), set(), candidates, ho)
+        assert picked.node_id == "d1"
+        # (3) the loser is still the failover when the winner was tried
+        gw._handoff["exec_t"] = dict(ho)
+        picked = gw._pick_decode_node(_exec_for("d0.generate", toks), {"d1"}, candidates, ho)
+        assert picked.node_id == "d2"
+        gw._handoff.clear()
+        gw._kv_hints.clear()
+
+
+def test_heartbeat_pool_stats_ttl():
+    cache = NodeSnapshotCache(db=None, sketch_ttl_s=0.01)
+    cache.put_pool_stats("n0", free_pages=100.0, load=2.0)
+    assert cache.get_pool_stats("n0") == (100.0, 2.0)
+    time.sleep(0.05)
+    assert cache.get_pool_stats("n0") is None  # stale samples never served
+    cache.put_pool_stats("n1", free_pages=1.0, load=0.0)
+    cache.drop_sketch("n1")
+    assert cache.get_pool_stats("n1") is None

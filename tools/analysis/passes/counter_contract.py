@@ -44,7 +44,11 @@ from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
 
 _ID = "counter-contract"
 
-_TOTAL_RE = re.compile(r"\A[a-z][a-z0-9_]*_total\Z")
+# Constant stats keys the contract covers: monotonic ``*_total`` counters
+# plus assigned ``*_active`` gauges (e.g. session_pins_active) — both ride
+# the same stats → heartbeat → /metrics surface and need the same init +
+# docs + pin discipline.
+_TOTAL_RE = re.compile(r"\A[a-z][a-z0-9_]*_(total|active)\Z")
 
 
 _BRACE_RE = re.compile(r"([A-Za-z0-9_]*)\{([A-Za-z0-9_,]+)\}([A-Za-z0-9_]*)")

@@ -12,6 +12,10 @@ p50/p95/p99, status histograms, Prometheus pre/post scrape). Usage:
 
 Scenarios (pair with tools/perf/stress_agent.py):
     --scenario nested --depth 2 --width 3     # width^depth call tree per req
+    --scenario agent-chain --chains 8 --steps 3 --tool-latency 2.0
+                                              # N-step tool-call sessions,
+                                              # per-step TTFT (agent-aware
+                                              # serving A/B)
     --payload-bytes-sweep 1024,65536,1048576  # one run per payload size
     --scenario-file scenarios.json            # list of run configs
 
@@ -278,6 +282,106 @@ async def run_load(
     return report
 
 
+async def run_agent_chains(
+    url: str,
+    target: str,
+    chains: int,
+    steps: int,
+    concurrency: int,
+    payload=None,
+    tool_latency_s: float = 0.0,
+    timeout: float = 120.0,
+    execute_step=None,
+) -> dict:
+    """Agent-chain mode (docs/OPERATIONS.md "Agent-aware serving"): each
+    "chain" is one N-step agent program — every step a session-carrying
+    generate call, separated by ``tool_latency_s`` of think time (the tool
+    call the agent is waiting on). All steps but the last declare
+    ``expect_followup``, so a keep-warm-capable stack pins the session and
+    speculates across the gap; a stack without it re-prefills whatever
+    ``session_ttl`` collected meanwhile. The report keys on what an agent
+    loop actually feels: per-step TTFT percentiles (``step_ttft_ms[j]`` —
+    step 0 is the cold root, steps 1+ ride the warm path) plus the pooled
+    follow-up block (``followup_ttft_ms``).
+
+    ``execute_step`` (async ``(chain_i, step_j, prev) -> (status, ttft_s,
+    carry)``) replaces HTTP with an in-process call — the agent_chain bench
+    drives engines directly through the same loop and percentile math;
+    ``carry`` is threaded back in as ``prev`` for the chain's next step.
+    The HTTP path posts ``payload`` with ``session_id``/``expect_followup``
+    on the execute body and measures completion latency per step (unary
+    POST exposes no first-token timestamp, so there TTFT == completion)."""
+    step_ttfts: list[list[float]] = [[] for _ in range(steps)]
+    statuses: dict[str, int] = {}
+    errors: dict[str, int] = {}
+    sem = asyncio.Semaphore(concurrency)
+
+    session_ctx = (
+        aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=timeout))
+        if execute_step is None
+        else contextlib.nullcontext()
+    )
+    async with session_ctx as http:
+        t_start = time.perf_counter()
+
+        async def one_chain(i: int) -> None:
+            prev = None
+            async with sem:
+                for j in range(steps):
+                    if j and tool_latency_s > 0:
+                        await asyncio.sleep(tool_latency_s)  # the tool "runs"
+                    try:
+                        if execute_step is not None:
+                            status, ttft, prev = await execute_step(i, j, prev)
+                        else:
+                            body = {
+                                "input": payload,
+                                "session_id": f"chain{i}",
+                            }
+                            if j < steps - 1:
+                                body["expect_followup"] = True
+                            t0 = time.perf_counter()
+                            async with http.post(
+                                f"{url}/api/v1/execute/{target}", json=body
+                            ) as resp:
+                                doc = await resp.json()
+                                status = doc.get("status", f"http_{resp.status}")
+                            ttft = time.perf_counter() - t0
+                        statuses[status] = statuses.get(status, 0) + 1
+                        if ttft is not None:
+                            step_ttfts[j].append(ttft)
+                    except Exception as e:
+                        errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
+                        return  # a broken chain stops issuing steps
+
+        await asyncio.gather(*(one_chain(i) for i in range(chains)))
+        elapsed = time.perf_counter() - t_start
+
+    def block(vals: list[float]) -> dict:
+        return {
+            "p50": round(percentile(vals, 50) * 1e3, 1),
+            "p95": round(percentile(vals, 95) * 1e3, 1),
+            "p99": round(percentile(vals, 99) * 1e3, 1),
+            "samples": len(vals),
+        }
+
+    followups = [t for j in range(1, steps) for t in step_ttfts[j]]
+    ok = statuses.get("completed", 0)
+    return {
+        "target": target,
+        "mode": "agent_chain",
+        "chains": chains,
+        "steps": steps,
+        "tool_latency_s": tool_latency_s,
+        "elapsed_s": round(elapsed, 3),
+        "success_rate": round(ok / max(1, chains * steps), 4),
+        "step_ttft_ms": [block(v) for v in step_ttfts],
+        "followup_ttft_ms": block(followups),
+        "statuses": statuses,
+        "errors": errors,
+    }
+
+
 async def _poll(session, url: str, eid: str, timeout: float) -> str:
     deadline = time.monotonic() + timeout
     interval = 0.02
@@ -330,6 +434,17 @@ async def run_scenario(args_ns) -> dict:
         if args_ns.payload_bytes_sweep
         else [None]
     )
+    if args_ns.scenario == "agent-chain":
+        return await run_agent_chains(
+            args_ns.url,
+            args_ns.target,
+            getattr(args_ns, "chains", 8),
+            getattr(args_ns, "steps", 3),
+            args_ns.concurrency,
+            payload=json.loads(args_ns.payload) if args_ns.payload else None,
+            tool_latency_s=getattr(args_ns, "tool_latency", 0.0) or 0.0,
+            timeout=args_ns.timeout,
+        )
     rounds = []
     for size in sweeps:
         r = await run_load(
@@ -390,9 +505,23 @@ async def main() -> None:
         help="token length of the long-prompt requests (with --long-frac)",
     )
     ap.add_argument("--timeout", type=float, default=120.0)
-    ap.add_argument("--scenario", choices=("plain", "nested"), default="plain")
+    ap.add_argument(
+        "--scenario", choices=("plain", "nested", "agent-chain"), default="plain"
+    )
     ap.add_argument("--depth", type=int, default=1, help="nested: recursion depth")
     ap.add_argument("--width", type=int, default=2, help="nested: fanout per level")
+    ap.add_argument(
+        "--chains", type=int, default=8,
+        help="agent-chain: concurrent N-step agent programs (sessions)",
+    )
+    ap.add_argument(
+        "--steps", type=int, default=3,
+        help="agent-chain: session-carrying generate steps per chain",
+    )
+    ap.add_argument(
+        "--tool-latency", type=float, default=0.0,
+        help="agent-chain: simulated tool-call think time between steps (s)",
+    )
     ap.add_argument(
         "--payload-bytes-sweep",
         default=None,

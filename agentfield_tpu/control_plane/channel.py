@@ -478,6 +478,12 @@ class ChannelServer:
             "channel_server_reattaches_total": 0,
             "channel_server_cancels_total": 0,
             "channel_server_kv_fetches_total": 0,
+            # Degradation-ladder rungs (docs/FAULT_TOLERANCE.md): a fetch
+            # that timed out waiting on the peer (the caller re-prefilled)
+            # and a served fetch answered with an error frame — both are
+            # correct-but-slower outcomes an operator must be able to see.
+            "channel_server_kv_fetch_timeouts_total": 0,
+            "channel_server_kv_fetch_errors_total": 0,
         }
 
     def stream_handler(self, component_id: str, fn: StreamFn) -> None:
@@ -549,6 +555,7 @@ class ChannelServer:
             async with aio_timeout(timeout_s):
                 return await fut
         except TimeoutError:
+            self.stats["channel_server_kv_fetch_timeouts_total"] += 1
             return None  # the caller re-prefills; late frames are dropped
         except asyncio.CancelledError:
             raise  # an EXTERNAL cancel (client gone, drain) must propagate
@@ -637,6 +644,7 @@ class ChannelServer:
         self.stats["channel_server_kv_fetches_total"] += 1
 
         async def fail(err: str) -> None:
+            self.stats["channel_server_kv_fetch_errors_total"] += 1
             await conn.send(
                 {"kind": "kv_pages", "fetch_id": fid, "error": err, "done": True}
             )
@@ -1081,6 +1089,7 @@ class NodeChannel:
                     # header rewrite (payload bytes never enter JSON).
                     f = faults.fire("channel.drop")
                     if f is not None:
+                        self.mgr.metrics.inc("channel_drops_injected_total")
                         log.warning("injected channel drop (blob)", node_id=self.node_id)
                         break
                     self.mgr.metrics.inc("channel_frames_rx_total")
@@ -1092,6 +1101,7 @@ class NodeChannel:
                 if f is not None:
                     # Injected mid-stream channel kill (chaos tests): close
                     # the socket abruptly and let recovery reattach.
+                    self.mgr.metrics.inc("channel_drops_injected_total")
                     log.warning("injected channel drop", node_id=self.node_id)
                     break
                 try:
@@ -1099,6 +1109,7 @@ class NodeChannel:
                     if not isinstance(frame, dict):
                         raise ValueError("frame must be an object")
                 except ValueError as e:
+                    self.mgr.metrics.inc("channel_malformed_frames_total")
                     log.warning("malformed channel frame", node_id=self.node_id, error=repr(e))
                     continue
                 self.mgr.metrics.inc("channel_frames_rx_total")
@@ -1106,6 +1117,10 @@ class NodeChannel:
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            # Transport died under us: the finally below reconnects and
+            # reattaches live calls — count the loop death itself so a
+            # flapping socket is visible as a rate, not just log noise.
+            self.mgr.metrics.inc("channel_recv_failures_total")
             log.warning("channel receive loop failed", node_id=self.node_id, error=repr(e))
         finally:
             if self._ws is ws:
@@ -1495,6 +1510,7 @@ class ChannelManager:
             # translate back to the id the requester is waiting on
             await chan._send({**frame, "fetch_id": orig_fid})
         except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            self.metrics.inc("kv_relay_errors_total")
             log.debug(
                 "kv relay response not delivered",
                 node_id=requester_id, server=server_id, error=repr(e),
@@ -1519,6 +1535,7 @@ class ChannelManager:
         try:
             await chan._send_bytes(_pack_kv_blob(orig_fid, seq, payload))
         except (ChannelUnavailable, aiohttp.ClientError, ConnectionError, OSError, RuntimeError) as e:
+            self.metrics.inc("kv_relay_errors_total")
             log.debug(
                 "kv relay blob not delivered",
                 node_id=requester_id, server=server_id, error=repr(e),

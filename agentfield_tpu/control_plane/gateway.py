@@ -504,6 +504,9 @@ class ExecutionGateway:
             await asyncio.sleep(f.delay_s)
         f = faults.fire("gateway.agent_call.fail")
         if f is not None:
+            # Degrades by classification: node_error feeds the ordinary
+            # retry/failover machinery, counted so chaos runs can pin it.
+            self.metrics.inc("gateway_faults_injected_total")
             return "node_error", f"agent call failed: {f.error}"
         if self.channels.supports(node):
             # Streaming data plane: one persistent multiplexed WebSocket per
@@ -571,6 +574,7 @@ class ExecutionGateway:
         except Exception as e:
             # Transport/parse failure: the node (or the path to it) is the
             # problem — retryable by classification.
+            self.metrics.inc("gateway_transport_errors_total")
             return "node_error", f"agent call failed: {e!r}"
         finally:
             self.metrics.observe("gateway_agent_call_seconds", time.perf_counter() - t0)

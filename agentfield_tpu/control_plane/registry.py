@@ -395,7 +395,7 @@ class NodeRegistry:
         if requested is not None:
             try:
                 new_status = NodeStatus(requested)
-            except ValueError:
+            except ValueError:  # afcheck: caller-error invalid status value is the heartbeater's bug — a 400, not a rung
                 raise RegistryError(
                     400, f"invalid status {requested!r}; one of {[s.value for s in NodeStatus]}"
                 ) from None

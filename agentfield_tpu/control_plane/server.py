@@ -518,9 +518,12 @@ def create_app(cp: ControlPlane) -> web.Application:
                 if frame is None:
                     # this consumer lagged and was dropped by the fanout —
                     # explicit, so the client can distinguish it from done
+                    dropped = {
+                        "kind": "dropped",
+                        "error": "subscriber lagged behind the stream",
+                    }
                     await resp.write(
-                        b'data: {"kind": "dropped", "error": '
-                        b'"subscriber lagged behind the stream"}\n\n'
+                        f"data: {json.dumps(dropped)}\n\n".encode()
                     )
                     break
                 if frame.get("kind") == "terminal":

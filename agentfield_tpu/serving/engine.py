@@ -1353,6 +1353,10 @@ class InferenceEngine:
             # deadline_exceeded; queue-time overload signal)
             "preempt_storm_injected": 0,  # forced preemptions from the
             # engine.preempt_storm fault point (chaos testing)
+            "spec_fail_injected": 0,  # spec.fail fault vetoes (keep-warm
+            # only — the rung every speculation failure degrades to)
+            "spec_stall_injected": 0,  # spec jobs deferred by the
+            # spec.stall fault point (drained after delay_s, chaos testing)
             # Branch decoding (docs/PREFIX_CACHING.md "Fork / COW
             # branches") — always present so the stats→heartbeat→/metrics
             # pipeline carries the family even on nodes that never branch:
@@ -2246,7 +2250,9 @@ class InferenceEngine:
         if not cands or not self._shared_prefix:
             return
         if _engine_fault("spec.fail") is not None:
-            return  # chaos: keep-warm only, the cold-path ladder's first rung
+            # chaos: keep-warm only, the cold-path ladder's first rung
+            self.stats["spec_fail_injected"] += 1
+            return
         if sid not in self._sessions:
             return  # retention did not happen (e.g. page churn): cold path
         stall = _engine_fault("spec.stall")
@@ -2278,6 +2284,7 @@ class InferenceEngine:
             if self._pages_needed(sreq) > self.ecfg.max_pages_per_seq:
                 continue  # speculated step would overflow a slot: skip it
             if stall is not None:
+                self.stats["spec_stall_injected"] += 1
                 self._spec_stalled.append(
                     (time.monotonic() + stall.delay_s, sreq)
                 )
@@ -3252,6 +3259,7 @@ class InferenceEngine:
             or s.max_new_tokens <= 1
         ):
             return None
+        # afcheck: caller-error every decline is counted at the call site (kv_handoff_failed_total, kv_handoff_fail_export_total)
         if _engine_fault("kv.handoff_fail") is not None:
             return None
         ps = self.ecfg.page_size
@@ -3262,7 +3270,7 @@ class InferenceEngine:
             handle = self._capture_page_kv(pages[k])
         try:
             payload = _fetch_page_kv(handle)
-        except Exception:
+        except Exception:  # afcheck: caller-error decline counted at the call site (kv_handoff_fail_export_total)
             return None  # decline: decode locally, pages still owned
         desc = {
             "id": req.id,

@@ -157,6 +157,16 @@ class CPHarness:
             self.lock_witness.instrument(
                 journal, "_flush_lock", "journal._flush_lock"
             )
+        # Runtime twin of the static lock-order pass: the [lock-order]
+        # order entries from tools/analysis/allowlist.toml, in witness
+        # names. An observed acquisition that inverts this reviewed
+        # hierarchy fails teardown even when the run never formed a cycle.
+        self.lock_witness.declare_order(
+            [
+                ("journal._flush_lock", "journal._mu"),
+                ("journal._flush_lock", "storage._lock"),
+            ]
+        )
 
     async def __aenter__(self):
         # Baselines for the teardown leak audit: anything beyond these after
@@ -222,6 +232,9 @@ class CPHarness:
         await self._runner.cleanup()
         if exc == (None, None, None):  # never mask the test's own failure
             self.lock_witness.assert_no_cycles()
+            # the declared storage/journal hierarchy ([lock-order] order in
+            # tools/analysis/allowlist.toml) holds at runtime too
+            self.lock_witness.assert_declared_order()
             # >50ms sync-lock hold on the loop thread = every coroutine on
             # the loop stalled that long (the runtime half of afcheck's
             # task-lifecycle await-under-lock rule)

@@ -404,11 +404,16 @@ def _backend(tiny, **eover):
     w.instrument(b.engine, "_session_lock", "engine._session_lock")
     w.instrument(b.engine, "_pending_lock", "engine._pending_lock")
     w.instrument(b.engine, "_telemetry_lock", "engine._telemetry_lock")
+    # mirror the reviewed [lock-order] hierarchy (allowlist.toml): an
+    # acquisition inverting it fails _assert_witness_clean even when the
+    # run never formed a full cycle
+    w.declare_order([("engine._session_lock", "engine._pending_lock")])
     return b
 
 
 def _assert_witness_clean(b) -> None:
     b.lock_witness.assert_no_cycles()
+    b.lock_witness.assert_declared_order()
     b.lock_witness.assert_no_loop_blocking()
 
 

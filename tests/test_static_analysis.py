@@ -1283,6 +1283,513 @@ def test_stale_knob_allow_is_flagged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# frame-contract
+
+
+def test_frame_contract_flags_unconsumed_and_undocumented(tmp_path):
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/channel.py",
+        """
+        async def send(conn):
+            await conn.send({"kind": "zap", "data": 1})
+        """,
+        pass_ids=["frame-contract"],
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert _ids(found) == ["frame-contract", "frame-contract"]
+    assert "no receiving side" in msgs and "no row" in msgs
+
+
+def test_frame_contract_flags_dead_dispatch_branch(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ARCHITECTURE.md").write_text(
+        "| `zap` | both | documented |\n", encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/channel.py",
+        """
+        def handle(frame):
+            if frame.get("kind") == "zap":
+                return 1
+        """,
+        pass_ids=["frame-contract"],
+    )
+    assert len(found) == 1 and "nothing in the tree produces" in found[0].message
+
+
+def test_frame_contract_passes_paired_and_documented(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ARCHITECTURE.md").write_text(
+        "| `zap` | both | documented |\nbinary blobs ride the AFKV1 header\n",
+        encoding="utf-8",
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/channel.py",
+        """
+        def _pack_kv_blob(fid, seq, b):
+            return b
+
+        def _unpack_kv_blob(data):
+            return None
+
+        async def send(conn):
+            await conn.send({"kind": "zap"})
+            await conn.send_bytes(_pack_kv_blob("f", 1, b""))
+
+        def handle(frame, data):
+            _unpack_kv_blob(data)
+            kind = frame.get("kind")
+            if kind in ("zap",):
+                return 1
+        """,
+        pass_ids=["frame-contract"],
+    )
+    assert found == []
+
+
+def test_frame_contract_nonframe_kind_receivers_dont_count(tmp_path):
+    # `n.get("kind")` over a registry node listing is not a frame dispatch:
+    # the receiver name is not frame-shaped, so no consumer is recorded and
+    # the const it compares against raises no dead-dispatch finding.
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ARCHITECTURE.md").write_text("", encoding="utf-8")
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/client.py",
+        """
+        def nodes_of(listing):
+            return [n for n in listing if n.get("kind") == "model"]
+        """,
+        pass_ids=["frame-contract"],
+    )
+    assert found == []
+
+
+def test_frame_contract_require_pin_fails_when_side_deleted(tmp_path):
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[frame-contract]\nrequire = ["zap"]\n', encoding="utf-8"
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ARCHITECTURE.md").write_text(
+        "| `zap` | both | documented |\n", encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/channel.py",
+        """
+        async def send(conn):
+            await conn.send({"kind": "zap"})
+        """,
+        pass_ids=["frame-contract"],
+        allowlist=allow,
+    )
+    # the unconsumed-producer finding AND the broken pin
+    assert any("pinned frame kind 'zap' has no consumer" in f.message for f in found)
+
+
+def test_frame_contract_stale_external_entry(tmp_path):
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[frame-contract]\nexternal = ["ghost"]\n', encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/channel.py",
+        """
+        def handle(frame):
+            return frame
+        """,
+        pass_ids=["frame-contract"],
+        allowlist=allow,
+    )
+    assert len(found) == 1 and "matches no produced or consumed" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# degradation-ladder
+
+
+def test_degradation_ladder_flags_uncounted_rung_and_escape(tmp_path):
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        from agentfield_tpu.control_plane import faults
+
+        class S:
+            async def dispatch_one(self):
+                f = faults.fire("x.fail")
+                if f is not None:
+                    raise RuntimeError(f.error)
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert _ids(found) == ["degradation-ladder", "degradation-ladder"]
+    assert "can raise to the caller" in msgs and "no per-reason counter" in msgs
+    assert "'x.fail'" in msgs  # the right fault point is named
+
+
+def test_degradation_ladder_names_nearest_consult(tmp_path):
+    # the `f = fire(...)` name is reused across consecutive rungs — each
+    # rung must be attributed to ITS point, not the first assignment's
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        import asyncio
+        from agentfield_tpu.control_plane import faults
+
+        class S:
+            async def dispatch_one(self):
+                f = faults.fire("x.stall")
+                if f is not None:
+                    await asyncio.sleep(f.delay_s)
+                f = faults.fire("x.fail")
+                if f is not None:
+                    return None
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    assert len(found) == 1 and "'x.fail'" in found[0].message
+
+
+def test_degradation_ladder_passes_counted_rungs(tmp_path):
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        import asyncio
+        from agentfield_tpu.control_plane import faults
+
+        class S:
+            def __init__(self):
+                self.stats = {"x_fail_total": 0, "x_err_total": 0}
+
+            async def dispatch_one(self):
+                f = faults.fire("x.stall")
+                if f is not None:
+                    await asyncio.sleep(f.delay_s)  # stall rung: manifests downstream
+                f = faults.fire("x.fail")
+                if f is not None:
+                    self.stats["x_fail_total"] += 1
+                    return None
+                try:
+                    return self._go()
+                except asyncio.CancelledError:
+                    raise  # external cancel must propagate
+                except Exception:
+                    self.stats["x_err_total"] += 1
+                    return None
+
+            def _go(self):
+                return 1
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    assert found == []
+
+
+def test_degradation_ladder_caller_error_pragma(tmp_path):
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        from agentfield_tpu.control_plane import faults
+
+        class S:
+            async def dispatch_one(self):
+                f = faults.fire("x.fail")
+                if f is not None:  # afcheck: caller-error the API contract is a 503 here
+                    raise RuntimeError(f.error)
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    assert found == []
+
+
+def test_degradation_ladder_except_rung_in_ladder_function(tmp_path):
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        class S:
+            async def relay_thing(self):
+                try:
+                    return self._go()
+                except Exception:
+                    return None
+
+            async def unrelated_name(self):
+                try:
+                    return self._go()
+                except Exception:
+                    return None
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    # only the ladder-named function's handler is a rung
+    assert len(found) == 1 and "relay_thing" in found[0].message
+
+
+def test_degradation_ladder_counter_via_helper_closure(tmp_path):
+    # the channel server's fail() idiom: the rung's counter lives one call
+    # level down, in a nested def
+    found = _run(
+        tmp_path,
+        f"{CP}/x.py",
+        """
+        class S:
+            def __init__(self):
+                self.stats = {"kv_fail_total": 0}
+
+            async def fetch_kv(self):
+                async def fail():
+                    self.stats["kv_fail_total"] += 1
+                try:
+                    return self._go()
+                except Exception:
+                    await fail()
+                    return None
+        """,
+        pass_ids=["degradation-ladder"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+
+
+def test_lock_order_flags_abba_cycle(tmp_path):
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+                self.m2 = threading.Lock()
+
+            def ab(self):
+                with self.m1:
+                    with self.m2:
+                        pass
+
+            def ba(self):
+                with self.m2:
+                    with self.m1:
+                        pass
+        """,
+        pass_ids=["lock-order"],
+    )
+    assert any("cycle" in f.message for f in found)
+    assert all(f.pass_id == "lock-order" for f in found)
+
+
+def test_lock_order_interprocedural_edge(tmp_path):
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+                self.m2 = threading.Lock()
+
+            def outer(self):
+                with self.m1:
+                    self.helper()
+
+            def helper(self):
+                with self.m2:
+                    pass
+        """,
+        pass_ids=["lock-order"],
+    )
+    assert len(found) == 1
+    assert "A.m1 is held while acquiring A.m2" in found[0].message
+
+
+def test_lock_order_declared_hierarchy_passes(tmp_path):
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[lock-order]\norder = ["A.m1 -> A.m2"]\n', encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+                self.m2 = threading.Lock()
+
+            def f(self):
+                with self.m1:
+                    with self.m2:
+                        pass
+        """,
+        pass_ids=["lock-order"],
+        allowlist=allow,
+    )
+    assert found == []
+
+
+def test_lock_order_inversion_of_declared_hierarchy(tmp_path):
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[lock-order]\norder = ["A.m1 -> A.m2"]\n', encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+                self.m2 = threading.Lock()
+
+            def f(self):
+                with self.m2:
+                    with self.m1:
+                        pass
+        """,
+        pass_ids=["lock-order"],
+        allowlist=allow,
+    )
+    assert len(found) == 1 and "INVERTS" in found[0].message
+
+
+def test_lock_order_async_and_thread_tiers_are_separate(tmp_path):
+    # t1->t2 on the thread tier and a2->a1 on the asyncio tier is NOT a
+    # cycle: an asyncio lock parks the coroutine, a threading lock parks
+    # the OS thread — ordering only composes within a tier.
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[lock-order]\norder = ["T.t1 -> T.t2", "T.a2 -> T.a1"]\n',
+        encoding="utf-8",
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import asyncio
+        import threading
+
+        class T:
+            def __init__(self):
+                self.t1 = threading.Lock()
+                self.t2 = threading.Lock()
+                self.a1 = asyncio.Lock()
+                self.a2 = asyncio.Lock()
+
+            def sync_path(self):
+                with self.t1:
+                    with self.t2:
+                        pass
+
+            async def async_path(self):
+                async with self.a2:
+                    async with self.a1:
+                        pass
+        """,
+        pass_ids=["lock-order"],
+        allowlist=allow,
+    )
+    assert found == []
+
+
+def test_lock_order_stale_declaration(tmp_path):
+    allow = tmp_path / "allow.toml"
+    allow.write_text(
+        '[lock-order]\norder = ["A.m1 -> A.m2"]\n', encoding="utf-8"
+    )
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+                self.m2 = threading.Lock()
+
+            def f(self):
+                with self.m1:
+                    pass
+        """,
+        pass_ids=["lock-order"],
+        allowlist=allow,
+    )
+    assert len(found) == 1 and "matches no observed nesting edge" in found[0].message
+
+
+def test_lock_order_self_reacquire_nonreentrant(tmp_path):
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.m1 = threading.Lock()
+
+            def outer(self):
+                with self.m1:
+                    self.inner()
+
+            def inner(self):
+                with self.m1:
+                    pass
+        """,
+        pass_ids=["lock-order"],
+    )
+    assert len(found) == 1 and "self-deadlock" in found[0].message
+
+
+def test_lock_order_deferred_spawn_is_not_a_call_under_lock(tmp_path):
+    # create_task(self.loop()) under a lock spawns the coroutine for LATER:
+    # the locks it takes when it eventually runs are not nested here.
+    found = _run(
+        tmp_path,
+        "agentfield_tpu/serving/x.py",
+        """
+        import asyncio
+
+        class A:
+            def __init__(self):
+                self.a1 = asyncio.Lock()
+                self.a2 = asyncio.Lock()
+
+            async def connect(self):
+                async with self.a1:
+                    asyncio.create_task(self.loop())
+
+            async def loop(self):
+                async with self.a2:
+                    pass
+        """,
+        pass_ids=["lock-order"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
 # the gate: the shipped tree is clean, and the CLI agrees
 
 
@@ -1298,7 +1805,9 @@ def test_repo_is_clean():
         "guarded-by", "async-blocking", "except-swallow", "tracer-safety",
         "knob-docs", "http-timeout", "refcount-pairing", "task-lifecycle",
         "counter-contract", "fault-coverage",
+        "frame-contract", "degradation-ladder", "lock-order",
     }
+    assert len(info["passes"]) == 13
 
 
 def test_runner_cli_json():
@@ -1314,7 +1823,9 @@ def test_runner_cli_json():
         "tracer-safety", "knob-docs", "http-timeout",
         "refcount-pairing", "task-lifecycle",
         "counter-contract", "fault-coverage",
+        "frame-contract", "degradation-ladder", "lock-order",
     }
+    assert len(doc["passes"]) == 13  # SARIF/--stats rule count rides this
 
 
 def test_runner_cli_changed_mode():
@@ -1511,3 +2022,40 @@ def test_lock_witness_condition_over_plain_lock():
         th.join(timeout=5)
         assert not th.is_alive()
     w.assert_no_cycles()
+
+
+def test_lock_witness_declared_order():
+    """declare_order mirrors the static pass's [lock-order] order list at
+    runtime: acquisitions matching the hierarchy pass, an inversion fails
+    teardown even when the run never formed a full ABBA cycle."""
+    w = LockWitness()
+    a = w.wrap(threading.Lock(), "A")
+    b = w.wrap(threading.Lock(), "B")
+    w.declare_order([("A", "B")])
+    with a:
+        with b:
+            pass
+    w.assert_declared_order()  # the declared direction: fine
+
+    w2 = LockWitness()
+    a2 = w2.wrap(threading.Lock(), "A")
+    b2 = w2.wrap(threading.Lock(), "B")
+    w2.declare_order([("A", "B")])
+    with b2:
+        with a2:
+            pass
+    w2.assert_no_cycles()  # one order alone is acyclic...
+    with pytest.raises(LockOrderError, match="inverted the declared"):
+        w2.assert_declared_order()  # ...but it contradicts the hierarchy
+
+
+def test_lock_witness_declared_order_is_transitive():
+    w = LockWitness()
+    a = w.wrap(threading.Lock(), "A")
+    c = w.wrap(threading.Lock(), "C")
+    w.declare_order([("A", "B"), ("B", "C")])
+    with c:
+        with a:  # inverts A ->* C through the declared middle hop
+            pass
+    with pytest.raises(LockOrderError, match="inverted the declared"):
+        w.assert_declared_order()

@@ -80,6 +80,11 @@ async def test_stream_token_exact_and_reattach_on_drop():
         h.lock_witness.instrument(backend.engine, "_session_lock", "engine._session_lock")
         h.lock_witness.instrument(backend.engine, "_pending_lock", "engine._pending_lock")
         h.lock_witness.instrument(backend.engine, "_telemetry_lock", "engine._telemetry_lock")
+        # mirror the reviewed [lock-order] hierarchy (allowlist.toml): an
+        # acquisition inverting it fails teardown via assert_declared_order
+        h.lock_witness.declare_order(
+            [("engine._session_lock", "engine._pending_lock")]
+        )
         await backend.start()
         await model_agent.start()
         try:

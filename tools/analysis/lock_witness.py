@@ -114,6 +114,10 @@ class LockWitness:
         # (lock name, hold seconds) for every over-threshold hold that
         # happened on a thread running an asyncio event loop
         self._loop_blocks: list[tuple[str, float]] = []
+        # declared hierarchy: outer name -> inner names that may be
+        # acquired under it (the static lock-order pass's [lock-order]
+        # order list, mirrored at runtime)
+        self._declared: dict[str, set[str]] = {}
 
     # -- instrumentation -------------------------------------------------
 
@@ -215,6 +219,60 @@ class LockWitness:
                 if found:
                     return found
         return None
+
+    def declare_order(self, pairs) -> None:
+        """Declare the intended hierarchy: each ``(outer, inner)`` pair says
+        ``inner`` may be acquired while ``outer`` is held — never the
+        reverse. This is the runtime twin of the static lock-order pass's
+        ``[lock-order] order`` list; ``assert_declared_order()`` fails when
+        an observed acquisition edge inverts the declared reachability."""
+        for outer, inner in pairs:
+            self._declared.setdefault(outer, set()).add(inner)
+
+    def order_inversions(self) -> list[tuple[str, str, tuple[str, ...]]]:
+        """Observed edges (A acquired-while-holding B) where the declared
+        hierarchy reaches A *from* B's successors — i.e. the declaration
+        says A comes before B, but the run acquired them the other way."""
+
+        def reaches(src: str, dst: str) -> bool:
+            seen = {src}
+            frontier = [src]
+            while frontier:
+                cur = frontier.pop()
+                for nxt in self._declared.get(cur, ()):
+                    if nxt == dst:
+                        return True
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            return False
+
+        out: list[tuple[str, str, tuple[str, ...]]] = []
+        with self._mu:
+            observed = [
+                (a, b, site)
+                for a, bs in self._edges.items()
+                for b, site in bs.items()
+            ]
+        for a, b, site in observed:
+            if reaches(b, a):  # hierarchy says b-before-a; run did a-then-b
+                out.append((a, b, site))
+        return out
+
+    def assert_declared_order(self) -> None:
+        """Fail when an observed acquisition inverted the declared lock
+        hierarchy — even if this particular run never formed a full cycle,
+        the inversion means one path disagrees with the reviewed order."""
+        inv = self.order_inversions()
+        if inv:
+            detail = "; ".join(
+                f"acquired {b} while holding {a} (held: {list(site)}) but "
+                f"the declared hierarchy orders {b} before {a}"
+                for a, b, site in inv
+            )
+            raise LockOrderError(
+                f"lock acquisition inverted the declared hierarchy: {detail}"
+            )
 
     def loop_blocks(self) -> list[tuple[str, float]]:
         with self._mu:

@@ -6,11 +6,14 @@ from __future__ import annotations
 from tools.analysis.core import Pass
 from tools.analysis.passes.async_blocking import AsyncBlockingPass
 from tools.analysis.passes.counter_contract import CounterContractPass
+from tools.analysis.passes.degradation_ladder import DegradationLadderPass
 from tools.analysis.passes.except_swallow import ExceptSwallowPass
 from tools.analysis.passes.fault_coverage import FaultCoveragePass
+from tools.analysis.passes.frame_contract import FrameContractPass
 from tools.analysis.passes.guarded_by import GuardedByPass
 from tools.analysis.passes.http_timeout import HttpTimeoutPass
 from tools.analysis.passes.knob_docs import KnobDocsPass
+from tools.analysis.passes.lock_order import LockOrderPass
 from tools.analysis.passes.refcount_pairing import RefcountPairingPass
 from tools.analysis.passes.task_lifecycle import TaskLifecyclePass
 from tools.analysis.passes.tracer_safety import TracerSafetyPass
@@ -26,6 +29,9 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     TaskLifecyclePass,
     CounterContractPass,
     FaultCoveragePass,
+    FrameContractPass,
+    DegradationLadderPass,
+    LockOrderPass,
 )
 
 PASS_IDS: tuple[str, ...] = tuple(p.id for p in ALL_PASSES)

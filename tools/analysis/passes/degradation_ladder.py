@@ -1,0 +1,349 @@
+"""degradation-ladder: every chaos-covered failure degrades, counted.
+
+The dispatch/handoff/spec/kv-fetch paths promise "every failure mode
+degrades" (docs/FAULT_TOLERANCE.md): a failed cross-node fetch re-prefills
+locally, a vetoed handoff keeps decoding single-node, a dead channel falls
+back to POST. Each rung of those ladders has two obligations the chaos
+tests witness dynamically but nothing checked statically until now:
+
+1. **a per-reason counter** — the rung must increment a counter
+   (``stats["..._total"] += 1`` or ``metrics.inc("...")``) so the operator
+   can tell WHICH rung fired (a ladder that degrades uncounted looks
+   identical to one that never fires);
+2. **no escape to the caller** — the rung handles the failure (returns a
+   degraded result, falls through to a fallback) instead of raising. A rung
+   that deliberately re-raises carries ``# afcheck: caller-error <why>`` on
+   the raise (or the rung's opening line) — the pragma IS the
+   classification.
+
+Rungs, per function in ``serving/`` + ``control_plane/``:
+
+- **fault-consult rungs** — the body of every ``if f is not None:`` branch
+  where ``f`` came from ``faults.fire("point")`` (or the engine's
+  ``_engine_fault``/``_kv_fault`` aliases). Stall-shaped rungs — body
+  sleeps and falls through — are exempt: the injected failure manifests
+  downstream, where its ladder is checked.
+- **except rungs** — every except handler in a *ladder function*: one that
+  consults faults, or whose name says it is a dispatch/handoff/spec/
+  kv-fetch path (``_LADDER_NAME_RE``). ``except asyncio.CancelledError``
+  that re-raises is exempt (an external cancel MUST propagate).
+
+Counter search inlines one level of same-file helpers (``self._m()``,
+nested ``def``s, module functions) — the ``fail()`` closure idiom in the
+channel server counts its callers' rungs.
+
+Per-file pass: runs on ``--changed`` walks too (a rung and its counter
+live in the same function).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "degradation-ladder"
+
+_CONSULT_NAMES = {"fire", "_engine_fault", "_kv_fault"}
+
+_LADDER_NAME_RE = re.compile(
+    r"(handoff|fetch_kv|kv_fetch|kv_prefetch|spec_prefill|dispatch|relay)"
+)
+
+_CALLER_ERROR_RE = re.compile(r"#\s*afcheck:\s*caller-error\b")
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _consult_call(node: ast.AST) -> str | None:
+    """``faults.fire("p")`` / ``_engine_fault("p")`` -> "p"."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = attr_chain(node.func)
+    if not chain or chain[-1] not in _CONSULT_NAMES:
+        return None
+    return _const_str(node.args[0]) if node.args else None
+
+
+def _is_counter_stmt(node: ast.AST) -> bool:
+    if isinstance(node, (ast.AugAssign, ast.Assign)):
+        targets = [node.target] if isinstance(node, ast.AugAssign) else node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                chain = attr_chain(t.value)
+                if chain and chain[-1].endswith("stats") and _const_str(t.slice):
+                    return True
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain and chain[-1] == "inc" and node.args and _const_str(node.args[0]):
+            return True
+    return False
+
+
+def _sleeps(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        return bool(chain) and chain[-1] == "sleep"
+    return False
+
+
+class _FileIndex:
+    """Same-file call targets for one-level counter inlining."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.module_fns: dict[str, ast.AST] = {}
+        self.methods: dict[str, ast.AST] = {}  # name -> def (any class)
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_fns[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods.setdefault(sub.name, sub)
+
+    def resolve(self, call: ast.Call, local_defs: dict[str, ast.AST]) -> ast.AST | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            return local_defs.get(chain[0]) or self.module_fns.get(chain[0])
+        if chain[0] == "self" and len(chain) == 2:
+            return self.methods.get(chain[1])
+        return None
+
+
+def _body_counts(
+    stmts: list[ast.stmt], index: _FileIndex, local_defs: dict[str, ast.AST]
+) -> bool:
+    """A counter increment in these statements, or one call-level deeper."""
+    for s in stmts:
+        for node in ast.walk(s):
+            if _is_counter_stmt(node):
+                return True
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call):
+                target = index.resolve(node, local_defs)
+                if target is not None and any(
+                    _is_counter_stmt(n) for n in ast.walk(target)
+                ):
+                    return True
+    return False
+
+
+def _raises(stmts: list[ast.stmt]) -> list[ast.Raise]:
+    """Raise statements that escape these statements (raises inside nested
+    defs or inside a try that catches them are the inner scope's business)."""
+    out: list[ast.Raise] = []
+
+    def walk(body: list[ast.stmt]) -> None:
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Raise):
+                out.append(s)
+                continue
+            if isinstance(s, ast.Try):
+                # handlers/else/finally escape; the try body's raises may be
+                # caught — treat a try with any handler as absorbing them
+                if not s.handlers:
+                    walk(s.body)
+                for h in s.handlers:
+                    walk(h.body)
+                walk(s.orelse)
+                walk(s.finalbody)
+                continue
+            for attr in ("body", "orelse"):
+                sub = getattr(s, attr, None)
+                if isinstance(sub, list):
+                    walk(sub)
+
+    walk(stmts)
+    return out
+
+
+def _walk_shallow(fn: ast.AST) -> list[ast.AST]:
+    """All descendants of ``fn`` WITHOUT descending into nested function or
+    class definitions (their bodies are their own scope's business)."""
+    out: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _pragma_ok(f: SourceFile, *lines: int) -> bool:
+    for ln in lines:
+        for cand in (ln, ln - 1):
+            c = f.comments.get(cand)
+            if c and _CALLER_ERROR_RE.search(c):
+                return True
+    return False
+
+
+def _handler_is_cancel_reraise(h: ast.ExceptHandler) -> bool:
+    names: list[str] = []
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        if t is None:
+            return False
+        chain = attr_chain(t)
+        names.append(chain[-1] if chain else "")
+    if not all(n == "CancelledError" for n in names):
+        return False
+    return any(isinstance(s, ast.Raise) for s in h.body)
+
+
+class DegradationLadderPass(Pass):
+    id = _ID
+    description = (
+        "every fault-consult branch and except rung on the dispatch/"
+        "handoff/spec/kv-fetch paths increments a per-reason counter and "
+        "degrades instead of raising (# afcheck: caller-error opts a "
+        "deliberate re-raise out)"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        parts = rel.split("/")
+        if parts[-1] == "faults.py":
+            return False  # the injector itself, not a consult site
+        return "serving" in parts or "control_plane" in parts
+
+    def check_file(self, ctx: Context, f: SourceFile) -> list[Finding]:
+        index = _FileIndex(f.tree)
+        findings: list[Finding] = []
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._check_function(f, fn, index))
+        return findings
+
+    def _check_function(
+        self,
+        f: SourceFile,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        index: _FileIndex,
+    ) -> list[Finding]:
+        # nested defs belong to their enclosing function's rungs (the
+        # fail() closure idiom); don't re-walk them as standalone functions
+        # but DO make them resolvable for inlining.
+        local_defs: dict[str, ast.AST] = {}
+        consult_vars: set[str] = set()
+        # var -> [(assignment line, fault point)]: the same name is reused
+        # across consecutive consults (`f = fire(...)` idiom), so a rung's
+        # point is the nearest assignment ABOVE it, not "the" assignment
+        consult_points: dict[str, list[tuple[int, str]]] = {}
+        own = _walk_shallow(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                local_defs[node.name] = node
+        for node in own:
+            if isinstance(node, ast.Assign):
+                point = _consult_call(node.value)
+                if point is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            consult_vars.add(t.id)
+                            consult_points.setdefault(t.id, []).append(
+                                (node.lineno, point)
+                            )
+        is_ladder_fn = bool(consult_vars) or bool(
+            _LADDER_NAME_RE.search(fn.name)
+        ) or any(_consult_call(n) is not None for n in own if isinstance(n, ast.Call))
+        out: list[Finding] = []
+        # -- fault-consult rungs ----------------------------------------
+        for node in own:
+            if not isinstance(node, ast.If):
+                continue
+            point = None
+            for e in ast.walk(node.test):
+                p = _consult_call(e)
+                if p is not None:
+                    point = p
+                    break
+                if isinstance(e, ast.Name) and e.id in consult_vars:
+                    prior = [
+                        (ln, p)
+                        for ln, p in consult_points[e.id]
+                        if ln <= node.lineno
+                    ]
+                    if prior:
+                        point = max(prior)[1]
+                        break
+            if point is None:
+                continue
+            body = node.body
+            if any(_sleeps(n) for s in body for n in ast.walk(s)):
+                continue  # stall-shaped: the failure manifests downstream
+            if _pragma_ok(f, node.lineno):
+                continue
+            raises = _raises(body)
+            for r in raises:
+                if not _pragma_ok(f, r.lineno):
+                    out.append(
+                        Finding(
+                            self.id, f.rel, r.lineno,
+                            f"fault rung for {point!r} can raise to the "
+                            "caller — injected failures must degrade, not "
+                            "propagate",
+                            hint="degrade (return/fallback) or mark the "
+                            "deliberate contract with `# afcheck: "
+                            "caller-error <why>`",
+                        )
+                    )
+            if not _body_counts(body, index, local_defs):
+                out.append(
+                    Finding(
+                        self.id, f.rel, node.lineno,
+                        f"fault rung for {point!r} has no per-reason "
+                        "counter — when this ladder fires the operator "
+                        "cannot see which rung degraded",
+                        hint="increment a stats[\"..._total\"] or "
+                        "metrics.inc(...) counter inside the rung",
+                    )
+                )
+        # -- except rungs -----------------------------------------------
+        if not is_ladder_fn:
+            return out
+        for node in own:
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if _handler_is_cancel_reraise(h):
+                    continue
+                if _pragma_ok(f, h.lineno):
+                    continue
+                for r in _raises(h.body):
+                    if not _pragma_ok(f, r.lineno):
+                        out.append(
+                            Finding(
+                                self.id, f.rel, r.lineno,
+                                f"except rung in ladder function "
+                                f"{fn.name!r} re-raises to the caller",
+                                hint="degrade here, or mark the deliberate "
+                                "contract with `# afcheck: caller-error "
+                                "<why>`",
+                            )
+                        )
+                if not _body_counts(h.body, index, local_defs):
+                    out.append(
+                        Finding(
+                            self.id, f.rel, h.lineno,
+                            f"except rung in ladder function {fn.name!r} "
+                            "has no per-reason counter — this failure "
+                            "degrades invisibly",
+                            hint="increment a stats[\"..._total\"] or "
+                            "metrics.inc(...) counter in the handler (or "
+                            "a helper it calls)",
+                        )
+                    )
+        return out

@@ -1,0 +1,270 @@
+"""frame-contract: channel/SSE frame producers and consumers must agree.
+
+The gateway↔node channel (docs/ARCHITECTURE.md "Persistent gateway↔node
+channels") and the SSE client stream speak in tagged JSON frames —
+``{"kind": "token", ...}`` — plus the ``AFKV1`` binary page blobs that ride
+the same WebSocket. Nothing ties the two ends of that wire together
+statically: a producer can start emitting a kind no handler dispatches on
+(silently dropped frames), a handler can keep dispatching on a kind nothing
+sends anymore (dead protocol surface that rots unreviewed), and the frame
+table in ARCHITECTURE.md — the only place an operator can look a frame up —
+can drift from both. Each of those is a finding.
+
+Extraction, over the protocol surface files only (``_FRAME_FILES``):
+
+- **producers** — ``ast.Dict`` literals with a constant ``"kind"`` key and a
+  constant string value (every send site builds its frame as a literal);
+  a ``_pack_kv_blob(...)`` call produces the ``(binary)`` pseudo-kind.
+- **consumers** — comparisons/membership tests against constant strings
+  where the other side is *kind-derived*: ``frame.get("kind")`` /
+  ``frame["kind"]`` on a frame-shaped receiver name, or a local ``kind``
+  assigned from one in the same function (the model node's ``kind, obj =
+  sink`` tuple unpack is deliberately NOT kind-derived — sink kinds are an
+  internal enum, not wire frames); a ``_unpack_kv_blob(...)`` call consumes
+  ``(binary)``.
+- **docs** — a kind is documented when it appears in backticks anywhere in
+  docs/ARCHITECTURE.md (the frame tables there are the source of truth);
+  ``(binary)`` is documented by naming the ``AFKV1`` header.
+
+Allowlist (``[frame-contract]``):
+
+- ``require`` — load-bearing kinds that must keep BOTH a producer and a
+  consumer site (deleting either side fails the suite);
+- ``external`` — kinds with one side outside this tree by design (``ping``
+  is sent by diagnostic tooling, ``start`` is consumed by raw SSE clients);
+  pairing checks are skipped but documentation is still required, and an
+  entry whose kind no longer appears anywhere is stale;
+- ``non_frame`` — constant ``"kind"`` values in the surface files that are
+  not wire frames at all (node-registration payloads).
+
+Producer/consumer inventories live in different files, so this pass runs on
+full walks only (a partial walk cannot tell "no consumer" from "outside
+the walk").
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "frame-contract"
+
+_FRAME_BASENAMES = (
+    "channel.py",
+    "server.py",
+    "gateway.py",
+    "model_node.py",
+    "client.py",
+    "agent.py",
+)
+
+# Receiver names that carry wire frames at dispatch sites; ``n.get("kind")``
+# over a registry node listing must not register as a frame consumer.
+_FRAME_RECEIVERS = {"frame", "frm", "f", "msg", "term", "terminal"}
+
+_BINARY = "(binary)"
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _kind_access(node: ast.AST) -> bool:
+    """``<recv>.get("kind")`` or ``<recv>["kind"]`` on a frame-shaped
+    receiver."""
+    recv: ast.AST | None = None
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+        and _const_str(node.args[0]) == "kind"
+    ):
+        recv = node.func.value
+    elif isinstance(node, ast.Subscript) and _const_str(node.slice) == "kind":
+        recv = node.value
+    if recv is None:
+        return False
+    chain = attr_chain(recv)
+    name = chain[-1] if chain else None
+    return name in _FRAME_RECEIVERS or (name or "").endswith("frame")
+
+
+class _Sites:
+    def __init__(self) -> None:
+        # kind -> first (rel, line) per role
+        self.produced: dict[str, tuple[str, int]] = {}
+        self.consumed: dict[str, tuple[str, int]] = {}
+
+    def produce(self, kind: str, rel: str, line: int) -> None:
+        self.produced.setdefault(kind, (rel, line))
+
+    def consume(self, kind: str, rel: str, line: int) -> None:
+        self.consumed.setdefault(kind, (rel, line))
+
+
+def _scan_function_consumers(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef, sites: _Sites, rel: str
+) -> None:
+    """Comparisons against constant strings where the other side is
+    kind-derived, within one function body (nested defs included — they
+    share the enclosing dispatch context)."""
+    kind_names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _kind_access(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    kind_names.add(t.id)
+
+    def derived(expr: ast.AST) -> bool:
+        if _kind_access(expr):
+            return True
+        return isinstance(expr, ast.Name) and expr.id in kind_names
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        exprs = [node.left, *node.comparators]
+        if not any(derived(e) for e in exprs):
+            continue
+        for e in exprs:
+            k = _const_str(e)
+            if k is not None:
+                sites.consume(k, rel, e.lineno)
+            elif isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                for el in e.elts:
+                    k = _const_str(el)
+                    if k is not None:
+                        sites.consume(k, rel, el.lineno)
+
+
+def _scan_file(f: SourceFile, sites: _Sites) -> None:
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is not None and _const_str(k) == "kind":
+                    kind = _const_str(v)
+                    if kind is not None:
+                        sites.produce(kind, f.rel, v.lineno)
+        elif isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            term = chain[-1] if chain else None
+            if term == "_pack_kv_blob":
+                sites.produce(_BINARY, f.rel, node.lineno)
+            elif term == "_unpack_kv_blob":
+                sites.consume(_BINARY, f.rel, node.lineno)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function_consumers(node, sites, f.rel)
+
+
+class FrameContractPass(Pass):
+    id = _ID
+    description = (
+        "every produced channel/SSE frame kind has a dispatch site, every "
+        "handled kind a producer, and every kind a row in ARCHITECTURE.md's "
+        "frame tables (AFKV1 binary blobs included)"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        return rel.startswith("agentfield_tpu/") and rel.rsplit("/", 1)[-1] in (
+            _FRAME_BASENAMES
+        )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        if not ctx.full_walk:
+            # producers and consumers live at opposite ends of the wire (and
+            # of the file set); a partial walk cannot judge pairing
+            return []
+        sites = _Sites()
+        scanned = False
+        for f in ctx.files:
+            if not self.relevant(f.rel) or ctx.skipped(self.id, f.rel):
+                continue
+            if f.tree is None:
+                continue
+            scanned = True
+            _scan_file(f, sites)
+        if not scanned:
+            return []
+        cfg = ctx.cfg(self.id)
+        external = set(cfg.get("external", []))
+        non_frame = set(cfg.get("non_frame", []))
+        arch = ctx.root / "docs" / "ARCHITECTURE.md"
+        doc_text = arch.read_text(encoding="utf-8") if arch.is_file() else ""
+
+        def documented(kind: str) -> bool:
+            if kind == _BINARY:
+                return "AFKV1" in doc_text
+            return f"`{kind}`" in doc_text
+
+        findings: list[Finding] = []
+        all_kinds = (set(sites.produced) | set(sites.consumed)) - non_frame
+        for kind in sorted(all_kinds):
+            prod = sites.produced.get(kind)
+            cons = sites.consumed.get(kind)
+            if kind not in external:
+                if prod and not cons:
+                    findings.append(
+                        Finding(
+                            self.id, prod[0], prod[1],
+                            f"frame kind {kind!r} is produced here but no "
+                            "receiving side dispatches on it — these frames "
+                            "are sent and silently dropped",
+                            hint="add a handler branch, or delete the send "
+                            "site; a kind with one side outside this tree "
+                            "belongs in [frame-contract] external",
+                        )
+                    )
+                if cons and not prod:
+                    findings.append(
+                        Finding(
+                            self.id, cons[0], cons[1],
+                            f"frame kind {kind!r} is dispatched on here but "
+                            "nothing in the tree produces it — dead protocol "
+                            "surface, or a producer the extractor cannot see "
+                            "(e.g. a pre-encoded bytes literal)",
+                            hint="produce the frame as a dict literal with a "
+                            "constant \"kind\", or delete the handler branch",
+                        )
+                    )
+            site = prod or cons
+            if site and not documented(kind):
+                findings.append(
+                    Finding(
+                        self.id, site[0], site[1],
+                        f"frame kind {kind!r} has no row in "
+                        "docs/ARCHITECTURE.md's frame tables",
+                        hint="add a `kind | direction | meaning` row — the "
+                        "frame table is the wire protocol's source of truth",
+                    )
+                )
+        allow_rel = "tools/analysis/allowlist.toml"
+        for pin in cfg.get("require", []):
+            if pin not in sites.produced or pin not in sites.consumed:
+                side = "producer" if pin not in sites.produced else "consumer"
+                findings.append(
+                    Finding(
+                        self.id, allow_rel, 1,
+                        f"pinned frame kind {pin!r} has no {side} site left "
+                        "in the protocol surface — a load-bearing frame "
+                        "family was deleted or renamed silently",
+                        hint="restore the send/dispatch site, or remove the "
+                        "pin in the same reviewed change that retires the "
+                        "frame from ARCHITECTURE.md",
+                    )
+                )
+        for kind in sorted(external):
+            if kind not in sites.produced and kind not in sites.consumed:
+                findings.append(
+                    Finding(
+                        self.id, allow_rel, 1,
+                        f"[frame-contract] external entry {kind!r} matches "
+                        "no produced or consumed frame kind — the thing it "
+                        "exempted is gone",
+                        hint="delete the entry",
+                    )
+                )
+        return findings

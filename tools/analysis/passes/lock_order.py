@@ -1,0 +1,594 @@
+"""lock-order: the may-hold-while-acquiring graph must stay a declared DAG.
+
+guarded-by proves each field sits under its lock; lock_witness catches a
+bad interleaving at runtime IF a test happens to drive it. Neither proves
+the global property that makes the engine scheduler thread, the journal
+flusher, and the event loop composable: every pair of locks is always
+acquired in the same order. An ABBA inversion is invisible file-by-file —
+this pass builds the interprocedural lock-acquisition graph across
+``serving/`` + ``control_plane/`` and checks it whole.
+
+Model:
+
+- **locks** — ``self.X = threading.Lock()/RLock()/Condition()`` (thread
+  tier) and ``asyncio.Lock()/Condition()`` (async tier) class attributes,
+  plus module-level ``NAME = threading.Lock()`` globals. The two tiers are
+  separate graphs: an asyncio lock parks the coroutine, a threading lock
+  parks the OS thread — ordering only composes within a tier (the
+  async-blocking pass polices sync holds on the loop).
+- **acquisitions** — ``with``/``async with`` on a resolvable lock
+  expression. Resolution follows ``self`` attributes, parameter
+  annotations (``st: _ServerExec``), locals assigned from constructors
+  (``conn = _ServerConn(ws)``), and annotated attribute hops
+  (``st.conn.send`` via ``self.conn: "_ServerConn | None"``).
+- **may-hold-while-acquiring** — inside a ``with`` holding L, a direct
+  acquisition of M or a call whose transitive *may-acquire* summary
+  contains M adds edge L→M. Summaries are a fixpoint over the resolvable
+  call graph (``self.m()``, typed ``obj.m()``, same-module ``f()``).
+  ``*_locked`` / ``# guarded by:`` methods ASSUME their lock (guarded-by
+  enforces the callers), so calling them adds no edge for it.
+
+Findings (full walk only — the graph spans the whole tree):
+
+- a **cycle** in either tier's graph (deadlock one preemption away);
+- a non-reentrant ``Lock`` whose may-acquire reaches itself;
+- an edge **not declared** in ``[lock-order] order`` ("A._x -> B._y"
+  entries) — every intentional hierarchy is written down once, reviewed,
+  and new nestings cannot land silently; an edge whose REVERSE is
+  declared is an inversion of the hierarchy (worse than undeclared);
+- a declared entry no code exhibits (stale, same honesty rule as pragmas).
+
+The runtime twin: ``lock_witness.LockWitness.declare_order`` takes the
+same hierarchy and fails test teardown when an observed acquisition
+inverts it (wired into tests/helpers_cp.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Context, Finding, Pass, SourceFile, attr_chain
+
+_ID = "lock-order"
+
+_THREAD_CTORS = {("threading", "Lock"), ("threading", "RLock"), ("threading", "Condition")}
+_ASYNC_CTORS = {("asyncio", "Lock"), ("asyncio", "Condition")}
+
+
+def _lock_ctor(node: ast.AST) -> tuple[str, str] | None:
+    """``threading.RLock()`` -> ("thread", "RLock"); None when not a lock
+    constructor call."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = tuple(attr_chain(node.func))
+    if chain in _THREAD_CTORS:
+        return "thread", chain[1]
+    if chain in _ASYNC_CTORS:
+        return "async", chain[1]
+    return None
+
+
+def _ann_name(node: ast.AST | None) -> str | None:
+    """Best-effort class name from an annotation: ``_ServerConn``,
+    ``"_ServerConn | None"``, ``Optional[T]``, ``mod.T``."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        for part in node.value.split("|"):
+            part = part.strip().strip('"').strip("'")
+            if part and part != "None":
+                return part.split("[")[0].split(".")[-1]
+        return None
+    if isinstance(node, ast.Subscript):  # Optional[T] / list[T]: take T
+        return _ann_name(node.slice)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _ann_name(node.left) or _ann_name(node.right)
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, name: str, rel: str) -> None:
+        self.name = name
+        self.rel = rel
+        self.locks: dict[str, tuple[str, str]] = {}  # attr -> (tier, kind)
+        self.attr_types: dict[str, str] = {}  # attr -> class name
+        self.methods: dict[str, ast.AST] = {}
+
+
+class _Index:
+    """Cross-file registry of classes, locks, and resolvable functions."""
+
+    def __init__(self) -> None:
+        self.classes: dict[str, _ClassInfo] = {}
+        self.module_fns: dict[tuple[str, str], ast.AST] = {}
+        self.module_locks: dict[tuple[str, str], tuple[str, str]] = {}
+
+    def add_file(self, f: SourceFile) -> None:
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._add_class(f.rel, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_fns[(f.rel, node.name)] = node
+            elif isinstance(node, ast.Assign):
+                lk = _lock_ctor(node.value)
+                if lk is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[(f.rel, t.id)] = lk
+
+    def _add_class(self, rel: str, cls: ast.ClassDef) -> None:
+        info = self.classes.setdefault(cls.name, _ClassInfo(cls.name, rel))
+        for sub in cls.body:
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info.methods[sub.name] = sub
+            params = {
+                a.arg: _ann_name(a.annotation)
+                for a in [*sub.args.posonlyargs, *sub.args.args, *sub.args.kwonlyargs]
+            }
+            for node in ast.walk(sub):
+                target = value = annotation = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value, annotation = node.target, node.value, node.annotation
+                else:
+                    continue
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                attr = target.attr
+                lk = _lock_ctor(value) if value is not None else None
+                if lk is not None:
+                    info.locks[attr] = lk
+                    continue
+                tname = _ann_name(annotation)
+                if tname is None and isinstance(value, ast.Call) and isinstance(
+                    value.func, ast.Name
+                ):
+                    tname = value.func.id
+                if tname is None and isinstance(value, ast.Name):
+                    tname = params.get(value.id)
+                if tname is not None:
+                    info.attr_types.setdefault(attr, tname)
+
+
+# A lock is identified by a display name: "Class._attr" or "mod.py::NAME".
+_Lock = str
+
+# Callables whose call-expression arguments are coroutines/callbacks that run
+# LATER (or on another thread), not under the locks held at the spawn site —
+# `create_task(self._recv_loop(ws))` under a lock is not a call under it.
+_SPAWN_NAMES = {
+    "create_task",
+    "ensure_future",
+    "_task",
+    "to_thread",
+    "run_in_executor",
+    "call_soon",
+    "call_soon_threadsafe",
+    "call_later",
+    "add_done_callback",
+}
+
+
+def _deferred_calls(fn: ast.AST) -> set[int]:
+    """``id()``s of Call nodes that appear as direct arguments to a
+    spawn-shaped call inside ``fn``."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain or chain[-1] not in _SPAWN_NAMES:
+            continue
+        for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+            if isinstance(arg, ast.Call):
+                out.add(id(arg))
+    return out
+
+
+class _Analyzer:
+    def __init__(self, index: _Index) -> None:
+        self.index = index
+        self.lock_kinds: dict[_Lock, tuple[str, str]] = {}
+        for info in index.classes.values():
+            for attr, lk in info.locks.items():
+                self.lock_kinds[f"{info.name}.{attr}"] = lk
+        for (rel, name), lk in index.module_locks.items():
+            self.lock_kinds[f"{rel}::{name}"] = lk
+        # fn key -> set of locks it may acquire (transitively)
+        self.may_acquire: dict[tuple, set[_Lock]] = {}
+        self.calls: dict[tuple, set[tuple]] = {}
+        # (held, acquired) -> (rel, line) of the first witnessing site
+        self.edge_sites: dict[tuple[_Lock, _Lock], tuple[str, int]] = {}
+
+    # -- resolution ------------------------------------------------------
+
+    def _local_types(self, cls: str | None, fn: ast.AST) -> dict[str, str]:
+        types: dict[str, str] = {}
+        for a in [*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs]:
+            t = _ann_name(a.annotation)
+            if t is not None:
+                types[a.arg] = t
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and (
+                v.func.id in self.index.classes
+            ):
+                types[t.id] = v.func.id
+            elif isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) and (
+                v.value.id == "self" and cls is not None
+            ):
+                info = self.index.classes.get(cls)
+                at = info.attr_types.get(v.attr) if info else None
+                if at is not None:
+                    types[t.id] = at
+        return types
+
+    def _chain_type(self, chain: list[str], cls: str | None, types: dict[str, str]) -> str | None:
+        """Type of ``chain[:-1]`` (the receiver of the final segment)."""
+        if chain[0] == "self":
+            cur = cls
+        else:
+            cur = types.get(chain[0])
+        for seg in chain[1:-1]:
+            if cur is None:
+                return None
+            info = self.index.classes.get(cur)
+            cur = info.attr_types.get(seg) if info else None
+        return cur
+
+    def _resolve_lock(
+        self, expr: ast.AST, rel: str, cls: str | None, types: dict[str, str]
+    ) -> _Lock | None:
+        chain = attr_chain(expr)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            if (rel, chain[0]) in self.index.module_locks:
+                return f"{rel}::{chain[0]}"
+            return None
+        owner = self._chain_type(chain, cls, types)
+        if owner is None:
+            return None
+        info = self.index.classes.get(owner)
+        if info is not None and chain[-1] in info.locks:
+            return f"{owner}.{chain[-1]}"
+        return None
+
+    def _resolve_call(
+        self, call: ast.Call, rel: str, cls: str | None, types: dict[str, str]
+    ) -> tuple | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            if (rel, chain[0]) in self.index.module_fns:
+                return ("fn", rel, chain[0])
+            return None
+        owner = self._chain_type(chain, cls, types)
+        if owner is None:
+            return None
+        info = self.index.classes.get(owner)
+        if info is not None and chain[-1] in info.methods:
+            return ("m", owner, chain[-1])
+        return None
+
+    def _fn_node(self, key: tuple) -> tuple[ast.AST, str | None, str]:
+        if key[0] == "m":
+            info = self.index.classes[key[1]]
+            return info.methods[key[2]], key[1], info.rel
+        return self.index.module_fns[(key[1], key[2])], None, key[1]
+
+    # -- summaries -------------------------------------------------------
+
+    def build_summaries(self) -> None:
+        keys: list[tuple] = [
+            ("m", cname, m)
+            for cname, info in self.index.classes.items()
+            for m in info.methods
+        ] + [("fn", rel, name) for (rel, name) in self.index.module_fns]
+        direct: dict[tuple, set[_Lock]] = {}
+        for key in keys:
+            fn, cls, rel = self._fn_node(key)
+            types = self._local_types(cls, fn)
+            deferred = _deferred_calls(fn)
+            acq: set[_Lock] = set()
+            calls: set[tuple] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        lk = self._resolve_lock(item.context_expr, rel, cls, types)
+                        if lk is not None:
+                            acq.add(lk)
+                elif isinstance(node, ast.Call) and id(node) not in deferred:
+                    callee = self._resolve_call(node, rel, cls, types)
+                    if callee is not None:
+                        calls.add(callee)
+            direct[key] = acq
+            self.calls[key] = calls
+        self.may_acquire = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key in keys:
+                cur = self.may_acquire[key]
+                for callee in self.calls[key]:
+                    extra = self.may_acquire.get(callee, set())
+                    if not extra <= cur:
+                        cur |= extra
+                        changed = True
+
+    # -- edge extraction -------------------------------------------------
+
+    def _assumed_locks(self, cls: str | None, fn: ast.AST, f: SourceFile) -> set[_Lock]:
+        """Locks a ``*_locked`` method (or ``# guarded by:`` def-line
+        annotation) assumes are already held — calling it creates no edge
+        for them, and inside it they count as held."""
+        out: set[_Lock] = set()
+        if cls is None:
+            return out
+        info = self.index.classes.get(cls)
+        if info is None:
+            return out
+        comment = f.comments.get(fn.lineno, "")
+        for attr in info.locks:
+            if f"guarded by: {attr}" in comment or (
+                fn.name.endswith("_locked") and attr in comment
+            ):
+                out.add(f"{info.name}.{attr}")
+        return out
+
+    def extract_edges(self, files: list[SourceFile]) -> None:
+        for f in files:
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            self._walk_fn(f, sub, node.name)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk_fn(f, node, None)
+
+    def _walk_fn(self, f: SourceFile, fn: ast.AST, cls: str | None) -> None:
+        types = self._local_types(cls, fn)
+        assumed = self._assumed_locks(cls, fn, f)
+        deferred = _deferred_calls(fn)
+
+        def edge(held: _Lock, acquired: _Lock, line: int) -> None:
+            if held == acquired:
+                kind = self.lock_kinds.get(held, ("", ""))[1]
+                if kind != "Lock":
+                    return  # re-entrant (RLock/Condition) self-hold is fine
+            if self.lock_kinds.get(held, ("?",))[0] != self.lock_kinds.get(
+                acquired, ("!",)
+            )[0]:
+                return  # tiers do not compose into one order
+            self.edge_sites.setdefault((held, acquired), (f.rel, line))
+
+        def traverse(node: ast.AST, held: tuple[_Lock, ...]) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                cur = held
+                for item in node.items:
+                    traverse(item.context_expr, cur)
+                    lk = self._resolve_lock(item.context_expr, f.rel, cls, types)
+                    if lk is not None:
+                        for h in cur:
+                            edge(h, lk, item.context_expr.lineno)
+                        cur = (*cur, lk)
+                for s in node.body:
+                    traverse(s, cur)
+                return
+            if isinstance(node, ast.Call):
+                callee = (
+                    self._resolve_call(node, f.rel, cls, types)
+                    if id(node) not in deferred
+                    else None
+                )
+                if callee is not None and held:
+                    callee_fn, callee_cls, _ = self._fn_node(callee)
+                    callee_file = None
+                    rel = (
+                        self.index.classes[callee[1]].rel
+                        if callee[0] == "m"
+                        else callee[1]
+                    )
+                    for sf in self._files_by_rel.values():
+                        if sf.rel == rel:
+                            callee_file = sf
+                            break
+                    skip = (
+                        self._assumed_locks(callee_cls, callee_fn, callee_file)
+                        if callee_file is not None
+                        else set()
+                    )
+                    for lk in self.may_acquire.get(callee, ()):  # transitive
+                        if lk in skip:
+                            continue
+                        for h in held:
+                            edge(h, lk, node.lineno)
+            for child in ast.iter_child_nodes(node):
+                traverse(child, held)
+
+        for s in fn.body:
+            traverse(s, tuple(sorted(assumed)))
+
+    _files_by_rel: dict[str, SourceFile] = {}
+
+
+class LockOrderPass(Pass):
+    id = _ID
+    description = (
+        "the interprocedural may-hold-while-acquiring graph (threading and "
+        "asyncio tiers separately) is acyclic and every nesting edge is a "
+        "declared [lock-order] hierarchy entry"
+    )
+
+    def relevant(self, rel: str) -> bool:
+        parts = rel.split("/")
+        return "serving" in parts or "control_plane" in parts
+
+    def run(self, ctx: Context) -> list[Finding]:
+        if not ctx.full_walk:
+            # the acquisition graph spans the whole tree; a partial walk
+            # sees fragments of cycles and "missing" declarations
+            return []
+        files = [
+            f for f in ctx.files
+            if self.relevant(f.rel) and not ctx.skipped(self.id, f.rel)
+            and f.tree is not None
+        ]
+        if not files:
+            return []
+        index = _Index()
+        for f in files:
+            index.add_file(f)
+        an = _Analyzer(index)
+        an._files_by_rel = {f.rel: f for f in files}
+        an.build_summaries()
+        an.extract_edges(files)
+
+        declared: set[tuple[str, str]] = set()
+        allow_rel = "tools/analysis/allowlist.toml"
+        findings: list[Finding] = []
+        for entry in ctx.cfg(self.id).get("order", []):
+            if "->" not in entry:
+                findings.append(
+                    Finding(
+                        self.id, allow_rel, 1,
+                        f"[lock-order] order entry {entry!r} is not of the "
+                        "form \"A._x -> B._y\"",
+                        hint="write the held lock, an arrow, then the lock "
+                        "acquired under it",
+                    )
+                )
+                continue
+            a, _, b = entry.partition("->")
+            declared.add((a.strip(), b.strip()))
+
+        edges = sorted(an.edge_sites.items())
+        graph: dict[str, set[str]] = {}
+        for (a, b), _site in edges:
+            graph.setdefault(a, set()).add(b)
+        cycle = _find_cycle(graph)
+        if cycle is not None:
+            pairs = list(zip(cycle, cycle[1:]))
+            site = next(
+                (an.edge_sites[p] for p in pairs if p in an.edge_sites),
+                (allow_rel, 1),
+            )
+            findings.append(
+                Finding(
+                    self.id, site[0], site[1],
+                    "lock acquisition order cycle (deadlock potential): "
+                    + " -> ".join(cycle),
+                    hint="pick ONE order for these locks and restructure "
+                    "the other path(s); the [lock-order] order list is "
+                    "where the chosen hierarchy gets written down",
+                )
+            )
+        used: set[tuple[str, str]] = set()
+        for (a, b), (rel, line) in edges:
+            if a == b:
+                findings.append(
+                    Finding(
+                        self.id, rel, line,
+                        f"non-reentrant lock {a} may be re-acquired while "
+                        "already held — self-deadlock",
+                        hint="make it an RLock, or restructure so the "
+                        "inner path assumes the lock (e.g. a *_locked "
+                        "helper)",
+                    )
+                )
+                continue
+            if (a, b) in declared:
+                used.add((a, b))
+                continue
+            if (b, a) in declared:
+                used.add((b, a))
+                findings.append(
+                    Finding(
+                        self.id, rel, line,
+                        f"acquiring {b} while holding {a} INVERTS the "
+                        f"declared hierarchy \"{b} -> {a}\"",
+                        hint="restructure this path to the declared order "
+                        "(or re-review the hierarchy itself)",
+                    )
+                )
+                continue
+            findings.append(
+                Finding(
+                    self.id, rel, line,
+                    f"undeclared lock-nesting edge: {a} is held while "
+                    f"acquiring {b}",
+                    hint=f"if intentional, declare \"{a} -> {b}\" in "
+                    "[lock-order] order (allowlist.toml) so the hierarchy "
+                    "is reviewed once and witnessed at runtime",
+                )
+            )
+        for a, b in sorted(declared - used):
+            findings.append(
+                Finding(
+                    self.id, allow_rel, 1,
+                    f"[lock-order] order entry \"{a} -> {b}\" matches no "
+                    "observed nesting edge — the hierarchy it declared is "
+                    "gone",
+                    hint="delete the entry (and its runtime declare_order "
+                    "twin) or fix the pass if the nesting still exists",
+                )
+            )
+        return findings
+
+
+def _find_cycle(edges: dict[str, set[str]]) -> list[str] | None:
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict[str, int] = {n: WHITE for n in edges}
+    parent: dict[str, str] = {}
+
+    def dfs(n: str) -> list[str] | None:
+        color[n] = GRAY
+        for m in edges.get(n, ()):
+            if m == n:
+                continue  # self-edges are reported separately
+            c = color.get(m, WHITE)
+            if c == GRAY:
+                cyc = [n]
+                cur = n
+                while cur != m:
+                    cur = parent[cur]
+                    cyc.append(cur)
+                cyc.reverse()
+                cyc.append(m)
+                return cyc
+            if c == WHITE and m in edges:
+                parent[m] = n
+                found = dfs(m)
+                if found:
+                    return found
+            elif c == WHITE:
+                color[m] = BLACK
+        color[n] = BLACK
+        return None
+
+    for n in list(edges):
+        if color[n] == WHITE:
+            found = dfs(n)
+            if found:
+                return found
+    return None
